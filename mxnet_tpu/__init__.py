@@ -1,0 +1,52 @@
+"""mxnet_tpu: a TPU-native deep learning framework with the capabilities of
+MXNet v0.7 (reference: kaiyuzhao/mxnet), re-designed for JAX/XLA/Pallas.
+
+Usage mirrors the reference python package:
+
+    import mxnet_tpu as mx
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, num_hidden=10)
+    mod = mx.mod.Module(mx.sym.SoftmaxOutput(fc), context=mx.tpu())
+"""
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random
+from . import random as rnd
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import executor
+from .executor import Executor
+from . import io
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import kvstore as kv
+from . import kvstore
+from .kvstore import create as create_kvstore
+from . import callback
+from . import monitor
+from .monitor import Monitor
+from . import model
+from .model import FeedForward
+from . import module
+from . import module as mod
+from . import visualization
+from . import visualization as viz
+from . import operator
+from .operator import CustomOp, CustomOpProp, NumpyOp, NDArrayOp
+from . import recordio
+from . import rtc
+from .attribute import AttrScope
+from .name import NameManager, Prefix
+from . import parallel
+
+__version__ = "0.7.0-tpu.1"

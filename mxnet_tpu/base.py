@@ -1,0 +1,70 @@
+"""Base types and helpers for mxnet_tpu.
+
+TPU-native re-design of the reference's dmlc-core surface
+(reference: include/mxnet/base.h, dmlc logging/registry/parameter).
+Instead of a C ABI + ctypes, the Python layer talks straight to JAX;
+the registry/metadata system (op names, param schemas, docstrings)
+is reproduced natively in Python so the introspection capabilities
+(MXListFunctions / MXSymbolGetAtomicSymbolInfo analogues) survive.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "mx_uint", "mx_float",
+           "get_env", "c_array", "MXNetTPUError"]
+
+
+class MXNetError(Exception):
+    """Error raised by mxnet_tpu functions (reference: c_api_error.cc MXGetLastError)."""
+
+
+# Alias — some user code may catch the TPU-flavored name.
+MXNetTPUError = MXNetError
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+# ctypes-era aliases kept for API compatibility of user code that imported them.
+mx_uint = int
+mx_float = float
+
+
+def get_env(name: str, default: Any = None, typ: Callable = str) -> Any:
+    """dmlc::GetEnv equivalent (reference: docs/how_to/env_var.md)."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    try:
+        if typ is bool:
+            return val not in ("0", "false", "False", "")
+        return typ(val)
+    except (TypeError, ValueError):
+        return default
+
+
+def c_array(ctype, values):  # pragma: no cover - compat shim
+    """Compatibility shim: reference python/mxnet/base.py built ctypes arrays."""
+    return list(values)
+
+
+def check_call(ret):  # pragma: no cover - compat shim
+    """Compatibility shim for reference-style check_call(LIB.MX...())."""
+    if ret != 0:
+        raise MXNetError("non-zero return code %s" % str(ret))
+
+
+class _AttrDict(dict):
+    """dict allowing attribute access, used for op parameter bags."""
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError as e:
+            raise AttributeError(key) from e
+
+    def __setattr__(self, key, value):
+        self[key] = value

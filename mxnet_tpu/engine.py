@@ -1,0 +1,131 @@
+"""Execution engine facade. Reference: src/engine/ (1531 LoC), include/mxnet/engine.h.
+
+TPU-native re-design, NOT a port: the reference's dependency engine exists to
+order async operations on mutable buffers (ThreadedVar pending-write queues,
+per-device worker pools, copy threads).  On TPU, XLA's async dispatch plus
+JAX's immutable arrays give the same guarantees by construction:
+
+* serialized writes per Var        -> each write produces a new jax.Array; the
+                                      runtime orders ops by data dependence.
+* WaitToRead / WaitToWrite         -> jax.Array.block_until_ready() on the
+                                      current buffer.
+* WaitForAll                       -> barrier over all recently dispatched
+                                      arrays (tracked here via weakrefs).
+* NaiveEngine (sync debug mode)    -> MXNET_ENGINE_TYPE=NaiveEngine blocks
+                                      after every op (jax.block_until_ready),
+                                      the reference's deterministic-debugging
+                                      workflow (threaded_engine.h:302-315).
+* FnProperty / worker pools        -> PJRT/XLA stream scheduling; no user
+                                      tuning needed, knobs accepted + ignored.
+
+The facade preserves the public Engine API surface so user code and the rest
+of the framework keep the same call sites as the reference.
+"""
+from __future__ import annotations
+
+import os
+import weakref
+from typing import Any, Callable, Iterable, List
+
+import jax
+
+from .base import get_env
+
+__all__ = ["Engine", "engine", "naive_mode", "wait_for_all", "track"]
+
+
+class FnProperty:
+    """Scheduling hints (reference include/mxnet/engine.h:58-69). Accepted, unused."""
+    kNormal = 0
+    kCopyFromGPU = 1
+    kCopyToGPU = 2
+    kCPUPrioritized = 3
+    kAsync = 4
+
+
+class Engine:
+    """Singleton engine facade."""
+
+    def __init__(self):
+        # MXNET_ENGINE_TYPE=NaiveEngine -> force synchronous execution
+        # (reference src/engine/engine.cc:13-39).
+        self._naive = get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") == "NaiveEngine"
+        # weak references to recently produced arrays, for WaitForAll.
+        self._pending: "weakref.WeakSet" = weakref.WeakSet()
+
+    # -- mode ---------------------------------------------------------------
+    @property
+    def is_naive(self) -> bool:
+        return self._naive
+
+    def set_naive(self, value: bool) -> None:
+        self._naive = bool(value)
+
+    # -- tracking -----------------------------------------------------------
+    def track(self, arr: Any) -> Any:
+        """Register a dispatched jax.Array so WaitForAll can find it.
+
+        In naive mode, block immediately (NaiveEngine semantics).
+        """
+        if arr is None:
+            return arr
+        if self._naive:
+            try:
+                jax.block_until_ready(arr)
+            except Exception:
+                pass
+            return arr
+        try:
+            self._pending.add(arr)
+        except TypeError:  # not weak-referenceable (e.g. python scalar)
+            pass
+        return arr
+
+    # -- waits --------------------------------------------------------------
+    def wait_for_var(self, arr: Any) -> None:
+        """WaitForVar (reference engine.h:191): block until arr is computed."""
+        if arr is not None:
+            jax.block_until_ready(arr)
+
+    def wait_for_all(self) -> None:
+        """WaitForAll (reference engine.h:197): barrier over all pending work."""
+        pending = list(self._pending)
+        self._pending.clear()
+        for arr in pending:
+            try:
+                jax.block_until_ready(arr)
+            except Exception:
+                pass
+
+    # -- push (compat) ------------------------------------------------------
+    def push(self, fn: Callable[[], Any], *_args, **_kwargs) -> Any:
+        """PushSync/PushAsync analogue: run fn now (XLA dispatch is async)."""
+        out = fn()
+        return self.track(out)
+
+
+_ENGINE = Engine()
+
+
+def engine() -> Engine:
+    return _ENGINE
+
+
+def track(arr):
+    return _ENGINE.track(arr)
+
+
+def wait_for_all() -> None:
+    _ENGINE.wait_for_all()
+
+
+class naive_mode:
+    """Context manager forcing synchronous execution (debugging aid)."""
+
+    def __enter__(self):
+        self._old = _ENGINE.is_naive
+        _ENGINE.set_naive(True)
+        return self
+
+    def __exit__(self, *exc):
+        _ENGINE.set_naive(self._old)

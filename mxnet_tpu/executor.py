@@ -1,0 +1,447 @@
+"""Executor: binds a Symbol to devices/arrays and runs forward/backward.
+
+Reference: src/symbol/graph_executor.cc (1164 LoC), include/mxnet/symbolic.h:
+323-391, python/mxnet/executor.py (339 LoC).
+
+TPU-native design (SURVEY §7): instead of the reference's per-node engine
+dispatch with a hand-written memory planner, the whole graph lowers to ONE
+XLA program per (shapes, dtypes, is_train) via jax.jit — XLA does fusion,
+layout, rematerialization and memory planning (the reference's
+GraphStorageAllocator / bulk-exec InitOpSegs collapse into the compiler).
+The backward pass is jax.vjp over the traced graph — the reference's
+MakeBackwardPass gradient nodes + addto aggregation come from autodiff, with
+loss-layer semantics preserved by the ops' custom_vjp definitions.
+
+Two execution modes mirror the reference's bulk-exec vs NaiveEngine split:
+* jit mode (default): fused whole-graph program; used for speed.
+* eager mode: node-by-node execution with per-op device placement and
+  monitor callbacks — this is what powers Monitor, debug_str parity, and
+  ctx_group model parallelism (AssignContext + _CrossDeviceCopy insertion,
+  graph_executor.cc:391-508, becomes per-node jax.device_put).
+
+``force_mirroring`` attrs / MXNET_BACKWARD_DO_MIRROR map onto jax.checkpoint
+(the memonger hook, static_graph.cc:404-437).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, get_env
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, zeros as nd_zeros, array as nd_array
+from .ops.registry import OpContext
+from . import random as _random
+from .symbol import Symbol, _topo, _Node
+
+__all__ = ["Executor", "bind", "simple_bind"]
+
+
+def _node_aux_names(node: _Node) -> List[str]:
+    return ["%s_%s" % (node.name, an)
+            for an in node.op.list_auxiliary_states(node.params)]
+
+
+class _GraphProgram:
+    """Pure function over (args, aux, rng, is_train) compiled once per mode."""
+
+    def __init__(self, symbol: Symbol, node_ctx: Dict[int, Context],
+                 single_ctx: Optional[Context], do_mirror: bool):
+        self.symbol = symbol
+        self.topo = _topo(symbol._heads)
+        self.node_ctx = node_ctx
+        self.single_ctx = single_ctx
+        self.do_mirror = do_mirror
+        self._monitor = None
+
+    def set_monitor(self, cb):
+        self._monitor = cb
+
+    def eval(self, args: Dict[str, Any], aux: Dict[str, Any], rng,
+             is_train: bool, eager: bool = False):
+        """Evaluate the graph; returns (outputs, new_aux)."""
+        vals: Dict[Tuple[int, int], Any] = {}
+        new_aux: Dict[str, Any] = {}
+        for k, node in enumerate(self.topo):
+            if node.is_variable:
+                if node.name not in args:
+                    raise MXNetError("executor missing argument %r" % node.name)
+                v = args[node.name]
+                if eager and self.node_ctx.get(id(node)) is not None:
+                    v = jax.device_put(v, self.node_ctx[id(node)].jax_device())
+                vals[(id(node), 0)] = v
+                continue
+            ins = [vals[(id(i), x)] for (i, x) in node.inputs]
+            if eager:
+                tgt = self.node_ctx.get(id(node))
+                if tgt is not None:
+                    dev = tgt.jax_device()
+                    ins = [jax.device_put(x, dev) for x in ins]
+            aux_names = _node_aux_names(node)
+            aux_in = [aux[a] for a in aux_names]
+            key = jax.random.fold_in(rng, k) if node.op.needs_rng else None
+            opctx = OpContext(is_train=is_train, rng=key)
+
+            def run(op=node.op, p=node.params, ins=ins, aux_in=aux_in, opctx=opctx):
+                return op.forward(p, ins, aux_in, opctx)
+
+            mirror = (self.do_mirror
+                      or node.attrs.get("force_mirroring", "").lower() == "true")
+            if mirror and not aux_names:
+                outs = jax.checkpoint(
+                    lambda *i: node.op.forward(node.params, list(i), [], opctx))(*ins)
+            else:
+                outs = run()
+            if isinstance(outs, tuple):
+                outs, aux_out = outs
+                for a, v in zip(aux_names, aux_out):
+                    new_aux[a] = v
+            for i, o in enumerate(outs):
+                vals[(id(node), i)] = o
+            if self._monitor is not None and eager:
+                out_names = node.op.list_outputs(node.params)
+                for i, o in enumerate(outs):
+                    nm = ("%s_%s" % (node.name, out_names[i])
+                          if len(outs) > 1 else "%s_output" % node.name)
+                    self._monitor(nm, o)
+        outputs = [vals[(id(n), i)] for (n, i) in self.symbol._heads]
+        return outputs, new_aux
+
+
+class Executor:
+    """Bound executor (reference python/mxnet/executor.py)."""
+
+    def __init__(self, symbol: Symbol, ctx: Context,
+                 arg_dict: Dict[str, NDArray],
+                 grad_dict: Dict[str, Optional[NDArray]],
+                 grad_req: Dict[str, str],
+                 aux_dict: Dict[str, NDArray],
+                 group2ctx: Optional[Dict[str, Context]] = None,
+                 shared_exec: Optional["Executor"] = None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        self._grad_req = grad_req
+        self._group2ctx = group2ctx or {}
+        self._monitor_callback = None
+        self._outputs_nd: Optional[List[NDArray]] = None
+        self._pending_grads = None
+        self._rng_seed = 0
+
+        self.arg_arrays = [arg_dict[n] for n in symbol.list_arguments()]
+        self.grad_arrays = [grad_dict.get(n) for n in symbol.list_arguments()]
+        self.aux_arrays = [aux_dict[n] for n in symbol.list_auxiliary_states()]
+
+        # device placement per node (AssignContext, graph_executor.cc:391-508)
+        node_ctx: Dict[int, Context] = {}
+        multi_ctx = False
+        for node in _topo(symbol._heads):
+            grp = node.attrs.get("ctx_group")
+            c = self._group2ctx.get(grp, ctx) if grp else ctx
+            node_ctx[id(node)] = c
+            if c != ctx:
+                multi_ctx = True
+        do_mirror = bool(get_env("MXNET_BACKWARD_DO_MIRROR", 0, int))
+        self._prog = _GraphProgram(symbol, node_ctx,
+                                   None if multi_ctx else ctx, do_mirror)
+        self._eager = multi_ctx
+        self._jit_cache: Dict[Any, Any] = {}
+
+        # names of args that receive gradients
+        self._grad_names = [n for n in symbol.list_arguments()
+                            if grad_req.get(n, "null") != "null"
+                            and grad_dict.get(n) is not None]
+
+    # -- helpers ------------------------------------------------------------
+    @property
+    def outputs(self) -> List[NDArray]:
+        if self._outputs_nd is None:
+            raise MXNetError("call forward() first")
+        return self._outputs_nd
+
+    @property
+    def output_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def _args_jax(self):
+        return {k: v._get() for k, v in self.arg_dict.items()}
+
+    def _aux_jax(self):
+        return {k: v._get() for k, v in self.aux_dict.items()}
+
+    def _next_rng(self):
+        self._rng_seed += 1
+        return _random.new_key()
+
+    def _get_jit(self, kind: str):
+        """kind: 'fwd_train' | 'fwd_eval' | 'fwdbwd'."""
+        if kind in self._jit_cache:
+            return self._jit_cache[kind]
+        prog = self._prog
+        if kind == "fwdbwd":
+            grad_names = tuple(self._grad_names)
+
+            def fn(gargs, sargs, aux, rng, head_grads):
+                def inner(gargs):
+                    allargs = dict(sargs)
+                    allargs.update(gargs)
+                    outs, new_aux = prog.eval(allargs, aux, rng, True)
+                    return outs, new_aux
+                outs, vjp_fn, new_aux = jax.vjp(inner, gargs, has_aux=True)
+                grads = vjp_fn(list(head_grads))[0]
+                return outs, grads, new_aux
+            jfn = jax.jit(fn)
+        else:
+            is_train = (kind == "fwd_train")
+
+            def fn(args, aux, rng, _t=is_train):
+                return prog.eval(args, aux, rng, _t)
+            jfn = jax.jit(fn)
+        self._jit_cache[kind] = jfn
+        return jfn
+
+    # -- forward / backward -------------------------------------------------
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        """Run forward (reference executor.py:60).  kwargs update args."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %r" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k][:] = v
+            else:
+                self.arg_dict[k][:] = nd_array(v, dtype=self.arg_dict[k].dtype)
+        args, aux = self._args_jax(), self._aux_jax()
+        rng = self._next_rng()
+        if self._eager or self._monitor_callback is not None:
+            self._prog.set_monitor(self._monitor_callback)
+            outs, new_aux = self._prog.eval(args, aux, rng, is_train, eager=True)
+        else:
+            outs, new_aux = self._get_jit(
+                "fwd_train" if is_train else "fwd_eval")(args, aux, rng)
+        if is_train:
+            for k, v in new_aux.items():
+                self.aux_dict[k]._set(v)
+        self._outputs_nd = [NDArray(o) for o in outs]
+        self._pending_grads = None
+        self._last_rng = rng
+        return self._outputs_nd
+
+    def backward(self, out_grads=None) -> None:
+        """Run backward (reference executor.py:91): fills grad arrays
+        honoring grad_req write/add/null."""
+        if self._outputs_nd is None:
+            raise MXNetError("backward() requires a prior forward(is_train=True)")
+        if out_grads is None:
+            head_grads = [jnp.ones_like(o._get()) for o in self._outputs_nd]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head_grads = [g._get() if isinstance(g, NDArray) else jnp.asarray(g)
+                          for g in out_grads]
+        args, aux = self._args_jax(), self._aux_jax()
+        gargs = {k: args[k] for k in self._grad_names}
+        sargs = {k: v for k, v in args.items() if k not in gargs}
+        if self._eager or self._monitor_callback is not None:
+            def inner(gargs):
+                allargs = dict(sargs)
+                allargs.update(gargs)
+                outs, new_aux = self._prog.eval(allargs, aux, self._last_rng,
+                                                True, eager=True)
+                return outs, new_aux
+            outs, vjp_fn, _ = jax.vjp(inner, gargs, has_aux=True)
+            grads = vjp_fn(list(head_grads))[0]
+        else:
+            _, grads, _ = self._get_jit("fwdbwd")(
+                gargs, sargs, aux, self._last_rng, tuple(head_grads))
+        for name in self._grad_names:
+            g = grads[name]
+            tgt = self.grad_dict[name]
+            if self._grad_req.get(name) == "add":
+                tgt._set(tgt._get() + g)
+            else:
+                tgt._set(jnp.asarray(g, dtype=tgt.dtype))
+
+    # -- misc API ------------------------------------------------------------
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **new_shapes):
+        """Return a new executor with new input shapes (reference executor.py
+        reshape); weights are shared by value."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**new_shapes)
+        if arg_shapes is None:
+            raise MXNetError("cannot infer shapes for reshape")
+        new_args = {}
+        for name, sh in zip(self._symbol.list_arguments(), arg_shapes):
+            old = self.arg_dict[name]
+            if tuple(old.shape) == tuple(sh):
+                new_args[name] = old
+            else:
+                new_args[name] = nd_zeros(sh, ctx=self._ctx, dtype=old.dtype)
+        new_grads = {}
+        for name, sh in zip(self._symbol.list_arguments(), arg_shapes):
+            old = self.grad_dict.get(name)
+            if old is None:
+                continue
+            new_grads[name] = old if tuple(old.shape) == tuple(sh) else \
+                nd_zeros(sh, ctx=self._ctx, dtype=old.dtype)
+        new_aux = {}
+        for name, sh in zip(self._symbol.list_auxiliary_states(), aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = old if tuple(old.shape) == tuple(sh) else \
+                nd_zeros(sh, ctx=self._ctx, dtype=old.dtype)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, new_aux, self._group2ctx)
+
+    def copy_params_from(self, arg_params: Dict[str, NDArray],
+                         aux_params: Optional[Dict[str, NDArray]] = None,
+                         allow_extra_params: bool = False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name][:] = arr
+            elif not allow_extra_params:
+                raise MXNetError("Found name %r not in executor arguments" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name][:] = arr
+                elif not allow_extra_params:
+                    raise MXNetError("Found name %r not in executor aux states" % name)
+
+    def set_monitor_callback(self, callback):
+        """Install per-op output monitor (reference symbolic.h:386-390);
+        switches execution to the node-level (eager) mode."""
+        def cb(name, jarr):
+            callback(name, NDArray(jarr))
+        self._monitor_callback = cb
+
+    def debug_str(self) -> str:
+        """Execution plan dump (reference graph_executor.cc:955-988)."""
+        lines = ["Symbol Outputs:", "\t" + ", ".join(self._symbol.list_outputs())]
+        total = 0
+        for node in self._prog.topo:
+            if node.is_variable:
+                lines.append("Variable:%s ctx=%s" % (
+                    node.name, self._prog.node_ctx.get(id(node), self._ctx)))
+            else:
+                lines.append("Op:%s Name=%s ctx=%s" % (
+                    node.op.name, node.name,
+                    self._prog.node_ctx.get(id(node), self._ctx)))
+                for (i, x) in node.inputs:
+                    lines.append("\targ[%d]=%s" % (x, i.name))
+        for arr in list(self.arg_dict.values()) + list(self.aux_dict.values()):
+            total += arr.size * arr.dtype.itemsize
+        lines.append("Total %.1f MB allocated (args+aux)" % (total / 2**20))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# binding entry points (reference c_api.cc MXExecutorBind / symbol.py bind)
+
+def bind(symbol: Symbol, ctx: Context, args, args_grad=None, grad_req="write",
+         aux_states=None, group2ctx=None, shared_exec=None) -> Executor:
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+
+    if isinstance(args, (list, tuple)):
+        if len(args) != len(arg_names):
+            raise MXNetError("bind needs %d args, got %d" % (len(arg_names), len(args)))
+        arg_dict = dict(zip(arg_names, args))
+    else:
+        arg_dict = dict(args)
+        missing = [n for n in arg_names if n not in arg_dict]
+        if missing:
+            raise MXNetError("bind missing arguments %s" % missing)
+
+    if args_grad is None:
+        grad_dict = {}
+    elif isinstance(args_grad, (list, tuple)):
+        grad_dict = dict(zip(arg_names, args_grad))
+    else:
+        grad_dict = dict(args_grad)
+
+    if isinstance(grad_req, str):
+        req = {n: grad_req for n in arg_names}
+    elif isinstance(grad_req, (list, tuple)):
+        req = dict(zip(arg_names, grad_req))
+    else:
+        req = dict(grad_req)
+    for n in arg_names:
+        if n not in grad_dict:
+            req[n] = "null"
+
+    if aux_states is None:
+        aux_list = []
+        if aux_names:
+            _, _, aux_shapes = symbol.infer_shape(
+                **{n: a.shape for n, a in arg_dict.items()})
+            for n, sh in zip(aux_names, aux_shapes):
+                aux_list.append(nd_zeros(sh, ctx=ctx))
+        aux_dict = dict(zip(aux_names, aux_list))
+    elif isinstance(aux_states, (list, tuple)):
+        aux_dict = dict(zip(aux_names, aux_states))
+    else:
+        aux_dict = dict(aux_states)
+
+    return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict,
+                    group2ctx=group2ctx, shared_exec=shared_exec)
+
+
+def simple_bind(symbol: Symbol, ctx: Context, grad_req="write", type_dict=None,
+                group2ctx=None, shared_exec=None, **kwargs) -> Executor:
+    """Infer shapes, allocate arrays, bind (reference symbol.py:630-700)."""
+    arg_shapes, _, aux_shapes = symbol.infer_shape(**kwargs)
+    if arg_shapes is None:
+        raise MXNetError("simple_bind cannot infer all shapes from %s" % kwargs)
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    type_dict = type_dict or {}
+    attrs = symbol.attr_dict()
+
+    def _ctx_for(name):
+        grp = attrs.get(name, {}).get("ctx_group")
+        if grp and group2ctx and grp in group2ctx:
+            return group2ctx[grp]
+        return ctx
+
+    arg_dict = {}
+    for name, sh in zip(arg_names, arg_shapes):
+        dt = type_dict.get(name, np.float32)
+        # reuse shared_exec arrays of identical shape (bucketing memory share,
+        # reference graph_executor.h:50-56 GraphStoragePool)
+        if shared_exec is not None and name in shared_exec.arg_dict and \
+                tuple(shared_exec.arg_dict[name].shape) == tuple(sh):
+            arg_dict[name] = shared_exec.arg_dict[name]
+        else:
+            arg_dict[name] = nd_zeros(sh, ctx=_ctx_for(name), dtype=dt)
+
+    if isinstance(grad_req, str):
+        req = {n: grad_req for n in arg_names}
+    elif isinstance(grad_req, (list, tuple)):
+        req = dict(zip(arg_names, grad_req))
+    else:
+        req = {n: grad_req.get(n, "null") for n in arg_names}
+
+    grad_dict = {}
+    for name, sh in zip(arg_names, arg_shapes):
+        if req.get(name, "null") != "null":
+            if shared_exec is not None and name in shared_exec.grad_dict and \
+                    shared_exec.grad_dict[name] is not None and \
+                    tuple(shared_exec.grad_dict[name].shape) == tuple(sh):
+                grad_dict[name] = shared_exec.grad_dict[name]
+            else:
+                grad_dict[name] = nd_zeros(sh, ctx=_ctx_for(name),
+                                           dtype=type_dict.get(name, np.float32))
+
+    aux_dict = {}
+    for name, sh in zip(aux_names, aux_shapes):
+        if shared_exec is not None and name in shared_exec.aux_dict and \
+                tuple(shared_exec.aux_dict[name].shape) == tuple(sh):
+            aux_dict[name] = shared_exec.aux_dict[name]
+        else:
+            aux_dict[name] = nd_zeros(sh, ctx=ctx)
+
+    return Executor(symbol, ctx, arg_dict, grad_dict, req, aux_dict,
+                    group2ctx=group2ctx, shared_exec=shared_exec)

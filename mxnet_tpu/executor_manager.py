@@ -1,0 +1,229 @@
+"""Data-parallel executor manager (used by FeedForward).
+
+Reference: python/mxnet/executor_manager.py (406 LoC): _split_input_slice
+workload split, _bind_exec, DataParallelExecutorManager with per-device
+executor replicas and param/grad array views.
+
+TPU-native: per-device executors are separate jit programs per device (the
+fake-device CPU trick works unchanged); the fused mesh path lives in
+parallel/ and is used by Module when all devices sit in one jax mesh.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context
+from .ndarray import NDArray, zeros as nd_zeros, array as nd_array
+from .symbol import Symbol
+
+__all__ = ["DataParallelExecutorManager", "_split_input_slice",
+           "_check_arguments", "_load_data", "_load_label"]
+
+
+def _split_input_slice(batch_size: int, work_load_list: Sequence[float]):
+    """Split batch into per-device slices (reference executor_manager.py:13)."""
+    total_work_load = sum(work_load_list)
+    batch_num_list = [round(batch_size * (float(work_load) / total_work_load))
+                      for work_load in work_load_list]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise ValueError("Too many slices such that some splits are empty")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _check_arguments(symbol: Symbol):
+    """Check duplicated argument/aux names (reference executor_manager.py:48)."""
+    arg_names = symbol.list_arguments()
+    if len(set(arg_names)) != len(arg_names):
+        raise ValueError("Find duplicated argument name, argument names: %s"
+                         % str(arg_names))
+    aux_names = symbol.list_auxiliary_states()
+    if len(set(aux_names)) != len(aux_names):
+        raise ValueError("Find duplicated auxiliary param name, names: %s"
+                         % str(aux_names))
+
+
+def _load_general(data, targets):
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, NDArray):
+            d_src.copyto(d_targets)
+        else:
+            for slice_idx, d_dst in d_targets:
+                d_src[slice_idx.start:slice_idx.stop].copyto(d_dst)
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+def _bind_exec(sym: Symbol, ctx: Context, input_shapes: Dict[str, tuple],
+               param_names: Sequence[str], need_grad=False,
+               base_exec=None, shared_data_arrays=None,
+               input_types=None, logger=logging):
+    """Bind one executor (reference executor_manager.py:94-178)."""
+    grad_req = {}
+    for name in sym.list_arguments():
+        if need_grad and name in param_names:
+            grad_req[name] = "write"
+        else:
+            grad_req[name] = "null"
+    exe = sym.simple_bind(ctx, grad_req=grad_req, type_dict=input_types,
+                          shared_exec=base_exec, **input_shapes)
+    return exe
+
+
+class DataParallelExecutorGroup:
+    """One executor per device over batch slices
+    (merged from reference executor_manager.py ExecutorGroup)."""
+
+    def __init__(self, sym: Symbol, arg_names, param_names, ctx, slices,
+                 train_data, shared_group=None):
+        _check_arguments(sym)
+        self.arg_names = arg_names
+        self.param_names = param_names
+        data_shapes = dict(train_data.provide_data + train_data.provide_label)
+        self.data_names = [x[0] for x in train_data.provide_data]
+        self.label_names = [x[0] for x in train_data.provide_label]
+
+        self.train_execs = []
+        for i, ctxi in enumerate(ctx):
+            shapes = {k: tuple([slices[i].stop - slices[i].start] + list(v[1:]))
+                      for k, v in data_shapes.items()}
+            base = shared_group.train_execs[i] if shared_group else None
+            exe = _bind_exec(sym, ctxi, shapes, param_names,
+                             need_grad=True, base_exec=base)
+            self.train_execs.append(exe)
+
+        self.data_arrays = [
+            [(slices[i], e.arg_dict[name]) for i, e in enumerate(self.train_execs)]
+            for name in self.data_names]
+        self.label_arrays = [
+            [(slices[i], e.arg_dict[name]) for i, e in enumerate(self.train_execs)]
+            for name in self.label_names]
+
+        self.param_idx = [i for i in range(len(arg_names))
+                          if arg_names[i] in param_names]
+        self.param_names = [arg_names[i] for i in self.param_idx]
+        self.param_arrays = [[e.arg_arrays[i] for e in self.train_execs]
+                             for i in self.param_idx]
+        self.grad_arrays = [[e.grad_arrays[i] for e in self.train_execs]
+                            for i in self.param_idx]
+        self.aux_arrays = [[e.aux_arrays[i] for e in self.train_execs]
+                           for i in range(len(sym.list_auxiliary_states()))]
+        self.slices = slices
+
+    def load_data_batch(self, data_batch):
+        _load_data(data_batch, self.data_arrays)
+        _load_label(data_batch, self.label_arrays)
+
+    def forward(self, is_train=False):
+        for texec in self.train_execs:
+            texec.forward(is_train=is_train)
+
+    def backward(self):
+        for texec in self.train_execs:
+            texec.backward()
+
+    def update_metric(self, metric, labels):
+        for texec, islice in zip(self.train_execs, self.slices):
+            labels_slice = [label[islice.start:islice.stop] for label in labels]
+            metric.update(labels_slice, texec.outputs)
+
+
+class DataParallelExecutorManager:
+    """Top-level helper for multi-device training
+    (reference executor_manager.py:264-406)."""
+
+    def __init__(self, symbol, ctx, train_data, param_names, arg_names,
+                 aux_names, work_load_list=None, logger=None, sym_gen=None):
+        if logger is None:
+            logger = logging
+        num_device = len(ctx)
+        logger.info("Start training with %s", str(ctx))
+        if work_load_list is None:
+            work_load_list = [1] * num_device
+        assert isinstance(work_load_list, list) and len(work_load_list) == num_device
+        self.slices = _split_input_slice(train_data.batch_size, work_load_list)
+        self.arg_names = arg_names
+        self.param_names = param_names
+        self.aux_names = aux_names
+        self.ctx = ctx
+        self.symbol = symbol
+        self.sym_gen = sym_gen
+        self.curr_execgrp = None
+        self.execgrp_bucket = {}
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, self.arg_names, self.param_names, self.ctx,
+            self.slices, train_data)
+        if self.sym_gen is not None:
+            self.execgrp_bucket = {train_data.default_bucket_key: self.execgrp}
+
+    def install_monitor(self, monitor):
+        if self.sym_gen is not None:
+            raise NotImplementedError("Monitoring is not implemented for bucketing")
+        for train_exec in self.execgrp.train_execs:
+            monitor.install(train_exec)
+
+    def set_params(self, arg_params, aux_params):
+        for texec in self.execgrp.train_execs:
+            texec.copy_params_from(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        """Copy current (averaged over devices) params to dicts."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(w.copyto(Context("cpu"))._get() for w in block) / len(block)
+            arg_params[name][:] = NDArray(weight).astype(arg_params[name].dtype)
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(w.copyto(Context("cpu"))._get() for w in block) / len(block)
+            aux_params[name][:] = NDArray(weight).astype(aux_params[name].dtype)
+
+    @property
+    def param_arrays(self):
+        return self.execgrp.param_arrays
+
+    @property
+    def grad_arrays(self):
+        return self.execgrp.grad_arrays
+
+    @property
+    def aux_arrays(self):
+        return self.execgrp.aux_arrays
+
+    def load_data_batch(self, data_batch):
+        if self.sym_gen is not None:
+            key = data_batch.bucket_key
+            if key not in self.execgrp_bucket:
+                symbol = self.sym_gen(key)
+                execgrp = DataParallelExecutorGroup(
+                    symbol, self.arg_names, self.param_names, self.ctx,
+                    self.slices, data_batch, shared_group=self.execgrp)
+                self.execgrp_bucket[key] = execgrp
+            self.curr_execgrp = self.execgrp_bucket[key]
+        else:
+            self.curr_execgrp = self.execgrp
+        self.curr_execgrp.load_data_batch(data_batch)
+
+    def forward(self, is_train=False):
+        self.curr_execgrp.forward(is_train=is_train)
+
+    def backward(self):
+        self.curr_execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        self.curr_execgrp.update_metric(metric, labels)

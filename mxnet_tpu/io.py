@@ -1,0 +1,510 @@
+"""Data iterators. Reference: python/mxnet/io.py (605 LoC), src/io/ (2006 LoC).
+
+DataIter protocol, DataBatch, NDArrayIter (numpy in-memory, shuffle, pad),
+ResizeIter, PrefetchingIter (thread prefetch, the PrefetcherIter analogue),
+MNISTIter (idx-format files), CSVIter, ImageRecordIter (RecordIO + packed
+image records; decode via PIL when available).
+
+TPU-native notes: batches land on host as numpy; the executor's H2D transfer
+is async (the reference's dedicated copy-worker threads collapse into PJRT
+async transfers).  PrefetchingIter double-buffers exactly like
+iter_prefetcher.h:16-130.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import threading
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["DataIter", "DataBatch", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MNISTIter", "CSVIter", "ImageRecordIter"]
+
+
+DataDesc = namedtuple("DataDesc", ["name", "shape"])
+
+
+class DataBatch:
+    """One batch (reference io.py DataBatch)."""
+
+    def __init__(self, data, label, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator protocol (reference io.py:64)."""
+
+    def __init__(self):
+        self.batch_size = 0
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def iter_next(self):
+        raise NotImplementedError()
+
+    def getdata(self):
+        raise NotImplementedError()
+
+    def getlabel(self):
+        raise NotImplementedError()
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError()
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input to list of (name, numpy) (reference io.py:219)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them "
+                        "or dict with them as values")
+    out = {}
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out[k] = np.ascontiguousarray(np.asarray(v, dtype=np.float32))
+    return list(sorted(out.items()))
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator with shuffle/pad (reference io.py:319)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__()
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.batch_size = batch_size
+
+        self.num_data = self.data[0][1].shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size need to be smaller than data size."
+
+        if shuffle:
+            idx = np.arange(self.num_data)
+            np.random.shuffle(idx)
+            self.data = [(k, v[idx]) for k, v in self.data]
+            self.label = [(k, v[idx]) for k, v in self.label]
+
+        if last_batch_handle == "discard":
+            new_n = self.num_data - self.num_data % batch_size
+            self.data = [(k, v[:new_n]) for k, v in self.data]
+            self.label = [(k, v[:new_n]) for k, v in self.label]
+            self.num_data = new_n
+
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
+        self.cursor = -batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self):
+        return [(k, tuple([self.batch_size] + list(v.shape[1:])))
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [(k, tuple([self.batch_size] + list(v.shape[1:])))
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None)
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter need reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            return [nd_array(v[self.cursor:self.cursor + self.batch_size])
+                    for _, v in data_source]
+        # padding: wrap around
+        pad = self.batch_size - self.num_data + self.cursor
+        return [nd_array(np.concatenate([v[self.cursor:], v[:pad]], axis=0))
+                for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize the epoch length of an iterator (reference io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-based prefetcher (reference io.py:171, iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter > 0
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0][1][0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self.next_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            for i in range(self.n_iter)]
+        for thread in self.prefetch_threads:
+            thread.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[(r[n], s) for n, s in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[(r[n], s) for n, s in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            for i in self.next_batch:
+                assert i is None, "Number of entry mismatches between iterators"
+            return False
+        for batch in self.next_batch:
+            assert batch.pad == self.next_batch[0].pad, \
+                "Number of entry mismatches between iterators"
+        self.current_batch = DataBatch(
+            sum([batch.data for batch in self.next_batch], []),
+            sum([batch.label for batch in self.next_batch], []),
+            self.next_batch[0].pad, self.next_batch[0].index)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, "not an idx image file: %s" % path
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(num, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        assert magic == 2049, "not an idx label file: %s" % path
+        return np.frombuffer(f.read(), dtype=np.uint8).astype(np.float32)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-file iterator (reference src/io/iter_mnist.cc)."""
+
+    def __init__(self, image="train-images-idx3-ubyte", label="train-labels-idx1-ubyte",
+                 batch_size=128, shuffle=True, flat=False, silent=False, seed=0,
+                 input_shape=None, part_index=0, num_parts=1, **kwargs):
+        for path in (image, label):
+            if not os.path.exists(path) and not os.path.exists(path + ".gz"):
+                raise MXNetError("MNIST file %s not found" % path)
+        if not os.path.exists(image):
+            image += ".gz"
+        if not os.path.exists(label):
+            label += ".gz"
+        images = _read_idx_images(image).astype(np.float32) / 255.0
+        labels = _read_idx_labels(label)
+        # distributed sharding (reference iter_mnist.cc part_index/num_parts)
+        if num_parts > 1:
+            n = images.shape[0] // num_parts
+            images = images[part_index * n:(part_index + 1) * n]
+            labels = labels[part_index * n:(part_index + 1) * n]
+        if flat or (input_shape is not None and len(input_shape) == 1):
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images.reshape(images.shape[0], 1,
+                                    images.shape[1], images.shape[2])
+        super().__init__(images, labels, batch_size=batch_size, shuffle=shuffle,
+                         label_name="softmax_label")
+
+
+class CSVIter(NDArrayIter):
+    """CSV iterator (reference src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[1:] == (1,):
+                label = label.reshape(-1)
+        super().__init__(data, label, batch_size=batch_size, shuffle=False,
+                         last_batch_handle="pad" if round_batch else "discard")
+
+
+class ImageRecordIter(DataIter):
+    """Packed image RecordIO iterator (reference src/io/iter_image_recordio.cc).
+
+    Supports the core pipeline: RecordIO read -> image decode (PIL) ->
+    mean subtract / scale -> crop/mirror augment -> batch.  Sharding via
+    part_index/num_parts as in the reference.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, mean_img=None, mean_r=0, mean_g=0, mean_b=0,
+                 scale=1.0, rand_crop=False, rand_mirror=False,
+                 part_index=0, num_parts=1, round_batch=True,
+                 preprocess_threads=4, prefetch_buffer=4, **kwargs):
+        super().__init__()
+        from . import recordio as _recordio
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.scale = scale
+        self.mean = None
+        if mean_img is not None and os.path.exists(mean_img):
+            from .ndarray import load as nd_load
+            self.mean = list(nd_load(mean_img).values())[0].asnumpy()
+        elif mean_r or mean_g or mean_b:
+            self.mean = np.array([mean_r, mean_g, mean_b],
+                                 dtype=np.float32).reshape(3, 1, 1)
+        self._records: List[Tuple[np.ndarray, bytes]] = []
+        rec = _recordio.MXRecordIO(path_imgrec, "r")
+        while True:
+            s = rec.read()
+            if s is None:
+                break
+            header, img = _recordio.unpack(s)
+            self._records.append((np.asarray(header.label, dtype=np.float32), img))
+        rec.close()
+        if num_parts > 1:
+            n = len(self._records) // num_parts
+            self._records = self._records[part_index * n:(part_index + 1) * n]
+        self._order = np.arange(len(self._records))
+        self.cursor = -batch_size
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        if self.label_width == 1:
+            return [("softmax_label", (self.batch_size,))]
+        return [("softmax_label", (self.batch_size, self.label_width))]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self._order)
+        self.cursor = -self.batch_size
+
+    def _decode(self, raw: bytes) -> np.ndarray:
+        try:
+            from PIL import Image
+            import io as _io
+            img = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"),
+                             dtype=np.float32)
+            img = img.transpose(2, 0, 1)  # HWC -> CHW
+        except ImportError:
+            # raw-packed records: stored as flattened CHW float/uint8
+            arr = np.frombuffer(raw, dtype=np.uint8)
+            img = arr.astype(np.float32).reshape(self.data_shape)
+        c, h, w = self.data_shape
+        _, ih, iw = img.shape
+        if ih < h or iw < w:
+            raise MXNetError("image %s smaller than data_shape %s"
+                             % (img.shape, self.data_shape))
+        if self.rand_crop:
+            dy = np.random.randint(0, ih - h + 1)
+            dx = np.random.randint(0, iw - w + 1)
+        else:
+            dy, dx = (ih - h) // 2, (iw - w) // 2
+        img = img[:, dy:dy + h, dx:dx + w]
+        if self.rand_mirror and np.random.rand() < 0.5:
+            img = img[:, :, ::-1]
+        if self.mean is not None:
+            img = img - self.mean
+        return img * self.scale
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < len(self._records)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        idxs = [self._order[(self.cursor + i) % len(self._records)]
+                for i in range(self.batch_size)]
+        data = np.stack([self._decode(self._records[i][1]) for i in idxs])
+        labels = np.stack([self._records[i][0] for i in idxs])
+        if self.label_width == 1:
+            labels = labels.reshape(-1)
+        pad = max(0, self.cursor + self.batch_size - len(self._records))
+        return DataBatch(data=[nd_array(data)], label=[nd_array(labels)],
+                         pad=pad, index=None)
+
+    def getpad(self):
+        return max(0, self.cursor + self.batch_size - len(self._records))

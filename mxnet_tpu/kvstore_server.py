@@ -1,0 +1,44 @@
+"""Server-role entry for distributed training.
+
+Reference: python/mxnet/kvstore_server.py (68 LoC): on import, non-worker
+DMLC_ROLE processes create a dist kvstore, register a controller that
+un-pickles the optimizer shipped by workers, block in RunServer, and exit.
+
+TPU-native: `dist_sync_tpu` has NO server role — aggregation is an XLA
+collective over the mesh (SURVEY §5.8 north star).  This module keeps the
+bootstrap contract: if a process is launched with DMLC_ROLE=server/scheduler
+it logs the divergence and exits cleanly instead of hanging, so reference
+launch scripts (tools/launch.py style) still work with -s 0 semantics.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """Compatibility shim for the server loop (reference kvstore_server.py:9)."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+        self.handle = None
+        self.init_logging = False
+
+    def run(self):
+        logging.info("dist_sync_tpu has no server processes; returning")
+
+
+def _init_kvstore_server_module():
+    role = os.environ.get("DMLC_ROLE", "worker")
+    if role in ("server", "scheduler"):
+        logging.warning(
+            "DMLC_ROLE=%s: TPU-native kvstore uses XLA collectives over the "
+            "device mesh; no server processes are needed (launch with -s 0). "
+            "Exiting cleanly.", role)
+        sys.exit(0)
+
+
+_init_kvstore_server_module()

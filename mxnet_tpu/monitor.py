@@ -1,0 +1,81 @@
+"""Monitor: per-op output statistics during execution.
+
+Reference: python/mxnet/monitor.py (120 LoC), Executor::SetMonitorCallback
+(symbolic.h:386-390), fired per-op inside RunOps (graph_executor.cc:937-951).
+
+TPU-native: installing a monitor flips the executor into node-level (eager)
+execution mode — the analogue of the reference's per-op engine dispatch —
+so every intermediate output is observable; stats are computed lazily.
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import List, Tuple
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Regex-filtered per-op stats (reference monitor.py:13-120)."""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """|x|/size(x), the reference default stat."""
+                return NDArray(abs(x._get()).sum().reshape(1) / x.size)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue: List[Tuple[int, str, NDArray]] = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe):
+        """Install to an executor (called by the module/model layers)."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting stats for current batch; clears old stats."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        """End collection; return stats for the batch."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for (n, k, v_list) in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,):
+                    s += str(v.asscalar()) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        if self.sort:
+            res = sorted(res, key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,277 @@
+"""Python custom operators — all three reference generations.
+
+Reference: python/mxnet/operator.py (802 LoC): PythonOp/NumpyOp (ctypes
+callbacks into numpy), NDArrayOp (async NDArray in/out), CustomOp/CustomOpProp
++ register (newest, used with sym.Custom), plus the _Native/_NDArray symbol
+ops (src/operator/native_op-inl.h, ndarray_op-inl.h, custom-inl.h:211).
+
+TPU-native: a python custom op inside a compiled graph is a
+``jax.pure_callback`` (forward) + ``jax.custom_vjp`` whose backward is a
+second pure_callback — shape contracts come from the op's infer_shape, which
+is required exactly as in the reference (SURVEY §7 hard-part 5).  The
+callback runs on host; XLA overlaps it with device work where possible.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ops.registry import OpDef, Param, register_op, get_op
+from . import symbol as _symbol
+
+__all__ = ["PythonOp", "NumpyOp", "NDArrayOp", "CustomOp", "CustomOpProp",
+           "register", "get_all_registered_operators"]
+
+_CUSTOM_REGISTRY: Dict[str, type] = {}
+
+
+class PythonOp:
+    """Base class for python-side ops (reference operator.py:20-122)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError("Must override this")
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError("Must override this")
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError("Must override this")
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+class NumpyOp(PythonOp):
+    """Numpy-callback op (reference operator.py:122-222).  Define
+    forward/backward on numpy arrays; get_symbol() returns a Symbol whose
+    compiled forward runs through pure_callback."""
+
+    def get_symbol(self, *args, **kwargs):
+        op_ref = self
+
+        class _NumpyOpDef(OpDef):
+            needs_rng = False
+
+            def list_arguments(self, p):
+                return op_ref.list_arguments()
+
+            def list_outputs(self, p):
+                return op_ref.list_outputs()
+
+            def infer_shape(self, p, in_shapes):
+                if any(s is None for s in in_shapes):
+                    return in_shapes, [None] * len(op_ref.list_outputs()), []
+                ins, outs = op_ref.infer_shape([list(s) for s in in_shapes])
+                return ([tuple(s) for s in ins], [tuple(s) for s in outs], [])
+
+            def forward(self, p, inputs, aux, ctx):
+                in_shapes = [tuple(x.shape) for x in inputs]
+                _, out_shapes = op_ref.infer_shape([list(s) for s in in_shapes])
+                out_shapes = [tuple(s) for s in out_shapes]
+                dtypes = [jnp.float32] * len(out_shapes)
+
+                def host_fwd(*np_inputs):
+                    outs = [np.zeros(s, dtype=np.float32) for s in out_shapes]
+                    op_ref.forward(in_data=[np.asarray(x) for x in np_inputs],
+                                   out_data=outs)
+                    return tuple(outs)
+
+                def host_bwd(np_inputs, np_outputs, np_ograds):
+                    in_grads = [np.zeros(s, dtype=np.float32) for s in in_shapes]
+                    op_ref.backward(out_grad=[np.asarray(g) for g in np_ograds],
+                                    in_data=[np.asarray(x) for x in np_inputs],
+                                    out_data=[np.asarray(o) for o in np_outputs],
+                                    in_grad=in_grads)
+                    return tuple(in_grads)
+
+                result_shape = tuple(
+                    jax.ShapeDtypeStruct(s, d) for s, d in zip(out_shapes, dtypes))
+
+                @jax.custom_vjp
+                def f(*ins):
+                    return jax.pure_callback(host_fwd, result_shape, *ins)
+
+                def f_fwd(*ins):
+                    outs = jax.pure_callback(host_fwd, result_shape, *ins)
+                    return outs, (ins, outs)
+
+                def f_bwd(res, g):
+                    ins, outs = res
+                    in_struct = tuple(jax.ShapeDtypeStruct(s, jnp.float32)
+                                      for s in in_shapes)
+                    grads = jax.pure_callback(host_bwd, in_struct, ins, outs, g)
+                    return tuple(grads)
+
+                f.defvjp(f_fwd, f_bwd)
+                outs = f(*inputs)
+                return list(outs)
+
+        name = kwargs.pop("name", None)
+        op_name = "_numpy_op_%d" % id(self)
+        cls = type("_NumpyOp_%d" % id(self), (_NumpyOpDef,), {})
+        register_op(op_name, hint="numpyop")(cls)
+        input_syms = [a for a in args if isinstance(a, _symbol.Symbol)]
+        sym_kwargs = {k: v for k, v in kwargs.items()
+                      if isinstance(v, _symbol.Symbol)}
+        return _symbol._create(op_name, input_syms, name=name, **sym_kwargs)
+
+
+class NDArrayOp(NumpyOp):
+    """Async NDArray custom op (reference operator.py:222+).  On TPU the
+    numpy-callback path already overlaps via XLA host callbacks, so this
+    shares the NumpyOp bridge while keeping the NDArray-flavored override
+    points."""
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError("Must override this")
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError("Must override this")
+
+
+class CustomOp:
+    """Newest-generation custom op (reference operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+
+
+class CustomOpProp:
+    """Property class for CustomOp (reference operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+        self.kwargs: Dict[str, str] = {}
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError()
+
+
+def register(reg_name: str):
+    """Register a CustomOpProp subclass under sym.Custom(op_type=reg_name)
+    (reference operator.py register)."""
+    def do_register(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_all_registered_operators():
+    return sorted(_CUSTOM_REGISTRY)
+
+
+@register_op("Custom", hint="custom")
+class CustomSymbolOp(OpDef):
+    """sym.Custom(..., op_type='name') (reference custom-inl.h:211)."""
+    params = [Param("op_type", str, required=True)]
+
+    def _prop(self, p) -> CustomOpProp:
+        if p.op_type not in _CUSTOM_REGISTRY:
+            raise MXNetError("custom op %r not registered (have %s)"
+                             % (p.op_type, get_all_registered_operators()))
+        prop = _CUSTOM_REGISTRY[p.op_type]()
+        return prop
+
+    def list_arguments(self, p):
+        return self._prop(p).list_arguments()
+
+    def list_outputs(self, p):
+        return self._prop(p).list_outputs()
+
+    def list_auxiliary_states(self, p):
+        return self._prop(p).list_auxiliary_states()
+
+    def infer_shape(self, p, in_shapes):
+        if any(s is None for s in in_shapes):
+            return in_shapes, [None] * len(self.list_outputs(p)), []
+        prop = self._prop(p)
+        res = prop.infer_shape([list(s) for s in in_shapes])
+        ins, outs = res[0], res[1]
+        aux = res[2] if len(res) > 2 else []
+        return ([tuple(s) for s in ins], [tuple(s) for s in outs],
+                [tuple(s) for s in aux])
+
+    def forward(self, p, inputs, aux, ctx):
+        prop = self._prop(p)
+        in_shapes = [tuple(x.shape) for x in inputs]
+        res = prop.infer_shape([list(s) for s in in_shapes])
+        out_shapes = [tuple(s) for s in res[1]]
+        op = prop.create_operator(None, in_shapes, [np.float32] * len(in_shapes))
+
+        def host_fwd(*np_ins):
+            ins_nd = [NDArray(jnp.asarray(x)) for x in np_ins]
+            outs_nd = [NDArray(jnp.zeros(s, jnp.float32)) for s in out_shapes]
+            op.forward(is_train=ctx.is_train, req=["write"] * len(outs_nd),
+                       in_data=ins_nd, out_data=outs_nd, aux=[])
+            return tuple(o.asnumpy() for o in outs_nd)
+
+        def host_bwd(np_ins, np_outs, np_ogs):
+            ins_nd = [NDArray(jnp.asarray(x)) for x in np_ins]
+            outs_nd = [NDArray(jnp.asarray(x)) for x in np_outs]
+            ogs_nd = [NDArray(jnp.asarray(x)) for x in np_ogs]
+            igs_nd = [NDArray(jnp.zeros(s, jnp.float32)) for s in in_shapes]
+            op.backward(req=["write"] * len(igs_nd), out_grad=ogs_nd,
+                        in_data=ins_nd, out_data=outs_nd, in_grad=igs_nd, aux=[])
+            return tuple(g.asnumpy() for g in igs_nd)
+
+        result_struct = tuple(jax.ShapeDtypeStruct(s, jnp.float32)
+                              for s in out_shapes)
+
+        @jax.custom_vjp
+        def f(*ins):
+            return jax.pure_callback(host_fwd, result_struct, *ins)
+
+        def f_fwd(*ins):
+            outs = jax.pure_callback(host_fwd, result_struct, *ins)
+            return outs, (ins, outs)
+
+        def f_bwd(res_, g):
+            ins, outs = res_
+            in_struct = tuple(jax.ShapeDtypeStruct(s, jnp.float32)
+                              for s in in_shapes)
+            grads = jax.pure_callback(host_bwd, in_struct, ins, outs, g)
+            return tuple(grads)
+
+        f.defvjp(f_fwd, f_bwd)
+        return list(f(*inputs))

@@ -1,0 +1,14 @@
+"""Operator library: registry + full op inventory (SURVEY §2.2).
+
+Importing this package registers every op.  The symbol and ndarray layers
+generate their user-facing constructors from this registry, mirroring the
+reference's dual SimpleOp registration (include/mxnet/operator_util.h:92-486).
+"""
+from .registry import (OpDef, OpContext, Param, register_op,
+                       register_simple_op, get_op, list_ops)
+from . import tensor  # noqa: F401  (registers elementwise/broadcast/reduce/matrix)
+from . import nn      # noqa: F401  (registers NN layers)
+from . import special  # noqa: F401 (registers ROIPooling/SpatialTransformer/Correlation)
+
+__all__ = ["OpDef", "OpContext", "Param", "register_op", "register_simple_op",
+           "get_op", "list_ops"]

@@ -1,0 +1,587 @@
+"""Tensor ops: elementwise / broadcast / reduction / matrix / shape family.
+
+Reference: src/operator/elementwise_binary_op-inl.h, elementwise_unary_op-inl.h,
+elementwise_binary_broadcast_op-inl.h, broadcast_reduce_op-inl.h,
+matrix_op-inl.h, reshape-inl.h, concat-inl.h, slice_channel-inl.h,
+swapaxis-inl.h, cast-inl.h, block_grad-inl.h, elementwise_sum-inl.h,
+embedding-inl.h, crop-inl.h, sample_op-inl.h, smooth_l1_unary-inl.h,
+loss_binary_op-inl.h, mshadow_op.h.
+
+TPU-native: every kernel collapses to a jnp/lax primitive (SURVEY §2.2 note);
+what is reproduced 1:1 is the registry metadata — names, param schemas,
+shape rules, and gradient semantics (via custom_vjp where the reference
+backward is not the autodiff of forward).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import OpDef, Param, register_op, register_simple_op
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary (reference elementwise_binary_op-inl.h:257)
+
+def _binary_shape(p, in_shapes):
+    d = in_shapes[0] if in_shapes[0] is not None else in_shapes[1]
+    return [d, d], [d], []
+
+
+for name, fn in [("_plus", jnp.add), ("_minus", jnp.subtract),
+                 ("_mul", jnp.multiply), ("_div", jnp.divide),
+                 ("_power", jnp.power), ("_maximum", jnp.maximum),
+                 ("_minimum", jnp.minimum)]:
+    register_simple_op(name, (lambda _f: lambda p, a, b: _f(a, b))(fn),
+                       nin=2, infer_shape=_binary_shape)
+
+# scalar / reverse-scalar variants (elementwise_binary_scalar_op-inl.h:262)
+_SCALAR_PARAMS = [Param("scalar", float, required=True)]
+for name, fn, rev in [
+        ("_plus_scalar", jnp.add, False), ("_minus_scalar", jnp.subtract, False),
+        ("_rminus_scalar", jnp.subtract, True), ("_mul_scalar", jnp.multiply, False),
+        ("_div_scalar", jnp.divide, False), ("_rdiv_scalar", jnp.divide, True),
+        ("_power_scalar", jnp.power, False), ("_rpower_scalar", jnp.power, True),
+        ("_maximum_scalar", jnp.maximum, False), ("_minimum_scalar", jnp.minimum, False)]:
+    if rev:
+        register_simple_op(name, (lambda _f: lambda p, a: _f(p.scalar, a))(fn),
+                           nin=1, params=list(_SCALAR_PARAMS))
+    else:
+        register_simple_op(name, (lambda _f: lambda p, a: _f(a, p.scalar))(fn),
+                           nin=1, params=list(_SCALAR_PARAMS))
+
+# ---------------------------------------------------------------------------
+# elementwise unary (reference elementwise_unary_op-inl.h:144, mshadow_op.h)
+
+for name, fn in [("abs", jnp.abs), ("ceil", jnp.ceil), ("cos", jnp.cos),
+                 ("exp", jnp.exp), ("floor", jnp.floor), ("log", jnp.log),
+                 ("round", jnp.round), ("rsqrt", lambda x: lax.rsqrt(x)),
+                 ("sign", jnp.sign), ("sin", jnp.sin), ("sqrt", jnp.sqrt),
+                 ("square", jnp.square)]:
+    register_simple_op(name, (lambda _f: lambda p, a: _f(a))(fn), nin=1)
+    register_simple_op("_" + name, (lambda _f: lambda p, a: _f(a))(fn), nin=1)
+
+# ---------------------------------------------------------------------------
+# broadcast family (reference elementwise_binary_broadcast_op-inl.h:549)
+
+
+def _bcast_shape(p, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return in_shapes, [a if a is not None else b], []
+    if len(a) != len(b):
+        raise MXNetError("broadcast inputs need same ndim: %s vs %s" % (a, b))
+    out = []
+    for x, y in zip(a, b):
+        if x == y or y == 1:
+            out.append(x)
+        elif x == 1:
+            out.append(y)
+        else:
+            raise MXNetError("broadcast shape mismatch %s vs %s" % (a, b))
+    return [a, b], [tuple(out)], []
+
+
+for name, fn in [("broadcast_plus", jnp.add), ("broadcast_minus", jnp.subtract),
+                 ("broadcast_mul", jnp.multiply), ("broadcast_div", jnp.divide),
+                 ("broadcast_power", jnp.power)]:
+    register_simple_op(name, (lambda _f: lambda p, a, b: _f(a, b))(fn),
+                       nin=2, infer_shape=_bcast_shape)
+
+
+def _broadcast_axis_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    out = list(d)
+    axes = p.axis if isinstance(p.axis, tuple) else (p.axis,)
+    sizes = p.size if isinstance(p.size, tuple) else (p.size,)
+    for ax, sz in zip(axes, sizes):
+        if out[ax] != 1:
+            raise MXNetError("broadcast_axis: input dim %d must be 1" % ax)
+        out[ax] = sz
+    return [d], [tuple(out)], []
+
+
+def _broadcast_axis(p, a):
+    out_shape = list(a.shape)
+    axes = p.axis if isinstance(p.axis, tuple) else (p.axis,)
+    sizes = p.size if isinstance(p.size, tuple) else (p.size,)
+    for ax, sz in zip(axes, sizes):
+        out_shape[ax] = sz
+    return jnp.broadcast_to(a, tuple(out_shape))
+
+
+register_simple_op("broadcast_axis", _broadcast_axis, nin=1,
+                   infer_shape=_broadcast_axis_shape,
+                   params=[Param("axis", "shape", default=()),
+                           Param("size", "shape", default=())])
+
+
+def _broadcast_to_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    tgt = list(p.shape)
+    for i, (x, y) in enumerate(zip(d, tgt)):
+        if y == 0:
+            tgt[i] = x
+        elif x != y and x != 1:
+            raise MXNetError("cannot broadcast %s to %s" % (d, p.shape))
+    return [d], [tuple(tgt)], []
+
+
+def _broadcast_to(p, a):
+    tgt = [x if y == 0 else y for x, y in zip(a.shape, p.shape)]
+    return jnp.broadcast_to(a, tuple(tgt))
+
+
+register_simple_op("broadcast_to", _broadcast_to, nin=1,
+                   infer_shape=_broadcast_to_shape,
+                   params=[Param("shape", "shape", required=True)])
+
+# ---------------------------------------------------------------------------
+# reductions (reference broadcast_reduce_op-inl.h:491)
+
+
+def _reduce_all_shape(p, in_shapes):
+    return in_shapes, [(1,)], []
+
+
+def _reduce_axis_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    axes = p.axis if isinstance(p.axis, tuple) else (p.axis,)
+    if p.keepdims:
+        out = tuple(1 if i in axes else x for i, x in enumerate(d))
+    else:
+        out = tuple(x for i, x in enumerate(d) if i not in axes)
+        if out == ():
+            out = (1,)
+    return [d], [out], []
+
+
+_AXIS_PARAMS = [Param("axis", "shape", default=(0,)), Param("keepdims", bool, default=False)]
+
+for name, fn in [("sum", jnp.sum), ("max", jnp.max), ("min", jnp.min)]:
+    register_simple_op(name, (lambda _f: lambda p, a: _f(a).reshape(1))(fn),
+                       nin=1, infer_shape=_reduce_all_shape)
+
+    def _axis_red(p, a, _f=fn):
+        axes = p.axis if isinstance(p.axis, tuple) else (p.axis,)
+        return _f(a, axis=axes, keepdims=p.keepdims)
+    register_simple_op(name + "_axis", _axis_red, nin=1,
+                       infer_shape=_reduce_axis_shape, params=list(_AXIS_PARAMS))
+
+register_simple_op("norm", lambda p, a: jnp.sqrt(jnp.sum(jnp.square(a))).reshape(1),
+                   nin=1, infer_shape=_reduce_all_shape)
+
+
+def _argmax_channel_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    return [d], [(d[0],)], []
+
+
+register_simple_op("argmax_channel",
+                   lambda p, a: jnp.argmax(a, axis=1).astype(a.dtype),
+                   nin=1, infer_shape=_argmax_channel_shape)
+
+# ---------------------------------------------------------------------------
+# matrix ops (reference matrix_op-inl.h:680)
+
+
+def _dot_shape(p, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return in_shapes, [None], []
+    if len(a) == 2 and len(b) == 2:
+        return [a, b], [(a[0], b[1])], []
+    if len(a) == 1 and len(b) == 1:
+        return [a, b], [(1,)], []
+    if len(a) == 2 and len(b) == 1:
+        return [a, b], [(a[0],)], []
+    raise MXNetError("dot shape mismatch %s %s" % (a, b))
+
+
+def _dot(p, a, b):
+    out = jnp.dot(a, b)
+    if out.ndim == 0:
+        out = out.reshape(1)
+    return out
+
+
+register_simple_op("dot", _dot, nin=2, infer_shape=_dot_shape)
+
+
+def _batch_dot_shape(p, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return in_shapes, [None], []
+    return [a, b], [(a[0], a[1], b[2])], []
+
+
+register_simple_op("batch_dot", lambda p, a, b: jnp.matmul(a, b),
+                   nin=2, infer_shape=_batch_dot_shape)
+
+
+def _transpose_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    axes = p.axes if p.axes else tuple(reversed(range(len(d))))
+    return [d], [tuple(d[a] for a in axes)], []
+
+
+register_simple_op("transpose",
+                   lambda p, a: jnp.transpose(a, p.axes if p.axes else None),
+                   nin=1, infer_shape=_transpose_shape,
+                   params=[Param("axes", "shape", default=())])
+
+
+def _expand_dims_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    out = list(d)
+    out.insert(p.axis, 1)
+    return [d], [tuple(out)], []
+
+
+register_simple_op("expand_dims", lambda p, a: jnp.expand_dims(a, p.axis),
+                   nin=1, infer_shape=_expand_dims_shape,
+                   params=[Param("axis", int, required=True)])
+
+
+def _slice_axis_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    out = list(d)
+    end = p.end if p.end is not None and p.end != 0 else d[p.axis]
+    if end < 0:
+        end += d[p.axis]
+    begin = p.begin if p.begin >= 0 else p.begin + d[p.axis]
+    out[p.axis] = end - begin
+    return [d], [tuple(out)], []
+
+
+def _slice_axis(p, a):
+    ax = p.axis
+    n = a.shape[ax]
+    end = p.end if p.end is not None and p.end != 0 else n
+    if end < 0:
+        end += n
+    begin = p.begin if p.begin >= 0 else p.begin + n
+    idx = [slice(None)] * a.ndim
+    idx[ax] = slice(begin, end)
+    return a[tuple(idx)]
+
+
+register_simple_op("slice_axis", _slice_axis, nin=1, infer_shape=_slice_axis_shape,
+                   params=[Param("axis", int, required=True),
+                           Param("begin", int, default=0),
+                           Param("end", int, default=0)])
+
+register_simple_op("flip", lambda p, a: jnp.flip(a, axis=p.axis), nin=1,
+                   params=[Param("axis", int, required=True)])
+
+# ---------------------------------------------------------------------------
+# losses (reference loss_binary_op-inl.h:110, smooth_l1_unary-inl.h:115)
+
+
+def _softmax_ce_shape(p, in_shapes):
+    return in_shapes, [(1,)], []
+
+
+def _softmax_cross_entropy(p, data, label):
+    # reference: out = -sum(log softmax(data)[i, label[i]])
+    logp = jax.nn.log_softmax(data, axis=-1)
+    idx = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, idx[:, None], axis=-1)
+    return -jnp.sum(picked).reshape(1)
+
+
+register_simple_op("softmax_cross_entropy", _softmax_cross_entropy, nin=2,
+                   infer_shape=_softmax_ce_shape)
+
+
+def _smooth_l1(p, a):
+    sigma2 = p.sigma * p.sigma
+    return jnp.where(jnp.abs(a) < 1.0 / sigma2,
+                     0.5 * sigma2 * jnp.square(a),
+                     jnp.abs(a) - 0.5 / sigma2)
+
+
+register_simple_op("smooth_l1", _smooth_l1, nin=1,
+                   params=[Param("sigma", float, default=1.0)])
+
+# ---------------------------------------------------------------------------
+# sampling (reference sample_op-inl.h:112)
+
+
+def _sample_shape(p, in_shapes):
+    return [], [tuple(p.shape)], []
+
+
+def _sample_uniform(p, rng=None):
+    return p.low + (p.high - p.low) * jax.random.uniform(rng, tuple(p.shape))
+
+
+def _sample_normal(p, rng=None):
+    return p.loc + p.scale * jax.random.normal(rng, tuple(p.shape))
+
+
+_u = register_simple_op("_sample_uniform", lambda p, rng=None: _sample_uniform(p, rng),
+                        nin=0, infer_shape=_sample_shape, needs_rng=True,
+                        params=[Param("low", float, default=0.0),
+                                Param("high", float, default=1.0),
+                                Param("shape", "shape", required=True)])
+_u.list_arguments = lambda p: []
+_n = register_simple_op("_sample_normal", lambda p, rng=None: _sample_normal(p, rng),
+                        nin=0, infer_shape=_sample_shape, needs_rng=True,
+                        params=[Param("loc", float, default=0.0),
+                                Param("scale", float, default=1.0),
+                                Param("shape", "shape", required=True)])
+_n.list_arguments = lambda p: []
+
+
+# ---------------------------------------------------------------------------
+# structural ops (class-based: Reshape/Flatten/Cast/Concat/SliceChannel/...)
+
+@register_op("Reshape", hint="reshape")
+class ReshapeOp(OpDef):
+    """reference reshape-inl.h:370 (supports 0 = copy dim, -1 = infer)."""
+    params = [Param("target_shape", "shape", default=None),
+              Param("shape", "shape", default=None),
+              Param("keep_highest", bool, default=False)]
+
+    def _target(self, p, in_shape):
+        tgt = p.shape if p.shape else p.target_shape
+        if tgt is None:
+            raise MXNetError("Reshape needs shape")
+        tgt = list(tgt)
+        size = int(np.prod(in_shape))
+        if p.keep_highest:
+            tgt[0] = in_shape[0]
+        for i, x in enumerate(tgt):
+            if x == 0:
+                tgt[i] = in_shape[i]
+        if -1 in tgt:
+            known = int(np.prod([x for x in tgt if x != -1]))
+            tgt[tgt.index(-1)] = size // known
+        return tuple(tgt)
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        return [d], [self._target(p, d)], []
+
+    def forward(self, p, inputs, aux, ctx):
+        return [inputs[0].reshape(self._target(p, inputs[0].shape))]
+
+
+@register_op("Flatten", hint="flatten")
+class FlattenOp(OpDef):
+    """reference reshape-inl.h FlattenOp: (N, ...) -> (N, prod)."""
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        return [d], [(d[0], int(np.prod(d[1:])))], []
+
+    def forward(self, p, inputs, aux, ctx):
+        x = inputs[0]
+        return [x.reshape(x.shape[0], -1)]
+
+
+@register_op("Cast", hint="cast")
+class CastOp(OpDef):
+    """reference cast-inl.h."""
+    params = [Param("dtype", str, required=True,
+                    enum=["float16", "float32", "float64", "bfloat16",
+                          "uint8", "int32", "int64"])]
+
+    def infer_type(self, p, in_types):
+        return in_types, [np.dtype(p.dtype) if p.dtype != "bfloat16"
+                          else jnp.bfloat16], []
+
+    def forward(self, p, inputs, aux, ctx):
+        dt = jnp.bfloat16 if p.dtype == "bfloat16" else np.dtype(p.dtype)
+        return [inputs[0].astype(dt)]
+
+
+@register_op("Concat", hint="concat")
+class ConcatOp(OpDef):
+    """reference concat-inl.h (num_args variable inputs, dim param)."""
+    params = [Param("num_args", int, required=True),
+              Param("dim", int, default=1)]
+    variable_args = "num_args"
+
+    def list_arguments(self, p):
+        return ["arg%d" % i for i in range(p.num_args)]
+
+    def infer_shape(self, p, in_shapes):
+        known = [s for s in in_shapes if s is not None]
+        if not known:
+            return in_shapes, [None], []
+        out = list(known[0])
+        out[p.dim] = int(np.sum([s[p.dim] for s in known]))
+        return in_shapes, [tuple(out)], []
+
+    def forward(self, p, inputs, aux, ctx):
+        return [jnp.concatenate(inputs, axis=p.dim)]
+
+
+@register_op("SliceChannel", hint="slicechannel")
+class SliceChannelOp(OpDef):
+    """reference slice_channel-inl.h: split along axis into num_outputs."""
+    params = [Param("num_outputs", int, required=True),
+              Param("axis", int, default=1),
+              Param("squeeze_axis", bool, default=False)]
+
+    def list_outputs(self, p):
+        return ["output%d" % i for i in range(p.num_outputs)]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None] * p.num_outputs, []
+        out = list(d)
+        if out[p.axis] % p.num_outputs != 0:
+            raise MXNetError("SliceChannel: axis size %d not divisible by %d"
+                             % (out[p.axis], p.num_outputs))
+        out[p.axis] //= p.num_outputs
+        if p.squeeze_axis and out[p.axis] == 1:
+            out = out[:p.axis] + out[p.axis + 1:]
+        return [d], [tuple(out)] * p.num_outputs, []
+
+    def forward(self, p, inputs, aux, ctx):
+        parts = jnp.split(inputs[0], p.num_outputs, axis=p.axis)
+        if p.squeeze_axis:
+            parts = [jnp.squeeze(x, axis=p.axis) for x in parts]
+        return parts
+
+
+@register_op("SwapAxis", hint="swapaxis")
+class SwapAxisOp(OpDef):
+    """reference swapaxis-inl.h."""
+    params = [Param("dim1", int, default=0), Param("dim2", int, default=0)]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        out = list(d)
+        out[p.dim1], out[p.dim2] = out[p.dim2], out[p.dim1]
+        return [d], [tuple(out)], []
+
+    def forward(self, p, inputs, aux, ctx):
+        return [jnp.swapaxes(inputs[0], p.dim1, p.dim2)]
+
+
+@register_op("BlockGrad", hint="blockgrad")
+class BlockGradOp(OpDef):
+    """reference block_grad-inl.h: identity forward, zero gradient."""
+
+    def forward(self, p, inputs, aux, ctx):
+        return [lax.stop_gradient(inputs[0])]
+
+
+@register_op("ElementWiseSum", hint="esum")
+class ElementWiseSumOp(OpDef):
+    """reference elementwise_sum-inl.h."""
+    params = [Param("num_args", int, required=True)]
+    variable_args = "num_args"
+
+    def list_arguments(self, p):
+        return ["arg%d" % i for i in range(p.num_args)]
+
+    def infer_shape(self, p, in_shapes):
+        d = next((s for s in in_shapes if s is not None), None)
+        return [d] * len(in_shapes), [d], []
+
+    def forward(self, p, inputs, aux, ctx):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out]
+
+
+@register_op("Embedding", hint="embedding")
+class EmbeddingOp(OpDef):
+    """reference embedding-inl.h: weight[(int)data]."""
+    params = [Param("input_dim", int, required=True),
+              Param("output_dim", int, required=True)]
+
+    def list_arguments(self, p):
+        return ["data", "weight"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        w = (p.input_dim, p.output_dim)
+        if d is None:
+            return [None, w], [None], []
+        return [d, w], [tuple(d) + (p.output_dim,)], []
+
+    def forward(self, p, inputs, aux, ctx):
+        data, weight = inputs
+        idx = lax.stop_gradient(data).astype(jnp.int32)
+        return [jnp.take(weight, idx, axis=0)]
+
+
+@register_op("Crop", hint="crop")
+class CropOp(OpDef):
+    """reference crop-inl.h: crop x to h_w (or to shape of second input)."""
+    params = [Param("num_args", int, default=1),
+              Param("offset", "shape", default=(0, 0)),
+              Param("h_w", "shape", default=(0, 0)),
+              Param("center_crop", bool, default=False)]
+    variable_args = "num_args"
+
+    def list_arguments(self, p):
+        if p.num_args == 1:
+            return ["data"]
+        return ["arg0", "arg1"]
+
+    def _out_hw(self, p, dshape, like_shape):
+        if p.num_args == 2 and like_shape is not None:
+            return like_shape[2], like_shape[3]
+        return p.h_w[0], p.h_w[1]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        like = in_shapes[1] if p.num_args == 2 and len(in_shapes) > 1 else None
+        h, w = self._out_hw(p, d, like)
+        return in_shapes, [(d[0], d[1], h, w)], []
+
+    def forward(self, p, inputs, aux, ctx):
+        x = inputs[0]
+        like = inputs[1].shape if p.num_args == 2 else None
+        h, w = self._out_hw(p, x.shape, like)
+        if p.center_crop:
+            oy = (x.shape[2] - h) // 2
+            ox = (x.shape[3] - w) // 2
+        else:
+            oy, ox = p.offset
+        return [x[:, :, oy:oy + h, ox:ox + w]]
+
+
+@register_op("_CrossDeviceCopy", hint="crossdevicecopy")
+class CrossDeviceCopyOp(OpDef):
+    """reference cross_device_copy.cc: identity; placement handled by executor
+    (XLA inserts the actual transfer/reshard)."""
+
+    def forward(self, p, inputs, aux, ctx):
+        return [inputs[0]]

@@ -1,0 +1,345 @@
+"""Optimizers. Reference: python/mxnet/optimizer.py (824 LoC), src/optimizer/.
+
+Registry + SGD/NAG/SGLD/ccSGD/Adam/AdaGrad/RMSProp/AdaDelta/Test, the
+get_updater closure used by kvstore, lr_mult/wd_mult resolution from symbol
+attrs — all preserved.  Updates run as jnp expressions so XLA fuses each
+param update into a couple of kernels; Module's fused training path (see
+parallel/) folds them into the train step entirely.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+from typing import Dict, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros, clip as nd_clip
+from . import random as _random
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "ccSGD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Test", "create", "get_updater", "register"]
+
+
+class Optimizer:
+    """Base optimizer with registry (reference optimizer.py:12-160)."""
+
+    opt_registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        assert isinstance(klass, type)
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, rescale_grad=1.0, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](
+                rescale_grad=rescale_grad, **kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, arg_names=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.num_update = 0
+        self._index_update_count: Dict[int, int] = {}
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict)
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.lr_mult = {}
+        self.wd_mult = {}
+        if sym is not None:
+            attr = sym.attr_dict()
+            for name in sym.list_arguments():
+                if name in attr:
+                    if "lr_mult" in attr[name]:
+                        self.lr_mult[name] = float(attr[name]["lr_mult"])
+                    if "wd_mult" in attr[name]:
+                        self.wd_mult[name] = float(attr[name]["wd_mult"])
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_scale(self, args_lrscale):  # deprecated in reference too
+        self.lr_mult = {self.idx2name.get(i, i): s
+                        for i, s in args_lrscale.items()}
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = 0
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        name = self.idx2name.get(index, index)
+        return lr * self.lr_mult.get(name, 1.0)
+
+    def _get_wd(self, index):
+        name = self.idx2name.get(index, index)
+        wd = self.wd
+        # bias / gamma / beta default to wd 0 via wd_mult naming rule
+        if name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        elif isinstance(name, str) and (
+                name.endswith("_bias") or name.endswith("_gamma")
+                or name.endswith("_beta")):
+            wd *= 0.0
+        return wd
+
+    def _preprocess_grad(self, grad):
+        g = grad._get() * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+
+register = Optimizer.register
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and weight decay (reference optimizer.py:163)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        assert isinstance(weight, NDArray) and isinstance(grad, NDArray)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._get()
+        if state is not None:
+            mom = self.momentum * state._get() - lr * g - lr * wd * w
+            state._set(mom)
+            weight._set(w + mom)
+        else:
+            weight._set(w - lr * (g + wd * w))
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer.py:235)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._get()
+        if state is not None:
+            mom = state._get()
+            mom = self.momentum * mom + g + wd * w
+            g2 = self.momentum * mom + g
+            state._set(mom)
+            weight._set(w - lr * g2)
+        else:
+            weight._set(w - lr * (g + wd * w))
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:288)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._get()
+        noise = _random.normal(0, math.sqrt(lr), shape=weight.shape,
+                               ctx=weight.context)._get()
+        weight._set(w - lr / 2 * (g + wd * w) + noise)
+
+
+@register
+class ccSGD(SGD):
+    """C++-backed SGD in the reference (optimizer.py:341); same math here."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer.py:404; Kingma & Ba 2014)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, decay_factor=(1 - 1e-8), **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.decay_factor = decay_factor
+        self.time = 0
+        self.time_first_index = None
+
+    def create_state(self, index, weight):
+        self.time_first_index = None
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        # reference keeps a single shared time counter keyed to first index
+        if self.time_first_index is None:
+            self.time_first_index = index
+            self.time = 0
+        elif self.time_first_index == index:
+            self.time += 1
+        mean, variance = state
+        g = self._preprocess_grad(grad)
+        w = weight._get()
+        t = self.time + 1
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        m = self.beta1 * mean._get() + (1 - self.beta1) * g
+        v = self.beta2 * variance._get() + (1 - self.beta2) * jnp.square(g)
+        mean._set(m)
+        variance._set(v)
+        weight._set(w - lr_t * (m / (jnp.sqrt(v) + self.epsilon) + wd * w))
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:475; Duchi et al 2011)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._get()
+        hist = state._get() + jnp.square(g)
+        state._set(hist)
+        weight._set(w - lr * (g / jnp.sqrt(hist + self.float_stable_eps) + wd * w))
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (reference optimizer.py:512; Tieleman & Hinton / Graves 2013)."""
+
+    def __init__(self, learning_rate=0.002, gamma1=0.95, gamma2=0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
+                zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        n, gbar, delta = state
+        g = self._preprocess_grad(grad)
+        w = weight._get()
+        nn = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n._get()
+        gg = (1 - self.gamma1) * g + self.gamma1 * gbar._get()
+        dd = (self.gamma2 * delta._get()
+              - lr * (g / jnp.sqrt(nn - jnp.square(gg) + 1e-4) + wd * w))
+        n._set(nn)
+        gbar._set(gg)
+        delta._set(dd)
+        weight._set(w + dd)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:568; Zeiler 2012)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        g = self._preprocess_grad(grad)
+        w = weight._get()
+        ag = self.rho * acc_g._get() + (1.0 - self.rho) * jnp.square(g)
+        cur_delta = (jnp.sqrt(acc_delta._get() + self.epsilon)
+                     / jnp.sqrt(ag + self.epsilon) * g)
+        ad = self.rho * acc_delta._get() + (1.0 - self.rho) * jnp.square(cur_delta)
+        acc_g._set(ag)
+        acc_delta._set(ad)
+        weight._set(w - cur_delta - wd * w)
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: weight += grad (reference optimizer.py:620)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._set(weight._get() + grad._get() * self.rescale_grad)
+        state._set(weight._get())
+
+
+def create(name, rescale_grad=1.0, **kwargs):
+    """Create optimizer by registered name (reference optimizer.py:786)."""
+    return Optimizer.create_optimizer(name, rescale_grad=rescale_grad, **kwargs)
+
+
+def get_updater(optimizer: Optimizer):
+    """Closure updater(index, grad, weight) used by kvstore
+    (reference optimizer.py:804-824)."""
+    states: Dict[int, object] = {}
+
+    def updater(index, grad, weight):
+        if index not in states:
+            states[index] = optimizer.create_state(index, weight)
+        optimizer.update(index, weight, grad, states[index])
+    updater.optimizer = optimizer
+    updater.states = states
+    return updater

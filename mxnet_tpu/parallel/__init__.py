@@ -1,0 +1,12 @@
+"""Parallelism: meshes, sharded training steps, collectives.
+
+TPU-native replacement for the reference's kvstore/ps-lite distribution stack
+(SURVEY §2.4, §5.8): data parallel = GSPMD batch sharding + XLA all-reduce
+over ICI; model parallel = param PartitionSpecs (ctx_group analogue);
+multi-host = the same mesh spanning processes over ICI+DCN.
+"""
+from .mesh import make_mesh, dp_sharding, replicated, Mesh, NamedSharding, PartitionSpec
+from .data_parallel import DPTrainStep
+
+__all__ = ["make_mesh", "dp_sharding", "replicated", "Mesh", "NamedSharding",
+           "PartitionSpec", "DPTrainStep"]

@@ -1,0 +1,141 @@
+"""Fused data-parallel training step over a device mesh.
+
+This is the TPU-native fast path for the reference's multi-device training
+loop (SURVEY §3.1): one jit-compiled step = forward + backward + gradient
+all-reduce + optimizer update, sharded over the mesh with GSPMD.  The
+reference pipeline (per-device executors -> kvstore push/pull -> per-device
+updater, model.py:119-310) collapses into a single XLA program where:
+
+* batch slicing            -> batch-axis NamedSharding over the "dp" axis
+* kvstore local/device sum -> XLA all-reduce inserted by GSPMD (rides ICI)
+* update_on_kvstore        -> replicated optimizer state updated in-program
+* engine copy workers      -> XLA async collective/transfer scheduling
+
+The Module/FeedForward APIs keep reference semantics; ``DPTrainStep`` is what
+bench.py and high-throughput users call directly, and what `dist_sync_tpu`
+multi-host training jits over a global (ICI+DCN) mesh.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..symbol import Symbol, _topo
+from ..executor import _GraphProgram
+from ..ops.registry import OpContext
+from .mesh import make_mesh
+
+__all__ = ["DPTrainStep"]
+
+
+class DPTrainStep:
+    """Compile a symbol into one sharded train step.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        loss-headed symbol (e.g. SoftmaxOutput head).
+    mesh : Mesh
+        device mesh with a "dp" axis (extra axes allowed; params replicated
+        across "dp", and may be sharded over other axes via param_specs).
+    data_names / label_names : input argument names (batch-sharded on "dp").
+    learning_rate, momentum, weight_decay, rescale_grad : fused SGD params.
+    param_specs : optional dict name -> PartitionSpec for tensor-parallel
+        param sharding (ctx_group analogue on the mesh).
+    """
+
+    def __init__(self, symbol: Symbol, mesh: Mesh,
+                 data_names=("data",), label_names=("softmax_label",),
+                 learning_rate=0.01, momentum=0.9, weight_decay=1e-4,
+                 rescale_grad=None, param_specs=None, dtype=np.float32,
+                 remat=False):
+        self.symbol = symbol
+        self.mesh = mesh
+        self.data_names = tuple(data_names)
+        self.label_names = tuple(label_names)
+        self.lr = learning_rate
+        self.momentum = momentum
+        self.wd = weight_decay
+        self.rescale = rescale_grad
+        self.param_specs = param_specs or {}
+        self._prog = _GraphProgram(symbol, {}, None, do_mirror=remat)
+        input_names = set(self.data_names) | set(self.label_names)
+        self.param_names = [n for n in symbol.list_arguments()
+                            if n not in input_names]
+        self.aux_names = symbol.list_auxiliary_states()
+        self._step = None
+
+    # -- shardings ----------------------------------------------------------
+    def _param_sharding(self, name):
+        spec = self.param_specs.get(name, P())
+        return NamedSharding(self.mesh, spec)
+
+    def _batch_sharding(self):
+        return NamedSharding(self.mesh, P("dp"))
+
+    def init(self, arg_params: Dict[str, np.ndarray],
+             aux_params: Dict[str, np.ndarray]):
+        """Place params/aux/momentum on the mesh; returns device state."""
+        params = {k: jax.device_put(jnp.asarray(v), self._param_sharding(k))
+                  for k, v in arg_params.items() if k in self.param_names}
+        aux = {k: jax.device_put(jnp.asarray(v), self._param_sharding(k))
+               for k, v in aux_params.items()}
+        mom = {k: jax.device_put(jnp.zeros_like(v), self._param_sharding(k))
+               for k, v in params.items()} if self.momentum else None
+        return {"params": params, "aux": aux, "mom": mom}
+
+    def shard_batch(self, data: Dict[str, np.ndarray]):
+        sh = self._batch_sharding()
+        return {k: jax.device_put(jnp.asarray(v), sh) for k, v in data.items()}
+
+    # -- the step -----------------------------------------------------------
+    def _build(self):
+        prog = self._prog
+        lr, momentum, wd = self.lr, self.momentum, self.wd
+
+        def step(state, batch, rng):
+            params, aux, mom = state["params"], state["aux"], state["mom"]
+            rescale = self.rescale
+            if rescale is None:
+                rescale = 1.0 / batch[self.data_names[0]].shape[0]
+
+            def loss_fn(params):
+                args = dict(params)
+                args.update(batch)
+                outs, new_aux = prog.eval(args, aux, rng, True)
+                return outs, new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(loss_fn, params, has_aux=True)
+            grads = vjp_fn([jnp.ones_like(o) for o in outs])[0]
+
+            new_params = {}
+            new_mom = {} if mom is not None else None
+            for k, p in params.items():
+                g = grads[k] * rescale + wd * p
+                if mom is not None:
+                    m = momentum * mom[k] - lr * g
+                    new_mom[k] = m
+                    new_params[k] = p + m
+                else:
+                    new_params[k] = p - lr * g
+            merged_aux = dict(aux)
+            merged_aux.update(new_aux)
+            return ({"params": new_params, "aux": merged_aux, "mom": new_mom},
+                    outs)
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+        return self._step
+
+    def __call__(self, state, batch, rng=None):
+        if self._step is None:
+            self._build()
+        if rng is None:
+            from .. import random as _random
+            rng = _random.new_key()
+        return self._step(state, batch, rng)

@@ -1,0 +1,47 @@
+"""Device mesh helpers: the TPU-native substrate for every parallelism mode.
+
+Reference analogue: the kvstore `device`/`dist_sync` machinery + ctx_group
+model parallelism (SURVEY §2.4).  On TPU, all of them are shardings over a
+jax.sharding.Mesh: data parallel = batch axis, model/tensor parallel =
+feature axes, pipeline = stage axis — XLA inserts the collectives that the
+reference implemented as cudaMemcpy reductions and ps-lite RPCs.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "dp_sharding", "replicated", "PartitionSpec",
+           "NamedSharding", "Mesh"]
+
+
+def make_mesh(axes: Sequence[Tuple[str, int]], devices=None) -> Mesh:
+    """Create a Mesh from (name, size) axes, e.g. [("dp", 4), ("tp", 2)].
+
+    Sizes may use -1 once to absorb remaining devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = [a for a, _ in axes]
+    sizes = [s for _, s in axes]
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError("mesh %s needs %d devices, have %d" % (axes, total, n))
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def dp_sharding(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Batch-dim sharding over the data-parallel axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,84 @@
+"""Random sampling. Reference: python/mxnet/random.py, src/ndarray/ndarray.cc:417+
+(SampleUniform/SampleGaussian via per-device mshadow RNG in the resource manager).
+
+TPU-native: a global threaded PRNG-key chain (jax.random) replaces the
+per-device mshadow generators; ``seed()`` resets the chain, matching the
+reference's MXRandomSeed semantics.  Ops needing randomness inside compiled
+graphs (Dropout, RReLU) draw keys from :func:`new_key` at trace time or take
+keys as executor inputs.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import jax
+
+from .ndarray import NDArray, _dev_put, _resolve_ctx
+from . import engine as _engine
+
+__all__ = ["seed", "uniform", "normal", "new_key", "randint"]
+
+_state = threading.local()
+
+
+def _key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+    return _state.key
+
+
+def new_key():
+    """Split and return a fresh PRNG key (internal use by ops/resources)."""
+    k1, k2 = jax.random.split(_key())
+    _state.key = k1
+    return k2
+
+
+def seed(seed_state: int) -> None:
+    """Seed the global RNG (reference MXRandomSeed; also seeds numpy-side)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+    np.random.seed(int(seed_state) % (2**32))
+
+
+def uniform(low=0.0, high=1.0, shape=None, ctx=None, out=None) -> NDArray:
+    """Sample uniform [low, high) (reference SampleUniform)."""
+    if out is not None:
+        shape = out.shape
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    val = jax.random.uniform(new_key(), shape, minval=low, maxval=high,
+                             dtype=np.float32)
+    val = _dev_put(val, _resolve_ctx(ctx))
+    if out is not None:
+        out._set(val.astype(out.dtype))
+        return out
+    return NDArray(_engine.track(val))
+
+
+def normal(loc=0.0, scale=1.0, shape=None, ctx=None, out=None) -> NDArray:
+    """Sample gaussian N(loc, scale^2) (reference SampleGaussian)."""
+    if out is not None:
+        shape = out.shape
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    val = loc + scale * jax.random.normal(new_key(), shape, dtype=np.float32)
+    val = _dev_put(val, _resolve_ctx(ctx))
+    if out is not None:
+        out._set(val.astype(out.dtype))
+        return out
+    return NDArray(_engine.track(val))
+
+
+def randint(low, high, shape=None, ctx=None) -> NDArray:
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    val = jax.random.randint(new_key(), shape, low, high)
+    return NDArray(_dev_put(val, _resolve_ctx(ctx)))

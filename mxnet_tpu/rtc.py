@@ -1,0 +1,80 @@
+"""Runtime kernel authoring from Python.
+
+Reference: src/common/mxrtc.cc + python/mxnet/rtc.py — NVRTC-compiled CUDA
+kernels launched on NDArrays.
+
+TPU-native: Pallas IS the runtime-kernel system (SURVEY §2.1 RTC row): users
+author kernels in Python against ``pl.BlockSpec`` grids instead of CUDA
+source strings; compilation and caching are handled by XLA.  ``Rtc`` keeps
+the reference's (name, inputs, outputs, kernel) constructor shape but takes
+a python kernel function, not CUDA source.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+try:
+    from jax.experimental import pallas as pl
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    pl = None
+    HAS_PALLAS = False
+
+__all__ = ["Rtc", "pallas_call", "HAS_PALLAS"]
+
+
+def pallas_call(kernel, out_shape, **kwargs):
+    """Thin passthrough to pl.pallas_call for user kernels."""
+    if not HAS_PALLAS:
+        raise MXNetError("pallas unavailable in this JAX build")
+    return pl.pallas_call(kernel, out_shape=out_shape, **kwargs)
+
+
+class Rtc:
+    """Python-authored device kernel (reference rtc.py:9-61 reimagined).
+
+    Parameters
+    ----------
+    name : str
+        kernel name (for caches/debugging).
+    inputs : list of (name, NDArray)
+        prototype inputs fixing shapes/dtypes.
+    outputs : list of (name, NDArray)
+        prototype outputs fixing shapes/dtypes.
+    kernel : callable
+        either a Pallas kernel ``kernel(*in_refs, *out_refs)`` (used when
+        ``use_pallas=True``) or a jnp function ``kernel(*inputs) -> outputs``.
+    """
+
+    def __init__(self, name: str, inputs, outputs, kernel: Callable,
+                 use_pallas: bool = False):
+        self.name = name
+        self._in_proto = [(n, a.shape, a.dtype) for n, a in inputs]
+        self._out_proto = [(n, a.shape, a.dtype) for n, a in outputs]
+        self._use_pallas = use_pallas
+        if use_pallas:
+            if not HAS_PALLAS:
+                raise MXNetError("pallas unavailable in this JAX build")
+            out_shape = [jax.ShapeDtypeStruct(s, d) for (_, s, d) in self._out_proto]
+            self._fn = jax.jit(pl.pallas_call(kernel, out_shape=out_shape))
+        else:
+            self._fn = jax.jit(kernel)
+
+    def push(self, ins: Sequence[NDArray], outs: Sequence[NDArray],
+             grid_dims: Tuple[int, ...] = None, block_dims: Tuple[int, ...] = None):
+        """Run the kernel (reference rtc.py push; grid/block dims accepted for
+        API compatibility — XLA/Mosaic choose the schedule)."""
+        res = self._fn(*[a._get() for a in ins])
+        if not isinstance(res, (tuple, list)):
+            res = [res]
+        if len(res) != len(outs):
+            raise MXNetError("kernel produced %d outputs, expected %d"
+                             % (len(res), len(outs)))
+        for o, r in zip(outs, res):
+            o._set(jnp.asarray(r, dtype=o.dtype))

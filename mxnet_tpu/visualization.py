@@ -1,0 +1,56 @@
+"""Network visualization. Reference: python/mxnet/visualization.py (152 LoC)."""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from .base import MXNetError
+from .symbol import Symbol
+
+__all__ = ["plot_network", "print_summary"]
+
+
+def print_summary(symbol: Symbol, shape: Optional[Dict] = None):
+    """Print layer summary table (reference visualization.py print_summary)."""
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if shape is not None:
+        _, out_shapes, _ = symbol.get_internals().infer_shape(**shape)
+    print("%-30s %-20s %-20s" % ("Layer (type)", "Op", "Param"))
+    print("=" * 72)
+    total = 0
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        print("%-30s %-20s %-20s" % (node["name"], node["op"],
+                                     str(node.get("param", {}))))
+    print("=" * 72)
+
+
+def plot_network(symbol: Symbol, title="plot", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot (reference visualization.py plot_network)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise MXNetError("plot_network requires graphviz; "
+                         "use print_summary for a text view")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title)
+    for i, node in enumerate(nodes):
+        name = node["name"]
+        if node["op"] == "null":
+            if hide_weights and (name.endswith("weight") or name.endswith("bias")
+                                 or name.endswith("gamma") or name.endswith("beta")):
+                continue
+            dot.node(name=name, label=name, shape="oval")
+        else:
+            dot.node(name=name, label="%s\n%s" % (name, node["op"]), shape="box")
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        for (j, _) in node["inputs"]:
+            src = nodes[j]["name"]
+            dot.edge(tail_name=src, head_name=node["name"])
+    return dot

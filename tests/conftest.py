@@ -1,0 +1,30 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's fake-device trick (tests/python/unittest/
+test_multi_device_exec.py:35 uses distinct cpu dev_ids as devices): we force
+the JAX host platform to expose 8 CPU devices so multi-device / sharding
+tests run without TPU hardware.
+
+Must run BEFORE jax is imported anywhere: sets JAX_PLATFORMS=cpu and removes
+the axon TPU-tunnel plugin from the import path (it would otherwise claim the
+real TPU for every test process).
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# keep the axon TPU plugin out of test processes
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":")
+    if p and ".axon_site" not in p)
+mods = [m for m in sys.modules if m == "axon" or m.startswith("axon.")]
+for m in mods:
+    del sys.modules[m]
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
