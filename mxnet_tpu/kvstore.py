@@ -95,9 +95,13 @@ class KVStore:
         ReduceSumCPU / kvstore_device.h device reduce)."""
         if len(vals) == 1:
             return vals[0].copy()
+        # gather onto one merge device first (the reference's CPU-pinned /
+        # chosen-GPU merge buffer, kvstore_local.h:133-168); PJRT transfers
+        # are async, the adds fuse on the merge device.
+        dev = vals[0].context.jax_device()
         acc = vals[0]._get()
         for v in vals[1:]:
-            acc = acc + v._get()   # XLA adds; transfers are async via PJRT
+            acc = acc + jax.device_put(v._get(), dev)
         return NDArray(acc)
 
     def push(self, key, value, priority=0):
@@ -118,6 +122,8 @@ class KVStore:
         keys, _ = _key_list(key)
         if isinstance(out, NDArray):
             outs = [[out]]
+        elif len(keys) == 1 and all(isinstance(o, NDArray) for o in out):
+            outs = [list(out)]
         else:
             outs = []
             for o in out:

@@ -130,6 +130,15 @@ class NDArray:
                     % (tuple(new.shape), tuple(self._data.shape)))
             if self._data is not None and new.dtype != self._data.dtype:
                 new = new.astype(self._data.dtype)
+            if self._data is not None:
+                # a write mutates the chunk in place in the reference —
+                # keep the buffer on its original device
+                try:
+                    old_dev = next(iter(self._data.devices()))
+                    if hasattr(new, "devices") and new.devices() != {old_dev}:
+                        new = jax.device_put(new, old_dev)
+                except Exception:
+                    pass
             self._data = _engine.track(new)
             return
         parent = self._base._get()
@@ -187,7 +196,7 @@ class NDArray:
 
     def asnumpy(self) -> np.ndarray:
         """Copy to host numpy array — THE sync point (SURVEY §3.6)."""
-        return np.asarray(self._get())
+        return np.array(self._get())
 
     def asscalar(self):
         if self.size != 1:
@@ -206,7 +215,10 @@ class NDArray:
         if isinstance(other, NDArray):
             if other is self or (other._root() is self._root() and other._spec == self._spec):
                 return other
-            other._set(jnp.asarray(self._get(), dtype=other.dtype))
+            val = jnp.asarray(self._get(), dtype=other.dtype)
+            if other.context != self.context:
+                val = jax.device_put(val, other.context.jax_device())
+            other._set(val)
             return other
         if isinstance(other, Context):
             return NDArray(_dev_put(self._get(), other))

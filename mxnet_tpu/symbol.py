@@ -156,7 +156,8 @@ class Symbol:
     # -- introspection ------------------------------------------------------
     @property
     def name(self) -> Optional[str]:
-        if len(self._heads) == 1:
+        nodes = {id(n) for (n, _) in self._heads}
+        if len(nodes) == 1:
             return self._heads[0][0].name
         return None
 
@@ -487,12 +488,14 @@ def _create(op_name: str, input_syms: Sequence[Symbol], name: Optional[str] = No
                 raise MXNetError("cannot use grouped symbol as input")
             inputs.append(s._heads[0])
         else:
-            # auto-create missing argument variable, e.g. fc1_weight
-            vnode = _Node(None, "%s_%s" % (name, an))
+            # auto-create missing argument variable, e.g. fc1_weight;
+            # inherits scope attrs (ctx_group etc.) like the reference
+            vnode = _Node(None, "%s_%s" % (name, an),
+                          attrs=dict(attr) if attr else {})
             inputs.append((vnode, 0))
     node = _Node(op, name, params=p, attrs=dict(attr) if attr else {},
                  inputs=inputs)
-    return Symbol([(node, 0)])
+    return Symbol([(node, i) for i in range(node.num_outputs())])
 
 
 def _make_atomic_symbol_function(op_name: str):

@@ -1,0 +1,145 @@
+"""Executor tests. Modeled on reference tests/python/unittest/test_executor.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def reldiff(a, b):
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a)) + 1e-12
+    return diff / norm
+
+
+def check_bind_with_uniform(uf, gf, dim):
+    """check function consistency with uniform random numbers
+    (reference test_executor.py check_bind_with_uniform)."""
+    shape = tuple(np.random.randint(1, 8, size=dim))
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    ret = uf(lhs, rhs)
+    assert ret.list_arguments() == ["lhs", "rhs"]
+    lhs_arr = mx.nd.array(np.random.uniform(-1, 1, shape))
+    rhs_arr = mx.nd.array(np.random.uniform(-1, 1, shape))
+    lhs_grad = mx.nd.empty(shape)
+    rhs_grad = mx.nd.empty(shape)
+
+    executor = ret.bind(mx.cpu(), args=[lhs_arr, rhs_arr],
+                        args_grad=[lhs_grad, rhs_grad])
+    exec3 = ret.bind(mx.cpu(), args=[lhs_arr, rhs_arr])
+    exec4 = ret.bind(mx.cpu(), args={"rhs": rhs_arr, "lhs": lhs_arr},
+                     args_grad={"lhs": lhs_grad, "rhs": rhs_grad})
+    executor.forward()
+    exec3.forward()
+    exec4.forward()
+    out1 = executor.outputs[0].asnumpy()
+    out2 = uf(lhs_arr.asnumpy(), rhs_arr.asnumpy())
+    out3 = exec3.outputs[0].asnumpy()
+    out4 = exec4.outputs[0].asnumpy()
+    assert reldiff(out1, out2) < 1e-5
+    assert reldiff(out1, out3) < 1e-5
+    assert reldiff(out1, out4) < 1e-5
+    # test gradient
+    out_grad = mx.nd.array(np.ones(out2.shape))
+    lhs_grad2, rhs_grad2 = gf(out_grad.asnumpy(),
+                              lhs_arr.asnumpy(), rhs_arr.asnumpy())
+    executor.forward(is_train=True)
+    executor.backward([out_grad])
+    assert reldiff(lhs_grad.asnumpy(), lhs_grad2) < 1e-5
+    assert reldiff(rhs_grad.asnumpy(), rhs_grad2) < 1e-5
+
+
+def test_bind():
+    np.random.seed(0)
+    nrepeat = 3
+    maxdim = 3
+    for _ in range(nrepeat):
+        for dim in range(1, maxdim):
+            check_bind_with_uniform(lambda x, y: x + y,
+                                    lambda g, x, y: (g, g), dim)
+            check_bind_with_uniform(lambda x, y: x - y,
+                                    lambda g, x, y: (g, -g), dim)
+            check_bind_with_uniform(lambda x, y: x * y,
+                                    lambda g, x, y: (y * g, x * g), dim)
+            check_bind_with_uniform(lambda x, y: x / y,
+                                    lambda g, x, y: (g / y, -x * g / (y ** 2)),
+                                    dim)
+
+
+def test_reshape_executor():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    exe = y.simple_bind(mx.cpu(), x=(5, 4), grad_req="null")
+    exe.arg_dict["x"][:] = 1
+    exe.arg_dict["fc_weight"][:] = np.eye(4)
+    exe.arg_dict["fc_bias"][:] = 0
+    new_exe = exe.reshape(x=(3, 4))
+    new_exe.arg_dict["x"][:] = 1
+    new_exe.forward(is_train=False)
+    # weights are shared with the original executor
+    assert new_exe.arg_dict["fc_weight"] is exe.arg_dict["fc_weight"]
+    assert np.allclose(new_exe.outputs[0].asnumpy(), np.ones((3, 4)))
+
+
+def test_grad_req_add():
+    x = mx.sym.Variable("x")
+    y = 2.0 * x
+    xv = mx.nd.array(np.ones((2, 2)))
+    g = mx.nd.zeros((2, 2))
+    exe = y.bind(mx.cpu(), args={"x": xv}, args_grad={"x": g}, grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward()
+    exe.forward(is_train=True)
+    exe.backward()
+    assert np.allclose(g.asnumpy(), 4 * np.ones((2, 2)))
+
+
+def test_output_dict_and_copy_params():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    exe = y.simple_bind(mx.cpu(), x=(3, 2))
+    exe.copy_params_from({"fc_weight": mx.nd.ones((2, 2)),
+                          "fc_bias": mx.nd.zeros((2,))})
+    exe.arg_dict["x"][:] = 2
+    exe.forward()
+    assert list(exe.output_dict.keys()) == ["fc_output"]
+    assert np.allclose(exe.outputs[0].asnumpy(), 4 * np.ones((3, 2)))
+
+
+def test_monitor_callback():
+    stats = []
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    z = mx.sym.Activation(y, act_type="relu", name="act")
+    exe = z.simple_bind(mx.cpu(), x=(2, 2))
+    exe.set_monitor_callback(lambda name, arr: stats.append(name))
+    exe.arg_dict["x"][:] = 1
+    exe.forward()
+    assert "fc_output" in stats
+    assert "act_output" in stats
+
+
+def test_debug_str():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    exe = y.simple_bind(mx.cpu(), x=(2, 2))
+    s = exe.debug_str()
+    assert "fc" in s and "MB allocated" in s
+
+
+def test_forward_kwargs_update_args():
+    x = mx.sym.Variable("x")
+    y = x * 3.0
+    exe = y.simple_bind(mx.cpu(), x=(2, 2))
+    out = exe.forward(x=np.ones((2, 2), dtype=np.float32))
+    assert np.allclose(out[0].asnumpy(), 3 * np.ones((2, 2)))
+
+
+def test_head_gradient():
+    x = mx.sym.Variable("x")
+    y = x * x
+    xv = mx.nd.array(np.array([[1.0, 2.0]], dtype=np.float32))
+    g = mx.nd.zeros((1, 2))
+    exe = y.bind(mx.cpu(), args={"x": xv}, args_grad={"x": g})
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.array(np.array([[10.0, 100.0]], dtype=np.float32)))
+    assert np.allclose(g.asnumpy(), np.array([[20.0, 400.0]]))

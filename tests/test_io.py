@@ -1,0 +1,182 @@
+"""IO tests. Modeled on reference tests/python/unittest/test_io.py."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def test_NDArrayIter():
+    datas = np.ones([1000, 2, 2])
+    labels = np.ones([1000, 1])
+    for i in range(1000):
+        datas[i] = i / 100
+        labels[i] = i / 100
+    dataiter = mx.io.NDArrayIter(datas, labels, 128, True,
+                                 last_batch_handle="pad")
+    batchidx = 0
+    for batch in dataiter:
+        batchidx += 1
+    assert batchidx == 8
+    dataiter = mx.io.NDArrayIter(datas, labels, 128, False,
+                                 last_batch_handle="pad")
+    batchidx = 0
+    labelcount = [0] * 10
+    for batch in dataiter:
+        label = batch.label[0].asnumpy().flatten()
+        assert (batch.data[0].asnumpy()[:, 0, 0] == label).all()
+        for i in range(label.shape[0]):
+            labelcount[int(label[i])] += 1
+    for i in range(10):
+        if i == 0:
+            # pad up to 1024: the first 24 are repeated
+            assert labelcount[i] == 124
+        else:
+            assert labelcount[i] == 100
+
+
+def test_NDArrayIter_discard():
+    datas = np.random.rand(100, 3)
+    it = mx.io.NDArrayIter(datas, np.zeros(100), 32,
+                           last_batch_handle="discard")
+    n = sum(1 for _ in it)
+    assert n == 3
+
+
+def test_NDArrayIter_provide():
+    it = mx.io.NDArrayIter(np.zeros((20, 4)), np.zeros(20), batch_size=5)
+    assert it.provide_data == [("data", (5, 4))]
+    assert it.provide_label == [("softmax_label", (5,))]
+
+
+def test_resize_iter():
+    it = mx.io.NDArrayIter(np.zeros((30, 2)), np.zeros(30), batch_size=10)
+    r = mx.io.ResizeIter(it, 7)
+    n = sum(1 for _ in r)
+    assert n == 7
+
+
+def test_prefetching_iter():
+    it = mx.io.NDArrayIter(np.arange(40).reshape(40, 1).astype(np.float32),
+                           np.arange(40), batch_size=10)
+    p = mx.io.PrefetchingIter(it)
+    seen = []
+    for batch in p:
+        seen.append(batch.data[0].asnumpy()[0, 0])
+    assert len(seen) == 4
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(30, 6).astype(np.float32)
+    label = np.arange(30, dtype=np.float32)
+    dfile = str(tmp_path / "data.csv")
+    lfile = str(tmp_path / "label.csv")
+    np.savetxt(dfile, data.reshape(30, 6), delimiter=",")
+    np.savetxt(lfile, label, delimiter=",")
+    it = mx.io.CSVIter(data_csv=dfile, data_shape=(2, 3), label_csv=lfile,
+                       batch_size=10)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (10, 2, 3)
+
+
+def test_recordio(tmp_path):
+    frec = str(tmp_path / "test.rec")
+    N = 10
+    writer = recordio.MXRecordIO(frec, "w")
+    for i in range(N):
+        writer.write(bytes(str(i), "utf-8") * (i + 1))
+    writer.close()
+    reader = recordio.MXRecordIO(frec, "r")
+    for i in range(N):
+        res = reader.read()
+        assert res == bytes(str(i), "utf-8") * (i + 1)
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    fidx = str(tmp_path / "test.idx")
+    frec = str(tmp_path / "test.rec")
+    N = 10
+    writer = recordio.MXIndexedRecordIO(fidx, frec, "w")
+    for i in range(N):
+        writer.write_idx(i, bytes(str(i), "utf-8"))
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(fidx, frec, "r")
+    for i in reversed(range(N)):
+        res = reader.read_idx(i)
+        assert res == bytes(str(i), "utf-8")
+    reader.close()
+
+
+def test_image_record_pack_unpack():
+    label = 4.0
+    header = recordio.IRHeader(0, label, 7, 0)
+    s = b"\x01\x02\x03\x04"
+    packed = recordio.pack(header, s)
+    h2, s2 = recordio.unpack(packed)
+    assert h2.label == label and h2.id == 7
+    assert s2 == s
+    # multi-label
+    header = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 9, 0)
+    packed = recordio.pack(header, s)
+    h2, s2 = recordio.unpack(packed)
+    assert np.allclose(h2.label, [1, 2, 3]) and s2 == s
+
+
+def test_image_record_iter(tmp_path):
+    """Raw-packed records through the ImageRecordIter pipeline."""
+    frec = str(tmp_path / "img.rec")
+    writer = recordio.MXRecordIO(frec, "w")
+    N, C, H, W = 12, 3, 8, 8
+    rng = np.random.RandomState(0)
+    imgs = (rng.rand(N, C, H, W) * 255).astype(np.uint8)
+    have_pil = True
+    try:
+        import PIL  # noqa: F401
+    except ImportError:
+        have_pil = False
+    for i in range(N):
+        if have_pil:
+            payload = recordio.pack_img(
+                recordio.IRHeader(0, float(i % 3), i, 0),
+                imgs[i].transpose(1, 2, 0), img_fmt=".png")
+        else:
+            payload = recordio.pack(recordio.IRHeader(0, float(i % 3), i, 0),
+                                    imgs[i].tobytes())
+        writer.write(payload)
+    writer.close()
+    it = mx.io.ImageRecordIter(path_imgrec=frec, data_shape=(C, H, W),
+                               batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, C, H, W)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(labels.tolist()) == {0.0, 1.0, 2.0}
+
+
+def test_mnist_like_idx(tmp_path):
+    """MNISTIter reads standard idx files."""
+    import struct
+    imgs = (np.random.rand(50, 28, 28) * 255).astype(np.uint8)
+    labels = (np.arange(50) % 10).astype(np.uint8)
+    img_path = str(tmp_path / "train-images-idx3-ubyte")
+    lbl_path = str(tmp_path / "train-labels-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 50, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 50))
+        f.write(labels.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                         shuffle=False)
+    b = next(iter(it))
+    assert b.data[0].shape == (10, 1, 28, 28)
+    assert np.allclose(b.label[0].asnumpy(), labels[:10])
+    it2 = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                          flat=True, shuffle=False)
+    b = next(iter(it2))
+    assert b.data[0].shape == (10, 784)

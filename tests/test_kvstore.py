@@ -1,0 +1,123 @@
+"""KVStore tests — mirror of reference tests/python/unittest/test_kvstore.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+shape = (4, 4)
+keys = [5, 7, 11]
+
+
+def init_kv():
+    kv = mx.kv.create()
+    kv.init(3, mx.nd.zeros(shape))
+    kv.init(keys, [mx.nd.zeros(shape)] * len(keys))
+    return kv
+
+
+def check_diff_to_scalar(A, x):
+    assert np.sum(np.abs((A - x).asnumpy())) == 0
+
+
+def test_single_kv_pair():
+    kv = init_kv()
+    kv.push(3, mx.nd.ones(shape))
+    val = mx.nd.empty(shape)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1)
+
+
+def test_init():
+    kv = mx.kv.create()
+    kv.init(3, mx.nd.ones(shape) * 4)
+    a = mx.nd.zeros(shape)
+    kv.pull(3, out=a)
+    check_diff_to_scalar(a, 4)
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(keys, [mx.nd.ones(shape) * 4] * len(keys))
+    val = [mx.nd.empty(shape) for _ in keys]
+    kv.pull(keys, out=val)
+    for v in val:
+        check_diff_to_scalar(v, 4)
+
+
+def test_aggregator():
+    kv = init_kv()
+    num_devs = 4
+    devs = [mx.Context("cpu", i) for i in range(num_devs)]
+    vals = [mx.nd.ones(shape, d) for d in devs]
+    kv.push(3, vals)
+    kv.pull(3, out=vals)
+    for v in vals:
+        check_diff_to_scalar(v, num_devs)
+    vals = [[mx.nd.ones(shape, d) * 2.0 for d in devs]] * len(keys)
+    kv.push(keys, vals)
+    kv.pull(keys, out=vals)
+    for vv in vals:
+        for v in vv:
+            check_diff_to_scalar(v, num_devs * 2.0)
+
+
+def updater(key, recv, local):
+    local += recv
+
+
+def test_updater(dev="cpu"):
+    kv = init_kv()
+    kv._set_updater(updater)
+    num_devs = 4
+    devs = [mx.Context(dev, i) for i in range(num_devs)]
+    vals = [mx.nd.ones(shape, d) for d in devs]
+    kv.push(3, vals)
+    kv.pull(3, out=vals)
+    for v in vals:
+        check_diff_to_scalar(v, num_devs)
+    vals = [[mx.nd.ones(shape, d) for d in devs]] * len(keys)
+    num_push = 4
+    for _ in range(num_push):
+        kv.push(keys, vals)
+    kv.pull(keys, out=vals)
+    for vv in vals:
+        for v in vv:
+            check_diff_to_scalar(v, num_devs * num_push)
+
+
+def test_get_type():
+    kvtype = "local_allreduce_cpu"
+    kv = mx.kv.create(kvtype)
+    assert kv.type == kvtype
+
+
+def test_device_kvstore():
+    kv = mx.kv.create("device")
+    kv.init(0, mx.nd.zeros(shape))
+    kv.push(0, [mx.nd.ones(shape, mx.cpu(i)) for i in range(2)])
+    out = mx.nd.empty(shape)
+    kv.pull(0, out=out)
+    check_diff_to_scalar(out, 2)
+
+
+def test_set_optimizer_local():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.zeros(shape))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0, rescale_grad=1.0,
+                                      wd=0.0, momentum=0.0))
+    kv.push(0, mx.nd.ones(shape))
+    out = mx.nd.empty(shape)
+    kv.pull(0, out=out)
+    # sgd: w = 0 - lr * grad = -1
+    check_diff_to_scalar(out, -1)
+
+
+def test_dist_sync_tpu_single_process():
+    kv = mx.kv.create("dist_sync_tpu")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.init(3, mx.nd.ones(shape))
+    kv.push(3, mx.nd.ones(shape) * 2)
+    out = mx.nd.empty(shape)
+    kv.pull(3, out=out)
+    check_diff_to_scalar(out, 2)
+    kv.barrier()

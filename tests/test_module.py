@@ -1,0 +1,154 @@
+"""Module + training convergence tests. Modeled on reference
+tests/python/train/test_mlp.py and module unit usage."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def make_blobs(n=400, dim=10, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim) * 3
+    X = []
+    y = []
+    for i in range(n):
+        c = rng.randint(classes)
+        X.append(centers[c] + rng.randn(dim) * 0.5)
+        y.append(c)
+    return np.asarray(X, dtype=np.float32), np.asarray(y, dtype=np.float32)
+
+
+def mlp_sym(classes=4):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_fit_convergence():
+    np.random.seed(0)
+    mx.random.seed(0)
+    X, y = make_blobs()
+    it = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=5, optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(it, "acc")
+    assert acc[0][1] > 0.95, acc
+
+
+def test_module_multi_device_data_parallel():
+    """Fake multi-device data parallelism over cpu(0..3)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    X, y = make_blobs()
+    it = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(mlp_sym(), context=[mx.cpu(i) for i in range(4)])
+    mod.fit(it, num_epoch=5, optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(it, "acc")
+    assert acc[0][1] > 0.95, acc
+
+
+def test_module_predict_and_params():
+    np.random.seed(0)
+    X, y = make_blobs(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (100, 4)
+    arg, aux = mod.get_params()
+    assert "fc1_weight" in arg
+    # set_params round trip
+    mod2 = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params(arg_params=arg, aux_params=aux)
+    out2 = mod2.predict(it)
+    assert np.allclose(out.asnumpy(), out2.asnumpy(), atol=1e-5)
+
+
+def test_module_save_load_params(tmp_path):
+    X, y = make_blobs(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    fname = str(tmp_path / "params")
+    mod.save_params(fname)
+    arg1, _ = mod.get_params()
+    mod.load_params(fname)
+    arg2, _ = mod.get_params()
+    for k in arg1:
+        assert np.allclose(arg1[k].asnumpy(), arg2[k].asnumpy())
+
+
+def test_feedforward_fit_and_checkpoint(tmp_path):
+    np.random.seed(0)
+    mx.random.seed(0)
+    X, y = make_blobs()
+    it = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    model = mx.model.FeedForward(mlp_sym(), ctx=mx.cpu(), num_epoch=4,
+                                 learning_rate=0.5)
+    model.fit(it)
+    acc = model.score(it)
+    assert acc > 0.9, acc
+    prefix = str(tmp_path / "ffn")
+    model.save(prefix)
+    model2 = mx.model.FeedForward.load(prefix, 4, ctx=mx.cpu())
+    acc2 = model2.score(it)
+    assert abs(acc - acc2) < 1e-6
+    pred = model2.predict(it)
+    assert pred.shape == (400, 4)
+
+
+def test_bucketing_module():
+    """Buckets of different sequence lengths share parameters
+    (reference bucketing flow)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc_shared")
+        net = mx.sym.FullyConnected(net, num_hidden=2, name="out")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataBatch
+
+    def batch(key, bs=8):
+        X = np.random.randn(bs, key).astype(np.float32)
+        y = (X.sum(axis=1) > 0).astype(np.float32)
+        return DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)],
+                         bucket_key=key, pad=0,
+                         provide_data=[("data", (bs, key))],
+                         provide_label=[("softmax_label", (bs,))])
+
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    for key in (8, 4, 8, 4, 6):
+        b = batch(key)
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets.keys()) == {8, 4, 6}
+
+
+def test_monitor_in_module():
+    X, y = make_blobs(n=80)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    seen = []
+    mon = mx.Monitor(1, stat_func=lambda x: x, pattern=".*output")
+    mon.stat_helper_orig = mon.stat_helper
+    mod = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(iter(it)), is_train=True)
+    res = mon.toc()
+    assert len(res) > 0

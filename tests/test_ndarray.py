@@ -1,0 +1,247 @@
+"""NDArray tests. Modeled on reference tests/python/unittest/test_ndarray.py."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def same(a, b):
+    return np.sum(a != b) == 0
+
+
+def reldiff(a, b):
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a)) + 1e-12
+    return diff / norm
+
+
+def random_ndarray(dim):
+    shape = tuple(np.random.randint(1, 8, size=dim))
+    return mx.nd.array(np.random.uniform(-10, 10, shape))
+
+
+def test_ndarray_setitem():
+    shape = (3, 4, 2)
+    x = mx.nd.zeros(shape)
+    x[:] = 1
+    x_np = np.ones(shape, dtype=x.dtype)
+    assert same(x.asnumpy(), x_np)
+
+    x = mx.nd.zeros(shape)
+    x[1] = 1
+    x_np = np.zeros(shape, dtype=x.dtype)
+    x_np[1] = 1
+    assert same(x.asnumpy(), x_np)
+
+    x = mx.nd.zeros(shape)
+    x[1:3] = 1
+    x_np = np.zeros(shape, dtype=x.dtype)
+    x_np[1:3] = 1
+    assert same(x.asnumpy(), x_np)
+
+
+def test_ndarray_elementwise():
+    np.random.seed(0)
+    for scale in [1, 10]:
+        for dim in [1, 2, 3, 4]:
+            shape = tuple(np.random.randint(1, 6, size=dim))
+            a_np = np.random.uniform(1, 10, shape).astype(np.float32)
+            b_np = np.random.uniform(1, 10, shape).astype(np.float32)
+            a = mx.nd.array(a_np)
+            b = mx.nd.array(b_np)
+            assert reldiff((a + b).asnumpy(), a_np + b_np) < 1e-6
+            assert reldiff((a - b).asnumpy(), a_np - b_np) < 1e-6
+            assert reldiff((a * b).asnumpy(), a_np * b_np) < 1e-6
+            assert reldiff((a / b).asnumpy(), a_np / b_np) < 1e-5
+            assert reldiff((a + 2).asnumpy(), a_np + 2) < 1e-6
+            assert reldiff((2 - a).asnumpy(), 2 - a_np) < 1e-5
+            assert reldiff((a ** 2).asnumpy(), a_np ** 2) < 1e-5
+
+
+def test_ndarray_inplace():
+    a = mx.nd.ones((2, 3))
+    b = a
+    a += 2
+    assert same(a.asnumpy(), np.ones((2, 3)) * 3)
+    assert same(b.asnumpy(), np.ones((2, 3)) * 3)  # same handle sees mutation
+    a *= 2
+    assert same(a.asnumpy(), np.ones((2, 3)) * 6)
+    a -= 1
+    a /= 5
+    assert same(a.asnumpy(), np.ones((2, 3)))
+
+
+def test_ndarray_negate():
+    npy = np.random.uniform(-10, 10, (2, 3, 4)).astype(np.float32)
+    arr = mx.nd.array(npy)
+    assert reldiff(npy, arr.asnumpy()) < 1e-6
+    assert reldiff(-npy, (-arr).asnumpy()) < 1e-6
+    # negation doesn't mutate the source
+    assert reldiff(npy, arr.asnumpy()) < 1e-6
+
+
+def test_ndarray_slice():
+    shape = (10,)
+    A = mx.nd.array(np.random.uniform(-10, 10, shape))
+    A2 = A.asnumpy()
+    assert same(A[3:8].asnumpy(), A2[3:8])
+    A2[3:8] *= 10
+    A[3:8] = A2[3:8]
+    assert same(A[3:8].asnumpy(), A2[3:8])
+    assert same(A.asnumpy(), A2)
+
+
+def test_ndarray_slice_writethrough():
+    a = mx.nd.zeros((4, 3))
+    s = a[1:3]
+    s[:] = 5
+    out = a.asnumpy()
+    assert same(out[1:3], np.ones((2, 3)) * 5)
+    assert same(out[0], np.zeros(3))
+
+
+def test_ndarray_at_reshape_views():
+    a = mx.nd.array(np.arange(12).reshape(3, 4))
+    r = a.reshape((4, 3))
+    assert same(r.asnumpy(), np.arange(12).reshape(4, 3))
+    r[:] = 0
+    assert same(a.asnumpy(), np.zeros((3, 4)))
+    row = a[2]
+    row[:] = 7
+    assert same(a.asnumpy()[2], np.ones(4) * 7)
+
+
+def test_ndarray_scalar():
+    c = mx.nd.empty((10, 10))
+    d = mx.nd.empty((10, 10))
+    c[:] = 0.5
+    d[:] = 1.0
+    d -= c * 2 / 3 * 6.0
+    c += 0.5
+    assert np.sum(c.asnumpy()) - 100 < 1e-5
+    assert np.sum(d.asnumpy()) + 100 < 1e-5
+    c[:] = 2
+    assert np.sum(c.asnumpy()) == 200
+    d = -c + 2
+    assert np.sum(d.asnumpy()) == 0
+
+
+def test_ndarray_copy():
+    c = mx.nd.array(np.random.uniform(-10, 10, (10, 10)))
+    d = c.copyto(mx.cpu(0))
+    assert np.sum(np.abs(c.asnumpy() != d.asnumpy())) == 0.0
+    d2 = mx.nd.zeros((10, 10))
+    c.copyto(d2)
+    assert same(c.asnumpy(), d2.asnumpy())
+
+
+def test_ndarray_saveload():
+    np.random.seed(0)
+    nrepeat = 2
+    with tempfile.TemporaryDirectory() as tmpdir:
+        fname = os.path.join(tmpdir, "tmp_list.bin")
+        for _ in range(nrepeat):
+            data = []
+            for _ in range(5):
+                data.append(random_ndarray(np.random.randint(1, 5)))
+            mx.nd.save(fname, data)
+            data2 = mx.nd.load(fname)
+            assert len(data) == len(data2)
+            for x, y in zip(data, data2):
+                assert same(x.asnumpy(), y.asnumpy())
+            dmap = {"ndarray xx %s" % i: x for i, x in enumerate(data)}
+            mx.nd.save(fname, dmap)
+            dmap2 = mx.nd.load(fname)
+            assert len(dmap2) == len(dmap)
+            for k, x in dmap.items():
+                y = dmap2[k]
+                assert same(x.asnumpy(), y.asnumpy())
+
+
+def test_ndarray_pickle():
+    import pickle
+    np.random.seed(0)
+    for _ in range(5):
+        dim = np.random.randint(1, 5)
+        a = random_ndarray(dim)
+        a[:] = 0.5 * a + 1
+        data = pickle.dumps(a)
+        a2 = pickle.loads(data)
+        assert same(a.asnumpy(), a2.asnumpy())
+
+
+def test_clip():
+    shape = (10,)
+    A = mx.nd.array(np.random.uniform(-10, 10, shape))
+    B = mx.nd.clip(A, -2, 2)
+    B1 = B.asnumpy()
+    for i in range(shape[0]):
+        assert -2 <= B1[i] <= 2
+
+
+def test_dot():
+    a = np.random.uniform(-3, 3, (3, 4)).astype(np.float32)
+    b = np.random.uniform(-3, 3, (4, 5)).astype(np.float32)
+    c = np.dot(a, b)
+    A = mx.nd.array(a)
+    B = mx.nd.array(b)
+    C = mx.nd.dot(A, B)
+    assert reldiff(c, C.asnumpy()) < 1e-5
+
+
+def test_ndarray_onehot():
+    shape = (4, 5)
+    out = mx.nd.zeros(shape)
+    idx = mx.nd.array([1, 0, 2, 4])
+    mx.nd.onehot_encode(idx, out)
+    exp = np.zeros(shape, dtype=np.float32)
+    exp[np.arange(4), [1, 0, 2, 4]] = 1
+    assert same(out.asnumpy(), exp)
+
+
+def test_ndarray_choose():
+    a = np.random.uniform(-10, 10, (5, 4)).astype(np.float32)
+    idx = np.array([0, 1, 2, 3, 0], dtype=np.float32)
+    out = mx.nd.choose_element_0index(mx.nd.array(a), mx.nd.array(idx))
+    assert same(out.asnumpy(), a[np.arange(5), idx.astype(int)])
+
+
+def test_ndarray_broadcast_to():
+    a = mx.nd.array(np.arange(3).reshape(1, 3))
+    b = a.broadcast_to((4, 3))
+    assert same(b.asnumpy(), np.broadcast_to(np.arange(3).reshape(1, 3), (4, 3)))
+
+
+def test_ndarray_concatenate():
+    arrs = [mx.nd.array(np.random.rand(3, 4)) for _ in range(3)]
+    out = mx.nd.concatenate(arrs, axis=0)
+    exp = np.concatenate([a.asnumpy() for a in arrs], axis=0)
+    assert same(out.asnumpy(), exp)
+
+
+def test_ndarray_dtype():
+    a = mx.nd.zeros((3, 3), dtype=np.int32)
+    assert a.dtype == np.int32
+    b = a.astype(np.float32)
+    assert b.dtype == np.float32
+
+
+def test_waitall():
+    a = mx.nd.ones((10, 10))
+    b = a * 2
+    mx.nd.waitall()
+    assert same(b.asnumpy(), np.ones((10, 10)) * 2)
+
+
+def test_multi_cpu_devices():
+    """Fake-device trick: distinct cpu dev ids are independent devices."""
+    import jax
+    assert len(jax.devices("cpu")) >= 8
+    a = mx.nd.ones((4,), ctx=mx.cpu(2))
+    assert a.context == mx.Context("cpu", 2)
+    b = a.as_in_context(mx.cpu(5))
+    assert b.context == mx.Context("cpu", 5)
+    assert same(b.asnumpy(), np.ones(4))

@@ -1,0 +1,585 @@
+"""Operator tests: numeric + gradient checks against numpy references.
+Modeled on reference tests/python/unittest/test_operator.py (1519 LoC)."""
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from check_utils import (reldiff, check_numeric_gradient,
+                         check_symbolic_forward, same)
+
+np.random.seed(7)
+
+
+def exec_forward(sym, loc, is_train=False, aux=None):
+    ex = sym.simple_bind(mx.cpu(), grad_req="write",
+                         **{k: v.shape for k, v in loc.items()})
+    for k, v in loc.items():
+        ex.arg_dict[k][:] = np.asarray(v, dtype=np.float32)
+    if aux:
+        for k, v in aux.items():
+            ex.aux_dict[k][:] = np.asarray(v, dtype=np.float32)
+    ex.forward(is_train=is_train)
+    return ex
+
+
+def test_elementwise_sum():
+    n = 4
+    shape = (5, 5, 3)
+    inputs = [mx.sym.Variable("arg%d" % i) for i in range(n)]
+    out = mx.sym.ElementWiseSum(*inputs, name="esum")
+    arrs = [np.random.uniform(-10, 10, shape).astype(np.float32) for _ in range(n)]
+    ex = exec_forward(out, {"arg%d" % i: arrs[i] for i in range(n)}, is_train=True)
+    assert reldiff(ex.outputs[0].asnumpy(), sum(arrs)) < 1e-5
+    ex.backward()
+    for i in range(n):
+        assert reldiff(ex.grad_dict["arg%d" % i].asnumpy(), np.ones(shape)) < 1e-5
+
+
+def test_slice_channel():
+    data = mx.sym.Variable("data")
+    outs = mx.sym.SliceChannel(data, num_outputs=3, name="slice")
+    arr = np.random.rand(2, 6, 4).astype(np.float32)
+    ex = exec_forward(outs, {"data": arr})
+    for i in range(3):
+        assert same(ex.outputs[i].asnumpy(), arr[:, i * 2:(i + 1) * 2, :])
+
+
+def test_concat():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.Concat(a, b, dim=1)
+    av = np.random.rand(2, 3).astype(np.float32)
+    bv = np.random.rand(2, 5).astype(np.float32)
+    ex = exec_forward(out, {"a": av, "b": bv}, is_train=True)
+    assert same(ex.outputs[0].asnumpy(), np.concatenate([av, bv], axis=1))
+    ex.backward(mx.nd.array(np.ones((2, 8), dtype=np.float32)))
+    assert same(ex.grad_dict["a"].asnumpy(), np.ones((2, 3)))
+
+
+def test_activations():
+    x = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    cases = {
+        "relu": np.maximum(x, 0),
+        "sigmoid": 1 / (1 + np.exp(-x)),
+        "tanh": np.tanh(x),
+        "softrelu": np.log1p(np.exp(x)),
+    }
+    for act, expected in cases.items():
+        sym = mx.sym.Activation(data, act_type=act)
+        ex = exec_forward(sym, {"data": x})
+        assert reldiff(ex.outputs[0].asnumpy(), expected) < 1e-5, act
+        check_numeric_gradient(sym, {"data": x.copy() + 2.1})  # avoid kink
+
+
+def test_leaky_relu():
+    x = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    sym = mx.sym.LeakyReLU(data, act_type="leaky", slope=0.1)
+    ex = exec_forward(sym, {"data": x})
+    assert reldiff(ex.outputs[0].asnumpy(), np.where(x > 0, x, 0.1 * x)) < 1e-5
+    sym = mx.sym.LeakyReLU(data, act_type="elu", slope=0.5)
+    ex = exec_forward(sym, {"data": x})
+    assert reldiff(ex.outputs[0].asnumpy(),
+                   np.where(x > 0, x, 0.5 * (np.exp(x) - 1))) < 1e-5
+    # prelu with learnable gamma
+    sym = mx.sym.LeakyReLU(data, act_type="prelu", name="pr")
+    x4 = np.random.uniform(-2, 2, (2, 3, 4, 5)).astype(np.float32)
+    g = np.random.uniform(0.1, 0.5, (3,)).astype(np.float32)
+    ex = exec_forward(sym, {"data": x4, "pr_gamma": g})
+    expected = np.where(x4 > 0, x4, g.reshape(1, 3, 1, 1) * x4)
+    assert reldiff(ex.outputs[0].asnumpy(), expected) < 1e-5
+
+
+def test_fully_connected():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    x = np.random.rand(4, 3).astype(np.float32)
+    w = np.random.rand(5, 3).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    ex = exec_forward(fc, {"data": x, "fc_weight": w, "fc_bias": b})
+    assert reldiff(ex.outputs[0].asnumpy(), x @ w.T + b) < 1e-5
+    check_numeric_gradient(fc, {"data": x, "fc_weight": w, "fc_bias": b})
+
+
+def test_convolution():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                              name="conv")
+    x = np.random.rand(1, 1, 5, 5).astype(np.float32)
+    w = np.random.rand(2, 1, 3, 3).astype(np.float32)
+    b = np.zeros(2, dtype=np.float32)
+    ex = exec_forward(conv, {"data": x, "conv_weight": w, "conv_bias": b})
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (1, 2, 5, 5)
+    # direct numpy conv check
+    xp = np.pad(x[0, 0], 1)
+    expected = np.zeros((2, 5, 5), dtype=np.float32)
+    for f in range(2):
+        for i in range(5):
+            for j in range(5):
+                expected[f, i, j] = np.sum(xp[i:i + 3, j:j + 3] * w[f, 0])
+    assert reldiff(out[0], expected) < 1e-4
+    check_numeric_gradient(conv, {"data": x, "conv_weight": w, "conv_bias": b},
+                           numeric_eps=1e-2, check_eps=0.1)
+
+
+def test_convolution_grouping():
+    num_filter = 4
+    num_group = 2
+    kernel = (3, 3)
+    shape = (1, 4, 9, 9)
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    b = mx.sym.Variable("b")
+    y1 = mx.sym.Convolution(data=x, weight=w, bias=b, num_filter=num_filter,
+                            num_group=num_group, kernel=kernel)
+    xslice = mx.sym.SliceChannel(x, axis=1, num_outputs=num_group)
+    wslice = mx.sym.SliceChannel(w, axis=0, num_outputs=num_group)
+    bslice = mx.sym.SliceChannel(b, axis=0, num_outputs=num_group)
+    y2 = mx.sym.Concat(*[mx.sym.Convolution(
+        data=xslice[i], weight=wslice[i], bias=bslice[i],
+        num_filter=num_filter // num_group, kernel=kernel)
+        for i in range(num_group)], dim=1)
+    xv = np.random.rand(*shape).astype(np.float32)
+    wv = np.random.rand(num_filter, shape[1] // num_group, 3, 3).astype(np.float32)
+    bv = np.random.rand(num_filter).astype(np.float32)
+    ex1 = exec_forward(y1, {"x": xv, "w": wv, "b": bv})
+    ex2 = exec_forward(y2, {"x": xv, "w": wv, "b": bv})
+    assert reldiff(ex1.outputs[0].asnumpy(), ex2.outputs[0].asnumpy()) < 1e-5
+
+
+def test_deconvolution():
+    data = mx.sym.Variable("data")
+    deconv = mx.sym.Deconvolution(data, kernel=(4, 4), stride=(2, 2),
+                                  pad=(1, 1), num_filter=3, name="dc")
+    arg_shapes, out_shapes, _ = deconv.infer_shape(data=(2, 5, 7, 7))
+    assert out_shapes[0] == (2, 3, 14, 14)
+    x = np.random.rand(1, 2, 4, 4).astype(np.float32)
+    w = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    ex = exec_forward(deconv, {"data": x, "dc_weight": w})
+    assert ex.outputs[0].shape == (1, 3, 8, 8)
+    check_numeric_gradient(deconv, {"data": x, "dc_weight": w},
+                           numeric_eps=1e-2, check_eps=0.1)
+
+
+def test_pooling():
+    data = mx.sym.Variable("data")
+    x = np.random.rand(1, 2, 6, 6).astype(np.float32)
+    # max pool
+    p = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ex = exec_forward(p, {"data": x})
+    expected = x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+    assert reldiff(ex.outputs[0].asnumpy(), expected) < 1e-5
+    # avg pool
+    p = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    ex = exec_forward(p, {"data": x})
+    expected = x.reshape(1, 2, 3, 2, 3, 2).mean(axis=(3, 5))
+    assert reldiff(ex.outputs[0].asnumpy(), expected) < 1e-5
+    # global pool
+    p = mx.sym.Pooling(data, kernel=(1, 1), global_pool=True, pool_type="max")
+    ex = exec_forward(p, {"data": x})
+    assert reldiff(ex.outputs[0].asnumpy(),
+                   x.max(axis=(2, 3), keepdims=True)) < 1e-5
+    # floor convention: 6 with k=3 s=2 -> 2
+    p = mx.sym.Pooling(data, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    _, out_shapes, _ = p.infer_shape(data=(1, 2, 6, 6))
+    assert out_shapes[0] == (1, 2, 2, 2)
+
+
+def test_batchnorm():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, fix_gamma=False, eps=1e-3, name="bn")
+    x = np.random.rand(8, 3, 4, 4).astype(np.float32) * 10
+    gamma = np.random.rand(3).astype(np.float32) + 0.5
+    beta = np.random.rand(3).astype(np.float32)
+    ex = exec_forward(bn, {"data": x, "bn_gamma": gamma, "bn_beta": beta},
+                      is_train=True)
+    out = ex.outputs[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3), keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=(0, 2, 3), keepdims=True)
+    expected = gamma.reshape(1, 3, 1, 1) * (x - mean) / np.sqrt(var + 1e-3) \
+        + beta.reshape(1, 3, 1, 1)
+    assert reldiff(out, expected) < 1e-3
+    # moving stats updated
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    assert reldiff(mm, 0.1 * mean.reshape(3)) < 1e-3
+
+
+def test_batchnorm_inference_uses_moving_stats():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, fix_gamma=True, name="bn")
+    x = np.random.rand(4, 3).astype(np.float32)
+    ex = exec_forward(bn, {"data": x, "bn_gamma": np.ones(3, np.float32),
+                           "bn_beta": np.zeros(3, np.float32)},
+                      aux={"bn_moving_mean": np.zeros(3, np.float32),
+                           "bn_moving_var": np.ones(3, np.float32)},
+                      is_train=False)
+    assert reldiff(ex.outputs[0].asnumpy(), x / np.sqrt(1 + 1e-3)) < 1e-4
+
+
+def test_dropout():
+    data = mx.sym.Variable("data")
+    d = mx.sym.Dropout(data, p=0.5)
+    x = np.ones((200, 200), dtype=np.float32)
+    ex = exec_forward(d, {"data": x}, is_train=True)
+    out = ex.outputs[0].asnumpy()
+    frac = (out == 0).mean()
+    assert 0.4 < frac < 0.6
+    nz = out[out != 0]
+    assert reldiff(nz, np.ones_like(nz) * 2) < 1e-5
+    ex = exec_forward(d, {"data": x}, is_train=False)
+    assert same(ex.outputs[0].asnumpy(), x)
+
+
+def test_softmax_output():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.SoftmaxOutput(data, label=label, name="sm")
+    x = np.random.rand(4, 5).astype(np.float32)
+    y = np.array([0, 1, 2, 3], dtype=np.float32)
+    ex = exec_forward(sym, {"data": x, "label": y}, is_train=True)
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    assert reldiff(ex.outputs[0].asnumpy(), p) < 1e-5
+    ex.backward()
+    onehot = np.zeros((4, 5), dtype=np.float32)
+    onehot[np.arange(4), y.astype(int)] = 1
+    assert reldiff(ex.grad_dict["data"].asnumpy(), p - onehot) < 1e-5
+
+
+def test_softmax_output_ignore_label():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.SoftmaxOutput(data, label=label, use_ignore=True,
+                               ignore_label=1.0)
+    x = np.random.rand(4, 5).astype(np.float32)
+    y = np.array([0, 1, 2, 1], dtype=np.float32)
+    ex = exec_forward(sym, {"data": x, "label": y}, is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert np.abs(g[1]).sum() == 0 and np.abs(g[3]).sum() == 0
+    assert np.abs(g[0]).sum() > 0
+
+
+def test_regression():
+    # linear
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.LinearRegressionOutput(data, label=label)
+    x = np.random.rand(4, 3).astype(np.float32)
+    y = np.random.rand(4, 3).astype(np.float32)
+    ex = exec_forward(sym, {"data": x, "label": y}, is_train=True)
+    assert same(ex.outputs[0].asnumpy(), x)
+    ex.backward()
+    assert reldiff(ex.grad_dict["data"].asnumpy(), (x - y) / 3) < 1e-5
+    # logistic
+    sym = mx.sym.LogisticRegressionOutput(data, label=label)
+    ex = exec_forward(sym, {"data": x, "label": y}, is_train=True)
+    assert reldiff(ex.outputs[0].asnumpy(), 1 / (1 + np.exp(-x))) < 1e-5
+    ex.backward()
+    sig = 1 / (1 + np.exp(-x))
+    assert reldiff(ex.grad_dict["data"].asnumpy(), (sig - y) / 3) < 1e-5
+    # mae
+    sym = mx.sym.MAERegressionOutput(data, label=label)
+    ex = exec_forward(sym, {"data": x, "label": y}, is_train=True)
+    ex.backward()
+    assert reldiff(ex.grad_dict["data"].asnumpy(), np.sign(x - y) / 3) < 1e-5
+
+
+def test_block_grad():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.BlockGrad(data * 2.0) + data
+    x = np.random.rand(3, 3).astype(np.float32)
+    ex = exec_forward(sym, {"data": x}, is_train=True)
+    ex.backward()
+    assert reldiff(ex.grad_dict["data"].asnumpy(), np.ones((3, 3))) < 1e-5
+
+
+def test_make_loss():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.MakeLoss(mx.sym.square(data))
+    x = np.random.rand(3, 3).astype(np.float32)
+    ex = exec_forward(sym, {"data": x}, is_train=True)
+    ex.backward()
+    assert reldiff(ex.grad_dict["data"].asnumpy(), 2 * x) < 1e-5
+
+
+def test_reshape_flatten():
+    data = mx.sym.Variable("data")
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    sym = mx.sym.Reshape(data, target_shape=(2, 12))
+    ex = exec_forward(sym, {"data": x})
+    assert same(ex.outputs[0].asnumpy(), x.reshape(2, 12))
+    sym = mx.sym.Reshape(data, shape=(-1, 6))
+    ex = exec_forward(sym, {"data": x})
+    assert same(ex.outputs[0].asnumpy(), x.reshape(4, 6))
+    sym = mx.sym.Flatten(data)
+    ex = exec_forward(sym, {"data": x})
+    assert same(ex.outputs[0].asnumpy(), x.reshape(2, 12))
+
+
+def test_transpose_swapaxis():
+    data = mx.sym.Variable("data")
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    ex = exec_forward(mx.sym.transpose(data), {"data": x})
+    assert same(ex.outputs[0].asnumpy(), x.T)
+    ex = exec_forward(mx.sym.transpose(data, axes=(1, 0, 2)), {"data": x})
+    assert same(ex.outputs[0].asnumpy(), x.transpose(1, 0, 2))
+    ex = exec_forward(mx.sym.SwapAxis(data, dim1=0, dim2=2), {"data": x})
+    assert same(ex.outputs[0].asnumpy(), x.swapaxes(0, 2))
+
+
+def test_embedding():
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=10, output_dim=4, name="emb")
+    idx = np.array([1, 3, 5], dtype=np.float32)
+    w = np.random.rand(10, 4).astype(np.float32)
+    ex = exec_forward(emb, {"data": idx, "emb_weight": w}, is_train=True)
+    assert same(ex.outputs[0].asnumpy(), w[[1, 3, 5]])
+    ex.backward()
+    g = ex.grad_dict["emb_weight"].asnumpy()
+    expected = np.zeros_like(w)
+    expected[[1, 3, 5]] = 1
+    assert same(g, expected)
+
+
+def test_broadcast_ops():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    av = np.random.rand(2, 1, 4).astype(np.float32)
+    bv = np.random.rand(2, 3, 1).astype(np.float32)
+    ex = exec_forward(mx.sym.broadcast_mul(a, b), {"a": av, "b": bv})
+    assert reldiff(ex.outputs[0].asnumpy(), av * bv) < 1e-5
+    x = mx.sym.Variable("x")
+    xv = np.random.rand(2, 1, 3).astype(np.float32)
+    ex = exec_forward(mx.sym.broadcast_axis(x, axis=1, size=4), {"x": xv})
+    assert same(ex.outputs[0].asnumpy(), np.broadcast_to(xv, (2, 4, 3)))
+    ex = exec_forward(mx.sym.broadcast_to(x, shape=(2, 5, 3)), {"x": xv})
+    assert same(ex.outputs[0].asnumpy(), np.broadcast_to(xv, (2, 5, 3)))
+
+
+def test_reductions():
+    data = mx.sym.Variable("data")
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    ex = exec_forward(mx.sym.sum(data), {"data": x})
+    assert reldiff(ex.outputs[0].asnumpy(), np.array([x.sum()])) < 1e-5
+    ex = exec_forward(mx.sym.sum_axis(data, axis=1), {"data": x})
+    assert reldiff(ex.outputs[0].asnumpy(), x.sum(axis=1)) < 1e-5
+    ex = exec_forward(mx.sym.max_axis(data, axis=(0, 2)), {"data": x})
+    assert reldiff(ex.outputs[0].asnumpy(), x.max(axis=(0, 2))) < 1e-5
+    ex = exec_forward(mx.sym.norm(data), {"data": x})
+    assert reldiff(ex.outputs[0].asnumpy(),
+                   np.array([np.sqrt((x ** 2).sum())])) < 1e-5
+
+
+def test_unary_math():
+    data = mx.sym.Variable("data")
+    x = np.random.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    for name, fn in [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+                     ("square", np.square), ("abs", np.abs),
+                     ("sign", np.sign), ("cos", np.cos), ("sin", np.sin),
+                     ("rsqrt", lambda v: 1 / np.sqrt(v))]:
+        sym = getattr(mx.sym, name)(data)
+        ex = exec_forward(sym, {"data": x})
+        assert reldiff(ex.outputs[0].asnumpy(), fn(x)) < 1e-5, name
+
+
+def test_scalar_ops_symbol():
+    data = mx.sym.Variable("data")
+    x = np.random.rand(3, 3).astype(np.float32) + 1
+    ex = exec_forward(2.0 / data, {"data": x})
+    assert reldiff(ex.outputs[0].asnumpy(), 2.0 / x) < 1e-5
+    ex = exec_forward(data ** 2.0, {"data": x})
+    assert reldiff(ex.outputs[0].asnumpy(), x ** 2) < 1e-5
+    check_numeric_gradient(1.0 - data * 3.0, {"data": x})
+
+
+def test_dot_ops():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    av = np.random.rand(3, 4).astype(np.float32)
+    bv = np.random.rand(4, 5).astype(np.float32)
+    ex = exec_forward(mx.sym.dot(a, b), {"a": av, "b": bv})
+    assert reldiff(ex.outputs[0].asnumpy(), av @ bv) < 1e-5
+    av = np.random.rand(2, 3, 4).astype(np.float32)
+    bv = np.random.rand(2, 4, 5).astype(np.float32)
+    ex = exec_forward(mx.sym.batch_dot(a, b), {"a": av, "b": bv})
+    assert reldiff(ex.outputs[0].asnumpy(), av @ bv) < 1e-5
+
+
+def test_smooth_l1():
+    data = mx.sym.Variable("data")
+    x = np.array([[-2.0, -0.5, 0.0, 0.3, 2.0]], dtype=np.float32)
+    ex = exec_forward(mx.sym.smooth_l1(data, sigma=1.0), {"data": x})
+    expected = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    assert reldiff(ex.outputs[0].asnumpy(), expected) < 1e-5
+
+
+def test_softmax_cross_entropy():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.softmax_cross_entropy(data, label)
+    x = np.random.rand(4, 5).astype(np.float32)
+    y = np.array([0, 1, 2, 3], dtype=np.float32)
+    ex = exec_forward(sym, {"data": x, "label": y})
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    expected = -np.log(p[np.arange(4), y.astype(int)]).sum()
+    assert reldiff(ex.outputs[0].asnumpy(), np.array([expected])) < 1e-5
+
+
+def test_lrn():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.LRN(data, nsize=3, alpha=1e-4, beta=0.75, knorm=2.0)
+    x = np.random.rand(2, 5, 3, 3).astype(np.float32)
+    ex = exec_forward(sym, {"data": x})
+    out = ex.outputs[0].asnumpy()
+    # numpy reference
+    sq = x ** 2
+    expected = np.zeros_like(x)
+    for c in range(5):
+        lo, hi = max(0, c - 1), min(5, c + 2)
+        s = sq[:, lo:hi].sum(axis=1)
+        expected[:, c] = x[:, c] * (2.0 + 1e-4 / 3 * s) ** -0.75
+    assert reldiff(out, expected) < 1e-4
+
+
+def test_l2_normalization():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.L2Normalization(data)
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    ex = exec_forward(sym, {"data": x})
+    flat = x.reshape(3, -1)
+    expected = (flat / np.sqrt((flat ** 2).sum(axis=1, keepdims=True) + 1e-10)
+                ).reshape(x.shape)
+    assert reldiff(ex.outputs[0].asnumpy(), expected) < 1e-5
+
+
+def test_upsampling_nearest():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.UpSampling(data, scale=2, sample_type="nearest")
+    x = np.random.rand(1, 2, 3, 3).astype(np.float32)
+    ex = exec_forward(sym, {"data": x})
+    expected = x.repeat(2, axis=2).repeat(2, axis=3)
+    assert same(ex.outputs[0].asnumpy(), expected)
+
+
+def test_crop():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Crop(data, h_w=(2, 2), offset=(1, 1))
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    ex = exec_forward(sym, {"data": x})
+    assert same(ex.outputs[0].asnumpy(), x[:, :, 1:3, 1:3])
+
+
+def test_cast():
+    data = mx.sym.Variable("data")
+    sym = mx.sym.Cast(data, dtype="int32")
+    x = np.array([[1.6, 2.2], [-1.7, 0.0]], dtype=np.float32)
+    ex = exec_forward(sym, {"data": x})
+    assert ex.outputs[0].dtype == np.int32
+
+
+def test_expand_dims_slice_axis_flip():
+    data = mx.sym.Variable("data")
+    x = np.random.rand(3, 4).astype(np.float32)
+    ex = exec_forward(mx.sym.expand_dims(data, axis=1), {"data": x})
+    assert ex.outputs[0].shape == (3, 1, 4)
+    ex = exec_forward(mx.sym.slice_axis(data, axis=1, begin=1, end=3), {"data": x})
+    assert same(ex.outputs[0].asnumpy(), x[:, 1:3])
+    ex = exec_forward(mx.sym.flip(data, axis=1), {"data": x})
+    assert same(ex.outputs[0].asnumpy(), x[:, ::-1])
+
+
+def test_sample_ops():
+    sym = mx.sym._sample_uniform(low=0.0, high=1.0, shape=(100, 100))
+    ex = sym.simple_bind(mx.cpu())
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    assert 0.45 < out.mean() < 0.55
+    sym = mx.sym._sample_normal(loc=1.0, scale=2.0, shape=(100, 100))
+    ex = sym.simple_bind(mx.cpu())
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    assert 0.9 < out.mean() < 1.1
+    assert 1.8 < out.std() < 2.2
+
+
+def test_roi_pooling():
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    sym = mx.sym.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    r = np.array([[0, 0, 0, 3, 3]], dtype=np.float32)
+    ex = exec_forward(sym, {"data": x, "rois": r})
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (1, 1, 2, 2)
+    assert same(out[0, 0], np.array([[5, 7], [13, 15]], dtype=np.float32))
+
+
+def test_spatial_transformer_identity():
+    data = mx.sym.Variable("data")
+    loc = mx.sym.Variable("loc")
+    sym = mx.sym.SpatialTransformer(data, loc, target_shape=(4, 4),
+                                    transform_type="affine",
+                                    sampler_type="bilinear")
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], dtype=np.float32), (2, 1))
+    ex = exec_forward(sym, {"data": x, "loc": theta})
+    assert reldiff(ex.outputs[0].asnumpy(), x) < 1e-4
+
+
+def test_correlation_shapes():
+    a = mx.sym.Variable("data1")
+    b = mx.sym.Variable("data2")
+    sym = mx.sym.Correlation(a, b, kernel_size=1, max_displacement=2,
+                             stride1=1, stride2=1, pad_size=2)
+    av = np.random.rand(1, 2, 6, 6).astype(np.float32)
+    # identical inputs -> zero-displacement channel = mean over C of a^2
+    ex = exec_forward(sym, {"data1": av, "data2": av})
+    out = ex.outputs[0].asnumpy()
+    assert out.shape[1] == 25
+    center = out[0, 12]
+    expected = (av[0] ** 2).sum(axis=0) / 2.0
+    assert reldiff(center, expected) < 1e-4
+
+
+def test_svm_output():
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    sym = mx.sym.SVMOutput(data, label=label, margin=1.0)
+    x = np.random.rand(4, 3).astype(np.float32)
+    y = np.array([0, 1, 2, 0], dtype=np.float32)
+    ex = exec_forward(sym, {"data": x, "label": y}, is_train=True)
+    assert same(ex.outputs[0].asnumpy(), x)
+    ex.backward()
+    assert ex.grad_dict["data"].asnumpy().shape == x.shape
+
+
+def test_maximum_minimum():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    av = np.random.rand(3, 3).astype(np.float32)
+    bv = np.random.rand(3, 3).astype(np.float32)
+    ex = exec_forward(mx.sym._maximum(a, b), {"a": av, "b": bv})
+    assert same(ex.outputs[0].asnumpy(), np.maximum(av, bv))
+    ex = exec_forward(mx.sym._minimum(a, b), {"a": av, "b": bv})
+    assert same(ex.outputs[0].asnumpy(), np.minimum(av, bv))
+
+
+def test_mlp_gradient():
+    """End-to-end gradient through a small MLP."""
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="f1")
+    act = mx.sym.Activation(fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="f2")
+    loc = {"data": np.random.rand(4, 5).astype(np.float32),
+           "f1_weight": (np.random.rand(8, 5).astype(np.float32) - 0.5),
+           "f1_bias": np.random.rand(8).astype(np.float32),
+           "f2_weight": (np.random.rand(3, 8).astype(np.float32) - 0.5),
+           "f2_bias": np.random.rand(3).astype(np.float32)}
+    check_numeric_gradient(fc2, loc, numeric_eps=1e-2, check_eps=0.1)

@@ -1,0 +1,54 @@
+"""Random tests. Modeled on reference tests/python/unittest/test_random.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_uniform_basic():
+    mx.random.seed(42)
+    a = mx.random.uniform(-1, 1, shape=(1000,))
+    v = a.asnumpy()
+    assert v.min() >= -1 and v.max() < 1
+    assert abs(v.mean()) < 0.1
+
+
+def test_normal_basic():
+    mx.random.seed(42)
+    a = mx.random.normal(3, 2, shape=(10000,))
+    v = a.asnumpy()
+    assert abs(v.mean() - 3) < 0.1
+    assert abs(v.std() - 2) < 0.1
+
+
+def test_seed_determinism():
+    mx.random.seed(7)
+    a = mx.random.uniform(shape=(10,)).asnumpy()
+    mx.random.seed(7)
+    b = mx.random.uniform(shape=(10,)).asnumpy()
+    assert np.allclose(a, b)
+    c = mx.random.uniform(shape=(10,)).asnumpy()
+    assert not np.allclose(b, c)
+
+
+def test_out_param():
+    out = mx.nd.zeros((50,))
+    mx.random.uniform(10, 11, out=out)
+    v = out.asnumpy()
+    assert v.min() >= 10 and v.max() < 11
+
+
+def test_initializers():
+    for init, check in [
+            (mx.init.Uniform(0.1), lambda v: np.abs(v).max() <= 0.1),
+            (mx.init.Normal(0.1), lambda v: abs(v.mean()) < 0.05),
+            (mx.init.Xavier(), lambda v: np.isfinite(v).all()),
+            (mx.init.Orthogonal(), lambda v: np.isfinite(v).all()),
+            (mx.init.MSRAPrelu(), lambda v: np.isfinite(v).all())]:
+        arr = mx.nd.zeros((16, 16))
+        init("fc_weight", arr)
+        assert check(arr.asnumpy()), init
+    arr = mx.nd.zeros((16,))
+    mx.init.Uniform()("fc_bias", arr)
+    assert (arr.asnumpy() == 0).all()
+    mx.init.Uniform()("bn_gamma", arr)
+    assert (arr.asnumpy() == 1).all()

@@ -1,0 +1,177 @@
+"""Symbol tests. Modeled on reference tests/python/unittest/test_symbol.py,
+test_infer_shape.py, test_attr.py."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def mlp2():
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=1000)
+    out = mx.sym.Activation(data=out, act_type="relu")
+    out = mx.sym.FullyConnected(data=out, name="fc2", num_hidden=10)
+    return out
+
+
+def test_symbol_basic():
+    mlist = [mlp2()]
+    for m in mlist:
+        m.list_arguments()
+        m.list_outputs()
+
+
+def test_compose():
+    data = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = mx.sym.FullyConnected(data=net1, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                     "fc2_weight", "fc2_bias"]
+
+    net2 = mx.sym.FullyConnected(name="fc3", num_hidden=10)
+    net2 = mx.sym.Activation(data=net2, act_type="relu")
+    net2 = mx.sym.FullyConnected(data=net2, name="fc4", num_hidden=20)
+    composed = net2(fc3_data=net1, name="composed")
+    multi_out = mx.sym.Group([composed, net1])
+    assert len(multi_out.list_outputs()) == 2
+
+
+def test_symbol_internal():
+    data = mx.sym.Variable("data")
+    oldfc = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = mx.sym.FullyConnected(data=oldfc, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                     "fc2_weight", "fc2_bias"]
+    internal = net1.get_internals()
+    fc1 = internal["fc1_output"]
+    assert fc1.list_arguments() == oldfc.list_arguments()
+
+
+def test_symbol_pickle():
+    import pickle
+    mlist = [mlp2()]
+    data = pickle.dumps(mlist[0].tojson())
+    assert pickle.loads(data) == mlist[0].tojson()
+
+
+def test_symbol_saveload():
+    sym = mlp2()
+    with tempfile.TemporaryDirectory() as tmpdir:
+        fname = os.path.join(tmpdir, "net.json")
+        sym.save(fname)
+        data2 = mx.sym.load(fname)
+        assert sym.tojson() == data2.tojson()
+        assert sym.list_arguments() == data2.list_arguments()
+
+
+def test_symbol_infer_shape():
+    num_hidden = 128
+    num_dim = 64
+    num_sample = 10
+    data = mx.sym.Variable("data")
+    prev = mx.sym.Variable("prevstate")
+    x2h = mx.sym.FullyConnected(data=data, name="x2h", num_hidden=num_hidden)
+    p2h = mx.sym.FullyConnected(data=prev, name="p2h", num_hidden=num_hidden)
+    out = mx.sym.Activation(data=mx.sym.ElementWiseSum(x2h, p2h),
+                            name="out", act_type="relu")
+    # shape inference partial-through
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        data=(num_sample, num_dim), prevstate=(num_sample, num_hidden))
+    assert out_shapes[0] == (num_sample, num_hidden)
+    arg_dict = dict(zip(out.list_arguments(), arg_shapes))
+    assert arg_dict["x2h_weight"] == (num_hidden, num_dim)
+    assert arg_dict["p2h_weight"] == (num_hidden, num_hidden)
+
+
+def test_symbol_infer_shape_var():
+    "Test specifying shape information when constructing a variable"
+    shape = (2, 3)
+    a = mx.sym.Variable("a", shape=shape)
+    b = mx.sym.Variable("b")
+    c = a + b
+    arg_shapes, out_shapes, aux_shapes = c.infer_shape()
+    assert arg_shapes[0] == shape
+    assert arg_shapes[1] == shape
+    assert out_shapes[0] == shape
+
+    overwrite_shape = (5, 6)
+    arg_shapes, out_shapes, aux_shapes = c.infer_shape(a=overwrite_shape)
+    assert arg_shapes[0] == overwrite_shape
+    assert out_shapes[0] == overwrite_shape
+
+
+def test_symbol_infer_type():
+    data = mx.sym.Variable("data")
+    f32data = mx.sym.Cast(data=data, dtype="float32")
+    fc1 = mx.sym.FullyConnected(data=f32data, name="fc1", num_hidden=128)
+    arg, out, aux = fc1.infer_type(data=np.float32)
+    assert out == [np.dtype(np.float32)]
+
+
+def test_attr_basic():
+    with mx.AttrScope(group="4", data="great"):
+        data = mx.sym.Variable("data", attr={"dtype": "data",
+                                             "group": "1"})
+        gdata = mx.sym.Variable("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"
+
+    exceeded = False
+    try:
+        mx.AttrScope(x=1)
+    except ValueError:
+        exceeded = True
+    assert exceeded
+
+
+def test_attr_operator():
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(group="4"):
+        fc1 = mx.sym.Activation(data, act_type="relu")
+    with mx.AttrScope(group="3"):
+        fc2 = mx.sym.Activation(fc1, act_type="relu")
+    assert fc1.attr("group") == "4"
+    assert fc2.attr("group") == "3"
+
+
+def test_attr_in_json():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"mood": "so so"})
+    assert mx.sym.load_json(op.tojson()).attr_dict() == op.attr_dict()
+
+
+def test_variable_shape_attr_roundtrip():
+    a = mx.sym.Variable("a", shape=(3,))
+    b = a * 2.0
+    arg_shapes, out_shapes, _ = b.infer_shape()
+    assert out_shapes[0] == (3,)
+    b2 = mx.sym.load_json(b.tojson())
+    arg_shapes, out_shapes, _ = b2.infer_shape()
+    assert out_shapes[0] == (3,)
+
+
+def test_symbol_grouping_and_indexing():
+    a = mx.sym.Variable("a")
+    b = a + 1.0
+    c = a * 2.0
+    g = mx.sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    assert g[1].list_outputs() == c.list_outputs()
+
+
+def test_list_auxiliary_states():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_symbol_args_kwargs_errors():
+    data = mx.sym.Variable("data")
+    with pytest.raises(mx.MXNetError):
+        mx.sym.FullyConnected(data)  # missing num_hidden
+    with pytest.raises(mx.MXNetError):
+        mx.sym.FullyConnected(data, num_hidden=4, bogus_param=1)
