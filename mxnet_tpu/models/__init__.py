@@ -1,0 +1,13 @@
+"""Model zoo: the reference's example/ network definitions, rebuilt on the
+mxnet_tpu symbol API (reference example/image-classification/symbol_*.py,
+example/rnn/lstm.py — capability parity, fresh implementations)."""
+from .mlp import get_mlp
+from .lenet import get_lenet
+from .resnet import get_resnet, get_resnet50
+from .inception_bn import get_inception_bn
+from .vgg import get_vgg
+from .lstm import lstm_unroll, lstm_cell, LSTMState, LSTMParam
+
+__all__ = ["get_mlp", "get_lenet", "get_resnet", "get_resnet50",
+           "get_inception_bn", "get_vgg", "lstm_unroll", "lstm_cell",
+           "LSTMState", "LSTMParam"]

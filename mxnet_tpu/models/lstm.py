@@ -1,0 +1,107 @@
+"""Unrolled LSTM for bucketing language models.
+
+Reference capability: example/rnn/lstm.py lstm_unroll (explicit unrolling,
+truncated BPTT via carried init states), example/model-parallel-lstm
+(ctx_group layer placement).  Fresh implementation.
+
+TPU notes: each bucket length compiles to one fused XLA program; per-layer
+``ctx_group`` attrs place layers on mesh axes for model parallelism.
+"""
+from collections import namedtuple
+
+from .. import symbol as sym
+
+LSTMState = namedtuple("LSTMState", ["c", "h"])
+LSTMParam = namedtuple("LSTMParam", ["i2h_weight", "i2h_bias",
+                                     "h2h_weight", "h2h_bias"])
+
+
+def lstm_cell(num_hidden, indata, prev_state, param, seqidx, layeridx,
+              dropout=0.0):
+    """One LSTM step (4 gates via one fused FC pair -> MXU-friendly)."""
+    if dropout > 0.0:
+        indata = sym.Dropout(data=indata, p=dropout)
+    i2h = sym.FullyConnected(data=indata, weight=param.i2h_weight,
+                             bias=param.i2h_bias, num_hidden=num_hidden * 4,
+                             name="t%d_l%d_i2h" % (seqidx, layeridx))
+    h2h = sym.FullyConnected(data=prev_state.h, weight=param.h2h_weight,
+                             bias=param.h2h_bias, num_hidden=num_hidden * 4,
+                             name="t%d_l%d_h2h" % (seqidx, layeridx))
+    gates = i2h + h2h
+    slices = sym.SliceChannel(gates, num_outputs=4,
+                              name="t%d_l%d_slice" % (seqidx, layeridx))
+    in_gate = sym.Activation(slices[0], act_type="sigmoid")
+    in_transform = sym.Activation(slices[1], act_type="tanh")
+    forget_gate = sym.Activation(slices[2], act_type="sigmoid")
+    out_gate = sym.Activation(slices[3], act_type="sigmoid")
+    next_c = (forget_gate * prev_state.c) + (in_gate * in_transform)
+    next_h = out_gate * sym.Activation(next_c, act_type="tanh")
+    return LSTMState(c=next_c, h=next_h)
+
+
+def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
+                num_label, dropout=0.0, ctx_groups=None):
+    """Unrolled LSTM LM (reference lstm.py lstm_unroll).
+
+    ctx_groups: optional list of group names per layer for model-parallel
+    placement (example/model-parallel-lstm capability).
+    """
+    embed_weight = sym.Variable("embed_weight")
+    cls_weight = sym.Variable("cls_weight")
+    cls_bias = sym.Variable("cls_bias")
+    param_cells = []
+    last_states = []
+    for i in range(num_lstm_layer):
+        param_cells.append(LSTMParam(
+            i2h_weight=sym.Variable("l%d_i2h_weight" % i),
+            i2h_bias=sym.Variable("l%d_i2h_bias" % i),
+            h2h_weight=sym.Variable("l%d_h2h_weight" % i),
+            h2h_bias=sym.Variable("l%d_h2h_bias" % i)))
+        last_states.append(LSTMState(
+            c=sym.Variable("l%d_init_c" % i),
+            h=sym.Variable("l%d_init_h" % i)))
+
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(data=data, input_dim=input_size, weight=embed_weight,
+                          output_dim=num_embed, name="embed")
+    wordvec = sym.SliceChannel(data=embed, num_outputs=seq_len,
+                               squeeze_axis=True, name="wordvec_slice")
+
+    hidden_all = []
+    for seqidx in range(seq_len):
+        hidden = wordvec[seqidx]
+        for i in range(num_lstm_layer):
+            if ctx_groups is not None:
+                from ..attribute import AttrScope
+                with AttrScope(ctx_group=ctx_groups[i]):
+                    next_state = lstm_cell(num_hidden, indata=hidden,
+                                           prev_state=last_states[i],
+                                           param=param_cells[i],
+                                           seqidx=seqidx, layeridx=i,
+                                           dropout=dropout if i > 0 else 0.0)
+            else:
+                next_state = lstm_cell(num_hidden, indata=hidden,
+                                       prev_state=last_states[i],
+                                       param=param_cells[i],
+                                       seqidx=seqidx, layeridx=i,
+                                       dropout=dropout if i > 0 else 0.0)
+            hidden = next_state.h
+            last_states[i] = next_state
+        if dropout > 0.0:
+            hidden = sym.Dropout(data=hidden, p=dropout)
+        hidden_all.append(hidden)
+
+    hidden_concat = sym.Concat(*hidden_all, dim=0)
+    pred = sym.FullyConnected(data=hidden_concat, num_hidden=num_label,
+                              weight=cls_weight, bias=cls_bias, name="pred")
+    label_t = sym.transpose(data=label)
+    label_flat = sym.Reshape(data=label_t, target_shape=(0,), shape=(-1,))
+    return sym.SoftmaxOutput(data=pred, label=label_flat, name="softmax")
+
+
+def lstm_inference_symbol(num_lstm_layer, input_size, num_hidden, num_embed,
+                          num_label, dropout=0.0):
+    """Single-step inference symbol (reference lstm.py lstm_inference_symbol)."""
+    return lstm_unroll(num_lstm_layer, 1, input_size, num_hidden, num_embed,
+                       num_label, dropout)

@@ -1,0 +1,114 @@
+"""Model zoo smoke tests: shapes infer, forward/backward runs."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def _run_fwd_bwd(net, data_shape, label_shape, extra=None):
+    shapes = {"data": data_shape, "softmax_label": label_shape}
+    if extra:
+        shapes.update(extra)
+    ex = net.simple_bind(mx.cpu(), **shapes)
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name not in shapes:
+            init(name, arr)
+    for name, arr in ex.aux_dict.items():
+        init(name, arr)
+    ex.arg_dict["data"][:] = np.random.randn(*data_shape).astype(np.float32)
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    assert np.isfinite(out).all()
+    ex.backward()
+    return ex
+
+
+def test_mlp_shapes():
+    net = models.get_mlp(10)
+    args, outs, _ = net.infer_shape(data=(32, 784))
+    assert outs[0] == (32, 10)
+    _run_fwd_bwd(net, (4, 784), (4,))
+
+
+def test_lenet_shapes():
+    net = models.get_lenet(10)
+    args, outs, _ = net.infer_shape(data=(8, 1, 28, 28))
+    assert outs[0] == (8, 10)
+    _run_fwd_bwd(net, (2, 1, 28, 28), (2,))
+
+
+def test_resnet50_shapes():
+    net = models.get_resnet50(1000)
+    args, outs, aux = net.infer_shape(data=(2, 3, 224, 224))
+    assert outs[0] == (2, 1000)
+    # 53 convolutions in ResNet-50 (49 main + 4 downsample)
+    n_conv = sum(1 for a in net.list_arguments() if a.endswith("_conv_weight"))
+    assert n_conv == 53
+
+
+def test_resnet_small_train():
+    net = models.get_resnet([1, 1], [8, 16, 32], num_classes=4)
+    ex = _run_fwd_bwd(net, (2, 3, 32, 32), (2,))
+    g = ex.grad_dict["stem_conv_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_inception_bn_shapes():
+    net = models.get_inception_bn(1000)
+    args, outs, _ = net.infer_shape(data=(2, 3, 224, 224))
+    assert outs[0] == (2, 1000)
+
+
+def test_inception_bn_small():
+    from mxnet_tpu.models.inception_bn import get_inception_bn_28small
+    net = get_inception_bn_28small(10)
+    args, outs, _ = net.infer_shape(data=(2, 3, 28, 28))
+    assert outs[0] == (2, 10)
+
+
+def test_vgg_shapes():
+    net = models.get_vgg(1000)
+    args, outs, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert outs[0] == (1, 1000)
+
+
+def test_lstm_unroll():
+    seq_len = 4
+    net = models.lstm_unroll(num_lstm_layer=2, seq_len=seq_len, input_size=50,
+                             num_hidden=16, num_embed=8, num_label=50)
+    bs = 3
+    shapes = {"data": (bs, seq_len), "softmax_label": (bs, seq_len)}
+    for i in range(2):
+        shapes["l%d_init_c" % i] = (bs, 16)
+        shapes["l%d_init_h" % i] = (bs, 16)
+    args, outs, _ = net.infer_shape(**shapes)
+    assert outs[0] == (bs * seq_len, 50)
+    ex = net.simple_bind(mx.cpu(), **shapes)
+    ex.arg_dict["data"][:] = np.random.randint(0, 50, (bs, seq_len)).astype("f")
+    ex.arg_dict["softmax_label"][:] = np.random.randint(
+        0, 50, (bs, seq_len)).astype("f")
+    for name, arr in ex.arg_dict.items():
+        if name.endswith("weight"):
+            arr[:] = np.random.randn(*arr.shape).astype(np.float32) * 0.05
+    ex.forward(is_train=True)
+    assert np.isfinite(ex.outputs[0].asnumpy()).all()
+    ex.backward()
+    assert np.abs(ex.grad_dict["l0_i2h_weight"].asnumpy()).sum() > 0
+
+
+def test_lstm_model_parallel_groups():
+    net = models.lstm_unroll(num_lstm_layer=2, seq_len=2, input_size=20,
+                             num_hidden=8, num_embed=4, num_label=20,
+                             ctx_groups=["g0", "g1"])
+    bs = 2
+    shapes = {"data": (bs, 2), "softmax_label": (bs, 2)}
+    for i in range(2):
+        shapes["l%d_init_c" % i] = (bs, 8)
+        shapes["l%d_init_h" % i] = (bs, 8)
+    ex = net.simple_bind(mx.cpu(0), group2ctx={"g0": mx.cpu(1), "g1": mx.cpu(2)},
+                         **shapes)
+    ex.arg_dict["data"][:] = np.zeros((bs, 2), "f")
+    ex.forward(is_train=True)
+    assert np.isfinite(ex.outputs[0].asnumpy()).all()
