@@ -1,0 +1,70 @@
+"""Benchmark: ResNet-50 training throughput, images/sec on one TPU chip.
+
+North star (BASELINE.json): match MXNet-CUDA per-chip ResNet-class training
+throughput. In-repo baseline: ImageNet Inception-BN b512 on 4x TitanX =
+2,495 s/epoch => ~128 img/s/GPU (BASELINE.md, derived).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S_PER_CHIP = 128.0  # MXNet-CUDA TitanX img/s/GPU (BASELINE.md)
+
+
+def build_step(batch):
+    import jax
+    from mxnet_tpu.parallel import make_mesh, DPTrainStep
+    from __graft_entry__ import _resnet_prog
+
+    net, prog, params, aux, data, label = _resnet_prog(
+        [3, 4, 6, 3], [64, 256, 512, 1024, 2048], 1000, (3, 224, 224), batch)
+    mesh = make_mesh([("dp", 1)], devices=jax.devices()[:1])
+    step = DPTrainStep(net, mesh, learning_rate=0.1, momentum=0.9,
+                       weight_decay=1e-4, rescale_grad=1.0 / batch)
+    state = step.init(params, aux)
+    sharded = step.shard_batch({"data": data, "softmax_label": label})
+    return step, state, sharded
+
+
+def run(batch, warmup=3, iters=10):
+    import jax
+    step, state, batch_data = build_step(batch)
+    for _ in range(warmup):
+        state, outs = step(state, batch_data)
+    jax.block_until_ready(outs)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, outs = step(state, batch_data)
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def main():
+    import jax
+    value = None
+    for batch in (128, 64, 32):
+        try:
+            value = run(batch)
+            break
+        except Exception as e:  # OOM etc: halve the batch
+            sys.stderr.write("bench: batch %d failed (%s)\n" % (batch, e))
+    if value is None:
+        print(json.dumps({"metric": "resnet50_train_throughput_per_chip",
+                          "value": 0.0, "unit": "images/sec",
+                          "vs_baseline": 0.0}))
+        return
+    print(json.dumps({
+        "metric": "resnet50_train_throughput_per_chip",
+        "value": round(value, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(value / BASELINE_IMG_S_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
