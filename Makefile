@@ -1,0 +1,26 @@
+# Build the native core (libmxtpu.so: recordio + threaded batch loader)
+# and the im2rec tool.  Reference analogue: the reference's Makefile building
+# libmxnet.so; here the XLA/PJRT runtime comes from jaxlib, so the native
+# library covers the IO/runtime pieces the reference wrote in C++.
+CXX ?= g++
+CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -pthread
+LIB = mxnet_tpu/libmxtpu.so
+SRCS = src/recordio.cc src/data_loader.cc
+
+all: $(LIB) bin/im2rec
+
+$(LIB): $(SRCS) src/recordio.h
+	@mkdir -p $(dir $@)
+	$(CXX) $(CXXFLAGS) -shared $(SRCS) -o $@
+
+bin/im2rec: src/im2rec.cc src/recordio.cc src/recordio.h
+	@mkdir -p bin
+	$(CXX) $(CXXFLAGS) src/im2rec.cc src/recordio.cc -o $@
+
+test: all
+	python -m pytest tests/ -q
+
+clean:
+	rm -f $(LIB) bin/im2rec
+
+.PHONY: all test clean

@@ -1,0 +1,67 @@
+"""Train ImageNet (reference example/image-classification/train_imagenet.py
+capability — the north-star script: runs with only --gpus -> --tpus changed)."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models import (get_mlp, get_lenet, get_resnet50,
+                              get_inception_bn, get_vgg)
+import train_model
+
+
+def get_iterators(args, kv):
+    rank = kv.rank if kv else 0
+    nworker = kv.num_workers if kv else 1
+    train = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(args.data_dir, "train.rec"),
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        data_shape=tuple(args.data_shape),
+        batch_size=args.batch_size, rand_crop=True, rand_mirror=True,
+        part_index=rank, num_parts=nworker)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(args.data_dir, "val.rec"),
+        mean_r=123.68, mean_g=116.779, mean_b=103.939,
+        data_shape=tuple(args.data_shape),
+        batch_size=args.batch_size,
+        part_index=rank, num_parts=nworker)
+    return (train, val)
+
+
+NETS = {
+    "resnet-50": lambda c: get_resnet50(c),
+    "inception-bn": lambda c: get_inception_bn(c),
+    "vgg": lambda c: get_vgg(c),
+}
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train imagenet")
+    parser.add_argument("--network", type=str, default="resnet-50",
+                        choices=sorted(NETS))
+    parser.add_argument("--data-dir", type=str, default="imagenet/")
+    parser.add_argument("--tpus", type=str, help="tpus to use, e.g. '0,1,2,3'")
+    parser.add_argument("--gpus", type=str, help="accepted alias of --tpus")
+    parser.add_argument("--kv-store", type=str, default="local")
+    parser.add_argument("--num-epochs", type=int, default=20)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--data-shape", type=int, nargs=3,
+                        default=[3, 224, 224])
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--model-prefix", type=str)
+    parser.add_argument("--load-epoch", type=int)
+    parser.add_argument("--num-examples", type=int, default=1281167)
+    parser.add_argument("--lr-factor", type=float, default=1)
+    parser.add_argument("--lr-factor-epoch", type=float, default=1)
+    args = parser.parse_args()
+
+    net = NETS[args.network](args.num_classes)
+    logging.basicConfig(level=logging.INFO)
+    train_model.fit(args, net, get_iterators)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Train MNIST (reference example/image-classification/train_mnist.py
+capability; --gpus -> --tpus)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_mlp, get_lenet
+import train_model
+
+
+def get_iterators(args, kv):
+    data_dir = args.data_dir
+    flat = args.network == "mlp"
+    rank = kv.rank if kv else 0
+    nworker = kv.num_workers if kv else 1
+    train = mx.io.MNISTIter(
+        image=os.path.join(data_dir, "train-images-idx3-ubyte"),
+        label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+        batch_size=args.batch_size, flat=flat,
+        part_index=rank, num_parts=nworker)
+    val = mx.io.MNISTIter(
+        image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+        label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+        batch_size=args.batch_size, flat=flat, shuffle=False)
+    return (train, val)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.add_argument("--network", type=str, default="mlp",
+                        choices=["mlp", "lenet"])
+    parser.add_argument("--data-dir", type=str, default="mnist/")
+    parser.add_argument("--tpus", type=str, help="tpus to use, e.g. '0,1'")
+    parser.add_argument("--gpus", type=str, help="accepted alias of --tpus")
+    parser.add_argument("--kv-store", type=str, default="local")
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--model-prefix", type=str)
+    parser.add_argument("--load-epoch", type=int)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--lr-factor", type=float, default=1)
+    parser.add_argument("--lr-factor-epoch", type=float, default=1)
+    args = parser.parse_args()
+
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    train_model.fit(args, net, get_iterators)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Shared training harness (reference example/image-classification/
+train_model.py:8-69 capability: kvstore from --kv-store, devices from
+--tpus/--gpus, checkpointing, lr schedule)."""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def fit(args, network, data_loader):
+    # devices: --tpus takes precedence (north star: --gpus -> --tpus only)
+    devs = None
+    if getattr(args, "tpus", None):
+        devs = [mx.tpu(int(i)) for i in args.tpus.split(",")]
+    elif getattr(args, "gpus", None):
+        devs = [mx.gpu(int(i)) for i in args.gpus.split(",")]
+    else:
+        devs = [mx.cpu()]
+
+    kv = mx.create_kvstore(args.kv_store) if args.kv_store else None
+
+    # load / save model
+    model_prefix = getattr(args, "model_prefix", None)
+    checkpoint = None if model_prefix is None else \
+        mx.callback.do_checkpoint(model_prefix)
+    arg_params = None
+    aux_params = None
+    begin_epoch = 0
+    if getattr(args, "load_epoch", None):
+        assert model_prefix is not None
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch
+
+    lr_scheduler = None
+    if getattr(args, "lr_factor", 1) < 1 and getattr(args, "lr_factor_epoch", 0) > 0:
+        epoch_size = args.num_examples // args.batch_size
+        lr_scheduler = mx.lr_scheduler.FactorScheduler(
+            step=max(int(epoch_size * args.lr_factor_epoch), 1),
+            factor=args.lr_factor)
+
+    model = mx.model.FeedForward(
+        symbol=network, ctx=devs, num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=0.9, wd=0.00001,
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        arg_params=arg_params, aux_params=aux_params,
+        begin_epoch=begin_epoch, lr_scheduler=lr_scheduler)
+
+    train, val = data_loader(args, kv)
+    model.fit(X=train, eval_data=val, kvstore=kv,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+              epoch_end_callback=checkpoint)
+    return model

@@ -1,0 +1,120 @@
+"""Bucketed sentence iterator (reference example/rnn/bucket_io.py capability)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataIter, DataBatch
+
+
+def default_read_content(path):
+    with open(path) as f:
+        return f.read().replace("\n", " <eos> ")
+
+
+def default_build_vocab(path):
+    content = default_read_content(path).split(" ")
+    vocab = {}
+    idx = 1  # 0 reserved for padding
+    for word in content:
+        if word and word not in vocab:
+            vocab[word] = idx
+            idx += 1
+    return vocab
+
+
+def default_text2id(sentence, vocab):
+    return [vocab[w] for w in sentence.split(" ") if w and w in vocab]
+
+
+class BucketSentenceIter(DataIter):
+    """Group sentences by length bucket (reference bucket_io.py)."""
+
+    def __init__(self, path, vocab, buckets, batch_size, init_states,
+                 data_name="data", label_name="softmax_label",
+                 text2id=None, read_content=None):
+        super().__init__()
+        self.vocab_size = len(vocab)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.batch_size = batch_size
+        buckets = sorted(buckets)
+        self.buckets = buckets
+        content = (read_content or default_read_content)(path)
+        sentences = content.split(" <eos> ")
+        self.data = [[] for _ in buckets]
+        discard = 0
+        for sentence in sentences:
+            ids = (text2id or default_text2id)(sentence, vocab)
+            if not ids:
+                continue
+            placed = False
+            for i, bkt in enumerate(buckets):
+                if bkt >= len(ids):
+                    self.data[i].append(ids + [0] * (bkt - len(ids)))
+                    placed = True
+                    break
+            if not placed:
+                discard += 1
+        self.data = [np.asarray(x, dtype=np.float32) if x else
+                     np.zeros((0, b), dtype=np.float32)
+                     for x, b in zip(self.data, buckets)]
+        self.init_states = init_states
+        self.init_state_arrays = [mx.nd.zeros(x[1]) for x in init_states]
+        self.default_bucket_key = max(buckets)
+        self.make_data_iter_plan()
+
+    @property
+    def provide_data(self):
+        return [(self.data_name, (self.batch_size, self.default_bucket_key))] + \
+            list(self.init_states)
+
+    @property
+    def provide_label(self):
+        return [(self.label_name, (self.batch_size, self.default_bucket_key))]
+
+    def make_data_iter_plan(self):
+        bucket_n_batches = []
+        for i in range(len(self.data)):
+            bucket_n_batches.append(len(self.data[i]) // self.batch_size)
+            self.data[i] = self.data[i][:int(bucket_n_batches[i] * self.batch_size)]
+        bucket_plan = np.hstack([np.zeros(n, int) + i
+                                 for i, n in enumerate(bucket_n_batches)])
+        np.random.shuffle(bucket_plan)
+        bucket_idx_all = [np.random.permutation(len(x)) for x in self.data]
+        self.bucket_plan = bucket_plan
+        self.bucket_idx_all = bucket_idx_all
+        self.bucket_curr_idx = [0 for _ in self.data]
+        self._plan_pos = 0
+
+    def reset(self):
+        self.bucket_curr_idx = [0 for _ in self.data]
+        self._plan_pos = 0
+        np.random.shuffle(self.bucket_plan)
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        if self._plan_pos >= len(self.bucket_plan):
+            raise StopIteration
+        i_bucket = self.bucket_plan[self._plan_pos]
+        self._plan_pos += 1
+        idx = self.bucket_curr_idx[i_bucket]
+        self.bucket_curr_idx[i_bucket] += self.batch_size
+        data = self.data[i_bucket][idx:idx + self.batch_size]
+        label = np.zeros_like(data)
+        label[:, :-1] = data[:, 1:]
+        seq_len = self.buckets[i_bucket]
+        data_all = [mx.nd.array(data)] + self.init_state_arrays
+        label_all = [mx.nd.array(label)]
+        data_names = [self.data_name] + [x[0] for x in self.init_states]
+        provide_data = [(self.data_name, (self.batch_size, seq_len))] + \
+            [(n, s) for n, s in self.init_states]
+        provide_label = [(self.label_name, (self.batch_size, seq_len))]
+        return DataBatch(data=data_all, label=label_all, pad=0,
+                         bucket_key=seq_len,
+                         provide_data=provide_data,
+                         provide_label=provide_label)

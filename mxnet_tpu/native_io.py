@@ -1,0 +1,135 @@
+"""ctypes bindings for the native IO core (libmxtpu.so).
+
+Reference analogue: the C++ src/io/ pipeline reached through the C ABI +
+ctypes, exactly like the reference python package reached libmxnet.so.
+The native loader runs N decode threads off the GIL and double-buffers
+float32 batches; PJRT async H2D replaces the engine copy workers.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["NativeBatchLoader", "NativeRecordWriter", "lib_available"]
+
+_LIB = None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "libmxtpu.so")
+    if not os.path.exists(path):
+        return None
+    lib = ctypes.CDLL(path)
+    lib.mxtpu_loader_create.restype = ctypes.c_void_p
+    lib.mxtpu_loader_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_float,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.mxtpu_loader_num_records.restype = ctypes.c_long
+    lib.mxtpu_loader_num_records.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_loader_next.restype = ctypes.c_int
+    lib.mxtpu_loader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int)]
+    lib.mxtpu_loader_reset.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_loader_free.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_writer_create.restype = ctypes.c_void_p
+    lib.mxtpu_writer_create.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_writer_write_image.argtypes = [
+        ctypes.c_void_p, ctypes.c_float, ctypes.c_ulong,
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long]
+    lib.mxtpu_writer_free.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+def lib_available() -> bool:
+    return _load() is not None
+
+
+class NativeBatchLoader:
+    """Threaded native batch loader over a raw-packed .rec file."""
+
+    def __init__(self, path: str, batch_size: int, data_shape: Tuple[int, ...],
+                 label_width: int = 1, threads: int = 4, shuffle: bool = False,
+                 rand_crop: bool = False, rand_mirror: bool = False,
+                 mean_rgb=None, scale: float = 1.0, part_index: int = 0,
+                 num_parts: int = 1, seed: int = 0, queue_depth: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libmxtpu.so not built; run make")
+        c, h, w = data_shape
+        mean_ptr = None
+        if mean_rgb is not None:
+            self._mean = (ctypes.c_float * 3)(*[float(x) for x in mean_rgb])
+            mean_ptr = ctypes.cast(self._mean, ctypes.POINTER(ctypes.c_float))
+        self._lib = lib
+        self._h = lib.mxtpu_loader_create(
+            path.encode(), batch_size, c, h, w, label_width, threads,
+            int(shuffle), int(rand_crop), int(rand_mirror), mean_ptr,
+            float(scale), part_index, num_parts, seed, queue_depth)
+        if not self._h:
+            raise RuntimeError("failed to open %s" % path)
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._data_buf = np.empty((batch_size,) + self.data_shape, np.float32)
+        self._label_buf = np.empty((batch_size, label_width), np.float32)
+
+    @property
+    def num_records(self) -> int:
+        return int(self._lib.mxtpu_loader_num_records(self._h))
+
+    def next(self):
+        """Return (data, label, pad) numpy copies or None at epoch end."""
+        pad = ctypes.c_int(0)
+        rc = self._lib.mxtpu_loader_next(
+            self._h,
+            self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.byref(pad))
+        if rc != 0:
+            return None
+        return (self._data_buf.copy(), self._label_buf.copy(), pad.value)
+
+    def reset(self):
+        self._lib.mxtpu_loader_reset(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.mxtpu_loader_free(self._h)
+            self._h = None
+
+
+class NativeRecordWriter:
+    """Native RecordIO image writer (im2rec core)."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libmxtpu.so not built; run make")
+        self._lib = lib
+        self._h = lib.mxtpu_writer_create(path.encode())
+        if not self._h:
+            raise RuntimeError("cannot open %s" % path)
+
+    def write_image(self, label: float, idx: int, payload: bytes):
+        buf = (ctypes.c_ubyte * len(payload)).from_buffer_copy(payload)
+        self._lib.mxtpu_writer_write_image(self._h, float(label), idx,
+                                           buf, len(payload))
+
+    def close(self):
+        if self._h:
+            self._lib.mxtpu_writer_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()

@@ -1,0 +1,61 @@
+// im2rec: pack an image list into a RecordIO file
+// (reference tools/im2rec.cc capability).
+//
+// Input list format (same as reference): image_index \t label \t path
+// Without an image-decode library in this build, image files are packed
+// pass-through (JPEG/PNG bytes verbatim — what the reference does without
+// --resize); python-side decoding (PIL) or the raw-CHW path handles them.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "recordio.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr,
+            "Usage: im2rec image.lst image_root output.rec\n"
+            "  image.lst lines: index\\tlabel\\trelative_path\n");
+    return 1;
+  }
+  std::string lst_path = argv[1];
+  std::string root = argc >= 4 ? argv[2] : "";
+  std::string out_path = argc >= 4 ? argv[3] : argv[2];
+
+  std::ifstream lst(lst_path);
+  if (!lst) {
+    fprintf(stderr, "cannot open %s\n", lst_path.c_str());
+    return 1;
+  }
+  mxtpu::RecordWriter writer(out_path);
+  if (!writer.ok()) {
+    fprintf(stderr, "cannot open %s for write\n", out_path.c_str());
+    return 1;
+  }
+  std::string line;
+  size_t count = 0;
+  while (std::getline(lst, line)) {
+    if (line.empty()) continue;
+    std::istringstream ss(line);
+    uint64_t idx;
+    float label;
+    std::string rel;
+    ss >> idx >> label >> rel;
+    std::string path = root.empty() ? rel : root + "/" + rel;
+    std::ifstream img(path, std::ios::binary);
+    if (!img) {
+      fprintf(stderr, "skip missing %s\n", path.c_str());
+      continue;
+    }
+    std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(img)),
+                               std::istreambuf_iterator<char>());
+    writer.WriteImageRecord(label, idx, bytes.data(), bytes.size());
+    if (++count % 1000 == 0) fprintf(stderr, "packed %zu images\n", count);
+  }
+  fprintf(stderr, "done: %zu records -> %s\n", count, out_path.c_str());
+  return 0;
+}

@@ -1,0 +1,60 @@
+// RecordIO framing + packed image records — native core of the data pipeline.
+// Byte-compatible with the python mxnet_tpu.recordio module (and the
+// reference dmlc-core recordio format): magic 0xced7230a, little-endian
+// length word (low 29 bits), payload padded to 4 bytes.
+// Reference analogue: dmlc-core recordio + src/io/iter_image_recordio.cc.
+#ifndef MXTPU_RECORDIO_H_
+#define MXTPU_RECORDIO_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+constexpr uint32_t kRecordMagic = 0xced7230a;
+
+// One parsed record: header (flag/label/id) + payload bytes.
+struct ImageRecord {
+  uint32_t flag = 0;
+  std::vector<float> labels;  // single or multi-label
+  uint64_t id = 0;
+  uint64_t id2 = 0;
+  const uint8_t* payload = nullptr;  // points into the mapped file
+  size_t payload_size = 0;
+};
+
+// Memory-loaded sequential reader. Splits the file into records once at
+// open (the reference's chunked OMP parse, iter_image_recordio.cc:139-291,
+// becomes an upfront index + thread-pooled decode).
+class RecordFile {
+ public:
+  bool Open(const std::string& path);
+  size_t size() const { return offsets_.size(); }
+  // Parse record i (IRHeader + payload view into the file buffer).
+  bool Get(size_t i, ImageRecord* out) const;
+
+ private:
+  std::vector<uint8_t> data_;
+  std::vector<std::pair<size_t, size_t>> offsets_;  // (begin, length)
+};
+
+// Writer used by im2rec.
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string& path);
+  ~RecordWriter();
+  bool ok() const { return f_ != nullptr; }
+  void Write(const uint8_t* buf, size_t len);
+  // Pack IRHeader(flag=0, label, id) + payload.
+  void WriteImageRecord(float label, uint64_t id, const uint8_t* payload,
+                        size_t len);
+
+ private:
+  FILE* f_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_RECORDIO_H_

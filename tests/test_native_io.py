@@ -1,0 +1,104 @@
+"""Native IO core tests (libmxtpu.so): recordio compat + threaded loader."""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu import native_io
+
+pytestmark = pytest.mark.skipif(not native_io.lib_available(),
+                                reason="libmxtpu.so not built (run make)")
+
+
+def _write_raw_rec(path, n=20, c=3, h=8, w=8, writer="py"):
+    rng = np.random.RandomState(0)
+    imgs = (rng.rand(n, c, h, w) * 255).astype(np.uint8)
+    if writer == "py":
+        rec = recordio.MXRecordIO(path, "w")
+        for i in range(n):
+            rec.write(recordio.pack(recordio.IRHeader(0, float(i % 5), i, 0),
+                                    imgs[i].tobytes()))
+        rec.close()
+    else:
+        w_ = native_io.NativeRecordWriter(path)
+        for i in range(n):
+            w_.write_image(float(i % 5), i, imgs[i].tobytes())
+        w_.close()
+    return imgs
+
+
+def test_native_writer_python_reader(tmp_path):
+    """Records written natively parse with the python recordio module
+    (byte-format compatibility)."""
+    path = str(tmp_path / "n.rec")
+    imgs = _write_raw_rec(path, writer="native")
+    rec = recordio.MXRecordIO(path, "r")
+    for i in range(20):
+        header, payload = recordio.unpack(rec.read())
+        assert header.label == float(i % 5)
+        assert payload == imgs[i].tobytes()
+    assert rec.read() is None
+
+
+def test_native_loader_batches(tmp_path):
+    path = str(tmp_path / "p.rec")
+    imgs = _write_raw_rec(path, n=20)
+    loader = native_io.NativeBatchLoader(path, batch_size=5,
+                                         data_shape=(3, 8, 8), threads=2)
+    assert loader.num_records == 20
+    seen_labels = []
+    batches = 0
+    while True:
+        out = loader.next()
+        if out is None:
+            break
+        data, label, pad = out
+        assert data.shape == (5, 3, 8, 8)
+        assert pad == 0
+        seen_labels.extend(label[:, 0].tolist())
+        batches += 1
+    assert batches == 4
+    assert sorted(seen_labels) == sorted([float(i % 5) for i in range(20)])
+    # epoch 2 after reset
+    loader.reset()
+    out = loader.next()
+    assert out is not None
+
+
+def test_native_loader_values_match(tmp_path):
+    path = str(tmp_path / "v.rec")
+    imgs = _write_raw_rec(path, n=4)
+    loader = native_io.NativeBatchLoader(path, batch_size=4,
+                                         data_shape=(3, 8, 8), threads=1,
+                                         mean_rgb=(10.0, 20.0, 30.0),
+                                         scale=0.5)
+    data, label, pad = loader.next()
+    expected = (imgs.astype(np.float32)
+                - np.array([10, 20, 30], np.float32).reshape(1, 3, 1, 1)) * 0.5
+    assert np.allclose(data, expected)
+
+
+def test_im2rec_binary(tmp_path):
+    """bin/im2rec packs an image list pass-through."""
+    binary = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bin", "im2rec")
+    if not os.path.exists(binary):
+        pytest.skip("bin/im2rec not built")
+    files = []
+    for i in range(3):
+        p = tmp_path / ("f%d.bin" % i)
+        p.write_bytes(bytes([i]) * (10 + i))
+        files.append(p.name)
+    lst = tmp_path / "img.lst"
+    lst.write_text("".join("%d\t%d\t%s\n" % (i, i * 2, f)
+                           for i, f in enumerate(files)))
+    out = tmp_path / "out.rec"
+    subprocess.check_call([binary, str(lst), str(tmp_path), str(out)])
+    rec = recordio.MXRecordIO(str(out), "r")
+    for i in range(3):
+        header, payload = recordio.unpack(rec.read())
+        assert header.label == i * 2
+        assert payload == bytes([i]) * (10 + i)

@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Kill stray training processes on a host list
+(reference tools/kill-mxnet.py capability)."""
+import argparse
+import subprocess
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("hostfile", help="one host per line; '-' = local only")
+    parser.add_argument("--pattern", default="train_", help="pkill -f pattern")
+    args = parser.parse_args()
+    if args.hostfile == "-":
+        subprocess.call(["pkill", "-f", args.pattern])
+        return
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    for host in hosts:
+        print("killing %s on %s" % (args.pattern, host))
+        subprocess.call(["ssh", "-o", "StrictHostKeyChecking=no", host,
+                         "pkill -f %s || true" % args.pattern])
+
+
+if __name__ == "__main__":
+    main()

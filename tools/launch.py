@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Launch distributed jobs (reference tools/launch.py:27-70 capability,
+re-designed for TPU).
+
+The reference launched scheduler + server + worker processes over
+ssh/mpi/sge/yarn via dmlc-tracker.  The TPU-native stack has NO server or
+scheduler roles — every process is a worker participating in XLA collectives
+(SURVEY §5.8).  This launcher covers:
+
+* local  : fork N worker processes on this host (jax.distributed rendezvous
+           via a local coordinator) — the analogue of the reference's local
+           launcher used by tests/nightly/test_all.sh.
+* ssh    : start one worker per host in a hostfile, pointing all of them at
+           the rank-0 coordinator address.
+* tpu-pod: on Cloud-TPU-style pods the runtime injects topology env vars and
+           every host just runs the same command (documented passthrough).
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def local_launch(args, cmd):
+    procs = []
+    env = dict(os.environ)
+    env["MXNET_TPU_COORDINATOR"] = "127.0.0.1:%d" % args.port
+    env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
+    for rank in range(args.num_workers):
+        worker_env = dict(env)
+        worker_env["MXNET_TPU_WORKER_ID"] = str(rank)
+        # reference-compat aliases so ports of reference scripts work
+        worker_env["DMLC_ROLE"] = "worker"
+        worker_env["DMLC_NUM_WORKER"] = str(args.num_workers)
+        procs.append(subprocess.Popen(cmd, shell=True, env=worker_env))
+    code = 0
+    try:
+        for p in procs:
+            code = p.wait() or code
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        code = 1
+    return code
+
+
+def ssh_launch(args, cmd):
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    hosts = hosts[:args.num_workers]
+    coordinator = "%s:%d" % (hosts[0], args.port)
+    procs = []
+    for rank, host in enumerate(hosts):
+        env = ("MXNET_TPU_COORDINATOR=%s MXNET_TPU_NUM_WORKERS=%d "
+               "MXNET_TPU_WORKER_ID=%d" % (coordinator, len(hosts), rank))
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host,
+             "cd %s && %s %s" % (os.getcwd(), env, cmd)]))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (TPU-native: workers only)")
+    parser.add_argument("-n", "--num-workers", required=True, type=int,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="accepted for reference compatibility; must be 0 "
+                             "(no server role on TPU)")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh", "tpu-pod"])
+    parser.add_argument("-H", "--hostfile", type=str,
+                        help="hostfile for ssh launcher")
+    parser.add_argument("--port", type=int, default=9091)
+    parser.add_argument("command", nargs="+", help="command to launch")
+    args = parser.parse_args()
+
+    if args.num_servers:
+        sys.stderr.write("warning: -s %d ignored — TPU kvstore has no server "
+                         "processes (aggregation is an XLA collective)\n"
+                         % args.num_servers)
+    cmd = " ".join(args.command)
+    if args.launcher == "local":
+        sys.exit(local_launch(args, cmd))
+    elif args.launcher == "ssh":
+        sys.exit(ssh_launch(args, cmd))
+    else:
+        sys.stderr.write("tpu-pod: run the same command on every pod host; "
+                         "the TPU runtime provides rendezvous.\n")
+        sys.exit(subprocess.call(cmd, shell=True))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Parse training logs into tables (reference tools/parse_log.py capability)."""
+import argparse
+import re
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Parse mxnet_tpu training logs")
+    parser.add_argument("logfile", help="the log file for parsing")
+    parser.add_argument("--format", type=str, default="markdown",
+                        choices=["markdown", "none", "csv"])
+    args = parser.parse_args()
+
+    with open(args.logfile) as f:
+        lines = f.readlines()
+
+    res = [re.compile(r".*Epoch\[(\d+)\] Train-([a-z0-9-]+)=([-\d\.]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Validation-([a-z0-9-]+)=([-\d\.]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Time cost=([-\d\.]+)")]
+
+    data = {}
+    for l in lines:
+        i = 0
+        for r in res:
+            m = r.match(l)
+            if m:
+                break
+            i += 1
+        if not m:
+            continue
+        assert len(m.groups()) <= 3
+        epoch = int(m.groups()[0])
+        if epoch not in data:
+            data[epoch] = [0] * len(res) * 2
+        if i == 2:
+            data[epoch][i * 2] += float(m.groups()[1])
+        else:
+            data[epoch][i * 2] += float(m.groups()[2])
+        data[epoch][i * 2 + 1] += 1
+
+    if args.format == "markdown":
+        print("| epoch | train-accuracy | valid-accuracy | time |")
+        print("| --- | --- | --- | --- |")
+        for k, v in data.items():
+            print("| %2d | %f | %f | %.1f |" % (
+                k + 1, v[0] / max(v[1], 1), v[2] / max(v[3], 1),
+                v[4] / max(v[5], 1)))
+    elif args.format == "csv":
+        print("epoch,train accuracy,valid accuracy,time")
+        for k, v in data.items():
+            print("%2d,%f,%f,%.1f" % (
+                k + 1, v[0] / max(v[1], 1), v[2] / max(v[3], 1),
+                v[4] / max(v[5], 1)))
+
+
+if __name__ == "__main__":
+    main()
