@@ -147,6 +147,8 @@ class Executor:
             if c != ctx:
                 multi_ctx = True
         do_mirror = bool(get_env("MXNET_BACKWARD_DO_MIRROR", 0, int))
+        # MXNET_EXEC_PREFER_BULK_EXEC analogue: fuse train fwd+bwd in one jit
+        self._fused_train = bool(get_env("MXNET_EXEC_PREFER_BULK_EXEC", 1, int))
         self._prog = _GraphProgram(symbol, node_ctx,
                                    None if multi_ctx else ctx, do_mirror)
         self._eager = multi_ctx
@@ -183,19 +185,25 @@ class Executor:
         if kind in self._jit_cache:
             return self._jit_cache[kind]
         prog = self._prog
-        if kind == "fwdbwd":
-            grad_names = tuple(self._grad_names)
+        if kind in ("fwdbwd", "fwdbwd_ones"):
+            with_head = (kind == "fwdbwd")
 
-            def fn(gargs, sargs, aux, rng, head_grads):
+            def fn(gargs, sargs, aux, rng, head_grads=None):
                 def inner(gargs):
                     allargs = dict(sargs)
                     allargs.update(gargs)
                     outs, new_aux = prog.eval(allargs, aux, rng, True)
                     return outs, new_aux
                 outs, vjp_fn, new_aux = jax.vjp(inner, gargs, has_aux=True)
+                if head_grads is None:
+                    head_grads = [jnp.ones_like(o) for o in outs]
                 grads = vjp_fn(list(head_grads))[0]
                 return outs, grads, new_aux
-            jfn = jax.jit(fn)
+            if with_head:
+                jfn = jax.jit(fn)
+            else:
+                jfn = jax.jit(lambda gargs, sargs, aux, rng:
+                              fn(gargs, sargs, aux, rng, None))
         else:
             is_train = (kind == "fwd_train")
 
@@ -217,9 +225,20 @@ class Executor:
                 self.arg_dict[k][:] = nd_array(v, dtype=self.arg_dict[k].dtype)
         args, aux = self._args_jax(), self._aux_jax()
         rng = self._next_rng()
+        self._pending_grads = None
         if self._eager or self._monitor_callback is not None:
             self._prog.set_monitor(self._monitor_callback)
             outs, new_aux = self._prog.eval(args, aux, rng, is_train, eager=True)
+        elif is_train and self._grad_names and self._fused_train:
+            # fused train step: forward + backward in ONE XLA program (the
+            # reference's bulk-exec idea taken to its limit) with unit head
+            # gradients; backward() then just commits the grads.  A later
+            # backward(out_grads=...) falls back to the explicit-head jit.
+            gargs = {k: args[k] for k in self._grad_names}
+            sargs = {k: v for k, v in args.items() if k not in gargs}
+            outs, grads, new_aux = self._get_jit("fwdbwd_ones")(
+                gargs, sargs, aux, rng)
+            self._pending_grads = grads
         else:
             outs, new_aux = self._get_jit(
                 "fwd_train" if is_train else "fwd_eval")(args, aux, rng)
@@ -227,7 +246,6 @@ class Executor:
             for k, v in new_aux.items():
                 self.aux_dict[k]._set(v)
         self._outputs_nd = [NDArray(o) for o in outs]
-        self._pending_grads = None
         self._last_rng = rng
         return self._outputs_nd
 
@@ -236,6 +254,9 @@ class Executor:
         honoring grad_req write/add/null."""
         if self._outputs_nd is None:
             raise MXNetError("backward() requires a prior forward(is_train=True)")
+        if out_grads is None and self._pending_grads is not None:
+            self._commit_grads(self._pending_grads)
+            return
         if out_grads is None:
             head_grads = [jnp.ones_like(o._get()) for o in self._outputs_nd]
         else:
@@ -258,6 +279,9 @@ class Executor:
         else:
             _, grads, _ = self._get_jit("fwdbwd")(
                 gargs, sargs, aux, self._last_rng, tuple(head_grads))
+        self._commit_grads(grads)
+
+    def _commit_grads(self, grads):
         for name in self._grad_names:
             g = grads[name]
             tgt = self.grad_dict[name]

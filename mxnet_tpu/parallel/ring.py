@@ -1,0 +1,138 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+First-class long-context support (task requirement; SURVEY §5.7 notes the
+reference era handled long sequences only by bucketing — this module is the
+TPU-native extension that makes sequence lengths scale past one chip's HBM).
+
+* ring_attention: each device holds a sequence shard of Q/K/V; K/V blocks
+  rotate around the ring via lax.ppermute while a numerically-stable online
+  softmax accumulates — compute overlaps with the ICI transfer of the next
+  block (Liu et al., Ring Attention with Blockwise Transformers, 2023).
+* ulysses_attention: all-to-all re-shard (sequence <-> heads) so each device
+  computes full-sequence attention for a head subset (Jacobs et al.,
+  DeepSpeed-Ulysses, 2023).
+
+Both are pure functions designed for use inside shard_map over a mesh axis
+(default "sp"); `make_ring_attention` wraps one in shard_map for direct use.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention", "make_ring_attention",
+           "attention_reference"]
+
+
+def attention_reference(q, k, v, causal: bool = False):
+    """Plain single-device attention (B, T, H, D) for parity checks."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+    """Blockwise ring attention over a sequence-sharded axis.
+
+    Args (per-device shards): q, k, v of shape (B, T_local, H, D).
+    Must be called inside shard_map/pmap with `axis_name` bound.
+    Returns the attention output shard (B, T_local, H, D).
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    # online softmax state
+    m = jnp.full((b, h, t_local), -jnp.inf, dtype=jnp.float32)
+    l = jnp.zeros((b, h, t_local), dtype=jnp.float32)
+    acc = jnp.zeros((b, t_local, h, d), dtype=jnp.float32)
+
+    def block(carry, step):
+        m, l, acc, kc, vc = carry
+        src = (my_idx + step) % axis_size        # whose K/V block we hold now
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kc) * scale
+        s = s.astype(jnp.float32)
+        if causal:
+            q_pos = my_idx * t_local + jnp.arange(t_local)
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        blk_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # guard fully-masked rows (exp(-inf - -inf))
+        safe_m = jnp.where(jnp.isinf(new_m), 0.0, new_m)
+        p = jnp.exp(jnp.where(jnp.isinf(s), -jnp.inf, s) - safe_m[..., None])
+        p = jnp.where(jnp.isinf(s), 0.0, p)
+        corr = jnp.where(jnp.isinf(m), 0.0, jnp.exp(m - safe_m))
+        l2 = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32))
+        acc2 = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        # rotate K/V to the next ring position (rides ICI)
+        perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+        kn = lax.ppermute(kc, axis_name, perm)
+        vn = lax.ppermute(vc, axis_name, perm)
+        return (new_m, l2, acc2, kn, vn), None
+
+    carry = (m, l, acc, k, v)
+    (m, l, acc, _, _), _ = lax.scan(block, carry,
+                                    jnp.arange(axis_size))
+    l = jnp.maximum(l, 1e-20)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    Re-shards (seq-sharded, all heads) -> (full seq, head-sharded) with one
+    all_to_all, runs full attention on the local head subset, then re-shards
+    back.  Requires num_heads divisible by the axis size.
+    """
+    axis_size = lax.psum(1, axis_name)
+    b, t_local, h, d = q.shape
+    hl = h // axis_size
+
+    def to_heads(x):
+        # (B, T_local, H, D) -> full sequence, local head subset
+        x = x.reshape(b, t_local, axis_size, hl, d)
+        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1)
+        # (B, size*T_local, 1, hl, D) -> (B, T_full, hl, D)
+        return x.reshape(b, t_local * axis_size, hl, d)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    oh = attention_reference(qh, kh, vh, causal=causal)
+    # back: (B, T_full, hl, D) -> local sequence shard, all heads
+    oh = oh.reshape(b, axis_size, t_local, 1, hl, d)
+    out = lax.all_to_all(oh, axis_name, split_axis=1, concat_axis=3)
+    return out.reshape(b, t_local, h, d)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "sp", causal: bool = False,
+                        impl: str = "ring"):
+    """Wrap ring/ulysses attention in shard_map over `axis` of `mesh`.
+
+    Returns fn(q, k, v) taking GLOBAL (B, T, H, D) arrays sharded on T.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    inner = ring_attention if impl == "ring" else ulysses_attention
+    fn = functools.partial(inner, axis_name=axis, causal=causal)
+    spec = P(None, axis, None, None)
+    sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec, check_rep=False)
+    return jax.jit(sharded)
