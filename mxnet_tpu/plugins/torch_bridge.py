@@ -1,0 +1,82 @@
+"""Torch plugin parity: call torch functions/modules on NDArrays.
+
+Reference: plugin/torch (TorchModule/TorchCriterion wrap Lua Torch) +
+python/mxnet/torch.py sugar.  Here the bridge targets PyTorch (CPU build
+baked into the image): tensors round-trip host-side; inside compiled graphs
+use mxnet_tpu.operator custom ops instead.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["to_torch", "from_torch", "torch_function", "TorchModule",
+           "TorchCriterion"]
+
+
+def _torch():
+    try:
+        import torch
+        return torch
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("pytorch is not available") from e
+
+
+def to_torch(arr: NDArray):
+    """NDArray -> torch.Tensor (host copy)."""
+    return _torch().from_numpy(arr.asnumpy())
+
+
+def from_torch(tensor, ctx=None) -> NDArray:
+    """torch.Tensor -> NDArray."""
+    return nd_array(tensor.detach().cpu().numpy(), ctx=ctx)
+
+
+def torch_function(fn: Callable):
+    """Wrap a torch function so it maps NDArray -> NDArray
+    (reference python/mxnet/torch.py generated wrappers)."""
+    def wrapped(*args, **kwargs):
+        conv = [to_torch(a) if isinstance(a, NDArray) else a for a in args]
+        out = fn(*conv, **kwargs)
+        if isinstance(out, (list, tuple)):
+            return [from_torch(o) for o in out]
+        return from_torch(out)
+    wrapped.__name__ = getattr(fn, "__name__", "torch_fn")
+    return wrapped
+
+
+class TorchModule:
+    """Run a torch.nn.Module as a forward/backward block on NDArrays
+    (reference plugin/torch torch_module-inl.h capability)."""
+
+    def __init__(self, module):
+        self.module = module
+
+    def forward(self, *inputs: NDArray):
+        torch = _torch()
+        tins = [to_torch(x).requires_grad_(True) for x in inputs]
+        self._tins = tins
+        self._tout = self.module(*tins)
+        return from_torch(self._tout)
+
+    def backward(self, out_grad: NDArray):
+        self._tout.backward(to_torch(out_grad))
+        return [from_torch(t.grad) for t in self._tins]
+
+    def parameters(self):
+        return [from_torch(p) for p in self.module.parameters()]
+
+
+class TorchCriterion(TorchModule):
+    """Torch loss wrapper (reference TorchCriterion)."""
+
+    def forward(self, data: NDArray, label: NDArray):
+        torch = _torch()
+        tin = to_torch(data).requires_grad_(True)
+        self._tins = [tin]
+        self._tout = self.module(tin, to_torch(label))
+        return from_torch(self._tout.reshape(1))

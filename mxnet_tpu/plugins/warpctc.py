@@ -1,0 +1,69 @@
+"""WarpCTC plugin parity: CTC loss layer.
+
+Reference: plugin/warpctc/warpctc-inl.h — inputs [data, label], params
+label_length (padded label width, blank=0-padded) and input_length (T);
+data is the (T*batch, alphabet) concat of per-step activations; forward
+outputs softmax, backward injects the CTC gradient (head grad ignored).
+
+TPU-native: the CTC alpha-beta recursion comes from optax.ctc_loss (pure
+lax.scan — compiles to one fused XLA loop); the layer gradient is
+jax.grad of that loss wrt the activations, wrapped in custom_vjp to
+reproduce the reference loss-layer semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import OpDef, Param, register_op
+
+
+@register_op("WarpCTC", hint="warpctc")
+class WarpCTCOp(OpDef):
+    params = [Param("label_length", int, required=True),
+              Param("input_length", int, required=True)]
+
+    def list_arguments(self, p):
+        return ["data", "label"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        batch = d[0] // p.input_length
+        return [d, (batch, p.label_length)], [d], []
+
+    def forward(self, p, inputs, aux, ctx):
+        import optax
+        data, label = inputs
+        T = p.input_length
+        A = data.shape[1]
+        B = data.shape[0] // T
+
+        def ctc_grad(data, label):
+            logits = data.reshape(T, B, A).transpose(1, 0, 2)  # (B, T, A)
+            logprobs = jax.nn.log_softmax(logits, axis=-1)
+            labels = label.astype(jnp.int32)
+            # blank=0; zero-padding marks unused label slots (reference
+            # labelLengths counts to the first blank)
+            label_pad = (labels == 0).astype(jnp.float32)
+            logit_pad = jnp.zeros((B, T), jnp.float32)
+            loss = optax.ctc_loss(logprobs, logit_pad, labels, label_pad,
+                                  blank_id=0)
+            return jnp.sum(loss)
+
+        @jax.custom_vjp
+        def f(data, label):
+            return jax.nn.softmax(data, axis=-1)
+
+        def f_fwd(data, label):
+            return jax.nn.softmax(data, axis=-1), (data, label)
+
+        def f_bwd(res, g):
+            data, label = res
+            del g  # loss layer: head gradient ignored (reference behavior)
+            grad = jax.grad(ctc_grad)(data, label)
+            return grad, jnp.zeros_like(label)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(data, label)]

@@ -1,0 +1,102 @@
+"""Predictor: the deployment mini-API.
+
+Reference: include/mxnet/c_predict_api.h (8 MXPred* functions: create a
+predictor from symbol JSON + param blob only, set input, forward, get
+output) + amalgamation/ (single-file predict build for mobile).
+
+TPU-native: a Predictor loads the two checkpoint artifacts, jit-compiles
+one inference XLA program per input shape, and exposes the same minimal
+surface (set_input/forward/get_output + reshape).  The "amalgamation"
+capability — deploy with minimal deps — holds because this module only
+needs jax + numpy + the symbol/executor layers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu
+from .ndarray import NDArray, load as nd_load, array as nd_array
+from .symbol import Symbol, load_json as sym_load_json
+
+__all__ = ["Predictor", "load_ndarray_file", "create_predictor"]
+
+
+def load_ndarray_file(path: str) -> Dict[str, NDArray]:
+    """MXNDListCreate analogue: read a saved param blob."""
+    params = nd_load(path)
+    out = {}
+    for k, v in params.items():
+        if k.startswith("arg:") or k.startswith("aux:"):
+            out[k[4:]] = v
+        else:
+            out[k] = v
+    return out
+
+
+class Predictor:
+    """MXPredCreate analogue (c_predict_api.h:1-207)."""
+
+    def __init__(self, symbol_json: str, param_bytes_or_path,
+                 input_shapes: Dict[str, Tuple[int, ...]],
+                 dev_type: str = "cpu", dev_id: int = 0):
+        self.symbol = sym_load_json(symbol_json) \
+            if isinstance(symbol_json, str) and symbol_json.lstrip().startswith("{") \
+            else sym_load_json(open(symbol_json).read())
+        self.ctx = Context(dev_type, dev_id)
+        if isinstance(param_bytes_or_path, (dict,)):
+            params = param_bytes_or_path
+        else:
+            params = load_ndarray_file(param_bytes_or_path)
+        self._arg_params = {k: v for k, v in params.items()
+                            if k in self.symbol.list_arguments()}
+        self._aux_params = {k: v for k, v in params.items()
+                            if k in self.symbol.list_auxiliary_states()}
+        self._bind(dict(input_shapes))
+
+    def _bind(self, input_shapes: Dict[str, Tuple[int, ...]]):
+        self._input_shapes = input_shapes
+        self._exec = self.symbol.simple_bind(self.ctx, grad_req="null",
+                                             **input_shapes)
+        self._exec.copy_params_from(self._arg_params, self._aux_params,
+                                    allow_extra_params=True)
+
+    def set_input(self, name: str, data) -> None:
+        """MXPredSetInput."""
+        self._exec.arg_dict[name][:] = np.asarray(data, dtype=np.float32)
+
+    def forward(self) -> None:
+        """MXPredForward."""
+        self._exec.forward(is_train=False)
+
+    def get_output(self, index: int) -> np.ndarray:
+        """MXPredGetOutput."""
+        return self._exec.outputs[index].asnumpy()
+
+    def get_output_shape(self, index: int) -> Tuple[int, ...]:
+        """MXPredGetOutputShape."""
+        return tuple(self._exec.outputs[index].shape) if self._exec._outputs_nd \
+            else tuple(self.symbol.infer_shape(**self._input_shapes)[1][index])
+
+    def reshape(self, input_shapes: Dict[str, Tuple[int, ...]]) -> "Predictor":
+        """MXPredReshape: new input shapes, shared weights."""
+        self._bind(dict(input_shapes))
+        return self
+
+    def predict(self, data) -> np.ndarray:
+        """Convenience one-shot: set first input, forward, output 0."""
+        first = next(iter(self._input_shapes))
+        self.set_input(first, data)
+        self.forward()
+        return self.get_output(0)
+
+
+def create_predictor(prefix: str, epoch: int, input_shapes,
+                     dev_type="cpu", dev_id=0) -> Predictor:
+    """Build a Predictor from a save_checkpoint pair."""
+    with open("%s-symbol.json" % prefix) as f:
+        sym_json = f.read()
+    return Predictor(sym_json, "%s-%04d.params" % (prefix, epoch),
+                     input_shapes, dev_type, dev_id)

@@ -521,5 +521,12 @@ def _init_symbol_module():
 
 _init_symbol_module()
 
-# convenience aliases matching reference python API
-sum_axis = getattr(sys.modules[__name__], "sum_axis", None)
+
+def __getattr__(name):
+    """Late-registered ops (plugins, custom ops) resolve lazily."""
+    from .ops.registry import _OP_REGISTRY
+    if name in _OP_REGISTRY:
+        fn = _make_atomic_symbol_function(name)
+        setattr(sys.modules[__name__], name, fn)
+        return fn
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,72 @@
+"""Plugin parity tests: WarpCTC, torch bridge, opencv image ops."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_warpctc_forward_backward():
+    T, B, A, L = 6, 2, 5, 3
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    net = mx.sym.WarpCTC(data=data, label=label, label_length=L,
+                         input_length=T)
+    x = np.random.randn(T * B, A).astype(np.float32)
+    # labels: nonzero classes, 0-padded
+    y = np.array([[1, 2, 0], [3, 0, 0]], dtype=np.float32)
+    ex = net.simple_bind(mx.cpu(), data=(T * B, A), label=(B, L))
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["label"][:] = y
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    # forward = softmax of activations
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    assert np.allclose(out, e / e.sum(axis=1, keepdims=True), atol=1e-5)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # CTC gradient rows sum to ~0 (softmax minus expected path counts)
+    assert np.allclose(g.sum(axis=1), 0, atol=1e-4)
+
+
+def test_torch_bridge():
+    torch = pytest.importorskip("torch")
+    a = mx.nd.array(np.random.rand(3, 4))
+    t = mx.th.to_torch(a)
+    assert tuple(t.shape) == (3, 4)
+    b = mx.th.from_torch(t * 2)
+    assert np.allclose(b.asnumpy(), a.asnumpy() * 2)
+
+    f = mx.th.torch_function(torch.sigmoid)
+    out = f(a)
+    assert np.allclose(out.asnumpy(), 1 / (1 + np.exp(-a.asnumpy())), atol=1e-6)
+
+    lin = torch.nn.Linear(4, 2)
+    tm = mx.th.TorchModule(lin)
+    y = tm.forward(a)
+    assert y.shape == (3, 2)
+    grads = tm.backward(mx.nd.ones((3, 2)))
+    assert grads[0].shape == (3, 4)
+
+
+def test_opencv_plugin_resize_border():
+    from mxnet_tpu.plugins import opencv as cv
+    img = mx.nd.array((np.random.rand(8, 6, 3) * 255).astype(np.uint8),
+                      dtype=np.uint8)
+    out = cv.imresize(img, 12, 16)
+    assert out.shape == (16, 12, 3)
+    out = cv.copyMakeBorder(img, 1, 2, 3, 4, fill_value=7)
+    assert out.shape == (11, 13, 3)
+    assert (out.asnumpy()[0] == 7).all()
+
+
+def test_opencv_imdecode_roundtrip():
+    pytest.importorskip("PIL")
+    from mxnet_tpu.plugins import opencv as cv
+    from PIL import Image
+    import io as _io
+    arr = (np.random.rand(5, 7, 3) * 255).astype(np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    out = cv.imdecode(buf.getvalue())
+    assert np.array_equal(out.asnumpy(), arr)

@@ -1,0 +1,68 @@
+"""Predictor (c_predict_api parity) + engine semantics tests."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.predictor import Predictor, create_predictor
+
+
+def _train_tiny(tmp_path):
+    np.random.seed(0)
+    mx.random.seed(0)
+    X = np.random.randn(64, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=3, optimizer_params={"learning_rate": 0.5})
+    arg, aux = mod.get_params()
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 3, net, arg, aux)
+    return prefix, X, mod, it
+
+
+def test_predictor_matches_module(tmp_path):
+    prefix, X, mod, it = _train_tiny(tmp_path)
+    pred = create_predictor(prefix, 3, {"data": (16, 6),
+                                        "softmax_label": (16,)})
+    out = pred.predict(X[:16])
+    module_out = mod.predict(it, num_batch=1).asnumpy()
+    assert np.allclose(out, module_out, atol=1e-5)
+
+
+def test_predictor_reshape(tmp_path):
+    prefix, X, _, _ = _train_tiny(tmp_path)
+    pred = create_predictor(prefix, 3, {"data": (16, 6),
+                                        "softmax_label": (16,)})
+    out16 = pred.predict(X[:16])
+    pred.reshape({"data": (4, 6), "softmax_label": (4,)})
+    out4 = pred.predict(X[:4])
+    assert np.allclose(out16[:4], out4, atol=1e-5)
+
+
+def test_engine_naive_mode():
+    """NaiveEngine-equivalent sync mode (reference MXNET_ENGINE_TYPE)."""
+    from mxnet_tpu import engine
+    with engine.naive_mode():
+        assert engine.engine().is_naive
+        a = mx.nd.ones((4, 4)) * 3
+        assert (a.asnumpy() == 3).all()
+    assert not engine.engine().is_naive
+
+
+def test_engine_waitall_and_ordering():
+    """Writes to a chunk serialize; wait_for_all drains pending work
+    (reference threaded_engine_test.cc semantics)."""
+    a = mx.nd.zeros((100, 100))
+    for i in range(10):
+        a += 1  # each write depends on the previous buffer
+    mx.nd.waitall()
+    assert (a.asnumpy() == 10).all()
+    # read-after-write through a view
+    v = a[5:10]
+    a *= 2
+    assert (v.asnumpy() == 20).all()
