@@ -8,6 +8,7 @@ Usage mirrors the reference python package:
     fc = mx.sym.FullyConnected(data, num_hidden=10)
     mod = mx.mod.Module(mx.sym.SoftmaxOutput(fc), context=mx.tpu())
 """
+from . import _distributed_boot  # must precede any jax backend init
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context

@@ -1,0 +1,35 @@
+"""Multi-process bootstrap.
+
+Joins the jax.distributed process group when launched by tools/launch.py
+(MXNET_TPU_COORDINATOR / _NUM_WORKERS / _WORKER_ID envs — the TPU-native
+replacement for the reference's DMLC_PS_ROOT_* rendezvous).  MUST run before
+any JAX backend initialization, so mxnet_tpu/__init__ imports this first.
+"""
+from __future__ import annotations
+
+import os
+
+_done = False
+
+
+def ensure() -> None:
+    global _done
+    if _done:
+        return
+    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    if coord is None:
+        _done = True
+        return
+    import jax
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["MXNET_TPU_NUM_WORKERS"]),
+            process_id=int(os.environ["MXNET_TPU_WORKER_ID"]))
+    except RuntimeError as e:
+        if "already" not in str(e):
+            raise
+    _done = True
+
+
+ensure()

@@ -76,13 +76,11 @@ class Context:
         """
         dt = self.device_type
         if dt in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu")
+            devs = jax.local_devices(backend="cpu")
             return devs[self.device_id % len(devs)]
-        # tpu / gpu: prefer the default (accelerator) backend
-        devs = jax.devices()
-        if devs and devs[0].platform == "cpu":
-            # no accelerator present; fall back to host devices
-            return devs[self.device_id % len(devs)]
+        # tpu / gpu: prefer the default (accelerator) backend; local devices
+        # only — in multi-process runs jax.devices() includes remote chips
+        devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     @property

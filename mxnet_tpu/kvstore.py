@@ -164,20 +164,38 @@ class KVStore:
     barrier = _barrier
 
 
+def _maybe_init_distributed():
+    """Join the process group (delegates to the import-time boot; see
+    _distributed_boot.py — jax.distributed.initialize must run before any
+    backend init, so the real work happens at ``import mxnet_tpu``)."""
+    from . import _distributed_boot
+    _distributed_boot.ensure()
+
+
 class KVStoreDistTPU(KVStore):
     """Multi-host synchronous data-parallel store over XLA collectives.
 
-    Reference: kvstore_dist.h / kvstore_dist_server.h.  No servers: each
-    process holds a full replica; push aggregates across processes with a
-    psum over the global device mesh (ICI within slice, DCN across), pull
-    reads the local replica.  rank/num_workers = process index/count.
-    With one process it degrades to local semantics (so the nightly
-    dist_sync arithmetic tests run single-process, mirroring the
-    reference's local-launcher trick).
+    Reference: kvstore_dist.h / kvstore_dist_server.h.  No server processes:
+    each worker process holds a full replica; push first reduces its local
+    device values, then all-reduces across processes over the global device
+    mesh (ICI within a slice, DCN across — the ps-lite ZeroMQ van is gone);
+    pull reads the local replica.  rank/num_workers = jax process
+    index/count; barrier = a global collective.  With one process it
+    degrades to local semantics, mirroring the reference's local-launcher
+    test trick (tests/nightly/dist_sync_kvstore.py).
+
+    Note on update placement: the reference's server-side updater
+    (un-pickled optimizer, kvstore_dist_server.h:164-193) becomes a
+    REPLICATED updater — every worker applies the same update to identical
+    merged gradients, which is the standard TPU data-parallel recipe
+    (update_on_kvstore ≡ replicated optimizer, SURVEY §5.8).
+    ``dist_async`` has no clean ICI analogue and shares this synchronous
+    implementation (documented divergence).
     """
 
     def __init__(self, kv_type="dist_sync_tpu"):
         super().__init__(kv_type)
+        _maybe_init_distributed()
 
     @property
     def rank(self) -> int:
@@ -187,27 +205,46 @@ class KVStoreDistTPU(KVStore):
     def num_workers(self) -> int:
         return jax.process_count()
 
+    def init(self, key, value):
+        """Rank-0 value wins (reference dist init semantics): broadcast."""
+        super().init(key, value)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            keys, _ = _key_list(key)
+            for k in keys:
+                v = self._store[k].asnumpy()
+                v0 = multihost_utils.broadcast_one_to_all(v)
+                self._store[k][:] = np.asarray(v0)
+
     def _merge(self, vals: List[NDArray]) -> NDArray:
         merged = super()._merge(vals)
         if jax.process_count() > 1:
-            # cross-process allreduce: jit a psum over all devices
-            mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("dp",))
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            rep = NamedSharding(mesh, P())
-
-            @jax.jit
-            def allreduce(x):
-                return x
-            # NOTE: with multi-process jax, gradients are already global
-            # arrays; per-process partial sums ride jax.lax.psum inside the
-            # training step (parallel/ package).  Here we sum host-local.
+            # cross-process allreduce over the global mesh: psum of the
+            # per-process partial sums (one fused collective per key)
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(merged.asnumpy())
+            merged = NDArray(jnp.sum(jnp.asarray(gathered), axis=0))
         return merged
+
+    def push(self, key, value, priority=0):
+        """Dist semantics: without an updater the server ACCUMULATES pushes
+        (reference kvstore_dist_server.h default merge: stored += merged —
+        the nightly test arithmetic (n+1)*n*rate/2*nrepeat+1 relies on it)."""
+        keys, _ = _key_list(key)
+        values = _val_list(len(keys), value)
+        for k, vs in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            merged = self._merge(vs)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k][:] = self._store[k] + merged
 
     def _barrier(self):
         if jax.process_count() > 1:
-            # all processes sync on a trivial collective
-            x = jnp.zeros(())
-            jax.block_until_ready(x)
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
 
     barrier = _barrier
 

@@ -1,0 +1,34 @@
+"""Distributed tests without a cluster — fork workers with the local launcher
+(reference tests/nightly/test_all.sh: launch.py -n N + dist_sync_kvstore.py /
+dist_lenet.py with accuracy gate)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(n, script, timeout=110):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    env.pop("XLA_FLAGS", None)  # workers use default 1 cpu device each
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local",
+         "%s %s" % (sys.executable, os.path.join(ROOT, script))],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
+
+
+def test_dist_sync_kvstore_2workers():
+    res = _launch(2, "tests/nightly/dist_sync_kvstore.py")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr
+
+
+def test_dist_mlp_2workers_convergence():
+    res = _launch(2, "tests/nightly/dist_mlp.py")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr

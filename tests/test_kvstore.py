@@ -116,8 +116,24 @@ def test_dist_sync_tpu_single_process():
     assert kv.rank == 0
     assert kv.num_workers == 1
     kv.init(3, mx.nd.ones(shape))
+    # dist semantics: pushes accumulate into the store (server += merged)
     kv.push(3, mx.nd.ones(shape) * 2)
     out = mx.nd.empty(shape)
     kv.pull(3, out=out)
-    check_diff_to_scalar(out, 2)
+    check_diff_to_scalar(out, 3)
     kv.barrier()
+
+
+def test_dist_sync_arithmetic_single_process():
+    """The nightly dist arithmetic (reference dist_sync_kvstore.py) with n=1."""
+    kv = mx.kv.create("dist_sync")
+    n = kv.num_workers
+    rate = 2
+    nrepeat = 3
+    kv.init(3, mx.nd.ones(shape))
+    for _ in range(nrepeat):
+        kv.push(3, mx.nd.ones(shape) * (kv.rank + 1) * rate)
+    num = (n + 1) * n * rate / 2 * nrepeat + 1
+    val = mx.nd.zeros(shape)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, num)
