@@ -1,0 +1,121 @@
+"""Pallas TPU kernels for hot ops.
+
+The RTC subsystem's successor (SURVEY §2.1 RTC row): where the reference let
+users JIT raw CUDA (mxrtc.cc), the TPU build ships Pallas kernels and lets
+users write their own through mxnet_tpu.rtc.
+
+flash_attention: blockwise attention with online softmax, MXU-shaped tiles
+(q blocks x k blocks of 128, fp32 accumulators in VMEM), causal masking via
+block skipping.  Falls back to the dense jnp reference off-TPU; tests run the
+kernel in interpret mode for numerical parity.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax.experimental import pallas as pl
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    pl = None
+    HAS_PALLAS = False
+
+__all__ = ["flash_attention", "HAS_PALLAS"]
+
+
+def _attention_dense(q, k, v, causal):
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal,
+                  scale, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)            # (block_q, D)
+    d = q.shape[-1]
+    nk = seq_len // block_k
+
+    m0 = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    a0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                                        (block_q, block_k), 0)
+            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32,
+                                                       (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        blk_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        safe_m = jnp.where(jnp.isinf(new_m), 0.0, new_m)
+        p = jnp.where(jnp.isinf(s), 0.0, jnp.exp(s - safe_m[:, None]))
+        corr = jnp.where(jnp.isinf(m), 0.0, jnp.exp(m - safe_m))
+        l2 = l * corr + jnp.sum(p, axis=-1)
+        acc2 = acc * corr[:, None] + jnp.dot(p, vblk,
+                                             preferred_element_type=jnp.float32)
+        return new_m, l2, acc2
+
+    if causal:
+        # only blocks with k_start <= q_end contribute
+        nk_run = (qi * block_q + block_q + block_k - 1) // block_k
+        nk_run = jnp.minimum(nk_run, nk)
+    else:
+        nk_run = nk
+    m, l, acc = lax.fori_loop(0, nk_run, body, (m0, l0, a0))
+    l = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Blockwise attention.  q, k, v: (B, T, H, D) -> (B, T, H, D).
+
+    Uses the Pallas kernel on TPU (or with interpret=True anywhere);
+    falls back to dense attention otherwise.
+    """
+    b, t, h, d = q.shape
+    on_tpu = jax.default_backend() == "tpu"
+    if not HAS_PALLAS or (not on_tpu and not interpret) or t % block_k:
+        from ..parallel.ring import attention_reference
+        return attention_reference(q, k, v, causal=causal)
+
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    scale = 1.0 / math.sqrt(d)
+    # (B, T, H, D) -> (B*H, T, D)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    kernel = functools.partial(_flash_kernel, block_q=block_q,
+                               block_k=block_k, causal=causal, scale=scale,
+                               seq_len=t)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
