@@ -133,6 +133,10 @@ def make_ring_attention(mesh: Mesh, axis: str = "sp", causal: bool = False,
     inner = ring_attention if impl == "ring" else ulysses_attention
     fn = functools.partial(inner, axis_name=axis, causal=causal)
     spec = P(None, axis, None, None)
-    sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                        out_specs=spec, check_rep=False)
+    try:
+        sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)
+    except TypeError:  # older shard_map spelling
+        sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_rep=False)
     return jax.jit(sharded)
