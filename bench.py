@@ -15,16 +15,19 @@ import numpy as np
 BASELINE_IMG_S_PER_CHIP = 128.0  # MXNet-CUDA TitanX img/s/GPU (BASELINE.md)
 
 
-def build_step(batch):
+def build_step(batch, compute_dtype="bfloat16"):
     import jax
+    import jax.numpy as jnp
     from mxnet_tpu.parallel import make_mesh, DPTrainStep
     from __graft_entry__ import _resnet_prog
 
     net, prog, params, aux, data, label = _resnet_prog(
         [3, 4, 6, 3], [64, 256, 512, 1024, 2048], 1000, (3, 224, 224), batch)
     mesh = make_mesh([("dp", 1)], devices=jax.devices()[:1])
+    cdt = jnp.bfloat16 if compute_dtype == "bfloat16" else None
     step = DPTrainStep(net, mesh, learning_rate=0.1, momentum=0.9,
-                       weight_decay=1e-4, rescale_grad=1.0 / batch)
+                       weight_decay=1e-4, rescale_grad=1.0 / batch,
+                       compute_dtype=cdt)
     state = step.init(params, aux)
     sharded = step.shard_batch({"data": data, "softmax_label": label})
     return step, state, sharded

@@ -20,6 +20,8 @@ from . import random
 from . import random as rnd
 from . import symbol
 from . import symbol as sym
+from .ops import nd_bridge as _nd_bridge
+_nd_bridge.register_all()  # SimpleOp dual registration: ops -> mx.nd.*
 from .symbol import Symbol
 from . import executor
 from .executor import Executor

@@ -1,0 +1,60 @@
+"""Dual registration: expose registry ops as mx.nd.* functions.
+
+Reference: include/mxnet/operator_util.h SimpleOp — one registration serves
+both `mx.nd.*` (imperative) and `mx.sym.*` (symbolic).  Here every registered
+op without auxiliary state gets an eager NDArray wrapper: inputs are
+NDArrays, params are kwargs, execution dispatches through jnp immediately
+(async, engine-tracked).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..base import MXNetError
+from .registry import OpContext, get_op, list_ops
+from .. import random as _random
+
+
+def _make_nd_fn(op_name: str):
+    def nd_fn(*args, **kwargs):
+        from ..ndarray import NDArray
+        from .. import engine as _engine
+        op = get_op(op_name)
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        out = kwargs.pop("out", None)
+        if op.variable_args is not None and op.variable_args not in kwargs:
+            kwargs[op.variable_args] = len(inputs)
+        p = op.parse_params(kwargs)
+        nargs = len(op.list_arguments(p))
+        if len(inputs) != nargs:
+            raise MXNetError("%s expects %d NDArray inputs, got %d"
+                             % (op_name, nargs, len(inputs)))
+        rng = _random.new_key() if op.needs_rng else None
+        res = op.forward(p, [x._get() for x in inputs], [],
+                         OpContext(is_train=False, rng=rng))
+        if isinstance(res, tuple):
+            res = res[0]
+        outs = [NDArray(_engine.track(o)) for o in res]
+        if out is not None:
+            outs[0].copyto(out)
+            return out
+        return outs[0] if len(outs) == 1 else outs
+    nd_fn.__name__ = op_name
+    nd_fn.__doc__ = "Imperative form of operator %s (SimpleOp dual " \
+                    "registration)." % op_name
+    return nd_fn
+
+
+def register_all():
+    """Attach imperative wrappers to mxnet_tpu.ndarray for every aux-free op."""
+    from .. import ndarray as nd_mod
+    for name in list_ops():
+        op = get_op(name)
+        try:
+            if op.list_auxiliary_states(op.parse_params({})):
+                continue  # stateful ops (BatchNorm...) need an executor
+        except MXNetError:
+            pass  # required params block introspection; such ops are aux-free
+        if hasattr(nd_mod, name):
+            continue  # keep hand-written versions (dot, sum, clip, ...)
+        nd_mod.register_ndarray_fn(name, _make_nd_fn(name))

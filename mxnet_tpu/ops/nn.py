@@ -256,18 +256,25 @@ class BatchNormOp(OpDef):
         if p.fix_gamma:
             gamma = jnp.ones_like(gamma)
         bshape = [1, -1] + [1] * (x.ndim - 2)
+        # statistics in f32 regardless of compute dtype (bf16-safe on TPU)
+        xf = x.astype(jnp.float32)
         if ctx.is_train and not p.use_global_stats:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.mean(jnp.square(x - mean.reshape(bshape)), axis=axes)
-            y = (x - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + p.eps)
-            y = gamma.reshape(bshape) * y + beta.reshape(bshape)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf - mean.reshape(bshape)), axis=axes)
+            y = (xf - mean.reshape(bshape)) * lax.rsqrt(var.reshape(bshape) + p.eps)
+            y = gamma.astype(jnp.float32).reshape(bshape) * y \
+                + beta.astype(jnp.float32).reshape(bshape)
             m = p.momentum
-            new_mean = m * moving_mean + (1 - m) * lax.stop_gradient(mean)
-            new_var = m * moving_var + (1 - m) * lax.stop_gradient(var)
-            return [y], [new_mean, new_var]
-        y = (x - moving_mean.reshape(bshape)) * lax.rsqrt(moving_var.reshape(bshape) + p.eps)
-        y = gamma.reshape(bshape) * y + beta.reshape(bshape)
-        return [y], [moving_mean, moving_var]
+            mm = moving_mean.astype(jnp.float32)
+            mv = moving_var.astype(jnp.float32)
+            new_mean = (m * mm + (1 - m) * lax.stop_gradient(mean)).astype(moving_mean.dtype)
+            new_var = (m * mv + (1 - m) * lax.stop_gradient(var)).astype(moving_var.dtype)
+            return [y.astype(x.dtype)], [new_mean, new_var]
+        y = (xf - moving_mean.astype(jnp.float32).reshape(bshape)) \
+            * lax.rsqrt(moving_var.astype(jnp.float32).reshape(bshape) + p.eps)
+        y = gamma.astype(jnp.float32).reshape(bshape) * y \
+            + beta.astype(jnp.float32).reshape(bshape)
+        return [y.astype(x.dtype)], [moving_mean, moving_var]
 
 
 @register_op("Dropout", hint="dropout")

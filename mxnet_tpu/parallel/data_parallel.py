@@ -54,7 +54,7 @@ class DPTrainStep:
                  data_names=("data",), label_names=("softmax_label",),
                  learning_rate=0.01, momentum=0.9, weight_decay=1e-4,
                  rescale_grad=None, param_specs=None, dtype=np.float32,
-                 remat=False):
+                 compute_dtype=None, remat=False):
         self.symbol = symbol
         self.mesh = mesh
         self.data_names = tuple(data_names)
@@ -64,6 +64,9 @@ class DPTrainStep:
         self.wd = weight_decay
         self.rescale = rescale_grad
         self.param_specs = param_specs or {}
+        # bf16 mixed precision: f32 master weights + momentum, bf16 fwd/bwd
+        # compute (MXU-native; fp16-era capability mapped the TPU way)
+        self.compute_dtype = compute_dtype
         self._prog = _GraphProgram(symbol, {}, None, do_mirror=remat)
         input_names = set(self.data_names) | set(self.label_names)
         self.param_names = [n for n in symbol.list_arguments()
@@ -99,6 +102,8 @@ class DPTrainStep:
         prog = self._prog
         lr, momentum, wd = self.lr, self.momentum, self.wd
 
+        cdt = self.compute_dtype
+
         def step(state, batch, rng):
             params, aux, mom = state["params"], state["aux"], state["mom"]
             rescale = self.rescale
@@ -108,11 +113,17 @@ class DPTrainStep:
             def loss_fn(params):
                 args = dict(params)
                 args.update(batch)
+                if cdt is not None:
+                    args = {k: v.astype(cdt)
+                            if jnp.issubdtype(v.dtype, jnp.floating) else v
+                            for k, v in args.items()}
                 outs, new_aux = prog.eval(args, aux, rng, True)
                 return outs, new_aux
 
             outs, vjp_fn, new_aux = jax.vjp(loss_fn, params, has_aux=True)
             grads = vjp_fn([jnp.ones_like(o) for o in outs])[0]
+            if cdt is not None:
+                grads = {k: g.astype(jnp.float32) for k, g in grads.items()}
 
             new_params = {}
             new_mom = {} if mom is not None else None

@@ -123,3 +123,39 @@ def test_mesh_dp_train_step():
         acc = (probs.argmax(axis=1) == ys).mean()
         losses.append(acc)
     assert np.mean(losses[-5:]) > 0.9, losses
+
+
+def test_mesh_dp_train_step_bf16():
+    """bf16 compute + f32 master weights converges (mixed precision)."""
+    import jax.numpy as jnp
+    np.random.seed(0)
+    mx.random.seed(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mesh = mx.parallel.make_mesh([("dp", 4)])
+    step = mx.parallel.DPTrainStep(net, mesh, learning_rate=0.5,
+                                   momentum=0.9, weight_decay=0.0,
+                                   compute_dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    arg_params = {
+        "fc1_weight": rng.randn(16, 10).astype(np.float32) * 0.1,
+        "fc1_bias": np.zeros(16, np.float32),
+        "fc2_weight": rng.randn(4, 16).astype(np.float32) * 0.1,
+        "fc2_bias": np.zeros(4, np.float32),
+    }
+    state = step.init(arg_params, {})
+    centers = rng.randn(4, 10) * 3
+    accs = []
+    for _ in range(25):
+        ys = rng.randint(4, size=64)
+        X = centers[ys] + rng.randn(64, 10) * 0.5
+        batch = step.shard_batch({"data": X.astype(np.float32),
+                                  "softmax_label": ys.astype(np.float32)})
+        state, outs = step(state, batch)
+        accs.append((np.asarray(outs[0].astype(jnp.float32)).argmax(axis=1)
+                     == ys).mean())
+    assert state["params"]["fc1_weight"].dtype == np.float32  # master stays f32
+    assert np.mean(accs[-5:]) > 0.9, accs
