@@ -201,6 +201,27 @@ def get_all_registered_operators():
     return sorted(_CUSTOM_REGISTRY)
 
 
+@register_op("_NDArray", hint="ndarrayop")
+class _NDArrayShimOp(OpDef):
+    """reference ndarray_op-inl.h: handle-passing symbol for NDArrayOp.
+    In this build NDArrayOp.get_symbol registers a dedicated op per instance
+    (no raw pointers across an ABI), so this shim only reports the path."""
+    params = [Param("info", str, default="")]
+
+    def forward(self, p, inputs, aux, ctx):
+        raise MXNetError("_NDArray pointer-passing is not used in the TPU "
+                         "build; construct the symbol via NDArrayOp.get_symbol")
+
+
+@register_op("_Native", hint="nativeop")
+class _NativeShimOp(_NDArrayShimOp):
+    """reference native_op-inl.h — see _NDArray shim; use NumpyOp.get_symbol."""
+
+    def forward(self, p, inputs, aux, ctx):
+        raise MXNetError("_Native pointer-passing is not used in the TPU "
+                         "build; construct the symbol via NumpyOp.get_symbol")
+
+
 @register_op("Custom", hint="custom")
 class CustomSymbolOp(OpDef):
     """sym.Custom(..., op_type='name') (reference custom-inl.h:211)."""

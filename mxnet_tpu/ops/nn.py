@@ -277,6 +277,12 @@ class BatchNormOp(OpDef):
         return [y.astype(x.dtype)], [moving_mean, moving_var]
 
 
+@register_op("CuDNNBatchNorm", hint="cudnnbatchnorm")
+class CuDNNBatchNormOp(BatchNormOp):
+    """reference cudnn_batch_norm-inl.h — same semantics; on TPU the XLA
+    fusion IS the fast path, so this is an alias of BatchNorm."""
+
+
 @register_op("Dropout", hint="dropout")
 class DropoutOp(OpDef):
     """reference dropout-inl.h (scale by 1/(1-p) at train time)."""

@@ -293,6 +293,28 @@ register_simple_op("slice_axis", _slice_axis, nin=1, infer_shape=_slice_axis_sha
 register_simple_op("flip", lambda p, a: jnp.flip(a, axis=p.axis), nin=1,
                    params=[Param("axis", int, required=True)])
 
+
+def _crop_simple_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    begin = p.begin if p.begin else (0,) * len(d)
+    end = p.end if p.end else d
+    return [d], [tuple(e - b for b, e in zip(begin, end))], []
+
+
+def _crop_simple(p, a):
+    begin = p.begin if p.begin else (0,) * a.ndim
+    end = p.end if p.end else a.shape
+    return a[tuple(slice(b, e) for b, e in zip(begin, end))]
+
+
+# lowercase crop = general slice (reference matrix_op-inl.h crop SimpleOp,
+# distinct from the Crop layer)
+register_simple_op("crop", _crop_simple, nin=1, infer_shape=_crop_simple_shape,
+                   params=[Param("begin", "shape", default=()),
+                           Param("end", "shape", default=())])
+
 # ---------------------------------------------------------------------------
 # losses (reference loss_binary_op-inl.h:110, smooth_l1_unary-inl.h:115)
 
