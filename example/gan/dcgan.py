@@ -1,0 +1,95 @@
+"""DCGAN training (reference example/gan/dcgan.py capability).
+
+Generator and discriminator trained adversarially with the Module API;
+the generator gradient comes from the discriminator's input grads
+(inputs_need_grad=True), exactly the reference flow.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models.dcgan import make_generator, make_discriminator
+from mxnet_tpu.io import DataBatch
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tpus", type=str)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--code-dim", type=int, default=100)
+    parser.add_argument("--num-iters", type=int, default=200)
+    parser.add_argument("--lr", type=float, default=0.0002)
+    parser.add_argument("--image-size", type=int, default=64)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else [mx.cpu()]
+    bs = args.batch_size
+
+    gen = mx.mod.Module(make_generator(code_dim=args.code_dim),
+                        data_names=("rand",), label_names=None, context=ctx)
+    gen.bind(data_shapes=[("rand", (bs, args.code_dim, 1, 1))],
+             label_shapes=None, for_training=True, inputs_need_grad=False)
+    gen.init_params(mx.init.Normal(0.02))
+    gen.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "beta1": 0.5})
+
+    disc = mx.mod.Module(make_discriminator(),
+                         data_names=("data",), label_names=("label",),
+                         context=ctx)
+    disc.bind(data_shapes=[("data", (bs, 3, args.image_size, args.image_size))],
+              label_shapes=[("label", (bs,))],
+              for_training=True, inputs_need_grad=True)
+    disc.init_params(mx.init.Normal(0.02))
+    disc.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "beta1": 0.5})
+
+    rng = np.random.RandomState(0)
+    for it in range(args.num_iters):
+        # synthetic "real" data stand-in; plug an ImageRecordIter here
+        real = rng.rand(bs, 3, args.image_size, args.image_size).astype("f") * 2 - 1
+        z = rng.randn(bs, args.code_dim, 1, 1).astype("f")
+
+        # G forward
+        gen.forward(DataBatch(data=[mx.nd.array(z)], label=[]), is_train=True)
+        fake = gen.get_outputs()[0]
+
+        # D on fake (label 0), backprop into inputs
+        disc.forward(DataBatch(data=[fake], label=[mx.nd.zeros((bs,))]),
+                     is_train=True)
+        disc.backward()
+        grad_d_fake = [[g.copy() for g in grads]
+                       for grads in disc._exec_group.grad_arrays]
+        # D on real (label 1)
+        disc.forward(DataBatch(data=[mx.nd.array(real)],
+                               label=[mx.nd.ones((bs,))]), is_train=True)
+        disc.backward()
+        # accumulate D grads (fake + real) then update
+        for gw, gf in zip(disc._exec_group.grad_arrays, grad_d_fake):
+            for a, b in zip(gw, gf):
+                if a is not None:
+                    a[:] = a + b
+        disc.update()
+
+        # G step: D(fake) with label 1, take input grads back through G
+        disc.forward(DataBatch(data=[fake], label=[mx.nd.ones((bs,))]),
+                     is_train=True)
+        disc.backward()
+        diff = disc.get_input_grads()[0]
+        gen.backward([diff])
+        gen.update()
+
+        if it % 20 == 0:
+            d_out = disc.get_outputs()[0].asnumpy()
+            logging.info("iter %d  D(G(z))=%.3f", it, d_out.mean())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Model-parallel LSTM (reference example/model-parallel-lstm capability).
+
+Each LSTM layer gets a ctx_group; group2ctx places layers on devices.
+On the fake 8-cpu-device test rig this demonstrates placement; on a TPU
+mesh the groups map onto mesh axes (docs/multi_node.md).
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models import lstm_unroll
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-lstm-layer", type=int, default=4)
+    parser.add_argument("--seq-len", type=int, default=8)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--num-embed", type=int, default=32)
+    parser.add_argument("--vocab", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-devices", type=int, default=4)
+    parser.add_argument("--iters", type=int, default=20)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    groups = ["layer%d" % i for i in range(args.num_lstm_layer)]
+    net = lstm_unroll(args.num_lstm_layer, args.seq_len, args.vocab,
+                      args.num_hidden, args.num_embed, args.vocab,
+                      ctx_groups=groups)
+    group2ctx = {g: mx.cpu(i % args.num_devices)
+                 for i, g in enumerate(groups)}
+
+    bs = args.batch_size
+    shapes = {"data": (bs, args.seq_len),
+              "softmax_label": (bs, args.seq_len)}
+    for i in range(args.num_lstm_layer):
+        shapes["l%d_init_c" % i] = (bs, args.num_hidden)
+        shapes["l%d_init_h" % i] = (bs, args.num_hidden)
+
+    exe = net.simple_bind(mx.cpu(0), group2ctx=group2ctx, **shapes)
+    init = mx.init.Xavier()
+    for name, arr in exe.arg_dict.items():
+        if name not in shapes:
+            init(name, arr)
+
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           rescale_grad=1.0 / bs)
+    updater = mx.optimizer.get_updater(opt)
+    rng = np.random.RandomState(0)
+    param_names = [n for n in exe.arg_dict if n not in shapes]
+    for it in range(args.iters):
+        tokens = rng.randint(1, args.vocab, (bs, args.seq_len + 1))
+        exe.arg_dict["data"][:] = tokens[:, :-1].astype("f")
+        exe.arg_dict["softmax_label"][:] = tokens[:, 1:].astype("f")
+        exe.forward(is_train=True)
+        exe.backward()
+        for idx, name in enumerate(param_names):
+            if exe.grad_dict.get(name) is not None:
+                updater(idx, exe.grad_dict[name], exe.arg_dict[name])
+        if it % 5 == 0:
+            out = exe.outputs[0].asnumpy()
+            ppl = np.exp(-np.log(out[np.arange(out.shape[0]),
+                                     tokens[:, 1:].T.reshape(-1).astype(int)]
+                                 + 1e-12).mean())
+            logging.info("iter %d perplexity %.1f", it, ppl)
+    logging.info("layer placement: %s",
+                 {g: str(c) for g, c in group2ctx.items()})
+
+
+if __name__ == "__main__":
+    main()
