@@ -1,0 +1,129 @@
+"""Neural style transfer (reference example/neural-style capability;
+Gatys et al. 2015).
+
+Optimizes the INPUT image through a VGG feature extractor: content loss on
+deep features, style loss on Gram matrices — the gradient flows to the data
+via inputs_need_grad/args_grad on the executor, the same mechanism the
+reference used.  Load converted VGG-19 weights via --params for real runs
+(random weights still demonstrate the full optimization loop).
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def style_content_symbol():
+    """VGG-ish trunk exposing style (relu1..4) + content (relu4) features."""
+    data = sym.Variable("data")
+    style_feats = []
+    body = data
+    for stage, (nf, n) in enumerate([(64, 2), (128, 2), (256, 3), (512, 3)]):
+        for i in range(n):
+            body = sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                   num_filter=nf,
+                                   name="conv%d_%d" % (stage + 1, i + 1))
+            body = sym.Activation(body, act_type="relu",
+                                  name="relu%d_%d" % (stage + 1, i + 1))
+        style_feats.append(body)
+        body = sym.Pooling(body, pool_type="avg", kernel=(2, 2), stride=(2, 2),
+                           name="pool%d" % (stage + 1))
+    content_feat = style_feats[-1]
+    return sym.Group(style_feats), content_feat
+
+
+def gram(feat):
+    n = feat.shape[1]
+    x = feat.asnumpy().reshape(n, -1)
+    return x @ x.T / x.shape[1]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--content-image", type=str)
+    parser.add_argument("--style-image", type=str)
+    parser.add_argument("--params", type=str, help="converted VGG params file")
+    parser.add_argument("--size", type=int, default=128)
+    parser.add_argument("--iters", type=int, default=50)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--style-weight", type=float, default=1.0)
+    parser.add_argument("--content-weight", type=float, default=10.0)
+    parser.add_argument("--output", type=str, default="out.npy")
+    parser.add_argument("--tpus", type=str)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    ctx = mx.tpu(0) if args.tpus else mx.cpu()
+    hw = (1, 3, args.size, args.size)
+
+    def load_img(path):
+        if path and os.path.exists(path):
+            from mxnet_tpu.plugins import opencv as cv
+            img = cv.imresize(cv.imread(path), args.size, args.size)
+            return img.asnumpy().transpose(2, 0, 1)[None].astype(np.float32) / 255
+        return np.random.rand(*hw).astype(np.float32)
+
+    content = load_img(args.content_image)
+    style = load_img(args.style_image)
+
+    style_sym, content_sym = style_content_symbol()
+    net = sym.Group([style_sym, content_sym])
+    exe = net.bind(ctx, args={"data": mx.nd.array(content),
+                              **{n: mx.nd.zeros(s) for n, s in zip(
+                                  net.list_arguments()[1:],
+                                  net.infer_shape(data=hw)[0][1:])}},
+                   args_grad={"data": mx.nd.zeros(hw)}, grad_req={"data": "write"})
+    init = mx.init.Xavier()
+    for name in net.list_arguments()[1:]:
+        init(name, exe.arg_dict[name])
+    if args.params:
+        exe.copy_params_from(
+            {k: v for k, v in mx.nd.load(args.params).items()},
+            allow_extra_params=True)
+
+    n_style = len(net.list_outputs()) - 1
+    # targets
+    exe.arg_dict["data"][:] = mx.nd.array(style)
+    exe.forward(is_train=False)
+    style_targets = [gram(o) for o in exe.outputs[:n_style]]
+    exe.arg_dict["data"][:] = mx.nd.array(content)
+    exe.forward(is_train=False)
+    content_target = exe.outputs[-1].asnumpy()
+
+    img = mx.nd.array(content + np.random.randn(*hw).astype(np.float32) * 0.05)
+    opt = mx.optimizer.Adam(learning_rate=args.lr)
+    state = opt.create_state(0, img)
+    for it in range(args.iters):
+        exe.arg_dict["data"][:] = img
+        exe.forward(is_train=True)
+        # build head gradients: d(style+content loss)/d(features)
+        head_grads = []
+        loss = 0.0
+        for o, tgt in zip(exe.outputs[:n_style], style_targets):
+            feat = o.asnumpy()
+            n = feat.shape[1]
+            flat = feat.reshape(n, -1)
+            g = flat @ flat.T / flat.shape[1] - tgt
+            loss += args.style_weight * float((g ** 2).sum())
+            gg = (2 * args.style_weight / flat.shape[1]) * (g @ flat)
+            head_grads.append(mx.nd.array(gg.reshape(feat.shape)))
+        cf = exe.outputs[-1].asnumpy()
+        loss += args.content_weight * float(((cf - content_target) ** 2).mean())
+        head_grads.append(mx.nd.array(
+            2 * args.content_weight * (cf - content_target) / cf.size))
+        exe.backward(head_grads)
+        opt.update(0, img, exe.grad_dict["data"], state)
+        img[:] = mx.nd.clip(img, 0.0, 1.0)
+        if it % 10 == 0:
+            logging.info("iter %d loss %.4f", it, loss)
+    np.save(args.output, img.asnumpy())
+    logging.info("saved %s", args.output)
+
+
+if __name__ == "__main__":
+    main()

@@ -112,3 +112,59 @@ def test_lstm_model_parallel_groups():
     ex.arg_dict["data"][:] = np.zeros((bs, 2), "f")
     ex.forward(is_train=True)
     assert np.isfinite(ex.outputs[0].asnumpy()).all()
+
+
+def test_dcgan_shapes():
+    from mxnet_tpu.models.dcgan import make_generator, make_discriminator
+    gen = make_generator(code_dim=16)
+    _, outs, _ = gen.infer_shape(rand=(2, 16, 1, 1))
+    assert outs[0] == (2, 3, 64, 64)
+    disc = make_discriminator()
+    _, outs, _ = disc.infer_shape(data=(2, 3, 64, 64), label=(2,))
+    assert outs[0] == (2, 1)
+
+
+def test_fcn_shapes():
+    from mxnet_tpu.models.fcn import get_fcn32s, get_fcn16s
+    net = get_fcn32s(num_classes=5)
+    _, outs, _ = net.infer_shape(data=(1, 3, 64, 64),
+                                 softmax_label=(1, 64, 64))
+    assert outs[0] == (1, 5, 64, 64)
+    net16 = get_fcn16s(num_classes=5)
+    _, outs, _ = net16.infer_shape(data=(1, 3, 64, 64),
+                                   softmax_label=(1, 64, 64))
+    assert outs[0] == (1, 5, 64, 64)
+
+
+def test_fast_rcnn_forward_backward():
+    from mxnet_tpu.models.rcnn import get_fast_rcnn
+    net = get_fast_rcnn(num_classes=4, pooled_size=(3, 3),
+                        spatial_scale=0.5, small=True)
+    n_roi = 6
+    shapes = {"data": (1, 3, 32, 32), "rois": (n_roi, 5),
+              "label": (n_roi,), "bbox_target": (n_roi, 16),
+              "bbox_weight": (n_roi, 16)}
+    ex = net.simple_bind(mx.cpu(), **shapes)
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name not in shapes:
+            init(name, arr)
+    rois = np.zeros((n_roi, 5), np.float32)
+    rois[:, 1:] = np.sort(np.random.rand(n_roi, 4) * 30, axis=1)
+    ex.arg_dict["data"][:] = np.random.randn(1, 3, 32, 32).astype("f")
+    ex.arg_dict["rois"][:] = rois
+    ex.arg_dict["label"][:] = np.random.randint(0, 4, n_roi).astype("f")
+    ex.arg_dict["bbox_weight"][:] = 1.0
+    ex.forward(is_train=True)
+    assert ex.outputs[0].shape == (n_roi, 4)
+    assert np.allclose(ex.outputs[0].asnumpy().sum(axis=1), 1, atol=1e-5)
+    ex.backward()
+    assert np.abs(ex.grad_dict["cls_score_weight"].asnumpy()).sum() > 0
+    assert np.abs(ex.grad_dict["bbox_pred_weight"].asnumpy()).sum() > 0
+
+
+def test_rpn_shapes():
+    from mxnet_tpu.models.rcnn import get_rpn
+    net = get_rpn(num_anchors=3, small=True)
+    _, outs, _ = net.infer_shape(data=(1, 3, 32, 32))
+    assert outs[1][1] == 12  # 4 * num_anchors bbox deltas
