@@ -4,10 +4,15 @@ example/rnn/lstm.py — capability parity, fresh implementations)."""
 from .mlp import get_mlp
 from .lenet import get_lenet
 from .resnet import get_resnet, get_resnet50
-from .inception_bn import get_inception_bn
+from .inception_bn import get_inception_bn, get_inception_bn_28small
 from .vgg import get_vgg
 from .lstm import lstm_unroll, lstm_cell, LSTMState, LSTMParam
+from .dcgan import make_generator, make_discriminator
+from .fcn import get_fcn32s, get_fcn16s
+from .rcnn import get_fast_rcnn, get_rpn
 
 __all__ = ["get_mlp", "get_lenet", "get_resnet", "get_resnet50",
-           "get_inception_bn", "get_vgg", "lstm_unroll", "lstm_cell",
-           "LSTMState", "LSTMParam"]
+           "get_inception_bn", "get_inception_bn_28small", "get_vgg",
+           "lstm_unroll", "lstm_cell", "LSTMState", "LSTMParam",
+           "make_generator", "make_discriminator", "get_fcn32s", "get_fcn16s",
+           "get_fast_rcnn", "get_rpn"]
