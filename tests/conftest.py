@@ -28,3 +28,11 @@ for m in mods:
     del sys.modules[m]
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon sitecustomize (PYTHONPATH=.axon_site) runs at interpreter start and
+# sets jax's jax_platforms config to "axon,cpu", which takes precedence over
+# the JAX_PLATFORMS env var. Force it back to cpu-only before any backend
+# initializes so tests never touch the real TPU tunnel.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
