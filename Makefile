@@ -1,11 +1,12 @@
-# Build the native core (libmxtpu.so: recordio + threaded batch loader)
-# and the im2rec tool.  Reference analogue: the reference's Makefile building
-# libmxnet.so; here the XLA/PJRT runtime comes from jaxlib, so the native
-# library covers the IO/runtime pieces the reference wrote in C++.
+# Build the native core (libmxtpu.so: dependency engine + storage manager +
+# recordio + threaded batch loader) and the im2rec tool.  Reference analogue:
+# the reference's Makefile building libmxnet.so; here the XLA/PJRT runtime
+# comes from jaxlib, so the native library covers the scheduler/allocator/IO
+# pieces the reference wrote in C++.
 CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -pthread
 LIB = mxnet_tpu/libmxtpu.so
-SRCS = src/recordio.cc src/data_loader.cc
+SRCS = src/recordio.cc src/data_loader.cc src/engine.cc src/storage.cc
 
 all: $(LIB) bin/im2rec
 

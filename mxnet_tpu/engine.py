@@ -20,12 +20,20 @@ JAX's immutable arrays give the same guarantees by construction:
 
 The facade preserves the public Engine API surface so user code and the rest
 of the framework keep the same call sites as the reference.
+
+HOST-side scheduling (IO closures, checkpoint writes, user async work) is
+backed by the native C++ engine (src/engine.cc via native_engine.py) with the
+reference's exact ThreadedVar semantics — serialized writes, batched reads,
+WaitForVar/WaitForAll — on a C++ worker pool, mirroring
+ThreadedEnginePerDevice's CPU pools (threaded_engine_perdevice.cc:26-183).
 """
 from __future__ import annotations
 
+import atexit
 import os
+import threading
 import weakref
-from typing import Any, Callable, Iterable, List
+from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import jax
 
@@ -52,6 +60,33 @@ class Engine:
         self._naive = get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice") == "NaiveEngine"
         # weak references to recently produced arrays, for WaitForAll.
         self._pending: "weakref.WeakSet" = weakref.WeakSet()
+        self._native = None  # lazily-created C++ engine for host closures
+        self._native_lock = threading.Lock()
+
+    # -- native host-side engine --------------------------------------------
+    @property
+    def native(self):
+        """The C++ dependency engine for host closures, or None if the
+        native library is not built (pure-python fallback keeps working)."""
+        if self._native is None:
+            with self._native_lock:
+                if self._native is None:
+                    from . import native_engine
+                    if native_engine.lib_available():
+                        eng = native_engine.NativeEngine()
+                        atexit.register(eng.wait_for_all)
+                        self._native = eng
+        return self._native
+
+    def new_var(self) -> Optional[int]:
+        """NewVariable (reference engine.h:104): a dependency token for
+        host-side pushes."""
+        native = self.native
+        return native.new_var() if native is not None else None
+
+    def delete_var(self, var: Optional[int]) -> None:
+        if var is not None and self._native is not None:
+            self._native.delete_var(var)
 
     # -- mode ---------------------------------------------------------------
     @property
@@ -59,6 +94,10 @@ class Engine:
         return self._naive
 
     def set_naive(self, value: bool) -> None:
+        # Drain in-flight native ops first: naive-mode pushes run inline and
+        # must not race still-queued writes on the same vars.
+        if value and self._native is not None:
+            self._native.wait_for_all()
         self._naive = bool(value)
 
     # -- tracking -----------------------------------------------------------
@@ -83,12 +122,24 @@ class Engine:
 
     # -- waits --------------------------------------------------------------
     def wait_for_var(self, arr: Any) -> None:
-        """WaitForVar (reference engine.h:191): block until arr is computed."""
-        if arr is not None:
-            jax.block_until_ready(arr)
+        """WaitForVar (reference engine.h:191): block until arr is computed.
+
+        Accepts a jax array (device compute) or a VarHandle token from
+        new_var() (host-side native engine); plain scalars pass through to
+        jax as before."""
+        if arr is None:
+            return
+        from .native_engine import VarHandle
+        if isinstance(arr, VarHandle):
+            if self._native is not None:
+                self._native.wait_for_var(arr)
+            return
+        jax.block_until_ready(arr)
 
     def wait_for_all(self) -> None:
         """WaitForAll (reference engine.h:197): barrier over all pending work."""
+        if self._native is not None:
+            self._native.wait_for_all()
         pending = list(self._pending)
         self._pending.clear()
         for arr in pending:
@@ -97,9 +148,26 @@ class Engine:
             except Exception:
                 pass
 
-    # -- push (compat) ------------------------------------------------------
-    def push(self, fn: Callable[[], Any], *_args, **_kwargs) -> Any:
-        """PushSync/PushAsync analogue: run fn now (XLA dispatch is async)."""
+    # -- push ---------------------------------------------------------------
+    def push(self, fn: Callable[[], Any],
+             const_vars: Sequence[int] = (),
+             mutable_vars: Sequence[int] = (),
+             prop: int = 0, priority: int = 0) -> Any:
+        """Push (reference engine.h:129-163).
+
+        Device compute: call with no vars — fn runs immediately and XLA's
+        async dispatch provides the ordering (the returned arrays are tracked
+        for WaitForAll).
+
+        Host closures: pass const_vars/mutable_vars from new_var() — fn is
+        scheduled on the native C++ worker pool once its dependencies are
+        satisfied, with serialized-write / batched-read Var semantics.
+        """
+        if (const_vars or mutable_vars) and not self._naive:
+            native = self.native
+            if native is not None:
+                native.push(fn, const_vars, mutable_vars, prop, priority)
+                return None
         out = fn()
         return self.track(out)
 

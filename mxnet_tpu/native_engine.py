@@ -1,0 +1,236 @@
+"""ctypes bindings for the native dependency engine + storage manager
+(libmxtpu.so: src/engine.cc, src/storage.cc).
+
+Reference analogue: the C++ async dataflow scheduler src/engine/
+(ThreadedEnginePerDevice, threaded_engine_perdevice.cc:26-183) and the pooled
+storage manager src/storage/pooled_storage_manager.h, reached through the C
+ABI exactly like the reference python package reached libmxnet.so.
+
+On TPU, XLA/PJRT already orders device compute by data dependence; the native
+engine schedules the HOST side (python closures for IO prefetch, checkpoint
+writes, kvstore reductions) on C++ worker threads with the reference's exact
+Var semantics: serialized writes, batched reads, WaitForVar/WaitForAll.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Callable, Optional, Sequence
+
+from .base import get_env
+
+__all__ = ["NativeEngine", "NativeStorage", "FnProperty", "VarHandle",
+           "lib_available"]
+
+_LIB = None  # None = not tried; False = tried and unavailable
+_TRAMPOLINE = None
+
+
+class VarHandle(int):
+    """Opaque dependency token from Engine.new_var (reference engine.h VarHandle).
+
+    A distinct type (not a bare int) so facade APIs can tell a var token
+    apart from scalars and jax arrays."""
+    __slots__ = ()
+
+    def __repr__(self):
+        return "VarHandle(%d)" % int(self)
+
+
+class FnProperty:
+    """Scheduling hints (reference include/mxnet/engine.h:58-69)."""
+    kNormal = 0
+    kCopyFromDevice = 1
+    kCopyToDevice = 2
+    kPrioritized = 3
+    kAsync = 4
+
+
+def _load():
+    global _LIB, _TRAMPOLINE
+    if _LIB is not None:
+        return _LIB or None  # False (cached failure) -> None
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "libmxtpu.so")
+    if not os.path.exists(path):
+        _LIB = False
+        return None
+    lib = ctypes.CDLL(path)
+    if not hasattr(lib, "mxtpu_engine_create"):
+        _LIB = False  # stale .so without engine symbols: don't re-dlopen
+        return None
+    u64 = ctypes.c_uint64
+    u64p = ctypes.POINTER(u64)
+    fnty = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+    lib.mxtpu_engine_create.restype = ctypes.c_void_p
+    lib.mxtpu_engine_create.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.mxtpu_engine_free.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_new_var.restype = u64
+    lib.mxtpu_engine_new_var.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_delete_var.argtypes = [ctypes.c_void_p, u64]
+    lib.mxtpu_engine_push.restype = ctypes.c_int
+    lib.mxtpu_engine_push.argtypes = [
+        ctypes.c_void_p, fnty, ctypes.c_void_p, u64p, ctypes.c_int, u64p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.mxtpu_engine_wait_for_var.argtypes = [ctypes.c_void_p, u64]
+    lib.mxtpu_engine_wait_for_all.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_num_pending.restype = ctypes.c_long
+    lib.mxtpu_engine_num_pending.argtypes = [ctypes.c_void_p]
+
+    lib.mxtpu_storage_create.restype = ctypes.c_void_p
+    lib.mxtpu_storage_create.argtypes = [ctypes.c_double]
+    lib.mxtpu_storage_destroy.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_storage_alloc.restype = ctypes.c_void_p
+    lib.mxtpu_storage_alloc.argtypes = [ctypes.c_void_p, u64]
+    lib.mxtpu_storage_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.mxtpu_storage_direct_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.mxtpu_storage_release_all.argtypes = [ctypes.c_void_p]
+    for sym in ("pool_bytes", "used_bytes", "num_allocs", "pool_hits"):
+        f = getattr(lib, "mxtpu_storage_" + sym)
+        f.restype = ctypes.c_long
+        f.argtypes = [ctypes.c_void_p]
+
+    # One global trampoline: C passes back a token identifying the python
+    # closure. ctypes acquires the GIL for the callback, so closures run
+    # safely on the C++ worker threads (the reference runs its closures on
+    # engine worker threads the same way).
+    def _tramp(token):
+        fn = None
+        with _CLOSURES_LOCK:
+            fn = _CLOSURES.pop(token, None)
+        if fn is not None:
+            try:
+                fn()
+            except Exception:  # an engine closure must never unwind into C++
+                import traceback
+                traceback.print_exc()
+
+    _TRAMPOLINE = fnty(_tramp)
+    _LIB = lib
+    return lib
+
+
+_CLOSURES = {}
+_CLOSURES_LOCK = threading.Lock()
+_NEXT_TOKEN = [1]
+
+
+def lib_available() -> bool:
+    return _load() is not None
+
+
+class NativeEngine:
+    """The C++ dependency engine (reference Engine, include/mxnet/engine.h:74-223)."""
+
+    def __init__(self, num_workers: Optional[int] = None,
+                 num_prio_workers: Optional[int] = None):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libmxtpu.so with engine symbols not found; "
+                               "run `make` at the repo root")
+        if num_workers is None:
+            num_workers = int(get_env("MXNET_CPU_WORKER_NTHREADS", "4"))
+        if num_prio_workers is None:
+            num_prio_workers = int(get_env("MXNET_CPU_PRIORITY_NTHREADS", "2"))
+        self._lib = lib
+        self._h = lib.mxtpu_engine_create(num_workers, num_prio_workers)
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and self._lib:
+            self._lib.mxtpu_engine_free(h)
+
+    # -- vars ---------------------------------------------------------------
+    def new_var(self) -> VarHandle:
+        return VarHandle(self._lib.mxtpu_engine_new_var(self._h))
+
+    def delete_var(self, var: int) -> None:
+        self._lib.mxtpu_engine_delete_var(self._h, var)
+
+    # -- push ---------------------------------------------------------------
+    def push(self, fn: Callable[[], None],
+             const_vars: Sequence[int] = (),
+             mutable_vars: Sequence[int] = (),
+             prop: int = FnProperty.kNormal,
+             priority: int = 0) -> None:
+        """PushAsync (reference engine.h:129): run fn on a worker thread once
+        every const/mutable dependency is satisfied. Raises on duplicate vars
+        (reference CheckDuplicate aborts; we raise)."""
+        with _CLOSURES_LOCK:
+            token = _NEXT_TOKEN[0]
+            _NEXT_TOKEN[0] += 1
+            _CLOSURES[token] = fn
+        nc, nm = len(const_vars), len(mutable_vars)
+        cv = (ctypes.c_uint64 * max(nc, 1))(*const_vars)
+        mv = (ctypes.c_uint64 * max(nm, 1))(*mutable_vars)
+        rc = self._lib.mxtpu_engine_push(
+            self._h, _TRAMPOLINE, ctypes.c_void_p(token), cv, nc, mv, nm,
+            prop, priority)
+        if rc != 0:
+            with _CLOSURES_LOCK:
+                _CLOSURES.pop(token, None)
+            raise ValueError("engine push rejected: duplicate or deleted vars")
+
+    # -- waits --------------------------------------------------------------
+    def wait_for_var(self, var: int) -> None:
+        self._lib.mxtpu_engine_wait_for_var(self._h, var)
+
+    def wait_for_all(self) -> None:
+        self._lib.mxtpu_engine_wait_for_all(self._h)
+
+    def num_pending(self) -> int:
+        return self._lib.mxtpu_engine_num_pending(self._h)
+
+
+class NativeStorage:
+    """Pooled host storage manager (reference pooled_storage_manager.h:23-47).
+
+    MXNET_EXEC_MATCH_RANGE bounds how much larger a recycled block may be
+    than the request (reference graph_memory_allocator.h match_range_).
+    """
+
+    def __init__(self, match_range: Optional[float] = None):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libmxtpu.so with storage symbols not found")
+        if match_range is None:
+            match_range = float(get_env("MXNET_EXEC_MATCH_RANGE", "16"))
+        self._lib = lib
+        self._h = lib.mxtpu_storage_create(float(match_range))
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h and self._lib:
+            self._lib.mxtpu_storage_destroy(h)
+
+    def alloc(self, size: int) -> int:
+        p = self._lib.mxtpu_storage_alloc(self._h, size)
+        if not p:
+            raise MemoryError("native storage alloc of %d bytes failed" % size)
+        return p
+
+    def free(self, ptr: int) -> None:
+        self._lib.mxtpu_storage_free(self._h, ctypes.c_void_p(ptr))
+
+    def direct_free(self, ptr: int) -> None:
+        self._lib.mxtpu_storage_direct_free(self._h, ctypes.c_void_p(ptr))
+
+    def release_all(self) -> None:
+        self._lib.mxtpu_storage_release_all(self._h)
+
+    @property
+    def pool_bytes(self) -> int:
+        return self._lib.mxtpu_storage_pool_bytes(self._h)
+
+    @property
+    def used_bytes(self) -> int:
+        return self._lib.mxtpu_storage_used_bytes(self._h)
+
+    @property
+    def num_allocs(self) -> int:
+        return self._lib.mxtpu_storage_num_allocs(self._h)
+
+    @property
+    def pool_hits(self) -> int:
+        return self._lib.mxtpu_storage_pool_hits(self._h)

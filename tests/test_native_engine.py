@@ -1,0 +1,292 @@
+"""Native dependency-engine + storage tests.
+
+Mirror of the reference's C++ suites: tests/cpp/threaded_engine_test.cc
+(randomized dependency workloads pushed to the engine, checking completion
+and ordering) and tests/cpp/storage_test.cc (alloc/free reuse assertions) —
+driven from python through the ctypes ABI like every other native component.
+"""
+import random
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu import native_engine
+from mxnet_tpu.engine import engine
+
+pytestmark = pytest.mark.skipif(
+    not native_engine.lib_available(), reason="libmxtpu.so not built")
+
+
+def make_engine():
+    return native_engine.NativeEngine(num_workers=4, num_prio_workers=2)
+
+
+def test_basic_completion():
+    e = make_engine()
+    v = e.new_var()
+    out = []
+    e.push(lambda: out.append(1), mutable_vars=[v])
+    e.wait_for_all()
+    assert out == [1]
+    assert e.num_pending() == 0
+
+
+def test_writes_serialize():
+    """Writes to one var run in push order even across 4 worker threads
+    (reference ThreadedVar pending_write_ queue, threaded_engine.h:132-160)."""
+    e = make_engine()
+    v = e.new_var()
+    log = []
+    n = 200
+    for i in range(n):
+        e.push(lambda i=i: log.append(i), mutable_vars=[v])
+    e.wait_for_all()
+    assert log == list(range(n))
+
+
+def test_reads_batch_between_writes():
+    """Reads between two writes run concurrently; a write waits for all
+    prior reads (threaded_engine.h:95-160)."""
+    e = make_engine()
+    v = e.new_var()
+    state = {"val": 0}
+    seen = []
+    lock = threading.Lock()
+
+    e.push(lambda: state.__setitem__("val", 1), mutable_vars=[v])
+    for _ in range(8):
+        def read():
+            with lock:
+                seen.append(state["val"])
+        e.push(read, const_vars=[v])
+    e.push(lambda: state.__setitem__("val", 2), mutable_vars=[v])
+    e.push(lambda: seen.append(state["val"]), const_vars=[v])
+    e.wait_for_all()
+    assert seen[:8] == [1] * 8   # all reads saw the first write, not the 2nd
+    assert seen[8] == 2
+
+
+def test_random_dependency_workload():
+    """Reference threaded_engine_test.cc workload: random ops over random
+    var subsets; writes serialized per var => per-var counters match."""
+    rng = random.Random(0)
+    e = make_engine()
+    nvars = 10
+    vars_ = [e.new_var() for _ in range(nvars)]
+    counters = [0] * nvars
+
+    def bump(idxs):
+        # non-atomic read-modify-write: only correct if the engine truly
+        # serializes writers per var
+        for i in idxs:
+            cur = counters[i]
+            time.sleep(0)  # encourage interleaving if serialization is broken
+            counters[i] = cur + 1
+
+    expected = [0] * nvars
+    for _ in range(300):
+        k = rng.randint(1, 4)
+        idxs = rng.sample(range(nvars), k)
+        for i in idxs:
+            expected[i] += 1
+        e.push(lambda idxs=tuple(idxs): bump(idxs),
+               mutable_vars=[vars_[i] for i in idxs])
+    e.wait_for_all()
+    assert counters == expected
+
+
+def test_wait_for_var_waits_for_writes():
+    e = make_engine()
+    v = e.new_var()
+    out = []
+
+    def slow_write():
+        time.sleep(0.05)
+        out.append("w")
+
+    e.push(slow_write, mutable_vars=[v])
+    e.wait_for_var(v)
+    assert out == ["w"]
+
+
+def test_duplicate_vars_rejected():
+    """Reference CheckDuplicate (threaded_engine.cc:205-237)."""
+    e = make_engine()
+    v = e.new_var()
+    with pytest.raises(ValueError):
+        e.push(lambda: None, mutable_vars=[v, v])
+    with pytest.raises(ValueError):
+        e.push(lambda: None, const_vars=[v], mutable_vars=[v])
+    with pytest.raises(ValueError):
+        e.push(lambda: None, const_vars=[v, v], mutable_vars=[])
+    e.wait_for_all()
+
+
+def test_delete_var_after_pending():
+    """DeleteVariable: pending ops on the var still run; new pushes fail."""
+    e = make_engine()
+    v = e.new_var()
+    out = []
+    e.push(lambda: (time.sleep(0.02), out.append(1)), mutable_vars=[v])
+    e.delete_var(v)
+    e.wait_for_all()
+    assert out == [1]
+    with pytest.raises(ValueError):
+        e.push(lambda: None, mutable_vars=[v])
+
+
+def test_priority_ops_run():
+    e = make_engine()
+    done = []
+    vs = [e.new_var() for _ in range(20)]
+    for i, v in enumerate(vs):
+        e.push(lambda i=i: done.append(i), mutable_vars=[v],
+               prop=native_engine.FnProperty.kPrioritized, priority=i)
+    e.wait_for_all()
+    assert sorted(done) == list(range(20))
+
+
+def test_async_prop_runs_inline_when_ready():
+    e = make_engine()
+    v = e.new_var()
+    tid = []
+    e.push(lambda: tid.append(threading.get_ident()), mutable_vars=[v],
+           prop=native_engine.FnProperty.kAsync)
+    e.wait_for_all()
+    # ready at push time -> executed on the pushing (this) thread
+    assert tid == [threading.get_ident()]
+
+
+def test_facade_routes_host_closures():
+    """mx engine facade: pushes with vars go through the native engine."""
+    eng = engine()
+    if eng.native is None:
+        pytest.skip("native engine unavailable")
+    v = eng.new_var()
+    order = []
+    for i in range(50):
+        eng.push(lambda i=i: order.append(i), mutable_vars=[v])
+    eng.wait_for_var(v)
+    eng.wait_for_all()
+    assert order == list(range(50))
+    eng.delete_var(v)
+
+
+# ---- storage ---------------------------------------------------------------
+
+def test_storage_alloc_free_reuse():
+    """Reference tests/cpp/storage_test.cc: a freed block is recycled."""
+    s = native_engine.NativeStorage(match_range=16)
+    p1 = s.alloc(1 << 20)
+    assert s.used_bytes >= 1 << 20
+    s.free(p1)
+    assert s.pool_bytes >= 1 << 20
+    p2 = s.alloc(1 << 20)
+    assert p2 == p1          # exact-size pool hit
+    assert s.pool_hits == 1
+    s.free(p2)
+    s.release_all()
+    assert s.pool_bytes == 0
+
+
+def test_storage_match_range():
+    s = native_engine.NativeStorage(match_range=2)
+    p1 = s.alloc(1000)
+    s.free(p1)
+    p2 = s.alloc(600)        # 1000 <= 600*2 -> reuse
+    assert p2 == p1
+    s.free(p2)
+    p3 = s.alloc(100)        # 1000 > 100*2 -> fresh block
+    assert p3 != p1
+    s.free(p3)
+    s.release_all()
+
+
+def test_storage_direct_free():
+    s = native_engine.NativeStorage()
+    p = s.alloc(4096)
+    s.direct_free(p)
+    assert s.pool_bytes == 0
+    assert s.used_bytes == 0
+
+
+def test_storage_writable():
+    import ctypes
+    s = native_engine.NativeStorage()
+    n = 1 << 16
+    p = s.alloc(n)
+    buf = (ctypes.c_ubyte * n).from_address(p)
+    buf[0] = 7
+    buf[n - 1] = 9
+    assert buf[0] == 7 and buf[n - 1] == 9
+    s.free(p)
+
+
+def test_storage_double_free_is_noop():
+    s = native_engine.NativeStorage()
+    p = s.alloc(1024)
+    s.free(p)
+    pool = s.pool_bytes
+    s.free(p)                # second free must not duplicate the pool entry
+    assert s.pool_bytes == pool
+    q = s.alloc(1024)
+    r = s.alloc(1024)
+    assert q != r            # the block was handed out once, not twice
+    s.free(q); s.free(r)
+    s.release_all()
+
+
+def test_storage_direct_free_pooled_block():
+    s = native_engine.NativeStorage()
+    p = s.alloc(2048)
+    s.free(p)                # now in pool
+    s.direct_free(p)         # must remove the pool entry too
+    assert s.pool_bytes == 0
+    q = s.alloc(2048)        # must NOT hand back the freed pointer's entry
+    s.free(q)
+    s.release_all()
+
+
+def test_concurrent_push_delete_no_crash():
+    """Use-after-free regression: pushes genuinely racing delete_var."""
+    e = make_engine()
+    start = threading.Barrier(2)
+
+    def deleter(v):
+        start.wait()
+        e.delete_var(v)
+
+    for _ in range(200):
+        v = e.new_var()
+        t = threading.Thread(target=deleter, args=(v,))
+        t.start()
+        start.wait()  # both threads released together: push races delete
+        try:
+            e.push(lambda: None, mutable_vars=[v])
+        except ValueError:
+            pass  # delete won the race: rejected push is the correct outcome
+        t.join()
+    e.wait_for_all()
+
+
+def test_wait_for_var_after_delete_blocks_on_inflight():
+    """WaitForVar on a deleted var must not return before its ops finish."""
+    e = make_engine()
+    v = e.new_var()
+    out = []
+    e.push(lambda: (time.sleep(0.05), out.append("w")), mutable_vars=[v])
+    e.delete_var(v)
+    e.wait_for_var(v)  # falls back to a full drain
+    assert out == ["w"]
+
+
+def test_normal_negative_priority_keeps_fifo_order():
+    """A kNormal op with negative priority must not jump the FIFO."""
+    e = native_engine.NativeEngine(num_workers=1, num_prio_workers=0)
+    v = e.new_var()
+    order = []
+    for i in range(10):
+        e.push(lambda i=i: order.append(i), mutable_vars=[v], priority=-i)
+    e.wait_for_all()
+    assert order == list(range(10))
