@@ -1,0 +1,82 @@
+"""Adversarial examples by fast gradient sign (reference example/adversary/
+adversary_generation.ipynb capability).
+
+Trains a small convnet, then binds an executor with inputs_need_grad so the
+loss gradient flows back to the *data*, and perturbs inputs by
+``eps * sign(dL/dx)`` — the accuracy collapse is printed.  On TPU the
+data-gradient is just one more output of the same fused XLA train program.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_lenet
+
+
+def synthetic_digits(n, seed=0):
+    """Blob-per-class images, linearly separable enough to train quickly.
+    Class prototypes are fixed; `seed` only varies the noise/labels."""
+    protos = np.random.RandomState(12345).rand(10, 1, 28, 28).astype(
+        np.float32)
+    rng = np.random.RandomState(seed)
+    label = rng.randint(0, 10, size=n)
+    data = protos[label] + 0.3 * rng.randn(n, 1, 28, 28).astype(np.float32)
+    return data.astype(np.float32), label.astype(np.float32)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tpus", type=str)
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--epsilon", type=float, default=0.3)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else [mx.cpu()]
+
+    data, label = synthetic_digits(2000)
+    train = mx.io.NDArrayIter(data, label, batch_size=args.batch_size,
+                              shuffle=True)
+    net = get_lenet()
+    mod = mx.mod.Module(net, context=ctx)
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+
+    # re-bind for attack: gradients w.r.t. the input images
+    atk = mx.mod.Module(net, context=ctx)
+    atk.bind(data_shapes=[("data", (args.batch_size, 1, 28, 28))],
+             label_shapes=[("softmax_label", (args.batch_size,))],
+             for_training=True, inputs_need_grad=True)
+    arg_params, aux_params = mod.get_params()
+    atk.set_params(arg_params, aux_params)
+
+    test_data, test_label = synthetic_digits(args.batch_size, seed=1)
+    batch = mx.io.DataBatch(data=[mx.nd.array(test_data)],
+                            label=[mx.nd.array(test_label)])
+    atk.forward(batch, is_train=True)
+    clean_pred = atk.get_outputs()[0].asnumpy().argmax(axis=1)
+    atk.backward()
+    grad = atk.get_input_grads()[0].asnumpy()
+
+    adv = test_data + args.epsilon * np.sign(grad)
+    atk.forward(mx.io.DataBatch(data=[mx.nd.array(adv)],
+                                label=[mx.nd.array(test_label)]),
+                is_train=False)
+    adv_pred = atk.get_outputs()[0].asnumpy().argmax(axis=1)
+
+    clean_acc = float((clean_pred == test_label).mean())
+    adv_acc = float((adv_pred == test_label).mean())
+    print("clean accuracy:       %.3f" % clean_acc)
+    print("adversarial accuracy: %.3f (eps=%.2f)" % (adv_acc, args.epsilon))
+    assert adv_acc <= clean_acc, "FGSM should not improve accuracy"
+
+
+if __name__ == "__main__":
+    main()

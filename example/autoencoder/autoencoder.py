@@ -1,0 +1,90 @@
+"""Stacked autoencoder with layerwise pretraining + finetuning (reference
+example/autoencoder/{autoencoder.py,model.py} capability).
+
+Each layer is pretrained as a 1-hidden-layer denoising AE, then the full
+stack is finetuned end-to-end with LinearRegressionOutput reconstruction
+loss.  Every stage is one fused XLA program.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def ae_symbol(dims, noise=0.2):
+    """Encoder dims[0]->dims[-1] and mirrored decoder, reconstruction loss.
+    Layer names are depth-stable (enc_i / dec_i maps dims[i]<->dims[i+1])
+    so pretrained weights carry over when the stack grows."""
+    x = mx.sym.Variable("data")
+    net = mx.sym.Dropout(x, p=noise) if noise > 0 else x
+    for i, d in enumerate(dims[1:]):
+        net = mx.sym.FullyConnected(net, num_hidden=d, name="enc_%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+    for j in reversed(range(len(dims) - 1)):
+        net = mx.sym.FullyConnected(net, num_hidden=dims[j],
+                                    name="dec_%d" % j)
+        if j > 0:
+            net = mx.sym.Activation(net, act_type="relu")
+    return mx.sym.LinearRegressionOutput(net, label=mx.sym.Variable(
+        "reconstruction_label"), name="rec")
+
+
+def train_ae(dims, data, ctx, batch_size, epochs, lr, noise,
+             arg_params=None):
+    it = mx.io.NDArrayIter(data, data.reshape(len(data), -1),
+                           batch_size=batch_size, shuffle=True,
+                           label_name="reconstruction_label")
+    mod = mx.mod.Module(ae_symbol(dims, noise), context=ctx,
+                        label_names=("reconstruction_label",))
+    mod.fit(it, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": lr}, eval_metric="mse",
+            arg_params=arg_params, allow_missing=True)
+    return mod
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tpus", type=str)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--pretrain-epochs", type=int, default=2)
+    parser.add_argument("--finetune-epochs", type=int, default=4)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else [mx.cpu()]
+
+    rng = np.random.RandomState(0)
+    basis = rng.rand(16, 784).astype(np.float32)
+    codes = rng.rand(4096, 16).astype(np.float32)
+    data = (codes @ basis) / 16.0          # low-rank "images"
+
+    dims = [784, 256, 64]
+    # layerwise pretraining: grow the stack one layer at a time, reusing
+    # the already-trained encoder/decoder weights (allow_missing binds them)
+    pretrained = None
+    for depth in range(2, len(dims) + 1):
+        mod = train_ae(dims[:depth], data, ctx, args.batch_size,
+                       args.pretrain_epochs, 1e-3, noise=0.2,
+                       arg_params=pretrained)
+        pretrained, _ = mod.get_params()
+        logging.info("pretrained stack depth %d", depth - 1)
+
+    # finetune the full stack without input noise
+    mod = train_ae(dims, data, ctx, args.batch_size, args.finetune_epochs,
+                   1e-3, noise=0.0, arg_params=pretrained)
+
+    it = mx.io.NDArrayIter(data[:512], data[:512].reshape(512, -1),
+                           batch_size=args.batch_size,
+                           label_name="reconstruction_label")
+    mse = mx.metric.MSE()
+    mod.score(it, mse)
+    print("final reconstruction MSE: %.5f" % mse.get()[1])
+
+
+if __name__ == "__main__":
+    main()

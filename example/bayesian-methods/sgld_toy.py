@@ -1,0 +1,70 @@
+"""Stochastic Gradient Langevin Dynamics demo (reference
+example/bayesian-methods/{sgld.ipynb,algos.py} capability).
+
+Samples from the posterior of a 2-parameter Gaussian-mixture toy problem
+(Welling & Teh 2011's running example) with the built-in SGLD optimizer and
+checks the posterior mean; the injected Gaussian noise comes from the
+framework RNG so runs are seed-reproducible.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-samples", type=int, default=2000)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-steps", type=int, default=3000)
+    parser.add_argument("--lr", type=float, default=1e-2)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(42)
+
+    # data ~ N(theta, 1) with true theta = 1.5; prior theta ~ N(0, 10)
+    true_theta = 1.5
+    rng = np.random.RandomState(0)
+    data = (true_theta + rng.randn(args.num_samples)).astype(np.float32)
+
+    x = mx.sym.Variable("x")
+    theta = mx.sym.Variable("theta")
+    # negative log joint (up to const): theta^2/(2*10) + sum (x-theta)^2/2
+    # scaled so grad matches a minibatch estimate of the full dataset
+    diff = mx.sym.broadcast_minus(x, theta)
+    loss = mx.sym.MakeLoss(
+        mx.sym.sum(diff * diff) * (args.num_samples /
+                                   (2.0 * args.batch_size))
+        + mx.sym.sum(theta * theta) * (1.0 / 20.0))
+
+    exe = loss.simple_bind(ctx=mx.cpu(), grad_req="write",
+                           x=(args.batch_size,), theta=(1,))
+    exe.arg_dict["theta"][:] = 0.0
+
+    opt = mx.optimizer.SGLD(learning_rate=args.lr / args.num_samples,
+                            rescale_grad=1.0)
+    state = opt.create_state(0, exe.arg_dict["theta"])
+    samples = []
+    for step in range(args.num_steps):
+        idx = rng.randint(0, args.num_samples, size=args.batch_size)
+        exe.arg_dict["x"][:] = data[idx]
+        exe.forward(is_train=True)
+        exe.backward()
+        opt.update(0, exe.arg_dict["theta"], exe.grad_dict["theta"], state)
+        if step > args.num_steps // 2:          # burn-in discard
+            samples.append(float(exe.arg_dict["theta"].asnumpy()[0]))
+
+    post_mean = float(np.mean(samples))
+    post_std = float(np.std(samples))
+    print("posterior mean %.3f (true %.3f), std %.4f over %d samples"
+          % (post_mean, true_theta, post_std, len(samples)))
+    assert abs(post_mean - true_theta) < 0.25
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""CNN for sentence classification, Kim 2014 style (reference
+example/cnn_text_classification/text_cnn.py capability).
+
+Embedding -> parallel Convolutions with filter widths 3/4/5 over the token
+axis -> max-pool-over-time -> Concat -> Dropout -> softmax.  All filter
+branches fuse into one XLA program; the embedding lookup is a gather that
+XLA lays out for the MXU-fed convs.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def text_cnn(vocab_size, num_embed, seq_len, filter_sizes=(3, 4, 5),
+             num_filter=64, num_classes=2, dropout=0.5):
+    data = mx.sym.Variable("data")            # (batch, seq_len) token ids
+    embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    # (batch, 1, seq_len, num_embed) "image" for 2-D convolution
+    conv_input = mx.sym.Reshape(embed, shape=(-1, 1, seq_len, num_embed))
+    pooled = []
+    for width in filter_sizes:
+        conv = mx.sym.Convolution(conv_input, kernel=(width, num_embed),
+                                  num_filter=num_filter,
+                                  name="conv%d" % width)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pool = mx.sym.Pooling(act, pool_type="max",
+                              kernel=(seq_len - width + 1, 1),
+                              name="pool%d" % width)
+        pooled.append(pool)
+    concat = mx.sym.Concat(*pooled, dim=1)
+    flat = mx.sym.Flatten(concat)
+    if dropout > 0:
+        flat = mx.sym.Dropout(flat, p=dropout)
+    fc = mx.sym.FullyConnected(flat, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def synthetic_sentences(n, vocab_size, seq_len, seed=0):
+    """Positive sentences contain tokens from the top half of the vocab."""
+    rng = np.random.RandomState(seed)
+    label = rng.randint(0, 2, size=n)
+    lo = (vocab_size // 2) * label            # 0 or V/2
+    data = rng.randint(0, vocab_size // 2, size=(n, seq_len)) + lo[:, None]
+    return data.astype(np.float32), label.astype(np.float32)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=50)
+    parser.add_argument("--num-epochs", type=int, default=4)
+    parser.add_argument("--vocab-size", type=int, default=1000)
+    parser.add_argument("--seq-len", type=int, default=32)
+    parser.add_argument("--num-embed", type=int, default=64)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data, label = synthetic_sentences(2000, args.vocab_size, args.seq_len)
+    train = mx.io.NDArrayIter(data, label, batch_size=args.batch_size,
+                              shuffle=True)
+    net = text_cnn(args.vocab_size, args.num_embed, args.seq_len)
+    mod = mx.mod.Module(net, context=[mx.cpu()])
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-3})
+
+    train.reset()
+    acc = mx.metric.Accuracy()
+    mod.score(train, acc)
+    print("text-cnn accuracy: %.3f" % acc.get()[1])
+    assert acc.get()[1] > 0.9
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,177 @@
+"""Deep Embedded Clustering (reference example/dec/dec.py capability).
+
+Pretrains a stacked autoencoder, initializes cluster centroids with a small
+built-in k-means (no sklearn dependency), then refines encoder + centroids
+by minimizing KL(P || Q) of the Student-t soft assignments — the DEC
+objective — as a MakeLoss graph, all in one fused XLA program per step.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def encoder_symbol(dims):
+    net = mx.sym.Variable("data")
+    for i, d in enumerate(dims[1:]):
+        net = mx.sym.FullyConnected(net, num_hidden=d, name="enc_%d" % i)
+        if i < len(dims) - 2:
+            net = mx.sym.Activation(net, act_type="relu")
+    return net
+
+
+def ae_symbol(dims):
+    net = encoder_symbol(dims)
+    for i, d in enumerate(reversed(dims[:-1])):
+        net = mx.sym.FullyConnected(net, num_hidden=d, name="dec_%d" % i)
+        if i < len(dims) - 2:
+            net = mx.sym.Activation(net, act_type="relu")
+    return mx.sym.LinearRegressionOutput(
+        net, label=mx.sym.Variable("rec_label"), name="rec")
+
+
+def kmeans(z, k, iters=20, restarts=4, seed=0):
+    """Lloyd's with k-means++ seeding, best of `restarts` by inertia."""
+    rng = np.random.RandomState(seed)
+    best = None
+    for _ in range(restarts):
+        centers = [z[rng.randint(len(z))]]
+        for _ in range(k - 1):
+            d2 = np.min(((z[:, None, :] - np.asarray(centers)[None]) ** 2
+                         ).sum(-1), axis=1)
+            centers.append(z[rng.choice(len(z), p=d2 / d2.sum())])
+        centers = np.asarray(centers)
+        for _ in range(iters):
+            assign = ((z[:, None, :] - centers[None]) ** 2).sum(-1).argmin(1)
+            for j in range(k):
+                pts = z[assign == j]
+                if len(pts):
+                    centers[j] = pts.mean(axis=0)
+        inertia = ((z - centers[assign]) ** 2).sum()
+        if best is None or inertia < best[0]:
+            best = (inertia, centers, assign)
+    return best[1], best[2]
+
+
+def dec_symbol(dims, num_cluster, alpha=1.0):
+    """Student-t soft assignment + KL(P||Q) self-training loss."""
+    z = encoder_symbol(dims)                       # (batch, latent)
+    mu = mx.sym.Variable("centroids")              # (k, latent)
+    p = mx.sym.Variable("target_p")                # (batch, k) fixed target
+    # q_ij ~ (1 + |z_i - mu_j|^2 / alpha)^-(alpha+1)/2, row-normalized
+    zz = mx.sym.Reshape(z, shape=(-1, 1, dims[-1]))
+    mu3 = mx.sym.Reshape(mu, shape=(1, num_cluster, dims[-1]))
+    diff = mx.sym.broadcast_minus(zz, mu3)
+    dist = mx.sym.sum_axis(diff * diff, axis=2)    # (batch, k)
+    qu = (1.0 + dist * (1.0 / alpha)) ** (-(alpha + 1.0) / 2.0)
+    q = mx.sym.broadcast_div(qu, mx.sym.sum_axis(qu, axis=1, keepdims=True))
+    kl = mx.sym.sum(p * (mx.sym.log(p + 1e-10) - mx.sym.log(q + 1e-10)))
+    group = mx.sym.Group([mx.sym.MakeLoss(kl), mx.sym.BlockGrad(q)])
+    return group
+
+
+def target_distribution(q):
+    w = (q ** 2) / q.sum(axis=0, keepdims=True)
+    return w / w.sum(axis=1, keepdims=True)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-cluster", type=int, default=4)
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--pretrain-epochs", type=int, default=6)
+    parser.add_argument("--dec-iters", type=int, default=60)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    # well-separated gaussian clusters in 64-d, projected to 784-d
+    rng = np.random.RandomState(0)
+    k = args.num_cluster
+    proj = rng.randn(64, 784).astype(np.float32) / 8.0
+    means = rng.randn(k, 64).astype(np.float32) * 4.0
+    truth = rng.randint(0, k, size=4096)
+    data = ((means[truth] + rng.randn(4096, 64).astype(np.float32)) @ proj)
+
+    dims = [784, 256, 10]
+    ae = mx.mod.Module(ae_symbol(dims), context=[mx.cpu()],
+                       label_names=("rec_label",))
+    it = mx.io.NDArrayIter(data, data, batch_size=args.batch_size,
+                           shuffle=True, label_name="rec_label")
+    ae.fit(it, num_epoch=args.pretrain_epochs, optimizer="adam",
+           optimizer_params={"learning_rate": 1e-3}, eval_metric="mse")
+    ae_args, _ = ae.get_params()
+
+    # embed all data, init centroids by k-means
+    enc = encoder_symbol(dims)
+    enc_exe = enc.simple_bind(ctx=mx.cpu(), grad_req="null",
+                              data=(len(data), 784))
+    for nm, arr in ae_args.items():
+        if nm in enc_exe.arg_dict:
+            enc_exe.arg_dict[nm][:] = arr.asnumpy()
+    enc_exe.arg_dict["data"][:] = data
+    enc_exe.forward(is_train=False)
+    z = enc_exe.outputs[0].asnumpy()
+    centers, assign = kmeans(z, k)
+
+    # DEC refinement
+    dec = dec_symbol(dims, k)
+    exe = dec.simple_bind(ctx=mx.cpu(), grad_req="write",
+                          data=(args.batch_size, 784),
+                          centroids=(k, dims[-1]),
+                          target_p=(args.batch_size, k))
+    for nm, arr in ae_args.items():
+        if nm in exe.arg_dict:
+            exe.arg_dict[nm][:] = arr.asnumpy()
+    exe.arg_dict["centroids"][:] = centers
+    opt = mx.optimizer.SGD(learning_rate=0.01, momentum=0.9,
+                           rescale_grad=1.0 / args.batch_size)
+    states = {nm: opt.create_state(i, exe.arg_dict[nm])
+              for i, nm in enumerate(exe.grad_dict)}
+    for it_i in range(args.dec_iters):
+        idx = rng.randint(0, len(data), size=args.batch_size)
+        exe.arg_dict["data"][:] = data[idx]
+        exe.forward(is_train=True)
+        q = exe.outputs[1].asnumpy()
+        exe.arg_dict["target_p"][:] = target_distribution(q)
+        exe.forward(is_train=True)
+        exe.backward()
+        for i, nm in enumerate(exe.grad_dict):
+            if nm in ("data", "target_p"):
+                continue
+            opt.update(i, exe.arg_dict[nm], exe.grad_dict[nm], states[nm])
+
+    # final cluster accuracy (best label permutation via greedy matching)
+    enc_exe2 = enc.simple_bind(ctx=mx.cpu(), grad_req="null",
+                               data=(len(data), 784))
+    for nm in enc_exe2.arg_dict:
+        if nm != "data":
+            enc_exe2.arg_dict[nm][:] = exe.arg_dict[nm].asnumpy()
+    enc_exe2.arg_dict["data"][:] = data
+    enc_exe2.forward(is_train=False)
+    z2 = enc_exe2.outputs[0].asnumpy()
+    dist = ((z2[:, None, :] - exe.arg_dict["centroids"].asnumpy()[None]) ** 2
+            ).sum(-1)
+    pred = dist.argmin(1)
+    # greedy cluster->class matching
+    acc = 0
+    used = set()
+    for c in range(k):
+        best, best_n = -1, -1
+        for t in range(k):
+            if t in used:
+                continue
+            n = int(((pred == c) & (truth == t)).sum())
+            if n > best_n:
+                best, best_n = t, n
+        used.add(best)
+        acc += best_n
+    print("cluster accuracy: %.3f" % (acc / len(data)))
+
+
+if __name__ == "__main__":
+    main()

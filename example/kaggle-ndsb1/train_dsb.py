@@ -1,0 +1,69 @@
+"""National Data Science Bowl plankton classification (reference
+example/kaggle-ndsb1/{train_dsb.py,symbol_dsb.py,gen_img_list.py}
+capability): pack images with bin/im2rec or tools/im2rec.py, train the
+small conv net on ImageRecordIter with train/val split by list files.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def get_dsb_net(num_classes=121):
+    """The reference symbol_dsb.py conv net (fresh implementation)."""
+    data = mx.sym.Variable("data")
+    net = data
+    for i, (nf, k) in enumerate([(32, 5), (64, 3), (128, 3)]):
+        net = mx.sym.Convolution(net, num_filter=nf, kernel=(k, k),
+                                 pad=(k // 2, k // 2), name="conv%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                             stride=(2, 2), name="pool%d" % i)
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=512, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-dir", type=str, default="dsb/")
+    parser.add_argument("--train-rec", type=str, default="tr.rec")
+    parser.add_argument("--val-rec", type=str, default="va.rec")
+    parser.add_argument("--num-classes", type=int, default=121)
+    parser.add_argument("--image-size", type=int, default=48)
+    parser.add_argument("--tpus", type=str)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-epochs", type=int, default=30)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--model-prefix", type=str, default="dsb")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else [mx.cpu()]
+    shape = (3, args.image_size, args.image_size)
+
+    train = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(args.data_dir, args.train_rec),
+        data_shape=shape, batch_size=args.batch_size, shuffle=True,
+        rand_crop=True, rand_mirror=True)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(args.data_dir, args.val_rec),
+        data_shape=shape, batch_size=args.batch_size)
+
+    mod = mx.mod.Module(get_dsb_net(args.num_classes), context=ctx)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-4},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+            epoch_end_callback=mx.callback.do_checkpoint(args.model_prefix))
+
+
+if __name__ == "__main__":
+    main()

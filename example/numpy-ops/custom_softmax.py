@@ -1,0 +1,123 @@
+"""Custom python operators in a compiled graph (reference
+example/numpy-ops/{custom_softmax.py,numpy_softmax.py} capability).
+
+Shows all two user-facing generations:
+  * NumpyOp  — numpy forward/backward, bridged into XLA via pure_callback
+  * CustomOp — registered prop, used as mx.sym.Custom(op_type=...)
+Both define softmax + its cross-entropy gradient by hand and train an MLP.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.NumpyOp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        output_shape = in_shape[0]
+        return [data_shape, label_shape], [output_shape]
+
+    def forward(self, in_data, out_data):
+        x = in_data[0]
+        y = out_data[0]
+        y[:] = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        l = in_data[1].astype(int)
+        y = out_data[0]
+        dx = in_grad[0]
+        dx[:] = y
+        dx[np.arange(l.shape[0]), l] -= 1.0
+
+
+@mx.operator.register("custom_softmax")
+class CustomSoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], [in_shape[0][0]]], [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomSoftmaxOp()
+
+
+class CustomSoftmaxOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().astype(int)
+        y = out_data[0].asnumpy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+def build_net(flavor):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="fc2")
+    if flavor == "numpy":
+        return NumpySoftmax().get_symbol(data=fc2, label=label,
+                                         name="softmax")
+    return mx.sym.Custom(fc2, label, op_type="custom_softmax",
+                         name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--flavor", choices=["numpy", "custom"],
+                        default="numpy")
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(50, 10).astype(np.float32)
+    x = rng.randn(2000, 50).astype(np.float32)
+    y = (x @ w).argmax(axis=1).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True)
+
+    net = build_net(args.flavor)
+    mod = mx.mod.Module(net, context=[mx.cpu()])
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+
+    train.reset()
+    acc = mx.metric.Accuracy()
+    mod.score(train, acc)
+    print("%s softmax final accuracy: %.3f" % (args.flavor, acc.get()[1]))
+    assert acc.get()[1] > 0.8
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Writing a custom DataIter (reference example/python-howto/data_iter.py)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+class SimpleIter(mx.io.DataIter):
+    """Generates batches from a python generator function."""
+
+    def __init__(self, data_shapes, label_shapes, num_batches=10):
+        super().__init__()
+        self._provide_data = data_shapes
+        self._provide_label = label_shapes
+        self.num_batches = num_batches
+        self.cur = 0
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= self.num_batches:
+            raise StopIteration
+        self.cur += 1
+        data = [mx.nd.array(np.random.rand(*shape))
+                for _, shape in self._provide_data]
+        label = [mx.nd.array(np.random.randint(0, 10, shape).astype(np.float32))
+                 for _, shape in self._provide_label]
+        return mx.io.DataBatch(data=data, label=label)
+
+
+if __name__ == "__main__":
+    it = SimpleIter([("data", (32, 20))], [("softmax_label", (32,))])
+    for i, batch in enumerate(it):
+        print("batch", i, batch.data[0].shape, batch.label[0].shape)

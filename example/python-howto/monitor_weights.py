@@ -1,0 +1,28 @@
+"""Monitor per-op outputs during training (reference
+example/python-howto/monitor_weights.py)."""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+logging.basicConfig(level=logging.INFO)
+
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data, name="fc1", num_hidden=32)
+net = mx.sym.Activation(net, act_type="relu")
+net = mx.sym.FullyConnected(net, name="fc2", num_hidden=10)
+net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+rng = np.random.RandomState(0)
+x = rng.randn(500, 20).astype(np.float32)
+y = rng.randint(0, 10, size=500).astype(np.float32)
+train = mx.io.NDArrayIter(x, y, batch_size=50)
+
+mon = mx.monitor.Monitor(interval=2, pattern=".*fc.*")
+mod = mx.mod.Module(net, context=[mx.cpu()])
+mod.fit(train, num_epoch=1, monitor=mon,
+        optimizer_params={"learning_rate": 0.1})

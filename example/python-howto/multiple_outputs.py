@@ -1,0 +1,24 @@
+"""Grouping symbols to expose internal outputs (reference
+example/python-howto/multiple_outputs.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+data = mx.sym.Variable("data")
+fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+net = mx.sym.SoftmaxOutput(fc1, name="softmax")
+
+# expose an internal layer alongside the loss output
+out = mx.sym.Group([fc1, net])
+print("outputs:", out.list_outputs())
+
+exe = out.simple_bind(ctx=mx.cpu(), data=(10, 20), softmax_label=(10,))
+exe.forward(is_train=False)
+print("fc1 out shape:", exe.outputs[0].shape)
+print("softmax out shape:", exe.outputs[1].shape)
+
+# get_internals view of every reachable output
+internals = net.get_internals()
+print("internals:", internals.list_outputs()[:6], "...")

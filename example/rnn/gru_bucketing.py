@@ -1,0 +1,52 @@
+"""PTB GRU with bucketing (reference example/rnn/gru_bucketing.py
+capability).  Same BucketingModule flow as lstm_bucketing, GRU cells."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models.gru import gru_unroll
+from bucket_io import BucketSentenceIter, default_build_vocab
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data", type=str, default="ptb.train.txt")
+    parser.add_argument("--tpus", type=str)
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--num-layer", type=int, default=2)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    buckets = [10, 20, 30, 40]
+    vocab = default_build_vocab(args.data)
+    init_states = [("l%d_init_h" % l, (args.batch_size, args.num_hidden))
+                   for l in range(args.num_layer)]
+    data_train = BucketSentenceIter(args.data, vocab, buckets,
+                                    args.batch_size, init_states)
+
+    def sym_gen(seq_len):
+        sym = gru_unroll(args.num_layer, seq_len, len(vocab),
+                         args.num_hidden, args.num_embed, len(vocab))
+        data_names = ["data"] + [n for n, _ in init_states]
+        return sym, tuple(data_names), ("softmax_label",)
+
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else [mx.cpu()]
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=data_train.default_bucket_key,
+                                 context=ctx)
+    mod.fit(data_train, num_epoch=args.num_epochs,
+            eval_metric=mx.metric.CrossEntropy(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""Acoustic-model LSTM with a projection layer (reference
+example/speech-demo/{train_lstm_proj.py,lstm_proj.py,speechSGD.py}
+capability): frame-level senone classification over feature windows.
+
+The projected LSTM (LSTMP, Sak et al. 2014) adds a low-rank projection
+after each step's hidden state; here the projection FC fuses into the
+unrolled XLA program.  Runs on synthetic filterbank-like features so it
+is self-contained (the reference reads Kaldi archives).
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models.lstm import LSTMState
+
+
+def lstm_proj_cell(num_hidden, num_proj, indata, prev_state, prefix, seqidx):
+    """LSTM step with output projection h = W_p * o (reference lstm_proj.py)."""
+    i2h = mx.sym.FullyConnected(indata,
+                                weight=mx.sym.Variable(prefix + "_i2h_weight"),
+                                bias=mx.sym.Variable(prefix + "_i2h_bias"),
+                                num_hidden=num_hidden * 4,
+                                name="%s_t%d_i2h" % (prefix, seqidx))
+    h2h = mx.sym.FullyConnected(prev_state.h,
+                                weight=mx.sym.Variable(prefix + "_h2h_weight"),
+                                bias=mx.sym.Variable(prefix + "_h2h_bias"),
+                                num_hidden=num_hidden * 4,
+                                name="%s_t%d_h2h" % (prefix, seqidx))
+    gates = i2h + h2h
+    s = mx.sym.SliceChannel(gates, num_outputs=4,
+                            name="%s_t%d_slice" % (prefix, seqidx))
+    in_gate = mx.sym.Activation(s[0], act_type="sigmoid")
+    in_trans = mx.sym.Activation(s[1], act_type="tanh")
+    forget = mx.sym.Activation(s[2], act_type="sigmoid")
+    out_gate = mx.sym.Activation(s[3], act_type="sigmoid")
+    next_c = forget * prev_state.c + in_gate * in_trans
+    h_full = out_gate * mx.sym.Activation(next_c, act_type="tanh")
+    h_proj = mx.sym.FullyConnected(
+        h_full, weight=mx.sym.Variable(prefix + "_proj_weight"),
+        no_bias=True, num_hidden=num_proj,
+        name="%s_t%d_proj" % (prefix, seqidx))
+    return LSTMState(c=next_c, h=h_proj)
+
+
+def lstm_proj_net(seq_len, feat_dim, num_hidden, num_proj, num_senone):
+    data = mx.sym.Variable("data")           # (batch, seq_len, feat)
+    frames = mx.sym.SliceChannel(data, num_outputs=seq_len, axis=1,
+                                 squeeze_axis=True)
+    state = LSTMState(c=mx.sym.Variable("init_c"),
+                      h=mx.sym.Variable("init_h"))
+    outs = []
+    cls_w = mx.sym.Variable("cls_weight")
+    cls_b = mx.sym.Variable("cls_bias")
+    for t in range(seq_len):
+        state = lstm_proj_cell(num_hidden, num_proj, frames[t], state,
+                               "l0", t)
+        outs.append(mx.sym.FullyConnected(
+            state.h, weight=cls_w, bias=cls_b, num_hidden=num_senone,
+            name="t%d_cls" % t))
+    pred = mx.sym.Concat(*outs, dim=0)       # (T*batch, senone)
+    label = mx.sym.Variable("softmax_label")  # (batch, T)
+    label_t = mx.sym.transpose(label)
+    label_flat = mx.sym.Reshape(label_t, shape=(-1,))
+    return mx.sym.SoftmaxOutput(pred, label=label_flat, name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--seq-len", type=int, default=12)
+    parser.add_argument("--feat-dim", type=int, default=40)
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-proj", type=int, default=64)
+    parser.add_argument("--num-senone", type=int, default=16)
+    parser.add_argument("--num-epochs", type=int, default=6)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    # synthetic "speech": senone identity painted into the filterbank bins
+    rng = np.random.RandomState(0)
+    n = 1024
+    labels = rng.randint(0, args.num_senone, size=(n, args.seq_len))
+    feats = np.zeros((n, args.seq_len, args.feat_dim), np.float32)
+    for s in range(args.num_senone):
+        pattern = rng.randn(args.feat_dim).astype(np.float32)
+        feats[labels == s] = pattern
+    feats += 0.5 * rng.randn(*feats.shape).astype(np.float32)
+
+    bs = args.batch_size
+    iter_data = {
+        "data": feats,
+        "init_c": np.zeros((n, args.num_hidden), np.float32),
+        "init_h": np.zeros((n, args.num_proj), np.float32),
+    }
+    train = mx.io.NDArrayIter(iter_data,
+                              {"softmax_label": labels.astype(np.float32)},
+                              batch_size=bs, shuffle=True)
+    net = lstm_proj_net(args.seq_len, args.feat_dim, args.num_hidden,
+                        args.num_proj, args.num_senone)
+    mod = mx.mod.Module(net, context=[mx.cpu()],
+                        data_names=("data", "init_c", "init_h"))
+    def frame_ce(label, pred):
+        """CE with t-major alignment (pred rows are time-major; the stock
+        CrossEntropy metric assumes batch-major labels)."""
+        lt = np.asarray(label).astype(int).T.reshape(-1)
+        p = np.asarray(pred)
+        return float(-np.log(p[np.arange(len(lt)), lt] + 1e-9).mean())
+
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="adam",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 1e-3, "clip_gradient": 5.0},
+            eval_metric=mx.metric.np_metric(frame_ce, name="frame-ce"))
+
+    train.reset()
+    correct = total = 0
+    for batch in train:
+        mod.forward(batch, is_train=False)
+        out = mod.get_outputs()[0].asnumpy()
+        pred = out.reshape(args.seq_len, bs, -1).argmax(axis=2).T
+        truth = batch.label[0].asnumpy().astype(int)
+        correct += (pred == truth).sum()
+        total += truth.size
+    print("frame accuracy: %.3f" % (correct / total))
+    assert correct / total > 0.7
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""SVMOutput head instead of softmax (reference example/svm_mnist/
+svm_mnist.py capability): hinge-loss (L2-SVM) classifier on MLP features.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--num-epochs", type=int, default=8)
+    parser.add_argument("--use-linear", action="store_true",
+                        help="L1-SVM hinge instead of squared hinge")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SVMOutput(net, name="svm", margin=1.0,
+                           regularization_coefficient=1.0,
+                           use_linear=args.use_linear)
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(50, 10).astype(np.float32)
+    x = rng.randn(4000, 50).astype(np.float32)
+    y = (x @ w).argmax(axis=1).astype(np.float32)
+    train = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True,
+                              label_name="svm_label")
+
+    mod = mx.mod.Module(net, context=[mx.cpu()], label_names=("svm_label",))
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9})
+
+    train.reset()
+    acc = mx.metric.Accuracy()
+    mod.score(train, acc)
+    print("svm accuracy: %.3f" % acc.get()[1])
+    assert acc.get()[1] > 0.8
+
+
+if __name__ == "__main__":
+    main()

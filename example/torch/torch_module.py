@@ -1,0 +1,68 @@
+"""Torch interop (reference example/torch/{torch_module.py,torch_function.py}
+capability): run torch.nn blocks and criterions on NDArrays, and call torch
+functions through the bridge.  CPU-torch is bundled; tensors cross the
+bridge via zero-ceremony numpy exchange.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.plugins.torch_bridge import (TorchModule, TorchCriterion,
+                                            torch_function, to_torch,
+                                            from_torch)
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    import torch
+    import torch.nn as nn
+
+    # --- torch functions on NDArrays (reference torch_function.py) ---
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    exp = torch_function(torch.exp)(x)
+    print("torch.exp:", exp.asnumpy())
+
+    # --- a torch module as a layer (reference torch_module.py) ---
+    torch.manual_seed(0)
+    block = TorchModule(nn.Sequential(nn.Linear(50, 64), nn.ReLU(),
+                                      nn.Linear(64, 10)))
+
+    class _CE(nn.Module):
+        """cross-entropy with the float->long label cast the NDArray
+        bridge needs (NDArrays are float32)."""
+
+        def forward(self, x, t):
+            return nn.functional.cross_entropy(x, t.long())
+
+    criterion = TorchCriterion(_CE())
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(50, 10).astype(np.float32)
+    data = rng.randn(2000, 50).astype(np.float32)
+    label = (data @ w).argmax(axis=1)
+
+    opt = torch.optim.SGD(block.module.parameters(), lr=0.1, momentum=0.9)
+    bs = 100
+    for epoch in range(5):
+        correct = 0
+        for i in range(0, len(data), bs):
+            xb = mx.nd.array(data[i:i + bs])
+            yb = mx.nd.array(label[i:i + bs].astype(np.float32))
+            opt.zero_grad()
+            out = block.forward(xb)
+            loss = criterion.forward(out, yb)
+            grad = criterion.backward(mx.nd.ones((1,)))[0]
+            block.backward(grad)
+            opt.step()
+            correct += (out.asnumpy().argmax(1) == label[i:i + bs]).sum()
+        print("epoch %d acc %.3f" % (epoch, correct / len(data)))
+    assert correct / len(data) > 0.9
+
+
+if __name__ == "__main__":
+    main()

@@ -274,8 +274,14 @@ class Executor:
                 outs, new_aux = self._prog.eval(allargs, aux, self._last_rng,
                                                 True, eager=True)
                 return outs, new_aux
-            outs, vjp_fn, _ = jax.vjp(inner, gargs, has_aux=True)
-            grads = vjp_fn(list(head_grads))[0]
+            # monitor stats were already collected on concrete values during
+            # forward(); the vjp re-trace must not fire callbacks on tracers
+            self._prog.set_monitor(None)
+            try:
+                outs, vjp_fn, _ = jax.vjp(inner, gargs, has_aux=True)
+                grads = vjp_fn(list(head_grads))[0]
+            finally:
+                self._prog.set_monitor(self._monitor_callback)
         else:
             _, grads, _ = self._get_jit("fwdbwd")(
                 gargs, sargs, aux, self._last_rng, tuple(head_grads))

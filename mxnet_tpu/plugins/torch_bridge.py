@@ -78,5 +78,5 @@ class TorchCriterion(TorchModule):
         torch = _torch()
         tin = to_torch(data).requires_grad_(True)
         self._tins = [tin]
-        self._tout = self.module(tin, to_torch(label))
-        return from_torch(self._tout.reshape(1))
+        self._tout = self.module(tin, to_torch(label)).reshape(1)
+        return from_torch(self._tout)

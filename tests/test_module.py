@@ -150,5 +150,8 @@ def test_monitor_in_module():
     mod.install_monitor(mon)
     mon.tic()
     mod.forward(next(iter(it)), is_train=True)
+    # backward with a monitor installed must not leak tracers into the
+    # callback (regression: vjp re-trace fired monitor on traced arrays)
+    mod.backward()
     res = mon.toc()
     assert len(res) > 0

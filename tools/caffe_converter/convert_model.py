@@ -1,0 +1,48 @@
+"""Attach converted weights to a caffe-converted symbol (reference
+tools/caffe_converter/convert_model.py capability).
+
+The reference unpacked .caffemodel protobufs; binary protobuf parsing is
+out of scope here, so weights come from an .npz whose keys are caffe layer
+names mapping to [weight, bias] pairs saved as `<layer>_0` / `<layer>_1`
+(the standard caffe-extract convention).  Writes a standard checkpoint
+(prefix-symbol.json + prefix-0000.params).
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from convert_symbol import convert_symbol
+
+
+def convert_model(prototxt, npz_path, prefix):
+    net, input_name = convert_symbol(prototxt)
+    blobs = np.load(npz_path)
+    arg_params = {}
+    for key in blobs.files:
+        if key.endswith("_0"):
+            arg_params[key[:-2] + "_weight"] = mx.nd.array(blobs[key])
+        elif key.endswith("_1"):
+            arg_params[key[:-2] + "_bias"] = mx.nd.array(blobs[key])
+    known = set(net.list_arguments())
+    arg_params = {k: v for k, v in arg_params.items() if k in known}
+    mx.model.save_checkpoint(prefix, 0, net, arg_params, {})
+    print("saved %s-symbol.json and %s-0000.params (%d arrays)"
+          % (prefix, prefix, len(arg_params)))
+    return net, arg_params
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("prototxt")
+    parser.add_argument("npz", help="caffe blobs exported as npz")
+    parser.add_argument("prefix", help="output checkpoint prefix")
+    args = parser.parse_args()
+    convert_model(args.prototxt, args.npz, args.prefix)
+
+
+if __name__ == "__main__":
+    main()

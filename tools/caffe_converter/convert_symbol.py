@@ -1,0 +1,158 @@
+"""Caffe prototxt -> mxnet_tpu Symbol converter (reference
+tools/caffe_converter/convert_symbol.py capability).
+
+Parses the prototxt text format directly (no caffe/protobuf dependency —
+the reference compiled caffe.proto; here a small recursive-descent parser
+reads the same surface) and emits the equivalent symbol graph for the
+layer types the reference supported: Convolution, Pooling, InnerProduct,
+ReLU/Sigmoid/TanH, LRN, BatchNorm, Dropout, Concat, Eltwise, Flatten,
+SoftmaxWithLoss/Softmax.  Binary .caffemodel weight unpacking is out of
+scope (reference used the compiled proto); load weights via
+convert_model.py from an .npz instead.
+"""
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def parse_prototxt(text):
+    """Parse prototxt into a list of {name,type,bottom[],top[],params{}}."""
+    tokens = re.findall(r"[\w.\-+/]+|[{}:]|\"[^\"]*\"", text)
+    pos = [0]
+
+    def parse_block():
+        out = {}
+        while pos[0] < len(tokens):
+            tok = tokens[pos[0]]
+            if tok == "}":
+                pos[0] += 1
+                return out
+            key = tok
+            pos[0] += 1
+            if tokens[pos[0]] == ":":
+                pos[0] += 1
+                val = tokens[pos[0]].strip('"')
+                pos[0] += 1
+                out.setdefault(key, []).append(val)
+            elif tokens[pos[0]] == "{":
+                pos[0] += 1
+                out.setdefault(key, []).append(parse_block())
+        return out
+
+    top = parse_block()
+    layers = []
+    for layer in top.get("layer", []) + top.get("layers", []):
+        layers.append(layer)
+    return top, layers
+
+
+def _first(d, key, default=None):
+    v = d.get(key)
+    if not v:
+        return default
+    return v[0]
+
+
+def _int(d, key, default=0):
+    return int(_first(d, key, default))
+
+
+def convert_symbol(prototxt_path):
+    """Return (symbol, input_name).  Mirrors the reference layer mapping."""
+    with open(prototxt_path) as f:
+        top, layers = parse_prototxt(f.read())
+
+    input_name = _first(top, "input", "data")
+    nodes = {input_name: mx.sym.Variable(input_name)}
+
+    def get_bottom(layer):
+        bots = layer.get("bottom", [input_name])
+        return [nodes[b] for b in bots]
+
+    for layer in layers:
+        ltype = _first(layer, "type", "")
+        name = _first(layer, "name", "layer%d" % len(nodes))
+        tops = layer.get("top", [name])
+        bots = get_bottom(layer)
+        x = bots[0]
+
+        if ltype in ("Convolution", "CONVOLUTION"):
+            p = layer["convolution_param"][0]
+            k = _int(p, "kernel_size", 1)
+            net = mx.sym.Convolution(
+                x, num_filter=_int(p, "num_output"),
+                kernel=(k, k),
+                stride=(_int(p, "stride", 1),) * 2,
+                pad=(_int(p, "pad", 0),) * 2,
+                no_bias=_first(p, "bias_term", "true") == "false",
+                name=name)
+        elif ltype in ("Pooling", "POOLING"):
+            p = layer["pooling_param"][0]
+            k = _int(p, "kernel_size", 2)
+            pool = _first(p, "pool", "MAX").lower()
+            net = mx.sym.Pooling(
+                x, kernel=(k, k), stride=(_int(p, "stride", k),) * 2,
+                pad=(_int(p, "pad", 0),) * 2,
+                pool_type="avg" if pool == "ave" else pool, name=name)
+        elif ltype in ("InnerProduct", "INNER_PRODUCT"):
+            p = layer["inner_product_param"][0]
+            net = mx.sym.FullyConnected(
+                mx.sym.Flatten(x), num_hidden=_int(p, "num_output"),
+                no_bias=_first(p, "bias_term", "true") == "false", name=name)
+        elif ltype in ("ReLU", "RELU"):
+            net = mx.sym.Activation(x, act_type="relu", name=name)
+        elif ltype in ("Sigmoid", "SIGMOID"):
+            net = mx.sym.Activation(x, act_type="sigmoid", name=name)
+        elif ltype in ("TanH", "TANH"):
+            net = mx.sym.Activation(x, act_type="tanh", name=name)
+        elif ltype in ("LRN",):
+            p = layer.get("lrn_param", [{}])[0]
+            net = mx.sym.LRN(x, nsize=_int(p, "local_size", 5),
+                             alpha=float(_first(p, "alpha", 1e-4)),
+                             beta=float(_first(p, "beta", 0.75)), name=name)
+        elif ltype in ("BatchNorm",):
+            net = mx.sym.BatchNorm(x, name=name)
+        elif ltype in ("Dropout", "DROPOUT"):
+            p = layer.get("dropout_param", [{}])[0]
+            net = mx.sym.Dropout(x, p=float(_first(p, "dropout_ratio", 0.5)),
+                                 name=name)
+        elif ltype in ("Concat", "CONCAT"):
+            net = mx.sym.Concat(*bots, name=name)
+        elif ltype in ("Eltwise",):
+            net = bots[0]
+            for b in bots[1:]:
+                net = net + b
+        elif ltype in ("Flatten", "FLATTEN"):
+            net = mx.sym.Flatten(x, name=name)
+        elif ltype in ("Softmax", "SOFTMAX", "SoftmaxWithLoss",
+                       "SOFTMAX_LOSS"):
+            net = mx.sym.SoftmaxOutput(x, name="softmax")
+        elif ltype in ("Accuracy", "ACCURACY", "Data", "DATA", "Input"):
+            continue
+        else:
+            raise ValueError("unsupported caffe layer type %r (%s)"
+                             % (ltype, name))
+        for t in tops:
+            nodes[t] = net
+
+    return net, input_name
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("prototxt")
+    parser.add_argument("--output", type=str, help="write symbol json here")
+    args = parser.parse_args()
+    net, input_name = convert_symbol(args.prototxt)
+    print("converted; arguments:", net.list_arguments())
+    if args.output:
+        net.save(args.output)
+        print("saved", args.output)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Python RecordIO packer — twin of bin/im2rec (reference tools/im2rec.py).
+
+Two subcommands, same flow as the reference:
+  * list mode (--list): walk an image directory, assign integer labels per
+    subdirectory (or from an existing list), write prefix.lst with optional
+    train/val/test split.
+  * pack mode (default): read prefix.lst ("index\tlabel\tpath"), resize /
+    re-encode each image, pack into prefix.rec (+ prefix.idx) with the
+    IRHeader binary layout shared with the native loader (src/recordio.cc).
+"""
+import argparse
+import os
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from mxnet_tpu import recordio
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root):
+    """Yield (relpath, label) with one label per sorted subdirectory."""
+    cat = {}
+    items = []
+    for path, _, files in sorted(os.walk(root, followlinks=True)):
+        for fname in sorted(files):
+            if os.path.splitext(fname)[1].lower() not in EXTS:
+                continue
+            folder = os.path.relpath(path, root)
+            if folder not in cat:
+                cat[folder] = len(cat)
+            items.append((os.path.relpath(os.path.join(path, fname), root),
+                          cat[folder]))
+    return items
+
+
+def write_list(prefix, items, train_ratio, test_ratio, shuffle, chunks=1):
+    if shuffle:
+        random.shuffle(items)
+    n = len(items)
+    n_test = int(n * test_ratio)
+    n_train = int(n * train_ratio)
+    splits = [("_test", items[:n_test]),
+              ("_train" if train_ratio + test_ratio < 1.0 else "",
+               items[n_test:n_test + n_train]),
+              ("_val", items[n_test + n_train:])]
+    for suffix, chunk in splits:
+        if not chunk:
+            continue
+        name = prefix + (suffix if train_ratio < 1.0 else "") + ".lst"
+        with open(name, "w") as f:
+            for i, (path, label) in enumerate(chunk):
+                f.write("%d\t%s\t%s\n" % (i, label, path))
+        print("wrote %s (%d items)" % (name, len(chunk)))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            label = [float(x) for x in parts[1:-1]]
+            yield idx, label[0] if len(label) == 1 else label, parts[-1]
+
+
+def pack_records(args):
+    from PIL import Image
+    prefix = args.prefix
+    record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    cnt = 0
+    for idx, label, path in read_list(prefix + ".lst"):
+        fullpath = os.path.join(args.root, path)
+        try:
+            img = Image.open(fullpath).convert("RGB")
+        except Exception as e:
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+            continue
+        if args.resize:
+            w, h = img.size
+            scale = args.resize / min(w, h)
+            img = img.resize((int(round(w * scale)), int(round(h * scale))))
+        if args.center_crop:
+            w, h = img.size
+            s = min(w, h)
+            left, top = (w - s) // 2, (h - s) // 2
+            img = img.crop((left, top, left + s, top + s))
+        header = recordio.IRHeader(0, label, idx, 0)
+        buf = recordio.pack_img(header, np.asarray(img),
+                                quality=args.quality,
+                                img_fmt=args.encoding)
+        record.write_idx(idx, buf)
+        cnt += 1
+        if cnt % 1000 == 0:
+            print("packed %d images" % cnt)
+    record.close()
+    print("wrote %s.rec / %s.idx (%d records)" % (prefix, prefix, cnt))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="make an image list and/or pack a RecordIO file")
+    parser.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    parser.add_argument("root", help="image root directory")
+    parser.add_argument("--list", action="store_true",
+                        help="make a list instead of a record file")
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--test-ratio", type=float, default=0.0)
+    parser.add_argument("--shuffle", type=int, default=1)
+    parser.add_argument("--resize", type=int, default=0,
+                        help="resize shorter edge to this")
+    parser.add_argument("--center-crop", action="store_true")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    args = parser.parse_args()
+    if args.list:
+        items = list_images(args.root)
+        write_list(args.prefix, items, args.train_ratio, args.test_ratio,
+                   bool(args.shuffle))
+    else:
+        pack_records(args)
+
+
+if __name__ == "__main__":
+    main()
