@@ -8,11 +8,31 @@ CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -pthread
 LIB = mxnet_tpu/libmxtpu.so
 SRCS = src/recordio.cc src/data_loader.cc src/engine.cc src/storage.cc
 
-all: $(LIB) bin/im2rec
+# C ABI (reference src/c_api/): embeds CPython, forwards MX* to the JAX core
+PY_INCLUDES := $(shell python3-config --includes)
+PY_LDFLAGS := $(shell python3-config --ldflags --embed 2>/dev/null || python3-config --ldflags)
+PY_LIB := $(shell python3 -c "import sysconfig; print('-lpython' + sysconfig.get_config_var('LDVERSION'))")
+CAPI_LIB = mxnet_tpu/libmxtpu_capi.so
+PREDICT_LIB = mxnet_tpu/libmxtpu_predict.so
+
+all: $(LIB) bin/im2rec $(CAPI_LIB) $(PREDICT_LIB)
 
 $(LIB): $(SRCS) src/recordio.h
 	@mkdir -p $(dir $@)
 	$(CXX) $(CXXFLAGS) -shared $(SRCS) -o $@
+
+$(CAPI_LIB): src/c_api.cc src/c_predict_api.cc src/c_api_common.h \
+             include/c_api.h include/c_predict_api.h
+	@mkdir -p $(dir $@)
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared src/c_api.cc \
+	    src/c_predict_api.cc -o $@ $(PY_LDFLAGS) $(PY_LIB)
+
+# predict-only minimal build (reference amalgamation/: deploy surface with
+# nothing but the 8 MXPred* + 3 MXNDList* entry points)
+$(PREDICT_LIB): src/c_predict_api.cc src/c_api_common.h include/c_predict_api.h
+	@mkdir -p $(dir $@)
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -DMXTPU_PREDICT_STANDALONE -shared \
+	    src/c_predict_api.cc -o $@ $(PY_LDFLAGS) $(PY_LIB)
 
 bin/im2rec: src/im2rec.cc src/recordio.cc src/recordio.h
 	@mkdir -p bin
@@ -22,6 +42,6 @@ test: all
 	python -m pytest tests/ -q
 
 clean:
-	rm -f $(LIB) bin/im2rec
+	rm -f $(LIB) $(CAPI_LIB) $(PREDICT_LIB) bin/im2rec
 
 .PHONY: all test clean

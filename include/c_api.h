@@ -1,0 +1,290 @@
+/*!
+ * C ABI of the TPU-native framework.
+ *
+ * Mirrors the reference surface (include/mxnet/c_api.h, ~110 MX* functions):
+ * every handle is opaque, every function returns 0 on success / -1 on error
+ * with the message retrievable via MXGetLastError() (thread-local, like
+ * src/c_api/c_api_error.cc).  Underneath, calls are forwarded into the
+ * embedded CPython interpreter hosting the JAX/XLA runtime — the TPU-native
+ * equivalent of the reference forwarding into its C++ core.
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#ifdef __cplusplus
+#define MXTPU_EXTERN_C extern "C"
+#else
+#define MXTPU_EXTERN_C
+#endif
+
+#include <stdint.h>
+#include <stddef.h>
+
+#define MXTPU_DLL MXTPU_EXTERN_C __attribute__((visibility("default")))
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+
+typedef void *NDArrayHandle;
+typedef const void *FunctionHandle;
+typedef const void *AtomicSymbolCreator;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *DataIterHandle;
+typedef const void *DataIterCreator;
+typedef void *KVStoreHandle;
+typedef void *RecordIOHandle;
+typedef void *RtcHandle;
+typedef void *OptimizerHandle;
+typedef const void *OptimizerCreator;
+
+/*! \brief user-defined updater for the kvstore (reference c_api.h:66-74) */
+typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                NDArrayHandle local, void *handle);
+
+/* -------------------- error handling + global -------------------- */
+MXTPU_DLL const char *MXGetLastError();
+MXTPU_DLL int MXRandomSeed(int seed);
+MXTPU_DLL int MXNotifyShutdown();
+
+/* -------------------- NDArray -------------------- */
+MXTPU_DLL int MXNDArrayCreateNone(NDArrayHandle *out);
+MXTPU_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              NDArrayHandle *out);
+MXTPU_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                                int dev_type, int dev_id, int delay_alloc,
+                                int dtype, NDArrayHandle *out);
+MXTPU_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                                       size_t size);
+MXTPU_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,
+                                     size_t size);
+MXTPU_DLL int MXNDArrayWaitToRead(NDArrayHandle handle);
+MXTPU_DLL int MXNDArrayWaitToWrite(NDArrayHandle handle);
+MXTPU_DLL int MXNDArrayWaitAll();
+MXTPU_DLL int MXNDArrayFree(NDArrayHandle handle);
+MXTPU_DLL int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                             mx_uint slice_end, NDArrayHandle *out);
+MXTPU_DLL int MXNDArrayAt(NDArrayHandle handle, mx_uint idx,
+                          NDArrayHandle *out);
+MXTPU_DLL int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                               NDArrayHandle *out);
+MXTPU_DLL int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                                const mx_uint **out_pdata);
+MXTPU_DLL int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+MXTPU_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+MXTPU_DLL int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                                  int *out_dev_id);
+MXTPU_DLL int MXNDArraySave(const char *fname, mx_uint num_args,
+                            NDArrayHandle *args, const char **keys);
+MXTPU_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                            NDArrayHandle **out_arr, mx_uint *out_name_size,
+                            const char ***out_names);
+
+/* -------------------- NDArray function registry -------------------- */
+MXTPU_DLL int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+MXTPU_DLL int MXGetFunction(const char *name, FunctionHandle *out);
+MXTPU_DLL int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                            const char **description, mx_uint *num_args,
+                            const char ***arg_names, const char ***arg_type_infos,
+                            const char ***arg_descriptions);
+MXTPU_DLL int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                             mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                             int *type_mask);
+MXTPU_DLL int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                           mx_float *scalar_args, NDArrayHandle *mutate_vars);
+
+/* -------------------- Symbol -------------------- */
+MXTPU_DLL int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                               AtomicSymbolCreator **out_array);
+MXTPU_DLL int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                          const char **name,
+                                          const char **description,
+                                          mx_uint *num_args,
+                                          const char ***arg_names,
+                                          const char ***arg_type_infos,
+                                          const char ***arg_descriptions,
+                                          const char **key_var_num_args);
+MXTPU_DLL int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                                         mx_uint num_param, const char **keys,
+                                         const char **vals, SymbolHandle *out);
+MXTPU_DLL int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+MXTPU_DLL int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                                  SymbolHandle *out);
+MXTPU_DLL int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+MXTPU_DLL int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+MXTPU_DLL int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
+MXTPU_DLL int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+MXTPU_DLL int MXSymbolFree(SymbolHandle symbol);
+MXTPU_DLL int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
+MXTPU_DLL int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+MXTPU_DLL int MXSymbolGetAttr(SymbolHandle symbol, const char *key,
+                              const char **out, int *success);
+MXTPU_DLL int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
+                              const char *value);
+MXTPU_DLL int MXSymbolListAttr(SymbolHandle symbol, int recursive,
+                               mx_uint *out_size, const char ***out);
+MXTPU_DLL int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                                    const char ***out_str_array);
+MXTPU_DLL int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                                  const char ***out_str_array);
+MXTPU_DLL int MXSymbolListAuxiliaryStates(SymbolHandle symbol,
+                                          mx_uint *out_size,
+                                          const char ***out_str_array);
+MXTPU_DLL int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+MXTPU_DLL int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index,
+                                SymbolHandle *out);
+MXTPU_DLL int MXSymbolCompose(SymbolHandle sym, const char *name,
+                              mx_uint num_args, const char **keys,
+                              SymbolHandle *args);
+MXTPU_DLL int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt,
+                           const char **wrt, SymbolHandle *out);
+MXTPU_DLL int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args,
+                                 const char **keys,
+                                 const mx_uint *arg_ind_ptr,
+                                 const mx_uint *arg_shape_data,
+                                 mx_uint *in_shape_size,
+                                 const mx_uint **in_shape_ndim,
+                                 const mx_uint ***in_shape_data,
+                                 mx_uint *out_shape_size,
+                                 const mx_uint **out_shape_ndim,
+                                 const mx_uint ***out_shape_data,
+                                 mx_uint *aux_shape_size,
+                                 const mx_uint **aux_shape_ndim,
+                                 const mx_uint ***aux_shape_data,
+                                 int *complete);
+MXTPU_DLL int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                                        const char **keys,
+                                        const mx_uint *arg_ind_ptr,
+                                        const mx_uint *arg_shape_data,
+                                        mx_uint *in_shape_size,
+                                        const mx_uint **in_shape_ndim,
+                                        const mx_uint ***in_shape_data,
+                                        mx_uint *out_shape_size,
+                                        const mx_uint **out_shape_ndim,
+                                        const mx_uint ***out_shape_data,
+                                        mx_uint *aux_shape_size,
+                                        const mx_uint **aux_shape_ndim,
+                                        const mx_uint ***aux_shape_data,
+                                        int *complete);
+MXTPU_DLL int MXSymbolInferType(SymbolHandle sym, mx_uint num_args,
+                                const char **keys, const int *arg_type_data,
+                                mx_uint *in_type_size,
+                                const int **in_type_data,
+                                mx_uint *out_type_size,
+                                const int **out_type_data,
+                                mx_uint *aux_type_size,
+                                const int **aux_type_data, int *complete);
+
+/* -------------------- Executor -------------------- */
+MXTPU_DLL int MXExecutorFree(ExecutorHandle handle);
+MXTPU_DLL int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+MXTPU_DLL int MXExecutorForward(ExecutorHandle handle, int is_train);
+MXTPU_DLL int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle *head_grads);
+MXTPU_DLL int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                                NDArrayHandle **out);
+MXTPU_DLL int MXExecutorBind(SymbolHandle symbol_handle, int dev_type,
+                             int dev_id, mx_uint len,
+                             NDArrayHandle *in_args,
+                             NDArrayHandle *arg_grad_store,
+                             mx_uint *grad_req_type, mx_uint aux_states_len,
+                             NDArrayHandle *aux_states, ExecutorHandle *out);
+MXTPU_DLL int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type,
+                              int dev_id, mx_uint num_map_keys,
+                              const char **map_keys, const int *map_dev_types,
+                              const int *map_dev_ids, mx_uint len,
+                              NDArrayHandle *in_args,
+                              NDArrayHandle *arg_grad_store,
+                              mx_uint *grad_req_type, mx_uint aux_states_len,
+                              NDArrayHandle *aux_states, ExecutorHandle *out);
+MXTPU_DLL int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type,
+                               int dev_id, mx_uint num_map_keys,
+                               const char **map_keys, const int *map_dev_types,
+                               const int *map_dev_ids, mx_uint len,
+                               NDArrayHandle *in_args,
+                               NDArrayHandle *arg_grad_store,
+                               mx_uint *grad_req_type, mx_uint aux_states_len,
+                               NDArrayHandle *aux_states,
+                               ExecutorHandle shared_exec,
+                               ExecutorHandle *out);
+
+/* -------------------- Data iterators -------------------- */
+MXTPU_DLL int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+MXTPU_DLL int MXDataIterCreateIter(DataIterCreator handle, mx_uint num_param,
+                                   const char **keys, const char **vals,
+                                   DataIterHandle *out);
+MXTPU_DLL int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                                    const char **description,
+                                    mx_uint *num_args,
+                                    const char ***arg_names,
+                                    const char ***arg_type_infos,
+                                    const char ***arg_descriptions);
+MXTPU_DLL int MXDataIterFree(DataIterHandle handle);
+MXTPU_DLL int MXDataIterNext(DataIterHandle handle, int *out);
+MXTPU_DLL int MXDataIterBeforeFirst(DataIterHandle handle);
+MXTPU_DLL int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+MXTPU_DLL int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+MXTPU_DLL int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                                 uint64_t *out_size);
+MXTPU_DLL int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
+/* -------------------- KVStore -------------------- */
+MXTPU_DLL int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+MXTPU_DLL int MXKVStoreFree(KVStoreHandle handle);
+MXTPU_DLL int MXKVStoreInit(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals);
+MXTPU_DLL int MXKVStorePush(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals,
+                            int priority);
+MXTPU_DLL int MXKVStorePull(KVStoreHandle handle, mx_uint num,
+                            const int *keys, NDArrayHandle *vals,
+                            int priority);
+MXTPU_DLL int MXKVStoreSetUpdater(KVStoreHandle handle,
+                                  MXKVStoreUpdater updater,
+                                  void *updater_handle);
+MXTPU_DLL int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+MXTPU_DLL int MXKVStoreGetRank(KVStoreHandle handle, int *ret);
+MXTPU_DLL int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret);
+MXTPU_DLL int MXKVStoreBarrier(KVStoreHandle handle);
+MXTPU_DLL int MXKVStoreRunServer(KVStoreHandle handle);
+/* (typo'd name kept for ABI parity with the reference, c_api.h) */
+MXTPU_DLL int MXKVStoreSendCommmandToServers(KVStoreHandle handle,
+                                             int cmd_id, const char *cmd_body);
+MXTPU_DLL int MXInitPSEnv(mx_uint num_vars, const char **keys,
+                          const char **vals);
+
+/* -------------------- RecordIO -------------------- */
+MXTPU_DLL int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+MXTPU_DLL int MXRecordIOWriterFree(RecordIOHandle handle);
+MXTPU_DLL int MXRecordIOWriterWriteRecord(RecordIOHandle handle,
+                                          const char *buf, size_t size);
+MXTPU_DLL int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+MXTPU_DLL int MXRecordIOReaderFree(RecordIOHandle handle);
+MXTPU_DLL int MXRecordIOReaderReadRecord(RecordIOHandle handle,
+                                         char const **buf, size_t *size);
+
+/* -------------------- Rtc (Pallas-backed runtime kernels) -------------------- */
+MXTPU_DLL int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                          char **input_names, char **output_names,
+                          NDArrayHandle *inputs, NDArrayHandle *outputs,
+                          char *kernel, RtcHandle *out);
+MXTPU_DLL int MXRtcPush(RtcHandle handle, mx_uint num_input,
+                        mx_uint num_output, NDArrayHandle *inputs,
+                        NDArrayHandle *outputs, mx_uint gridDimX,
+                        mx_uint gridDimY, mx_uint gridDimZ, mx_uint blockDimX,
+                        mx_uint blockDimY, mx_uint blockDimZ);
+MXTPU_DLL int MXRtcFree(RtcHandle handle);
+
+/* -------------------- Optimizer -------------------- */
+MXTPU_DLL int MXOptimizerFindCreator(const char *key, OptimizerCreator *out);
+MXTPU_DLL int MXOptimizerCreateOptimizer(OptimizerCreator creator,
+                                         mx_uint num_param, const char **keys,
+                                         const char **vals,
+                                         OptimizerHandle *out);
+MXTPU_DLL int MXOptimizerFree(OptimizerHandle handle);
+MXTPU_DLL int MXOptimizerUpdate(OptimizerHandle handle, int index,
+                                NDArrayHandle weight, NDArrayHandle grad,
+                                mx_float lr, mx_float wd);
+
+#endif  /* MXTPU_C_API_H_ */

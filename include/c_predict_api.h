@@ -1,0 +1,65 @@
+/*!
+ * Predict-only mini-ABI (deployment surface).
+ *
+ * Mirrors the reference include/mxnet/c_predict_api.h (8 MXPred* + 3
+ * MXNDList* functions): create a predictor from symbol JSON + a param blob
+ * only, set input, forward, read output.  This header + src/c_predict_api.cc
+ * + src/c_api.cc build standalone into libmxtpu_predict.so — the
+ * amalgamation-style minimal deployment build (reference amalgamation/).
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+#define MXTPU_EXTERN_C extern "C"
+#else
+#define MXTPU_EXTERN_C
+#endif
+
+#include <stdint.h>
+
+#define MXTPU_DLL MXTPU_EXTERN_C __attribute__((visibility("default")))
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+MXTPU_DLL const char *MXGetLastError();
+
+MXTPU_DLL int MXPredCreate(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           PredictorHandle *out);
+MXTPU_DLL int MXPredCreatePartialOut(const char *symbol_json_str,
+                                     const void *param_bytes, int param_size,
+                                     int dev_type, int dev_id,
+                                     mx_uint num_input_nodes,
+                                     const char **input_keys,
+                                     const mx_uint *input_shape_indptr,
+                                     const mx_uint *input_shape_data,
+                                     mx_uint num_output_nodes,
+                                     const char **output_keys,
+                                     PredictorHandle *out);
+MXTPU_DLL int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint **shape_data, mx_uint *shape_ndim);
+MXTPU_DLL int MXPredSetInput(PredictorHandle handle, const char *key,
+                             const mx_float *data, mx_uint size);
+MXTPU_DLL int MXPredForward(PredictorHandle handle);
+MXTPU_DLL int MXPredPartialForward(PredictorHandle handle, int step,
+                                   int *step_left);
+MXTPU_DLL int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              mx_float *data, mx_uint size);
+MXTPU_DLL int MXPredFree(PredictorHandle handle);
+
+MXTPU_DLL int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                             NDListHandle *out, mx_uint *out_length);
+MXTPU_DLL int MXNDListGet(NDListHandle handle, mx_uint index,
+                          const char **out_key, const mx_float **out_data,
+                          const mx_uint **out_shape, mx_uint *out_ndim);
+MXTPU_DLL int MXNDListFree(NDListHandle handle);
+
+#endif  /* MXTPU_C_PREDICT_API_H_ */

@@ -1,0 +1,734 @@
+"""Handle-table bridge backing the C ABI (src/c_api.cc).
+
+Reference analogue: src/c_api/c_api.cc (1543 LoC) marshals every MX* call onto
+the C++ core; here the core is the JAX/XLA runtime reached through the Python
+package, so the C ABI embeds CPython and forwards each MX* function to one of
+the plain-typed functions below.  Every object crossing the ABI (NDArray,
+Symbol, Executor, DataIter, KVStore, Optimizer, RecordIO, Rtc, Predictor) is
+held in a process-wide handle table keyed by integer id; the C side treats
+ids as opaque ``void*`` handles exactly like the reference's opaque pointers
+(include/mxnet/c_api.h:37-66).
+
+All arguments/returns are ints, floats, strs, bytes, or flat lists thereof so
+the C++ marshalling layer stays mechanical.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_TABLE: Dict[int, Any] = {}
+_NEXT = [1]
+_LOCK = threading.Lock()
+
+# reference dtype codes (mshadow type flags used across the C ABI)
+_DTYPE_TO_CODE = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                  "int32": 4, "bfloat16": 5}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+
+_DEVSTR_TO_CODE = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+_CODE_TO_DEVSTR = {v: k for k, v in _DEVSTR_TO_CODE.items()}
+
+_GRAD_REQ = {0: "null", 1: "write", 2: "inplace", 3: "add"}
+
+
+def _put(obj) -> int:
+    with _LOCK:
+        h = _NEXT[0]
+        _NEXT[0] += 1
+        _TABLE[h] = obj
+    return h
+
+
+def _get(h: int):
+    return _TABLE[h]
+
+
+def free_handle(h: int) -> None:
+    with _LOCK:
+        _TABLE.pop(h, None)
+
+
+def _ctx(dev_type: int, dev_id: int):
+    from . import context
+    return context.Context(_CODE_TO_DEVSTR.get(dev_type, "cpu"), dev_id)
+
+
+def _nd():
+    from . import ndarray
+    return ndarray
+
+
+# ---------------------------------------------------------------------------
+# misc
+
+def random_seed(seed: int) -> None:
+    from . import random as rnd
+    rnd.seed(seed)
+
+
+def notify_shutdown() -> None:
+    from . import engine
+    engine.wait_for_all()
+
+
+# ---------------------------------------------------------------------------
+# NDArray (reference c_api.cc MXNDArray*)
+
+def ndarray_create_none() -> int:
+    return _put(_nd().NDArray(np.zeros((), np.float32)))
+
+
+def ndarray_create(shape: List[int], dev_type: int, dev_id: int,
+                   dtype_code: int = 0) -> int:
+    arr = _nd().zeros(tuple(shape), ctx=_ctx(dev_type, dev_id),
+                      dtype=np.dtype(_CODE_TO_DTYPE[dtype_code]))
+    return _put(arr)
+
+
+def ndarray_sync_copy_from(h: int, data: bytes) -> None:
+    arr = _get(h)
+    src = np.frombuffer(data, dtype=arr.dtype).reshape(arr.shape)
+    arr._sync_copyfrom(src)
+
+
+def ndarray_sync_copy_to(h: int) -> bytes:
+    return np.ascontiguousarray(_get(h).asnumpy()).tobytes()
+
+
+def ndarray_wait_to_read(h: int) -> None:
+    _get(h).wait_to_read()
+
+
+def ndarray_wait_to_write(h: int) -> None:
+    _get(h).wait_to_read()
+
+
+def ndarray_wait_all() -> None:
+    from . import engine
+    engine.wait_for_all()
+
+
+def ndarray_slice(h: int, start: int, stop: int) -> int:
+    return _put(_get(h)._slice(start, stop))
+
+
+def ndarray_at(h: int, idx: int) -> int:
+    return _put(_get(h)._at(idx))
+
+
+def ndarray_reshape(h: int, shape: List[int]) -> int:
+    return _put(_get(h).reshape(tuple(shape)))
+
+
+def ndarray_get_shape(h: int) -> List[int]:
+    return list(_get(h).shape)
+
+
+def ndarray_get_dtype(h: int) -> int:
+    return _DTYPE_TO_CODE[np.dtype(_get(h).dtype).name]
+
+
+def ndarray_get_context(h: int) -> List[int]:
+    c = _get(h).context
+    return [_DEVSTR_TO_CODE.get(c.device_type, 1), c.device_id]
+
+
+def ndarray_save(fname: str, handles: List[int], keys: List[str]) -> None:
+    nd = _nd()
+    if keys:
+        nd.save(fname, {k: _get(h) for k, h in zip(keys, handles)})
+    else:
+        nd.save(fname, [_get(h) for h in handles])
+
+
+def ndarray_load(fname: str):
+    data = _nd().load(fname)
+    if isinstance(data, dict):
+        names = list(data.keys())
+        handles = [_put(data[k]) for k in names]
+    else:
+        names = []
+        handles = [_put(v) for v in data]
+    return handles, names
+
+
+# ---------------------------------------------------------------------------
+# NDArray function registry (reference MXListFunctions/MXFuncInvoke)
+
+def list_functions() -> List[str]:
+    return _nd().list_functions()
+
+
+# hand-written ndarray functions whose positional scalars are not visible to
+# registry introspection: name -> (num_use_vars, num_scalars, num_mutate_vars)
+_FUNC_SIGNATURES = {
+    "clip": (1, 2, 1),
+    "onehot_encode": (1, 1, 1),
+    "choose_element_0index": (2, 0, 1),
+    "fill_element_0index": (3, 0, 1),
+}
+
+
+def func_describe(name: str) -> List[int]:
+    """[num_use_vars, num_scalars, num_mutate_vars, type_mask]; mirrors
+    MXFuncDescribe (c_api.h:299-312)."""
+    if name in _FUNC_SIGNATURES:
+        nuse, nscalar, nmutate = _FUNC_SIGNATURES[name]
+        return [nuse, nscalar, nmutate, 1]
+    from .ops.registry import get_op
+    try:
+        op = get_op(name)
+        p = op.parse_params({})
+        return [len(op.list_arguments(p)), 0, 1, 1]
+    except Exception:
+        return [1, 0, 1, 1]
+
+
+def func_get_info(name: str):
+    fn = _nd()._NDARRAY_FUNCS[name]
+    doc = fn.__doc__ or ""
+    return [name, doc]
+
+
+def func_invoke(name: str, use_handles: List[int], scalars: List[float],
+                mutate_handles: List[int]) -> None:
+    nd = _nd()
+    fn = nd._NDARRAY_FUNCS[name]
+    ins = [_get(h) for h in use_handles]
+    outs = [_get(h) for h in mutate_handles]
+    args = ins + list(scalars)
+    if not outs:
+        fn(*args)
+        return
+    try:
+        fn(*args, out=outs[0])
+        return
+    except TypeError:
+        pass  # function has no out= kwarg; copy the result instead
+    res = fn(*args)
+    if isinstance(res, (list, tuple)):
+        res = res[0]
+    if isinstance(res, nd.NDArray):
+        res.copyto(outs[0])
+    else:
+        outs[0]._sync_copyfrom(np.asarray(res, dtype=outs[0].dtype))
+
+
+# ---------------------------------------------------------------------------
+# Symbol (reference MXSymbol*)
+
+def _sym():
+    from . import symbol
+    return symbol
+
+
+def symbol_list_creators() -> List[str]:
+    from .ops.registry import list_ops
+    return list(list_ops())
+
+
+def symbol_get_creator_info(name: str):
+    """[name, description, key_var_num_args, arg_names..., arg_types...,
+    arg_descs...] flattened with counts on the C side."""
+    from .ops.registry import get_op
+    op = get_op(name)
+    schema = getattr(op, "param_schema", None) or {}
+    arg_names, arg_types, arg_descs = [], [], []
+    for pname, field in schema.items():
+        arg_names.append(pname)
+        arg_types.append(str(getattr(field, "type_str", "any")))
+        arg_descs.append(str(getattr(field, "doc", "")))
+    desc = (op.__doc__ or "").strip()
+    kvar = op.variable_args or ""
+    return [name, desc, kvar], arg_names, arg_types, arg_descs
+
+
+def symbol_create_atomic(op_name: str, keys: List[str],
+                         vals: List[str]) -> int:
+    creator = getattr(_sym(), op_name, None)
+    if creator is None:
+        from .symbol import _make_atomic_symbol_function
+        creator = _make_atomic_symbol_function(op_name)
+    kwargs = dict(zip(keys, vals))
+    return _put(creator(**kwargs))
+
+
+def symbol_create_variable(name: str) -> int:
+    return _put(_sym().Variable(name))
+
+
+def symbol_create_group(handles: List[int]) -> int:
+    return _put(_sym().Group([_get(h) for h in handles]))
+
+
+def symbol_from_json(js: str) -> int:
+    return _put(_sym().load_json(js))
+
+
+def symbol_from_file(fname: str) -> int:
+    return _put(_sym().load(fname))
+
+
+def symbol_to_json(h: int) -> str:
+    return _get(h).tojson()
+
+
+def symbol_save_file(h: int, fname: str) -> None:
+    _get(h).save(fname)
+
+
+def symbol_copy(h: int) -> int:
+    import copy
+    return _put(copy.deepcopy(_get(h)))
+
+
+def symbol_print(h: int) -> str:
+    return _get(h).debug_str()
+
+
+def symbol_get_attr(h: int, key: str) -> Optional[str]:
+    return _get(h).attr(key)
+
+
+def symbol_set_attr(h: int, key: str, value: str) -> None:
+    _get(h)._set_attr(**{key: value})
+
+
+def symbol_list_attr(h: int, recursive: bool) -> List[str]:
+    """Flattened [k0, v0, k1, v1, ...]."""
+    if recursive:
+        flat = []
+        for name, attrs in _get(h).attr_dict().items():
+            for k, v in attrs.items():
+                flat += ["%s$%s" % (name, k), str(v)]
+        return flat
+    out = []
+    for k, v in _get(h).list_attr().items():
+        out += [k, str(v)]
+    return out
+
+
+def symbol_list_arguments(h: int) -> List[str]:
+    return _get(h).list_arguments()
+
+
+def symbol_list_outputs(h: int) -> List[str]:
+    return _get(h).list_outputs()
+
+
+def symbol_list_aux(h: int) -> List[str]:
+    return _get(h).list_auxiliary_states()
+
+
+def symbol_get_internals(h: int) -> int:
+    return _put(_get(h).get_internals())
+
+
+def symbol_get_output(h: int, idx: int) -> int:
+    return _put(_get(h)[idx])
+
+
+def symbol_compose(h: int, name: str, keys: List[str],
+                   arg_handles: List[int]) -> None:
+    """MXSymbolCompose: reference atomic symbols expose raw argument names
+    (``data``/``weight``) until composed; ours auto-prefix on creation, so
+    map caller keys onto the prefixed names by suffix and re-prefix the
+    remaining auto variables when compose assigns a new node name (matching
+    reference compose+rename semantics, symbolic.h:77-142)."""
+    from .symbol import _topo
+    sym = _get(h)
+    args = [_get(a) for a in arg_handles]
+    arg_names = sym.list_arguments()
+    head = sym._heads[0][0] if len(sym._heads) == 1 else None
+    old_name = head.name if head is not None else None
+    if keys:
+        kwargs = {}
+        for k, a in zip(keys, args):
+            if k in arg_names:
+                kwargs[k] = a
+            else:
+                matches = [an for an in arg_names if an.endswith("_" + k)]
+                if len(matches) != 1:
+                    raise ValueError("cannot map compose key %r onto %s"
+                                     % (k, arg_names))
+                kwargs[matches[0]] = a
+        sym._compose(name=name or None, **kwargs)
+    else:
+        sym._compose(*args, name=name or None)
+    if name and head is not None and old_name and name != old_name:
+        prefix = old_name + "_"
+        for node in _topo(sym._heads):
+            for inp, _ in node.inputs:
+                if inp.is_variable and inp.name.startswith(prefix):
+                    inp.name = name + "_" + inp.name[len(prefix):]
+
+
+def symbol_grad(h: int, wrt: List[str]) -> int:
+    return _put(_get(h).grad(wrt))
+
+
+def symbol_infer_shape(h: int, keys: List[str], shapes: List[List[int]],
+                       partial: bool):
+    """Returns (arg_shapes, out_shapes, aux_shapes, complete) with each group
+    a list of int lists; raises on inference failure like the reference."""
+    sym = _get(h)
+    kwargs = {k: tuple(s) for k, s in zip(keys, shapes)}
+    if partial:
+        arg, out, aux = sym.infer_shape_partial(**kwargs)
+    else:
+        arg, out, aux = sym.infer_shape(**kwargs)
+    if arg is None:
+        return [], [], [], 0
+    tolist = lambda group: [list(s) if s is not None else [] for s in group]
+    return tolist(arg), tolist(out), tolist(aux), 1
+
+
+def symbol_infer_type(h: int, keys: List[str], types: List[int]):
+    sym = _get(h)
+    kwargs = {k: np.dtype(_CODE_TO_DTYPE[t]) for k, t in zip(keys, types)}
+    arg, out, aux = sym.infer_type(**kwargs)
+    if arg is None:
+        return [], [], [], 0
+    code = lambda group: [_DTYPE_TO_CODE[np.dtype(t).name] if t is not None
+                          else -1 for t in group]
+    return code(arg), code(out), code(aux), 1
+
+
+# ---------------------------------------------------------------------------
+# Executor (reference MXExecutor*)
+
+def executor_bind(sym_h: int, dev_type: int, dev_id: int,
+                  g2c_keys: List[str], g2c_dev_types: List[int],
+                  g2c_dev_ids: List[int],
+                  arg_handles: List[int], grad_handles: List[int],
+                  grad_reqs: List[int], aux_handles: List[int],
+                  shared_exec_h: int = 0) -> int:
+    sym = _get(sym_h)
+    ctx = _ctx(dev_type, dev_id)
+    names = sym.list_arguments()
+    args = [_get(h) for h in arg_handles]
+    args_grad = {n: _get(h) for n, h in zip(names, grad_handles) if h}
+    grad_req = {n: _GRAD_REQ[r] for n, r in zip(names, grad_reqs)}
+    aux = [_get(h) for h in aux_handles]
+    group2ctx = {k: _ctx(t, i) for k, t, i in
+                 zip(g2c_keys, g2c_dev_types, g2c_dev_ids)} or None
+    shared = _get(shared_exec_h) if shared_exec_h else None
+    exe = sym.bind(ctx, args, args_grad=args_grad or None, grad_req=grad_req,
+                   aux_states=aux or None, group2ctx=group2ctx,
+                   shared_exec=shared)
+    return _put(exe)
+
+
+def executor_forward(h: int, is_train: int) -> None:
+    _get(h).forward(is_train=bool(is_train))
+
+
+def executor_backward(h: int, head_grad_handles: List[int]) -> None:
+    grads = [_get(g) for g in head_grad_handles]
+    _get(h).backward(grads if grads else None)
+
+
+def executor_outputs(h: int) -> List[int]:
+    return [_put(o) for o in _get(h).outputs]
+
+
+def executor_print(h: int) -> str:
+    return _get(h).debug_str()
+
+
+# ---------------------------------------------------------------------------
+# Data iterators (reference MXDataIter*)
+
+_ITER_REGISTRY = ["MNISTIter", "CSVIter", "ImageRecordIter", "NDArrayIter"]
+
+
+def list_data_iters() -> List[str]:
+    return list(_ITER_REGISTRY)
+
+
+def data_iter_create(name: str, keys: List[str], vals: List[str]) -> int:
+    from . import io
+    cls = getattr(io, name)
+    kwargs: Dict[str, Any] = {}
+    for k, v in zip(keys, vals):
+        if v.startswith("("):
+            kwargs[k] = tuple(int(x) for x in v.strip("()").split(",") if x)
+        else:
+            try:
+                kwargs[k] = int(v)
+            except ValueError:
+                try:
+                    kwargs[k] = float(v)
+                except ValueError:
+                    kwargs[k] = v
+    return _put(cls(**kwargs))
+
+
+def data_iter_next(h: int) -> int:
+    it = _get(h)
+    try:
+        batch = it.next()
+    except StopIteration:
+        return 0
+    it._capi_batch = batch
+    return 1
+
+
+def data_iter_before_first(h: int) -> None:
+    _get(h).reset()
+
+
+def data_iter_get_data(h: int) -> int:
+    return _put(_get(h)._capi_batch.data[0])
+
+
+def data_iter_get_label(h: int) -> int:
+    return _put(_get(h)._capi_batch.label[0])
+
+
+def data_iter_get_pad(h: int) -> int:
+    return int(_get(h)._capi_batch.pad or 0)
+
+
+def data_iter_get_index(h: int) -> List[int]:
+    idx = _get(h)._capi_batch.index
+    return [int(i) for i in idx] if idx is not None else []
+
+
+# ---------------------------------------------------------------------------
+# KVStore (reference MXKVStore*)
+
+def kvstore_create(type_str: str) -> int:
+    from . import kvstore
+    return _put(kvstore.create(type_str))
+
+
+def kvstore_init(h: int, keys: List[int], val_handles: List[int]) -> None:
+    _get(h).init(keys, [_get(v) for v in val_handles])
+
+
+def kvstore_push(h: int, keys: List[int], val_handles: List[int],
+                 priority: int) -> None:
+    _get(h).push(keys, [_get(v) for v in val_handles], priority=priority)
+
+
+def kvstore_pull(h: int, keys: List[int], out_handles: List[int],
+                 priority: int) -> None:
+    _get(h).pull(keys, [_get(v) for v in out_handles], priority=priority)
+
+
+def kvstore_set_updater_addr(h: int, fn_addr: int) -> None:
+    """Wrap a C callback ``void (*)(int key, NDArrayHandle recv,
+    NDArrayHandle local, void*)`` (c_api.h MXKVStoreUpdater) via ctypes."""
+    import ctypes
+    cb_type = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_void_p)
+    cfn = cb_type(fn_addr)
+
+    def updater(key, recv, local):
+        hrecv, hlocal = _put(recv), _put(local)
+        try:
+            cfn(int(key), hrecv, hlocal, None)
+        finally:
+            # handles are lent to the callback for its duration only
+            # (reference engine frees them after the updater returns)
+            free_handle(hrecv)
+            free_handle(hlocal)
+
+    kv = _get(h)
+    kv._capi_updater_ref = cfn  # keep callback alive
+    kv.set_updater(updater)
+
+
+def kvstore_get_type(h: int) -> str:
+    return _get(h).type
+
+
+def kvstore_get_rank(h: int) -> int:
+    return _get(h).rank
+
+
+def kvstore_get_group_size(h: int) -> int:
+    return _get(h).num_workers
+
+
+def kvstore_barrier(h: int) -> None:
+    _get(h)._barrier()
+
+
+def kvstore_send_command(h: int, head: int, body: str) -> None:
+    _get(h)._send_command_to_servers(head, body)
+
+
+def kvstore_run_server(h: int) -> None:
+    from .kvstore_server import KVStoreServer
+    KVStoreServer(_get(h)).run()
+
+
+# ---------------------------------------------------------------------------
+# RecordIO (reference MXRecordIO*)
+
+def recordio_writer_create(uri: str) -> int:
+    from . import recordio
+    return _put(recordio.MXRecordIO(uri, "w"))
+
+
+def recordio_reader_create(uri: str) -> int:
+    from . import recordio
+    return _put(recordio.MXRecordIO(uri, "r"))
+
+
+def recordio_close(h: int) -> None:
+    _get(h).close()
+    free_handle(h)
+
+
+def recordio_write(h: int, buf: bytes) -> None:
+    _get(h).write(buf)
+
+
+def recordio_read(h: int) -> Optional[bytes]:
+    return _get(h).read()
+
+
+# ---------------------------------------------------------------------------
+# Optimizer (reference MXOptimizer*; src/optimizer C++ registry analogue)
+
+def optimizer_find_creator(name: str) -> int:
+    from .optimizer import Optimizer
+    key = name.lower()
+    return 1 if key in Optimizer.opt_registry else 0
+
+
+def optimizer_create(name: str, keys: List[str], vals: List[str]) -> int:
+    from .optimizer import Optimizer
+    kwargs: Dict[str, Any] = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = float(v)
+        except ValueError:
+            kwargs[k] = v
+    opt = Optimizer.create_optimizer(name, **kwargs)
+    opt._capi_states: Dict[int, Any] = {}
+    return _put(opt)
+
+
+def optimizer_update(h: int, index: int, weight_h: int, grad_h: int,
+                     lr: float, wd: float) -> None:
+    opt = _get(h)
+    weight, grad = _get(weight_h), _get(grad_h)
+    if index not in opt._capi_states:
+        opt._capi_states[index] = opt.create_state(index, weight)
+    opt.lr = lr
+    opt.wd = wd
+    opt.update(index, weight, grad, opt._capi_states[index])
+
+
+# ---------------------------------------------------------------------------
+# Rtc (reference MXRtc* — NVRTC; here named Pallas kernels, rtc.py)
+
+def rtc_create(name: str, input_names: List[str], input_handles: List[int],
+               output_names: List[str], output_handles: List[int],
+               kernel_src: str) -> int:
+    """kernel_src is Python source defining ``kernel(*args)`` (jnp / Pallas
+    body) — the TPU analogue of the reference's CUDA source string
+    (MXRtcCreate, c_api.h)."""
+    from .rtc import Rtc
+    ns: Dict[str, Any] = {}
+    exec(kernel_src, ns)  # user-supplied kernel source, like NVRTC input
+    kern = ns.get(name) or ns.get("kernel")
+    if kern is None:
+        raise ValueError("kernel source must define %r or 'kernel'" % name)
+    ins = list(zip(input_names, [_get(h) for h in input_handles]))
+    outs = list(zip(output_names, [_get(h) for h in output_handles]))
+    return _put(Rtc(name, ins, outs, kern))
+
+
+def rtc_push(h: int, in_handles: List[int], out_handles: List[int],
+             grid: List[int]) -> None:
+    rtc = _get(h)
+    rtc.push([_get(i) for i in in_handles], [_get(o) for o in out_handles],
+             tuple(grid) if grid else None)
+
+
+# ---------------------------------------------------------------------------
+# Predict mini-ABI (reference include/mxnet/c_predict_api.h, 8 MXPred* +
+# 3 MXNDList* functions — the deployment/amalgamation surface)
+
+def pred_create(symbol_json: str, param_blob: bytes, dev_type: int,
+                dev_id: int, input_keys: List[str],
+                input_shapes: List[List[int]],
+                output_keys: Optional[List[str]] = None) -> int:
+    from . import ndarray as nd
+    from .predictor import Predictor, strip_param_prefixes
+    from .symbol import load_json, Group
+    params = nd.loads(param_blob)
+    if isinstance(params, dict):
+        params = strip_param_prefixes(params)
+    sym = load_json(symbol_json)
+    if output_keys:
+        internals = sym.get_internals()
+        outs = internals.list_outputs()
+        picked = []
+        for key in output_keys:
+            want = key if key.endswith("_output") else key + "_output"
+            if want not in outs:
+                raise ValueError("unknown output %r" % key)
+            picked.append(internals[outs.index(want)])
+        sym = picked[0] if len(picked) == 1 else Group(picked)
+    shapes = {k: tuple(s) for k, s in zip(input_keys, input_shapes)}
+    pred = Predictor(sym.tojson(), params, shapes,
+                     _CODE_TO_DEVSTR.get(dev_type, "cpu"), dev_id)
+    return _put(pred)
+
+
+def pred_get_output_shape(h: int, index: int) -> List[int]:
+    return list(_get(h).get_output_shape(index))
+
+
+def pred_set_input(h: int, name: str, data: bytes) -> None:
+    pred = _get(h)
+    shape = pred._input_shapes[name]
+    pred.set_input(name, np.frombuffer(data, np.float32).reshape(shape))
+
+
+def pred_forward(h: int) -> None:
+    _get(h).forward()
+
+
+def pred_partial_forward(h: int, step: int) -> int:
+    """Reference MXPredPartialForward walks the graph one monitored step at a
+    time; the XLA program is one fused computation, so step 0 runs it all and
+    0 steps remain (documented divergence)."""
+    if step == 0:
+        _get(h).forward()
+    return 0
+
+
+def pred_get_output(h: int, index: int) -> bytes:
+    out = _get(h).get_output(index)
+    return np.ascontiguousarray(out, dtype=np.float32).tobytes()
+
+
+def ndlist_create(param_blob: bytes):
+    """Returns (handle, names); MXNDListCreate."""
+    from . import ndarray as nd
+    params = nd.loads(param_blob)
+    if isinstance(params, dict):
+        names = list(params.keys())
+        arrays = [params[k] for k in names]
+    else:
+        names = ["" for _ in params]
+        arrays = params
+    return _put((names, arrays)), names
+
+
+def ndlist_get(h: int, index: int):
+    """Returns (name, data_bytes, shape); MXNDListGet."""
+    names, arrays = _get(h)
+    arr = arrays[index]
+    data = np.ascontiguousarray(arr.asnumpy(), dtype=np.float32).tobytes()
+    return names[index], data, list(arr.shape)

@@ -441,14 +441,21 @@ def save(fname: str, data) -> None:
 def load(fname: str):
     """Load NDArrays saved by :func:`save`."""
     with open(fname, "rb") as f:
-        magic = f.read(len(_SAVE_MAGIC))
-        if magic != _SAVE_MAGIC:
-            raise MXNetError("invalid NDArray file %s" % fname)
-        (meta_len,) = struct.unpack("<Q", f.read(8))
-        names = pickle.loads(f.read(meta_len))
-        npz = np.load(_io.BytesIO(f.read()))
-        arrays = [array(npz["arr_%d" % i], dtype=npz["arr_%d" % i].dtype)
-                  for i in range(len(npz.files))]
+        return loads(f.read(), name=fname)
+
+
+def loads(buf: bytes, name: str = "<bytes>"):
+    """Load NDArrays from an in-memory save() blob (the form the C predict
+    ABI receives param blobs in, c_predict_api.h MXPredCreate)."""
+    stream = _io.BytesIO(buf)
+    magic = stream.read(len(_SAVE_MAGIC))
+    if magic != _SAVE_MAGIC:
+        raise MXNetError("invalid NDArray file %s" % name)
+    (meta_len,) = struct.unpack("<Q", stream.read(8))
+    names = pickle.loads(stream.read(meta_len))
+    npz = np.load(_io.BytesIO(stream.read()))
+    arrays = [array(npz["arr_%d" % i], dtype=npz["arr_%d" % i].dtype)
+              for i in range(len(npz.files))]
     if names is None:
         return arrays
     return dict(zip(names, arrays))

@@ -21,19 +21,20 @@ from .context import Context, cpu
 from .ndarray import NDArray, load as nd_load, array as nd_array
 from .symbol import Symbol, load_json as sym_load_json
 
-__all__ = ["Predictor", "load_ndarray_file", "create_predictor"]
+__all__ = ["Predictor", "load_ndarray_file", "create_predictor",
+           "strip_param_prefixes"]
+
+
+def strip_param_prefixes(params: Dict[str, NDArray]) -> Dict[str, NDArray]:
+    """Drop the ``arg:``/``aux:`` checkpoint key prefixes (model.py
+    save_checkpoint convention) — shared by the Python and C predict paths."""
+    return {(k[4:] if k.startswith(("arg:", "aux:")) else k): v
+            for k, v in params.items()}
 
 
 def load_ndarray_file(path: str) -> Dict[str, NDArray]:
     """MXNDListCreate analogue: read a saved param blob."""
-    params = nd_load(path)
-    out = {}
-    for k, v in params.items():
-        if k.startswith("arg:") or k.startswith("aux:"):
-            out[k[4:]] = v
-        else:
-            out[k] = v
-    return out
+    return strip_param_prefixes(nd_load(path))
 
 
 class Predictor:

@@ -1,0 +1,310 @@
+/*!
+ * End-to-end exercise of the C ABI (include/c_api.h) and the predict
+ * mini-ABI (include/c_predict_api.h) — reference analogue of what each
+ * language binding does through include/mxnet/c_api.h.
+ *
+ * Usage: test_c_api <prefix>
+ *   expects <prefix>-symbol.json and <prefix>-0001.params written by the
+ *   pytest wrapper (tests/test_c_api.py), plus stdin-free environment with
+ *   PYTHONPATH pointing at the repo root.
+ * Prints "ALL C API TESTS PASSED" and exits 0 on success.
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../../include/c_api.h"
+#include "../../include/c_predict_api.h"
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "FAIL %s:%d: %s (last error: %s)\n", __FILE__, \
+                   __LINE__, #cond, MXGetLastError());                    \
+      std::exit(1);                                                       \
+    }                                                                     \
+  } while (0)
+
+static std::string ReadFile(const std::string &path) {
+  FILE *f = std::fopen(path.c_str(), "rb");
+  CHECK(f != nullptr);
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(n), '\0');
+  CHECK(std::fread(&buf[0], 1, static_cast<size_t>(n), f) ==
+        static_cast<size_t>(n));
+  std::fclose(f);
+  return buf;
+}
+
+static void TestNDArray() {
+  // create 2x3, fill from host, read back
+  mx_uint shape[2] = {2, 3};
+  NDArrayHandle a, b;
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &a) == 0);
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &b) == 0);
+  float av[6] = {1, 2, 3, 4, 5, 6}, bv[6] = {10, 20, 30, 40, 50, 60};
+  CHECK(MXNDArraySyncCopyFromCPU(a, av, sizeof(av)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(b, bv, sizeof(bv)) == 0);
+
+  mx_uint ndim; const mx_uint *sdata;
+  CHECK(MXNDArrayGetShape(a, &ndim, &sdata) == 0);
+  CHECK(ndim == 2 && sdata[0] == 2 && sdata[1] == 3);
+  int dtype;
+  CHECK(MXNDArrayGetDType(a, &dtype) == 0 && dtype == 0);
+
+  // c = a + b through the registered-function path (MXFuncInvoke)
+  FunctionHandle plus;
+  CHECK(MXGetFunction("_plus", &plus) == 0);
+  mx_uint nuse, nscalar, nmutate; int mask;
+  CHECK(MXFuncDescribe(plus, &nuse, &nscalar, &nmutate, &mask) == 0);
+  CHECK(nuse == 2 && nmutate == 1);
+  NDArrayHandle c;
+  CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &c) == 0);
+  NDArrayHandle use_vars[2] = {a, b};
+  NDArrayHandle mutate_vars[1] = {c};
+  CHECK(MXFuncInvoke(plus, use_vars, nullptr, mutate_vars) == 0);
+  CHECK(MXNDArrayWaitToRead(c) == 0);
+  float cv[6];
+  CHECK(MXNDArraySyncCopyToCPU(c, cv, sizeof(cv)) == 0);
+  for (int i = 0; i < 6; ++i) CHECK(cv[i] == av[i] + bv[i]);
+
+  // slice/reshape views
+  NDArrayHandle s;
+  CHECK(MXNDArraySlice(a, 0, 1, &s) == 0);
+  CHECK(MXNDArrayGetShape(s, &ndim, &sdata) == 0);
+  CHECK(ndim == 2 && sdata[0] == 1 && sdata[1] == 3);
+  int newdims[1] = {6};
+  NDArrayHandle r;
+  CHECK(MXNDArrayReshape(a, 1, newdims, &r) == 0);
+  CHECK(MXNDArrayGetShape(r, &ndim, &sdata) == 0);
+  CHECK(ndim == 1 && sdata[0] == 6);
+
+  // registry listing is non-empty
+  mx_uint nfn; FunctionHandle *fns;
+  CHECK(MXListFunctions(&nfn, &fns) == 0);
+  CHECK(nfn > 50);
+
+  CHECK(MXNDArrayFree(s) == 0);
+  CHECK(MXNDArrayFree(r) == 0);
+  CHECK(MXNDArrayFree(a) == 0);
+  CHECK(MXNDArrayFree(b) == 0);
+  CHECK(MXNDArrayFree(c) == 0);
+  std::printf("ndarray ok\n");
+}
+
+static void TestSymbolExecutor() {
+  // mlp: FullyConnected(data, W, bias, 4) -> relu -> sum == scalar loss
+  SymbolHandle data, fc, act;
+  CHECK(MXSymbolCreateVariable("data", &data) == 0);
+  AtomicSymbolCreator fc_creator = "FullyConnected";
+  const char *fc_keys[] = {"num_hidden"};
+  const char *fc_vals[] = {"4"};
+  CHECK(MXSymbolCreateAtomicSymbol(fc_creator, 1, fc_keys, fc_vals, &fc) == 0);
+  const char *ckeys[] = {"data"};
+  SymbolHandle cargs[] = {data};
+  CHECK(MXSymbolCompose(fc, "fc1", 1, ckeys, cargs) == 0);
+  const char *act_keys[] = {"act_type"};
+  const char *act_vals[] = {"relu"};
+  CHECK(MXSymbolCreateAtomicSymbol("Activation", 1, act_keys, act_vals,
+                                   &act) == 0);
+  SymbolHandle aargs[] = {fc};
+  const char *akeys[] = {"data"};
+  CHECK(MXSymbolCompose(act, "relu1", 1, akeys, aargs) == 0);
+
+  mx_uint narg; const char **arg_names;
+  CHECK(MXSymbolListArguments(act, &narg, &arg_names) == 0);
+  CHECK(narg == 3);  // data, fc1_weight, fc1_bias
+  CHECK(std::strcmp(arg_names[0], "data") == 0);
+
+  // infer shapes from data=(2,3)
+  const char *ikeys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shdata[] = {2, 3};
+  mx_uint in_sz, out_sz, aux_sz;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_sh, **out_sh, **aux_sh;
+  int complete;
+  CHECK(MXSymbolInferShape(act, 1, ikeys, indptr, shdata, &in_sz, &in_nd,
+                           &in_sh, &out_sz, &out_nd, &out_sh, &aux_sz,
+                           &aux_nd, &aux_sh, &complete) == 0);
+  CHECK(complete == 1);
+  CHECK(in_sz == 3);
+  CHECK(in_nd[1] == 2 && in_sh[1][0] == 4 && in_sh[1][1] == 3);  // weight
+  CHECK(out_sz == 1 && out_nd[0] == 2 && out_sh[0][0] == 2 && out_sh[0][1] == 4);
+
+  // JSON round trip
+  const char *json;
+  CHECK(MXSymbolSaveToJSON(act, &json) == 0);
+  std::string json_copy(json);
+  SymbolHandle act2;
+  CHECK(MXSymbolCreateFromJSON(json_copy.c_str(), &act2) == 0);
+  CHECK(MXSymbolListArguments(act2, &narg, &arg_names) == 0);
+  CHECK(narg == 3);
+
+  // bind + forward + backward
+  mx_uint wshape[2] = {4, 3}, bshape[1] = {4}, dshape[2] = {2, 3};
+  NDArrayHandle arg_nd[3], grad_nd[3];
+  CHECK(MXNDArrayCreate(dshape, 2, 1, 0, 0, &arg_nd[0]) == 0);
+  CHECK(MXNDArrayCreate(wshape, 2, 1, 0, 0, &arg_nd[1]) == 0);
+  CHECK(MXNDArrayCreate(bshape, 1, 1, 0, 0, &arg_nd[2]) == 0);
+  float dv[6] = {1, -2, 3, -4, 5, -6};
+  float wv[12] = {.1f, .2f, .3f, .4f, .5f, .6f, .7f, .8f, .9f, 1.f, 1.1f, 1.2f};
+  float bv[4] = {0, 0, 0, 0};
+  CHECK(MXNDArraySyncCopyFromCPU(arg_nd[0], dv, sizeof(dv)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(arg_nd[1], wv, sizeof(wv)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(arg_nd[2], bv, sizeof(bv)) == 0);
+  mx_uint reqs[3] = {1, 1, 1};  // write
+  for (int i = 0; i < 3; ++i) {
+    mx_uint *shp = i == 0 ? dshape : (i == 1 ? wshape : bshape);
+    CHECK(MXNDArrayCreate(shp, i == 2 ? 1 : 2, 1, 0, 0, &grad_nd[i]) == 0);
+  }
+  ExecutorHandle exec;
+  CHECK(MXExecutorBind(act, 1, 0, 3, arg_nd, grad_nd, reqs, 0, nullptr,
+                       &exec) == 0);
+  CHECK(MXExecutorForward(exec, 1) == 0);
+  mx_uint nout; NDArrayHandle *outs;
+  CHECK(MXExecutorOutputs(exec, &nout, &outs) == 0);
+  CHECK(nout == 1);
+  float out[8];
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], out, sizeof(out)) == 0);
+  // row 0: x=(1,-2,3): w row0 = (.1,.2,.3) -> .1-.4+.9=0.6 relu->0.6
+  CHECK(out[0] > 0.59f && out[0] < 0.61f);
+
+  NDArrayHandle head;
+  mx_uint oshape[2] = {2, 4};
+  CHECK(MXNDArrayCreate(oshape, 2, 1, 0, 0, &head) == 0);
+  float ones[8] = {1, 1, 1, 1, 1, 1, 1, 1};
+  CHECK(MXNDArraySyncCopyFromCPU(head, ones, sizeof(ones)) == 0);
+  NDArrayHandle heads[1] = {head};
+  CHECK(MXExecutorBackward(exec, 1, heads) == 0);
+  float gw[12];
+  CHECK(MXNDArraySyncCopyToCPU(grad_nd[1], gw, sizeof(gw)) == 0);
+  // some gradient must be nonzero
+  bool nonzero = false;
+  for (int i = 0; i < 12; ++i) nonzero = nonzero || gw[i] != 0.0f;
+  CHECK(nonzero);
+
+  const char *dbg;
+  CHECK(MXExecutorPrint(exec, &dbg) == 0);
+  CHECK(std::strlen(dbg) > 0);
+  CHECK(MXExecutorFree(exec) == 0);
+  std::printf("symbol/executor ok\n");
+}
+
+static void TestKVStoreOptimizer() {
+  KVStoreHandle kv;
+  CHECK(MXKVStoreCreate("local", &kv) == 0);
+  const char *type;
+  CHECK(MXKVStoreGetType(kv, &type) == 0);
+  int rank, size;
+  CHECK(MXKVStoreGetRank(kv, &rank) == 0 && rank == 0);
+  CHECK(MXKVStoreGetGroupSize(kv, &size) == 0 && size == 1);
+
+  mx_uint shape[1] = {4};
+  NDArrayHandle w, g;
+  CHECK(MXNDArrayCreate(shape, 1, 1, 0, 0, &w) == 0);
+  CHECK(MXNDArrayCreate(shape, 1, 1, 0, 0, &g) == 0);
+  float wv[4] = {1, 2, 3, 4}, gv[4] = {1, 1, 1, 1};
+  CHECK(MXNDArraySyncCopyFromCPU(w, wv, sizeof(wv)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(g, gv, sizeof(gv)) == 0);
+  int keys[1] = {3};
+  NDArrayHandle vals[1] = {w};
+  CHECK(MXKVStoreInit(kv, 1, keys, vals) == 0);
+  NDArrayHandle pushv[1] = {g};
+  CHECK(MXKVStorePush(kv, 1, keys, pushv, 0) == 0);
+  NDArrayHandle pullv[1] = {w};
+  CHECK(MXKVStorePull(kv, 1, keys, pullv, 0) == 0);
+  float after[4];
+  CHECK(MXNDArraySyncCopyToCPU(w, after, sizeof(after)) == 0);
+  // default local store assigns the merged push value; pull returns it
+  CHECK(after[0] == 1.0f && after[3] == 1.0f);
+
+  OptimizerCreator creator;
+  CHECK(MXOptimizerFindCreator("sgd", &creator) == 0);
+  const char *okeys[] = {"momentum"};
+  const char *ovals[] = {"0.9"};
+  OptimizerHandle opt;
+  CHECK(MXOptimizerCreateOptimizer(creator, 1, okeys, ovals, &opt) == 0);
+  CHECK(MXOptimizerUpdate(opt, 0, w, g, 0.1f, 0.0f) == 0);
+  float upd[4];
+  CHECK(MXNDArraySyncCopyToCPU(w, upd, sizeof(upd)) == 0);
+  CHECK(upd[0] < after[0]);  // sgd stepped downhill on +1 grads
+  CHECK(MXOptimizerFree(opt) == 0);
+  CHECK(MXKVStoreFree(kv) == 0);
+  std::printf("kvstore/optimizer ok\n");
+}
+
+static void TestRecordIO(const std::string &tmpdir) {
+  std::string uri = tmpdir + "/test.rec";
+  RecordIOHandle w;
+  CHECK(MXRecordIOWriterCreate(uri.c_str(), &w) == 0);
+  const char *rec1 = "hello record";
+  const char *rec2 = "second";
+  CHECK(MXRecordIOWriterWriteRecord(w, rec1, std::strlen(rec1)) == 0);
+  CHECK(MXRecordIOWriterWriteRecord(w, rec2, std::strlen(rec2)) == 0);
+  CHECK(MXRecordIOWriterFree(w) == 0);
+  RecordIOHandle r;
+  CHECK(MXRecordIOReaderCreate(uri.c_str(), &r) == 0);
+  const char *buf; size_t size;
+  CHECK(MXRecordIOReaderReadRecord(r, &buf, &size) == 0);
+  CHECK(size == std::strlen(rec1) && std::memcmp(buf, rec1, size) == 0);
+  CHECK(MXRecordIOReaderReadRecord(r, &buf, &size) == 0);
+  CHECK(size == std::strlen(rec2));
+  CHECK(MXRecordIOReaderReadRecord(r, &buf, &size) == 0);
+  CHECK(buf == nullptr);  // EOF
+  CHECK(MXRecordIOReaderFree(r) == 0);
+  std::printf("recordio ok\n");
+}
+
+static void TestPredict(const std::string &prefix) {
+  std::string json = ReadFile(prefix + "-symbol.json");
+  std::string params = ReadFile(prefix + "-0001.params");
+  const char *input_keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shdata[] = {1, 8};
+  PredictorHandle pred;
+  CHECK(MXPredCreate(json.c_str(), params.data(),
+                     static_cast<int>(params.size()), 1, 0, 1, input_keys,
+                     indptr, shdata, &pred) == 0);
+  mx_uint *oshape; mx_uint ondim;
+  CHECK(MXPredGetOutputShape(pred, 0, &oshape, &ondim) == 0);
+  CHECK(ondim == 2 && oshape[0] == 1 && oshape[1] == 3);
+  float in[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+  CHECK(MXPredSetInput(pred, "data", in, 8) == 0);
+  CHECK(MXPredForward(pred) == 0);
+  float out[3];
+  CHECK(MXPredGetOutput(pred, 0, out, 3) == 0);
+  float sum = out[0] + out[1] + out[2];
+  CHECK(sum > 0.99f && sum < 1.01f);  // softmax output sums to 1
+
+  NDListHandle ndlist; mx_uint nd_len;
+  CHECK(MXNDListCreate(params.data(), static_cast<int>(params.size()),
+                       &ndlist, &nd_len) == 0);
+  CHECK(nd_len >= 2);
+  const char *key; const mx_float *data; const mx_uint *shape; mx_uint ndim;
+  CHECK(MXNDListGet(ndlist, 0, &key, &data, &shape, &ndim) == 0);
+  CHECK(std::strlen(key) > 0 && ndim > 0);
+  CHECK(MXNDListFree(ndlist) == 0);
+  CHECK(MXPredFree(pred) == 0);
+  std::printf("predict ok\n");
+}
+
+int main(int argc, char **argv) {
+  CHECK(argc >= 2);
+  std::string prefix = argv[1];
+  std::string tmpdir = prefix.substr(0, prefix.find_last_of('/'));
+  CHECK(MXRandomSeed(0) == 0);
+  TestNDArray();
+  TestSymbolExecutor();
+  TestKVStoreOptimizer();
+  TestRecordIO(tmpdir);
+  TestPredict(prefix);
+  CHECK(MXNDArrayWaitAll() == 0);
+  CHECK(MXNotifyShutdown() == 0);
+  std::printf("ALL C API TESTS PASSED\n");
+  return 0;
+}

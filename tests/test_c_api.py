@@ -1,0 +1,59 @@
+"""Build + run the C ABI test binary against libmxtpu_capi.so.
+
+Reference analogue: every language binding (R/Scala/Matlab) exercises
+include/mxnet/c_api.h; tests/cpp/ holds the native tests.  Here the C test
+program embeds CPython (hosting the JAX runtime) through the C ABI, so this
+wrapper: (1) writes a tiny MLP checkpoint for the predict-API leg, (2)
+compiles tests/cpp/test_c_api.cc against include/c_api.h, (3) runs it in a
+clean subprocess (the embedded interpreter must not inherit pytest's).
+"""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI_LIB = os.path.join(ROOT, "mxnet_tpu", "libmxtpu_capi.so")
+
+
+def _write_checkpoint(prefix):
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=3)
+    net = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    net.save(prefix + "-symbol.json")
+    rng = np.random.RandomState(0)
+    params = {
+        "arg:fc1_weight": mx.nd.array(rng.uniform(-0.1, 0.1, (3, 8))),
+        "arg:fc1_bias": mx.nd.array(np.zeros(3)),
+    }
+    mx.nd.save(prefix + "-0001.params", params)
+
+
+@pytest.mark.skipif(not os.path.exists(CAPI_LIB),
+                    reason="libmxtpu_capi.so not built (run make)")
+def test_c_api_end_to_end(tmp_path):
+    prefix = str(tmp_path / "capimlp")
+    _write_checkpoint(prefix)
+
+    binary = str(tmp_path / "test_c_api")
+    includes = sysconfig.get_paths()["include"]
+    compile_cmd = [
+        "g++", "-O1", "-std=c++17", "-I" + includes,
+        os.path.join(ROOT, "tests", "cpp", "test_c_api.cc"),
+        "-o", binary, CAPI_LIB,
+        "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu"),
+    ]
+    subprocess.run(compile_cmd, check=True)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    result = subprocess.run([binary, prefix], env=env, capture_output=True,
+                            text=True, timeout=600)
+    sys.stderr.write(result.stderr)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "ALL C API TESTS PASSED" in result.stdout
