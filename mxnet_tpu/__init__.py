@@ -54,5 +54,9 @@ from . import parallel
 from . import plugins
 from .plugins import torch_bridge as th
 from . import native_io
+# must be last: on DMLC_ROLE=server/scheduler this runs the parameter-server
+# loop and exits (reference python/mxnet/__init__.py imports kvstore_server
+# so that `import mxnet` on a server role never returns to user code)
+from . import kvstore_server
 
 __version__ = "0.7.0-tpu.1"

@@ -20,6 +20,7 @@ call-compatible with the reference python package.
 """
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Dict, List, Optional, Union
 
@@ -143,11 +144,14 @@ class KVStore:
 
     def set_optimizer(self, optimizer):
         """Reference pickles the optimizer to server processes
-        (kvstore.py:231-254); locally it becomes the updater."""
+        (kvstore.py:231-254, rank 0 ships it); locally it becomes the
+        updater."""
         from . import optimizer as opt_mod
         if self._is_distributed_server_mode():
-            optim_str = pickle.dumps(optimizer)
-            self._send_command_to_servers(0, optim_str)
+            if self.rank == 0:
+                optim_str = pickle.dumps(optimizer)
+                self._send_command_to_servers(0, optim_str)
+            self.barrier()
         else:
             self._optimizer = optimizer
             self._set_updater(opt_mod.get_updater(optimizer))
@@ -249,17 +253,101 @@ class KVStoreDistTPU(KVStore):
     barrier = _barrier
 
 
+class KVStoreDistAsync(KVStore):
+    """True asynchronous parameter server (reference ``dist_async``).
+
+    Unlike the synchronous path (XLA collectives, no servers), async SGD is
+    inherently a host-side service: the server applies each worker's push
+    IMMEDIATELY (kvstore_dist_server.h:194-202) and workers train on stale
+    weights.  This class is the worker side; scheduler/server processes run
+    via mxnet_tpu.ps (launched by tools/launch.py -s N, reference ps-lite
+    role model with DMLC_* envs).  Key->server sharding, big-array striping,
+    pickled-optimizer shipping and push-then-pull ordering all mirror the
+    reference (see mxnet_tpu/ps.py docstring).
+    """
+
+    def __init__(self, kv_type="dist_async"):
+        super().__init__(kv_type)
+        from .ps import PSWorkerClient
+        self._client = PSWorkerClient()
+
+    @property
+    def rank(self) -> int:
+        return self._client.rank
+
+    @property
+    def num_workers(self) -> int:
+        return int(os.environ.get("DMLC_NUM_WORKER", "1"))
+
+    def init(self, key, value):
+        """Rank-0 value wins; barrier so pushes can't race inits."""
+        keys, _ = _key_list(key)
+        values = _val_list(len(keys), value)
+        for k, vs in zip(keys, values):
+            self._store[k] = vs[0].copy()   # local shape/dtype record
+            if self.rank == 0:
+                self._client.init(k, vs[0].asnumpy())
+        self._client.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, _ = _key_list(key)
+        values = _val_list(len(keys), value)
+        for k, vs in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            merged = self._merge(vs)          # local device reduce first
+            self._client.push(k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0):
+        if out is None:
+            raise MXNetError("pull requires out=")
+        keys, _ = _key_list(key)
+        if isinstance(out, NDArray):
+            outs = [[out]]
+        elif len(keys) == 1 and all(isinstance(o, NDArray) for o in out):
+            outs = [list(out)]
+        else:
+            outs = [[o] if isinstance(o, NDArray) else list(o) for o in out]
+        for k, os_ in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % k)
+            ref = self._store[k]
+            val = self._client.pull(k, tuple(ref.shape), np.dtype(ref.dtype))
+            for o in os_:
+                o[:] = val
+
+    def _is_distributed_server_mode(self):
+        return True
+
+    def _send_command_to_servers(self, head, body):
+        self._client.send_command_to_servers(head, body)
+
+    def _barrier(self):
+        self._client.barrier()
+
+    barrier = _barrier
+
+    def close(self):
+        self._client.close()
+
+
 def create(name: str = "local") -> KVStore:
     """Create a KVStore (reference kvstore.cc:17-51 Create dispatch).
 
     local / local_update_cpu / local_allreduce_cpu -> host-side aggregation
     device / local_allreduce_device               -> on-accelerator aggregation
-    dist_sync / dist_sync_tpu / dist_async / dist_sync_device ->
+    dist_sync / dist_sync_tpu / dist_sync_device ->
         process-replicated store with collective aggregation (no servers)
+    dist_async -> host parameter-server (scheduler+servers via mxnet_tpu.ps)
+        when launched with DMLC_PS_ROOT_URI set (tools/launch.py -s N);
+        without the PS env it degrades to the synchronous collective path
+        (documented divergence).
     """
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     name_l = name.lower()
+    if name_l == "dist_async" and os.environ.get("DMLC_PS_ROOT_URI"):
+        return KVStoreDistAsync(name)
     if name_l.startswith("dist"):
         return KVStoreDistTPU(name)
     if name_l in ("local", "local_update_cpu", "local_allreduce_cpu",

@@ -5,10 +5,13 @@ DMLC_ROLE processes create a dist kvstore, register a controller that
 un-pickles the optimizer shipped by workers, block in RunServer, and exit.
 
 TPU-native: `dist_sync_tpu` has NO server role — aggregation is an XLA
-collective over the mesh (SURVEY §5.8 north star).  This module keeps the
-bootstrap contract: if a process is launched with DMLC_ROLE=server/scheduler
-it logs the divergence and exits cleanly instead of hanging, so reference
-launch scripts (tools/launch.py style) still work with -s 0 semantics.
+collective over the mesh (SURVEY §5.8 north star) and jobs launch with
+-s 0.  ``dist_async`` keeps the reference process model: when a process is
+launched with DMLC_ROLE=server/scheduler AND the PS rendezvous env
+(DMLC_PS_ROOT_URI, set by tools/launch.py -s N), importing mxnet_tpu runs
+the parameter-server loop (mxnet_tpu.ps) and exits — exactly the
+reference's import-time hijack (kvstore_server.py:58-68: ``import mxnet``
+on a server role never returns to user code).
 """
 from __future__ import annotations
 
@@ -28,17 +31,33 @@ class KVStoreServer:
         self.init_logging = False
 
     def run(self):
-        logging.info("dist_sync_tpu has no server processes; returning")
+        import os
+        if not (os.environ.get("DMLC_PS_ROOT_URI")
+                and os.environ.get("DMLC_NUM_WORKER")):
+            logging.info("no parameter-server environment (DMLC_PS_ROOT_URI/"
+                         "DMLC_NUM_WORKER); nothing to serve — returning")
+            return
+        from . import ps
+        ps.run_server()
 
 
 def _init_kvstore_server_module():
     role = os.environ.get("DMLC_ROLE", "worker")
-    if role in ("server", "scheduler"):
-        logging.warning(
-            "DMLC_ROLE=%s: TPU-native kvstore uses XLA collectives over the "
-            "device mesh; no server processes are needed (launch with -s 0). "
-            "Exiting cleanly.", role)
+    if role not in ("server", "scheduler"):
+        return
+    if os.environ.get("DMLC_PS_ROOT_URI"):
+        from . import ps
+        if role == "scheduler":
+            ps.run_scheduler()
+        else:
+            ps.run_server()
         sys.exit(0)
+    logging.warning(
+        "DMLC_ROLE=%s without DMLC_PS_ROOT_URI: synchronous TPU kvstore "
+        "uses XLA collectives over the device mesh and needs no server "
+        "processes (launch with -s 0; dist_async needs launch.py -s N). "
+        "Exiting cleanly.", role)
+    sys.exit(0)
 
 
 _init_kvstore_server_module()

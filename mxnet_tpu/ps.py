@@ -1,0 +1,478 @@
+"""Host-side parameter server for ``dist_async`` training.
+
+Reference: src/kvstore/kvstore_dist.h (worker), kvstore_dist_server.h
+(server), ps-lite roles (include/mxnet/kvstore.h:157-206 env config:
+DMLC_ROLE / DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT / DMLC_NUM_WORKER /
+DMLC_NUM_SERVER).
+
+TPU-native stance (SURVEY §2.4): synchronous data-parallel training rides
+XLA collectives and has NO server processes — but asynchronous SGD
+("dist_async": the server applies each worker's push immediately, workers
+read stale weights, kvstore_dist_server.h:194-202) has no ICI analogue; it
+is fundamentally a host-side service.  So the async path keeps the
+reference's process architecture — scheduler + S servers + W workers —
+re-built on stdlib TCP (multiprocessing.connection replaces the ZeroMQ
+van), with the same capability surface:
+
+* key -> server placement: small keys by ``(key*9973) % num_servers``,
+  big arrays striped contiguously across ALL servers above
+  MXNET_KVSTORE_BIGARRAY_BOUND (reference kvstore_dist.h:230-268).
+* per-worker push-then-pull ordering per key: both ride one FIFO TCP
+  connection per (worker, server), the analogue of the reference's
+  merge-buffer Var ordering (kvstore_dist.h:79-137).
+* server-side optimizer shipped as a pickled python object via the command
+  channel (reference kvstore.py:231-254 + kvstore_dist_server.h controller).
+* barrier via the scheduler (reference ps::Postoffice::Barrier).
+
+The TPU itself never appears on the server: servers hold numpy arrays in
+host RAM and apply updates with the pure-python optimizer — exactly the
+reference's CPU-side server executor.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+import zlib
+from multiprocessing.connection import Client, Listener
+
+import numpy as np
+
+__all__ = ["Scheduler", "PSServer", "PSWorkerClient", "run_scheduler",
+           "run_server", "bigarray_bound", "key_to_server", "stripe_ranges"]
+
+_AUTHKEY = b"mxnet_tpu_ps"
+
+
+def _connect_retry(addr, timeout=None):
+    """Dial with retries: roles come up in arbitrary order (each process
+    pays the jax import before its listener binds), so clients must retry
+    until the rendezvous window closes (reference ps-lite van retries)."""
+    import time
+    if timeout is None:
+        timeout = float(os.environ.get("MXNET_PS_CONNECT_TIMEOUT", "180"))
+    addr = tuple(addr) if isinstance(addr, (list, tuple)) else addr
+    deadline = time.monotonic() + timeout
+    delay = 0.05
+    while True:
+        try:
+            return Client(addr, authkey=_AUTHKEY)
+        except (ConnectionRefusedError, ConnectionResetError, OSError):
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
+
+
+def _root_addr():
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9092"))
+    return (uri, port)
+
+
+def bigarray_bound() -> int:
+    """Stripe threshold (reference env MXNET_KVSTORE_BIGARRAY_BOUND)."""
+    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000))
+
+
+def _key_int(key) -> int:
+    if isinstance(key, int):
+        return key
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return zlib.crc32(str(key).encode())
+
+
+def key_to_server(key, num_servers: int) -> int:
+    """Deterministic small-key placement (kvstore_dist.h: (key*9973)%n)."""
+    return (_key_int(key) * 9973) % num_servers
+
+
+def stripe_ranges(size: int, num_servers: int):
+    """Contiguous near-equal ranges of a flattened big array, one per
+    server (reference GetServerKeyRanges striping)."""
+    step = size // num_servers
+    ranges = []
+    for i in range(num_servers):
+        lo = i * step
+        hi = (i + 1) * step if i + 1 < num_servers else size
+        ranges.append((lo, hi))
+    return ranges
+
+
+# ---------------------------------------------------------------------------
+# scheduler: rendezvous + barrier (the ps::Postoffice role)
+# ---------------------------------------------------------------------------
+
+class Scheduler:
+    """Rendezvous point: servers register their listen address, workers
+    fetch the server list and ranks; also implements the worker barrier."""
+
+    def __init__(self, num_workers: int, num_servers: int, addr=None):
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        addr = addr or _root_addr()
+        self.listener = Listener(addr, authkey=_AUTHKEY)
+        self.server_addrs = [None] * num_servers
+        self._lock = threading.Lock()
+        self._servers_ready = threading.Event()
+        self._barrier_conns = []
+        self._worker_ranks = 0
+        self._server_ranks = 0
+
+    def serve_forever(self):
+        threads = []
+        # one connection per role-process; scheduler exits once every worker
+        # has sent "stop" and every connection closed.
+        conns_expected = self.num_workers + self.num_servers
+        for _ in range(conns_expected):
+            conn = self.listener.accept()
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        self.listener.close()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                kind = msg[0]
+                if kind == "reg_server":
+                    with self._lock:
+                        rank = self._server_ranks
+                        self._server_ranks += 1
+                        self.server_addrs[rank] = msg[1]
+                        if all(a is not None for a in self.server_addrs):
+                            self._servers_ready.set()
+                    conn.send(("rank", rank))
+                elif kind == "reg_worker":
+                    self._servers_ready.wait()
+                    with self._lock:
+                        rank = self._worker_ranks
+                        self._worker_ranks += 1
+                    conn.send(("servers", list(self.server_addrs), rank))
+                elif kind == "barrier":
+                    release = []
+                    with self._lock:
+                        self._barrier_conns.append(conn)
+                        if len(self._barrier_conns) == self.num_workers:
+                            release = self._barrier_conns
+                            self._barrier_conns = []
+                    for c in release:
+                        c.send(("barrier_ok",))
+                elif kind == "stop":
+                    conn.send(("bye",))
+                    return
+        finally:
+            conn.close()
+
+
+# ---------------------------------------------------------------------------
+# server: holds weights, applies updates (kvstore_dist_server.h role)
+# ---------------------------------------------------------------------------
+
+class _MainThreadExec:
+    """Synchronous executor: handler threads submit closures, the server's
+    MAIN thread runs them (reference kvstore_dist_server.h:28-85 Executor —
+    "dedicated Executor thread so python updater runs on the RunServer
+    thread").  Essential here beyond reference parity: the server loop runs
+    while ``import mxnet_tpu`` is still on the main thread's stack
+    (kvstore_server import hijack), so any python-level work that can
+    trigger an import — unpickling the optimizer, building NDArrays —
+    would DEADLOCK on the package import lock if run from a handler
+    thread; the main thread holds that lock reentrantly."""
+
+    def __init__(self):
+        import queue
+        self._q = queue.Queue()
+
+    def exec(self, fn):
+        """Submit fn and block until the main thread has run it."""
+        done = threading.Event()
+        box = {}
+
+        def task():
+            try:
+                box["result"] = fn()
+            except BaseException as e:   # marshal errors to the caller
+                box["error"] = e
+            done.set()
+
+        self._q.put(task)
+        done.wait()
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def run_until(self, stop_event):
+        while not stop_event.is_set():
+            task = self._q.get()
+            if task is None:
+                continue
+            task()
+
+    def wake(self):
+        self._q.put(None)
+
+
+class PSServer:
+    """Async parameter server: ``push`` applies the update IMMEDIATELY per
+    worker (stale-weight async SGD, kvstore_dist_server.h:194-202); without
+    an updater it accumulates (the default merge ``stored += merged`` that
+    the nightly arithmetic test relies on).  All mutations run serialized
+    on the main thread via _MainThreadExec; handler threads only do socket
+    IO and locked reads."""
+
+    def __init__(self, num_workers: int, root=None):
+        self.num_workers = num_workers
+        self.store = {}
+        self.updater = None
+        self._lock = threading.Lock()
+        self._exec = _MainThreadExec()
+        # own listen socket on an ephemeral port
+        host = os.environ.get("DMLC_NODE_HOST", "127.0.0.1")
+        self.listener = Listener((host, 0), authkey=_AUTHKEY)
+        self.addr = self.listener.address
+        # register with the scheduler
+        sched = _connect_retry(root or _root_addr())
+        sched.send(("reg_server", self.addr))
+        self.rank = sched.recv()[1]
+        self._sched = sched
+
+    def serve_forever(self):
+        """Run the executor on this (main) thread; accept one connection
+        per worker on a helper thread; exit when all workers stopped."""
+        stop = threading.Event()
+
+        def acceptor():
+            threads = []
+            for _ in range(self.num_workers):
+                conn = self.listener.accept()
+                t = threading.Thread(target=self._handle, args=(conn,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+            stop.set()
+            self._exec.wake()
+
+        accept_thread = threading.Thread(target=acceptor, daemon=True)
+        accept_thread.start()
+        self._exec.run_until(stop)
+        accept_thread.join()
+        self.listener.close()
+        try:
+            self._sched.send(("stop",))
+            self._sched.recv()
+            self._sched.close()
+        except (EOFError, OSError):
+            pass
+
+    # the three mutators below always run on the main thread via _exec ------
+    def _do_init(self, key, value):
+        with self._lock:
+            # rank-0 value wins: first init wins, later ignored
+            if key not in self.store:
+                self.store[key] = np.array(value, copy=True)
+
+    def _apply_push(self, key, value):
+        with self._lock:
+            stored = self.store.get(key)
+            if stored is None:
+                # first push before init: treat as init (reference servers
+                # lazily create entries on first push)
+                self.store[key] = np.array(value, copy=True)
+                return
+            if self.updater is not None:
+                self.updater(key, value, stored)   # in-place on stored
+            else:
+                stored += value
+
+    def _command(self, head, body):
+        """Command channel (reference kvstore_dist_server.h:91-135):
+        head 0 carries the pickled optimizer -> become the updater."""
+        if head == 0:
+            from . import optimizer as opt_mod
+            optimizer = pickle.loads(body)
+            updater = opt_mod.get_updater(optimizer)
+
+            def np_updater(key, grad, stored):
+                from .ndarray import array as nd_array
+                w = nd_array(stored)
+                updater(_key_int(key), nd_array(grad), w)
+                stored[...] = w.asnumpy()
+
+            with self._lock:
+                self.updater = np_updater
+
+    def _handle(self, conn):
+        try:
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                kind = msg[0]
+                if kind == "init":
+                    _, key, value = msg
+                    self._exec.exec(lambda: self._do_init(key, value))
+                    conn.send(("init_ok",))
+                elif kind == "push":
+                    # blocking exec keeps this worker's FIFO ordering while
+                    # the worker itself never waits (fire-and-forget send)
+                    key, value = msg[1], msg[2]
+                    self._exec.exec(lambda: self._apply_push(key, value))
+                elif kind == "pull":
+                    with self._lock:
+                        val = np.array(self.store[msg[1]], copy=True)
+                    conn.send(("val", val))
+                elif kind == "cmd":
+                    head, body = msg[1], msg[2]
+                    self._exec.exec(lambda: self._command(head, body))
+                    conn.send(("cmd_ok",))
+                elif kind == "stop":
+                    conn.send(("bye",))
+                    return
+        finally:
+            conn.close()
+
+
+# ---------------------------------------------------------------------------
+# worker-side client
+# ---------------------------------------------------------------------------
+
+class PSWorkerClient:
+    """One per worker process: connections to the scheduler and to every
+    server.  Push is fire-and-forget (no reply) — the python thread never
+    blocks on the update, mirroring the reference's async ZPush; ordering
+    per (worker, server) is the TCP FIFO."""
+
+    def __init__(self, root=None):
+        root = root or _root_addr()
+        self._sched = _connect_retry(root)
+        self._sched.send(("reg_worker",))
+        msg = self._recv(self._sched, "scheduler registration")
+        self.server_addrs = msg[1]
+        self.rank = int(os.environ.get("DMLC_WORKER_ID", msg[2]))
+        self.num_servers = len(self.server_addrs)
+        self._conns = [_connect_retry(a) for a in self.server_addrs]
+        self._locks = [threading.Lock() for _ in self._conns]
+        self._sched_lock = threading.Lock()
+
+    @staticmethod
+    def _recv(conn, what):
+        """Bounded recv: a dead server/scheduler turns into a clear error
+        instead of an indefinite hang (the reference job simply hung on
+        node death, SURVEY §5.3 — we can do better than that)."""
+        timeout = float(os.environ.get("MXNET_PS_RECV_TIMEOUT", "600"))
+        if not conn.poll(timeout):
+            raise RuntimeError(
+                "parameter-server RPC timed out after %.0fs waiting for %s "
+                "(server process dead? raise MXNET_PS_RECV_TIMEOUT if not)"
+                % (timeout, what))
+        try:
+            return conn.recv()
+        except (EOFError, OSError) as e:
+            raise RuntimeError(
+                "parameter-server connection lost while waiting for %s: %s"
+                % (what, e))
+
+    # -- placement ----------------------------------------------------------
+    def _plan(self, key, size):
+        """Return [(server, lo, hi)] covering the flattened value."""
+        if size >= bigarray_bound() and self.num_servers > 1:
+            return [(s, lo, hi) for s, (lo, hi)
+                    in enumerate(stripe_ranges(size, self.num_servers))]
+        return [(key_to_server(key, self.num_servers), 0, size)]
+
+    # -- data plane ---------------------------------------------------------
+    def init(self, key, value: np.ndarray):
+        flat = np.ascontiguousarray(value).reshape(-1)
+        for s, lo, hi in self._plan(key, flat.size):
+            with self._locks[s]:
+                self._conns[s].send(("init", key, flat[lo:hi]))
+                self._recv(self._conns[s], "init ack")
+
+    def push(self, key, value: np.ndarray):
+        flat = np.ascontiguousarray(value).reshape(-1)
+        for s, lo, hi in self._plan(key, flat.size):
+            with self._locks[s]:
+                self._conns[s].send(("push", key, flat[lo:hi]))
+
+    def pull(self, key, shape, dtype) -> np.ndarray:
+        size = int(np.prod(shape)) if shape else 1
+        out = np.empty(size, dtype)
+        for s, lo, hi in self._plan(key, size):
+            with self._locks[s]:
+                self._conns[s].send(("pull", key))
+                out[lo:hi] = self._recv(self._conns[s], "pull reply")[1]
+        return out.reshape(shape)
+
+    # -- control plane ------------------------------------------------------
+    def send_command_to_servers(self, head, body):
+        for s in range(self.num_servers):
+            with self._locks[s]:
+                self._conns[s].send(("cmd", head, body))
+                self._recv(self._conns[s], "command ack")
+
+    def barrier(self):
+        with self._sched_lock:
+            self._sched.send(("barrier",))
+            self._recv(self._sched, "barrier release")
+
+    def close(self):
+        for s in range(self.num_servers):
+            try:
+                with self._locks[s]:
+                    self._conns[s].send(("stop",))
+                    self._conns[s].recv()
+                    self._conns[s].close()
+            except (EOFError, OSError):
+                pass
+        try:
+            with self._sched_lock:
+                self._sched.send(("stop",))
+                self._sched.recv()
+                self._sched.close()
+        except (EOFError, OSError):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# role entry points (invoked from kvstore_server on import, launch.py)
+# ---------------------------------------------------------------------------
+
+def _require_env(*names):
+    missing = [n for n in names if not os.environ.get(n)]
+    if missing:
+        raise RuntimeError(
+            "parameter-server role needs %s in the environment (set by "
+            "tools/launch.py -s N; see docs/multi_node.md)"
+            % ", ".join(missing))
+
+
+def run_scheduler():
+    _require_env("DMLC_NUM_WORKER", "DMLC_NUM_SERVER")
+    num_workers = int(os.environ["DMLC_NUM_WORKER"])
+    num_servers = int(os.environ["DMLC_NUM_SERVER"])
+    logging.info("ps scheduler: %d workers, %d servers", num_workers,
+                 num_servers)
+    Scheduler(num_workers, num_servers).serve_forever()
+
+
+def run_server():
+    _require_env("DMLC_NUM_WORKER")
+    num_workers = int(os.environ["DMLC_NUM_WORKER"])
+    server = PSServer(num_workers)
+    logging.info("ps server rank %d listening on %s", server.rank,
+                 server.addr)
+    server.serve_forever()

@@ -10,16 +10,20 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(n, script, timeout=110):
+def _launch(n, script, timeout=110, servers=0, port=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = ""
     env.pop("XLA_FLAGS", None)  # workers use default 1 cpu device each
-    return subprocess.run(
-        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
-         "-n", str(n), "--launcher", "local",
-         "%s %s" % (sys.executable, os.path.join(ROOT, script))],
-        capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
+    args = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+            "-n", str(n), "--launcher", "local"]
+    if servers:
+        args += ["-s", str(servers)]
+    if port:
+        args += ["--port", str(port)]
+    args.append("%s %s" % (sys.executable, os.path.join(ROOT, script)))
+    return subprocess.run(args, capture_output=True, text=True,
+                          timeout=timeout, env=env, cwd=ROOT)
 
 
 def test_dist_sync_kvstore_2workers():
@@ -30,5 +34,23 @@ def test_dist_sync_kvstore_2workers():
 
 def test_dist_mlp_2workers_convergence():
     res = _launch(2, "tests/nightly/dist_mlp.py")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr
+
+
+def test_dist_async_mlp_convergence():
+    """Async SGD end-to-end: Module.fit with server-side optimizer
+    (update_on_kvstore), stale-weight pulls, accuracy gate."""
+    res = _launch(2, "tests/nightly/dist_async_mlp.py", servers=2,
+                  port=9096, timeout=160)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr
+
+
+def test_dist_async_kvstore_2workers_2servers():
+    """Real parameter-server path: scheduler + 2 servers + 2 workers
+    (reference ps-lite process model, async update semantics)."""
+    res = _launch(2, "tests/nightly/dist_async_kvstore.py", servers=2,
+                  port=9095)
     assert res.returncode == 0, res.stdout + res.stderr
     assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr

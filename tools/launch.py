@@ -3,13 +3,16 @@
 re-designed for TPU).
 
 The reference launched scheduler + server + worker processes over
-ssh/mpi/sge/yarn via dmlc-tracker.  The TPU-native stack has NO server or
-scheduler roles — every process is a worker participating in XLA collectives
-(SURVEY §5.8).  This launcher covers:
+ssh/mpi/sge/yarn via dmlc-tracker.  The TPU-native synchronous stack has NO
+server or scheduler roles — every process is a worker participating in XLA
+collectives (SURVEY §5.8); asynchronous training (``dist_async``) keeps the
+reference's scheduler+servers+workers process model (mxnet_tpu.ps), enabled
+with -s N.  This launcher covers:
 
-* local  : fork N worker processes on this host (jax.distributed rendezvous
-           via a local coordinator) — the analogue of the reference's local
-           launcher used by tests/nightly/test_all.sh.
+* local  : fork processes on this host — N workers (jax.distributed
+           rendezvous via a local coordinator; the analogue of the
+           reference's local launcher used by tests/nightly/test_all.sh),
+           plus scheduler + S servers when -s is given.
 * ssh    : start one worker per host in a hostfile, pointing all of them at
            the rank-0 coordinator address.
 * tpu-pod: on Cloud-TPU-style pods the runtime injects topology env vars and
@@ -25,15 +28,38 @@ import sys
 def local_launch(args, cmd):
     procs = []
     env = dict(os.environ)
-    env["MXNET_TPU_COORDINATOR"] = "127.0.0.1:%d" % args.port
-    env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
-    for rank in range(args.num_workers):
-        worker_env = dict(env)
-        worker_env["MXNET_TPU_WORKER_ID"] = str(rank)
-        # reference-compat aliases so ports of reference scripts work
-        worker_env["DMLC_ROLE"] = "worker"
-        worker_env["DMLC_NUM_WORKER"] = str(args.num_workers)
-        procs.append(subprocess.Popen(cmd, shell=True, env=worker_env))
+    if args.num_servers:
+        # dist_async parameter-server mode (reference ps-lite role model):
+        # scheduler + S servers + W workers, rendezvous via DMLC_PS_ROOT_*.
+        env["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+        env["DMLC_PS_ROOT_PORT"] = str(args.port)
+        env["DMLC_NUM_WORKER"] = str(args.num_workers)
+        env["DMLC_NUM_SERVER"] = str(args.num_servers)
+        for role, count in (("scheduler", 1), ("server", args.num_servers)):
+            for _ in range(count):
+                role_env = dict(env)
+                role_env["DMLC_ROLE"] = role
+                # `import mxnet_tpu` on a non-worker role runs the PS loop
+                # and exits (kvstore_server.py) — same command everywhere,
+                # like the reference dmlc-tracker launch.
+                procs.append(subprocess.Popen(cmd, shell=True, env=role_env))
+        for rank in range(args.num_workers):
+            worker_env = dict(env)
+            worker_env["DMLC_ROLE"] = "worker"
+            worker_env["DMLC_WORKER_ID"] = str(rank)
+            procs.append(subprocess.Popen(cmd, shell=True, env=worker_env))
+    else:
+        # synchronous collective mode: workers only, jax.distributed
+        # rendezvous at the rank-0 coordinator.
+        env["MXNET_TPU_COORDINATOR"] = "127.0.0.1:%d" % args.port
+        env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
+        for rank in range(args.num_workers):
+            worker_env = dict(env)
+            worker_env["MXNET_TPU_WORKER_ID"] = str(rank)
+            # reference-compat aliases so ports of reference scripts work
+            worker_env["DMLC_ROLE"] = "worker"
+            worker_env["DMLC_NUM_WORKER"] = str(args.num_workers)
+            procs.append(subprocess.Popen(cmd, shell=True, env=worker_env))
     code = 0
     try:
         for p in procs:
@@ -69,8 +95,10 @@ def main():
     parser.add_argument("-n", "--num-workers", required=True, type=int,
                         help="number of worker processes")
     parser.add_argument("-s", "--num-servers", type=int, default=0,
-                        help="accepted for reference compatibility; must be 0 "
-                             "(no server role on TPU)")
+                        help="number of parameter-server processes; 0 (the "
+                             "default) = synchronous collective mode (no "
+                             "server role on TPU), N>0 = dist_async "
+                             "parameter-server mode")
     parser.add_argument("--launcher", type=str, default="local",
                         choices=["local", "ssh", "tpu-pod"])
     parser.add_argument("-H", "--hostfile", type=str,
@@ -79,11 +107,12 @@ def main():
     parser.add_argument("command", nargs="+", help="command to launch")
     args = parser.parse_args()
 
-    if args.num_servers:
-        sys.stderr.write("warning: -s %d ignored — TPU kvstore has no server "
-                         "processes (aggregation is an XLA collective)\n"
-                         % args.num_servers)
     cmd = " ".join(args.command)
+    if args.num_servers and args.launcher != "local":
+        sys.stderr.write(
+            "warning: -s %d only supported by the local launcher; %s runs "
+            "workers only (synchronous collectives, NOT dist_async)\n"
+            % (args.num_servers, args.launcher))
     if args.launcher == "local":
         sys.exit(local_launch(args, cmd))
     elif args.launcher == "ssh":
