@@ -33,16 +33,16 @@ def build_step(batch, compute_dtype="bfloat16"):
     return step, state, sharded
 
 
-def run(batch, warmup=3, iters=10):
+def run(batch, warmup=5, iters=50):
     import jax
     step, state, batch_data = build_step(batch)
     for _ in range(warmup):
         state, outs = step(state, batch_data)
-    jax.block_until_ready(outs)
+    jax.block_until_ready((state, outs))
     t0 = time.perf_counter()
     for _ in range(iters):
         state, outs = step(state, batch_data)
-    jax.block_until_ready(outs)
+    jax.block_until_ready((state, outs))
     dt = time.perf_counter() - t0
     return batch * iters / dt
 

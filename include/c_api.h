@@ -55,6 +55,8 @@ MXTPU_DLL int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
 MXTPU_DLL int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
                                 int dev_type, int dev_id, int delay_alloc,
                                 int dtype, NDArrayHandle *out);
+/* `size` is the ELEMENT count (reference c_api.h convention, same as
+ * MXPredSetInput/MXPredGetOutput); a mismatch with the array size fails. */
 MXTPU_DLL int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
                                        size_t size);
 MXTPU_DLL int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data,

@@ -88,14 +88,28 @@ def ndarray_create(shape: List[int], dev_type: int, dev_id: int,
     return _put(arr)
 
 
-def ndarray_sync_copy_from(h: int, data: bytes) -> None:
+def ndarray_sync_copy_from(h: int, data: bytes, size: int = -1) -> None:
+    """size is the element count (reference MXNDArraySyncCopyFromCPU
+    convention); -1 skips the check (internal callers)."""
     arr = _get(h)
+    n = int(np.prod(arr.shape)) if arr.shape else 1
+    if size >= 0 and size != n:
+        raise ValueError(
+            "SyncCopyFromCPU size mismatch: array has %d elements, got %d"
+            % (n, size))
     src = np.frombuffer(data, dtype=arr.dtype).reshape(arr.shape)
     arr._sync_copyfrom(src)
 
 
-def ndarray_sync_copy_to(h: int) -> bytes:
-    return np.ascontiguousarray(_get(h).asnumpy()).tobytes()
+def ndarray_sync_copy_to(h: int, size: int = -1) -> bytes:
+    """size is the element count; -1 skips the check (internal callers)."""
+    arr = _get(h)
+    n = int(np.prod(arr.shape)) if arr.shape else 1
+    if size >= 0 and size != n:
+        raise ValueError(
+            "SyncCopyToCPU size mismatch: array has %d elements, got %d"
+            % (n, size))
+    return np.ascontiguousarray(arr.asnumpy()).tobytes()
 
 
 def ndarray_wait_to_read(h: int) -> None:
@@ -129,6 +143,13 @@ def ndarray_get_shape(h: int) -> List[int]:
 
 def ndarray_get_dtype(h: int) -> int:
     return _DTYPE_TO_CODE[np.dtype(_get(h).dtype).name]
+
+
+def ndarray_get_itemsize(h: int) -> int:
+    dt = np.dtype(_get(h).dtype)
+    if dt.name == "bfloat16":
+        return 2
+    return dt.itemsize
 
 
 def ndarray_get_context(h: int) -> List[int]:
@@ -193,6 +214,29 @@ def func_get_info(name: str):
     return [name, doc]
 
 
+_ACCEPTS_OUT_CACHE: Dict[int, bool] = {}
+
+
+def _accepts_out(fn) -> bool:
+    """True if fn can take an out= kwarg (named param or **kwargs).
+    Signature inspection instead of try/except so a TypeError raised INSIDE
+    the function body is never mistaken for 'no out kwarg' (which would
+    re-execute fn and apply side effects twice).  Cached per function:
+    MXFuncInvoke is the C-side operator hot path."""
+    cached = _ACCEPTS_OUT_CACHE.get(id(fn))
+    if cached is not None:
+        return cached
+    import inspect
+    try:
+        params = inspect.signature(fn).parameters
+        result = "out" in params or any(
+            p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values())
+    except (TypeError, ValueError):
+        result = True  # builtins without signatures: assume out= works
+    _ACCEPTS_OUT_CACHE[id(fn)] = result
+    return result
+
+
 def func_invoke(name: str, use_handles: List[int], scalars: List[float],
                 mutate_handles: List[int]) -> None:
     nd = _nd()
@@ -203,11 +247,9 @@ def func_invoke(name: str, use_handles: List[int], scalars: List[float],
     if not outs:
         fn(*args)
         return
-    try:
+    if _accepts_out(fn):
         fn(*args, out=outs[0])
         return
-    except TypeError:
-        pass  # function has no out= kwarg; copy the result instead
     res = fn(*args)
     if isinstance(res, (list, tuple)):
         res = res[0]
@@ -520,9 +562,10 @@ def kvstore_pull(h: int, keys: List[int], out_handles: List[int],
     _get(h).pull(keys, [_get(v) for v in out_handles], priority=priority)
 
 
-def kvstore_set_updater_addr(h: int, fn_addr: int) -> None:
+def kvstore_set_updater_addr(h: int, fn_addr: int, ctx_addr: int = 0) -> None:
     """Wrap a C callback ``void (*)(int key, NDArrayHandle recv,
-    NDArrayHandle local, void*)`` (c_api.h MXKVStoreUpdater) via ctypes."""
+    NDArrayHandle local, void*)`` (c_api.h MXKVStoreUpdater) via ctypes;
+    ctx_addr is the caller's opaque updater_handle, passed back verbatim."""
     import ctypes
     cb_type = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
                                ctypes.c_void_p, ctypes.c_void_p)
@@ -531,7 +574,7 @@ def kvstore_set_updater_addr(h: int, fn_addr: int) -> None:
     def updater(key, recv, local):
         hrecv, hlocal = _put(recv), _put(local)
         try:
-            cfn(int(key), hrecv, hlocal, None)
+            cfn(int(key), hrecv, hlocal, ctx_addr or None)
         finally:
             # handles are lent to the callback for its duration only
             # (reference engine frees them after the updater returns)

@@ -14,6 +14,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -25,8 +26,11 @@ using namespace mxtpu_capi;  // NOLINT
 
 namespace {
 
-/* Host mirrors for MXNDArrayGetData: bytes live until the array is freed. */
-std::unordered_map<void *, std::string> host_mirror;
+/* Host mirrors for MXNDArrayGetData: bytes live until the array is freed.
+ * Append-only per handle (a deque of immutable strings) so a pointer handed
+ * to one caller is never invalidated by a later GetData on the same handle
+ * from this or another thread. */
+std::unordered_map<void *, std::deque<std::string>> host_mirror;
 std::mutex host_mirror_mu;
 
 }  // namespace
@@ -78,25 +82,43 @@ int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
   return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0, out);
 }
 
+/* bytes per element, answered by the bridge (numpy knows the itemsize for
+ * every dtype — no table here to drift out of sync with _DTYPE_TO_CODE). */
+static int DTypeItemSize(NDArrayHandle handle) {
+  PyObject *ret = BridgeCall("ndarray_get_itemsize",
+                             Py_BuildValue("(L)", H(handle)));
+  if (ret == nullptr) return -1;
+  int itemsize = static_cast<int>(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return itemsize;
+}
+
+/* `size` is the ELEMENT count, matching the reference ABI
+ * (c_api.h MXNDArraySyncCopyFromCPU: "size - the memory size in elements");
+ * a mismatch with the array's size is an error, never a silent clamp. */
 int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
                              size_t size) {
   API_BEGIN();
+  int itemsize = DTypeItemSize(handle);
+  if (itemsize < 0) return -1;
   PyObject *bytes = PyBytes_FromStringAndSize(
-      static_cast<const char *>(data), static_cast<Py_ssize_t>(size));
+      static_cast<const char *>(data),
+      static_cast<Py_ssize_t>(size) * itemsize);
   CHECK_CALL(BridgeCall("ndarray_sync_copy_from",
-                        Py_BuildValue("(LN)", H(handle), bytes)));
+                        Py_BuildValue("(LNn)", H(handle), bytes,
+                                      static_cast<Py_ssize_t>(size))));
   API_END();
 }
 
 int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
   API_BEGIN();
   PyObject *ret = BridgeCall("ndarray_sync_copy_to",
-                             Py_BuildValue("(L)", H(handle)));
+                             Py_BuildValue("(Ln)", H(handle),
+                                           static_cast<Py_ssize_t>(size)));
   if (ret == nullptr) return -1;
   char *buf; Py_ssize_t n;
   PyBytes_AsStringAndSize(ret, &buf, &n);
-  if (static_cast<size_t>(n) < size) size = static_cast<size_t>(n);
-  std::memcpy(data, buf, size);
+  std::memcpy(data, buf, static_cast<size_t>(n));
   Py_DECREF(ret);
   API_END();
 }
@@ -182,8 +204,16 @@ int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
   PyBytes_AsStringAndSize(ret, &buf, &n);
   {
     std::lock_guard<std::mutex> lk(host_mirror_mu);
-    host_mirror[handle].assign(buf, static_cast<size_t>(n));
-    *out_pdata = const_cast<char *>(host_mirror[handle].data());
+    auto &mirrors = host_mirror[handle];
+    // dedupe: repeated GetData on an unchanged array reuses the last
+    // snapshot, so polling loops don't grow memory; only distinct
+    // snapshots accumulate (their pointers must stay valid until free)
+    if (mirrors.empty() ||
+        mirrors.back().compare(0, std::string::npos, buf,
+                               static_cast<size_t>(n)) != 0) {
+      mirrors.emplace_back(buf, static_cast<size_t>(n));
+    }
+    *out_pdata = const_cast<char *>(mirrors.back().data());
   }
   Py_DECREF(ret);
   API_END();
@@ -866,13 +896,13 @@ int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
 
 int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
                         void *updater_handle) {
-  (void)updater_handle;
   API_BEGIN();
   CHECK_CALL(BridgeCall(
       "kvstore_set_updater_addr",
-      Py_BuildValue("(LL)", H(handle),
+      Py_BuildValue("(LLL)", H(handle),
                     static_cast<long long>(
-                        reinterpret_cast<intptr_t>(updater)))));
+                        reinterpret_cast<intptr_t>(updater)),
+                    H(updater_handle))));
   API_END();
 }
 

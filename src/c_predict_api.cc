@@ -9,7 +9,9 @@
 #include <Python.h>
 
 #include <cstring>
+#include <mutex>
 #include <string>
+#include <unordered_map>
 
 #include "../include/c_predict_api.h"
 #include "c_api_common.h"
@@ -17,6 +19,12 @@
 using namespace mxtpu_capi;  // NOLINT
 
 namespace {
+
+/* Per-NDList return storage: pointers from MXNDListGet stay valid until
+ * MXNDListFree (the reference contract), NOT merely until the next Get —
+ * callers commonly collect pointers for every index before reading any. */
+std::unordered_map<void *, ReturnArena> ndlist_store;
+std::mutex ndlist_mu;
 
 /* Build the bridge args shared by MXPredCreate / MXPredCreatePartialOut. */
 PyObject *PredArgs(const char *symbol_json_str, const void *param_bytes,
@@ -127,7 +135,13 @@ int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
   char *buf; Py_ssize_t n;
   PyBytes_AsStringAndSize(ret, &buf, &n);
   size_t want = static_cast<size_t>(size) * sizeof(mx_float);
-  if (static_cast<size_t>(n) < want) want = static_cast<size_t>(n);
+  if (static_cast<size_t>(n) != want) {
+    Py_DECREF(ret);
+    last_error = "MXPredGetOutput size mismatch: output has " +
+                 std::to_string(n / sizeof(mx_float)) +
+                 " elements, caller asked for " + std::to_string(size);
+    return -1;
+  }
   std::memcpy(data, buf, want);
   Py_DECREF(ret);
   API_END();
@@ -158,19 +172,20 @@ int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
   PyObject *ret = BridgeCall("ndlist_get",
                              Py_BuildValue("(LI)", H(handle), index));
   if (ret == nullptr) return -1;
-  arena.clear();
-  arena.strs.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(ret, 0)));
-  *out_key = arena.strs.back().c_str();
+  std::lock_guard<std::mutex> lk(ndlist_mu);
+  ReturnArena &store = ndlist_store[handle];
+  store.strs.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(ret, 0)));
+  *out_key = store.strs.back().c_str();
   char *buf; Py_ssize_t n;
   PyBytes_AsStringAndSize(PyTuple_GetItem(ret, 1), &buf, &n);
-  arena.float_arrays.emplace_back();
-  auto &fdata = arena.float_arrays.back();
+  store.float_arrays.emplace_back();
+  auto &fdata = store.float_arrays.back();
   fdata.resize(static_cast<size_t>(n) / sizeof(float));
   std::memcpy(fdata.data(), buf, fdata.size() * sizeof(float));
   *out_data = fdata.data();
   PyObject *shape = PyTuple_GetItem(ret, 2);
-  arena.uint_arrays.emplace_back();
-  auto &sd = arena.uint_arrays.back();
+  store.uint_arrays.emplace_back();
+  auto &sd = store.uint_arrays.back();
   Py_ssize_t ndim = PyList_Size(shape);
   for (Py_ssize_t i = 0; i < ndim; ++i)
     sd.push_back(static_cast<mx_uint>(
@@ -183,6 +198,10 @@ int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
 
 int MXNDListFree(NDListHandle handle) {
   API_BEGIN();
+  {
+    std::lock_guard<std::mutex> lk(ndlist_mu);
+    ndlist_store.erase(handle);
+  }
   CHECK_CALL(BridgeCall("free_handle", Py_BuildValue("(L)", H(handle))));
   API_END();
 }

@@ -47,8 +47,8 @@ static void TestNDArray() {
   CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &a) == 0);
   CHECK(MXNDArrayCreate(shape, 2, 1, 0, 0, &b) == 0);
   float av[6] = {1, 2, 3, 4, 5, 6}, bv[6] = {10, 20, 30, 40, 50, 60};
-  CHECK(MXNDArraySyncCopyFromCPU(a, av, sizeof(av)) == 0);
-  CHECK(MXNDArraySyncCopyFromCPU(b, bv, sizeof(bv)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(a, av, sizeof(av) / sizeof(float)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(b, bv, sizeof(bv) / sizeof(float)) == 0);
 
   mx_uint ndim; const mx_uint *sdata;
   CHECK(MXNDArrayGetShape(a, &ndim, &sdata) == 0);
@@ -69,7 +69,7 @@ static void TestNDArray() {
   CHECK(MXFuncInvoke(plus, use_vars, nullptr, mutate_vars) == 0);
   CHECK(MXNDArrayWaitToRead(c) == 0);
   float cv[6];
-  CHECK(MXNDArraySyncCopyToCPU(c, cv, sizeof(cv)) == 0);
+  CHECK(MXNDArraySyncCopyToCPU(c, cv, sizeof(cv) / sizeof(float)) == 0);
   for (int i = 0; i < 6; ++i) CHECK(cv[i] == av[i] + bv[i]);
 
   // slice/reshape views
@@ -154,9 +154,9 @@ static void TestSymbolExecutor() {
   float dv[6] = {1, -2, 3, -4, 5, -6};
   float wv[12] = {.1f, .2f, .3f, .4f, .5f, .6f, .7f, .8f, .9f, 1.f, 1.1f, 1.2f};
   float bv[4] = {0, 0, 0, 0};
-  CHECK(MXNDArraySyncCopyFromCPU(arg_nd[0], dv, sizeof(dv)) == 0);
-  CHECK(MXNDArraySyncCopyFromCPU(arg_nd[1], wv, sizeof(wv)) == 0);
-  CHECK(MXNDArraySyncCopyFromCPU(arg_nd[2], bv, sizeof(bv)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(arg_nd[0], dv, sizeof(dv) / sizeof(float)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(arg_nd[1], wv, sizeof(wv) / sizeof(float)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(arg_nd[2], bv, sizeof(bv) / sizeof(float)) == 0);
   mx_uint reqs[3] = {1, 1, 1};  // write
   for (int i = 0; i < 3; ++i) {
     mx_uint *shp = i == 0 ? dshape : (i == 1 ? wshape : bshape);
@@ -170,7 +170,7 @@ static void TestSymbolExecutor() {
   CHECK(MXExecutorOutputs(exec, &nout, &outs) == 0);
   CHECK(nout == 1);
   float out[8];
-  CHECK(MXNDArraySyncCopyToCPU(outs[0], out, sizeof(out)) == 0);
+  CHECK(MXNDArraySyncCopyToCPU(outs[0], out, sizeof(out) / sizeof(float)) == 0);
   // row 0: x=(1,-2,3): w row0 = (.1,.2,.3) -> .1-.4+.9=0.6 relu->0.6
   CHECK(out[0] > 0.59f && out[0] < 0.61f);
 
@@ -178,11 +178,11 @@ static void TestSymbolExecutor() {
   mx_uint oshape[2] = {2, 4};
   CHECK(MXNDArrayCreate(oshape, 2, 1, 0, 0, &head) == 0);
   float ones[8] = {1, 1, 1, 1, 1, 1, 1, 1};
-  CHECK(MXNDArraySyncCopyFromCPU(head, ones, sizeof(ones)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(head, ones, sizeof(ones) / sizeof(float)) == 0);
   NDArrayHandle heads[1] = {head};
   CHECK(MXExecutorBackward(exec, 1, heads) == 0);
   float gw[12];
-  CHECK(MXNDArraySyncCopyToCPU(grad_nd[1], gw, sizeof(gw)) == 0);
+  CHECK(MXNDArraySyncCopyToCPU(grad_nd[1], gw, sizeof(gw) / sizeof(float)) == 0);
   // some gradient must be nonzero
   bool nonzero = false;
   for (int i = 0; i < 12; ++i) nonzero = nonzero || gw[i] != 0.0f;
@@ -209,8 +209,8 @@ static void TestKVStoreOptimizer() {
   CHECK(MXNDArrayCreate(shape, 1, 1, 0, 0, &w) == 0);
   CHECK(MXNDArrayCreate(shape, 1, 1, 0, 0, &g) == 0);
   float wv[4] = {1, 2, 3, 4}, gv[4] = {1, 1, 1, 1};
-  CHECK(MXNDArraySyncCopyFromCPU(w, wv, sizeof(wv)) == 0);
-  CHECK(MXNDArraySyncCopyFromCPU(g, gv, sizeof(gv)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(w, wv, sizeof(wv) / sizeof(float)) == 0);
+  CHECK(MXNDArraySyncCopyFromCPU(g, gv, sizeof(gv) / sizeof(float)) == 0);
   int keys[1] = {3};
   NDArrayHandle vals[1] = {w};
   CHECK(MXKVStoreInit(kv, 1, keys, vals) == 0);
@@ -219,7 +219,7 @@ static void TestKVStoreOptimizer() {
   NDArrayHandle pullv[1] = {w};
   CHECK(MXKVStorePull(kv, 1, keys, pullv, 0) == 0);
   float after[4];
-  CHECK(MXNDArraySyncCopyToCPU(w, after, sizeof(after)) == 0);
+  CHECK(MXNDArraySyncCopyToCPU(w, after, sizeof(after) / sizeof(float)) == 0);
   // default local store assigns the merged push value; pull returns it
   CHECK(after[0] == 1.0f && after[3] == 1.0f);
 
@@ -231,7 +231,7 @@ static void TestKVStoreOptimizer() {
   CHECK(MXOptimizerCreateOptimizer(creator, 1, okeys, ovals, &opt) == 0);
   CHECK(MXOptimizerUpdate(opt, 0, w, g, 0.1f, 0.0f) == 0);
   float upd[4];
-  CHECK(MXNDArraySyncCopyToCPU(w, upd, sizeof(upd)) == 0);
+  CHECK(MXNDArraySyncCopyToCPU(w, upd, sizeof(upd) / sizeof(float)) == 0);
   CHECK(upd[0] < after[0]);  // sgd stepped downhill on +1 grads
   CHECK(MXOptimizerFree(opt) == 0);
   CHECK(MXKVStoreFree(kv) == 0);
