@@ -1,0 +1,30 @@
+package org.mxnet_tpu;
+
+/** Java binding over the amalgamated predict ABI (reference
+ *  amalgamation/jni).  Load libmxtpu_predict_jni.so, then:
+ *
+ *    long h = Predictor.createPredictor(symbolJson, paramBytes, 1, 0,
+ *                new String[]{"data"}, new int[][]{{1, 784}});
+ *    Predictor.setInput(h, "data", batch);
+ *    Predictor.forward(h);
+ *    float[] out = Predictor.getOutput(h, 0);
+ *    Predictor.free(h);
+ */
+public class Predictor {
+    static {
+        System.loadLibrary("mxtpu_predict_jni");
+    }
+
+    public static native long createPredictor(String symbolJson,
+                                              byte[] params, int devType,
+                                              int devId, String[] inputKeys,
+                                              int[][] inputShapes);
+
+    public static native int setInput(long handle, String key, float[] data);
+
+    public static native int forward(long handle);
+
+    public static native float[] getOutput(long handle, int index);
+
+    public static native void free(long handle);
+}

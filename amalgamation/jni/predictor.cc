@@ -1,0 +1,96 @@
+/*!
+ * JNI wrapper over the amalgamated predict ABI (reference
+ * amalgamation/jni/predictor.cc capability): create / forward / getOutput /
+ * free from Java.  Build against mxnet_tpu_predict-all.cc:
+ *
+ *   g++ -O3 -std=c++17 -fPIC $(python3-config --includes) \
+ *       -I$JAVA_HOME/include -I$JAVA_HOME/include/linux -shared \
+ *       ../mxnet_tpu_predict-all.cc predictor.cc -o libmxtpu_predict_jni.so \
+ *       $(python3-config --ldflags --embed)
+ */
+#include <jni.h>
+
+#include <cstring>
+#include <vector>
+
+#include "../../include/c_predict_api.h"
+
+extern "C" {
+
+JNIEXPORT jlong JNICALL Java_org_mxnet_1tpu_Predictor_createPredictor(
+    JNIEnv *env, jclass, jstring jsymbol, jbyteArray jparams, jint dev_type,
+    jint dev_id, jobjectArray jkeys, jobjectArray jshapes) {
+  const char *symbol = env->GetStringUTFChars(jsymbol, nullptr);
+  jsize param_len = env->GetArrayLength(jparams);
+  std::vector<jbyte> params(param_len);
+  env->GetByteArrayRegion(jparams, 0, param_len, params.data());
+
+  jsize num_input = env->GetArrayLength(jkeys);
+  std::vector<const char *> keys;
+  std::vector<jstring> key_refs;
+  std::vector<mx_uint> indptr{0};
+  std::vector<mx_uint> shape_data;
+  for (jsize i = 0; i < num_input; ++i) {
+    jstring k = static_cast<jstring>(env->GetObjectArrayElement(jkeys, i));
+    key_refs.push_back(k);
+    keys.push_back(env->GetStringUTFChars(k, nullptr));
+    jintArray s =
+        static_cast<jintArray>(env->GetObjectArrayElement(jshapes, i));
+    jsize ndim = env->GetArrayLength(s);
+    std::vector<jint> dims(ndim);
+    env->GetIntArrayRegion(s, 0, ndim, dims.data());
+    for (jint d : dims) shape_data.push_back(static_cast<mx_uint>(d));
+    indptr.push_back(static_cast<mx_uint>(shape_data.size()));
+  }
+
+  PredictorHandle handle = nullptr;
+  int ret = MXPredCreate(symbol, params.data(), param_len, dev_type, dev_id,
+                         static_cast<mx_uint>(num_input), keys.data(),
+                         indptr.data(), shape_data.data(), &handle);
+  for (jsize i = 0; i < num_input; ++i)
+    env->ReleaseStringUTFChars(key_refs[i], keys[i]);
+  env->ReleaseStringUTFChars(jsymbol, symbol);
+  return ret == 0 ? reinterpret_cast<jlong>(handle) : 0;
+}
+
+JNIEXPORT jint JNICALL Java_org_mxnet_1tpu_Predictor_setInput(
+    JNIEnv *env, jclass, jlong handle, jstring jkey, jfloatArray jdata) {
+  const char *key = env->GetStringUTFChars(jkey, nullptr);
+  jsize n = env->GetArrayLength(jdata);
+  jfloat *data = env->GetFloatArrayElements(jdata, nullptr);
+  int ret = MXPredSetInput(reinterpret_cast<PredictorHandle>(handle), key,
+                           data, static_cast<mx_uint>(n));
+  env->ReleaseFloatArrayElements(jdata, data, JNI_ABORT);
+  env->ReleaseStringUTFChars(jkey, key);
+  return ret;
+}
+
+JNIEXPORT jint JNICALL Java_org_mxnet_1tpu_Predictor_forward(JNIEnv *, jclass,
+                                                             jlong handle) {
+  return MXPredForward(reinterpret_cast<PredictorHandle>(handle));
+}
+
+JNIEXPORT jfloatArray JNICALL Java_org_mxnet_1tpu_Predictor_getOutput(
+    JNIEnv *env, jclass, jlong handle, jint index) {
+  mx_uint ndim = 0;
+  mx_uint *shape = nullptr;
+  if (MXPredGetOutputShape(reinterpret_cast<PredictorHandle>(handle), index,
+                           &shape, &ndim) != 0)
+    return nullptr;
+  mx_uint size = 1;
+  for (mx_uint i = 0; i < ndim; ++i) size *= shape[i];
+  std::vector<float> buf(size);
+  if (MXPredGetOutput(reinterpret_cast<PredictorHandle>(handle), index,
+                      buf.data(), size) != 0)
+    return nullptr;
+  jfloatArray out = env->NewFloatArray(size);
+  env->SetFloatArrayRegion(out, 0, size, buf.data());
+  return out;
+}
+
+JNIEXPORT void JNICALL Java_org_mxnet_1tpu_Predictor_free(JNIEnv *, jclass,
+                                                          jlong handle) {
+  MXPredFree(reinterpret_cast<PredictorHandle>(handle));
+}
+
+}  // extern "C"

@@ -1,0 +1,115 @@
+"""Standalone ctypes wrapper for the amalgamated predict library
+(reference amalgamation/python/mxnet_predict.py): depends ONLY on
+libmxtpu_predict.so + numpy — no mxnet_tpu package import in this process's
+user code (the library embeds its own interpreter for the compute path).
+
+    from mxnet_predict import Predictor
+    p = Predictor(open('net-symbol.json').read(),
+                  open('net-0001.params','rb').read(),
+                  {'data': (1, 784)})
+    p.forward(data=batch)
+    out = p.get_output(0)
+"""
+import ctypes
+import os
+import sys
+
+import numpy as np
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+
+def _find_lib():
+    here = os.path.dirname(os.path.abspath(__file__))
+    for cand in (os.path.join(here, "..", "libmxtpu_predict.so"),
+                 os.path.join(here, "..", "..", "mxnet_tpu",
+                              "libmxtpu_predict.so")):
+        if os.path.exists(cand):
+            return os.path.abspath(cand)
+    raise OSError("libmxtpu_predict.so not found; run `make` in amalgamation/")
+
+
+_LIB = ctypes.CDLL(_find_lib(), ctypes.RTLD_GLOBAL)
+_LIB.MXGetLastError.restype = ctypes.c_char_p
+
+
+def _check(ret):
+    if ret != 0:
+        raise RuntimeError(_LIB.MXGetLastError().decode())
+
+
+class Predictor(object):
+    """Predict-only model runner over the MXPred mini-ABI."""
+
+    def __init__(self, symbol_json, param_bytes, input_shapes,
+                 dev_type=1, dev_id=0):
+        keys = list(input_shapes.keys())
+        indptr, data = [0], []
+        for k in keys:
+            data.extend(int(d) for d in input_shapes[k])
+            indptr.append(len(data))
+        ckeys = (ctypes.c_char_p * len(keys))(
+            *[k.encode() for k in keys])
+        cindptr = (ctypes.c_uint * len(indptr))(*indptr)
+        cdata = (ctypes.c_uint * len(data))(*data)
+        handle = ctypes.c_void_p()
+        _check(_LIB.MXPredCreate(
+            ctypes.c_char_p(symbol_json.encode()),
+            ctypes.c_char_p(param_bytes), ctypes.c_int(len(param_bytes)),
+            ctypes.c_int(dev_type), ctypes.c_int(dev_id),
+            ctypes.c_uint(len(keys)), ckeys, cindptr, cdata,
+            ctypes.byref(handle)))
+        self.handle = handle
+
+    def forward(self, **kwargs):
+        for k, v in kwargs.items():
+            v = np.ascontiguousarray(v, dtype=np.float32)
+            _check(_LIB.MXPredSetInput(
+                self.handle, ctypes.c_char_p(k.encode()),
+                v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ctypes.c_uint(v.size)))
+        _check(_LIB.MXPredForward(self.handle))
+
+    def get_output(self, index):
+        ndim = ctypes.c_uint()
+        pshape = ctypes.POINTER(ctypes.c_uint)()
+        _check(_LIB.MXPredGetOutputShape(
+            self.handle, ctypes.c_uint(index), ctypes.byref(pshape),
+            ctypes.byref(ndim)))
+        shape = tuple(pshape[i] for i in range(ndim.value))
+        out = np.empty(shape, dtype=np.float32)
+        _check(_LIB.MXPredGetOutput(
+            self.handle, ctypes.c_uint(index),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_uint(out.size)))
+        return out
+
+    def __del__(self):
+        if getattr(self, "handle", None):
+            _LIB.MXPredFree(self.handle)
+
+
+def load_ndarray_file(nd_bytes):
+    """Load a saved NDArray map (`prefix-NNNN.params` blob) into a dict of
+    numpy arrays via MXNDListCreate/Get."""
+    handle = ctypes.c_void_p()
+    length = ctypes.c_uint()
+    _check(_LIB.MXNDListCreate(
+        ctypes.c_char_p(nd_bytes), ctypes.c_int(len(nd_bytes)),
+        ctypes.byref(handle), ctypes.byref(length)))
+    out = {}
+    for i in range(length.value):
+        key = ctypes.c_char_p()
+        pdata = ctypes.POINTER(ctypes.c_float)()
+        pshape = ctypes.POINTER(ctypes.c_uint)()
+        ndim = ctypes.c_uint()
+        _check(_LIB.MXNDListGet(
+            handle, ctypes.c_uint(i), ctypes.byref(key),
+            ctypes.byref(pdata), ctypes.byref(pshape), ctypes.byref(ndim)))
+        shape = tuple(pshape[j] for j in range(ndim.value))
+        n = int(np.prod(shape)) if shape else 1
+        arr = np.array([pdata[j] for j in range(n)],
+                       dtype=np.float32).reshape(shape)
+        out[key.value.decode()] = arr
+    _check(_LIB.MXNDListFree(handle))
+    return out

@@ -1,0 +1,102 @@
+/*!
+ * C++ prediction example (reference example/cpp/image-classification):
+ * load a checkpoint (symbol JSON + params blob), run one forward pass on
+ * float input read from a raw .bin file (or zeros if none given), print
+ * the argmax class and probability.
+ *
+ * Build (against the amalgamated predict library):
+ *   g++ -O3 -std=c++17 -I../../../include predict_image.cc \
+ *       -o predict_image -L../../../amalgamation -lmxtpu_predict \
+ *       -Wl,-rpath,../../../amalgamation
+ *
+ * Run:
+ *   ./predict_image model-symbol.json model-0010.params 1,3,224,224 [in.bin]
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "c_predict_api.h"
+
+static std::string ReadFile(const char *path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    std::fprintf(stderr, "cannot open %s\n", path);
+    std::exit(1);
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s symbol.json params.bin N,C,H,W [input.bin]\n",
+                 argv[0]);
+    return 1;
+  }
+  std::string symbol = ReadFile(argv[1]);
+  std::string params = ReadFile(argv[2]);
+
+  std::vector<mx_uint> shape;
+  {
+    std::stringstream ss(argv[3]);
+    std::string tok;
+    while (std::getline(ss, tok, ','))
+      shape.push_back(static_cast<mx_uint>(std::atoi(tok.c_str())));
+  }
+  mx_uint indptr[2] = {0, static_cast<mx_uint>(shape.size())};
+  const char *keys[1] = {"data"};
+
+  PredictorHandle pred = nullptr;
+  if (MXPredCreate(symbol.c_str(), params.data(),
+                   static_cast<int>(params.size()), /*dev_type=*/1,
+                   /*dev_id=*/0, 1, keys, indptr, shape.data(),
+                   &pred) != 0) {
+    std::fprintf(stderr, "MXPredCreate failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  size_t in_size = 1;
+  for (mx_uint d : shape) in_size *= d;
+  std::vector<float> input(in_size, 0.0f);
+  if (argc > 4) {
+    std::string raw = ReadFile(argv[4]);
+    std::memcpy(input.data(), raw.data(),
+                std::min(raw.size(), in_size * sizeof(float)));
+  }
+  if (MXPredSetInput(pred, "data", input.data(),
+                     static_cast<mx_uint>(in_size)) != 0 ||
+      MXPredForward(pred) != 0) {
+    std::fprintf(stderr, "forward failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint out_ndim = 0;
+  mx_uint *out_shape = nullptr;
+  if (MXPredGetOutputShape(pred, 0, &out_shape, &out_ndim) != 0) {
+    std::fprintf(stderr, "get output shape failed: %s\n", MXGetLastError());
+    return 1;
+  }
+  size_t out_size = 1;
+  for (mx_uint i = 0; i < out_ndim; ++i) out_size *= out_shape[i];
+  std::vector<float> output(out_size);
+  if (MXPredGetOutput(pred, 0, output.data(),
+                      static_cast<mx_uint>(out_size)) != 0) {
+    std::fprintf(stderr, "get output failed: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  size_t best = 0;
+  for (size_t i = 1; i < out_size; ++i)
+    if (output[i] > output[best]) best = i;
+  std::printf("top-1 class %zu  prob %.6f  (output size %zu)\n", best,
+              output[best], out_size);
+  MXPredFree(pred);
+  return 0;
+}

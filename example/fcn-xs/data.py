@@ -1,0 +1,50 @@
+"""Segmentation data iterator (reference example/fcn-xs/data.py: FileIter
+over VOC image/label pairs).  Zero-egress stand-in: synthetic blob scenes
+whose pixel labels are recoverable from color, same iterator contract
+(data: NCHW float32 image, softmax_label: NHW int labels)."""
+import numpy as np
+
+from mxnet_tpu.io import DataIter, DataBatch
+from mxnet_tpu import ndarray as nd
+
+
+class SyntheticSegIter(DataIter):
+    """Scenes of colored rectangles on background; label = which class
+    painted the pixel."""
+
+    def __init__(self, num_classes=4, batch_size=2, size=64, num_batches=8,
+                 seed=0):
+        super().__init__()
+        self.batch_size = batch_size
+        self.num_classes = num_classes
+        self.size = size
+        self.num_batches = num_batches
+        self.rng = np.random.RandomState(seed)
+        self.cur = 0
+        self.provide_data = [("data", (batch_size, 3, size, size))]
+        self.provide_label = [("softmax_label", (batch_size, size, size))]
+
+    def _scene(self):
+        img = np.zeros((3, self.size, self.size), np.float32)
+        lab = np.zeros((self.size, self.size), np.float32)
+        for cls in range(1, self.num_classes):
+            x0, y0 = self.rng.randint(0, self.size // 2, 2)
+            w, h = self.rng.randint(self.size // 4, self.size // 2, 2)
+            color = np.zeros(3, np.float32)
+            color[cls % 3] = cls / self.num_classes
+            img[:, y0:y0 + h, x0:x0 + w] = color[:, None, None]
+            lab[y0:y0 + h, x0:x0 + w] = cls
+        img += self.rng.randn(*img.shape).astype(np.float32) * 0.02
+        return img, lab
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= self.num_batches:
+            raise StopIteration
+        self.cur += 1
+        imgs, labs = zip(*[self._scene() for _ in range(self.batch_size)])
+        return DataBatch(data=[nd.array(np.stack(imgs))],
+                         label=[nd.array(np.stack(labs))], pad=0,
+                         index=None)

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Train FCN-32s then FCN-16s (reference example/fcn-xs/fcn_xs.py +
+run_fcnxs.sh two-stage recipe): stage 1 trains fcn32s; stage 2 carries its
+trunk weights into fcn16s (init_fcnxs) and fine-tunes.
+
+    python fcn_xs.py --model fcn32s --epochs 2
+    python fcn_xs.py --model fcn16s --epochs 2   # carries fcn32s weights
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from symbol_fcnxs import get_fcn32s_symbol, get_fcn16s_symbol
+from init_fcnxs import init_fcnxs_args
+from solver import Solver
+from data import SyntheticSegIter
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="fcn32s",
+                        choices=["fcn32s", "fcn16s"])
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--num-classes", type=int, default=4)
+    parser.add_argument("--size", type=int, default=64)
+    parser.add_argument("--prefix", default="FCN")
+    parser.add_argument("--tpus", default="")
+    args = parser.parse_args()
+
+    ctx = mx.tpu(0) if args.tpus else mx.cpu()
+    builder = (get_fcn32s_symbol if args.model == "fcn32s"
+               else get_fcn16s_symbol)
+    net = builder(numclass=args.num_classes)
+
+    it = SyntheticSegIter(num_classes=args.num_classes, size=args.size)
+    shapes = dict(it.provide_data + it.provide_label)
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    arg_shapes_dict = dict(zip(net.list_arguments(), arg_shapes))
+
+    carry = None
+    prev = "%s32s-0000.params" % args.prefix
+    if args.model == "fcn16s" and os.path.exists(prev):
+        carry, _ = mx.model.load_checkpoint("%s32s" % args.prefix, 0)[1:]
+        logging.info("carrying %d arrays from fcn32s", len(carry))
+    arg_dict = init_fcnxs_args(net, arg_shapes_dict, carry)
+
+    solver = Solver(net, ctx, arg_dict, learning_rate=1e-3)
+    solver.fit(it, num_epoch=args.epochs)
+    mx.model.save_checkpoint("%s%s" % (args.prefix, args.model[3:]), 0, net,
+                             solver.arg_dict, {})
+    logging.info("saved %s%s checkpoint", args.prefix, args.model[3:])
+
+
+if __name__ == "__main__":
+    main()

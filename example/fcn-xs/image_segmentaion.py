@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Inference demo (reference example/fcn-xs/image_segmentaion.py, original
+filename kept): load a trained FCN checkpoint, segment one image, write the
+label map as a .npy (reference wrote a palette PNG via PIL)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+from data import SyntheticSegIter
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--prefix", default="FCN32s")
+    parser.add_argument("--epoch", type=int, default=0)
+    parser.add_argument("--out", default="segmented.npy")
+    args = parser.parse_args()
+
+    net, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                           args.epoch)
+    it = SyntheticSegIter(batch_size=1)
+    batch = it.next()
+    shapes = {"data": batch.data[0].shape}
+    exe = net.simple_bind(mx.cpu(), grad_req="null", **shapes)
+    for name, arr in arg_params.items():
+        if name in exe.arg_dict:
+            arr.copyto(exe.arg_dict[name])
+    batch.data[0].copyto(exe.arg_dict["data"])
+    exe.forward(is_train=False)
+    probs = exe.outputs[0].asnumpy()[0]           # (C, H, W)
+    labels = probs.argmax(axis=0).astype(np.uint8)
+    np.save(args.out, labels)
+    print("wrote %s: %s, classes present: %s"
+          % (args.out, labels.shape, sorted(set(labels.flat))))
+
+
+if __name__ == "__main__":
+    main()

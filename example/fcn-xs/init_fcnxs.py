@@ -1,0 +1,52 @@
+"""FCN initialization (reference example/fcn-xs/init_fcnxs.py): start the
+score heads at zero, deconvolution filters as fixed bilinear upsampling
+kernels, and carry trunk weights over from the previous stage (vgg16 ->
+fcn32s -> fcn16s)."""
+import numpy as np
+
+from mxnet_tpu import ndarray as nd
+
+
+def bilinear_kernel(shape):
+    """Bilinear upsample filter (reference upsampling init)."""
+    weight = np.zeros(shape, dtype=np.float32)
+    f = np.ceil(shape[3] / 2.0)
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    for i in range(np.prod(shape[2:])):
+        x = i % shape[3]
+        y = (i // shape[3]) % shape[2]
+        w = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        for k in range(min(shape[0], shape[1])):
+            weight[k, k, y, x] = w
+    return weight
+
+
+def init_fcnxs_args(symbol, arg_shapes_dict, carry_args=None):
+    """Build the arg dict: bilinear deconv filters, zero score heads,
+    MSRA-style trunk init, then overwrite with carry_args (weights from the
+    previous training stage, reference's vgg16->fcn32s handoff)."""
+    rng = np.random.RandomState(0)
+    args = {}
+    for name, shape in arg_shapes_dict.items():
+        if name in ("data", "softmax_label"):
+            continue
+        is_upsample = ("upsample" in name
+                       or name.split("_")[0].startswith("up"))
+        if is_upsample and name.endswith("weight"):
+            args[name] = nd.array(bilinear_kernel(shape))
+        elif "score" in name and name.endswith("weight"):
+            args[name] = nd.zeros(shape)
+        elif name.endswith("bias") or name.endswith("beta"):
+            args[name] = nd.zeros(shape)
+        elif name.endswith("gamma"):
+            args[name] = nd.ones(shape)
+        else:
+            fan_in = int(np.prod(shape[1:])) if len(shape) > 1 else shape[0]
+            args[name] = nd.array(
+                rng.randn(*shape).astype(np.float32)
+                * np.sqrt(2.0 / max(fan_in, 1)))
+    if carry_args:
+        for name, value in carry_args.items():
+            if name in args and tuple(value.shape) == tuple(args[name].shape):
+                args[name] = value.copy()
+    return args

@@ -1,0 +1,68 @@
+"""Manual-executor training loop (reference example/fcn-xs/solver.py:
+FCN trained below the FeedForward level — bind, forward, backward, python
+updater per array)."""
+import logging
+
+import numpy as np
+
+from mxnet_tpu import optimizer as opt_mod
+from mxnet_tpu import ndarray as nd
+
+
+class Solver(object):
+    def __init__(self, symbol, ctx, arg_dict, learning_rate=1e-4,
+                 momentum=0.9, wd=5e-4):
+        self.symbol = symbol
+        self.ctx = ctx
+        self.arg_dict = arg_dict
+        self.optimizer = opt_mod.SGD(learning_rate=learning_rate,
+                                     momentum=momentum, wd=wd)
+        self.updater = opt_mod.get_updater(self.optimizer)
+
+    def fit(self, train_iter, num_epoch=1, epoch_callback=None):
+        data_names = [n for n, _ in train_iter.provide_data]
+        label_names = [n for n, _ in train_iter.provide_label]
+        shapes = dict(train_iter.provide_data + train_iter.provide_label)
+        grad_req = {k: ("null" if k in shapes else "write")
+                    for k in self.symbol.list_arguments()}
+        # bind once; batches are copied into the bound arrays
+        args = dict(self.arg_dict)
+        for name, shape in shapes.items():
+            args[name] = nd.zeros(shape)
+        args_grad = {k: nd.zeros(v.shape) for k, v in args.items()
+                     if grad_req[k] == "write"}
+        exe = self.symbol.bind(self.ctx, args, args_grad=args_grad,
+                               grad_req=grad_req)
+        arg_names = self.symbol.list_arguments()
+        for epoch in range(num_epoch):
+            train_iter.reset()
+            epoch_loss, nbatch = 0.0, 0
+            for batch in train_iter:
+                for name, arr in zip(data_names, batch.data):
+                    arr.copyto(exe.arg_dict[name])
+                for name, arr in zip(label_names, batch.label):
+                    arr.copyto(exe.arg_dict[name])
+                exe.forward(is_train=True)
+                exe.backward()
+                for i, name in enumerate(arg_names):
+                    if grad_req.get(name) == "null" or name in shapes:
+                        continue
+                    if exe.grad_arrays[i] is not None:
+                        self.updater(i, exe.grad_arrays[i],
+                                     exe.arg_dict[name])
+                out = exe.outputs[0].asnumpy()
+                lab = batch.label[0].asnumpy().astype(int)
+                probs = out.reshape(out.shape[0], out.shape[1], -1)
+                flat = lab.reshape(lab.shape[0], -1)
+                picked = np.take_along_axis(probs, flat[:, None, :],
+                                            axis=1)[:, 0, :]
+                epoch_loss += float(-np.log(np.maximum(picked, 1e-8)).mean())
+                nbatch += 1
+            logging.info("epoch %d: pixel ce loss %.4f", epoch,
+                         epoch_loss / max(nbatch, 1))
+            if epoch_callback:
+                epoch_callback(epoch, self.symbol, exe.arg_dict)
+        # harvest trained params back
+        self.arg_dict = {k: v for k, v in exe.arg_dict.items()
+                         if k not in shapes}
+        return self

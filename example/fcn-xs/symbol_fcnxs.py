@@ -1,0 +1,13 @@
+"""FCN-xs symbols (reference example/fcn-xs/symbol_fcnxs.py): VGG16 trunk
+with 1x1 score heads and bilinear-upsampling deconvolution fusion.  The
+graph builders live in mxnet_tpu.models.fcn; this module keeps the
+reference example's entry points."""
+from mxnet_tpu.models.fcn import get_fcn32s, get_fcn16s
+
+
+def get_fcn32s_symbol(numclass=21, workspace_default=1024):
+    return get_fcn32s(num_classes=numclass)
+
+
+def get_fcn16s_symbol(numclass=21, workspace_default=1024):
+    return get_fcn16s(num_classes=numclass)

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""NDSB2 preprocessing (reference example/kaggle-ndsb2/Preprocessing.py:
+DICOM MRI -> 64x64 30-frame csv rows + systole/diastole volume labels).
+
+Zero-egress: synthesizes beating-heart-like sequences (a disc whose radius
+oscillates over the frame axis; "volume" = min disc area) into the same csv
+contract the real pipeline produced:
+
+  train-64x64-data.csv : one row per study, 30*64*64 floats
+  train-systole.csv    : one row per study, 600 CDF targets
+
+Point the csv writers at real DICOM-decoded arrays for the actual
+competition data."""
+import os
+import sys
+
+import numpy as np
+
+
+def make_sequence(rng, frames=10, size=32):
+    """Disc with oscillating radius; returns (sequence, systole_volume)."""
+    t = np.linspace(0, 2 * np.pi, frames)
+    base = rng.uniform(size * 0.15, size * 0.3)
+    amp = rng.uniform(2.0, size * 0.1)
+    cx, cy = rng.uniform(size * 0.4, size * 0.6, 2)
+    yy, xx = np.mgrid[0:size, 0:size]
+    seq = np.empty((frames, size, size), np.float32)
+    radii = base + amp * np.sin(t)
+    for f in range(frames):
+        mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= radii[f] ** 2
+        seq[f] = mask * 200.0 + rng.randn(size, size) * 5.0
+    systole = float(np.pi * radii.min() ** 2)
+    return seq, systole
+
+
+def encode_csv(label_data):
+    return np.array([(x < np.arange(600)) for x in label_data],
+                    dtype=np.uint8)
+
+
+def main(num_studies=32, frames=10, size=32):
+    here = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.RandomState(0)
+    seqs, vols = [], []
+    for _ in range(num_studies):
+        seq, systole = make_sequence(rng, frames, size)
+        seqs.append(seq.reshape(-1))
+        vols.append(systole)
+    np.savetxt(os.path.join(here, "train-64x64-data.csv"),
+               np.stack(seqs), delimiter=",", fmt="%.2f")
+    np.savetxt(os.path.join(here, "train-systole.csv"),
+               encode_csv(np.asarray(vols)), delimiter=",", fmt="%d")
+    print("wrote %d studies (%d frames, %dx%d)" % (num_studies, frames,
+                                                   size, size))
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

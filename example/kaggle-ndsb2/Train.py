@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Second National Data Science Bowl — cardiac volume estimation (reference
+example/kaggle-ndsb2/Train.py): LeNet-style net over frame DIFFERENCES of a
+30-frame MRI sequence, 600-way cumulative-distribution output trained with
+LogisticRegressionOutput, scored by CRPS.
+
+Data comes from CSVIter files produced by Preprocessing.py (run it first;
+zero-egress synthetic volumes by default, same csv contract as the real
+competition pipeline: each row = flattened 30x64x64 sequence / 600 CDF
+labels)."""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import mxnet_tpu as mx
+
+
+def get_lenet(frames=30, size=64):
+    """Frame-difference LeNet (reference Train.py get_lenet)."""
+    source = mx.sym.Variable("data")
+    source = (source - 128) * (1.0 / 128)
+    sliced = mx.sym.SliceChannel(source, num_outputs=frames)
+    diffs = [sliced[i + 1] - sliced[i] for i in range(frames - 1)]
+    source = mx.sym.Concat(*diffs)
+    net = mx.sym.Convolution(source, kernel=(5, 5), num_filter=40)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=40)
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    flatten = mx.sym.Flatten(net)
+    flatten = mx.sym.Dropout(flatten)
+    fc1 = mx.sym.FullyConnected(data=flatten, num_hidden=600)
+    return mx.sym.LogisticRegressionOutput(data=fc1, name="softmax")
+
+
+def CRPS(label, pred):
+    """Continuous Ranked Probability Score on the 600-bin CDF."""
+    for i in range(pred.shape[0]):
+        for j in range(pred.shape[1] - 1):
+            if pred[i, j] > pred[i, j + 1]:
+                pred[i, j + 1] = pred[i, j]
+    return np.sum(np.square(label - pred)) / label.size
+
+
+def encode_label(label_data):
+    """Volume scalar -> 600-step CDF (reference encode_label)."""
+    systole = label_data[:, 1]
+    systole_encode = np.array([(x < np.arange(600)) for x in systole],
+                              dtype=np.uint8)
+    return systole_encode
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    frames, size = 10, 32          # small default so the demo runs quickly
+    here = os.path.dirname(os.path.abspath(__file__))
+    dtrain = os.path.join(here, "train-64x64-data.csv")
+    ltrain = os.path.join(here, "train-systole.csv")
+    if not os.path.exists(dtrain):
+        print("run Preprocessing.py first")
+        return 1
+
+    data_train = mx.io.CSVIter(data_csv=dtrain,
+                               data_shape=(frames, size, size),
+                               label_csv=ltrain, label_shape=(600,),
+                               batch_size=4)
+    net = get_lenet(frames=frames, size=size)
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    crps = mx.metric.np(CRPS, name="CRPS")
+    mod.fit(data_train, num_epoch=2, eval_metric=crps,
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9,
+                              "wd": 1e-4})
+    mod.save_params(os.path.join(here, "ndsb2-lenet.params"))
+    logging.info("done")
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -152,6 +152,18 @@ def ndarray_get_itemsize(h: int) -> int:
     return dt.itemsize
 
 
+def ndarray_check_copy_size(h: int, size: int) -> int:
+    """Validate an element count against the array BEFORE the C side reads
+    the caller's buffer; returns the dtype itemsize on success."""
+    arr = _get(h)
+    n = int(np.prod(arr.shape)) if arr.shape else 1
+    if size != n:
+        raise ValueError(
+            "SyncCopy size mismatch: array has %d elements, got %d"
+            % (n, size))
+    return ndarray_get_itemsize(h)
+
+
 def ndarray_get_context(h: int) -> List[int]:
     c = _get(h).context
     return [_DEVSTR_TO_CODE.get(c.device_type, 1), c.device_id]
@@ -214,16 +226,18 @@ def func_get_info(name: str):
     return [name, doc]
 
 
-_ACCEPTS_OUT_CACHE: Dict[int, bool] = {}
+_ACCEPTS_OUT_CACHE: Dict[Any, bool] = {}
 
 
 def _accepts_out(fn) -> bool:
     """True if fn can take an out= kwarg (named param or **kwargs).
     Signature inspection instead of try/except so a TypeError raised INSIDE
     the function body is never mistaken for 'no out kwarg' (which would
-    re-execute fn and apply side effects twice).  Cached per function:
-    MXFuncInvoke is the C-side operator hot path."""
-    cached = _ACCEPTS_OUT_CACHE.get(id(fn))
+    re-execute fn and apply side effects twice).  Cached per function
+    (keyed by the function OBJECT — an id() key could be recycled after a
+    re-registration GCs the old fn): MXFuncInvoke is the operator hot
+    path."""
+    cached = _ACCEPTS_OUT_CACHE.get(fn)
     if cached is not None:
         return cached
     import inspect
@@ -233,7 +247,7 @@ def _accepts_out(fn) -> bool:
             p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values())
     except (TypeError, ValueError):
         result = True  # builtins without signatures: assume out= works
-    _ACCEPTS_OUT_CACHE[id(fn)] = result
+    _ACCEPTS_OUT_CACHE[fn] = result
     return result
 
 

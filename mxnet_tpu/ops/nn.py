@@ -158,9 +158,19 @@ class DeconvolutionOp(OpDef):
         # transposed conv = conv with lhs dilation; padding k-1-p (+adj on high side)
         pad_h = kh - 1 - p.pad[0]
         pad_w = kw - 1 - p.pad[1]
-        # weight (in_c, out_c/g, kh, kw) -> flip spatial, treat as IOHW
+        # weight (in_c, out_c/g, kh, kw), spatially flipped for the
+        # transposed conv.  With groups, lax wants rhs I = in_c/g and the
+        # O dim holding all out channels group-major, so regroup the
+        # reference layout accordingly.
+        w = jnp.flip(w, axis=(2, 3))
+        if p.num_group > 1:
+            g = p.num_group
+            in_c, out_pg = w.shape[0], w.shape[1]
+            w = w.reshape(g, in_c // g, out_pg, kh, kw)
+            w = jnp.transpose(w, (1, 0, 2, 3, 4))
+            w = w.reshape(in_c // g, g * out_pg, kh, kw)
         out = lax.conv_general_dilated(
-            x, jnp.flip(w, axis=(2, 3)),
+            x, w,
             window_strides=(1, 1),
             padding=[(pad_h, pad_h + p.adj[0]), (pad_w, pad_w + p.adj[1])],
             lhs_dilation=tuple(p.stride),
@@ -674,12 +684,15 @@ class UpSamplingOp(OpDef):
             x, w = inputs
             k = 2 * p.scale - p.scale % 2
             pad = int(np.ceil((p.scale - 1) / 2.0))
+            # depthwise transposed conv: weight (C, 1, k, k) is OIHW —
+            # with feature_group_count=C the rhs in-feature dim must be
+            # C/groups = 1
             out = lax.conv_general_dilated(
                 x, jnp.flip(w, axis=(2, 3)),
                 window_strides=(1, 1),
                 padding=[(k - 1 - pad, k - 1 - pad)] * 2,
                 lhs_dilation=(p.scale, p.scale),
-                dimension_numbers=("NCHW", "IOHW", "NCHW"),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
                 feature_group_count=x.shape[1])
             return [out]
         ups = [up_nearest(x) for x in inputs]

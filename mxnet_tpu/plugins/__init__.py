@@ -4,5 +4,6 @@ Importing registers the WarpCTC op; torch/opencv bridges are lazy."""
 from . import warpctc  # noqa: F401 — registers the WarpCTC op
 from . import torch_bridge
 from . import opencv
+from . import sframe
 
-__all__ = ["warpctc", "torch_bridge", "opencv"]
+__all__ = ["warpctc", "torch_bridge", "opencv", "sframe"]

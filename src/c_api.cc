@@ -26,10 +26,11 @@ using namespace mxtpu_capi;  // NOLINT
 
 namespace {
 
-/* Host mirrors for MXNDArrayGetData: bytes live until the array is freed.
- * Append-only per handle (a deque of immutable strings) so a pointer handed
- * to one caller is never invalidated by a later GetData on the same handle
- * from this or another thread. */
+/* Host mirrors for MXNDArrayGetData: one buffer per (handle, byte-length),
+ * refreshed in place on each call — handed-out pointers stay valid until
+ * MXNDArrayFree, see updated contents like the reference's live data
+ * pointer, and memory is O(1) per handle (plus one buffer per distinct
+ * reshape length). */
 std::unordered_map<void *, std::deque<std::string>> host_mirror;
 std::mutex host_mirror_mu;
 
@@ -82,11 +83,15 @@ int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
   return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0, out);
 }
 
-/* bytes per element, answered by the bridge (numpy knows the itemsize for
- * every dtype — no table here to drift out of sync with _DTYPE_TO_CODE). */
-static int DTypeItemSize(NDArrayHandle handle) {
-  PyObject *ret = BridgeCall("ndarray_get_itemsize",
-                             Py_BuildValue("(L)", H(handle)));
+/* Validate `size` (an ELEMENT count) against the array and return the
+ * dtype's bytes-per-element; the bridge answers both (numpy knows the
+ * itemsize — no table here to drift out of sync with _DTYPE_TO_CODE).
+ * MUST run before touching the caller's buffer so a wrong size becomes a
+ * clean error, not an out-of-bounds read. */
+static int CheckCopySize(NDArrayHandle handle, size_t size) {
+  PyObject *ret = BridgeCall("ndarray_check_copy_size",
+                             Py_BuildValue("(Ln)", H(handle),
+                                           static_cast<Py_ssize_t>(size)));
   if (ret == nullptr) return -1;
   int itemsize = static_cast<int>(PyLong_AsLong(ret));
   Py_DECREF(ret);
@@ -99,7 +104,7 @@ static int DTypeItemSize(NDArrayHandle handle) {
 int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
                              size_t size) {
   API_BEGIN();
-  int itemsize = DTypeItemSize(handle);
+  int itemsize = CheckCopySize(handle, size);
   if (itemsize < 0) return -1;
   PyObject *bytes = PyBytes_FromStringAndSize(
       static_cast<const char *>(data),
@@ -205,15 +210,20 @@ int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
   {
     std::lock_guard<std::mutex> lk(host_mirror_mu);
     auto &mirrors = host_mirror[handle];
-    // dedupe: repeated GetData on an unchanged array reuses the last
-    // snapshot, so polling loops don't grow memory; only distinct
-    // snapshots accumulate (their pointers must stay valid until free)
-    if (mirrors.empty() ||
-        mirrors.back().compare(0, std::string::npos, buf,
-                               static_cast<size_t>(n)) != 0) {
-      mirrors.emplace_back(buf, static_cast<size_t>(n));
+    // one live mirror per byte-length: same-size refreshes copy INTO the
+    // existing buffer (no realloc since capacity is equal), so previously
+    // handed-out pointers stay valid, see updated bytes like the
+    // reference's live data pointer, and memory stays O(1) per handle;
+    // a new length (reshape) appends a fresh buffer.
+    std::string *slot = nullptr;
+    for (auto &m : mirrors)
+      if (m.size() == static_cast<size_t>(n)) { slot = &m; break; }
+    if (slot == nullptr) {
+      mirrors.emplace_back(static_cast<size_t>(n), '\0');
+      slot = &mirrors.back();
     }
-    *out_pdata = const_cast<char *>(mirrors.back().data());
+    std::memcpy(&(*slot)[0], buf, static_cast<size_t>(n));
+    *out_pdata = const_cast<char *>(slot->data());
   }
   Py_DECREF(ret);
   API_END();

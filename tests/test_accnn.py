@@ -1,0 +1,100 @@
+"""accnn tool: low-rank decomposition preserves the function at full rank
+and stays close at reduced rank (reference tools/accnn capability)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "accnn"))
+
+from accnn import accelerate  # noqa: E402
+
+
+def _small_cnn():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                             name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _random_args(net, shapes):
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    rng = np.random.RandomState(0)
+    args = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in shapes:
+            continue
+        args[name] = mx.nd.array(rng.randn(*shp).astype(np.float32) * 0.1)
+    return args
+
+
+def _forward(net, args, x):
+    all_args = dict(args)
+    all_args["data"] = mx.nd.array(x)
+    all_args["softmax_label"] = mx.nd.zeros((x.shape[0],))
+    exe = net.bind(mx.cpu(), all_args, grad_req="null")
+    exe.forward(is_train=False)
+    return exe.outputs[0].asnumpy()
+
+
+def test_accnn_full_rank_exact():
+    """SVD factors at one-below-full rank of a rank-deficient weight are
+    exact: make conv1's weight rank 4 (< 8), decompose at rank 4."""
+    net = _small_cnn()
+    shapes = {"data": (2, 3, 8, 8), "softmax_label": (2,)}
+    args = _random_args(net, shapes)
+    w = args["conv1_weight"].asnumpy().reshape(8, -1)
+    w[4:] = w[:4]                      # rank <= 4
+    args["conv1_weight"] = mx.nd.array(w.reshape(8, 3, 3, 3))
+    x = np.random.RandomState(1).rand(2, 3, 8, 8).astype(np.float32)
+    base = _forward(net, args, x)
+    full = {"fc1": 16, "fc2": 4}       # FCs: keep effectively-full ranks
+    new_sym, new_args, _ = accelerate(
+        net, args, {}, config={"ranks": {"conv1": 4, **full}})
+    assert any(n.endswith("conv1_a_weight")
+               for n in new_sym.list_arguments())
+    out = _forward(new_sym, new_args, x)
+    assert np.allclose(out, base, atol=1e-4), np.abs(out - base).max()
+
+
+def test_accnn_reduced_rank_close():
+    net = _small_cnn()
+    shapes = {"data": (2, 3, 8, 8), "softmax_label": (2,)}
+    args = _random_args(net, shapes)
+    x = np.random.RandomState(1).rand(2, 3, 8, 8).astype(np.float32)
+    base = _forward(net, args, x)
+    new_sym, new_args, _ = accelerate(net, args, {}, ratio=1.5)
+    # decomposed layers replace the originals in the graph
+    names = [n for n in new_sym.list_arguments()]
+    assert any(n.endswith("_a_weight") for n in names), names
+    out = _forward(new_sym, new_args, x)
+    # softmax outputs remain close under mild truncation
+    assert np.abs(out - base).max() < 0.15, np.abs(out - base).max()
+
+
+def test_accnn_rank_config_and_flops():
+    from rank_selection import select_ranks, layer_flops, decomposed_flops
+    net = _small_cnn()
+    shapes = {"data": (2, 3, 8, 8), "softmax_label": (2,)}
+    args = _random_args(net, shapes)
+    import json as _json
+    from utils import Graph
+    g = Graph(net)
+    layers = [(n, args[n["name"] + "_weight"])
+              for n in g.conv_nodes() + g.fc_nodes()]
+    ranks = select_ranks(layers, ratio=2.0)
+    orig = sum(layer_flops(n, args[n["name"] + "_weight"].shape)
+               for n, _ in layers)
+    dec = sum(decomposed_flops(n, args[n["name"] + "_weight"].shape,
+                               ranks[n["name"]]) for n, _ in layers)
+    assert dec <= orig / 2.0 + 1, (dec, orig)

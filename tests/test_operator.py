@@ -468,6 +468,55 @@ def test_upsampling_nearest():
     assert same(ex.outputs[0].asnumpy(), expected)
 
 
+def test_upsampling_bilinear_multichannel():
+    """Depthwise bilinear deconv (reference upsampling-inl.h): with the
+    standard bilinear kernel, a constant C>1 image upsamples to the same
+    constant in the interior; channels stay independent."""
+    scale, C = 2, 4
+    data = mx.sym.Variable("data")
+    sym = mx.sym.UpSampling(data, scale=scale, sample_type="bilinear",
+                            num_filter=C, name="up")
+    k = 2 * scale - scale % 2
+    f = int(np.ceil(k / 2.0))
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    w = np.zeros((C, 1, k, k), np.float32)
+    for ch in range(C):
+        for y in range(k):
+            for xx in range(k):
+                w[ch, 0, y, xx] = ((1 - abs(xx / f - c))
+                                   * (1 - abs(y / f - c)))
+    x = np.zeros((1, C, 4, 4), np.float32)
+    for ch in range(C):
+        x[0, ch] = ch + 1.0
+    ex = exec_forward(sym, {"data": x, "up_weight": w})
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (1, C, 8, 8)
+    for ch in range(C):       # interior = constant per channel
+        assert np.allclose(out[0, ch, 2:-2, 2:-2], ch + 1.0, atol=1e-5), ch
+
+
+def test_deconvolution_grouped():
+    """num_group>1: equals independent deconvs on channel halves
+    (reference deconvolution-inl.h grouped path)."""
+    data = mx.sym.Variable("data")
+    dc = mx.sym.Deconvolution(data, kernel=(3, 3), stride=(2, 2),
+                              pad=(1, 1), num_filter=4, num_group=2,
+                              no_bias=True, name="dc")
+    x = np.random.rand(2, 4, 5, 5).astype(np.float32)
+    w = np.random.rand(4, 2, 3, 3).astype(np.float32)
+    ex = exec_forward(dc, {"data": x, "dc_weight": w})
+    out = ex.outputs[0].asnumpy()
+    # per-group reference: plain deconv on each half
+    ref = mx.sym.Deconvolution(data, kernel=(3, 3), stride=(2, 2),
+                               pad=(1, 1), num_filter=2, num_group=1,
+                               no_bias=True, name="dc")
+    for g in range(2):
+        exg = exec_forward(ref, {"data": x[:, 2 * g:2 * g + 2],
+                                 "dc_weight": w[2 * g:2 * g + 2]})
+        assert reldiff(out[:, 2 * g:2 * g + 2],
+                       exg.outputs[0].asnumpy()) < 1e-5, g
+
+
 def test_crop():
     data = mx.sym.Variable("data")
     sym = mx.sym.Crop(data, h_w=(2, 2), offset=(1, 1))

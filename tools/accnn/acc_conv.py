@@ -1,0 +1,40 @@
+"""Low-rank convolution decomposition (reference tools/accnn/acc_conv.py).
+
+Channel-output scheme (Zhang et al., "Accelerating Very Deep Convolutional
+Networks"): a KxK conv C_in->C_out of weight W (C_out, C_in, K, K) becomes
+
+    conv_a: KxK, C_in -> r, no bias      W1 = sqrt(S_r) V_r^T
+    conv_b: 1x1, r -> C_out, bias        W2 = U_r sqrt(S_r)
+
+via SVD of W reshaped to (C_out, C_in*K*K).  FLOPs ratio ~
+r*(C_in*K*K + C_out) / (C_out*C_in*K*K)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def conv_vh_decomposition(weight, bias, node, rank):
+    """Return (specs, new_args): two-node chain + decomposed weights."""
+    W = weight.asnumpy()
+    cout = W.shape[0]
+    mat = W.reshape(cout, -1)
+    U, S, Vt = np.linalg.svd(mat, full_matrices=False)
+    rank = max(1, min(rank, len(S)))
+    sq = np.sqrt(S[:rank])
+    W1 = (sq[:, None] * Vt[:rank]).reshape(rank, *W.shape[1:])
+    W2 = (U[:, :rank] * sq[None, :]).reshape(cout, rank, 1, 1)
+
+    p = dict(node["param"])
+    name = node["name"]
+    spec_a = {"op": "Convolution", "name": name + "_a", "no_bias": True,
+              "param": {**p, "num_filter": str(rank), "no_bias": "True"}}
+    spec_b = {"op": "Convolution", "name": name + "_b",
+              "no_bias": bias is None,
+              "param": {**p, "kernel": "(1, 1)", "stride": "(1, 1)",
+                        "pad": "(0, 0)", "num_filter": str(cout),
+                        "no_bias": str(bias is None)}}
+    new_args = {name + "_a_weight": mx.nd.array(W1.astype(np.float32)),
+                name + "_b_weight": mx.nd.array(W2.astype(np.float32))}
+    if bias is not None:
+        new_args[name + "_b_bias"] = bias.copy()
+    return [spec_a, spec_b], new_args

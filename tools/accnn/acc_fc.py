@@ -1,0 +1,30 @@
+"""Low-rank fully-connected decomposition (reference tools/accnn/acc_fc.py):
+FC W (n, m) -> FC_a (r, m) no-bias + FC_b (n, r) with the original bias,
+via truncated SVD."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def fc_decomposition(weight, bias, node, rank):
+    W = weight.asnumpy()
+    n = W.shape[0]
+    U, S, Vt = np.linalg.svd(W, full_matrices=False)
+    rank = max(1, min(rank, len(S)))
+    sq = np.sqrt(S[:rank])
+    W1 = sq[:, None] * Vt[:rank]           # (r, m)
+    W2 = U[:, :rank] * sq[None, :]         # (n, r)
+
+    name = node["name"]
+    p = dict(node["param"])
+    spec_a = {"op": "FullyConnected", "name": name + "_a", "no_bias": True,
+              "param": {**p, "num_hidden": str(rank), "no_bias": "True"}}
+    spec_b = {"op": "FullyConnected", "name": name + "_b",
+              "no_bias": bias is None,
+              "param": {**p, "num_hidden": str(n),
+                        "no_bias": str(bias is None)}}
+    new_args = {name + "_a_weight": mx.nd.array(W1.astype(np.float32)),
+                name + "_b_weight": mx.nd.array(W2.astype(np.float32))}
+    if bias is not None:
+        new_args[name + "_b_bias"] = bias.copy()
+    return [spec_a, spec_b], new_args

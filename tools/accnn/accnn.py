@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Accelerate a trained CNN by low-rank decomposition (reference
+tools/accnn/accnn.py driver):
+
+    python accnn.py --model prefix --load-epoch 10 --ratio 2 \
+        --save-model prefix-acc
+
+Every Convolution (kernel > 1x1) and FullyConnected layer is SVD-split
+into a rank-r pair; ranks chosen by rank_selection under the FLOPs ratio.
+The result loads like any checkpoint (same data/softmax contract)."""
+import argparse
+import ast
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from utils import Graph, load_model, save_model
+from acc_conv import conv_vh_decomposition
+from acc_fc import fc_decomposition
+from rank_selection import select_ranks
+
+
+def accelerate(symbol, arg_params, aux_params, ratio=2.0, config=None):
+    g = Graph(symbol)
+    layers = []
+    for node in g.conv_nodes() + g.fc_nodes():
+        wname = node["name"] + "_weight"
+        if wname not in arg_params:
+            continue
+        if node["op"] == "Convolution":
+            if ast.literal_eval(node["param"]["kernel"]) == (1, 1):
+                continue
+            if int(node["param"].get("num_group", "1")) != 1:
+                continue
+        layers.append((node, arg_params[wname]))
+    ranks = (config or {}).get("ranks") or select_ranks(layers, ratio)
+
+    replacements, new_args = {}, {}
+    for node, W in layers:
+        rank = int(ranks[node["name"]])
+        full = min(W.shape[0], int(np.prod(W.shape[1:])))
+        if rank >= full:      # nothing to gain
+            continue
+        bias = arg_params.get(node["name"] + "_bias")
+        fn = (conv_vh_decomposition if node["op"] == "Convolution"
+              else fc_decomposition)
+        chain, args = fn(W, bias, node, rank)
+        replacements[node["name"]] = chain
+        new_args.update(args)
+
+    new_sym = g.rebuild(replacements)
+    out_args = {k: v for k, v in arg_params.items()
+                if not any(k.startswith(n + "_") for n in replacements)}
+    out_args.update(new_args)
+    return new_sym, out_args, aux_params
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--load-epoch", type=int, default=0)
+    parser.add_argument("--ratio", type=float, default=2.0)
+    parser.add_argument("--config", default=None,
+                        help="json with per-layer ranks: {\"ranks\": {...}}")
+    parser.add_argument("--save-model", default=None)
+    args = parser.parse_args()
+
+    symbol, arg_params, aux_params = load_model(args)
+    config = json.load(open(args.config)) if args.config else None
+    new_sym, new_args, new_aux = accelerate(symbol, arg_params, aux_params,
+                                            args.ratio, config)
+    out = args.save_model or (args.model + "-acc")
+    save_model(out, args.load_epoch, new_sym, new_args, new_aux)
+    print("saved accelerated model to %s" % out)
+
+
+if __name__ == "__main__":
+    main()

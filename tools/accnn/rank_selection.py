@@ -1,0 +1,63 @@
+"""Rank selection (reference tools/accnn/rank_selection.py: DP over layers
+maximizing accuracy proxy under a FLOPs budget).
+
+Per-layer spectral-energy proxy: the loss of truncating to rank r is the
+discarded squared singular mass; pick the smallest ranks whose combined
+FLOPs meet `--ratio` while distributing energy loss evenly (waterfilling
+over the sorted spectra — the DP of the reference collapses to this under
+the additive-energy model)."""
+import numpy as np
+
+
+def layer_flops(node, weight_shape):
+    if node["op"] == "Convolution":
+        cout, cin, kh, kw = weight_shape
+        return cout * cin * kh * kw
+    n, m = weight_shape
+    return n * m
+
+
+def decomposed_flops(node, weight_shape, rank):
+    if node["op"] == "Convolution":
+        cout, cin, kh, kw = weight_shape
+        return rank * (cin * kh * kw + cout)
+    n, m = weight_shape
+    return rank * (n + m)
+
+
+def select_ranks(layers, ratio):
+    """layers: [(node, weight ndarray)] -> {name: rank}.
+
+    Greedy waterfilling: repeatedly drop the singular value with the
+    smallest energy-per-FLOP-saved until total decomposed FLOPs <=
+    original/ratio."""
+    spectra = {}
+    ranks = {}
+    budget = 0
+    for node, W in layers:
+        mat = W.asnumpy().reshape(W.shape[0], -1)
+        s = np.linalg.svd(mat, compute_uv=False)
+        spectra[node["name"]] = (node, W.shape, s ** 2)
+        ranks[node["name"]] = len(s)
+        budget += layer_flops(node, W.shape)
+    target = budget / float(ratio)
+
+    def total():
+        return sum(decomposed_flops(n, shp, ranks[name])
+                   for name, (n, shp, _) in spectra.items())
+
+    while total() > target:
+        best, best_cost = None, None
+        for name, (node, shp, energy) in spectra.items():
+            r = ranks[name]
+            if r <= 1:
+                continue
+            saved = (decomposed_flops(node, shp, r)
+                     - decomposed_flops(node, shp, r - 1))
+            cost = energy[r - 1] / max(saved, 1)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = name, cost
+        if best is None:
+            break
+        ranks[best] -= 1
+    return ranks

@@ -1,0 +1,90 @@
+"""Graph-JSON surgery helpers for CNN acceleration (reference
+tools/accnn/utils.py: load/save models, walk and edit the node list)."""
+import json
+
+import mxnet_tpu as mx
+
+
+def load_model(args):
+    """Load (symbol, arg_params, aux_params) from --model prefix/epoch."""
+    return mx.model.load_checkpoint(args.model, args.load_epoch)
+
+
+def save_model(prefix, epoch, symbol, arg_params, aux_params):
+    mx.model.save_checkpoint(prefix, epoch, symbol, arg_params,
+                             aux_params or {})
+
+
+class Graph(object):
+    """Editable view of a symbol's JSON: replace an op node with a small
+    chain of new nodes, then re-emit a loadable JSON."""
+
+    def __init__(self, symbol):
+        j = json.loads(symbol.tojson())
+        self.nodes = j["nodes"]
+        self.heads = j["heads"]
+        self.attrs = j.get("attrs", {})
+
+    def conv_nodes(self):
+        return [n for n in self.nodes if n["op"] == "Convolution"]
+
+    def fc_nodes(self):
+        return [n for n in self.nodes if n["op"] == "FullyConnected"]
+
+    def _emit_null(self, new_nodes, name):
+        new_nodes.append({"op": "null", "name": name, "attr": {},
+                          "inputs": []})
+        return len(new_nodes) - 1
+
+    def rebuild(self, replacements):
+        """replacements: {old_node_name: [spec, ...]} where each spec is
+        {op, name, param, no_bias} — a chain applied in order, first input
+        = the old node's first input, weights/bias created as fresh null
+        nodes named <name>_weight/_bias."""
+        old_nodes = self.nodes
+        # old weight/bias nulls of replaced nodes become dead: drop any
+        # null consumed only by replaced nodes (their data input survives
+        # because the replacement chain consumes it)
+        replaced_idx = {i for i, n in enumerate(old_nodes)
+                        if n["name"] in replacements}
+        used = set(h[0] for h in self.heads)
+        for i, node in enumerate(old_nodes):
+            if i in replaced_idx:
+                used.add(node["inputs"][0][0])
+            else:
+                used.update(src for src, _ in node["inputs"])
+        new_nodes = []
+        idx_map = {}           # old index -> new index
+        arg_nodes = []
+        for i, node in enumerate(old_nodes):
+            if node["op"] == "null" and i not in used:
+                continue
+            chain = replacements.get(node["name"])
+            if chain is None:
+                n = dict(node)
+                n["inputs"] = [[idx_map[src], out]
+                               for src, out in node["inputs"]]
+                new_nodes.append(n)
+                idx_map[i] = len(new_nodes) - 1
+                if node["op"] == "null":
+                    arg_nodes.append(idx_map[i])
+                continue
+            # the data input of the node being replaced
+            cur = [idx_map[node["inputs"][0][0]], node["inputs"][0][1]]
+            for spec in chain:
+                w = self._emit_null(new_nodes, spec["name"] + "_weight")
+                arg_nodes.append(w)
+                inputs = [cur, [w, 0]]
+                if not spec.get("no_bias", False):
+                    b = self._emit_null(new_nodes, spec["name"] + "_bias")
+                    arg_nodes.append(b)
+                    inputs.append([b, 0])
+                new_nodes.append({"op": spec["op"], "name": spec["name"],
+                                  "param": spec["param"], "attr": {},
+                                  "inputs": inputs})
+                cur = [len(new_nodes) - 1, 0]
+            idx_map[i] = cur[0]
+        heads = [[idx_map[h[0]], h[1]] for h in self.heads]
+        j = {"nodes": new_nodes, "arg_nodes": arg_nodes, "heads": heads,
+             "attrs": self.attrs}
+        return mx.sym.load_json(json.dumps(j))
