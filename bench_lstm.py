@@ -1,0 +1,101 @@
+"""Second north-star benchmark (BASELINE.json): PTB-style LSTM training
+throughput, tokens/sec on one TPU chip.
+
+Reference setup (example/rnn/lstm_bucketing.py): 2-layer LSTM, 200 hidden,
+200 embed, seq_len 32, batch 32, vocab 10k, trained with truncated BPTT.
+No published MXNet-CUDA tokens/sec exists in-repo (BASELINE.md has only
+image models), so vs_baseline uses the derived TitanX estimate of the same
+era: Inception-BN sustained ~128 img/s/GPU at ~4.4 GFLOP/img forward =
+~1.7 TFLOP/s/GPU training; the PTB LSTM above costs ~21 MFLOP/token
+(fwd+bwd), giving ~80k tokens/s/GPU as the comparable per-chip number.
+
+Prints ONE JSON line like bench.py; run `python bench.py` for the primary
+(ResNet-50) metric.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TOKENS_S_PER_CHIP = 80000.0
+
+
+def build_step(batch=32, seq_len=32, num_hidden=200, num_embed=200,
+               num_layer=2, vocab=10000):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import make_mesh, DPTrainStep
+    from mxnet_tpu.models.lstm import lstm_unroll
+
+    net = lstm_unroll(num_layer, seq_len, vocab, num_hidden, num_embed,
+                      vocab, dropout=0.0)
+    rng = np.random.RandomState(0)
+    data_shape = (batch, seq_len)
+    init_states = {}
+    for l in range(num_layer):
+        init_states["l%d_init_c" % l] = (batch, num_hidden)
+        init_states["l%d_init_h" % l] = (batch, num_hidden)
+    shapes = {"data": data_shape, "softmax_label": data_shape, **init_states}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name in shapes:
+            continue
+        fan_in = int(np.prod(shp[1:])) if len(shp) > 1 else shp[0]
+        params[name] = (rng.randn(*shp) * 0.1).astype(np.float32)
+
+    mesh = make_mesh([("dp", 1)], devices=jax.devices()[:1])
+    step = DPTrainStep(net, mesh, learning_rate=0.1, momentum=0.0,
+                      weight_decay=0.0, rescale_grad=1.0 / batch,
+                      compute_dtype=jnp.bfloat16,
+                      data_names=tuple(["data"] + list(init_states)),
+                      label_names=("softmax_label",))
+    state = step.init(params, {})
+    batch_data = {"data": rng.randint(0, vocab, data_shape).astype(np.float32),
+                  "softmax_label": rng.randint(0, vocab, data_shape)
+                  .astype(np.float32)}
+    for k, shp in init_states.items():
+        batch_data[k] = np.zeros(shp, np.float32)
+    sharded = step.shard_batch(batch_data)
+    return step, state, sharded
+
+
+def run(batch=32, seq_len=32, warmup=5, iters=50):
+    import jax
+    step, state, batch_data = build_step(batch=batch, seq_len=seq_len)
+    for _ in range(warmup):
+        state, outs = step(state, batch_data)
+    jax.block_until_ready((state, outs))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, outs = step(state, batch_data)
+    jax.block_until_ready((state, outs))
+    dt = time.perf_counter() - t0
+    return batch * seq_len * iters / dt
+
+
+def main():
+    value = None
+    for batch in (32, 16):
+        try:
+            value = run(batch=batch)
+            break
+        except Exception as e:
+            sys.stderr.write("bench_lstm: batch %d failed (%s)\n"
+                             % (batch, e))
+    if value is None:
+        print(json.dumps({"metric": "ptb_lstm_train_tokens_per_chip",
+                          "value": 0.0, "unit": "tokens/sec",
+                          "vs_baseline": 0.0}))
+        return
+    print(json.dumps({
+        "metric": "ptb_lstm_train_tokens_per_chip",
+        "value": round(value, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(value / BASELINE_TOKENS_S_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -168,3 +168,19 @@ def test_rpn_shapes():
     net = get_rpn(num_anchors=3, small=True)
     _, outs, _ = net.infer_shape(data=(1, 3, 32, 32))
     assert outs[1][1] == 12  # 4 * num_anchors bbox deltas
+
+
+def test_bench_lstm_step_cpu():
+    """bench_lstm harness: one train step on tiny shapes (the real bench
+    runs the same code on the TPU chip)."""
+    import sys as _sys, os as _os
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    import jax
+    from bench_lstm import build_step
+    step, state, batch = build_step(batch=2, seq_len=4, num_hidden=8,
+                                    num_embed=8, num_layer=1, vocab=50)
+    state, outs = step(state, batch)
+    jax.block_until_ready((state, outs))
+    state, outs = step(state, batch)   # donated-buffer second step
+    jax.block_until_ready((state, outs))

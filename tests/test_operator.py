@@ -632,3 +632,111 @@ def test_mlp_gradient():
            "f2_weight": (np.random.rand(3, 8).astype(np.float32) - 0.5),
            "f2_bias": np.random.rand(3).astype(np.float32)}
     check_numeric_gradient(fc2, loc, numeric_eps=1e-2, check_eps=0.1)
+
+
+def test_simpleop_unary_family():
+    """Every registered unary SimpleOp vs its numpy reference
+    (reference elementwise_unary_op-inl.h registrations)."""
+    cases = {
+        "_abs": (np.abs, (-5, 5)), "_ceil": (np.ceil, (-5, 5)),
+        "_cos": (np.cos, (-3, 3)), "_exp": (np.exp, (-2, 2)),
+        "_floor": (np.floor, (-5, 5)), "_log": (np.log, (0.1, 5)),
+        "_round": (np.round, (-5, 5)),
+        "_rsqrt": (lambda x: 1.0 / np.sqrt(x), (0.1, 5)),
+        "_sign": (np.sign, (-5, 5)), "_sin": (np.sin, (-3, 3)),
+        "_sqrt": (np.sqrt, (0.1, 5)), "_square": (np.square, (-5, 5)),
+    }
+    for name, (ref, (lo, hi)) in cases.items():
+        x = np.random.uniform(lo, hi, (3, 4)).astype(np.float32)
+        fn = getattr(mx.nd, name)
+        out = fn(mx.nd.array(x)).asnumpy()
+        assert reldiff(out, ref(x).astype(np.float32)) < 1e-4, name
+
+
+def test_simpleop_binary_and_scalar_family():
+    """_plus/_minus/_mul/_div/_power + scalar and reverse-scalar variants
+    (reference elementwise_binary_scalar_op-inl.h)."""
+    a = np.random.uniform(1, 3, (3, 4)).astype(np.float32)
+    b = np.random.uniform(1, 3, (3, 4)).astype(np.float32)
+    na, nb = mx.nd.array(a), mx.nd.array(b)
+    assert reldiff(mx.nd._plus(na, nb).asnumpy(), a + b) < 1e-5
+    assert reldiff(mx.nd._minus(na, nb).asnumpy(), a - b) < 1e-5
+    assert reldiff(mx.nd._mul(na, nb).asnumpy(), a * b) < 1e-5
+    assert reldiff(mx.nd._div(na, nb).asnumpy(), a / b) < 1e-5
+    assert reldiff(mx.nd._power(na, nb).asnumpy(), a ** b) < 1e-4
+    assert reldiff(mx.nd._plus_scalar(na, scalar=2.0).asnumpy(), a + 2) < 1e-5
+    assert reldiff(mx.nd._minus_scalar(na, scalar=2.0).asnumpy(), a - 2) < 1e-5
+    assert reldiff(mx.nd._rminus_scalar(na, scalar=2.0).asnumpy(), 2 - a) < 1e-5
+    assert reldiff(mx.nd._mul_scalar(na, scalar=3.0).asnumpy(), a * 3) < 1e-5
+    assert reldiff(mx.nd._div_scalar(na, scalar=3.0).asnumpy(), a / 3) < 1e-5
+    assert reldiff(mx.nd._rdiv_scalar(na, scalar=3.0).asnumpy(), 3 / a) < 1e-5
+    assert reldiff(mx.nd._power_scalar(na, scalar=2.0).asnumpy(), a ** 2) < 1e-4
+    assert reldiff(mx.nd._rpower_scalar(na, scalar=2.0).asnumpy(), 2 ** a) < 1e-4
+    assert reldiff(mx.nd._maximum_scalar(na, scalar=2.0).asnumpy(),
+                   np.maximum(a, 2)) < 1e-5
+    assert reldiff(mx.nd._minimum_scalar(na, scalar=2.0).asnumpy(),
+                   np.minimum(a, 2)) < 1e-5
+
+
+def test_broadcast_family():
+    """broadcast_{plus,minus,mul,div,power} numeric + gradient
+    (reference elementwise_binary_broadcast_op-inl.h)."""
+    a = np.random.uniform(1, 2, (2, 3, 4)).astype(np.float32)
+    b = np.random.uniform(1, 2, (1, 3, 1)).astype(np.float32)
+    lhs, rhs = mx.sym.Variable("lhs"), mx.sym.Variable("rhs")
+    for name, ref in [("broadcast_plus", np.add),
+                      ("broadcast_minus", np.subtract),
+                      ("broadcast_mul", np.multiply),
+                      ("broadcast_div", np.divide),
+                      ("broadcast_power", np.power)]:
+        sym = getattr(mx.sym, name)(lhs, rhs)
+        ex = exec_forward(sym, {"lhs": a, "rhs": b}, is_train=True)
+        assert reldiff(ex.outputs[0].asnumpy(), ref(a, b)) < 1e-4, name
+        if name in ("broadcast_plus", "broadcast_mul"):
+            check_numeric_gradient(sym, {"lhs": a, "rhs": b},
+                                   numeric_eps=1e-2, check_eps=0.1)
+
+
+def test_argmax_channel_min_axis_round():
+    a = np.random.uniform(-5, 5, (4, 6)).astype(np.float32)
+    na = mx.nd.array(a)
+    assert same(mx.nd.argmax_channel(na).asnumpy(),
+                a.argmax(axis=1).astype(np.float32))
+    assert reldiff(mx.nd.min_axis(na, axis=1).asnumpy(), a.min(axis=1)) < 1e-5
+    assert same(mx.nd.round(na).asnumpy(), np.round(a).astype(np.float32))
+
+
+def test_simpleop_crop_lowercase():
+    """SimpleOp crop (matrix_op-inl.h), distinct from the Crop symbol."""
+    a = np.random.rand(1, 3, 8, 8).astype(np.float32)
+    out = mx.nd.crop(mx.nd.array(a), begin=(0, 0, 2, 2), end=(1, 3, 6, 6))
+    assert same(out.asnumpy(), a[:, :, 2:6, 2:6])
+
+
+def test_softmax_deprecated_alias_and_activation():
+    """Softmax (deprecated alias of SoftmaxOutput) and SoftmaxActivation."""
+    x = np.random.rand(4, 5).astype(np.float32)
+    data = mx.sym.Variable("data")
+    sa = mx.sym.SoftmaxActivation(data)
+    ex = exec_forward(sa, {"data": x})
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    assert reldiff(ex.outputs[0].asnumpy(), e / e.sum(axis=1, keepdims=True)) < 1e-5
+    old = mx.sym.Softmax(data, name="softmax")
+    lab = np.random.randint(0, 5, (4,)).astype(np.float32)
+    ex2 = exec_forward(old, {"data": x, "softmax_label": lab})
+    assert reldiff(ex2.outputs[0].asnumpy(),
+                   e / e.sum(axis=1, keepdims=True)) < 1e-5
+
+
+def test_identity_attach_kl_sparse_reg():
+    """Identity forward; KL sparsity penalty only shapes the gradient
+    (reference identity_attach_KL_sparse_reg-inl.h)."""
+    x = np.random.uniform(0.05, 0.95, (6, 4)).astype(np.float32)
+    data = mx.sym.Variable("data")
+    sym = mx.sym.IdentityAttachKLSparseReg(data, sparseness_target=0.1,
+                                           penalty=0.01)
+    ex = exec_forward(sym, {"data": x}, is_train=True)
+    assert reldiff(ex.outputs[0].asnumpy(), x) < 1e-6
+    ex.backward(out_grads=[mx.nd.array(np.ones_like(x))])
+    g = ex.grad_dict["data"].asnumpy()
+    assert g.shape == x.shape and not np.allclose(g, 1.0)
