@@ -8,7 +8,8 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import mxnet_tpu as mx
 from mxnet_tpu.models import (get_mlp, get_lenet, get_resnet50,
-                              get_inception_bn, get_vgg)
+                              get_inception_bn, get_vgg, get_alexnet,
+                              get_googlenet, get_inception_v3)
 import train_model
 
 
@@ -34,6 +35,9 @@ NETS = {
     "resnet-50": lambda c: get_resnet50(c),
     "inception-bn": lambda c: get_inception_bn(c),
     "vgg": lambda c: get_vgg(c),
+    "alexnet": lambda c: get_alexnet(c),
+    "googlenet": lambda c: get_googlenet(c),
+    "inception-v3": lambda c: get_inception_v3(c),
 }
 
 

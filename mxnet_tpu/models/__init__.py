@@ -3,6 +3,9 @@ mxnet_tpu symbol API (reference example/image-classification/symbol_*.py,
 example/rnn/lstm.py — capability parity, fresh implementations)."""
 from .mlp import get_mlp
 from .lenet import get_lenet
+from .alexnet import get_alexnet
+from .googlenet import get_googlenet
+from .inception_v3 import get_inception_v3
 from .resnet import get_resnet, get_resnet50
 from .inception_bn import get_inception_bn, get_inception_bn_28small
 from .vgg import get_vgg

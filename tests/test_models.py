@@ -184,3 +184,20 @@ def test_bench_lstm_step_cpu():
     jax.block_until_ready((state, outs))
     state, outs = step(state, batch)   # donated-buffer second step
     jax.block_until_ready((state, outs))
+
+
+def test_alexnet_googlenet_inception_v3_shapes():
+    """New zoo members build, infer, and forward on tiny batches
+    (reference symbol_alexnet/googlenet/inception-v3)."""
+    from mxnet_tpu.models import (get_alexnet, get_googlenet,
+                                  get_inception_v3)
+    net = get_alexnet(num_classes=10)
+    _, out, _ = net.infer_shape(data=(1, 3, 224, 224),
+                                softmax_label=(1,))
+    assert out[0] == (1, 10)
+    net = get_googlenet(num_classes=10)
+    _, out, _ = net.infer_shape(data=(1, 3, 224, 224), softmax_label=(1,))
+    assert out[0] == (1, 10)
+    net = get_inception_v3(num_classes=10)
+    _, out, _ = net.infer_shape(data=(1, 3, 299, 299), softmax_label=(1,))
+    assert out[0] == (1, 10)
