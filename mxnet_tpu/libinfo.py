@@ -1,0 +1,30 @@
+"""Library discovery (reference python/mxnet/libinfo.py: find_lib_path for
+libmxnet.so).  Locates the native shared objects built by the Makefile."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["find_lib_path", "__version__"]
+
+
+def find_lib_path(name: str = "libmxtpu.so"):
+    """Return candidate paths for a native library, package dir first
+    (reference find_lib_path search-order contract)."""
+    curr = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    candidates = [
+        os.path.join(curr, name),
+        os.path.join(curr, "..", name),
+        os.path.join(curr, "..", "amalgamation", name),
+    ]
+    paths = [p for p in candidates if os.path.exists(p)
+             and os.path.isfile(p)]
+    if not paths:
+        raise RuntimeError(
+            "Cannot find %s: run `make` at the repo root. Searched:\n%s"
+            % (name, "\n".join(candidates)))
+    return paths
+
+
+# kept in sync with mxnet_tpu.__version__ (reference libinfo.py owns the
+# version string; here the package __init__ does)
+__version__ = "0.7.0-tpu.1"

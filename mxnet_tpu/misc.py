@@ -1,0 +1,6 @@
+"""Misc helpers (reference python/mxnet/misc.py: LearningRateScheduler
+era-helpers).  The maintained schedulers live in mxnet_tpu.lr_scheduler;
+this module keeps the reference import path working."""
+from .lr_scheduler import LRScheduler, FactorScheduler, MultiFactorScheduler
+
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler"]

@@ -1,0 +1,44 @@
+"""Symbol docstring helpers (reference python/mxnet/symbol_doc.py: extra
+doc sections attached to auto-generated symbol constructors).
+
+Constructors here are generated from the op registry
+(mxnet_tpu/ops/registry.py), which carries the dmlc::Parameter-style
+schemas; this module supplies the same supplementary-documentation hook."""
+from __future__ import annotations
+
+__all__ = ["SymbolDoc", "get_output_shape"]
+
+
+class SymbolDoc(object):
+    """Base for per-op documentation supplements (reference SymbolDoc).
+    Subclass with the op name + 'Doc' and a docstring; `build_doc` merges
+    it into the generated constructor's __doc__."""
+
+    @staticmethod
+    def get_output_shape(sym, **input_shapes):
+        """Infer and return {output_name: shape} — the doc-example helper
+        the reference exposes for interactive exploration."""
+        _, s_outputs, _ = sym.infer_shape(**input_shapes)
+        return dict(zip(sym.list_outputs(), s_outputs))
+
+
+def get_output_shape(sym, **input_shapes):
+    return SymbolDoc.get_output_shape(sym, **input_shapes)
+
+
+def build_doc(func_name: str, desc: str, arg_names, arg_types, arg_descs,
+              key_var_num_args: str = "", ret_type: str = "Symbol"):
+    """Assemble a numpy-style docstring from registry metadata (reference
+    symbol_doc.py _build_doc used by the generated ctors)."""
+    lines = [desc, "", "Parameters", "----------"]
+    for name, typ, d in zip(arg_names, arg_types, arg_descs):
+        lines.append("%s : %s" % (name, typ))
+        if d:
+            lines.append("    %s" % d)
+    if key_var_num_args:
+        lines += ["%s : int, optional" % key_var_num_args,
+                  "    number of variadic inputs"]
+    lines += ["name : string, optional", "    Name of the resulting symbol.",
+              "", "Returns", "-------", "%s" % ret_type,
+              "    The result symbol."]
+    return "\n".join(lines)

@@ -1,0 +1,157 @@
+/*!
+ * Native dependency-engine + pooled-storage tests, driven through the
+ * extern "C" ABI of libmxtpu.so.
+ *
+ * Reference: tests/cpp/threaded_engine_test.cc (randomized dependency
+ * workloads pushed to the engine, completion & ordering checks) and
+ * tests/cpp/storage_test.cc (alloc/free reuse assertions).
+ */
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+extern "C" {
+void *mxtpu_engine_create(int num_workers, int num_prio_workers);
+void mxtpu_engine_free(void *e);
+uint64_t mxtpu_engine_new_var(void *e);
+void mxtpu_engine_delete_var(void *e, uint64_t v);
+typedef void (*EngineFn)(void *arg);
+int mxtpu_engine_push(void *e, EngineFn fn, void *arg, const uint64_t *cvars,
+                      int nc, const uint64_t *mvars, int nm, int prop,
+                      int priority);
+void mxtpu_engine_wait_for_var(void *e, uint64_t v);
+void mxtpu_engine_wait_for_all(void *e);
+long mxtpu_engine_num_pending(void *e);
+
+void *mxtpu_storage_create(double match_range);
+void mxtpu_storage_destroy(void *s);
+void *mxtpu_storage_alloc(void *s, uint64_t size);
+void mxtpu_storage_free(void *s, void *p);
+void mxtpu_storage_release_all(void *s);
+long mxtpu_storage_pool_bytes(void *s);
+long mxtpu_storage_used_bytes(void *s);
+long mxtpu_storage_num_allocs(void *s);
+long mxtpu_storage_pool_hits(void *s);
+}
+
+#define CHECK(cond)                                                      \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,       \
+                   #cond);                                               \
+      std::exit(1);                                                      \
+    }                                                                    \
+  } while (0)
+
+/* -- write-serialization: ops mutating one var must run in push order -- */
+namespace {
+std::vector<int> g_order;
+std::atomic<int> g_counter{0};
+
+struct OrderArg {
+  int id;
+};
+void record_order(void *arg) {
+  // mutating pushes on ONE var are serialized, so no lock is needed —
+  // that absence IS the property under test
+  g_order.push_back(static_cast<OrderArg *>(arg)->id);
+}
+
+void bump(void *) { g_counter.fetch_add(1); }
+}  // namespace
+
+static void test_write_serialization() {
+  void *eng = mxtpu_engine_create(4, 1);
+  uint64_t var = mxtpu_engine_new_var(eng);
+  const int kOps = 200;
+  std::vector<OrderArg> args(kOps);
+  g_order.clear();
+  g_order.reserve(kOps);
+  for (int i = 0; i < kOps; ++i) {
+    args[i].id = i;
+    CHECK(mxtpu_engine_push(eng, record_order, &args[i], nullptr, 0, &var, 1,
+                            /*prop=*/0, /*priority=*/0) == 0);
+  }
+  mxtpu_engine_wait_for_var(eng, var);
+  CHECK(static_cast<int>(g_order.size()) == kOps);
+  for (int i = 0; i < kOps; ++i) CHECK(g_order[i] == i);
+  mxtpu_engine_delete_var(eng, var);
+  mxtpu_engine_free(eng);
+  std::printf("write serialization ok\n");
+}
+
+/* -- randomized dependency workload (reference threaded_engine_test) --- */
+static void test_random_workload() {
+  void *eng = mxtpu_engine_create(4, 1);
+  std::mt19937 rng(42);
+  const int kVars = 16, kOps = 500;
+  std::vector<uint64_t> vars(kVars);
+  for (auto &v : vars) v = mxtpu_engine_new_var(eng);
+  g_counter = 0;
+  for (int i = 0; i < kOps; ++i) {
+    // random disjoint const/mutable subsets
+    std::vector<uint64_t> cvars, mvars;
+    for (int k = 0; k < kVars; ++k) {
+      int r = static_cast<int>(rng() % 10);
+      if (r == 0)
+        mvars.push_back(vars[k]);
+      else if (r <= 2)
+        cvars.push_back(vars[k]);
+    }
+    if (mvars.empty()) {
+      if (!cvars.empty()) {       // reuse a const var as the mutable one
+        mvars.push_back(cvars.back());
+        cvars.pop_back();
+      } else {
+        mvars.push_back(vars[rng() % kVars]);
+      }
+    }
+    CHECK(mxtpu_engine_push(eng, bump, nullptr, cvars.data(),
+                            static_cast<int>(cvars.size()), mvars.data(),
+                            static_cast<int>(mvars.size()), 0, 0) == 0);
+  }
+  mxtpu_engine_wait_for_all(eng);
+  CHECK(g_counter.load() == kOps);
+  CHECK(mxtpu_engine_num_pending(eng) == 0);
+  for (auto v : vars) mxtpu_engine_delete_var(eng, v);
+  mxtpu_engine_free(eng);
+  std::printf("random workload ok (%d ops)\n", kOps);
+}
+
+/* -- pooled storage reuse (reference storage_test.cc) ------------------ */
+static void test_storage_pool() {
+  void *st = mxtpu_storage_create(1.0);
+  void *a = mxtpu_storage_alloc(st, 4096);
+  CHECK(a != nullptr);
+  CHECK(mxtpu_storage_used_bytes(st) == 4096);
+  mxtpu_storage_free(st, a);
+  CHECK(mxtpu_storage_used_bytes(st) == 0);
+  CHECK(mxtpu_storage_pool_bytes(st) == 4096);
+  // same-size realloc must come from the pool (and thus be the same ptr)
+  long hits_before = mxtpu_storage_pool_hits(st);
+  void *b = mxtpu_storage_alloc(st, 4096);
+  CHECK(b == a);
+  CHECK(mxtpu_storage_pool_hits(st) == hits_before + 1);
+  // different size is a fresh allocation
+  void *c = mxtpu_storage_alloc(st, 8192);
+  CHECK(c != nullptr && c != b);
+  mxtpu_storage_free(st, b);
+  mxtpu_storage_free(st, c);
+  long allocs = mxtpu_storage_num_allocs(st);
+  CHECK(allocs >= 2);
+  mxtpu_storage_release_all(st);
+  CHECK(mxtpu_storage_pool_bytes(st) == 0);
+  mxtpu_storage_destroy(st);
+  std::printf("storage pool ok\n");
+}
+
+int main() {
+  test_write_serialization();
+  test_random_workload();
+  test_storage_pool();
+  std::printf("ALL ENGINE/STORAGE TESTS PASSED\n");
+  return 0;
+}

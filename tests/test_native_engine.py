@@ -290,3 +290,26 @@ def test_normal_negative_priority_keeps_fifo_order():
         e.push(lambda i=i: order.append(i), mutable_vars=[v], priority=-i)
     e.wait_for_all()
     assert order == list(range(10))
+
+
+def test_cpp_engine_storage_binary(tmp_path):
+    """Compile and run the C++ engine/storage test against libmxtpu.so
+    (reference tests/cpp/threaded_engine_test.cc + storage_test.cc)."""
+    import os
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lib = os.path.join(root, "mxnet_tpu", "libmxtpu.so")
+    if not os.path.exists(lib):
+        import pytest
+        pytest.skip("libmxtpu.so not built (run make)")
+    binary = str(tmp_path / "engine_storage_test")
+    subprocess.run(["g++", "-O1", "-std=c++17",
+                    os.path.join(root, "tests", "cpp",
+                                 "engine_storage_test.cc"),
+                    "-o", binary, lib,
+                    "-Wl,-rpath," + os.path.join(root, "mxnet_tpu"),
+                    "-pthread"], check=True)
+    res = subprocess.run([binary], capture_output=True, text=True,
+                         timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "ALL ENGINE/STORAGE TESTS PASSED" in res.stdout
