@@ -178,7 +178,16 @@ class Executor:
 
     def _next_rng(self):
         self._rng_seed += 1
-        return _random.new_key()
+        key = _random.new_key()
+        # pin the key to the executor's device: jax would otherwise leave it
+        # on the DEFAULT device, and a cpu-ctx executor in a process that
+        # also has a TPU would feed mixed-device args to one jit (the
+        # reference analogue: the RNG resource lives on the op's stream,
+        # resource.cc:20-121)
+        if self._ctx is not None:
+            import jax
+            key = jax.device_put(key, self._ctx.jax_device())
+        return key
 
     def _get_jit(self, kind: str):
         """kind: 'fwd_train' | 'fwd_eval' | 'fwdbwd'."""
@@ -264,6 +273,14 @@ class Executor:
                 out_grads = [out_grads]
             head_grads = [g._get() if isinstance(g, NDArray) else jnp.asarray(g)
                           for g in out_grads]
+            if self._ctx is not None:
+                # caller-made head grads may live on another device (e.g.
+                # default-device TPU arrays fed to a cpu-ctx executor) —
+                # rebase them so one jit sees one platform, the analogue of
+                # the reference's head-grad CopyFromTo at bind
+                # (graph_executor.cc:1003-1027)
+                dev = self._ctx.jax_device()
+                head_grads = [jax.device_put(g, dev) for g in head_grads]
         args, aux = self._args_jax(), self._aux_jax()
         gargs = {k: args[k] for k in self._grad_names}
         sargs = {k: v for k, v in args.items() if k not in gargs}

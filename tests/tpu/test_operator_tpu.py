@@ -1,0 +1,146 @@
+"""TPU consistency suite (reference tests/python/gpu/test_operator_gpu.py:
+run the op suite on the accelerator and check CPU<->GPU agreement).
+
+Skipped unless a TPU backend is actually present — the CI suite under
+tests/ pins JAX_PLATFORMS=cpu (conftest), so these run via
+
+    python -m pytest tests/tpu/ -q        # no conftest CPU pin here
+
+on TPU hardware.  Each case computes forward (and backward where cheap) on
+both platforms and compares, the exact oracle the reference used between
+CPU and GPU kernels.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+_ENABLED = os.environ.get("MXNET_TPU_TESTS") == "1"
+if _ENABLED:
+    import jax
+    try:
+        _tpu_devices = [d for d in jax.devices() if d.platform != "cpu"]
+    except Exception:  # backend init failure == no TPU
+        _tpu_devices = []
+else:
+    _tpu_devices = []
+
+pytestmark = pytest.mark.skipif(
+    not _tpu_devices,
+    reason="TPU suite is opt-in: MXNET_TPU_TESTS=1 pytest tests/tpu/")
+
+if _tpu_devices:
+    import mxnet_tpu as mx
+else:  # keep collection importable without touching jax backends
+    mx = None
+
+
+def _forward_on(ctx, sym, vals, aux=None, backward=False):
+    shapes = {k: v.shape for k, v in vals.items()}
+    ex = sym.simple_bind(ctx, grad_req="write" if backward else "null",
+                         **shapes)
+    for k, v in vals.items():
+        ex.arg_dict[k][:] = v
+    if aux:
+        for k, v in aux.items():
+            ex.aux_dict[k][:] = v
+    ex.forward(is_train=backward)
+    outs = [o.asnumpy() for o in ex.outputs]
+    grads = {}
+    if backward:
+        ex.backward(out_grads=[mx.nd.array(np.ones_like(outs[0]))])
+        grads = {k: g.asnumpy() for k, g in ex.grad_dict.items()
+                 if g is not None}
+    return outs, grads
+
+
+def _check_consistency(sym, vals, aux=None, backward=False, tol=1e-2):
+    """CPU vs TPU forward/backward agreement (bf16-tolerant tol)."""
+    cpu_out, cpu_g = _forward_on(mx.cpu(), sym, vals, aux, backward)
+    tpu_out, tpu_g = _forward_on(mx.tpu(0), sym, vals, aux, backward)
+    for c, t in zip(cpu_out, tpu_out):
+        assert np.allclose(c, t, atol=tol, rtol=tol), np.abs(c - t).max()
+    for k in cpu_g:
+        assert np.allclose(cpu_g[k], tpu_g[k], atol=tol, rtol=tol), k
+
+
+def test_fully_connected_consistency():
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=32, name="fc")
+    _check_consistency(fc, {
+        "data": rng.rand(8, 16).astype(np.float32),
+        "fc_weight": rng.rand(32, 16).astype(np.float32),
+        "fc_bias": rng.rand(32).astype(np.float32)}, backward=True)
+
+
+def test_convolution_consistency():
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                              pad=(1, 1), name="c")
+    _check_consistency(conv, {
+        "data": rng.rand(2, 4, 10, 10).astype(np.float32),
+        "c_weight": rng.rand(8, 4, 3, 3).astype(np.float32),
+        "c_bias": rng.rand(8).astype(np.float32)}, backward=True)
+
+
+def test_batchnorm_consistency():
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    _check_consistency(
+        bn,
+        {"data": rng.rand(4, 3, 6, 6).astype(np.float32),
+         "bn_gamma": np.ones(3, np.float32),
+         "bn_beta": np.zeros(3, np.float32)},
+        aux={"bn_moving_mean": np.zeros(3, np.float32),
+             "bn_moving_var": np.ones(3, np.float32)})
+
+
+def test_pooling_softmax_consistency():
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Pooling(data, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    _check_consistency(net, {"data": rng.rand(2, 3, 8, 8)
+                             .astype(np.float32)})
+    sm = mx.sym.SoftmaxActivation(data)
+    _check_consistency(sm, {"data": rng.rand(6, 10).astype(np.float32)})
+
+
+def test_elementwise_and_broadcast_consistency():
+    rng = np.random.RandomState(0)
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    net = mx.sym.broadcast_plus(mx.sym.broadcast_mul(mx.sym.exp(a), b), a)
+    _check_consistency(net, {
+        "a": rng.rand(4, 1, 5).astype(np.float32),
+        "b": rng.rand(4, 6, 5).astype(np.float32)}, backward=True)
+
+
+def test_train_step_consistency():
+    """A whole fused train step agrees between platforms (the reference's
+    multi_lenet.py CPU/GPU parity oracle, collapsed to one chip)."""
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    X = rng.rand(32, 8).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.float32)
+    results = {}
+    for name, ctx in [("cpu", mx.cpu()), ("tpu", mx.tpu(0))]:
+        mx.random.seed(7)
+        np.random.seed(7)
+        it = mx.io.NDArrayIter(X, y, batch_size=8)
+        mod = mx.mod.Module(net, context=ctx)
+        mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+        arg, _ = mod.get_params()
+        results[name] = {k: v.asnumpy() for k, v in arg.items()}
+    for k in results["cpu"]:
+        assert np.allclose(results["cpu"][k], results["tpu"][k], atol=5e-2,
+                           rtol=5e-2), k
