@@ -50,7 +50,7 @@ def run(batch, warmup=5, iters=50):
 def main():
     import jax
     value = None
-    for batch in (128, 64, 32):
+    for batch in (512, 256, 128, 64, 32):
         try:
             value = run(batch)
             break

@@ -77,7 +77,7 @@ def run(batch=32, seq_len=32, warmup=5, iters=50):
 
 def main():
     value = None
-    for batch in (32, 16):
+    for batch in (256, 128, 32, 16):
         try:
             value = run(batch=batch)
             break
