@@ -54,6 +54,10 @@ from . import parallel
 from . import plugins
 from .plugins import torch_bridge as th
 from . import native_io
+from . import profiler
+from . import libinfo
+from . import misc
+from . import symbol_doc
 # must be last: on DMLC_ROLE=server/scheduler this runs the parameter-server
 # loop and exits (reference python/mxnet/__init__.py imports kvstore_server
 # so that `import mxnet` on a server role never returns to user code)

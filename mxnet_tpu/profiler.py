@@ -1,0 +1,72 @@
+"""Profiler: step traces and scoped annotations.
+
+Reference era had no timeline profiler (SURVEY §5.1: Monitor + debug_str +
+MXNET_ENGINE_INFO were the tools; later MXNet grew mx.profiler).  The
+TPU-native build completes the observability story by exposing XLA's real
+profiler through the mx surface:
+
+    mx.profiler.profiler_set_config(filename="/tmp/trace")
+    mx.profiler.profiler_set_state("run")
+    ... training steps ...
+    mx.profiler.profiler_set_state("stop")   # trace dir for xprof/tensorboard
+
+    with mx.profiler.scope("data-loading"):  # named regions in the trace
+        batch = next(it)
+
+Function names mirror the later-mxnet C API (MXSetProfilerConfig /
+MXSetProfilerState) so ported scripts work unchanged.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+__all__ = ["profiler_set_config", "profiler_set_state", "scope",
+           "dump_profile", "state"]
+
+_config = {"filename": "profile_output", "mode": "symbolic"}
+_state = "stop"
+
+
+def profiler_set_config(mode: str = "symbolic",
+                        filename: str = "profile_output") -> None:
+    """Configure the trace output directory (reference
+    MXSetProfilerConfig(mode, filename))."""
+    _config["mode"] = mode
+    _config["filename"] = filename
+
+
+def profiler_set_state(state_name: str = "stop") -> None:
+    """'run' starts a jax.profiler trace into the configured directory,
+    'stop' ends it (reference MXSetProfilerState(1/0))."""
+    global _state
+    import jax
+    if state_name not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    if state_name == "run" and _state != "run":
+        out = _config["filename"]
+        os.makedirs(out, exist_ok=True)
+        jax.profiler.start_trace(out)
+        _state = "run"
+    elif state_name == "stop" and _state == "run":
+        jax.profiler.stop_trace()
+        _state = "stop"
+
+
+def state() -> str:
+    return _state
+
+
+def dump_profile() -> str:
+    """Return the trace directory (reference MXDumpProfile wrote the json;
+    XLA traces stream to disk while running)."""
+    return _config["filename"]
+
+
+@contextlib.contextmanager
+def scope(name: str):
+    """Named region visible in the trace timeline (jax TraceAnnotation);
+    also usable around host-side work like data loading."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield

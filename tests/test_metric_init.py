@@ -1,0 +1,115 @@
+"""Metric zoo and initializer tests (reference python/mxnet/metric.py:21-330
+and initializer.py; reference covered these through training scripts)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+# -- metrics ----------------------------------------------------------------
+
+def _upd(metric, labels, preds):
+    metric.update([mx.nd.array(l) for l in labels],
+                  [mx.nd.array(p) for p in preds])
+    return metric.get()
+
+
+def test_accuracy_and_topk():
+    pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+    label = np.array([1, 1, 1], np.float32)
+    name, val = _upd(mx.metric.Accuracy(), [label], [pred])
+    assert abs(val - 2.0 / 3.0) < 1e-6
+    pred5 = np.random.RandomState(0).rand(8, 5).astype(np.float32)
+    lab5 = pred5.argsort(axis=1)[:, -3].astype(np.float32)  # 3rd best class
+    _, v2 = _upd(mx.metric.TopKAccuracy(top_k=2), [lab5], [pred5])
+    _, v3 = _upd(mx.metric.TopKAccuracy(top_k=3), [lab5], [pred5])
+    assert v2 == 0.0 and v3 == 1.0
+    with pytest.raises(AssertionError):   # reference guard (metric.py:126)
+        mx.metric.TopKAccuracy(top_k=1)
+
+
+def test_mae_mse_rmse():
+    pred = np.array([[1.0], [2.0], [3.0]], np.float32)
+    label = np.array([[2.0], [2.0], [5.0]], np.float32)
+    _, mae = _upd(mx.metric.MAE(), [label], [pred])
+    assert abs(mae - 1.0) < 1e-6
+    _, mse = _upd(mx.metric.MSE(), [label], [pred])
+    assert abs(mse - (1 + 0 + 4) / 3.0) < 1e-6
+    _, rmse = _upd(mx.metric.RMSE(), [label], [pred])
+    assert abs(rmse - np.sqrt(5 / 3.0)) < 1e-5
+
+
+def test_cross_entropy_metric():
+    pred = np.array([[0.2, 0.8], [0.9, 0.1]], np.float32)
+    label = np.array([1, 0], np.float32)
+    _, ce = _upd(mx.metric.CrossEntropy(), [label], [pred])
+    assert abs(ce - (-(np.log(0.8) + np.log(0.9)) / 2)) < 1e-5
+
+
+def test_f1():
+    pred = np.array([[0.9, 0.1], [0.2, 0.8], [0.3, 0.7], [0.6, 0.4]],
+                    np.float32)
+    label = np.array([0, 1, 0, 1], np.float32)
+    _, f1 = _upd(mx.metric.F1(), [label], [pred])
+    # tp=1 (idx1), fp=1 (idx2), fn=1 (idx3) -> precision=recall=0.5
+    assert abs(f1 - 0.5) < 1e-6
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["acc", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    m2 = mx.metric.create("rmse")
+    assert isinstance(m2, mx.metric.RMSE)
+    custom = mx.metric.np(lambda label, pred: float((label == 1).mean()),
+                          name="ones")
+    _, v = _upd(custom, [np.array([1, 1, 0], np.float32)],
+                [np.zeros((3, 2), np.float32)])
+    assert abs(v - 2.0 / 3.0) < 1e-6
+
+
+# -- initializers -----------------------------------------------------------
+
+def _init_arr(init, name, shape):
+    arr = mx.nd.zeros(shape)
+    init(name, arr)
+    return arr.asnumpy()
+
+
+def test_initializer_naming_rules():
+    init = mx.init.Uniform(0.1)
+    assert (_init_arr(init, "fc_bias", (4,)) == 0).all()
+    assert (_init_arr(init, "bn_gamma", (4,)) == 1).all()
+    assert (_init_arr(init, "bn_beta", (4,)) == 0).all()
+    assert (_init_arr(init, "bn_moving_mean", (4,)) == 0).all()
+    assert (_init_arr(init, "bn_moving_var", (4,)) == 1).all()
+    w = _init_arr(init, "fc_weight", (50, 50))
+    assert np.abs(w).max() <= 0.1 and np.abs(w).std() > 0
+
+
+def test_xavier_and_msra():
+    w = _init_arr(mx.init.Xavier(factor_type="avg", magnitude=3), "w_weight",
+                  (100, 200))
+    bound = np.sqrt(3.0 / ((100 + 200) / 2.0))
+    assert np.abs(w).max() <= bound + 1e-6
+    w2 = _init_arr(mx.init.MSRAPrelu(slope=0.25), "w_weight", (64, 128))
+    assert w2.std() > 0
+
+
+def test_orthogonal():
+    w = _init_arr(mx.init.Orthogonal(scale=1.0), "w_weight", (32, 64))
+    wwt = w @ w.T
+    assert np.allclose(wwt, np.eye(32), atol=1e-4)
+
+
+def test_load_and_mixed():
+    ref = {"fc_weight": mx.nd.array(np.full((3, 3), 7, np.float32))}
+    init = mx.init.Load(ref, default_init=mx.init.Uniform(0.01))
+    got = _init_arr(init, "fc_weight", (3, 3))
+    assert (got == 7).all()
+    other = _init_arr(init, "other_weight", (3, 3))
+    assert np.abs(other).max() <= 0.01
+    mixed = mx.init.Mixed([".*bias.*", ".*"],
+                          [mx.init.Zero() if hasattr(mx.init, "Zero")
+                           else mx.init.Uniform(0.0), mx.init.Uniform(0.05)])
+    b = _init_arr(mixed, "fc_bias", (4,))
+    assert (b == 0).all()

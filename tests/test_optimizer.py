@@ -1,0 +1,156 @@
+"""Optimizer update-rule tests vs numpy references (reference
+python/mxnet/optimizer.py formulas; reference had no dedicated optimizer
+unit suite — trainings covered it — but the rules are worth pinning)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _step(optimizer, w0, g, steps=1, index=0):
+    weight = mx.nd.array(w0.copy())
+    state = optimizer.create_state(index, weight)
+    for _ in range(steps):
+        optimizer.update(index, weight, mx.nd.array(g), state)
+    return weight.asnumpy(), state
+
+
+def test_sgd_plain_and_momentum():
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+    g = np.array([0.5, 0.5, -1.0], np.float32)
+    # plain: w -= lr*(g + wd*w)
+    got, _ = _step(opt.SGD(learning_rate=0.1, wd=0.01), w0, g)
+    assert np.allclose(got, w0 - 0.1 * (g + 0.01 * w0), atol=1e-6)
+    # momentum, two steps: mom = m*mom - lr*g - lr*wd*w ; w += mom
+    o = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.0)
+    got, _ = _step(o, w0, g, steps=2)
+    w, mom = w0.copy(), np.zeros_like(w0)
+    for _ in range(2):
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    assert np.allclose(got, w, atol=1e-6)
+
+
+def test_sgd_rescale_and_clip():
+    w0 = np.array([1.0, 1.0], np.float32)
+    g = np.array([10.0, -10.0], np.float32)
+    o = opt.SGD(learning_rate=0.1, wd=0.0, rescale_grad=0.5,
+                clip_gradient=2.0)
+    got, _ = _step(o, w0, g)
+    eff = np.clip(g * 0.5, -2.0, 2.0)
+    assert np.allclose(got, w0 - 0.1 * eff, atol=1e-6)
+
+
+def test_nag():
+    w0 = np.array([1.0, -1.0], np.float32)
+    g = np.array([0.2, 0.4], np.float32)
+    o = opt.NAG(learning_rate=0.1, momentum=0.9, wd=0.0)
+    got, _ = _step(o, w0, g, steps=2)
+    w, mom = w0.copy(), np.zeros_like(w0)
+    for _ in range(2):
+        mom = 0.9 * mom + g
+        w = w - 0.1 * (0.9 * mom + g)
+    assert np.allclose(got, w, atol=1e-6)
+
+
+def test_adam():
+    w0 = np.array([1.0, -1.0], np.float32)
+    g = np.array([0.3, 0.6], np.float32)
+    o = opt.Adam(learning_rate=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 wd=0.0)
+    got, _ = _step(o, w0, g, steps=3)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t in range(1, 4):
+        lr_t = 0.01 * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        w = w - lr_t * m / (np.sqrt(v) + 1e-8)
+    assert np.allclose(got, w, atol=1e-6)
+
+
+def test_adagrad():
+    w0 = np.array([2.0, -2.0], np.float32)
+    g = np.array([0.5, 1.0], np.float32)
+    o = opt.AdaGrad(learning_rate=0.1, wd=0.0, eps=1e-7)
+    got, _ = _step(o, w0, g, steps=2)
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for _ in range(2):
+        h += g * g
+        w = w - 0.1 * g / np.sqrt(h + 1e-7)
+    assert np.allclose(got, w, atol=1e-6)
+
+
+def test_rmsprop_graves():
+    w0 = np.array([1.0, 1.0], np.float32)
+    g = np.array([0.4, -0.2], np.float32)
+    o = opt.RMSProp(learning_rate=0.05, gamma1=0.95, gamma2=0.9, wd=0.0)
+    got, _ = _step(o, w0, g, steps=2)
+    w = w0.copy()
+    n = np.zeros_like(w); gb = np.zeros_like(w); d = np.zeros_like(w)
+    for _ in range(2):
+        n = 0.05 * g * g + 0.95 * n
+        gb = 0.05 * g + 0.95 * gb
+        d = 0.9 * d - 0.05 * g / np.sqrt(n - gb * gb + 1e-4)
+        w = w + d
+    assert np.allclose(got, w, atol=1e-6)
+
+
+def test_adadelta():
+    w0 = np.array([1.0, -1.0], np.float32)
+    g = np.array([0.3, 0.3], np.float32)
+    o = opt.AdaDelta(rho=0.9, epsilon=1e-5, wd=0.0)
+    got, _ = _step(o, w0, g, steps=2)
+    w = w0.copy()
+    ag = np.zeros_like(w); ad = np.zeros_like(w)
+    for _ in range(2):
+        ag = 0.9 * ag + 0.1 * g * g
+        cur = np.sqrt(ad + 1e-5) / np.sqrt(ag + 1e-5) * g
+        ad = 0.9 * ad + 0.1 * cur * cur
+        w = w - cur
+    assert np.allclose(got, w, atol=1e-6)
+
+
+def test_wd_mult_naming_rule():
+    """bias/gamma/beta get wd=0 by naming rule (reference optimizer.py)."""
+    o = opt.SGD(learning_rate=0.1, wd=0.5)
+    o.idx2name = {0: "fc_weight", 1: "fc_bias", 2: "bn_gamma"}
+    w0 = np.array([1.0], np.float32)
+    g = np.array([0.0], np.float32)
+    got_w, _ = _step(o, w0, g, index=0)
+    assert np.allclose(got_w, w0 - 0.1 * 0.5 * w0)     # decayed
+    got_b, _ = _step(o, w0, g, index=1)
+    assert np.allclose(got_b, w0)                       # bias: wd 0
+    got_g, _ = _step(o, w0, g, index=2)
+    assert np.allclose(got_g, w0)                       # gamma: wd 0
+
+
+def test_lr_scheduler_in_optimizer():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    o = opt.SGD(learning_rate=0.1, wd=0.0, lr_scheduler=sched)
+    o.lr_scheduler.base_lr = 0.1
+    w0 = np.array([1.0], np.float32)
+    g = np.array([1.0], np.float32)
+    weight = mx.nd.array(w0)
+    state = o.create_state(0, weight)
+    deltas = []
+    prev = w0[0]
+    for _ in range(5):
+        o.update(0, weight, mx.nd.array(g), state)
+        cur = weight.asnumpy()[0]
+        deltas.append(prev - cur)
+        prev = cur
+    # lr halves every 2 updates: 0.1, 0.1, 0.05, 0.05, 0.025
+    assert np.allclose(deltas, [0.1, 0.1, 0.05, 0.05, 0.025], atol=1e-6), deltas
+
+
+def test_create_and_get_updater():
+    o = opt.create("sgd", learning_rate=0.2)
+    assert isinstance(o, opt.SGD) and abs(o.lr - 0.2) < 1e-9
+    upd = opt.get_updater(opt.SGD(learning_rate=0.1, wd=0.0))
+    w = mx.nd.array(np.array([1.0], np.float32))
+    upd(0, mx.nd.array(np.array([0.5], np.float32)), w)
+    assert np.allclose(w.asnumpy(), [0.95])

@@ -66,3 +66,19 @@ def test_engine_waitall_and_ordering():
     v = a[5:10]
     a *= 2
     assert (v.asnumpy() == 20).all()
+
+
+def test_profiler_trace(tmp_path):
+    """mx.profiler: start/stop produces a trace dir; scope annotates."""
+    out = str(tmp_path / "trace")
+    mx.profiler.profiler_set_config(filename=out)
+    mx.profiler.profiler_set_state("run")
+    with mx.profiler.scope("work"):
+        (mx.nd.ones((64, 64)) * 2).asnumpy()
+    mx.profiler.profiler_set_state("stop")
+    assert mx.profiler.state() == "stop"
+    import os as _os
+    found = []
+    for root, _, files in _os.walk(out):
+        found += files
+    assert found, "no trace files written"
