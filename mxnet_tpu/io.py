@@ -466,7 +466,10 @@ class ImageRecordIter(DataIter):
                  shuffle=False, mean_img=None, mean_r=0, mean_g=0, mean_b=0,
                  scale=1.0, rand_crop=False, rand_mirror=False,
                  part_index=0, num_parts=1, round_batch=True,
-                 preprocess_threads=4, prefetch_buffer=4, **kwargs):
+                 preprocess_threads=4, prefetch_buffer=4, resize=0,
+                 max_rotate_angle=0, max_random_contrast=0.0,
+                 max_random_illumination=0.0, random_h=0, random_s=0,
+                 random_l=0, **kwargs):
         super().__init__()
         from . import recordio as _recordio
         self.batch_size = batch_size
@@ -476,6 +479,16 @@ class ImageRecordIter(DataIter):
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.scale = scale
+        # reference default augmenter knobs (src/io/image_aug_default.cc):
+        # resize shorter edge, random rotation, contrast/illumination
+        # jitter, HSL channel shifts
+        self.resize = resize
+        self.max_rotate_angle = max_rotate_angle
+        self.max_random_contrast = max_random_contrast
+        self.max_random_illumination = max_random_illumination
+        self.random_h = random_h
+        self.random_s = random_s
+        self.random_l = random_l
         self.mean = None
         if mean_img is not None and os.path.exists(mean_img):
             from .ndarray import load as nd_load
@@ -514,13 +527,57 @@ class ImageRecordIter(DataIter):
             np.random.shuffle(self._order)
         self.cursor = -self.batch_size
 
+    def _augment_pil(self, pil_img):
+        """Reference default-augmenter steps that need the decoded image
+        (image_aug_default.cc): shorter-edge resize, random rotation, HSL
+        channel jitter."""
+        from PIL import Image
+        if self.resize:
+            w0, h0 = pil_img.size
+            if w0 < h0:
+                pil_img = pil_img.resize(
+                    (self.resize, int(h0 * self.resize / w0)),
+                    Image.BILINEAR)
+            else:
+                pil_img = pil_img.resize(
+                    (int(w0 * self.resize / h0), self.resize),
+                    Image.BILINEAR)
+        if self.max_rotate_angle:
+            angle = np.random.uniform(-self.max_rotate_angle,
+                                      self.max_rotate_angle)
+            pil_img = pil_img.rotate(angle, resample=Image.BILINEAR)
+        if self.random_h or self.random_s or self.random_l:
+            hsv = np.asarray(pil_img.convert("HSV"), dtype=np.int16)
+            for ch, amp in enumerate((self.random_h, self.random_s,
+                                      self.random_l)):
+                if amp:
+                    delta = int(np.random.uniform(-amp, amp))
+                    if ch == 0:       # hue wraps
+                        hsv[..., 0] = (hsv[..., 0] + delta) % 256
+                    else:
+                        hsv[..., ch] = np.clip(hsv[..., ch] + delta, 0, 255)
+            pil_img = Image.fromarray(hsv.astype(np.uint8),
+                                      "HSV").convert("RGB")
+        return pil_img
+
     def _decode(self, raw: bytes) -> np.ndarray:
         try:
             from PIL import Image
             import io as _io
-            img = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"),
-                             dtype=np.float32)
+            pil_img = Image.open(_io.BytesIO(raw)).convert("RGB")
+            pil_img = self._augment_pil(pil_img)
+            img = np.asarray(pil_img, dtype=np.float32)
             img = img.transpose(2, 0, 1)  # HWC -> CHW
+            # photometric jitter (contrast around the mean, illumination
+            # shift), both on the 0-255 scale like the reference
+            if self.max_random_contrast:
+                alpha = 1.0 + np.random.uniform(-self.max_random_contrast,
+                                                self.max_random_contrast)
+                img = (img - img.mean()) * alpha + img.mean()
+            if self.max_random_illumination:
+                img = img + np.random.uniform(
+                    -self.max_random_illumination,
+                    self.max_random_illumination)
         except ImportError:
             # raw-packed records: stored as flattened CHW float/uint8
             arr = np.frombuffer(raw, dtype=np.uint8)

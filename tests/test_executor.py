@@ -143,3 +143,43 @@ def test_head_gradient():
     exe.forward(is_train=True)
     exe.backward(mx.nd.array(np.array([[10.0, 100.0]], dtype=np.float32)))
     assert np.allclose(g.asnumpy(), np.array([[20.0, 400.0]]))
+
+
+def test_backward_mirror_grad_equivalence(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR (memonger -> jax.checkpoint) must not
+    change gradients, only the memory/compute trade
+    (reference static_graph.cc:404-437)."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 6).astype(np.float32)
+    lab = rng.randint(0, 3, (4,)).astype(np.float32)
+
+    def grads(mirror):
+        if mirror:
+            monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+        else:
+            monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="tanh")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        ex = net.simple_bind(mx.cpu(), grad_req="write", data=x.shape,
+                             softmax_label=lab.shape)
+        rng2 = np.random.RandomState(1)
+        for k, v in ex.arg_dict.items():
+            if k == "data":
+                v[:] = x
+            elif k == "softmax_label":
+                v[:] = lab
+            else:
+                v[:] = rng2.rand(*v.shape).astype(np.float32) * 0.1
+        ex.forward(is_train=True)
+        ex.backward()
+        return {k: g.asnumpy() for k, g in ex.grad_dict.items()
+                if g is not None}
+
+    g_plain = grads(False)
+    g_mirror = grads(True)
+    assert set(g_plain) == set(g_mirror)
+    for k in g_plain:
+        assert np.allclose(g_plain[k], g_mirror[k], atol=1e-6), k

@@ -180,3 +180,34 @@ def test_mnist_like_idx(tmp_path):
                           flat=True, shuffle=False)
     b = next(iter(it2))
     assert b.data[0].shape == (10, 784)
+
+
+def test_image_record_iter_augmentations(tmp_path):
+    """Reference default-augmenter knobs (image_aug_default.cc): shorter-
+    edge resize, rotation, HSL jitter, contrast/illumination."""
+    pytest.importorskip("PIL")
+    frec = str(tmp_path / "aug.rec")
+    writer = recordio.MXRecordIO(frec, "w")
+    N, C, H, W = 8, 3, 16, 16
+    rng = np.random.RandomState(0)
+    for i in range(N):
+        img = (rng.rand(H, W, C) * 255).astype(np.uint8)
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i % 2), i, 0), img, img_fmt=".png"))
+    writer.close()
+    it = mx.io.ImageRecordIter(
+        path_imgrec=frec, data_shape=(C, 10, 10), batch_size=4,
+        resize=12, max_rotate_angle=15, rand_crop=True, rand_mirror=True,
+        random_h=20, random_s=20, random_l=20, max_random_contrast=0.2,
+        max_random_illumination=10)
+    batches = list(it)
+    assert len(batches) == 2
+    a0 = batches[0].data[0].asnumpy()
+    assert a0.shape == (4, C, 10, 10)
+    assert np.isfinite(a0).all()
+    # randomized augmentation: a second pass differs from the first
+    it.reset()
+    b0 = next(iter(it)).data[0].asnumpy()
+    assert not np.allclose(a0, b0)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(labels.tolist()) <= {0.0, 1.0}
