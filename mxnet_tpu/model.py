@@ -376,7 +376,9 @@ class FeedForward(BASE_ESTIMATOR):
         self._init_predictor(data_shapes)
         batch_size = X.batch_size
         data_arrays = [self._pred_exec.arg_dict[name] for name in data_names]
-        output_list = [[] for _ in range(len(self._pred_exec.outputs))]
+        # executor outputs materialize only after forward(); the count is
+        # static from the symbol
+        output_list = [[] for _ in range(len(self.symbol.list_outputs()))]
         if return_data:
             data_list = [[] for _ in X.provide_data]
             label_list = [[] for _ in X.provide_label]

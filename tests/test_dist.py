@@ -38,6 +38,15 @@ def test_dist_mlp_2workers_convergence():
     assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr
 
 
+def test_dist_sync_kvstore_4workers():
+    """The reference nightly ran exactly this: launch.py -n 4 +
+    dist_sync_kvstore.py (tests/nightly/test_all.sh:44)."""
+    res = _launch(4, "tests/nightly/dist_sync_kvstore.py", timeout=160,
+                  port=9097)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("PASSED") == 4, res.stdout + res.stderr
+
+
 def test_dist_async_mlp_convergence():
     """Async SGD end-to-end: Module.fit with server-side optimizer
     (update_on_kvstore), stale-weight pulls, accuracy gate."""

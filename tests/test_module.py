@@ -155,3 +155,38 @@ def test_monitor_in_module():
     mod.backward()
     res = mon.toc()
     assert len(res) > 0
+
+
+def test_checkpoint_resume_training(tmp_path):
+    """Crash-recovery story (SURVEY §5.3): train, checkpoint every epoch,
+    reload with --load-epoch semantics, resume to completion."""
+    import os
+    rng = np.random.RandomState(0)
+    centers = np.random.RandomState(42).randn(3, 6) * 3
+    y = rng.randint(3, size=240)
+    X = (centers[y] + rng.randn(240, 6) * 0.4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=24,
+                           shuffle=True)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    prefix = str(tmp_path / "resume")
+
+    ff = mx.model.FeedForward(net, ctx=mx.cpu(), num_epoch=2,
+                              learning_rate=0.3)
+    ff.fit(it, epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    assert os.path.exists(prefix + "-0002.params")
+
+    # resume from epoch 2, run to epoch 4 (reference --load-epoch path)
+    ff2 = mx.model.FeedForward.load(prefix, 2, ctx=mx.cpu(), num_epoch=4,
+                                    learning_rate=0.3)
+    it.reset()
+    ff2.fit(it, epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    assert os.path.exists(prefix + "-0004.params")
+
+    eval_it = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=24)
+    preds = ff2.predict(eval_it)
+    acc = (preds.argmax(axis=1) == y[:preds.shape[0]]).mean()
+    assert acc > 0.9, acc
