@@ -489,6 +489,11 @@ class ImageRecordIter(DataIter):
         self.random_h = random_h
         self.random_s = random_s
         self.random_l = random_l
+        # multi-threaded decode (reference ImageRecordIOParser's OMP decode
+        # threads, iter_image_recordio.cc:139-291): PIL decode drops the
+        # GIL, so a thread pool overlaps JPEG decode across the batch
+        self.preprocess_threads = max(1, int(preprocess_threads))
+        self._pool = None
         self.mean = None
         if mean_img is not None and os.path.exists(mean_img):
             from .ndarray import load as nd_load
@@ -608,7 +613,15 @@ class ImageRecordIter(DataIter):
             raise StopIteration
         idxs = [self._order[(self.cursor + i) % len(self._records)]
                 for i in range(self.batch_size)]
-        data = np.stack([self._decode(self._records[i][1]) for i in idxs])
+        if self.preprocess_threads > 1 and len(idxs) > 1:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(self.preprocess_threads)
+            decoded = list(self._pool.map(
+                lambda i: self._decode(self._records[i][1]), idxs))
+        else:
+            decoded = [self._decode(self._records[i][1]) for i in idxs]
+        data = np.stack(decoded)
         labels = np.stack([self._records[i][0] for i in idxs])
         if self.label_width == 1:
             labels = labels.reshape(-1)

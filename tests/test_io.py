@@ -211,3 +211,27 @@ def test_image_record_iter_augmentations(tmp_path):
     assert not np.allclose(a0, b0)
     labels = np.concatenate([b.label[0].asnumpy() for b in batches])
     assert set(labels.tolist()) <= {0.0, 1.0}
+
+
+def test_image_record_iter_threaded_decode(tmp_path):
+    """preprocess_threads>1 overlaps decode (reference OMP decode threads)
+    and yields byte-identical batches to serial decode when augmentation
+    is deterministic."""
+    pytest.importorskip("PIL")
+    frec = str(tmp_path / "thr.rec")
+    writer = recordio.MXRecordIO(frec, "w")
+    N, C, H, W = 16, 3, 12, 12
+    rng = np.random.RandomState(3)
+    for i in range(N):
+        img = (rng.rand(H, W, C) * 255).astype(np.uint8)
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    writer.close()
+    kw = dict(path_imgrec=frec, data_shape=(C, H, W), batch_size=8)
+    serial = [b.data[0].asnumpy()
+              for b in mx.io.ImageRecordIter(preprocess_threads=1, **kw)]
+    threaded = [b.data[0].asnumpy()
+                for b in mx.io.ImageRecordIter(preprocess_threads=4, **kw)]
+    assert len(serial) == len(threaded) == 2
+    for a, b in zip(serial, threaded):
+        assert np.array_equal(a, b)
