@@ -25,7 +25,7 @@ except Exception:  # pragma: no cover
     pl = None
     HAS_PALLAS = False
 
-__all__ = ["flash_attention", "HAS_PALLAS"]
+__all__ = ["flash_attention", "correlation", "HAS_PALLAS"]
 
 
 def _attention_dense(q, k, v, causal):
@@ -119,3 +119,58 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _correlation_kernel(a_ref, b_ref, o_ref, *, d2, stride2, hh, ww,
+                        is_multiply, norm):
+    """One batch sample per grid step: a (C,H,W) against the padded
+    b (C,H+2m,W+2m); the d2*d2 displacement loop reuses both VMEM tiles —
+    one HBM read per input instead of one per displacement (what the
+    unrolled jnp.roll lowering pays).  Displacement offsets are STATIC
+    python-unrolled slices: Mosaic cannot prove alignment for dynamic
+    lane-dimension offsets."""
+    a = a_ref[0].astype(jnp.float32)                      # (C, H, W)
+    b = b_ref[0].astype(jnp.float32)                      # (C, H+2m, W+2m)
+    for idx in range(d2 * d2):
+        dy = (idx // d2) * stride2
+        dx = (idx % d2) * stride2
+        b_tile = b[:, dy:dy + hh, dx:dx + ww]
+        if is_multiply:
+            corr = jnp.sum(a * b_tile, axis=0) / norm
+        else:
+            corr = jnp.sum(jnp.abs(a - b_tile), axis=0) / norm
+        o_ref[0, idx] = corr.astype(o_ref.dtype)
+
+
+def correlation(a, b, max_displacement: int, stride2: int = 1,
+                is_multiply: bool = True, interpret: bool = False):
+    """FlowNet correlation (reference correlation.cu) for the
+    kernel_size=1 / stride1=1 / pad=max_displacement configuration.
+    a, b: (N, C, H, W) -> (N, D2*D2, H, W) with D2 = 2*(m//stride2)+1.
+    Returns None when the Pallas path is unavailable (caller falls back
+    to the lax lowering)."""
+    on_tpu = jax.default_backend() == "tpu"
+    if not HAS_PALLAS or (not on_tpu and not interpret):
+        return None
+    n, c, h, w = a.shape
+    m = max_displacement
+    ng = m // stride2
+    d2 = 2 * ng + 1
+    if d2 * d2 > 169:   # static unroll bound: fall back for huge windows
+        return None
+    bp = jnp.pad(b, [(0, 0), (0, 0), (m, m), (m, m)])
+    kernel = functools.partial(
+        _correlation_kernel, d2=d2, stride2=stride2, hh=h, ww=w,
+        is_multiply=is_multiply, norm=float(c))
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, c, h, w), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, c, h + 2 * m, w + 2 * m),
+                         lambda i: (i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d2 * d2, h, w), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d2 * d2, h, w), a.dtype),
+        interpret=interpret,
+    )(a, bp)

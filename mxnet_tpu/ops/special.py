@@ -163,6 +163,16 @@ class CorrelationOp(OpDef):
         a, b = inputs
         n, c, h, w = a.shape
         ph, pw, kr, br, oh, ow, ng, d2 = self._geom(p, a.shape)
+        # Pallas fast path (the reference's hand-written correlation.cu
+        # equivalent): one VMEM-resident displacement loop instead of
+        # d2*d2 HBM passes. Covers the FlowNet configuration.
+        if (p.kernel_size == 1 and p.stride1 == 1
+                and p.pad_size == p.max_displacement):
+            from .pallas_kernels import correlation as _pallas_corr
+            out = _pallas_corr(a, b, p.max_displacement, p.stride2,
+                               p.is_multiply)
+            if out is not None:
+                return [out]
         pad = [(0, 0), (0, 0), (p.pad_size, p.pad_size), (p.pad_size, p.pad_size)]
         ap = jnp.pad(a, pad)
         bp = jnp.pad(b, pad)

@@ -46,3 +46,35 @@ def test_rtc_pallas_kernel():
               lambda x: x * 2.0 + 1.0)
     rtc.push([a], [out])
     assert np.allclose(out.asnumpy(), 7.0)
+
+
+def test_pallas_correlation_matches_lax():
+    """Pallas correlation kernel (interpret mode) vs the lax lowering
+    (reference correlation.cu semantics)."""
+    from mxnet_tpu.ops.pallas_kernels import correlation, HAS_PALLAS
+    if not HAS_PALLAS:
+        pytest.skip("no pallas")
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(0)
+    n, c, h, w, m = 2, 4, 6, 6, 2
+    a = jnp.asarray(rng.rand(n, c, h, w).astype(np.float32))
+    b = jnp.asarray(rng.rand(n, c, h, w).astype(np.float32))
+    for stride2 in (1, 2):
+        for is_mult in (True, False):
+            got = correlation(a, b, m, stride2, is_mult, interpret=True)
+            # lax reference via the registered op
+            data1, data2 = mx.sym.Variable("data1"), mx.sym.Variable("data2")
+            sym = mx.sym.Correlation(data1, data2, kernel_size=1,
+                                     max_displacement=m, stride1=1,
+                                     stride2=stride2, pad_size=m,
+                                     is_multiply=is_mult)
+            ex = sym.simple_bind(mx.cpu(), grad_req="null",
+                                 data1=(n, c, h, w), data2=(n, c, h, w))
+            ex.arg_dict["data1"][:] = np.asarray(a)
+            ex.arg_dict["data2"][:] = np.asarray(b)
+            ex.forward(is_train=False)
+            want = ex.outputs[0].asnumpy()
+            assert got.shape == want.shape, (got.shape, want.shape)
+            assert np.allclose(np.asarray(got), want, atol=1e-5), (
+                stride2, is_mult, np.abs(np.asarray(got) - want).max())
