@@ -84,10 +84,16 @@ class Module(BaseModule):
 
     @property
     def output_shapes(self):
+        """Static shapes from symbol inference (reference module.py
+        output_shapes) — must work before any forward has run
+        (SequentialModule wires the next module's input from these at
+        bind time)."""
         assert self.binded
-        outputs = self._exec_group.get_outputs()
-        return [(name, tuple(o.shape))
-                for name, o in zip(self._output_names, outputs)]
+        shapes = {name: shape for name, shape in self._data_shapes}
+        for name, shape in (self._label_shapes or []):
+            shapes[name] = shape
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self._output_names, [tuple(s) for s in out_shapes]))
 
     # -- params --------------------------------------------------------------
     def get_params(self):

@@ -426,13 +426,16 @@ def save(fname: str, data) -> None:
     for a in arrays:
         if not isinstance(a, NDArray):
             raise TypeError("save only accepts dict or list of NDArray")
-    payload = {"names": names,
-               "arrays": [a.asnumpy() for a in arrays]}
+    raw = [a.asnumpy() for a in arrays]
+    # npz has no bfloat16: store as uint16 bits + a dtype tag per array
+    dtypes = [str(a.dtype) for a in raw]
+    raw = [a.view(np.uint16) if d == "bfloat16" else a
+           for a, d in zip(raw, dtypes)]
     with open(fname, "wb") as f:
         f.write(_SAVE_MAGIC)
         np_bytes = _io.BytesIO()
-        np.savez(np_bytes, *payload["arrays"])
-        meta = pickle.dumps(payload["names"])
+        np.savez(np_bytes, *raw)
+        meta = pickle.dumps({"names": names, "dtypes": dtypes})
         f.write(struct.pack("<Q", len(meta)))
         f.write(meta)
         f.write(np_bytes.getvalue())
@@ -452,10 +455,20 @@ def loads(buf: bytes, name: str = "<bytes>"):
     if magic != _SAVE_MAGIC:
         raise MXNetError("invalid NDArray file %s" % name)
     (meta_len,) = struct.unpack("<Q", stream.read(8))
-    names = pickle.loads(stream.read(meta_len))
+    meta = pickle.loads(stream.read(meta_len))
+    if isinstance(meta, dict):
+        names, dtypes = meta["names"], meta.get("dtypes")
+    else:                      # blobs from older saves: names only
+        names, dtypes = meta, None
     npz = np.load(_io.BytesIO(stream.read()))
-    arrays = [array(npz["arr_%d" % i], dtype=npz["arr_%d" % i].dtype)
-              for i in range(len(npz.files))]
+    arrays = []
+    for i in range(len(npz.files)):
+        a = npz["arr_%d" % i]
+        dt = dtypes[i] if dtypes else str(a.dtype)
+        if dt == "bfloat16":
+            import ml_dtypes
+            a = a.view(ml_dtypes.bfloat16)
+        arrays.append(array(a, dtype=dt))
     if names is None:
         return arrays
     return dict(zip(names, arrays))

@@ -190,3 +190,51 @@ def test_checkpoint_resume_training(tmp_path):
     preds = ff2.predict(eval_it)
     acc = (preds.argmax(axis=1) == y[:preds.shape[0]]).mean()
     assert acc > 0.9, acc
+
+
+def test_sequential_module():
+    """SequentialModule chains sub-modules; labels feed only the tagged
+    one (reference sequential_module.py take_labels/auto_wiring)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+
+    d1 = mx.sym.Variable("data")
+    feat = mx.sym.Activation(mx.sym.FullyConnected(d1, num_hidden=12,
+                                                   name="fc1"),
+                             act_type="relu")
+    m1 = mx.mod.Module(feat, label_names=[], context=mx.cpu())
+    d2 = mx.sym.Variable("data")
+    head = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(d2, num_hidden=2,
+                                                      name="fc2"),
+                                name="softmax")
+    m2 = mx.mod.Module(head, context=mx.cpu())
+
+    seq = mx.mod.SequentialModule()
+    seq.add(m1).add(m2, take_labels=True, auto_wiring=True)
+    seq.fit(it, num_epoch=12, optimizer_params={"learning_rate": 0.5})
+    it.reset()
+    acc = seq.score(it, "acc")[0][1]
+    assert acc >= 0.9, acc
+    # gradient flowed through the chain into the first module
+    w1 = m1.get_params()[0]["fc1_weight"].asnumpy()
+    assert w1.std() > 0.05, w1.std()
+
+
+def test_python_loss_module():
+    """PythonLossModule computes gradients in python against the chained
+    symbolic module (reference python_module.py usage pattern)."""
+    from mxnet_tpu.module.python_module import PythonLossModule
+    m = PythonLossModule(grad_func=lambda scores, labels:
+                         scores.asnumpy() - labels.asnumpy())
+    m.bind(data_shapes=[("data", (4, 3))])
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 3).astype(np.float32))
+    from mxnet_tpu.io import DataBatch
+    b = DataBatch(data=[x], label=[x], pad=0)
+    m.forward(b, is_train=True)
+    out = m.get_outputs()[0]
+    assert out.shape == (4, 3)
+    m.backward()
+    grads = m.get_input_grads()
+    assert grads[0].shape == (4, 3)

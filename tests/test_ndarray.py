@@ -245,3 +245,49 @@ def test_multi_cpu_devices():
     b = a.as_in_context(mx.cpu(5))
     assert b.context == mx.Context("cpu", 5)
     assert same(b.asnumpy(), np.ones(4))
+
+
+def test_dtype_matrix():
+    """fp16/bf16/int32/uint8 dtype support (reference v0.7 NEWS: 'support
+    fp16, fp64, int32, uint8 dtypes').  float64 is a documented TPU-native
+    divergence: it truncates to float32 unless JAX_ENABLE_X64 is set (the
+    MXU has no f64)."""
+    for dt, tol in [(np.float16, 1e-2), ("bfloat16", 1e-1),
+                    (np.int32, 0), (np.uint8, 0)]:
+        a = mx.nd.ones((3, 4), dtype=dt)
+        b = a + a
+        out = b.asnumpy()
+        assert np.allclose(out.astype(np.float64), 2.0, atol=tol), dt
+        if dt != "bfloat16":
+            assert str(mx.nd.zeros((2,), dtype=dt).dtype) == np.dtype(dt).name
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f64 = mx.nd.ones((2, 2), dtype=np.float64)
+    assert str(f64.dtype) in ("float32", "float64")
+
+
+def test_cast_between_dtypes():
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    for target in ("float16", "int32", "uint8"):
+        data = mx.sym.Variable("data")
+        c = mx.sym.Cast(data, dtype=target)
+        ex = c.simple_bind(mx.cpu(), grad_req="null", data=(2, 3))
+        ex.arg_dict["data"][:] = x
+        ex.forward(is_train=False)
+        got = ex.outputs[0].asnumpy()
+        assert got.dtype == np.dtype(target), (target, got.dtype)
+        assert np.allclose(got.astype(np.float64),
+                           np.arange(6).reshape(2, 3)), target
+
+
+def test_mixed_precision_save_load(tmp_path):
+    path = str(tmp_path / "mixed.nd")
+    arrs = {"f16": mx.nd.ones((2, 2), dtype=np.float16),
+            "bf16": mx.nd.ones((2, 2), dtype="bfloat16") * 3,
+            "i32": mx.nd.ones((2, 2), dtype=np.int32) * 7}
+    mx.nd.save(path, arrs)
+    loaded = mx.nd.load(path)
+    for k, v in arrs.items():
+        assert str(loaded[k].dtype) == str(v.dtype), k
+        assert np.array_equal(loaded[k].asnumpy(), v.asnumpy()), k
