@@ -11,20 +11,44 @@ __all__ = ["plot_network", "print_summary"]
 
 
 def print_summary(symbol: Symbol, shape: Optional[Dict] = None):
-    """Print layer summary table (reference visualization.py print_summary)."""
+    """Print layer summary table with output shapes and parameter counts
+    (reference visualization.py print_summary)."""
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
+    out_shape_by_name = {}
+    arg_shape_by_name = {}
     if shape is not None:
-        _, out_shapes, _ = symbol.get_internals().infer_shape(**shape)
-    print("%-30s %-20s %-20s" % ("Layer (type)", "Op", "Param"))
-    print("=" * 72)
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        for name, s in zip(internals.list_outputs(), out_shapes):
+            out_shape_by_name[name] = tuple(s)
+        arg_shapes, _, _ = symbol.infer_shape(**shape)
+        for name, s in zip(symbol.list_arguments(), arg_shapes):
+            arg_shape_by_name[name] = tuple(s)
+    import numpy as _np
+    print("%-28s %-18s %-20s %-10s" % ("Layer (type)", "Op", "Output Shape",
+                                       "Params"))
+    print("=" * 80)
     total = 0
+    data_names = set(shape.keys()) if shape else {"data"}
     for node in nodes:
         if node["op"] == "null":
             continue
-        print("%-30s %-20s %-20s" % (node["name"], node["op"],
-                                     str(node.get("param", {}))))
-    print("=" * 72)
+        # parameters = this op's null inputs that aren't data/labels
+        n_params = 0
+        for (j, _) in node["inputs"]:
+            src = nodes[j]
+            if src["op"] == "null" and src["name"] not in data_names:
+                s = arg_shape_by_name.get(src["name"])
+                if s:
+                    n_params += int(_np.prod(s))
+        total += n_params
+        out_s = (out_shape_by_name.get(node["name"] + "_output")
+                 or out_shape_by_name.get(node["name"] + "_out") or "")
+        print("%-28s %-18s %-20s %-10d" % (node["name"], node["op"],
+                                           str(out_s), n_params))
+    print("=" * 80)
+    print("Total params: %d" % total)
 
 
 def plot_network(symbol: Symbol, title="plot", shape=None,

@@ -175,3 +175,20 @@ def test_symbol_args_kwargs_errors():
         mx.sym.FullyConnected(data)  # missing num_hidden
     with pytest.raises(mx.MXNetError):
         mx.sym.FullyConnected(data, num_hidden=4, bogus_param=1)
+
+
+def test_visualization_print_summary(capsys):
+    """print_summary renders the layer table (reference
+    visualization.py print_summary)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(net, num_hidden=2,
+                                                     name="fc2"),
+                               name="softmax")
+    mx.viz.print_summary(net, shape={"data": (4, 16),
+                                     "softmax_label": (4,)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out
+    # total params: 16*8+8 + 8*2+2 = 154
+    assert "154" in out, out
