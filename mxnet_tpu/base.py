@@ -46,6 +46,29 @@ def get_env(name: str, default: Any = None, typ: Callable = str) -> Any:
         return default
 
 
+def open_stream(fname: str, mode: str = "r"):
+    """Open a local path or a URI (reference dmlc::Stream: s3://, hdfs://
+    and friends made checkpointing location-transparent).  URIs route
+    through fsspec; a missing protocol driver raises a clear error rather
+    than writing to a bogus local file."""
+    if "://" in fname and not fname.startswith("file://"):
+        try:
+            import fsspec
+        except ImportError as e:
+            raise MXNetError(
+                "URI %r needs fsspec (not in this build); copy the file "
+                "locally or install the protocol driver" % fname) from e
+        try:
+            return fsspec.open(fname, mode).open()
+        except (ImportError, ValueError) as e:
+            raise MXNetError(
+                "cannot open %r: %s (protocol driver missing?)"
+                % (fname, e)) from e
+    if fname.startswith("file://"):
+        fname = fname[len("file://"):]
+    return open(fname, mode)
+
+
 def c_array(ctype, values):  # pragma: no cover - compat shim
     """Compatibility shim: reference python/mxnet/base.py built ctypes arrays."""
     return list(values)

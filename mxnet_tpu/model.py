@@ -223,7 +223,8 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
 def load_checkpoint(prefix, epoch):
     """Load checkpoint pair (reference model.py:340-375)."""
-    with open("%s-symbol.json" % prefix) as f:
+    from .base import open_stream
+    with open_stream("%s-symbol.json" % prefix) as f:
         symbol = sym_load_json(f.read())
     save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
     arg_params = {}

@@ -431,7 +431,8 @@ def save(fname: str, data) -> None:
     dtypes = [str(a.dtype) for a in raw]
     raw = [a.view(np.uint16) if d == "bfloat16" else a
            for a, d in zip(raw, dtypes)]
-    with open(fname, "wb") as f:
+    from .base import open_stream
+    with open_stream(fname, "wb") as f:
         f.write(_SAVE_MAGIC)
         np_bytes = _io.BytesIO()
         np.savez(np_bytes, *raw)
@@ -442,8 +443,10 @@ def save(fname: str, data) -> None:
 
 
 def load(fname: str):
-    """Load NDArrays saved by :func:`save`."""
-    with open(fname, "rb") as f:
+    """Load NDArrays saved by :func:`save` (local paths or URIs — the
+    reference's dmlc::Stream S3/HDFS transparency, via fsspec here)."""
+    from .base import open_stream
+    with open_stream(fname, "rb") as f:
         return loads(f.read(), name=fname)
 
 

@@ -356,7 +356,8 @@ class Symbol:
                           indent=2)
 
     def save(self, fname: str) -> None:
-        with open(fname, "w") as f:
+        from .base import open_stream
+        with open_stream(fname, "w") as f:
             f.write(self.tojson())
 
     def debug_str(self) -> str:
@@ -431,7 +432,8 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
 
 
 def load(fname: str) -> Symbol:
-    with open(fname) as f:
+    from .base import open_stream
+    with open_stream(fname) as f:
         return load_json(f.read())
 
 

@@ -291,3 +291,15 @@ def test_mixed_precision_save_load(tmp_path):
     for k, v in arrs.items():
         assert str(loaded[k].dtype) == str(v.dtype), k
         assert np.array_equal(loaded[k].asnumpy(), v.asnumpy()), k
+
+
+def test_save_load_uri_schemes(tmp_path):
+    """file:// URIs work; exotic schemes raise a clear error instead of
+    writing a bogus local file (reference dmlc::Stream transparency)."""
+    path = str(tmp_path / "u.nd")
+    mx.nd.save("file://" + path, {"a": mx.nd.ones((2, 2))})
+    back = mx.nd.load("file://" + path)
+    assert (back["a"].asnumpy() == 1).all()
+    with pytest.raises(Exception) as e:
+        mx.nd.save("bogus-scheme://bucket/x.nd", {"a": mx.nd.ones((2,))})
+    assert "bogus-scheme" in str(e.value) or "protocol" in str(e.value)
