@@ -195,6 +195,23 @@ class Module(BaseModule):
         self.binded = False
         self._exec_group = None
 
+    def reshape(self, data_shapes, label_shapes=None):
+        """Re-bind to new input shapes (e.g. a different batch size)
+        keeping trained parameters and optimizer state (reference
+        module.py reshape)."""
+        assert self.binded
+        self._data_shapes = [x if isinstance(x, tuple) else tuple(x)
+                             for x in data_shapes]
+        self._data_shapes = list(data_shapes)
+        self._label_shapes = list(label_shapes) if label_shapes else None
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list,
+            self._data_shapes, self._label_shapes, self._param_names,
+            self.for_training, self.inputs_need_grad, None,
+            logger=self.logger, fixed_param_names=self._fixed_param_names)
+        if self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+
     # -- optimizer ------------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=None, force_init=False):

@@ -238,3 +238,28 @@ def test_python_loss_module():
     m.backward()
     grads = m.get_input_grads()
     assert grads[0].shape == (4, 3)
+
+
+def test_module_reshape():
+    """Module.reshape changes batch size keeping trained params
+    (reference module.py reshape)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2,
+                                                     name="fc"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer_params={"learning_rate": 0.5})
+    w_before = mod.get_params()[0]["fc_weight"].asnumpy()
+
+    mod.reshape(data_shapes=[("data", (4, 6))],
+                label_shapes=[("softmax_label", (4,))])
+    assert mod.data_shapes[0][1] == (4, 6)
+    w_after = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert np.allclose(w_before, w_after)
+    it4 = mx.io.NDArrayIter(X, y, batch_size=4)
+    acc = mod.score(it4, "acc")[0][1]
+    assert acc >= 0.9, acc
