@@ -163,10 +163,9 @@ class Module(BaseModule):
         if not for_training:
             assert not inputs_need_grad
 
-        self._data_shapes = [x if isinstance(x, tuple) else tuple(x)
-                             for x in data_shapes]
         self._data_shapes = list(data_shapes)
         self._label_shapes = list(label_shapes) if label_shapes else None
+        self._grad_req = grad_req
 
         shared_group = None
         if shared_module is not None:
@@ -200,15 +199,18 @@ class Module(BaseModule):
         keeping trained parameters and optimizer state (reference
         module.py reshape)."""
         assert self.binded
-        self._data_shapes = [x if isinstance(x, tuple) else tuple(x)
-                             for x in data_shapes]
+        if self.params_initialized and self._params_dirty:
+            # updated params live only in the old exec group; pull them back
+            # before it is dropped or training silently reverts
+            self._sync_params_from_devices()
         self._data_shapes = list(data_shapes)
         self._label_shapes = list(label_shapes) if label_shapes else None
         self._exec_group = DataParallelExecutorGroup(
             self._symbol, self._context, self._work_load_list,
             self._data_shapes, self._label_shapes, self._param_names,
             self.for_training, self.inputs_need_grad, None,
-            logger=self.logger, fixed_param_names=self._fixed_param_names)
+            logger=self.logger, fixed_param_names=self._fixed_param_names,
+            grad_req=getattr(self, "_grad_req", "write"))
         if self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 

@@ -121,7 +121,7 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _correlation_kernel(a_ref, b_ref, o_ref, *, d2, stride2, hh, ww,
+def _correlation_kernel(a_ref, b_ref, o_ref, *, d2, stride2, base, hh, ww,
                         is_multiply, norm):
     """One batch sample per grid step: a (C,H,W) against the padded
     b (C,H+2m,W+2m); the d2*d2 displacement loop reuses both VMEM tiles —
@@ -132,8 +132,11 @@ def _correlation_kernel(a_ref, b_ref, o_ref, *, d2, stride2, hh, ww,
     a = a_ref[0].astype(jnp.float32)                      # (C, H, W)
     b = b_ref[0].astype(jnp.float32)                      # (C, H+2m, W+2m)
     for idx in range(d2 * d2):
-        dy = (idx // d2) * stride2
-        dx = (idx % d2) * stride2
+        # centered displacement (i-ng)*stride2 relative to the m-padded
+        # image: offset = m + (i-ng)*stride2 = base + i*stride2, which
+        # differs from i*stride2 whenever stride2 does not divide m
+        dy = base + (idx // d2) * stride2
+        dx = base + (idx % d2) * stride2
         b_tile = b[:, dy:dy + hh, dx:dx + ww]
         if is_multiply:
             corr = jnp.sum(a * b_tile, axis=0) / norm
@@ -160,8 +163,8 @@ def correlation(a, b, max_displacement: int, stride2: int = 1,
         return None
     bp = jnp.pad(b, [(0, 0), (0, 0), (m, m), (m, m)])
     kernel = functools.partial(
-        _correlation_kernel, d2=d2, stride2=stride2, hh=h, ww=w,
-        is_multiply=is_multiply, norm=float(c))
+        _correlation_kernel, d2=d2, stride2=stride2, base=m - ng * stride2,
+        hh=h, ww=w, is_multiply=is_multiply, norm=float(c))
     return pl.pallas_call(
         kernel,
         grid=(n,),

@@ -167,7 +167,10 @@ class CorrelationOp(OpDef):
         # equivalent): one VMEM-resident displacement loop instead of
         # d2*d2 HBM passes. Covers the FlowNet configuration.
         if (p.kernel_size == 1 and p.stride1 == 1
-                and p.pad_size == p.max_displacement):
+                and p.pad_size == p.max_displacement
+                and not getattr(ctx, "is_train", False)):
+            # inference only: pallas_call has no reverse-mode rule, so
+            # training must take the differentiable lax lowering below
             from .pallas_kernels import correlation as _pallas_corr
             out = _pallas_corr(a, b, p.max_displacement, p.stride2,
                                p.is_multiply)

@@ -42,7 +42,35 @@ import numpy as np
 __all__ = ["Scheduler", "PSServer", "PSWorkerClient", "run_scheduler",
            "run_server", "bigarray_bound", "key_to_server", "stripe_ranges"]
 
-_AUTHKEY = b"mxnet_tpu_ps"
+def _authkey() -> bytes:
+    """Per-job connection secret. multiprocessing.connection deserializes
+    pickles from any authenticated peer, so a source-code constant would be
+    remote code execution for anyone who can reach a non-loopback listener.
+    tools/launch.py generates DMLC_PS_AUTHKEY and passes it to every role;
+    a job started without the launcher gets a loud single-host default."""
+    key = os.environ.get("DMLC_PS_AUTHKEY")
+    if key:
+        return key.encode()
+    local = ("127.0.0.1", "localhost")  # "" binds all interfaces: not local
+    # servers bind DMLC_NODE_HOST, the scheduler binds DMLC_PS_ROOT_URI —
+    # either being non-loopback exposes a listener
+    if (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1") not in local
+            or os.environ.get("DMLC_NODE_HOST", "127.0.0.1") not in local):
+        logging.getLogger(__name__).warning(
+            "DMLC_PS_AUTHKEY is unset on a non-loopback PS job; peers "
+            "authenticate with a well-known default key. Use tools/launch.py "
+            "or export a per-job secret, and never expose the PS port.")
+    return b"mxnet_tpu_ps_insecure_default"
+
+
+_AUTHKEY = None  # resolved lazily so the env can be set after import
+
+
+def _get_authkey():
+    global _AUTHKEY
+    if _AUTHKEY is None:
+        _AUTHKEY = _authkey()
+    return _AUTHKEY
 
 
 def _connect_retry(addr, timeout=None):
@@ -57,7 +85,7 @@ def _connect_retry(addr, timeout=None):
     delay = 0.05
     while True:
         try:
-            return Client(addr, authkey=_AUTHKEY)
+            return Client(addr, authkey=_get_authkey())
         except (ConnectionRefusedError, ConnectionResetError, OSError):
             if time.monotonic() >= deadline:
                 raise
@@ -114,7 +142,7 @@ class Scheduler:
         self.num_workers = num_workers
         self.num_servers = num_servers
         addr = addr or _root_addr()
-        self.listener = Listener(addr, authkey=_AUTHKEY)
+        self.listener = Listener(addr, authkey=_get_authkey())
         self.server_addrs = [None] * num_servers
         self._lock = threading.Lock()
         self._servers_ready = threading.Event()
@@ -239,7 +267,7 @@ class PSServer:
         self._exec = _MainThreadExec()
         # own listen socket on an ephemeral port
         host = os.environ.get("DMLC_NODE_HOST", "127.0.0.1")
-        self.listener = Listener((host, 0), authkey=_AUTHKEY)
+        self.listener = Listener((host, 0), authkey=_get_authkey())
         self.addr = self.listener.address
         # register with the scheduler
         sched = _connect_retry(root or _root_addr())

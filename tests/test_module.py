@@ -263,3 +263,50 @@ def test_module_reshape():
     it4 = mx.io.NDArrayIter(X, y, batch_size=4)
     acc = mod.score(it4, "acc")[0][1]
     assert acc >= 0.9, acc
+
+
+def test_module_reshape_syncs_dirty_params():
+    """reshape() right after fit() must carry the trained device params
+    into the new exec group — without an intervening get_params() call
+    (which sync'd as a side effect and masked the bug)."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(64, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2,
+                                                     name="fc"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer_params={"learning_rate": 0.5})
+    # deliberately no get_params() here
+    mod.reshape(data_shapes=[("data", (4, 6))],
+                label_shapes=[("softmax_label", (4,))])
+    it4 = mx.io.NDArrayIter(X, y, batch_size=4)
+    acc = mod.score(it4, "acc")[0][1]
+    assert acc >= 0.9, acc
+
+
+def test_module_reshape_keeps_grad_req():
+    """grad_req='add' must survive a reshape (accumulation semantics)."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2,
+                                                     name="fc"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 6))],
+             label_shapes=[("softmax_label", (8,))], grad_req="add")
+    mod.init_params()
+    mod.reshape(data_shapes=[("data", (4, 6))],
+                label_shapes=[("softmax_label", (4,))])
+    rng = np.random.RandomState(2)
+    batch = mx.io.DataBatch(data=[mx.nd.array(rng.randn(4, 6))],
+                            label=[mx.nd.array(np.zeros(4))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g1 = [g[0].asnumpy().copy() for g in mod._exec_group.grad_arrays]
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g2 = [g[0].asnumpy() for g in mod._exec_group.grad_arrays]
+    for a, b in zip(g1, g2):
+        assert np.allclose(2 * a, b, atol=1e-5), "grad_req='add' lost"

@@ -57,24 +57,29 @@ def test_pallas_correlation_matches_lax():
     import jax.numpy as jnp
     import mxnet_tpu as mx
     rng = np.random.RandomState(0)
-    n, c, h, w, m = 2, 4, 6, 6, 2
+    n, c, h, w = 2, 4, 6, 6
     a = jnp.asarray(rng.rand(n, c, h, w).astype(np.float32))
     b = jnp.asarray(rng.rand(n, c, h, w).astype(np.float32))
-    for stride2 in (1, 2):
+    # (3, 2) covers stride2 that does NOT divide max_displacement, where
+    # the displacement grid is off-center relative to the padding
+    an, bn = np.asarray(a), np.asarray(b)
+    for m, stride2 in ((2, 1), (2, 2), (3, 2)):
         for is_mult in (True, False):
             got = correlation(a, b, m, stride2, is_mult, interpret=True)
-            # lax reference via the registered op
-            data1, data2 = mx.sym.Variable("data1"), mx.sym.Variable("data2")
-            sym = mx.sym.Correlation(data1, data2, kernel_size=1,
-                                     max_displacement=m, stride1=1,
-                                     stride2=stride2, pad_size=m,
-                                     is_multiply=is_mult)
-            ex = sym.simple_bind(mx.cpu(), grad_req="null",
-                                 data1=(n, c, h, w), data2=(n, c, h, w))
-            ex.arg_dict["data1"][:] = np.asarray(a)
-            ex.arg_dict["data2"][:] = np.asarray(b)
-            ex.forward(is_train=False)
-            want = ex.outputs[0].asnumpy()
+            # independent numpy reference (correlation.cu semantics) — NOT
+            # routed through the op, which on a real TPU would take the same
+            # Pallas kernel and make the comparison vacuous
+            ng = m // stride2
+            d2 = 2 * ng + 1
+            bpad = np.pad(bn, [(0, 0), (0, 0), (m, m), (m, m)])
+            want = np.empty((n, d2 * d2, h, w), np.float32)
+            for i, dy in enumerate(range(-ng, ng + 1)):
+                for j, dx in enumerate(range(-ng, ng + 1)):
+                    oy = m + dy * stride2
+                    ox = m + dx * stride2
+                    tile = bpad[:, :, oy:oy + h, ox:ox + w]
+                    val = (an * tile if is_mult else np.abs(an - tile))
+                    want[:, i * d2 + j] = val.sum(axis=1) / c
             assert got.shape == want.shape, (got.shape, want.shape)
             assert np.allclose(np.asarray(got), want, atol=1e-5), (
                 stride2, is_mult, np.abs(np.asarray(got) - want).max())

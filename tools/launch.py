@@ -20,6 +20,7 @@ with -s N.  This launcher covers:
 """
 import argparse
 import os
+import secrets
 import signal
 import subprocess
 import sys
@@ -31,6 +32,9 @@ def local_launch(args, cmd):
     if args.num_servers:
         # dist_async parameter-server mode (reference ps-lite role model):
         # scheduler + S servers + W workers, rendezvous via DMLC_PS_ROOT_*.
+        # Every role gets the same per-job secret: PS peers exchange
+        # pickles, so the connection authkey must not be guessable.
+        env.setdefault("DMLC_PS_AUTHKEY", secrets.token_hex(16))
         env["DMLC_PS_ROOT_URI"] = "127.0.0.1"
         env["DMLC_PS_ROOT_PORT"] = str(args.port)
         env["DMLC_NUM_WORKER"] = str(args.num_workers)
