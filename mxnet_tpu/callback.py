@@ -19,48 +19,50 @@ def do_checkpoint(prefix):
 def log_train_metric(period, auto_reset=False):
     """Log evaluation metric every `period` batches (reference callback.py:28)."""
     def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+        if param.nbatch % period or param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                         param.epoch, param.nbatch, name, value)
+        if auto_reset:
+            param.eval_metric.reset()
     return _callback
 
 
 class Speedometer:
     """Samples/sec logger (reference callback.py:49) — the throughput
-    instrument behind every BASELINE.md number."""
+    instrument behind every BASELINE.md number. Rates are measured over
+    windows of `frequent` batches; the clock restarts whenever the batch
+    counter jumps backwards (a new epoch)."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self._window_start = None
+        self._prev_batch = 0
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    for name, value in name_value:
-                        logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                                     "\tTrain-%s=%f",
-                                     param.epoch, count, speed, name, value)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        n = param.nbatch
+        if n < self._prev_batch:
+            self._window_start = None
+        self._prev_batch = n
+        if self._window_start is None:
+            self._window_start = time.time()
+            return
+        if n % self.frequent:
+            return
+        elapsed = max(time.time() - self._window_start, 1e-12)
+        rate = self.frequent * self.batch_size / elapsed
+        metric = param.eval_metric
+        if metric is None:
+            logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, n, rate)
         else:
-            self.init = True
-            self.tic = time.time()
+            for name, value in metric.get_name_value():
+                logging.info(
+                    "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                    "\tTrain-%s=%f", param.epoch, n, rate, name, value)
+        self._window_start = time.time()
 
 
 class ProgressBar:

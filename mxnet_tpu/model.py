@@ -6,6 +6,7 @@ prefix-NNNN.params, FeedForward.fit/predict/score/save/load/create.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import time
 from collections import namedtuple
@@ -20,8 +21,7 @@ from . import io as mx_io
 from . import metric as metric_mod
 from . import optimizer as opt_mod
 from . import kvstore as kvstore_mod
-from .executor_manager import (DataParallelExecutorManager, _check_arguments,
-                               _split_input_slice)
+from .executor_manager import _check_arguments
 from .initializer import Uniform
 from .symbol import Symbol, load_json as sym_load_json
 
@@ -64,36 +64,55 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
                         update_on_kvstore):
-    """Initialize kvstore (reference model.py:79-88)."""
-    for idx, param_on_devs in enumerate(param_arrays):
+    """Seed the kvstore with initial weights (reference model.py:79-88)."""
+    for idx, weights_on_devs in enumerate(param_arrays):
         kvstore.init(idx, arg_params[param_names[idx]])
         if update_on_kvstore:
-            kvstore.pull(idx, param_on_devs, priority=-idx)
+            kvstore.pull(idx, weights_on_devs, priority=-idx)
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """Push grads, pull updated weights (reference model.py:89-98)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
+    """Server-side update: push grads, pull back fresh weights
+    (reference model.py:89-98)."""
+    for idx, (weights, grads) in enumerate(zip(param_arrays, grad_arrays)):
+        if grads[0] is None:       # frozen param: nothing flowed back
             continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+        kvstore.push(idx, grads, priority=-idx)
+        kvstore.pull(idx, weights, priority=-idx)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
-    """Local updater path (reference model.py:100-117)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
+    """Local update: optionally aggregate grads through the kvstore, then
+    run the python updater on every device copy (reference model.py:100-117)."""
+    for idx, (weights, grads) in enumerate(zip(param_arrays, grad_arrays)):
+        if grads[0] is None:
             continue
         if kvstore:
-            kvstore.push(index, grad_list, priority=-index)
-            kvstore.pull(index, grad_list, priority=-index)
-        for k, p in enumerate(zip(arg_list, grad_list)):
-            w, g = p
-            updater(index * num_device + k, g, w)
+            kvstore.push(idx, grads, priority=-idx)
+            kvstore.pull(idx, grads, priority=-idx)
+        for dev, (w, g) in enumerate(zip(weights, grads)):
+            updater(idx * num_device + dev, g, w)
+
+
+def _as_callbacks(cb):
+    if cb is None:
+        return []
+    return cb if isinstance(cb, list) else [cb]
+
+
+def _rolling_batches(train_data, logger):
+    """Endless batch source: epochs driven by ``epoch_size`` cut across
+    iterator passes, so the iterator only resets when it runs dry."""
+    while True:
+        produced = False
+        for batch in train_data:
+            produced = True
+            yield batch
+        if not produced:
+            raise MXNetError("training data iterator produced no batches")
+        logger.info("Resetting Data Iterator")
+        train_data.reset()
 
 
 def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
@@ -103,110 +122,87 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                         epoch_end_callback=None, batch_end_callback=None,
                         logger=None, work_load_list=None, monitor=None,
                         eval_batch_end_callback=None, sym_gen=None):
-    """The reference training loop (model.py:119-310)."""
-    if logger is None:
-        logger = logging
-    executor_manager = DataParallelExecutorManager(
-        symbol=symbol, sym_gen=sym_gen, ctx=ctx, train_data=train_data,
-        param_names=param_names, arg_names=arg_names, aux_names=aux_names,
-        work_load_list=work_load_list, logger=logger)
-    if monitor:
-        executor_manager.install_monitor(monitor)
+    """FeedForward's training engine (reference capability model.py:119-310),
+    re-based on the Module API: the per-batch body is
+    Module.forward/backward/update, so it rides the fused single-program
+    train step whenever the configuration allows (module/fused.py) instead
+    of pushing every parameter through python per batch."""
+    logger = logger or logging
+    from .module import Module
+    from .module.bucketing_module import BucketingModule
 
-    executor_manager.set_params(arg_params, aux_params)
+    data_names = [d[0] for d in train_data.provide_data]
+    label_names = [l[0] for l in train_data.provide_label]
+    if sym_gen is not None:
+        # FeedForward's sym_gen yields a bare symbol; BucketingModule's
+        # contract also names the inputs
+        mod = BucketingModule(
+            lambda key: (sym_gen(key), data_names, label_names),
+            default_bucket_key=train_data.default_bucket_key,
+            context=ctx, work_load_list=work_load_list, logger=logger)
+    else:
+        mod = Module(symbol, data_names=data_names, label_names=label_names,
+                     context=ctx, work_load_list=work_load_list, logger=logger)
+    mod.bind(train_data.provide_data, train_data.provide_label,
+             for_training=True)
+    if monitor is not None:
+        mod.install_monitor(monitor)
+    mod.init_params(initializer=None, arg_params=arg_params,
+                    aux_params=aux_params, allow_missing=False)
+    mod.init_optimizer(kvstore=kvstore, optimizer=optimizer)
 
-    if not update_on_kvstore:
-        updater = opt_mod.get_updater(optimizer)
-    if kvstore:
-        _initialize_kvstore(kvstore=kvstore,
-                            param_arrays=executor_manager.param_arrays,
-                            arg_params=arg_params,
-                            param_names=executor_manager.param_names,
-                            update_on_kvstore=update_on_kvstore)
-    if update_on_kvstore:
-        kvstore.set_optimizer(optimizer)
+    def pull_params():
+        trained_arg, trained_aux = mod.get_params()
+        arg_params.update(trained_arg)
+        aux_params.update(trained_aux)
 
     train_data.reset()
+    endless = _rolling_batches(train_data, logger) if epoch_size else None
     for epoch in range(begin_epoch, end_epoch):
         tic = time.time()
         eval_metric.reset()
+        source = (itertools.islice(endless, epoch_size) if epoch_size
+                  else train_data)
         nbatch = 0
-        while True:
-            do_reset = True
-            for data_batch in train_data:
-                if monitor is not None:
-                    monitor.tic()
-                executor_manager.load_data_batch(data_batch)
-                executor_manager.forward(is_train=True)
-                executor_manager.backward()
-
-                if update_on_kvstore:
-                    _update_params_on_kvstore(executor_manager.param_arrays,
-                                              executor_manager.grad_arrays,
-                                              kvstore)
-                else:
-                    _update_params(executor_manager.param_arrays,
-                                   executor_manager.grad_arrays,
-                                   updater=updater, num_device=len(ctx),
-                                   kvstore=kvstore)
-                if monitor is not None:
-                    monitor.toc_print()
-
-                executor_manager.update_metric(eval_metric, data_batch.label)
-                nbatch += 1
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    if isinstance(batch_end_callback, list):
-                        for call in batch_end_callback:
-                            call(batch_end_params)
-                    else:
-                        batch_end_callback(batch_end_params)
-                if epoch_size is not None and nbatch >= epoch_size:
-                    do_reset = False
-                    break
-
-            if do_reset:
-                logger.info("Epoch[%d] Resetting Data Iterator", epoch)
-                train_data.reset()
-            if epoch_size is None or nbatch >= epoch_size:
-                break
-
-        toc = time.time()
-        logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+        for data_batch in source:
+            if monitor is not None:
+                monitor.tic()
+            mod.forward(data_batch, is_train=True)
+            mod.backward()
+            mod.update()
+            if monitor is not None:
+                monitor.toc_print()
+            mod.update_metric(eval_metric, data_batch.label)
+            nbatch += 1
+            bep = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals())
+            for cb in _as_callbacks(batch_end_callback):
+                cb(bep)
+        if not epoch_size:
+            train_data.reset()
+        logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
 
         if epoch_end_callback or epoch + 1 == end_epoch:
-            executor_manager.copy_to(arg_params, aux_params)
-        if epoch_end_callback is not None:
-            if isinstance(epoch_end_callback, list):
-                for call in epoch_end_callback:
-                    call(epoch, symbol, arg_params, aux_params)
-            else:
-                epoch_end_callback(epoch, symbol, arg_params, aux_params)
+            pull_params()
+        # always the stable (default-bucket) symbol: mod.symbol would be
+        # whichever bucket the last batch happened to use
+        for cb in _as_callbacks(epoch_end_callback):
+            cb(epoch, symbol, arg_params, aux_params)
 
-        name_value = eval_metric.get_name_value()
-        for name, value in name_value:
+        for name, value in eval_metric.get_name_value():
             logger.info("Epoch[%d] Train-%s=%f", epoch, name, value)
 
         if eval_data:
             eval_metric.reset()
             eval_data.reset()
             for i, eval_batch in enumerate(eval_data):
-                executor_manager.load_data_batch(eval_batch)
-                executor_manager.forward(is_train=False)
-                executor_manager.update_metric(eval_metric, eval_batch.label)
-                if eval_batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=i,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    if isinstance(eval_batch_end_callback, list):
-                        for call in eval_batch_end_callback:
-                            call(batch_end_params)
-                    else:
-                        eval_batch_end_callback(batch_end_params)
-            name_value = eval_metric.get_name_value()
-            for name, value in name_value:
+                mod.forward(eval_batch, is_train=False)
+                mod.update_metric(eval_metric, eval_batch.label)
+                bep = BatchEndParam(epoch=epoch, nbatch=i,
+                                    eval_metric=eval_metric, locals=locals())
+                for cb in _as_callbacks(eval_batch_end_callback):
+                    cb(bep)
+            for name, value in eval_metric.get_name_value():
                 logger.info("Epoch[%d] Validation-%s=%f", epoch, name, value)
             eval_data.reset()
 
@@ -367,50 +363,45 @@ class FeedForward(BASE_ESTIMATOR):
             raise TypeError("Eval data must be DataIter, or NDArray/numpy.ndarray pair")
         return eval_data
 
+    def _feed_batch(self, batch):
+        """Copy one batch into the predictor executor and run forward."""
+        for src, (name, _) in zip(batch.data, self._pred_exec_data_shapes):
+            src.copyto(self._pred_exec.arg_dict[name])
+        self._pred_exec.forward(is_train=False)
+
     def predict(self, X, num_batch=None, return_data=False, reset=True):
-        """Run prediction (reference model.py predict)."""
+        """Run prediction (reference model.py predict). Padded tail rows of
+        the final batch are dropped before concatenation."""
         X = self._init_iter(X, None, is_train=False)
         if reset:
             X.reset()
-        data_shapes = X.provide_data
-        data_names = [x[0] for x in data_shapes]
-        self._init_predictor(data_shapes)
-        batch_size = X.batch_size
-        data_arrays = [self._pred_exec.arg_dict[name] for name in data_names]
-        # executor outputs materialize only after forward(); the count is
-        # static from the symbol
-        output_list = [[] for _ in range(len(self.symbol.list_outputs()))]
-        if return_data:
-            data_list = [[] for _ in X.provide_data]
-            label_list = [[] for _ in X.provide_label]
-        i = 0
-        for batch in X:
-            _load_data(batch, data_arrays)
-            self._pred_exec.forward(is_train=False)
-            padded = batch.pad
-            real_size = batch_size - padded
-            for o_list, o_nd in zip(output_list, self._pred_exec.outputs):
-                o_list.append(o_nd[0:real_size].asnumpy())
-            if return_data:
-                for j, x in enumerate(batch.data):
-                    data_list[j].append(x[0:real_size].asnumpy())
-                for j, x in enumerate(batch.label):
-                    label_list[j].append(x[0:real_size].asnumpy())
-            i += 1
-            if num_batch is not None and i == num_batch:
+        self._init_predictor(X.provide_data)
+        self._pred_exec_data_shapes = X.provide_data
+        n_outputs = len(self.symbol.list_outputs())
+        out_chunks = [[] for _ in range(n_outputs)]
+        data_chunks = [[] for _ in X.provide_data]
+        label_chunks = [[] for _ in X.provide_label]
+        for nbatch, batch in enumerate(X):
+            if num_batch is not None and nbatch == num_batch:
                 break
-        outputs = [np.concatenate(x) for x in output_list]
-        if len(outputs) == 1:
-            outputs = outputs[0]
+            self._feed_batch(batch)
+            keep = X.batch_size - batch.pad
+            for chunk, out in zip(out_chunks, self._pred_exec.outputs):
+                chunk.append(out[:keep].asnumpy())
+            if return_data:
+                for chunk, arr in zip(data_chunks, batch.data):
+                    chunk.append(arr[:keep].asnumpy())
+                for chunk, arr in zip(label_chunks, batch.label):
+                    chunk.append(arr[:keep].asnumpy())
+
+        def merge(chunks):
+            whole = [np.concatenate(c) for c in chunks]
+            return whole[0] if len(whole) == 1 else whole
+
         if return_data:
-            data = [np.concatenate(x) for x in data_list]
-            label = [np.concatenate(x) for x in label_list]
-            if len(data) == 1:
-                data = data[0]
-            if len(label) == 1:
-                label = label[0]
-            return outputs, data, label
-        return outputs
+            return (merge(out_chunks), merge(data_chunks),
+                    merge(label_chunks))
+        return merge(out_chunks)
 
     def score(self, X, eval_metric="acc", num_batch=None,
               batch_end_callback=None, reset=True):
@@ -418,26 +409,18 @@ class FeedForward(BASE_ESTIMATOR):
         X = self._init_iter(X, None, is_train=False)
         if reset:
             X.reset()
-        data_shapes = X.provide_data
-        data_names = [x[0] for x in data_shapes]
-        self._init_predictor(data_shapes)
+        self._init_predictor(X.provide_data)
+        self._pred_exec_data_shapes = X.provide_data
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
-        data_arrays = [self._pred_exec.arg_dict[name] for name in data_names]
-        for i, batch in enumerate(X):
-            _load_data(batch, data_arrays)
-            self._pred_exec.forward(is_train=False)
+        for nbatch, batch in enumerate(X):
+            self._feed_batch(batch)
             eval_metric.update(batch.label, self._pred_exec.outputs)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=0, nbatch=i,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                if isinstance(batch_end_callback, list):
-                    for call in batch_end_callback:
-                        call(batch_end_params)
-                else:
-                    batch_end_callback(batch_end_params)
-            if num_batch is not None and i == num_batch:
+            bep = BatchEndParam(epoch=0, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals())
+            for cb in _as_callbacks(batch_end_callback):
+                cb(bep)
+            if num_batch is not None and nbatch == num_batch:
                 break
         return eval_metric.get()[1]
 
@@ -468,6 +451,17 @@ class FeedForward(BASE_ESTIMATOR):
             batch_size = data.batch_size
             if kvstore and kvstore.type == "dist_sync":
                 batch_size *= kvstore.num_workers
+            # index->name map so name-keyed rules (wd_mult, lr_mult, the
+            # bias/gamma/beta wd exemption) work on the index-keyed
+            # updater path (reference model.py fit sets the same map)
+            idx2name = {}
+            if update_on_kvstore:
+                idx2name.update(enumerate(param_names))
+            else:
+                for i, n in enumerate(param_names):
+                    for k in range(len(self.ctx)):
+                        idx2name[i * len(self.ctx) + k] = n
+            self.kwargs["param_idx2name"] = idx2name
             optimizer = opt_mod.create(self.optimizer,
                                        rescale_grad=(1.0 / batch_size),
                                        **(self.kwargs))
@@ -524,8 +518,3 @@ class FeedForward(BASE_ESTIMATOR):
         return model
 
 
-def _load_data(batch, targets):
-    from .executor_manager import _load_general
-    # targets here are plain NDArrays (predictor path)
-    for d_src, d_target in zip(batch.data, targets):
-        d_src.copyto(d_target)

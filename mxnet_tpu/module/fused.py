@@ -1,0 +1,252 @@
+"""Fused train step for the Module/FeedForward reference API.
+
+The reference's hot loop (model.py:119-310, module/module.py:377-394) has
+python push gradients per-parameter through kvstore and run the optimizer
+per-parameter on the host. On TPU that python round-trip dominates: the
+fwd+bwd pair is one XLA program, but ~2N more dispatches follow it every
+batch. This module collapses the whole batch body — forward, backward,
+cross-device gradient reduction, and the optimizer — into ONE donated,
+jit-compiled XLA program over the device mesh:
+
+* batch slicing across contexts  -> batch-axis NamedSharding over "dp"
+* kvstore local/device reduce    -> psum inserted by GSPMD (rides ICI)
+* per-param python updater       -> optimizer's fused_update_fn traced in
+* buffer reuse                   -> donation of the whole train state
+
+Engaged automatically by ``Module.init_optimizer`` when semantics allow
+(see Module._fusable); anything it can't express (monitor, ctx_group,
+grad_req!='write', optimizers without a functional form, shared/bucketing
+executors, dist kvstores) falls back to the reference path unchanged.
+Disable with MXNET_FUSED_TRAIN=0.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..executor import _GraphProgram
+from ..ndarray import NDArray
+
+__all__ = ["FusedTrainStep"]
+
+
+class FusedTrainStep:
+    """One donated XLA program per (shapes, dtypes): fwd+bwd+reduce+update.
+
+    State layout (a single donated pytree)::
+
+        {"params": {name: w}, "opt": {name: state}, "aux": {name: a},
+         "fixed": {name: w}}
+
+    ``step(state, batch, lr, t)`` advances it one batch and returns the
+    graph outputs; ``forward_only(state, batch)`` evaluates without
+    touching state (used for eval/predict on the live training params).
+    """
+
+    def __init__(self, symbol, contexts, data_names: Sequence[str],
+                 label_names: Sequence[str], param_names: Sequence[str],
+                 fixed_param_names: Sequence[str], optimizer,
+                 label_shapes=None, remat: bool = False,
+                 compute_dtype=None):
+        devices = [c.jax_device() for c in contexts]
+        if len(set(devices)) != len(devices):
+            raise MXNetError("fused step needs distinct devices")
+        self.mesh = Mesh(np.array(devices), ("dp",))
+        self.data_names = tuple(data_names)
+        self.label_names = tuple(label_names)
+        self.label_shapes = dict(label_shapes or [])
+        fixed = set(fixed_param_names or ())
+        self.train_names = [n for n in param_names if n not in fixed]
+        self.fixed_names = [n for n in param_names if n in fixed]
+        self.aux_names = symbol.list_auxiliary_states()
+        self.optimizer = optimizer
+        fused = optimizer.fused_update_fn()
+        if fused is None:
+            raise MXNetError("optimizer has no fused form")
+        self._opt_init, self._opt_update = fused
+        # static per-param schedule factors (reference lr_mult/wd_mult and
+        # the bias/gamma/beta wd rule, resolved by NAME not index)
+        self._lr_mult = {n: optimizer._name_lr_mult(n) for n in self.train_names}
+        self._wd = {n: optimizer._name_wd(n) for n in self.train_names}
+        self._prog = _GraphProgram(symbol, {}, None, do_mirror=remat)
+        # mixed precision the TPU way (fp16-era capability, SURVEY §7):
+        # master weights and optimizer state stay f32, the fwd/bwd compute
+        # runs in bf16 on the MXU, grads are cast back before the update
+        self.compute_dtype = compute_dtype
+        self._no_cast = set(self.label_names) | self._id_valued_inputs(symbol)
+        self._step = None
+        self._fwd = None
+        self._lr_cache = None
+
+    def _id_valued_inputs(self, symbol):
+        """Variable names whose float values are integer ids (embedding
+        tokens): casting those to bf16 would misround ids >= 257 and look
+        up the wrong rows."""
+        from ..symbol import _topo
+        ids = set()
+        for node in _topo(symbol._heads):
+            if node.is_variable or node.op is None:
+                continue
+            if getattr(node.op, "name", "") == "Embedding" and node.inputs:
+                src = node.inputs[0][0]
+                if src.is_variable:
+                    ids.add(src.name)
+        return ids
+
+    def _cast_compute(self, args):
+        if self.compute_dtype is None:
+            return args
+        cdt = self.compute_dtype
+        skip = self._no_cast
+        # labels and id-valued inputs stay full precision: integers
+        # >= 257 are not exactly representable in bf16
+        return {k: v.astype(cdt)
+                if k not in skip and jnp.issubdtype(v.dtype, jnp.floating)
+                else v for k, v in args.items()}
+
+    # -- placement ----------------------------------------------------------
+    def _replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    def _batched(self):
+        return NamedSharding(self.mesh, P("dp"))
+
+    def init_state(self, arg_params: Dict[str, NDArray],
+                   aux_params: Dict[str, NDArray]):
+        """Build the device-resident train state from host param dicts."""
+        rep = self._replicated()
+
+        def put(v):
+            a = v._get() if isinstance(v, NDArray) else jnp.asarray(v)
+            # device_put may alias the caller's buffer when it already
+            # lives here; the state is donated every step, so it must own
+            # fresh storage or the source NDArrays get deleted under it
+            return jnp.copy(jax.device_put(a, rep))
+        params = {n: put(arg_params[n]) for n in self.train_names}
+        fixed = {n: put(arg_params[n]) for n in self.fixed_names}
+        aux = {n: put(aux_params[n]) for n in self.aux_names}
+        opt = {n: self._opt_init(w) for n, w in params.items()}
+        # the step counter lives on device and increments in-program: a
+        # host-built scalar would cost one transfer per step
+        t = jax.device_put(jnp.zeros((), jnp.int32), rep)
+        return {"params": params, "opt": opt, "aux": aux, "fixed": fixed,
+                "t": t}
+
+    def make_batch(self, data_batch) -> Dict[str, jnp.ndarray]:
+        """Shard one DataBatch over the dp axis of the mesh."""
+        sh = self._batched()
+
+        def put(arr):
+            a = arr._get()
+            # already resident with the right sharding (a device-prefetched
+            # pipeline): hand it through untouched
+            if getattr(a, "sharding", None) == sh:
+                return a
+            return jax.device_put(a, sh)
+        out = {}
+        for name, arr in zip(self.data_names, data_batch.data):
+            out[name] = put(arr)
+        labels = data_batch.label or []
+        for i, name in enumerate(self.label_names):
+            if i < len(labels) and labels[i] is not None:
+                out[name] = put(labels[i])
+            else:
+                # label-free forward (predict): loss layers ignore the
+                # label in their forward pass
+                shape = self.label_shapes.get(name)
+                if shape is None:
+                    raise MXNetError("missing label %r" % name)
+                out[name] = jax.device_put(jnp.zeros(shape, jnp.float32), sh)
+        return out
+
+    # -- compiled programs ---------------------------------------------------
+    def _build_step(self):
+        prog = self._prog
+        rescale = self.optimizer.rescale_grad
+        clip = self.optimizer.clip_gradient
+        lr_mult, wd, opt_update = self._lr_mult, self._wd, self._opt_update
+
+        def step(state, batch, lr, base_key):
+            params, fixed, aux = state["params"], state["fixed"], state["aux"]
+            t = state["t"] + 1
+            # per-step randomness derived in-program from one resident key:
+            # creating a fresh host key every batch would cost a transfer
+            rng = jax.random.fold_in(base_key, t)
+
+            def loss_fn(train_params):
+                args = dict(train_params)
+                args.update(fixed)
+                args.update(batch)
+                args = self._cast_compute(args)
+                outs, new_aux = prog.eval(args, aux, rng, True)
+                # aux (BN moving stats) must keep its dtype or the donated
+                # state changes signature between steps
+                new_aux = {k: v.astype(aux[k].dtype) if k in aux else v
+                           for k, v in new_aux.items()}
+                return outs, new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(loss_fn, params, has_aux=True)
+            grads = vjp_fn([jnp.ones_like(o) for o in outs])[0]
+
+            new_params, new_opt = {}, {}
+            for n, w in params.items():
+                g = grads[n].astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                new_params[n], new_opt[n] = opt_update(
+                    w, g, state["opt"][n], lr * lr_mult[n], wd[n], t)
+            merged_aux = dict(aux)
+            merged_aux.update(new_aux)
+            return ({"params": new_params, "opt": new_opt,
+                     "aux": merged_aux, "fixed": fixed, "t": t}, outs)
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+        return self._step
+
+    def _build_fwd(self):
+        prog = self._prog
+
+        def fwd(state, batch, rng, is_train):
+            args = dict(state["params"])
+            args.update(state["fixed"])
+            args.update(batch)
+            args = self._cast_compute(args)
+            outs, _ = prog.eval(args, state["aux"], rng, is_train)
+            return outs
+
+        self._fwd = jax.jit(fwd, static_argnums=(3,))
+        return self._fwd
+
+    def step(self, state, batch, base_key):
+        """Advance one batch; returns (new_state, outputs)."""
+        if self._step is None:
+            self._build_step()
+        lr = self.optimizer.base_lr()
+        if self._lr_cache is None or self._lr_cache[0] != lr:
+            # lr changes only when the scheduler fires; keep the device
+            # scalar resident between changes
+            self._lr_cache = (lr, jnp.asarray(lr, jnp.float32))
+        return self._step(state, batch, self._lr_cache[1], base_key)
+
+    def forward_only(self, state, batch, rng, is_train=False):
+        if self._fwd is None:
+            self._build_fwd()
+        return self._fwd(state, batch, rng, is_train)
+
+    # -- host sync -----------------------------------------------------------
+    def read_params(self, state, arg_params: Dict[str, NDArray],
+                    aux_params: Dict[str, NDArray]):
+        """Pull the live state back into host-side NDArray dicts. Copies:
+        the state buffers are donated to the next step, which would delete
+        the arrays under any NDArray handed out here."""
+        for n in self.train_names:
+            arg_params[n] = NDArray(jnp.copy(state["params"][n]))
+        for n in self.fixed_names:
+            arg_params[n] = NDArray(jnp.copy(state["fixed"][n]))
+        for n in self.aux_names:
+            aux_params[n] = NDArray(jnp.copy(state["aux"][n]))

@@ -17,6 +17,7 @@ from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      _update_params_on_kvstore)
 from .base_module import BaseModule
 from .executor_group import DataParallelExecutorGroup
+from .fused import FusedTrainStep
 
 __all__ = ["Module"]
 
@@ -62,6 +63,17 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+
+        # fused fast path (see fused.py): engaged by init_optimizer when
+        # the configuration allows one donated XLA program per batch
+        self._fused = None
+        self._fused_state = None
+        self._fused_pending = None
+        self._fused_outputs = None
+        self._fused_t = 0
+        self._fused_key = None
+        self._monitor_installed = False
+        self._borrowed_optimizer = False
 
     # -- properties ----------------------------------------------------------
     @property
@@ -141,9 +153,19 @@ class Module(BaseModule):
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params)
+        # host params changed: any fused device state is stale
+        self._fused_state = None
+        self._fused_pending = None
+        self._fused_outputs = None
 
     def _sync_params_from_devices(self):
-        self._exec_group.get_params(self._arg_params, self._aux_params)
+        if self._fused is not None and self._fused_state is not None:
+            # the fused state, not the exec group, holds the live params
+            self._fused.read_params(self._fused_state, self._arg_params,
+                                    self._aux_params)
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+        else:
+            self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
     # -- bind ----------------------------------------------------------------
@@ -171,6 +193,11 @@ class Module(BaseModule):
         if shared_module is not None:
             assert isinstance(shared_module, Module) and \
                 shared_module.binded and shared_module.params_initialized
+            # the shared parent's exec-group arrays become the single
+            # source of truth for every sibling (bucketing); its private
+            # donated fused state would silently diverge from them
+            shared_module._disable_fused("executor shared with %r"
+                                         % getattr(self._symbol, "name", ""))
             shared_group = shared_module._exec_group
 
         self._exec_group = DataParallelExecutorGroup(
@@ -203,6 +230,10 @@ class Module(BaseModule):
             # updated params live only in the old exec group; pull them back
             # before it is dropped or training silently reverts
             self._sync_params_from_devices()
+        # batch shapes change: drop any per-batch fused artifacts (the
+        # fused state itself is shape-independent and survives)
+        self._fused_pending = None
+        self._fused_outputs = None
         self._data_shapes = list(data_shapes)
         self._label_shapes = list(label_shapes) if label_shapes else None
         self._exec_group = DataParallelExecutorGroup(
@@ -211,6 +242,8 @@ class Module(BaseModule):
             self.for_training, self.inputs_need_grad, None,
             logger=self.logger, fixed_param_names=self._fixed_param_names,
             grad_req=getattr(self, "_grad_req", "write"))
+        if self._fused is not None:
+            self._fused.label_shapes = dict(self._label_shapes or [])
         if self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
@@ -265,22 +298,154 @@ class Module(BaseModule):
         else:
             self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True
+        self._setup_fused()
+
+    def _fusable(self):
+        """Whether the batch body can run as one donated XLA program with
+        reference semantics. Anything here that says no falls back to the
+        classic executor-group + kvstore/updater path."""
+        import os
+        if os.environ.get("MXNET_FUSED_TRAIN", "1") == "0":
+            return False
+        if not self.for_training or self.inputs_need_grad:
+            return False
+        if getattr(self, "_grad_req", "write") != "write":
+            return False
+        if self._monitor_installed or self._borrowed_optimizer:
+            return False
+        if self._exec_group is None or self._exec_group.shared_group is not None:
+            return False
+        if self._optimizer.fused_update_fn() is None:
+            return False
+        kv = self._kvstore
+        if kv is not None and "dist" in kv.type:
+            return False
+        # ctx_group placement needs the node-level eager executor
+        if any("ctx_group" in a for a in self._symbol.attr_dict().values()):
+            return False
+        cs = self._context
+        if len({(c.device_type, c.device_id) for c in cs}) != len(cs):
+            return False
+        if len({c.device_type for c in cs}) != 1:
+            return False
+        return True
+
+    def _setup_fused(self):
+        self._fused = None
+        self._fused_state = None
+        self._fused_pending = None
+        self._fused_outputs = None
+        if not self._fusable():
+            return
+        import os
+        remat = bool(int(os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0")))
+        # MXNET_COMPUTE_DTYPE=bfloat16: bf16 fwd/bwd on the MXU with f32
+        # master weights (the fp16-era capability mapped the TPU way)
+        cdt = os.environ.get("MXNET_COMPUTE_DTYPE") or None
+        try:
+            self._fused = FusedTrainStep(
+                self._symbol, self._context, self._data_names,
+                self._label_names, self._param_names,
+                self._fixed_param_names, self._optimizer,
+                label_shapes=self._label_shapes, remat=remat,
+                compute_dtype=cdt)
+        except MXNetError:
+            self._fused = None
+
+    def _disable_fused(self, reason):
+        """Leave the fused path mid-training with consistent state: pull
+        the live params back into arg_params/exec group and re-seed an
+        update_on_kvstore kvstore (it still holds the weights from
+        init time — a pull would otherwise revert training)."""
+        if self._fused is None:
+            return
+        if self._fused_state is not None:
+            self._sync_params_from_devices()
+            if self._update_on_kvstore and self._kvstore is not None:
+                _initialize_kvstore(kvstore=self._kvstore,
+                                    param_arrays=self._exec_group.param_arrays,
+                                    arg_params=self._arg_params,
+                                    param_names=self._param_names,
+                                    update_on_kvstore=True)
+            if self._optimizer is not None and self._fused_t:
+                # classic updater counts per index; continue from the fused
+                # step count or Adam's bias correction restarts at t=1
+                counts = self._optimizer._index_update_count
+                for i in range(len(self._param_names) * len(self._context)):
+                    counts.setdefault(i, self._fused_t)
+        self._fused = None
+        self._fused_state = None
+        self._fused_pending = None
+        self._fused_outputs = None
+        self.logger.info("fused train step disabled: %s", reason)
+
+    def _fused_ensure_state(self):
+        if self._fused_state is None:
+            if self._params_dirty:
+                self._sync_params_from_devices()
+            self._fused_state = self._fused.init_state(self._arg_params,
+                                                       self._aux_params)
+            self._fused_t = 0
+            from .. import random as _random
+            self._fused_key = _random.new_key()
 
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
+        self._disable_fused("optimizer borrowed")
         self._optimizer = shared_module._optimizer
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
         self.optimizer_initialized = True
+        # a shared optimizer's state must be visible to every borrower;
+        # the donated fused state is private, so stay on the classic path
+        self._borrowed_optimizer = True
+        self._fused = None
+        self._fused_state = None
 
     # -- computation ----------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        if self._fused is not None and self.optimizer_initialized:
+            if is_train:
+                # defer: the whole batch body runs as one program when
+                # update() commits it (fit order: forward_backward,
+                # update, update_metric)
+                self._fused_ensure_state()
+                self._fused_pending = self._fused.make_batch(data_batch)
+                self._fused_outputs = None
+                return
+            if self._fused_state is not None:
+                # eval on the live training params without syncing them
+                # back through the exec group; a pending train batch stays
+                # pending (the eval must not eat the next update)
+                outs = self._fused.forward_only(
+                    self._fused_state, self._fused.make_batch(data_batch),
+                    self._fused_key, False)
+                self._fused_outputs = [NDArray(o) for o in outs]
+                return
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        if self._fused is not None and self._fused_pending is not None:
+            if out_grads is None:
+                return
+            # explicit head gradients (e.g. SequentialModule chaining)
+            # cannot ride the loss-headed fused program: replay this batch
+            # on the classic path and stay there. Rebuild the batch from
+            # the recorded device arrays — the caller's DataBatch may have
+            # been mutated since forward (SequentialModule does).
+            from ..io import DataBatch
+            pend = self._fused_pending
+            eg = self._exec_group
+            batch = DataBatch(
+                data=[NDArray(pend[n]) for n in eg.data_names],
+                label=[NDArray(pend[n]) for n in eg.label_names])
+            self._disable_fused("explicit head gradients")
+            self._exec_group.forward(batch, True)
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
@@ -288,6 +453,17 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        if self._fused is not None and self._fused_pending is not None:
+            self._fused_t += 1
+            # scheduler parity: one optimizer step per batch, lr resolved
+            # in python and fed in as a scalar (no recompile)
+            self._optimizer.num_update = max(self._optimizer.num_update,
+                                             self._fused_t)
+            self._fused_state, outs = self._fused.step(
+                self._fused_state, self._fused_pending, self._fused_key)
+            self._fused_outputs = [NDArray(o) for o in outs]
+            self._fused_pending = None
+            return
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,
@@ -299,8 +475,25 @@ class Module(BaseModule):
                            num_device=len(self._context),
                            kvstore=self._kvstore)
 
+    def _fused_live(self):
+        return self._fused is not None and (self._fused_outputs is not None
+                                            or self._fused_pending is not None)
+
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused_live():
+            if self._fused_outputs is None:
+                # outputs requested between forward and update: evaluate
+                # without committing the optimizer step, with the SAME
+                # rng the committed step will use (t+1 fold)
+                import jax as _jax
+                key = _jax.random.fold_in(self._fused_key, self._fused_t + 1)
+                outs = self._fused.forward_only(
+                    self._fused_state, self._fused_pending, key, True)
+                self._fused_outputs = [NDArray(o) for o in outs]
+            if merge_multi_context:
+                return list(self._fused_outputs)
+            return [[o] for o in self._fused_outputs]
         return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
@@ -309,8 +502,13 @@ class Module(BaseModule):
             merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        if self._fused_live():
+            eval_metric.update(labels, self.get_outputs())
+            return
         self._exec_group.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor_installed = True
+        self._disable_fused("monitor installed")
         self._exec_group.install_monitor(mon)

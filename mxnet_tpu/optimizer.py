@@ -93,14 +93,26 @@ class Optimizer:
         self.num_update = max(self._index_update_count[index], self.num_update)
 
     def _get_lr(self, index):
-        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
-        name = self.idx2name.get(index, index)
-        return lr * self.lr_mult.get(name, 1.0)
+        return self.base_lr() * self._name_lr_mult(self.idx2name.get(index, index))
 
     def _get_wd(self, index):
-        name = self.idx2name.get(index, index)
+        return self._name_wd(self.idx2name.get(index, index))
+
+    def _preprocess_grad(self, grad):
+        g = grad._get() * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _name_lr_mult(self, name):
+        """Static per-param lr multiplier by name (shared between the
+        index-keyed updater path and the fused train step)."""
+        return self.lr_mult.get(name, 1.0)
+
+    def _name_wd(self, name):
+        """Static per-param weight decay by name: wd_mult override, else
+        the bias/gamma/beta -> 0 naming rule."""
         wd = self.wd
-        # bias / gamma / beta default to wd 0 via wd_mult naming rule
         if name in self.wd_mult:
             wd *= self.wd_mult[name]
         elif isinstance(name, str) and (
@@ -109,11 +121,26 @@ class Optimizer:
             wd *= 0.0
         return wd
 
-    def _preprocess_grad(self, grad):
-        g = grad._get() * self.rescale_grad
-        if self.clip_gradient is not None:
-            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        return g
+    def base_lr(self):
+        """Current base learning rate (scheduler applied on num_update);
+        evaluated in python per step and fed to the fused step as a traced
+        scalar so lr changes never trigger recompilation."""
+        return (self.lr_scheduler(self.num_update) if self.lr_scheduler
+                else self.lr)
+
+    def fused_update_fn(self):
+        """Functional form for the fused (single-XLA-program) train step.
+
+        Returns ``(init_state, update)`` where ``init_state(w)`` builds the
+        per-param state pytree of jnp arrays and
+        ``update(w, g, state, lr, wd, t) -> (new_w, new_state)`` is pure
+        jnp — `g` arrives already rescaled/clipped, `lr` includes the
+        per-param multiplier as a traced scalar, `t` is the 1-based traced
+        step count. Returns None when the optimizer has no functional form
+        (e.g. SGLD's host randomness); callers then fall back to the
+        per-param NDArray update path.
+        """
+        return None
 
 
 register = Optimizer.register
@@ -146,6 +173,19 @@ class SGD(Optimizer):
         else:
             weight._set(w - lr * (g + wd * w))
 
+    def fused_update_fn(self):
+        momentum = self.momentum
+
+        def init_state(w):
+            return jnp.zeros_like(w) if momentum else None
+
+        def update(w, g, state, lr, wd, t):
+            if momentum:
+                mom = momentum * state - lr * g - lr * wd * w
+                return w + mom, mom
+            return w - lr * (g + wd * w), None
+        return init_state, update
+
 
 @register
 class NAG(SGD):
@@ -165,6 +205,19 @@ class NAG(SGD):
             weight._set(w - lr * g2)
         else:
             weight._set(w - lr * (g + wd * w))
+
+    def fused_update_fn(self):
+        momentum = self.momentum
+
+        def init_state(w):
+            return jnp.zeros_like(w) if momentum else None
+
+        def update(w, g, state, lr, wd, t):
+            if momentum:
+                mom = momentum * state + g + wd * w
+                return w - lr * (momentum * mom + g), mom
+            return w - lr * (g + wd * w), None
+        return init_state, update
 
 
 @register
@@ -201,11 +254,8 @@ class Adam(Optimizer):
         self.beta2 = beta2
         self.epsilon = epsilon
         self.decay_factor = decay_factor
-        self.time = 0
-        self.time_first_index = None
 
     def create_state(self, index, weight):
-        self.time_first_index = None
         return (zeros(weight.shape, weight.context, dtype=weight.dtype),
                 zeros(weight.shape, weight.context, dtype=weight.dtype))
 
@@ -213,16 +263,14 @@ class Adam(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        # reference keeps a single shared time counter keyed to first index
-        if self.time_first_index is None:
-            self.time_first_index = index
-            self.time = 0
-        elif self.time_first_index == index:
-            self.time += 1
         mean, variance = state
         g = self._preprocess_grad(grad)
         w = weight._get()
-        t = self.time + 1
+        # per-param update count as the bias-correction timestep (the
+        # reference's shared `time` counter was keyed to whichever index
+        # last created state, lagging every other param; later reference
+        # versions use the per-index count — so do both our paths)
+        t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
         lr_t = lr * math.sqrt(coef2) / coef1
@@ -231,6 +279,20 @@ class Adam(Optimizer):
         mean._set(m)
         variance._set(v)
         weight._set(w - lr_t * (m / (jnp.sqrt(v) + self.epsilon) + wd * w))
+
+    def fused_update_fn(self):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        def init_state(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, state, lr, wd, t):
+            mean, var = state
+            lr_t = lr * jnp.sqrt(1.0 - b2 ** t) / (1.0 - b1 ** t)
+            m = b1 * mean + (1 - b1) * g
+            v = b2 * var + (1 - b2) * jnp.square(g)
+            return w - lr_t * (m / (jnp.sqrt(v) + eps) + wd * w), (m, v)
+        return init_state, update
 
 
 @register
@@ -253,6 +315,17 @@ class AdaGrad(Optimizer):
         hist = state._get() + jnp.square(g)
         state._set(hist)
         weight._set(w - lr * (g / jnp.sqrt(hist + self.float_stable_eps) + wd * w))
+
+    def fused_update_fn(self):
+        eps = self.float_stable_eps
+
+        def init_state(w):
+            return jnp.zeros_like(w)
+
+        def update(w, g, state, lr, wd, t):
+            hist = state + jnp.square(g)
+            return w - lr * (g / jnp.sqrt(hist + eps) + wd * w), hist
+        return init_state, update
 
 
 @register
@@ -285,6 +358,21 @@ class RMSProp(Optimizer):
         delta._set(dd)
         weight._set(w + dd)
 
+    def fused_update_fn(self):
+        g1, g2 = self.gamma1, self.gamma2
+
+        def init_state(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, state, lr, wd, t):
+            n, gbar, delta = state
+            nn = (1 - g1) * jnp.square(g) + g1 * n
+            gg = (1 - g1) * g + g1 * gbar
+            dd = (g2 * delta
+                  - lr * (g / jnp.sqrt(nn - jnp.square(gg) + 1e-4) + wd * w))
+            return w + dd, (nn, gg, dd)
+        return init_state, update
+
 
 @register
 class AdaDelta(Optimizer):
@@ -313,6 +401,20 @@ class AdaDelta(Optimizer):
         acc_delta._set(ad)
         weight._set(w - cur_delta - wd * w)
 
+    def fused_update_fn(self):
+        rho, eps = self.rho, self.epsilon
+
+        def init_state(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, state, lr, wd, t):
+            acc_g, acc_delta = state
+            ag = rho * acc_g + (1.0 - rho) * jnp.square(g)
+            cur = jnp.sqrt(acc_delta + eps) / jnp.sqrt(ag + eps) * g
+            ad = rho * acc_delta + (1.0 - rho) * jnp.square(cur)
+            return w - cur - wd * w, (ag, ad)
+        return init_state, update
+
 
 @register
 class Test(Optimizer):
@@ -324,6 +426,17 @@ class Test(Optimizer):
     def update(self, index, weight, grad, state):
         weight._set(weight._get() + grad._get() * self.rescale_grad)
         state._set(weight._get())
+
+    def fused_update_fn(self):
+        # fused g arrives pre-rescaled (and clip applies), matching the
+        # imperative path for the default clip=None configuration
+        def init_state(w):
+            return jnp.zeros_like(w)
+
+        def update(w, g, state, lr, wd, t):
+            w2 = w + g
+            return w2, w2
+        return init_state, update
 
 
 def create(name, rescale_grad=1.0, **kwargs):

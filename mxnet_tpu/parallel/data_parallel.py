@@ -114,8 +114,12 @@ class DPTrainStep:
                 args = dict(params)
                 args.update(batch)
                 if cdt is not None:
+                    # labels stay full precision: class ids >= 257 round
+                    # in bf16 and would one-hot the wrong class
+                    labels = set(self.label_names)
                     args = {k: v.astype(cdt)
-                            if jnp.issubdtype(v.dtype, jnp.floating) else v
+                            if k not in labels
+                            and jnp.issubdtype(v.dtype, jnp.floating) else v
                             for k, v in args.items()}
                 outs, new_aux = prog.eval(args, aux, rng, True)
                 return outs, new_aux

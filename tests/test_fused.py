@@ -1,0 +1,283 @@
+"""Fused train step (module/fused.py): the classic executor-group +
+updater path and the single-donated-program path must produce identical
+training trajectories (reference semantics: model.py _update_params /
+module.py update)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=8, name="fc1"),
+                          act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=2, name="fc2"),
+                                name="softmax")
+
+
+def _data(batch_size=16):
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch_size)
+
+
+def _train(fused, contexts=None, optimizer="sgd", optimizer_params=None,
+           num_epoch=3, fixed=None, monkeypatch_env=None):
+    os.environ["MXNET_FUSED_TRAIN"] = "1" if fused else "0"
+    try:
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=contexts or [mx.cpu()],
+                            fixed_param_names=fixed)
+        if optimizer_params is None:
+            optimizer_params = {"learning_rate": 0.5, "momentum": 0.9}
+        mod.fit(_data(), num_epoch=num_epoch, optimizer=optimizer,
+                optimizer_params=optimizer_params)
+        assert (mod._fused is not None) == fused
+        return mod, {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+@pytest.mark.parametrize("opt,params", [
+    ("sgd", {"learning_rate": 0.5, "momentum": 0.9}),
+    ("sgd", {"learning_rate": 0.5, "wd": 0.01, "clip_gradient": 0.5}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.1}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("adadelta", {}),
+])
+def test_fused_matches_classic(opt, params):
+    _, pf = _train(True, optimizer=opt, optimizer_params=params)
+    _, pc = _train(False, optimizer=opt, optimizer_params=params)
+    for k in pf:
+        assert np.abs(pf[k] - pc[k]).max() < 1e-4, (opt, k)
+
+
+def test_fused_multi_device_matches_single():
+    _, p1 = _train(True, [mx.cpu(0)])
+    _, p2 = _train(True, [mx.cpu(0), mx.cpu(1)])
+    _, p3 = _train(False, [mx.cpu(0), mx.cpu(1)])
+    for k in p1:
+        assert np.abs(p1[k] - p2[k]).max() < 1e-4, k
+        assert np.abs(p2[k] - p3[k]).max() < 1e-4, k
+
+
+def test_fused_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=4, factor=0.5)
+    _, pf = _train(True, optimizer_params={"learning_rate": 0.4,
+                                           "lr_scheduler": sched})
+    sched2 = mx.lr_scheduler.FactorScheduler(step=4, factor=0.5)
+    _, pc = _train(False, optimizer_params={"learning_rate": 0.4,
+                                            "lr_scheduler": sched2})
+    for k in pf:
+        assert np.abs(pf[k] - pc[k]).max() < 1e-4, k
+
+
+def test_fused_fixed_params_stay_fixed():
+    mod, pf = _train(True, fixed=["fc1_weight"])
+    assert mod._fused is not None
+    mx.random.seed(7)
+    init = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    init.bind(data_shapes=[("data", (16, 6))],
+              label_shapes=[("softmax_label", (16,))])
+    init.init_params()
+    w0 = init.get_params()[0]["fc1_weight"].asnumpy()
+    assert np.allclose(pf["fc1_weight"], w0), "fixed param moved"
+    assert not np.allclose(pf["fc2_weight"],
+                           init.get_params()[0]["fc2_weight"].asnumpy())
+
+
+def test_fused_score_uses_live_params():
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        mod.fit(_data(), num_epoch=6,
+                optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+        assert mod._fused is not None
+        acc = mod.score(_data(4), "acc")[0][1]
+        assert acc >= 0.9, acc
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_monitor_disables_fusion():
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mon = mx.monitor.Monitor(1)
+    mod.fit(_data(), num_epoch=1, monitor=mon,
+            optimizer_params={"learning_rate": 0.1})
+    assert mod._fused is None
+
+
+def test_grad_req_add_disables_fusion():
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (16, 6))],
+             label_shapes=[("softmax_label", (16,))], grad_req="add")
+    mod.init_params()
+    mod.init_optimizer()
+    assert mod._fused is None
+
+
+def test_sgld_has_no_fused_form():
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (16, 6))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgld",
+                       optimizer_params={"learning_rate": 0.01})
+    assert mod._fused is None
+
+
+def test_cast_compute_preserves_labels():
+    """bf16 compute must not touch labels: class ids >= 257 are not
+    exactly representable in bf16."""
+    import jax.numpy as jnp
+    from mxnet_tpu.module.fused import FusedTrainStep
+    from mxnet_tpu import optimizer as opt_mod
+    net = _mlp()
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    fs = FusedTrainStep(net, [mx.cpu()], ["data"], ["softmax_label"],
+                        ["fc1_weight"], [], opt, compute_dtype="bfloat16")
+    args = {"data": jnp.ones((4, 6), jnp.float32),
+            "softmax_label": jnp.asarray([999.0, 998.0, 1.0, 0.0])}
+    cast = fs._cast_compute(args)
+    assert cast["data"].dtype == jnp.bfloat16
+    assert cast["softmax_label"].dtype == jnp.float32
+    assert np.allclose(np.asarray(cast["softmax_label"]),
+                       [999.0, 998.0, 1.0, 0.0])
+
+
+def test_get_params_survives_next_update():
+    """get_params() results must not alias the donated state (the next
+    update would delete the arrays under them)."""
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        it = _data()
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+        assert mod._fused is not None
+        batch = next(iter(it))
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        snap = mod.get_params()[0]
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        snap["fc2_weight"].asnumpy()   # raises if it aliased donated state
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_shared_module_disables_parent_fusion():
+    """Bucketing: once a sibling binds against this module's exec group,
+    the group's arrays are the single source of truth — the private
+    fused state must be retired (and its training synced back)."""
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        mx.random.seed(7)
+        parent = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        it = _data()
+        parent.bind(data_shapes=it.provide_data,
+                    label_shapes=it.provide_label)
+        parent.init_params()
+        parent.init_optimizer(optimizer_params={"learning_rate": 0.5})
+        batch = next(iter(it))
+        for _ in range(4):
+            parent.forward(batch, is_train=True)
+            parent.backward()
+            parent.update()
+        assert parent._fused_state is not None
+        trained = {k: v.asnumpy() for k, v in parent.get_params()[0].items()}
+        sib = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        sib.bind(data_shapes=[("data", (8, 6))],
+                 label_shapes=[("softmax_label", (8,))],
+                 shared_module=parent)
+        assert parent._fused is None, "parent kept a private fused state"
+        # the fused training must have landed in the shared exec group
+        synced = {}
+        parent._exec_group.get_params(synced, {})
+        for k, v in trained.items():
+            assert np.allclose(v, synced[k].asnumpy(), atol=1e-6), k
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_cast_compute_preserves_embedding_ids():
+    """bf16 compute must not round embedding token ids (>=257)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.module.fused import FusedTrainStep
+    from mxnet_tpu import optimizer as opt_mod
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=2000, output_dim=4, name="emb")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Flatten(emb), num_hidden=2, name="fc"), name="softmax")
+    opt = opt_mod.create("sgd", learning_rate=0.1)
+    fs = FusedTrainStep(net, [mx.cpu()], ["data"], ["softmax_label"],
+                        ["emb_weight", "fc_weight", "fc_bias"], [], opt,
+                        compute_dtype="bfloat16")
+    args = {"data": jnp.asarray([[1001.0, 1999.0]]),
+            "softmax_label": jnp.asarray([0.0])}
+    cast = fs._cast_compute(args)
+    assert cast["data"].dtype == jnp.float32
+    assert np.allclose(np.asarray(cast["data"]), [[1001.0, 1999.0]])
+
+
+def test_eval_forward_keeps_pending_train_batch():
+    """An eval forward between train forward and update() must not eat
+    the pending train step."""
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        it = _data()
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+        assert mod._fused is not None
+        batch = next(iter(it))
+        w0 = mod.get_params()[0]["fc2_weight"].asnumpy().copy()
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.forward(batch, is_train=False)   # mid-step eval
+        assert mod._fused_pending is not None
+        mod.update()
+        w1 = mod.get_params()[0]["fc2_weight"].asnumpy()
+        assert not np.allclose(w0, w1), "pending train batch was dropped"
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_fused_outputs_before_update():
+    """get_outputs() between forward and update must not commit the step."""
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        it = _data()
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+        assert mod._fused is not None
+        batch = next(iter(it))
+        w0 = mod.get_params()[0]["fc2_weight"].asnumpy().copy()
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        outs = mod.get_outputs()
+        assert outs[0].shape == (16, 2)
+        w1 = mod.get_params()[0]["fc2_weight"].asnumpy()
+        assert np.allclose(w0, w1), "peeking at outputs committed the update"
+        mod.update()
+        w2 = mod.get_params()[0]["fc2_weight"].asnumpy()
+        assert not np.allclose(w0, w2), "update did not commit"
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)

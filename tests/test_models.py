@@ -177,13 +177,16 @@ def test_bench_lstm_step_cpu():
     _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
         _os.path.abspath(__file__))))
     import jax
-    from bench_lstm import build_step
-    step, state, batch = build_step(batch=2, seq_len=4, num_hidden=8,
-                                    num_embed=8, num_layer=1, vocab=50)
-    state, outs = step(state, batch)
-    jax.block_until_ready((state, outs))
-    state, outs = step(state, batch)   # donated-buffer second step
-    jax.block_until_ready((state, outs))
+    import mxnet_tpu as mx
+    from bench_lstm import build_module
+    mod, staged = build_module(batch=2, seq_len=4, num_hidden=8,
+                               num_embed=8, num_layer=1, vocab=50,
+                               ctx=mx.cpu())
+    for _ in range(2):   # second step exercises the donated buffers
+        mod.forward(staged, is_train=True)
+        mod.backward()
+        mod.update()
+    jax.block_until_ready(next(iter(mod._fused_state["params"].values())))
 
 
 def test_alexnet_googlenet_inception_v3_shapes():
