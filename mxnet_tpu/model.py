@@ -81,6 +81,18 @@ def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
         kvstore.pull(idx, weights, priority=-idx)
 
 
+def _param_idx2name(param_names, num_device, update_on_kvstore):
+    """Updater-index -> param-name map so name-keyed optimizer rules
+    (wd_mult/lr_mult, the bias/gamma/beta wd exemption) work on the
+    index-keyed updater path.  The indexing convention is _update_params'
+    ``idx * num_device + dev``; keep the two in sync."""
+    if update_on_kvstore:
+        return dict(enumerate(param_names))
+    return {i * num_device + k: n
+            for i, n in enumerate(param_names)
+            for k in range(num_device)}
+
+
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
     """Local update: optionally aggregate grads through the kvstore, then
@@ -451,17 +463,8 @@ class FeedForward(BASE_ESTIMATOR):
             batch_size = data.batch_size
             if kvstore and kvstore.type == "dist_sync":
                 batch_size *= kvstore.num_workers
-            # index->name map so name-keyed rules (wd_mult, lr_mult, the
-            # bias/gamma/beta wd exemption) work on the index-keyed
-            # updater path (reference model.py fit sets the same map)
-            idx2name = {}
-            if update_on_kvstore:
-                idx2name.update(enumerate(param_names))
-            else:
-                for i, n in enumerate(param_names):
-                    for k in range(len(self.ctx)):
-                        idx2name[i * len(self.ctx) + k] = n
-            self.kwargs["param_idx2name"] = idx2name
+            self.kwargs["param_idx2name"] = _param_idx2name(
+                param_names, len(self.ctx), update_on_kvstore)
             optimizer = opt_mod.create(self.optimizer,
                                        rescale_grad=(1.0 / batch_size),
                                        **(self.kwargs))

@@ -78,36 +78,15 @@ class FusedTrainStep:
         # master weights and optimizer state stay f32, the fwd/bwd compute
         # runs in bf16 on the MXU, grads are cast back before the update
         self.compute_dtype = compute_dtype
-        self._no_cast = set(self.label_names) | self._id_valued_inputs(symbol)
+        from ..symbol import id_valued_inputs
+        self._no_cast = set(self.label_names) | id_valued_inputs(symbol)
         self._step = None
         self._fwd = None
         self._lr_cache = None
 
-    def _id_valued_inputs(self, symbol):
-        """Variable names whose float values are integer ids (embedding
-        tokens): casting those to bf16 would misround ids >= 257 and look
-        up the wrong rows."""
-        from ..symbol import _topo
-        ids = set()
-        for node in _topo(symbol._heads):
-            if node.is_variable or node.op is None:
-                continue
-            if getattr(node.op, "name", "") == "Embedding" and node.inputs:
-                src = node.inputs[0][0]
-                if src.is_variable:
-                    ids.add(src.name)
-        return ids
-
     def _cast_compute(self, args):
-        if self.compute_dtype is None:
-            return args
-        cdt = self.compute_dtype
-        skip = self._no_cast
-        # labels and id-valued inputs stay full precision: integers
-        # >= 257 are not exactly representable in bf16
-        return {k: v.astype(cdt)
-                if k not in skip and jnp.issubdtype(v.dtype, jnp.floating)
-                else v for k, v in args.items()}
+        from ..symbol import cast_compute
+        return cast_compute(args, self.compute_dtype, self._no_cast)
 
     # -- placement ----------------------------------------------------------
     def _replicated(self):

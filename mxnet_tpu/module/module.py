@@ -13,8 +13,8 @@ from ..context import Context, cpu, current_context
 from ..initializer import Uniform
 from ..ndarray import NDArray, zeros as nd_zeros
 from .. import optimizer as opt_mod
-from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
-                     _update_params_on_kvstore)
+from ..model import (_create_kvstore, _initialize_kvstore, _param_idx2name,
+                     _update_params, _update_params_on_kvstore)
 from .base_module import BaseModule
 from .executor_group import DataParallelExecutorGroup
 from .fused import FusedTrainStep
@@ -257,6 +257,12 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
+        if self.params_initialized and self._params_dirty:
+            # force_init mid-training: the live params may exist only in the
+            # donated fused state (or exec group); pull them back before the
+            # kvstore is re-seeded and _setup_fused drops that state, or
+            # training silently reverts to the last-synced values
+            self._sync_params_from_devices()
 
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
@@ -265,13 +271,8 @@ class Module(BaseModule):
             batch_size = self._exec_group.batch_size
             if kvstore and kvstore.type == "dist_sync":
                 batch_size *= kvstore.num_workers
-            idx2name = {i: n for i, n in enumerate(self._param_names)}
-            if not update_on_kvstore:
-                # per-device updater indices (reference model.py _update_params)
-                idx2name = {}
-                for i, n in enumerate(self._param_names):
-                    for k in range(len(self._context)):
-                        idx2name[i * len(self._context) + k] = n
+            idx2name = _param_idx2name(self._param_names,
+                                       len(self._context), update_on_kvstore)
             optimizer_params = dict(optimizer_params)
             if "rescale_grad" not in optimizer_params:
                 optimizer_params["rescale_grad"] = 1.0 / batch_size
@@ -331,6 +332,11 @@ class Module(BaseModule):
         return True
 
     def _setup_fused(self):
+        if self._fused is not None and self._fused_state is not None and \
+                self._params_dirty:
+            # defense in depth (init_optimizer syncs first): never drop a
+            # live fused state that holds the only copy of trained params
+            self._sync_params_from_devices()
         self._fused = None
         self._fused_state = None
         self._fused_pending = None
@@ -352,13 +358,14 @@ class Module(BaseModule):
         except MXNetError:
             self._fused = None
 
-    def _disable_fused(self, reason):
+    def _disable_fused(self, reason, replay_backward=True):
         """Leave the fused path mid-training with consistent state: pull
         the live params back into arg_params/exec group and re-seed an
         update_on_kvstore kvstore (it still holds the weights from
         init time — a pull would otherwise revert training)."""
         if self._fused is None:
             return
+        pend = self._fused_pending
         if self._fused_state is not None:
             self._sync_params_from_devices()
             if self._update_on_kvstore and self._kvstore is not None:
@@ -373,10 +380,50 @@ class Module(BaseModule):
                 counts = self._optimizer._index_update_count
                 for i in range(len(self._param_names) * len(self._context)):
                     counts.setdefault(i, self._fused_t)
+            # hand the accumulated moments (SGD momentum, Adam m/v, ...)
+            # to the classic updater — its lazy create_state would zero
+            # them and the trajectory would diverge from classic parity
+            opt_states = self._fused_state.get("opt") or {}
+            updater = self._updater
+            if updater is None and self._update_on_kvstore and \
+                    self._kvstore is not None:
+                updater = getattr(self._kvstore, "_updater", None)
+            if opt_states and updater is not None and \
+                    hasattr(updater, "states"):
+                def _to_nd(x):
+                    if x is None:
+                        return None
+                    if isinstance(x, (tuple, list)):
+                        return tuple(_to_nd(e) for e in x)
+                    return NDArray(x)
+                num_dev = len(self._context)
+                for i, n in enumerate(self._param_names):
+                    st = opt_states.get(n)
+                    if st is None:
+                        continue
+                    if self._update_on_kvstore:
+                        updater.states[i] = _to_nd(st)
+                    else:
+                        # one independent copy per device replica
+                        for dev in range(num_dev):
+                            updater.states[i * num_dev + dev] = _to_nd(st)
         self._fused = None
         self._fused_state = None
         self._fused_pending = None
         self._fused_outputs = None
+        if pend is not None:
+            # an uncommitted batch (forward recorded, update not yet run):
+            # replay it through the exec group so the caller's next
+            # backward()/update() acts on real gradients, not the
+            # bind-time zero buffers
+            from ..io import DataBatch
+            eg = self._exec_group
+            batch = DataBatch(
+                data=[NDArray(pend[n]) for n in eg.data_names],
+                label=[NDArray(pend[n]) for n in eg.label_names])
+            eg.forward(batch, True)
+            if replay_backward:
+                eg.backward()
         self.logger.info("fused train step disabled: %s", reason)
 
     def _fused_ensure_state(self):
@@ -434,18 +481,13 @@ class Module(BaseModule):
             if out_grads is None:
                 return
             # explicit head gradients (e.g. SequentialModule chaining)
-            # cannot ride the loss-headed fused program: replay this batch
-            # on the classic path and stay there. Rebuild the batch from
-            # the recorded device arrays — the caller's DataBatch may have
-            # been mutated since forward (SequentialModule does).
-            from ..io import DataBatch
-            pend = self._fused_pending
-            eg = self._exec_group
-            batch = DataBatch(
-                data=[NDArray(pend[n]) for n in eg.data_names],
-                label=[NDArray(pend[n]) for n in eg.label_names])
-            self._disable_fused("explicit head gradients")
-            self._exec_group.forward(batch, True)
+            # cannot ride the loss-headed fused program: _disable_fused
+            # replays the pending batch through the exec group (from the
+            # recorded device arrays — the caller's DataBatch may have
+            # been mutated since forward), then the caller's heads land
+            # via the backward below (no throwaway ones-seeded backward).
+            self._disable_fused("explicit head gradients",
+                                replay_backward=False)
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):

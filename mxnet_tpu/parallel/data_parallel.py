@@ -67,6 +67,9 @@ class DPTrainStep:
         # bf16 mixed precision: f32 master weights + momentum, bf16 fwd/bwd
         # compute (MXU-native; fp16-era capability mapped the TPU way)
         self.compute_dtype = compute_dtype
+        from ..symbol import id_valued_inputs
+        # labels AND embedding-id inputs stay full precision under bf16
+        self._no_cast = set(self.label_names) | id_valued_inputs(symbol)
         self._prog = _GraphProgram(symbol, {}, None, do_mirror=remat)
         input_names = set(self.data_names) | set(self.label_names)
         self.param_names = [n for n in symbol.list_arguments()
@@ -114,13 +117,8 @@ class DPTrainStep:
                 args = dict(params)
                 args.update(batch)
                 if cdt is not None:
-                    # labels stay full precision: class ids >= 257 round
-                    # in bf16 and would one-hot the wrong class
-                    labels = set(self.label_names)
-                    args = {k: v.astype(cdt)
-                            if k not in labels
-                            and jnp.issubdtype(v.dtype, jnp.floating) else v
-                            for k, v in args.items()}
+                    from ..symbol import cast_compute
+                    args = cast_compute(args, cdt, self._no_cast)
                 outs, new_aux = prog.eval(args, aux, rng, True)
                 return outs, new_aux
 

@@ -71,6 +71,33 @@ def _topo(heads: Sequence[Tuple[_Node, int]]) -> List[_Node]:
     return order
 
 
+def cast_compute(args: dict, compute_dtype, skip: set) -> dict:
+    """Mixed-precision cast for a train-step's input dict: float tensors go
+    to `compute_dtype` except names in `skip` (labels and id-valued inputs
+    — integers >= 257 are not exactly representable in bf16)."""
+    import jax.numpy as jnp
+    if compute_dtype is None:
+        return args
+    return {k: v.astype(compute_dtype)
+            if k not in skip and jnp.issubdtype(v.dtype, jnp.floating)
+            else v for k, v in args.items()}
+
+
+def id_valued_inputs(symbol: "Symbol") -> set:
+    """Variable names whose float values are integer ids (embedding
+    tokens): mixed-precision paths must not cast those to bf16 — ids
+    >= 257 would misround and look up the wrong rows."""
+    ids = set()
+    for node in _topo(symbol._heads):
+        if node.is_variable or node.op is None:
+            continue
+        if getattr(node.op, "name", "") == "Embedding" and node.inputs:
+            src = node.inputs[0][0]
+            if src.is_variable:
+                ids.add(src.name)
+    return ids
+
+
 class Symbol:
     """Symbol = list of output heads over a shared DAG."""
 

@@ -17,16 +17,18 @@ def same(a, b):
     return np.sum(a != b) == 0
 
 
-def numeric_grad(executor, location, eps=1e-4):
+def numeric_grad(executor, location, eps=1e-4, is_train=False):
     """Finite-difference gradients of sum(outputs[0]) wrt each location arg
-    (reference check_utils.py numeric_grad)."""
+    (reference check_utils.py numeric_grad).  `is_train=True` runs the
+    perturbed forwards in train mode — required for ops whose train-mode
+    forward differs deterministically from eval (BatchNorm batch stats)."""
     args = executor.arg_dict
     for k, v in location.items():
         args[k][:] = np.asarray(v, dtype=np.float32)
     approx_grads = {k: np.zeros(v.shape, dtype=np.float32)
                     for k, v in location.items()}
 
-    executor.forward(is_train=False)
+    executor.forward(is_train=is_train)
     f_x = executor.outputs[0].asnumpy().sum()
 
     for k in location:
@@ -37,7 +39,7 @@ def numeric_grad(executor, location, eps=1e-4):
             orig = flat[i]
             flat[i] = orig + eps
             args[k][:] = old_value.reshape(location[k].shape)
-            executor.forward(is_train=False)
+            executor.forward(is_train=is_train)
             f_eps = executor.outputs[0].asnumpy().sum()
             ap[i] = (f_eps - f_x) / eps
             flat[i] = orig
@@ -46,7 +48,8 @@ def numeric_grad(executor, location, eps=1e-4):
 
 
 def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
-                           check_eps=0.06, grad_nodes=None, rtol=None):
+                           check_eps=0.06, grad_nodes=None, rtol=None,
+                           fd_is_train=False):
     """Compare autodiff gradients against finite differences
     (reference check_utils.py check_numeric_gradient)."""
     kwargs = {k: v.shape for k, v in location.items()}
@@ -55,7 +58,7 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     if grad_nodes is None:
         grad_nodes = [k for k in location]
     grad_req = {n: ("write" if n in grad_nodes else "null") for n in arg_names}
-    executor = sym.simple_bind(mx.cpu(), grad_req=grad_req, **kwargs)
+    executor = sym.simple_bind(mx.current_context(), grad_req=grad_req, **kwargs)
     for k, v in location.items():
         executor.arg_dict[k][:] = np.asarray(v, dtype=np.float32)
     if aux_states is not None:
@@ -66,13 +69,13 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     executor.backward()
     sym_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
 
-    fd_exec = sym.simple_bind(mx.cpu(), grad_req="null", **kwargs)
+    fd_exec = sym.simple_bind(mx.current_context(), grad_req="null", **kwargs)
     if aux_states is not None:
         for k, v in aux_states.items():
             fd_exec.aux_dict[k][:] = np.asarray(v, dtype=np.float32)
     num_grads = numeric_grad(fd_exec, {k: np.asarray(v, dtype=np.float32)
                                        for k, v in location.items()},
-                             eps=numeric_eps)
+                             eps=numeric_eps, is_train=fd_is_train)
     for name in grad_nodes:
         rd = reldiff(num_grads[name], sym_grads[name])
         assert rd < check_eps, \
@@ -82,7 +85,7 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
 
 def check_symbolic_forward(sym, location, expected, check_eps=1e-4):
     kwargs = {k: v.shape for k, v in location.items()}
-    executor = sym.simple_bind(mx.cpu(), grad_req="null", **kwargs)
+    executor = sym.simple_bind(mx.current_context(), grad_req="null", **kwargs)
     for k, v in location.items():
         executor.arg_dict[k][:] = np.asarray(v, dtype=np.float32)
     executor.forward(is_train=False)

@@ -23,10 +23,10 @@ def check_bind_with_uniform(uf, gf, dim):
     lhs_grad = mx.nd.empty(shape)
     rhs_grad = mx.nd.empty(shape)
 
-    executor = ret.bind(mx.cpu(), args=[lhs_arr, rhs_arr],
+    executor = ret.bind(mx.current_context(), args=[lhs_arr, rhs_arr],
                         args_grad=[lhs_grad, rhs_grad])
-    exec3 = ret.bind(mx.cpu(), args=[lhs_arr, rhs_arr])
-    exec4 = ret.bind(mx.cpu(), args={"rhs": rhs_arr, "lhs": lhs_arr},
+    exec3 = ret.bind(mx.current_context(), args=[lhs_arr, rhs_arr])
+    exec4 = ret.bind(mx.current_context(), args={"rhs": rhs_arr, "lhs": lhs_arr},
                      args_grad={"lhs": lhs_grad, "rhs": rhs_grad})
     executor.forward()
     exec3.forward()
@@ -68,7 +68,7 @@ def test_bind():
 def test_reshape_executor():
     x = mx.sym.Variable("x")
     y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
-    exe = y.simple_bind(mx.cpu(), x=(5, 4), grad_req="null")
+    exe = y.simple_bind(mx.current_context(), x=(5, 4), grad_req="null")
     exe.arg_dict["x"][:] = 1
     exe.arg_dict["fc_weight"][:] = np.eye(4)
     exe.arg_dict["fc_bias"][:] = 0
@@ -85,7 +85,7 @@ def test_grad_req_add():
     y = 2.0 * x
     xv = mx.nd.array(np.ones((2, 2)))
     g = mx.nd.zeros((2, 2))
-    exe = y.bind(mx.cpu(), args={"x": xv}, args_grad={"x": g}, grad_req="add")
+    exe = y.bind(mx.current_context(), args={"x": xv}, args_grad={"x": g}, grad_req="add")
     exe.forward(is_train=True)
     exe.backward()
     exe.forward(is_train=True)
@@ -96,7 +96,7 @@ def test_grad_req_add():
 def test_output_dict_and_copy_params():
     x = mx.sym.Variable("x")
     y = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
-    exe = y.simple_bind(mx.cpu(), x=(3, 2))
+    exe = y.simple_bind(mx.current_context(), x=(3, 2))
     exe.copy_params_from({"fc_weight": mx.nd.ones((2, 2)),
                           "fc_bias": mx.nd.zeros((2,))})
     exe.arg_dict["x"][:] = 2
@@ -110,7 +110,7 @@ def test_monitor_callback():
     x = mx.sym.Variable("x")
     y = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
     z = mx.sym.Activation(y, act_type="relu", name="act")
-    exe = z.simple_bind(mx.cpu(), x=(2, 2))
+    exe = z.simple_bind(mx.current_context(), x=(2, 2))
     exe.set_monitor_callback(lambda name, arr: stats.append(name))
     exe.arg_dict["x"][:] = 1
     exe.forward()
@@ -121,7 +121,7 @@ def test_monitor_callback():
 def test_debug_str():
     x = mx.sym.Variable("x")
     y = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
-    exe = y.simple_bind(mx.cpu(), x=(2, 2))
+    exe = y.simple_bind(mx.current_context(), x=(2, 2))
     s = exe.debug_str()
     assert "fc" in s and "MB allocated" in s
 
@@ -129,7 +129,7 @@ def test_debug_str():
 def test_forward_kwargs_update_args():
     x = mx.sym.Variable("x")
     y = x * 3.0
-    exe = y.simple_bind(mx.cpu(), x=(2, 2))
+    exe = y.simple_bind(mx.current_context(), x=(2, 2))
     out = exe.forward(x=np.ones((2, 2), dtype=np.float32))
     assert np.allclose(out[0].asnumpy(), 3 * np.ones((2, 2)))
 
@@ -139,7 +139,7 @@ def test_head_gradient():
     y = x * x
     xv = mx.nd.array(np.array([[1.0, 2.0]], dtype=np.float32))
     g = mx.nd.zeros((1, 2))
-    exe = y.bind(mx.cpu(), args={"x": xv}, args_grad={"x": g})
+    exe = y.bind(mx.current_context(), args={"x": xv}, args_grad={"x": g})
     exe.forward(is_train=True)
     exe.backward(mx.nd.array(np.array([[10.0, 100.0]], dtype=np.float32)))
     assert np.allclose(g.asnumpy(), np.array([[20.0, 400.0]]))
@@ -163,7 +163,7 @@ def test_backward_mirror_grad_equivalence(monkeypatch):
         net = mx.sym.Activation(net, act_type="tanh")
         net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
         net = mx.sym.SoftmaxOutput(net, name="softmax")
-        ex = net.simple_bind(mx.cpu(), grad_req="write", data=x.shape,
+        ex = net.simple_bind(mx.current_context(), grad_req="write", data=x.shape,
                              softmax_label=lab.shape)
         rng2 = np.random.RandomState(1)
         for k, v in ex.arg_dict.items():

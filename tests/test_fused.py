@@ -30,7 +30,7 @@ def _train(fused, contexts=None, optimizer="sgd", optimizer_params=None,
     os.environ["MXNET_FUSED_TRAIN"] = "1" if fused else "0"
     try:
         mx.random.seed(7)
-        mod = mx.mod.Module(_mlp(), context=contexts or [mx.cpu()],
+        mod = mx.mod.Module(_mlp(), context=contexts or [mx.current_context()],
                             fixed_param_names=fixed)
         if optimizer_params is None:
             optimizer_params = {"learning_rate": 0.5, "momentum": 0.9}
@@ -82,7 +82,7 @@ def test_fused_fixed_params_stay_fixed():
     mod, pf = _train(True, fixed=["fc1_weight"])
     assert mod._fused is not None
     mx.random.seed(7)
-    init = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    init = mx.mod.Module(_mlp(), context=[mx.current_context()])
     init.bind(data_shapes=[("data", (16, 6))],
               label_shapes=[("softmax_label", (16,))])
     init.init_params()
@@ -96,7 +96,7 @@ def test_fused_score_uses_live_params():
     os.environ["MXNET_FUSED_TRAIN"] = "1"
     try:
         mx.random.seed(7)
-        mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
         mod.fit(_data(), num_epoch=6,
                 optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
         assert mod._fused is not None
@@ -108,7 +108,7 @@ def test_fused_score_uses_live_params():
 
 def test_monitor_disables_fusion():
     mx.random.seed(7)
-    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
     mon = mx.monitor.Monitor(1)
     mod.fit(_data(), num_epoch=1, monitor=mon,
             optimizer_params={"learning_rate": 0.1})
@@ -116,7 +116,7 @@ def test_monitor_disables_fusion():
 
 
 def test_grad_req_add_disables_fusion():
-    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
     mod.bind(data_shapes=[("data", (16, 6))],
              label_shapes=[("softmax_label", (16,))], grad_req="add")
     mod.init_params()
@@ -125,7 +125,7 @@ def test_grad_req_add_disables_fusion():
 
 
 def test_sgld_has_no_fused_form():
-    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
     mod.bind(data_shapes=[("data", (16, 6))],
              label_shapes=[("softmax_label", (16,))])
     mod.init_params()
@@ -142,7 +142,7 @@ def test_cast_compute_preserves_labels():
     from mxnet_tpu import optimizer as opt_mod
     net = _mlp()
     opt = opt_mod.create("sgd", learning_rate=0.1)
-    fs = FusedTrainStep(net, [mx.cpu()], ["data"], ["softmax_label"],
+    fs = FusedTrainStep(net, [mx.current_context()], ["data"], ["softmax_label"],
                         ["fc1_weight"], [], opt, compute_dtype="bfloat16")
     args = {"data": jnp.ones((4, 6), jnp.float32),
             "softmax_label": jnp.asarray([999.0, 998.0, 1.0, 0.0])}
@@ -159,7 +159,7 @@ def test_get_params_survives_next_update():
     os.environ["MXNET_FUSED_TRAIN"] = "1"
     try:
         mx.random.seed(7)
-        mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
         it = _data()
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
         mod.init_params()
@@ -185,7 +185,7 @@ def test_shared_module_disables_parent_fusion():
     os.environ["MXNET_FUSED_TRAIN"] = "1"
     try:
         mx.random.seed(7)
-        parent = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        parent = mx.mod.Module(_mlp(), context=[mx.current_context()])
         it = _data()
         parent.bind(data_shapes=it.provide_data,
                     label_shapes=it.provide_label)
@@ -198,7 +198,7 @@ def test_shared_module_disables_parent_fusion():
             parent.update()
         assert parent._fused_state is not None
         trained = {k: v.asnumpy() for k, v in parent.get_params()[0].items()}
-        sib = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        sib = mx.mod.Module(_mlp(), context=[mx.current_context()])
         sib.bind(data_shapes=[("data", (8, 6))],
                  label_shapes=[("softmax_label", (8,))],
                  shared_module=parent)
@@ -222,7 +222,7 @@ def test_cast_compute_preserves_embedding_ids():
     net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
         mx.sym.Flatten(emb), num_hidden=2, name="fc"), name="softmax")
     opt = opt_mod.create("sgd", learning_rate=0.1)
-    fs = FusedTrainStep(net, [mx.cpu()], ["data"], ["softmax_label"],
+    fs = FusedTrainStep(net, [mx.current_context()], ["data"], ["softmax_label"],
                         ["emb_weight", "fc_weight", "fc_bias"], [], opt,
                         compute_dtype="bfloat16")
     args = {"data": jnp.asarray([[1001.0, 1999.0]]),
@@ -238,7 +238,7 @@ def test_eval_forward_keeps_pending_train_batch():
     os.environ["MXNET_FUSED_TRAIN"] = "1"
     try:
         mx.random.seed(7)
-        mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
         it = _data()
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
         mod.init_params()
@@ -262,7 +262,7 @@ def test_fused_outputs_before_update():
     os.environ["MXNET_FUSED_TRAIN"] = "1"
     try:
         mx.random.seed(7)
-        mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
         it = _data()
         mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
         mod.init_params()
@@ -281,3 +281,108 @@ def test_fused_outputs_before_update():
         assert not np.allclose(w0, w2), "update did not commit"
     finally:
         os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_force_init_optimizer_keeps_trained_params():
+    """init_optimizer(force_init=True) mid-training must carry the live
+    fused-state params into the rebuilt state (and the re-seeded kvstore),
+    not revert to the init-time weights."""
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    it = _data()
+    mod.fit(it, num_epoch=2,
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    assert mod._fused is not None and mod._fused_state is not None
+    trained = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+
+    # simulate more training so params live only in the fused state again
+    it.reset()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    stepped = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    assert any(np.abs(stepped[k] - trained[k]).max() > 0 for k in trained)
+
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01},
+                       force_init=True)
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for k in stepped:
+        assert np.allclose(after[k], stepped[k]), k
+
+
+def test_disable_fused_replays_pending_batch():
+    """A forward that is still pending on the fused path when fusion is
+    torn down (e.g. monitor installed between forward and update) must be
+    replayed through the exec group so update() applies real gradients."""
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    it = _data()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+    assert mod._fused is not None
+
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    assert mod._fused_pending is not None
+    before = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+
+    mod._disable_fused("test: mid-batch teardown")
+    mod.update()
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    # the step must match a pure classic run of the same single batch
+    os.environ["MXNET_FUSED_TRAIN"] = "0"
+    try:
+        mx.random.seed(7)
+        ref = mx.mod.Module(_mlp(), context=[mx.current_context()])
+        ref.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        ref.init_params()
+        ref.init_optimizer(optimizer_params={"learning_rate": 0.5})
+        ref.forward(batch, is_train=True)
+        ref.backward()
+        ref.update()
+        expect = {k: v.asnumpy() for k, v in ref.get_params()[0].items()}
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
+    changed = any(np.abs(after[k] - before[k]).max() > 0 for k in before)
+    assert changed
+    for k in after:
+        assert np.abs(after[k] - expect[k]).max() < 1e-5, k
+
+
+def test_disable_fused_carries_momentum():
+    """Mid-training fallback must seed the classic updater with the fused
+    moments (SGD momentum here): fused-then-classic equals pure classic."""
+    def run(disable_after):
+        os.environ["MXNET_FUSED_TRAIN"] = "1" if disable_after else "0"
+        try:
+            mx.random.seed(11)
+            mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+            it = _data()
+            mod.bind(data_shapes=it.provide_data,
+                     label_shapes=it.provide_label)
+            mod.init_params()
+            mod.init_optimizer(optimizer_params={"learning_rate": 0.5,
+                                                 "momentum": 0.9})
+            nbatch = 0
+            for _ in range(3):
+                it.reset()
+                for batch in it:
+                    mod.forward(batch, is_train=True)
+                    mod.backward()
+                    mod.update()
+                    nbatch += 1
+                    if disable_after and nbatch == disable_after:
+                        mod._disable_fused("test: mid-training fallback")
+            return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+        finally:
+            os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+    mixed = run(disable_after=5)
+    classic = run(disable_after=0)
+    for k in classic:
+        assert np.abs(mixed[k] - classic[k]).max() < 1e-4, k

@@ -10,7 +10,7 @@ def _run_fwd_bwd(net, data_shape, label_shape, extra=None):
     shapes = {"data": data_shape, "softmax_label": label_shape}
     if extra:
         shapes.update(extra)
-    ex = net.simple_bind(mx.cpu(), **shapes)
+    ex = net.simple_bind(mx.current_context(), **shapes)
     init = mx.init.Xavier()
     for name, arr in ex.arg_dict.items():
         if name not in shapes:
@@ -85,7 +85,7 @@ def test_lstm_unroll():
         shapes["l%d_init_h" % i] = (bs, 16)
     args, outs, _ = net.infer_shape(**shapes)
     assert outs[0] == (bs * seq_len, 50)
-    ex = net.simple_bind(mx.cpu(), **shapes)
+    ex = net.simple_bind(mx.current_context(), **shapes)
     ex.arg_dict["data"][:] = np.random.randint(0, 50, (bs, seq_len)).astype("f")
     ex.arg_dict["softmax_label"][:] = np.random.randint(
         0, 50, (bs, seq_len)).astype("f")
@@ -144,7 +144,7 @@ def test_fast_rcnn_forward_backward():
     shapes = {"data": (1, 3, 32, 32), "rois": (n_roi, 5),
               "label": (n_roi,), "bbox_target": (n_roi, 16),
               "bbox_weight": (n_roi, 16)}
-    ex = net.simple_bind(mx.cpu(), **shapes)
+    ex = net.simple_bind(mx.current_context(), **shapes)
     init = mx.init.Xavier()
     for name, arr in ex.arg_dict.items():
         if name not in shapes:
@@ -181,7 +181,7 @@ def test_bench_lstm_step_cpu():
     from bench_lstm import build_module
     mod, staged = build_module(batch=2, seq_len=4, num_hidden=8,
                                num_embed=8, num_layer=1, vocab=50,
-                               ctx=mx.cpu())
+                               ctx=mx.current_context())
     for _ in range(2):   # second step exercises the donated buffers
         mod.forward(staged, is_train=True)
         mod.backward()

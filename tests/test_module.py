@@ -31,7 +31,7 @@ def test_module_fit_convergence():
     mx.random.seed(0)
     X, y = make_blobs()
     it = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
-    mod = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod = mx.mod.Module(mlp_sym(), context=mx.current_context())
     mod.fit(it, num_epoch=5, optimizer_params={"learning_rate": 0.5})
     acc = mod.score(it, "acc")
     assert acc[0][1] > 0.95, acc
@@ -53,7 +53,7 @@ def test_module_predict_and_params():
     np.random.seed(0)
     X, y = make_blobs(n=100)
     it = mx.io.NDArrayIter(X, y, batch_size=20)
-    mod = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod = mx.mod.Module(mlp_sym(), context=mx.current_context())
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod.init_params()
     out = mod.predict(it)
@@ -61,7 +61,7 @@ def test_module_predict_and_params():
     arg, aux = mod.get_params()
     assert "fc1_weight" in arg
     # set_params round trip
-    mod2 = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod2 = mx.mod.Module(mlp_sym(), context=mx.current_context())
     mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod2.init_params(arg_params=arg, aux_params=aux)
     out2 = mod2.predict(it)
@@ -71,7 +71,7 @@ def test_module_predict_and_params():
 def test_module_save_load_params(tmp_path):
     X, y = make_blobs(n=100)
     it = mx.io.NDArrayIter(X, y, batch_size=20)
-    mod = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod = mx.mod.Module(mlp_sym(), context=mx.current_context())
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod.init_params()
     fname = str(tmp_path / "params")
@@ -88,14 +88,14 @@ def test_feedforward_fit_and_checkpoint(tmp_path):
     mx.random.seed(0)
     X, y = make_blobs()
     it = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
-    model = mx.model.FeedForward(mlp_sym(), ctx=mx.cpu(), num_epoch=4,
+    model = mx.model.FeedForward(mlp_sym(), ctx=mx.current_context(), num_epoch=4,
                                  learning_rate=0.5)
     model.fit(it)
     acc = model.score(it)
     assert acc > 0.9, acc
     prefix = str(tmp_path / "ffn")
     model.save(prefix)
-    model2 = mx.model.FeedForward.load(prefix, 4, ctx=mx.cpu())
+    model2 = mx.model.FeedForward.load(prefix, 4, ctx=mx.current_context())
     acc2 = model2.score(it)
     assert abs(acc - acc2) < 1e-6
     pred = model2.predict(it)
@@ -115,7 +115,7 @@ def test_bucketing_module():
         return mx.sym.SoftmaxOutput(net, name="softmax")
 
     mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
-                                 context=mx.cpu())
+                                 context=mx.current_context())
     from mxnet_tpu.io import DataBatch
 
     def batch(key, bs=8):
@@ -144,7 +144,7 @@ def test_monitor_in_module():
     seen = []
     mon = mx.Monitor(1, stat_func=lambda x: x, pattern=".*output")
     mon.stat_helper_orig = mon.stat_helper
-    mod = mx.mod.Module(mlp_sym(), context=mx.cpu())
+    mod = mx.mod.Module(mlp_sym(), context=mx.current_context())
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod.init_params()
     mod.install_monitor(mon)
@@ -174,13 +174,13 @@ def test_checkpoint_resume_training(tmp_path):
     net = mx.sym.SoftmaxOutput(net, name="softmax")
     prefix = str(tmp_path / "resume")
 
-    ff = mx.model.FeedForward(net, ctx=mx.cpu(), num_epoch=2,
+    ff = mx.model.FeedForward(net, ctx=mx.current_context(), num_epoch=2,
                               learning_rate=0.3)
     ff.fit(it, epoch_end_callback=mx.callback.do_checkpoint(prefix))
     assert os.path.exists(prefix + "-0002.params")
 
     # resume from epoch 2, run to epoch 4 (reference --load-epoch path)
-    ff2 = mx.model.FeedForward.load(prefix, 2, ctx=mx.cpu(), num_epoch=4,
+    ff2 = mx.model.FeedForward.load(prefix, 2, ctx=mx.current_context(), num_epoch=4,
                                     learning_rate=0.3)
     it.reset()
     ff2.fit(it, epoch_end_callback=mx.callback.do_checkpoint(prefix))
@@ -204,12 +204,12 @@ def test_sequential_module():
     feat = mx.sym.Activation(mx.sym.FullyConnected(d1, num_hidden=12,
                                                    name="fc1"),
                              act_type="relu")
-    m1 = mx.mod.Module(feat, label_names=[], context=mx.cpu())
+    m1 = mx.mod.Module(feat, label_names=[], context=mx.current_context())
     d2 = mx.sym.Variable("data")
     head = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(d2, num_hidden=2,
                                                       name="fc2"),
                                 name="softmax")
-    m2 = mx.mod.Module(head, context=mx.cpu())
+    m2 = mx.mod.Module(head, context=mx.current_context())
 
     seq = mx.mod.SequentialModule()
     seq.add(m1).add(m2, take_labels=True, auto_wiring=True)
@@ -251,7 +251,7 @@ def test_module_reshape():
     net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2,
                                                      name="fc"),
                                name="softmax")
-    mod = mx.mod.Module(net, context=mx.cpu())
+    mod = mx.mod.Module(net, context=mx.current_context())
     mod.fit(it, num_epoch=6, optimizer_params={"learning_rate": 0.5})
     w_before = mod.get_params()[0]["fc_weight"].asnumpy()
 
@@ -277,7 +277,7 @@ def test_module_reshape_syncs_dirty_params():
     net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2,
                                                      name="fc"),
                                name="softmax")
-    mod = mx.mod.Module(net, context=mx.cpu())
+    mod = mx.mod.Module(net, context=mx.current_context())
     mod.fit(it, num_epoch=6, optimizer_params={"learning_rate": 0.5})
     # deliberately no get_params() here
     mod.reshape(data_shapes=[("data", (4, 6))],
@@ -293,7 +293,7 @@ def test_module_reshape_keeps_grad_req():
     net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(data, num_hidden=2,
                                                      name="fc"),
                                name="softmax")
-    mod = mx.mod.Module(net, context=mx.cpu())
+    mod = mx.mod.Module(net, context=mx.current_context())
     mod.bind(data_shapes=[("data", (8, 6))],
              label_shapes=[("softmax_label", (8,))], grad_req="add")
     mod.init_params()

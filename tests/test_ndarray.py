@@ -272,7 +272,7 @@ def test_cast_between_dtypes():
     for target in ("float16", "int32", "uint8"):
         data = mx.sym.Variable("data")
         c = mx.sym.Cast(data, dtype=target)
-        ex = c.simple_bind(mx.cpu(), grad_req="null", data=(2, 3))
+        ex = c.simple_bind(mx.current_context(), grad_req="null", data=(2, 3))
         ex.arg_dict["data"][:] = x
         ex.forward(is_train=False)
         got = ex.outputs[0].asnumpy()

@@ -1,0 +1,575 @@
+"""Systematic per-op finite-difference gradient checks.
+
+Reference model: tests/python/unittest/test_operator.py (1519 LoC) runs
+check_numeric_gradient over every op family.  This file sweeps the whole
+registry: each family gets FD-vs-autodiff agreement on tiny tensors, the
+zero-gradient ops get exact-zero assertions, and the loss layers are checked
+against their analytic backward definitions (reference softmax_output-inl.h,
+regression_output-inl.h semantics: backward ignores head grads and emits
+prediction - label).
+
+Inputs are kept away from kinks (|x| bounded below for abs/relu/max, ties
+separated for max-pool/reductions) so finite differences are well-defined.
+"""
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from check_utils import reldiff, check_numeric_gradient
+
+rng = np.random.RandomState(1234)
+
+
+def _away_from(x, lo=0.15):
+    """Push values away from 0 so kinked functions are locally smooth."""
+    return np.where(np.abs(x) < lo, lo * np.sign(x) + (x == 0) * lo, x)
+
+
+def _distinct(shape, lo=0.0, hi=1.0):
+    """Random values with all-distinct entries (no max/min ties)."""
+    n = int(np.prod(shape))
+    vals = np.linspace(lo, hi, n, dtype=np.float32)
+    return rng.permutation(vals).reshape(shape)
+
+
+def _sym_grads(sym, location, grad_nodes=None, out_grads=None):
+    """Bind, forward(train), backward; return grad dict."""
+    shapes = {k: v.shape for k, v in location.items()}
+    names = sym.list_arguments()
+    grad_nodes = grad_nodes or list(location)
+    req = {n: ("write" if n in grad_nodes else "null") for n in names}
+    ex = sym.simple_bind(mx.current_context(), grad_req=req, **shapes)
+    for k, v in location.items():
+        ex.arg_dict[k][:] = np.asarray(v, np.float32)
+    ex.forward(is_train=True)
+    ex.backward(out_grads)
+    return {k: ex.grad_dict[k].asnumpy() for k in grad_nodes}, \
+        [o.asnumpy() for o in ex.outputs]
+
+
+# ---------------------------------------------------------------- unary ----
+
+SMOOTH_UNARY = {
+    "exp": (lambda s: s.exp, -1.0, 1.0),
+    "log": (lambda s: s.log, 0.3, 2.0),
+    "sin": (lambda s: s.sin, -1.2, 1.2),
+    "cos": (lambda s: s.cos, -1.2, 1.2),
+    "sqrt": (lambda s: s.sqrt, 0.3, 2.0),
+    "rsqrt": (lambda s: s.rsqrt, 0.3, 2.0),
+    "square": (lambda s: s.square, -1.0, 1.0),
+    "abs": (lambda s: s.abs, None, None),   # needs away-from-zero input
+}
+
+
+@pytest.mark.parametrize("name", sorted(SMOOTH_UNARY))
+def test_unary_grad(name):
+    get, lo, hi = SMOOTH_UNARY[name]
+    x = mx.sym.Variable("x")
+    if lo is None:
+        data = _away_from(rng.uniform(-1, 1, (3, 4)).astype(np.float32))
+    else:
+        data = rng.uniform(lo, hi, (3, 4)).astype(np.float32)
+    check_numeric_gradient(get(mx.sym)(x), {"x": data}, numeric_eps=1e-3)
+
+
+@pytest.mark.parametrize("name", ["floor", "ceil", "round", "sign"])
+def test_step_unary_zero_grad(name):
+    """Piecewise-constant ops propagate exactly zero gradient
+    (reference mshadow_op.h: floor/ceil/round/sign grad functors)."""
+    x = mx.sym.Variable("x")
+    sym = getattr(mx.sym, name)(x)
+    data = rng.uniform(0.1, 0.9, (3, 4)).astype(np.float32) + 2.0
+    grads, _ = _sym_grads(sym, {"x": data})
+    assert np.all(grads["x"] == 0)
+
+
+# --------------------------------------------------------------- binary ----
+
+@pytest.mark.parametrize("name", ["plus", "minus", "mul", "div", "power",
+                                  "maximum", "minimum"])
+def test_binary_grad(name):
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    sym = getattr(mx.sym, name)(a, b)
+    if name == "power":
+        av = rng.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+        bv = rng.uniform(-1.0, 2.0, (3, 4)).astype(np.float32)
+    elif name in ("maximum", "minimum"):
+        av = _distinct((3, 4), 0.0, 1.0)
+        bv = _distinct((3, 4), 0.02, 1.02)  # offset grid: no exact ties
+    else:
+        av = rng.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+        bv = rng.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    check_numeric_gradient(sym, {"a": av, "b": bv}, numeric_eps=1e-3)
+
+
+@pytest.mark.parametrize("name", ["plus_scalar", "minus_scalar",
+                                  "rminus_scalar", "mul_scalar", "div_scalar",
+                                  "rdiv_scalar", "power_scalar",
+                                  "rpower_scalar", "maximum_scalar",
+                                  "minimum_scalar"])
+def test_scalar_op_grad(name):
+    x = mx.sym.Variable("x")
+    sym = getattr(mx.sym, name)(x, scalar=1.5)
+    data = rng.uniform(0.5, 1.3, (3, 4)).astype(np.float32)
+    if name in ("maximum_scalar", "minimum_scalar"):
+        data = _distinct((3, 4), 0.8, 2.2)  # straddle 1.5 without touching it
+        data = np.where(np.abs(data - 1.5) < 0.02, data + 0.05, data)
+    check_numeric_gradient(sym, {"x": data}, numeric_eps=1e-3)
+
+
+# ------------------------------------------------------------ broadcast ----
+
+@pytest.mark.parametrize("name", ["broadcast_plus", "broadcast_minus",
+                                  "broadcast_mul", "broadcast_div",
+                                  "broadcast_power"])
+def test_broadcast_binary_grad(name):
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    sym = getattr(mx.sym, name)(a, b)
+    av = rng.uniform(0.5, 2.0, (2, 3, 4)).astype(np.float32)
+    bv = rng.uniform(0.5, 2.0, (2, 1, 4)).astype(np.float32)
+    check_numeric_gradient(sym, {"a": av, "b": bv}, numeric_eps=1e-3)
+
+
+def test_broadcast_axis_grad():
+    x = mx.sym.Variable("x")
+    sym = mx.sym.broadcast_axis(x, axis=1, size=4)
+    check_numeric_gradient(
+        sym, {"x": rng.uniform(0.5, 1.5, (2, 1, 3)).astype(np.float32)})
+
+
+def test_broadcast_to_grad():
+    x = mx.sym.Variable("x")
+    sym = mx.sym.broadcast_to(x, shape=(2, 4, 3))
+    check_numeric_gradient(
+        sym, {"x": rng.uniform(0.5, 1.5, (2, 1, 3)).astype(np.float32)})
+
+
+# ----------------------------------------------------------- reductions ----
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("sum", {}),
+    ("sum_axis", {"axis": 1}),
+    ("max", {}),
+    ("max_axis", {"axis": 1}),
+    ("min", {}),
+    ("min_axis", {"axis": 1}),
+    ("norm", {}),
+])
+def test_reduction_grad(name, kwargs):
+    x = mx.sym.Variable("x")
+    sym = getattr(mx.sym, name)(x, **kwargs)
+    data = _distinct((3, 4, 2), 0.5, 2.0)
+    check_numeric_gradient(sym, {"x": data}, numeric_eps=1e-3)
+
+
+# --------------------------------------------------------------- matrix ----
+
+def test_dot_grad():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    check_numeric_gradient(mx.sym.dot(a, b), {
+        "a": rng.uniform(-1, 1, (3, 4)).astype(np.float32),
+        "b": rng.uniform(-1, 1, (4, 2)).astype(np.float32)})
+
+
+def test_batch_dot_grad():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    check_numeric_gradient(mx.sym.batch_dot(a, b), {
+        "a": rng.uniform(-1, 1, (2, 3, 4)).astype(np.float32),
+        "b": rng.uniform(-1, 1, (2, 4, 2)).astype(np.float32)})
+
+
+@pytest.mark.parametrize("make", [
+    lambda x: mx.sym.transpose(x, axes=(1, 0, 2)),
+    lambda x: mx.sym.expand_dims(x, axis=1),
+    lambda x: mx.sym.slice_axis(x, axis=1, begin=1, end=3),
+    lambda x: mx.sym.flip(x, axis=1),
+    lambda x: mx.sym.SwapAxis(x, dim1=0, dim2=2),
+    lambda x: mx.sym.Reshape(x, target_shape=(2, 12)),
+    lambda x: mx.sym.Flatten(x),
+])
+def test_shape_op_grad(make):
+    x = mx.sym.Variable("x")
+    data = rng.uniform(0.5, 1.5, (2, 3, 4)).astype(np.float32)
+    check_numeric_gradient(make(x), {"x": data})
+
+
+def test_crop_simpleop_grad():
+    x = mx.sym.Variable("x")
+    sym = mx.sym.crop(x, begin=(0, 1, 1), end=(2, 3, 3))
+    data = rng.uniform(0.5, 1.5, (2, 4, 4)).astype(np.float32)
+    check_numeric_gradient(sym, {"x": data})
+
+
+def test_concat_grad():
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    sym = mx.sym.Concat(a, b, num_args=2, dim=1)
+    check_numeric_gradient(sym, {
+        "a": rng.uniform(0.5, 1.5, (2, 2, 3)).astype(np.float32),
+        "b": rng.uniform(0.5, 1.5, (2, 4, 3)).astype(np.float32)})
+
+
+def test_slice_channel_grad():
+    x = mx.sym.Variable("x")
+    sym = mx.sym.SliceChannel(x, num_outputs=3)
+    data = rng.uniform(0.5, 1.5, (2, 6)).astype(np.float32)
+    # FD covers sum(outputs[0]); feed zero head grads to the other outputs
+    check_numeric_gradient(sym[0], {"x": data})
+
+
+def test_element_wise_sum_grad():
+    a, b, c = (mx.sym.Variable(n) for n in "abc")
+    sym = mx.sym.ElementWiseSum(a, b, c, num_args=3)
+    loc = {n: rng.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+           for n in "abc"}
+    check_numeric_gradient(sym, loc)
+
+
+# ------------------------------------------------------------- nn layers ----
+
+def test_fully_connected_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(x, num_hidden=5, name="fc")
+    check_numeric_gradient(sym, {
+        "data": rng.uniform(-1, 1, (4, 6)).astype(np.float32),
+        "fc_weight": rng.uniform(-1, 1, (5, 6)).astype(np.float32),
+        "fc_bias": rng.uniform(-1, 1, (5,)).astype(np.float32)})
+
+
+def test_fully_connected_nobias_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(x, num_hidden=5, no_bias=True, name="fc")
+    check_numeric_gradient(sym, {
+        "data": rng.uniform(-1, 1, (4, 6)).astype(np.float32),
+        "fc_weight": rng.uniform(-1, 1, (5, 6)).astype(np.float32)})
+
+
+@pytest.mark.parametrize("kwargs", [
+    {"kernel": (3, 3), "num_filter": 2, "pad": (1, 1)},
+    {"kernel": (2, 2), "num_filter": 2, "stride": (2, 2)},
+    {"kernel": (3, 3), "num_filter": 4, "num_group": 2, "pad": (1, 1)},
+])
+def test_convolution_grad(kwargs):
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Convolution(x, name="c", **kwargs)
+    cin = 2 if kwargs.get("num_group", 1) == 1 else 4
+    kh, kw = kwargs["kernel"]
+    loc = {"data": rng.uniform(-1, 1, (2, cin, 6, 6)).astype(np.float32),
+           "c_weight": rng.uniform(-0.5, 0.5,
+                                   (kwargs["num_filter"],
+                                    cin // kwargs.get("num_group", 1),
+                                    kh, kw)).astype(np.float32),
+           "c_bias": rng.uniform(-0.5, 0.5,
+                                 (kwargs["num_filter"],)).astype(np.float32)}
+    check_numeric_gradient(sym, loc, numeric_eps=1e-2, check_eps=0.08)
+
+
+def test_deconvolution_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Deconvolution(x, kernel=(3, 3), num_filter=2, stride=(2, 2),
+                               pad=(1, 1), name="dc")
+    loc = {"data": rng.uniform(-1, 1, (1, 2, 4, 4)).astype(np.float32),
+           "dc_weight": rng.uniform(-0.5, 0.5, (2, 2, 3, 3)).astype(np.float32)}
+    check_numeric_gradient(sym, loc, numeric_eps=1e-2, check_eps=0.08)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg", "sum"])
+def test_pooling_grad(pool_type):
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                         pool_type=pool_type)
+    data = _distinct((1, 2, 4, 4), 0.0, 4.0)
+    check_numeric_gradient(sym, {"data": data}, numeric_eps=1e-3)
+
+
+def test_lrn_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.LRN(x, nsize=3)
+    data = rng.uniform(0.5, 1.5, (1, 4, 3, 3)).astype(np.float32)
+    check_numeric_gradient(sym, {"data": data}, numeric_eps=1e-3)
+
+
+def test_l2_normalization_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.L2Normalization(x)
+    data = rng.uniform(0.5, 1.5, (3, 6)).astype(np.float32)
+    check_numeric_gradient(sym, {"data": data}, numeric_eps=1e-3)
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu"])
+def test_activation_grad(act):
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Activation(x, act_type=act)
+    data = rng.uniform(-2, 2, (3, 4)).astype(np.float32)
+    if act == "relu":
+        data = _away_from(data)
+    check_numeric_gradient(sym, {"data": data}, numeric_eps=1e-3)
+
+
+@pytest.mark.parametrize("act", ["leaky", "elu"])
+def test_leaky_relu_grad(act):
+    x = mx.sym.Variable("data")
+    sym = mx.sym.LeakyReLU(x, act_type=act, slope=0.3)
+    data = _away_from(rng.uniform(-2, 2, (3, 4)).astype(np.float32))
+    check_numeric_gradient(sym, {"data": data}, numeric_eps=1e-3)
+
+
+def test_prelu_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.LeakyReLU(x, act_type="prelu", name="pr")
+    data = _away_from(rng.uniform(-2, 2, (3, 4)).astype(np.float32))
+    gamma = rng.uniform(0.1, 0.4, (4,)).astype(np.float32)
+    check_numeric_gradient(sym, {"data": data, "pr_gamma": gamma},
+                           numeric_eps=1e-3)
+
+
+def test_softmax_activation_grad():
+    # sum(softmax(x)) is constant, so weight the outputs to get a
+    # non-degenerate objective before finite-differencing
+    x = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    sym = mx.sym.mul(mx.sym.SoftmaxActivation(x), w)
+    data = rng.uniform(-1, 1, (3, 5)).astype(np.float32)
+    wv = rng.uniform(0.5, 1.5, (3, 5)).astype(np.float32)
+    check_numeric_gradient(sym, {"data": data, "w": wv},
+                           grad_nodes=["data"], numeric_eps=1e-3)
+
+
+def test_batchnorm_grad():
+    # sum(BN(x)) is ~independent of data (normalization), so weight the
+    # outputs to make the FD objective sensitive to every input
+    x = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    sym = mx.sym.mul(mx.sym.BatchNorm(x, fix_gamma=False, name="bn"), w)
+    loc = {"data": rng.uniform(-1, 1, (4, 3)).astype(np.float32),
+           "bn_gamma": rng.uniform(0.5, 1.5, (3,)).astype(np.float32),
+           "bn_beta": rng.uniform(-0.5, 0.5, (3,)).astype(np.float32),
+           "w": rng.uniform(0.5, 1.5, (4, 3)).astype(np.float32)}
+    aux = {"bn_moving_mean": np.zeros(3, np.float32),
+           "bn_moving_var": np.ones(3, np.float32)}
+    check_numeric_gradient(sym, loc, aux_states=aux, numeric_eps=1e-3,
+                           check_eps=0.08, fd_is_train=True,
+                           grad_nodes=["data", "bn_gamma", "bn_beta"])
+
+
+def test_dropout_eval_identity_and_train_scale():
+    """Eval mode is the identity; train mode zeroes with keep-scale
+    (reference dropout-inl.h)."""
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Dropout(x, p=0.5)
+    data = rng.uniform(0.5, 1.5, (20, 20)).astype(np.float32)
+    ex = sym.simple_bind(mx.current_context(), grad_req="write",
+                         data=data.shape)
+    ex.arg_dict["data"][:] = data
+    ex.forward(is_train=False)
+    assert np.allclose(ex.outputs[0].asnumpy(), data, atol=1e-6)
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    kept = out != 0
+    assert 0.3 < kept.mean() < 0.7
+    assert np.allclose(out[kept], (data * 2)[kept], rtol=1e-5)
+
+
+def test_embedding_grad():
+    ids = mx.sym.Variable("ids")
+    sym = mx.sym.Embedding(ids, input_dim=7, output_dim=3, name="emb")
+    idv = rng.randint(0, 7, (4,)).astype(np.float32)
+    wv = rng.uniform(-1, 1, (7, 3)).astype(np.float32)
+    check_numeric_gradient(sym, {"ids": idv, "emb_weight": wv},
+                           grad_nodes=["emb_weight"])
+
+
+def test_upsampling_nearest_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.UpSampling(x, scale=2, sample_type="nearest")
+    data = rng.uniform(0.5, 1.5, (1, 2, 3, 3)).astype(np.float32)
+    check_numeric_gradient(sym, {"data": data})
+
+
+def test_upsampling_bilinear_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.UpSampling(x, scale=2, sample_type="bilinear",
+                            num_filter=2, name="up")
+    data = rng.uniform(0.5, 1.5, (1, 2, 3, 3)).astype(np.float32)
+    w = rng.uniform(0.1, 1.0, (2, 1, 4, 4)).astype(np.float32)
+    check_numeric_gradient(sym, {"data": data, "up_weight": w},
+                           numeric_eps=1e-2, check_eps=0.08)
+
+
+def test_spatial_transformer_grad():
+    x = mx.sym.Variable("data")
+    loc = mx.sym.Variable("loc")
+    sym = mx.sym.SpatialTransformer(x, loc, target_shape=(4, 4))
+    data = rng.uniform(0.5, 1.5, (2, 2, 5, 5)).astype(np.float32)
+    # identity-ish transform, interior sampling points: smooth neighborhood
+    theta = np.tile(np.array([0.7, 0.05, 0.03, -0.05, 0.7, 0.02],
+                             np.float32), (2, 1))
+    check_numeric_gradient(sym, {"data": data, "loc": theta},
+                           numeric_eps=1e-3, check_eps=0.08)
+
+
+def test_roi_pooling_grad():
+    x = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    sym = mx.sym.ROIPooling(x, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    data = _distinct((1, 2, 6, 6), 0.0, 5.0)
+    rv = np.array([[0, 0, 0, 4, 4], [0, 1, 1, 5, 5]], np.float32)
+    check_numeric_gradient(sym, {"data": data, "rois": rv},
+                           grad_nodes=["data"], numeric_eps=1e-3)
+
+
+def test_correlation_grad():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    sym = mx.sym.Correlation(a, b, kernel_size=1, max_displacement=1,
+                             stride1=1, stride2=1, pad_size=1)
+    av = rng.uniform(0.5, 1.5, (1, 2, 4, 4)).astype(np.float32)
+    bv = rng.uniform(0.5, 1.5, (1, 2, 4, 4)).astype(np.float32)
+    check_numeric_gradient(sym, {"a": av, "b": bv}, numeric_eps=1e-2,
+                           check_eps=0.08)
+
+
+def test_swapaxis_crop_op_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.Crop(x, offset=(1, 1), h_w=(3, 3), num_args=1)
+    data = rng.uniform(0.5, 1.5, (1, 2, 5, 5)).astype(np.float32)
+    check_numeric_gradient(sym, {"data": data})
+
+
+def test_identity_attach_kl_sparse_reg_grad():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.IdentityAttachKLSparseReg(x, sparseness_target=0.1,
+                                           penalty=0.001)
+    data = rng.uniform(0.1, 0.9, (3, 4)).astype(np.float32)
+    grads, outs = _sym_grads(sym, {"data": data})
+    assert np.allclose(outs[0], data, atol=1e-6)  # identity forward
+    assert grads["data"].shape == data.shape
+
+
+# --------------------------------------------------------------- losses ----
+
+def test_block_grad_zero():
+    x = mx.sym.Variable("x")
+    sym = mx.sym.BlockGrad(x)
+    data = rng.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+    grads, outs = _sym_grads(sym, {"x": data})
+    assert np.allclose(outs[0], data)
+    assert np.all(grads["x"] == 0)
+
+
+def test_softmax_output_analytic_grad():
+    """Backward = softmax(pred) - onehot(label), scaled by grad_scale
+    (reference softmax_output-inl.h)."""
+    x = mx.sym.Variable("data")
+    lab = mx.sym.Variable("softmax_label")
+    sym = mx.sym.SoftmaxOutput(x, lab, name="softmax")
+    data = rng.uniform(-1, 1, (4, 5)).astype(np.float32)
+    label = rng.randint(0, 5, (4,)).astype(np.float32)
+    grads, outs = _sym_grads(sym, {"data": data, "softmax_label": label},
+                             grad_nodes=["data"])
+    prob = outs[0]
+    expect = prob.copy()
+    expect[np.arange(4), label.astype(int)] -= 1.0
+    assert reldiff(grads["data"], expect) < 1e-4
+
+
+def test_softmax_output_ignore_label():
+    x = mx.sym.Variable("data")
+    lab = mx.sym.Variable("softmax_label")
+    sym = mx.sym.SoftmaxOutput(x, lab, use_ignore=True, ignore_label=2,
+                               name="softmax")
+    data = rng.uniform(-1, 1, (4, 5)).astype(np.float32)
+    label = np.array([0, 2, 1, 2], np.float32)
+    grads, _ = _sym_grads(sym, {"data": data, "softmax_label": label},
+                          grad_nodes=["data"])
+    assert np.all(grads["data"][1] == 0)
+    assert np.all(grads["data"][3] == 0)
+    assert np.any(grads["data"][0] != 0)
+
+
+def test_regression_output_grads():
+    """LinearRegression: pred - label; MAERegression: sign(pred - label);
+    LogisticRegression: sigmoid(pred) - label (reference
+    regression_output-inl.h BackwardOp definitions)."""
+    data = rng.uniform(-1, 1, (4, 3)).astype(np.float32)
+    label = rng.uniform(-1, 1, (4, 3)).astype(np.float32)
+    x = mx.sym.Variable("data")
+    y = mx.sym.Variable("label")
+
+    num_output = data.shape[1]  # grad is scaled by grad_scale/num_output
+
+    g, _ = _sym_grads(mx.sym.LinearRegressionOutput(x, y, name="lro"),
+                      {"data": data, "label": label}, grad_nodes=["data"])
+    assert reldiff(g["data"], (data - label) / num_output) < 1e-4
+
+    g, _ = _sym_grads(mx.sym.MAERegressionOutput(x, y, name="mae"),
+                      {"data": data, "label": label}, grad_nodes=["data"])
+    assert reldiff(g["data"], np.sign(data - label) / num_output) < 1e-4
+
+    g, _ = _sym_grads(mx.sym.LogisticRegressionOutput(x, y, name="lgr"),
+                      {"data": data, "label": label}, grad_nodes=["data"])
+    sig = 1.0 / (1.0 + np.exp(-data))
+    assert reldiff(g["data"], (sig - label) / num_output) < 1e-4
+
+
+def test_svm_output_grad_shape():
+    x = mx.sym.Variable("data")
+    y = mx.sym.Variable("label")
+    sym = mx.sym.SVMOutput(x, y, name="svm")
+    data = rng.uniform(-1, 1, (4, 3)).astype(np.float32)
+    label = rng.randint(0, 3, (4,)).astype(np.float32)
+    g, _ = _sym_grads(sym, {"data": data, "label": label},
+                      grad_nodes=["data"])
+    assert g["data"].shape == data.shape
+    assert np.any(g["data"] != 0)
+
+
+def test_make_loss_grad():
+    """MakeLoss backward emits grad_scale regardless of head grads
+    (reference make_loss-inl.h)."""
+    x = mx.sym.Variable("x")
+    loss = mx.sym.MakeLoss(mx.sym.square(x))
+    data = rng.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+    grads, _ = _sym_grads(loss, {"x": data})
+    assert reldiff(grads["x"], 2 * data) < 1e-4
+
+
+def test_smooth_l1_grad():
+    x = mx.sym.Variable("x")
+    sym = mx.sym.smooth_l1(x, sigma=1.0)
+    data = _away_from(rng.uniform(-2, 2, (3, 4)).astype(np.float32), lo=0.2)
+    data = np.where(np.abs(np.abs(data) - 1.0) < 0.05, data * 1.2, data)
+    check_numeric_gradient(sym, {"x": data}, numeric_eps=1e-3)
+
+
+def test_softmax_cross_entropy_grad():
+    x = mx.sym.Variable("x")
+    lab = mx.sym.Variable("label")
+    sym = mx.sym.softmax_cross_entropy(x, lab)
+    data = rng.uniform(-1, 1, (4, 5)).astype(np.float32)
+    label = rng.randint(0, 5, (4,)).astype(np.float32)
+    g, _ = _sym_grads(sym, {"x": data, "label": label}, grad_nodes=["x"])
+    e = np.exp(data - data.max(1, keepdims=True))
+    prob = e / e.sum(1, keepdims=True)
+    expect = prob.copy()
+    expect[np.arange(4), label.astype(int)] -= 1.0
+    assert reldiff(g["x"], expect) < 1e-3
+
+
+def test_cast_forward_and_grad_pass_through():
+    x = mx.sym.Variable("x")
+    sym = mx.sym.Cast(x, dtype="float16")
+    data = rng.uniform(0.5, 1.5, (3, 4)).astype(np.float32)
+    grads, outs = _sym_grads(sym, {"x": data})
+    assert outs[0].dtype == np.float16
+    assert np.allclose(grads["x"], np.ones_like(data))
+
+
+def test_argmax_channel_zero_grad():
+    x = mx.sym.Variable("x")
+    sym = mx.sym.argmax_channel(x)
+    data = _distinct((3, 4), 0.0, 1.0)
+    grads, _ = _sym_grads(sym, {"x": data})
+    assert np.all(grads["x"] == 0)

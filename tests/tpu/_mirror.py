@@ -1,0 +1,33 @@
+"""Shared plumbing for the TPU mirror suites.
+
+Reference trick being reproduced: tests/python/gpu/test_operator_gpu.py
+does ``from test_operator import *`` and swaps the default context so the
+whole CPU unit suite re-executes on the accelerator.  Here the swap is the
+``_run_on_tpu`` autouse fixture in conftest.py; this module just makes the
+CPU test modules importable and centralizes the hardware gate.
+"""
+import os
+import sys
+
+import pytest
+
+_TESTS_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for _p in (_TESTS_DIR, os.path.join(_TESTS_DIR, "common")):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+sys.path.insert(0, os.path.dirname(_TESTS_DIR))
+
+
+def tpu_gate():
+    """skipif marker: active only under MXNET_TPU_TESTS=1 with a real chip."""
+    if os.environ.get("MXNET_TPU_TESTS") == "1":
+        try:
+            import jax
+            have = any(d.platform != "cpu" for d in jax.devices())
+        except Exception:
+            have = False
+    else:
+        have = False
+    return pytest.mark.skipif(
+        not have,
+        reason="TPU suite is opt-in: MXNET_TPU_TESTS=1 pytest tests/tpu/")

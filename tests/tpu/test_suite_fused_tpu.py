@@ -1,0 +1,10 @@
+"""TPU re-run of tests/test_fused.py (reference: tests/python/gpu/
+test_operator_gpu.py re-collects the unit suite on the accelerator)."""
+from _mirror import tpu_gate
+
+pytestmark = tpu_gate()
+
+from test_fused import *  # noqa: F401,F403,E402
+
+# needs the 8-device CPU mesh; the TPU session exposes a single host device
+del test_fused_multi_device_matches_single  # noqa: F821

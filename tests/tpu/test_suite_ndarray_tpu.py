@@ -1,0 +1,10 @@
+"""TPU re-run of tests/test_ndarray.py (reference: tests/python/gpu/
+test_operator_gpu.py re-collects the unit suite on the accelerator)."""
+from _mirror import tpu_gate
+
+pytestmark = tpu_gate()
+
+from test_ndarray import *  # noqa: F401,F403,E402
+
+# needs multiple host devices; the TPU session exposes a single one
+del test_multi_cpu_devices  # noqa: F821
