@@ -75,6 +75,17 @@ class BucketSentenceIter(DataIter):
     def provide_label(self):
         return [(self.label_name, (self.batch_size, self.default_bucket_key))]
 
+    def provide_bucket_shapes(self):
+        """Per-bucket (key, data_shapes, label_shapes) for
+        BucketingModule.prepare: compile every bucket before the loop."""
+        out = []
+        for b in self.buckets:
+            data_shapes = [(self.data_name, (self.batch_size, b))] + \
+                list(self.init_states)
+            label_shapes = [(self.label_name, (self.batch_size, b))]
+            out.append((b, data_shapes, label_shapes))
+        return out
+
     def make_data_iter_plan(self):
         bucket_n_batches = []
         for i in range(len(self.data)):

@@ -42,6 +42,11 @@ def main():
     mod = mx.mod.BucketingModule(sym_gen,
                                  default_bucket_key=data_train.default_bucket_key,
                                  context=ctx)
+    # pre-compile all bucket programs off the hot loop (docs/bucketing.md)
+    mod.bind(data_shapes=data_train.provide_data,
+             label_shapes=data_train.provide_label)
+    mod.init_params()
+    mod.prepare(data_train.provide_bucket_shapes())
     mod.fit(data_train, num_epoch=args.num_epochs,
             eval_metric=mx.metric.CrossEntropy(),
             optimizer="sgd",

@@ -47,6 +47,12 @@ def main():
     mod = mx.mod.BucketingModule(sym_gen,
                                  default_bucket_key=data_train.default_bucket_key,
                                  context=ctx)
+    # compile every bucket's program before the hot loop: no mid-epoch
+    # XLA-compile stalls when a new sequence length first appears
+    mod.bind(data_shapes=data_train.provide_data,
+             label_shapes=data_train.provide_label)
+    mod.init_params()
+    mod.prepare(data_train.provide_bucket_shapes())
     mod.fit(data_train, num_epoch=args.num_epochs,
             eval_metric="ce",
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),

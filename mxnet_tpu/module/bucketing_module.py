@@ -136,6 +136,50 @@ class BucketingModule(BaseModule):
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
 
+    def prepare(self, bucket_shapes):
+        """Pre-bind and pre-compile bucket executables off the hot loop.
+
+        The reference kept bucket switching cheap through the shared
+        memory pool (graph_executor.h:50-56 shared_exec); here each bucket
+        is its own jit-compiled program, so the first batch of a new
+        bucket inside the training loop would otherwise stall on full XLA
+        compilation.  ``prepare`` pays those compiles up front by binding
+        every bucket and driving one zero-batch through its
+        forward(+backward when bound for training) path.
+
+        Parameters
+        ----------
+        bucket_shapes : dict bucket_key -> (data_shapes, label_shapes)
+            or iterable of (bucket_key, data_shapes, label_shapes).
+            Shapes use the usual [(name, shape), ...] form; label_shapes
+            may be None.
+        """
+        assert self.binded and self.params_initialized, \
+            "call bind and init_params before prepare"
+        from ..io import DataBatch
+        from ..ndarray import zeros as nd_zeros, waitall
+
+        if isinstance(bucket_shapes, dict):
+            items = [(k, v[0], v[1]) for k, v in bucket_shapes.items()]
+        else:
+            items = [tuple(it) for it in bucket_shapes]
+
+        keep = self._curr_module
+        for key, data_shapes, label_shapes in items:
+            self.switch_bucket(key, data_shapes, label_shapes)
+            mod = self._curr_module
+            batch = DataBatch(
+                data=[nd_zeros(s) for _, s in data_shapes],
+                label=[nd_zeros(s) for _, s in (label_shapes or [])],
+                bucket_key=key,
+                provide_data=list(data_shapes),
+                provide_label=list(label_shapes) if label_shapes else None)
+            mod.forward(batch, is_train=self.for_training)
+            if self.for_training:
+                mod.backward()
+        waitall()
+        self._curr_module = keep
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=None, force_init=False):
         assert self.binded and self.params_initialized

@@ -74,6 +74,10 @@ class Module(BaseModule):
         self._fused_key = None
         self._monitor_installed = False
         self._borrowed_optimizer = False
+        # set when this module's exec group is lent to a sibling (bucketing):
+        # the shared arrays are then the single source of truth, so the
+        # private donated fused state must never engage
+        self._lent_exec_group = False
 
     # -- properties ----------------------------------------------------------
     @property
@@ -195,7 +199,11 @@ class Module(BaseModule):
                 shared_module.binded and shared_module.params_initialized
             # the shared parent's exec-group arrays become the single
             # source of truth for every sibling (bucketing); its private
-            # donated fused state would silently diverge from them
+            # donated fused state would silently diverge from them.  The
+            # flag also keeps a later init_optimizer from re-engaging
+            # fusion on the parent (prepare() binds siblings before the
+            # optimizer exists, when _disable_fused is still a no-op).
+            shared_module._lent_exec_group = True
             shared_module._disable_fused("executor shared with %r"
                                          % getattr(self._symbol, "name", ""))
             shared_group = shared_module._exec_group
@@ -220,6 +228,7 @@ class Module(BaseModule):
     def _reset_bind(self):
         self.binded = False
         self._exec_group = None
+        self._lent_exec_group = False
 
     def reshape(self, data_shapes, label_shapes=None):
         """Re-bind to new input shapes (e.g. a different batch size)
@@ -313,6 +322,11 @@ class Module(BaseModule):
         if getattr(self, "_grad_req", "write") != "write":
             return False
         if self._monitor_installed or self._borrowed_optimizer:
+            return False
+        # exec group lent to a sibling (bucketing): stay on the classic
+        # path — the fused state is private and siblings would train on
+        # stale shared arrays
+        if self._lent_exec_group:
             return False
         if self._exec_group is None or self._exec_group.shared_group is not None:
             return False

@@ -310,3 +310,98 @@ def test_module_reshape_keeps_grad_req():
     g2 = [g[0].asnumpy() for g in mod._exec_group.grad_arrays]
     for a, b in zip(g1, g2):
         assert np.allclose(2 * a, b, atol=1e-5), "grad_req='add' lost"
+
+
+def test_bucketing_prepare_precompiles():
+    """prepare() binds and warms every bucket before the training loop
+    (the shared-pool switching-cost answer: docs/bucketing.md)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    def sym_gen(seq_len):
+        # params are seq-len independent (real bucketing's property)
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=10, output_dim=8, name="emb")
+        feat = mx.sym.sum_axis(emb, axis=1)
+        net = mx.sym.FullyConnected(feat, num_hidden=2, name="out")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.current_context())
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.prepare({k: ([("data", (8, k))], [("softmax_label", (8,))])
+                 for k in (4, 6)})
+    # every bucket bound, each executor's train program already compiled
+    assert set(mod._buckets.keys()) == {8, 4, 6}
+    for key in (4, 6):
+        for ex in mod._buckets[key]._exec_group.execs:
+            assert ex._jit_cache, key
+    # prepare must not disturb the current module or training
+    assert mod._curr_module is mod._buckets[8]
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    from mxnet_tpu.io import DataBatch
+    params_before = {k: v.asnumpy().copy()
+                     for k, v in mod.get_params()[0].items()}
+    for key in (4, 8, 6):
+        X = np.random.randint(0, 10, (8, key)).astype(np.float32)
+        y = (X.sum(axis=1) > key * 4.5).astype(np.float32)
+        b = DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)],
+                      bucket_key=key, pad=0,
+                      provide_data=[("data", (8, key))],
+                      provide_label=[("softmax_label", (8,))])
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+    params_after = mod.get_params()[0]
+    assert any(np.abs(params_after[k].asnumpy() - params_before[k]).max() > 0
+               for k in params_before)
+
+
+def test_bucketing_prepare_keeps_shared_params_consistent():
+    """prepare() before init_optimizer must not let the lent-out default
+    bucket re-engage the private fused path: a prepared run and a
+    lazy-bind run of the same batches train identical parameters."""
+    def run(prepared):
+        np.random.seed(3)
+        mx.random.seed(3)
+
+        def sym_gen(seq_len):
+            data = mx.sym.Variable("data")
+            emb = mx.sym.Embedding(data, input_dim=10, output_dim=8,
+                                   name="emb")
+            feat = mx.sym.sum_axis(emb, axis=1)
+            net = mx.sym.FullyConnected(feat, num_hidden=2, name="out")
+            return mx.sym.SoftmaxOutput(net, name="softmax")
+
+        mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                     context=mx.current_context())
+        mod.bind(data_shapes=[("data", (8, 8))],
+                 label_shapes=[("softmax_label", (8,))])
+        mod.init_params()
+        if prepared:
+            mod.prepare({k: ([("data", (8, k))], [("softmax_label", (8,))])
+                         for k in (4, 6)})
+        mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+        if prepared:
+            # exec group already lent to the prepared buckets: fusion must
+            # not re-engage (the lazy path tears it down at first switch)
+            assert mod._buckets[8]._fused is None
+        from mxnet_tpu.io import DataBatch
+        for key in (8, 8, 4, 8, 6):
+            X = np.random.randint(0, 10, (8, key)).astype(np.float32)
+            y = (X.sum(axis=1) > key * 4.5).astype(np.float32)
+            b = DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)],
+                          bucket_key=key, pad=0,
+                          provide_data=[("data", (8, key))],
+                          provide_label=[("softmax_label", (8,))])
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    pa = run(prepared=True)
+    pb = run(prepared=False)
+    for k in pb:
+        assert np.abs(pa[k] - pb[k]).max() < 1e-6, k
