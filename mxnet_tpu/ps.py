@@ -136,7 +136,12 @@ def stripe_ranges(size: int, num_servers: int):
 
 class Scheduler:
     """Rendezvous point: servers register their listen address, workers
-    fetch the server list and ranks; also implements the worker barrier."""
+    fetch the server list and ranks; also implements the worker barrier
+    and dead-peer detection.  A role that disconnects WITHOUT sending
+    "stop" is dead (TCP EOF fires on any process death, incl. kill -9);
+    the scheduler then broadcasts ("abort", reason) to every live role so
+    the job fails fast with a clear message instead of hanging (the
+    reference job simply hung on node death — SURVEY §5.3)."""
 
     def __init__(self, num_workers: int, num_servers: int, addr=None):
         self.num_workers = num_workers
@@ -149,6 +154,10 @@ class Scheduler:
         self._barrier_conns = []
         self._worker_ranks = 0
         self._server_ranks = 0
+        # conn -> (role, rank, send-lock); abort broadcast needs both the
+        # roster and per-conn write serialization (replies race otherwise)
+        self._roster = {}
+        self._abort_reason = None
 
     def serve_forever(self):
         threads = []
@@ -156,16 +165,53 @@ class Scheduler:
         # has sent "stop" and every connection closed.
         conns_expected = self.num_workers + self.num_servers
         for _ in range(conns_expected):
-            conn = self.listener.accept()
+            try:
+                conn = self.listener.accept()
+            except (OSError, EOFError):
+                break   # listener closed by _abort during rendezvous
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
             t.join()
-        self.listener.close()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+        if self._abort_reason:
+            raise RuntimeError("ps job aborted: %s" % self._abort_reason)
+
+    def _send(self, conn, msg):
+        entry = self._roster.get(id(conn))
+        lock = entry[2] if entry else threading.Lock()
+        try:
+            with lock:
+                conn.send(msg)
+        except (BrokenPipeError, OSError):
+            pass
+
+    def _abort(self, reason):
+        with self._lock:
+            if self._abort_reason is not None:
+                return
+            self._abort_reason = reason
+            self._barrier_conns = []   # their conns are in the roster too
+            targets = list(self._roster.values())
+        logging.getLogger(__name__).error("aborting ps job: %s", reason)
+        self._servers_ready.set()   # unpark reg_worker waiters (they
+                                    # re-check _abort_reason after the wait)
+        for entry in targets:
+            self._send(entry[3], ("abort", reason))
+        # unblock serve_forever if the rendezvous never completed
+        try:
+            self.listener.close()
+        except OSError:
+            pass
 
     def _handle(self, conn):
+        role, rank = "unknown", -1
+        clean_exit = False
         try:
             while True:
                 try:
@@ -175,31 +221,57 @@ class Scheduler:
                 kind = msg[0]
                 if kind == "reg_server":
                     with self._lock:
+                        if self._abort_reason is not None:
+                            self._send(conn, ("abort", self._abort_reason))
+                            continue
                         rank = self._server_ranks
                         self._server_ranks += 1
                         self.server_addrs[rank] = msg[1]
+                        role = "server"
+                        self._roster[id(conn)] = (role, rank,
+                                                  threading.Lock(), conn)
                         if all(a is not None for a in self.server_addrs):
                             self._servers_ready.set()
-                    conn.send(("rank", rank))
+                    self._send(conn, ("rank", rank))
                 elif kind == "reg_worker":
-                    self._servers_ready.wait()
+                    self._servers_ready.wait()   # set by _abort too
                     with self._lock:
+                        if self._abort_reason is not None:
+                            self._send(conn, ("abort", self._abort_reason))
+                            continue
                         rank = self._worker_ranks
                         self._worker_ranks += 1
-                    conn.send(("servers", list(self.server_addrs), rank))
+                        role = "worker"
+                        self._roster[id(conn)] = (role, rank,
+                                                  threading.Lock(), conn)
+                    self._send(conn, ("servers", list(self.server_addrs),
+                                      rank))
                 elif kind == "barrier":
                     release = []
                     with self._lock:
-                        self._barrier_conns.append(conn)
-                        if len(self._barrier_conns) == self.num_workers:
-                            release = self._barrier_conns
-                            self._barrier_conns = []
+                        if self._abort_reason is not None:
+                            reason = self._abort_reason
+                        else:
+                            reason = None
+                            self._barrier_conns.append(conn)
+                            if len(self._barrier_conns) == self.num_workers:
+                                release = self._barrier_conns
+                                self._barrier_conns = []
+                    if reason is not None:
+                        self._send(conn, ("abort", reason))
+                        continue
                     for c in release:
-                        c.send(("barrier_ok",))
+                        self._send(c, ("barrier_ok",))
                 elif kind == "stop":
-                    conn.send(("bye",))
+                    clean_exit = True
+                    self._send(conn, ("bye",))
                     return
         finally:
+            with self._lock:
+                self._roster.pop(id(conn), None)
+            if not clean_exit and self._abort_reason is None:
+                self._abort("%s rank %d disconnected without stop "
+                            "(process died?)" % (role, rank))
             conn.close()
 
 
@@ -272,31 +344,65 @@ class PSServer:
         # register with the scheduler
         sched = _connect_retry(root or _root_addr())
         sched.send(("reg_server", self.addr))
-        self.rank = sched.recv()[1]
+        msg = sched.recv()
+        if isinstance(msg, tuple) and msg and msg[0] == "abort":
+            # a peer died while we were registering
+            raise RuntimeError("ps job aborted by scheduler: %s" % msg[1])
+        self.rank = msg[1]
         self._sched = sched
 
     def serve_forever(self):
         """Run the executor on this (main) thread; accept one connection
-        per worker on a helper thread; exit when all workers stopped."""
+        per worker on a helper thread; exit when all workers stopped.  A
+        scheduler abort broadcast (dead peer) tears the server down and
+        exits with an error instead of waiting on dead workers."""
         stop = threading.Event()
+        abort_reason = []
 
         def acceptor():
             threads = []
-            for _ in range(self.num_workers):
-                conn = self.listener.accept()
-                t = threading.Thread(target=self._handle, args=(conn,),
-                                     daemon=True)
-                t.start()
-                threads.append(t)
+            try:
+                for _ in range(self.num_workers):
+                    conn = self.listener.accept()
+                    t = threading.Thread(target=self._handle, args=(conn,),
+                                         daemon=True)
+                    t.start()
+                    threads.append(t)
+            except (OSError, EOFError):
+                pass   # listener closed by the abort monitor
             for t in threads:
                 t.join()
             stop.set()
             self._exec.wake()
 
+        def abort_monitor():
+            while not stop.is_set():
+                try:
+                    if self._sched.poll(0.5):
+                        msg = self._sched.recv()
+                        if isinstance(msg, tuple) and msg and \
+                                msg[0] == "abort":
+                            abort_reason.append(msg[1])
+                            logging.getLogger(__name__).error(
+                                "server rank %d aborting: %s",
+                                self.rank, msg[1])
+                            stop.set()
+                            self._exec.wake()
+                            self.listener.close()
+                            return
+                except (EOFError, OSError):
+                    return   # scheduler gone; acceptor/stop path decides
+
         accept_thread = threading.Thread(target=acceptor, daemon=True)
         accept_thread.start()
+        monitor_thread = threading.Thread(target=abort_monitor, daemon=True)
+        monitor_thread.start()
         self._exec.run_until(stop)
+        if abort_reason:
+            raise RuntimeError("ps server rank %d aborted: %s"
+                               % (self.rank, abort_reason[0]))
         accept_thread.join()
+        monitor_thread.join()
         self.listener.close()
         try:
             self._sched.send(("stop",))
@@ -395,12 +501,21 @@ class PSWorkerClient:
         self._conns = [_connect_retry(a) for a in self.server_addrs]
         self._locks = [threading.Lock() for _ in self._conns]
         self._sched_lock = threading.Lock()
+        self._closed = False
+        # the stop handshake distinguishes a clean exit from a death (the
+        # scheduler aborts the job on EOF-without-stop).  Most training
+        # scripts never call kv.close() themselves (reference parity), so
+        # make interpreter exit clean automatically; a crash or os._exit
+        # still skips this and is correctly treated as a death.
+        import atexit
+        atexit.register(self.close)
 
     @staticmethod
     def _recv(conn, what):
         """Bounded recv: a dead server/scheduler turns into a clear error
         instead of an indefinite hang (the reference job simply hung on
-        node death, SURVEY §5.3 — we can do better than that)."""
+        node death, SURVEY §5.3 — we can do better than that).  A
+        scheduler-broadcast ("abort", reason) surfaces as RuntimeError."""
         timeout = float(os.environ.get("MXNET_PS_RECV_TIMEOUT", "600"))
         if not conn.poll(timeout):
             raise RuntimeError(
@@ -408,10 +523,36 @@ class PSWorkerClient:
                 "(server process dead? raise MXNET_PS_RECV_TIMEOUT if not)"
                 % (timeout, what))
         try:
-            return conn.recv()
+            msg = conn.recv()
         except (EOFError, OSError) as e:
             raise RuntimeError(
                 "parameter-server connection lost while waiting for %s: %s"
+                % (what, e))
+        if isinstance(msg, tuple) and msg and msg[0] == "abort":
+            raise RuntimeError("ps job aborted by scheduler: %s" % msg[1])
+        return msg
+
+    def check_abort(self):
+        """Poll the scheduler connection for a pending abort broadcast;
+        raises RuntimeError if the job is being torn down.  Called from
+        the data plane so a worker that never reaches another barrier
+        still fails fast when a peer dies."""
+        with self._sched_lock:
+            if self._sched.poll(0):
+                msg = self._sched.recv()
+                if isinstance(msg, tuple) and msg and msg[0] == "abort":
+                    raise RuntimeError(
+                        "ps job aborted by scheduler: %s" % msg[1])
+
+    @staticmethod
+    def _send(conn, msg, what):
+        """Clean error instead of a raw socket exception when the peer
+        is gone (server torn down by a scheduler abort)."""
+        try:
+            conn.send(msg)
+        except (BrokenPipeError, ConnectionResetError, OSError) as e:
+            raise RuntimeError(
+                "parameter-server connection lost while sending %s: %s"
                 % (what, e))
 
     # -- placement ----------------------------------------------------------
@@ -427,21 +568,24 @@ class PSWorkerClient:
         flat = np.ascontiguousarray(value).reshape(-1)
         for s, lo, hi in self._plan(key, flat.size):
             with self._locks[s]:
-                self._conns[s].send(("init", key, flat[lo:hi]))
+                self._send(self._conns[s], ("init", key, flat[lo:hi]),
+                           "init")
                 self._recv(self._conns[s], "init ack")
 
     def push(self, key, value: np.ndarray):
+        self.check_abort()
         flat = np.ascontiguousarray(value).reshape(-1)
         for s, lo, hi in self._plan(key, flat.size):
             with self._locks[s]:
-                self._conns[s].send(("push", key, flat[lo:hi]))
+                self._send(self._conns[s], ("push", key, flat[lo:hi]),
+                           "push")
 
     def pull(self, key, shape, dtype) -> np.ndarray:
         size = int(np.prod(shape)) if shape else 1
         out = np.empty(size, dtype)
         for s, lo, hi in self._plan(key, size):
             with self._locks[s]:
-                self._conns[s].send(("pull", key))
+                self._send(self._conns[s], ("pull", key), "pull request")
                 out[lo:hi] = self._recv(self._conns[s], "pull reply")[1]
         return out.reshape(shape)
 
@@ -449,15 +593,18 @@ class PSWorkerClient:
     def send_command_to_servers(self, head, body):
         for s in range(self.num_servers):
             with self._locks[s]:
-                self._conns[s].send(("cmd", head, body))
+                self._send(self._conns[s], ("cmd", head, body), "command")
                 self._recv(self._conns[s], "command ack")
 
     def barrier(self):
         with self._sched_lock:
-            self._sched.send(("barrier",))
+            self._send(self._sched, ("barrier",), "barrier request")
             self._recv(self._sched, "barrier release")
 
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
         for s in range(self.num_servers):
             try:
                 with self._locks[s]:

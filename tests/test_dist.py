@@ -63,3 +63,32 @@ def test_dist_async_kvstore_2workers_2servers():
                   port=9095)
     assert res.returncode == 0, res.stdout + res.stderr
     assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr
+
+
+def test_dist_async_worker_death_fails_fast():
+    """Kill a worker mid-job: the scheduler's dead-peer detection must
+    abort the job quickly with a clean message (no hang)."""
+    import time
+    t0 = time.monotonic()
+    res = _launch(2, "tests/nightly/dist_async_worker_death.py", servers=1,
+                  port=9094, timeout=120)
+    elapsed = time.monotonic() - t0
+    assert res.returncode != 0, res.stdout + res.stderr
+    # dead-peer detection fired at the scheduler...
+    assert "aborting ps job" in res.stderr, res.stdout + res.stderr
+    # ...and the surviving worker failed with its own clean message
+    assert "ABORT-DETECTED rank 0" in res.stdout, res.stdout + res.stderr
+    # the abort broadcast, not the 600s RPC-timeout fallback, must be
+    # what ends the job
+    assert elapsed < 60, elapsed
+
+
+def test_dist_async_clean_exit_without_close():
+    """A worker that never calls kv.close() (Module.fit never does) must
+    exit cleanly via the atexit stop handshake — not trip the dead-peer
+    abort."""
+    res = _launch(2, "tests/nightly/dist_async_noclose.py", servers=1,
+                  port=9098, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr
+    assert "aborting ps job" not in res.stderr, res.stderr

@@ -64,10 +64,41 @@ def local_launch(args, cmd):
             worker_env["DMLC_ROLE"] = "worker"
             worker_env["DMLC_NUM_WORKER"] = str(args.num_workers)
             procs.append(subprocess.Popen(cmd, shell=True, env=worker_env))
+    # fail fast: the first role to exit non-zero takes the job down
+    # (reference behavior was to hang until every process was killed by
+    # hand with tools/kill-mxnet.py)
+    import time
     code = 0
+    term_deadline = None
+    kill_deadline = None
     try:
-        for p in procs:
-            code = p.wait() or code
+        pending = list(procs)
+        while pending:
+            for p in list(pending):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                pending.remove(p)
+                if rc != 0 and code == 0:
+                    code = rc
+                    sys.stderr.write(
+                        "launch.py: role pid %d exited with code %d; "
+                        "taking the job down\n" % (p.pid, rc))
+                    # grace period first: the scheduler's abort broadcast
+                    # lets every role exit with its own clean error;
+                    # SIGTERM (then SIGKILL) is only the backstop
+                    term_deadline = time.monotonic() + 10
+            now = time.monotonic()
+            if term_deadline is not None and now > term_deadline:
+                for q in pending:
+                    q.send_signal(signal.SIGTERM)
+                term_deadline = None
+                kill_deadline = now + 20
+            if kill_deadline is not None and now > kill_deadline:
+                for q in pending:
+                    q.kill()
+                kill_deadline = None
+            time.sleep(0.2)
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGTERM)
