@@ -1,0 +1,126 @@
+"""Bucketing-API sanity check on MNIST (reference
+example/image-classification/mnist_bucket.py).
+
+Every bucket uses the same MLP; batches are randomly assigned a bucket key
+and duplicated k times for bucket k, exercising per-bucket executors with
+shared parameters and different batch sizes.  --synthetic generates the
+digits so the script runs without the MNIST files (CI-light mode).
+"""
+import argparse
+import logging
+import os
+import sys
+from copy import deepcopy
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_mlp
+
+
+class BucketIter(mx.io.DataIter):
+    """Wrap a flat iterator: each batch gets a random bucket key k and is
+    duplicated k times (reference mnist_bucket.py BucketIter)."""
+
+    def __init__(self, data_iter, buckets):
+        # no super().__init__(): the base sets a batch_size attribute that
+        # this class exposes as a delegating property instead
+        self.data_iter = data_iter
+        self.buckets = buckets
+        self.default_bucket_key = buckets[0]
+        self.stats = np.zeros(len(buckets))
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    @property
+    def batch_size(self):
+        return self.data_iter.batch_size
+
+    def reset(self):
+        self.data_iter.reset()
+
+    def __iter__(self):
+        def scale(shape, k):
+            return (shape[0] * k,) + tuple(shape[1:])
+
+        for batch in self.data_iter:
+            key = int(np.random.choice(self.buckets))
+            self.stats[self.buckets.index(key)] += 1
+            out = batch
+            if key > 1:
+                out = mx.io.DataBatch(
+                    data=[mx.nd.array(np.tile(d.asnumpy(), (key,) + (1,) *
+                                              (d.ndim - 1)))
+                          for d in batch.data],
+                    label=[mx.nd.array(np.tile(l.asnumpy(), key))
+                           for l in batch.label],
+                    pad=batch.pad, index=batch.index)
+                out.provide_data = [(n, scale(s, key)) for n, s in
+                                    deepcopy(self.provide_data)]
+                out.provide_label = [(n, scale(s, key)) for n, s in
+                                     deepcopy(self.provide_label)]
+            else:
+                out.provide_data = deepcopy(self.provide_data)
+                out.provide_label = deepcopy(self.provide_label)
+            out.bucket_key = key
+            yield out
+
+
+def main():
+    parser = argparse.ArgumentParser(description="bucketing sanity on mnist")
+    parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--data-dir", type=str, default="mnist/")
+    parser.add_argument("--tpus", type=str)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if args.synthetic:
+        rng = np.random.RandomState(0)
+        n = 20 * args.batch_size
+        y = rng.randint(0, 10, n)
+        # linearly separable fake digits: class signal in 10 pixels
+        X = rng.rand(n, 784).astype(np.float32) * 0.1
+        X[np.arange(n), y * 7] = 1.0
+        flat_iter = mx.io.NDArrayIter(X, y.astype(np.float32),
+                                      batch_size=args.batch_size,
+                                      shuffle=True)
+    else:
+        flat_iter = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "train-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, flat=True)
+
+    buckets = [1, 2, 3]
+    train = BucketIter(flat_iter, buckets)
+
+    def sym_gen(key):
+        # same network in every bucket — the sanity-check point: only the
+        # batch size differs, parameters are shared
+        return (get_mlp(), ("data",), ("softmax_label",))
+
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else [mx.cpu()]
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train.default_bucket_key,
+                                 context=ctx)
+    mod.fit(train, num_epoch=args.num_epochs,
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9})
+    logging.info("bucket usage counts: %s",
+                 dict(zip(buckets, train.stats.astype(int).tolist())))
+    score = mod.score(train, "acc")[0][1]
+    logging.info("final train accuracy: %.4f", score)
+    assert set(mod._buckets.keys()) <= set(buckets)
+
+
+if __name__ == "__main__":
+    main()

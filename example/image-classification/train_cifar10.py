@@ -1,0 +1,91 @@
+"""Train CIFAR-10 (reference example/image-classification/train_cifar10.py:
+Inception-BN-28-small, b128 — the BASELINE.md CIFAR rows: 842/1640/2943
+img/s on 1/2/4 GTX 980).
+
+Same CLI, --gpus accepted as an alias of --tpus.  Data comes from packed
+RecordIO files (train.rec/test.rec via im2rec, like the reference's
+cifar10.zip layout); --synthetic trains on generated tensors so the script
+runs end-to-end anywhere (CI-light mode).
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_inception_bn_28small
+import train_model
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="train an image classifier on cifar10")
+    parser.add_argument("--network", type=str,
+                        default="inception-bn-28-small")
+    parser.add_argument("--data-dir", type=str, default="cifar10/")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="train on generated data (smoke/CI mode)")
+    parser.add_argument("--tpus", type=str, help="e.g. '0,1,2,3'")
+    parser.add_argument("--gpus", type=str, help="accepted alias of --tpus")
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--lr-factor", type=float, default=1)
+    parser.add_argument("--lr-factor-epoch", type=float, default=1)
+    parser.add_argument("--model-prefix", type=str)
+    parser.add_argument("--save-model-prefix", type=str)
+    parser.add_argument("--num-epochs", type=int, default=20)
+    parser.add_argument("--load-epoch", type=int)
+    parser.add_argument("--kv-store", type=str, default="local")
+    return parser.parse_args()
+
+
+def get_iterator(args, kv):
+    data_shape = (3, 28, 28)
+    rank = kv.rank if kv else 0
+    nworker = kv.num_workers if kv else 1
+
+    if args.synthetic:
+        rng = np.random.RandomState(42 + rank)
+        n = min(args.num_examples, 2 * args.batch_size * 4)
+        X = rng.rand(n, *data_shape).astype(np.float32)
+        y = rng.randint(0, 10, n).astype(np.float32)
+        train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                                  shuffle=True)
+        val = mx.io.NDArrayIter(X[:args.batch_size], y[:args.batch_size],
+                                batch_size=args.batch_size)
+        return train, val
+
+    train = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(args.data_dir, "train.rec"),
+        data_shape=data_shape,
+        batch_size=args.batch_size,
+        rand_crop=True,
+        rand_mirror=True,
+        part_index=rank,
+        num_parts=nworker)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(args.data_dir, "test.rec"),
+        data_shape=data_shape,
+        batch_size=args.batch_size,
+        rand_crop=False,
+        rand_mirror=False)
+    return train, val
+
+
+def main():
+    args = parse_args()
+    logging.basicConfig(level=logging.INFO)
+    assert args.network == "inception-bn-28-small", \
+        "this script trains the BASELINE.md network"
+    net = get_inception_bn_28small(num_classes=10)
+    model = train_model.fit(args, net, get_iterator)
+    if args.save_model_prefix:
+        model.save(args.save_model_prefix)
+
+
+if __name__ == "__main__":
+    main()

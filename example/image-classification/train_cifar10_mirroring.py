@@ -1,0 +1,25 @@
+"""CIFAR-10 with gradient mirroring — the runnable "memonger" demo
+(reference: sublinear-memory hook in static_graph.cc:404-437, env
+MXNET_BACKWARD_DO_MIRROR; README.md links the memonger repo).
+
+Mirroring trades ~30% more compute for O(sqrt(N)) activation memory by
+recomputing activations in the backward pass.  The TPU build maps the same
+switch onto jax.checkpoint (executor.py force_mirroring -> remat), so this
+script is train_cifar10 with the env flag set before the framework loads —
+use it when a bigger batch or deeper net would otherwise exhaust HBM.
+
+    python train_cifar10_mirroring.py --synthetic --num-epochs 1
+
+Verify the remat actually engages with MXNET_EXEC_VERBOSE=1 (the executor
+logs the checkpoint policy) or a profiler trace: backward shows the
+recomputed forward ops.
+"""
+import os
+
+# must be set before mxnet_tpu (the executor reads it at program build)
+os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+
+import train_cifar10
+
+if __name__ == "__main__":
+    train_cifar10.main()

@@ -1,0 +1,90 @@
+"""Generate im2rec list files from a class-per-subdirectory image tree
+(reference example/kaggle-ndsb1/gen_img_list.py): writes the full list
+plus a stratified train/val split for training trees.
+
+    python gen_img_list.py --image-folder data/train/ --train --stratified
+    python gen_img_list.py --demo        # build + list a tiny fake tree
+"""
+import argparse
+import csv
+import os
+import random
+import sys
+
+
+def collect(image_folder, train):
+    """[(path, label)] — labels are subdirectory indices in sorted order."""
+    entries = []
+    if train:
+        classes = sorted(d for d in os.listdir(image_folder)
+                         if os.path.isdir(os.path.join(image_folder, d)))
+        for label, cls in enumerate(classes):
+            for fn in sorted(os.listdir(os.path.join(image_folder, cls))):
+                entries.append((os.path.join(cls, fn), label))
+    else:
+        for fn in sorted(os.listdir(image_folder)):
+            if os.path.isfile(os.path.join(image_folder, fn)):
+                entries.append((fn, 0))
+    return entries
+
+
+def write_lst(path, rows):
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f, delimiter="\t", lineterminator="\n")
+        for i, (rel, label) in enumerate(rows):
+            w.writerow([i, label, rel])
+
+
+def main():
+    parser = argparse.ArgumentParser(description="generate image lists")
+    parser.add_argument("--image-folder", type=str, default="data/train/")
+    parser.add_argument("--out-folder", type=str, default="data/")
+    parser.add_argument("--out-file", type=str, default="train.lst")
+    parser.add_argument("--train", action="store_true")
+    parser.add_argument("--percent-val", type=float, default=0.25)
+    parser.add_argument("--stratified", action="store_true")
+    parser.add_argument("--demo", action="store_true",
+                        help="create a tiny fake tree first (smoke mode)")
+    args = parser.parse_args()
+    random.seed(888)
+
+    if args.demo:
+        args.image_folder = "demo_tree/"
+        args.out_folder = "demo_tree/"
+        args.train = True
+        for cls in ("copepod", "diatom", "detritus"):
+            d = os.path.join(args.image_folder, cls)
+            os.makedirs(d, exist_ok=True)
+            for i in range(8):
+                open(os.path.join(d, "img%02d.jpg" % i), "a").close()
+
+    rows = collect(args.image_folder, args.train)
+    os.makedirs(args.out_folder, exist_ok=True)
+    write_lst(os.path.join(args.out_folder, args.out_file), rows)
+    if not args.train:
+        print("wrote %d entries" % len(rows))
+        return
+
+    if args.stratified:
+        by_class = {}
+        for row in rows:
+            by_class.setdefault(row[1], []).append(row)
+        tr, va = [], []
+        for cls_rows in by_class.values():
+            random.shuffle(cls_rows)
+            k = int(len(cls_rows) * args.percent_val)
+            va.extend(cls_rows[:k])
+            tr.extend(cls_rows[k:])
+    else:
+        random.shuffle(rows)
+        k = int(len(rows) * args.percent_val)
+        va, tr = rows[:k], rows[k:]
+    random.shuffle(tr)
+    random.shuffle(va)
+    write_lst(os.path.join(args.out_folder, "tr.lst"), tr)
+    write_lst(os.path.join(args.out_folder, "va.lst"), va)
+    print("wrote %d train / %d val entries" % (len(tr), len(va)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Predict test-set class probabilities and emit the submission file
+(reference example/kaggle-ndsb1/predict_dsb.py -> submission_dsb.py).
+
+    python predict_dsb.py --model-prefix dsb --epoch 10 \
+        --test-rec data/test.rec --test-lst data/test.lst
+
+--synthetic runs the whole path on generated data (CI-light mode).
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from submission_dsb import gen_sub
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model-prefix", type=str, default="dsb")
+    parser.add_argument("--epoch", type=int, default=10)
+    parser.add_argument("--test-rec", type=str)
+    parser.add_argument("--test-lst", type=str)
+    parser.add_argument("--data-shape", type=int, default=36)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--out", type=str, default="submission.csv")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    s = args.data_shape
+    if args.synthetic:
+        # train a 2-epoch throwaway model and predict generated images
+        from train_dsb import get_dsb_net
+        rng = np.random.RandomState(0)
+        X = rng.rand(4 * args.batch_size, 1, s, s).astype(np.float32)
+        y = rng.randint(0, 121, len(X)).astype(np.float32)
+        it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size)
+        model = mx.model.FeedForward(get_dsb_net(), ctx=mx.cpu(),
+                                     num_epoch=1, learning_rate=0.05)
+        model.fit(it)
+        test = mx.io.NDArrayIter(X[:args.batch_size],
+                                 batch_size=args.batch_size)
+        args.test_lst = "synthetic_test.lst"
+        with open(args.test_lst, "w") as f:
+            for i in range(args.batch_size):
+                f.write("%d\t0\tsyn%04d.jpg\n" % (i, i))
+    else:
+        model = mx.model.FeedForward.load(args.model_prefix, args.epoch,
+                                          ctx=mx.cpu())
+        test = mx.io.ImageRecordIter(
+            path_imgrec=args.test_rec, data_shape=(1, s, s),
+            batch_size=args.batch_size, rand_crop=False, rand_mirror=False)
+
+    probs = model.predict(test)
+    probs = np.asarray(probs)
+    n = sum(1 for _ in open(args.test_lst))
+    probs = probs[:n]
+    gen_sub(probs, args.test_lst, submission_path=args.out)
+    logging.info("wrote %s (%d rows x %d classes)", args.out, *probs.shape)
+    print("SUBMISSION %d" % probs.shape[0])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Format class-probability predictions as a Kaggle submission CSV
+(reference example/kaggle-ndsb1/submission_dsb.py gen_sub): one row per
+test image, one probability column per class."""
+import csv
+import gzip
+
+
+def gen_sub(predictions, test_lst_path="test.lst", class_names=None,
+            submission_path="submission.csv", compress=False):
+    """predictions: (N, C) array-like; test_lst_path: im2rec list whose
+    last tab field is the image filename."""
+    names = []
+    with open(test_lst_path) as f:
+        for line in f:
+            parts = line.rstrip("\n").split("\t")
+            names.append(parts[-1].split("/")[-1])
+    n_cls = len(predictions[0])
+    if class_names is None:
+        class_names = ["class_%03d" % i for i in range(n_cls)]
+    assert len(class_names) == n_cls
+    opener = (lambda p: gzip.open(p + ".gz", "wt")) if compress \
+        else (lambda p: open(p, "w", newline=""))
+    with opener(submission_path) as f:
+        w = csv.writer(f, lineterminator="\n")
+        w.writerow(["image"] + list(class_names))
+        for name, row in zip(names, predictions):
+            w.writerow([name] + ["%.6f" % float(p) for p in row])
+    return submission_path
+
+
+if __name__ == "__main__":
+    import numpy as np
+    # smoke: 3 fake images, 4 classes
+    with open("smoke_test.lst", "w") as f:
+        for i in range(3):
+            f.write("%d\t0\timg%d.jpg\n" % (i, i))
+    p = np.random.rand(3, 4)
+    p /= p.sum(axis=1, keepdims=True)
+    out = gen_sub(p, "smoke_test.lst")
+    print("wrote", out)

@@ -1,0 +1,58 @@
+"""Plot train/validation accuracy from a training log (reference
+example/kaggle-ndsb1/training_curves.py, built on tools/parse_log.py's
+format).  Writes a PNG when matplotlib is available, always prints the
+parsed table."""
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def parse(log_path):
+    tr = re.compile(r"Epoch\[(\d+)\] Train-accuracy=([\d.]+)")
+    va = re.compile(r"Epoch\[(\d+)\] Validation-accuracy=([\d.]+)")
+    train, val = {}, {}
+    with open(log_path) as f:
+        for line in f:
+            m = tr.search(line)
+            if m:
+                train[int(m.group(1))] = float(m.group(2))
+            m = va.search(line)
+            if m:
+                val[int(m.group(1))] = float(m.group(2))
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("log", help="training log file")
+    parser.add_argument("--out", type=str, default="training_curves.png")
+    args = parser.parse_args()
+    train, val = parse(args.log)
+    print("epoch\ttrain-acc\tval-acc")
+    for e in sorted(set(train) | set(val)):
+        print("%d\t%s\t%s" % (e, train.get(e, ""), val.get(e, "")))
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        fig, ax = plt.subplots()
+        if train:
+            ax.plot(sorted(train), [train[e] for e in sorted(train)],
+                    label="train")
+        if val:
+            ax.plot(sorted(val), [val[e] for e in sorted(val)],
+                    label="validation")
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("accuracy")
+        ax.legend()
+        fig.savefig(args.out, dpi=100)
+        print("wrote %s" % args.out)
+    except ImportError:
+        print("matplotlib unavailable; table only")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Character-level LSTM language model: train + sample (the runnable
+equivalent of the reference's char-rnn.ipynb, built on lstm_unroll for
+training and rnn_model.LSTMInferenceModel for generation).
+
+    python char_rnn.py --data input.txt --num-epochs 5 --sample 200
+
+Without --data a small synthetic corpus is generated so the script runs
+end-to-end anywhere (CI-light mode).
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models import lstm_unroll
+from rnn_model import LSTMInferenceModel
+
+
+def build_vocab(text):
+    chars = sorted(set(text))
+    # id 0 reserved for padding (reference char-rnn convention)
+    vocab = {c: i + 1 for i, c in enumerate(chars)}
+    return vocab
+
+
+def make_batches(text, vocab, seq_len, batch_size):
+    ids = np.array([vocab[c] for c in text], np.float32)
+    n_seq = (len(ids) - 1) // seq_len
+    n_seq -= n_seq % batch_size
+    if n_seq <= 0:
+        raise SystemExit("corpus too small for seq_len*batch_size")
+    X = ids[:n_seq * seq_len].reshape(n_seq, seq_len)
+    # next-char targets, same layout
+    y = ids[1:n_seq * seq_len + 1].reshape(n_seq, seq_len)
+    return X, y
+
+
+def main():
+    parser = argparse.ArgumentParser(description="char-rnn train + sample")
+    parser.add_argument("--data", type=str, help="text file; omit for a "
+                        "generated corpus (CI mode)")
+    parser.add_argument("--tpus", type=str)
+    parser.add_argument("--seq-len", type=int, default=32)
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--num-lstm-layer", type=int, default=1)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--sample", type=int, default=120,
+                        help="chars to generate after training")
+    parser.add_argument("--temperature", type=float, default=1.0)
+    parser.add_argument("--seed-text", type=str, default="th")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if args.data:
+        with open(args.data, encoding="utf-8", errors="ignore") as f:
+            text = f.read()
+    else:
+        # highly regular synthetic corpus: the model should learn the
+        # repetition quickly (CI-light oracle)
+        text = "the quick brown fox jumps over the lazy dog. " * 200
+
+    vocab = build_vocab(text)
+    inv_vocab = {i: c for c, i in vocab.items()}
+    vocab_size = len(vocab) + 1
+    X, y = make_batches(text, vocab, args.seq_len, args.batch_size)
+    logging.info("corpus %d chars, vocab %d, %d sequences of len %d",
+                 len(text), vocab_size, X.shape[0], args.seq_len)
+
+    state_names = ["l%d_init_c" % l for l in range(args.num_lstm_layer)] + \
+                  ["l%d_init_h" % l for l in range(args.num_lstm_layer)]
+    # zero init state rows alongside every sequence (stateless training)
+    state_arrays = {n: np.zeros((X.shape[0], args.num_hidden), np.float32)
+                    for n in state_names}
+
+    data_iter = mx.io.NDArrayIter(
+        {"data": X, **state_arrays}, {"softmax_label": y},
+        batch_size=args.batch_size, shuffle=True)
+
+    net = lstm_unroll(args.num_lstm_layer, args.seq_len, vocab_size,
+                      args.num_hidden, args.num_embed, vocab_size)
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else [mx.cpu()]
+    data_names = ["data"] + state_names
+    mod = mx.mod.Module(net, data_names=tuple(data_names),
+                        label_names=("softmax_label",), context=ctx)
+    mod.fit(data_iter, num_epoch=args.num_epochs, eval_metric="ce",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-5})
+
+    # -- sampling ------------------------------------------------------------
+    arg_params, _ = mod.get_params()
+    model = LSTMInferenceModel(args.num_lstm_layer, vocab_size,
+                               args.num_hidden, args.num_embed, vocab_size,
+                               arg_params, ctx=ctx[0])
+    rng = np.random.RandomState(7)
+    out = list(args.seed_text)
+    prob = None
+    for i, ch in enumerate(args.seed_text):
+        prob = model.forward(np.array([vocab.get(ch, 1)]), new_seq=(i == 0))
+    for _ in range(args.sample):
+        p = np.asarray(prob, np.float64)
+        if args.temperature != 1.0:
+            p = np.power(p, 1.0 / args.temperature)
+        p = p / p.sum()
+        idx = rng.choice(len(p), p=p)
+        ch = inv_vocab.get(int(idx), " ")
+        out.append(ch)
+        prob = model.forward(np.array([idx]))
+    print("SAMPLE> %s" % "".join(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Standalone RNN inference model (reference example/rnn/rnn_model.py
+LSTMInferenceModel): feed one token at a time, carry LSTM states between
+steps through the executor's extra outputs — the sampling engine behind
+char-rnn.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models.lstm import LSTMState, LSTMParam, lstm_cell
+
+
+def lstm_inference_symbol(num_lstm_layer, input_size, num_hidden,
+                          num_embed, num_label, dropout=0.0):
+    """One-step symbol whose outputs are [prob, l0_c, l0_h, l1_c, ...]
+    (reference lstm.py lstm_inference_symbol: Group of softmax + states)."""
+    embed_weight = mx.sym.Variable("embed_weight")
+    cls_weight = mx.sym.Variable("cls_weight")
+    cls_bias = mx.sym.Variable("cls_bias")
+    param_cells = []
+    last_states = []
+    for i in range(num_lstm_layer):
+        param_cells.append(LSTMParam(
+            i2h_weight=mx.sym.Variable("l%d_i2h_weight" % i),
+            i2h_bias=mx.sym.Variable("l%d_i2h_bias" % i),
+            h2h_weight=mx.sym.Variable("l%d_h2h_weight" % i),
+            h2h_bias=mx.sym.Variable("l%d_h2h_bias" % i)))
+        last_states.append(LSTMState(
+            c=mx.sym.Variable("l%d_init_c" % i),
+            h=mx.sym.Variable("l%d_init_h" % i)))
+
+    data = mx.sym.Variable("data")
+    hidden = mx.sym.Embedding(data=data, input_dim=input_size,
+                              weight=embed_weight, output_dim=num_embed,
+                              name="embed")
+    out_states = []
+    for i in range(num_lstm_layer):
+        state = lstm_cell(num_hidden, indata=hidden,
+                          prev_state=last_states[i], param=param_cells[i],
+                          seqidx=0, layeridx=i,
+                          dropout=dropout if i > 0 else 0.0)
+        hidden = state.h
+        out_states.extend([state.c, state.h])
+    fc = mx.sym.FullyConnected(data=hidden, num_hidden=num_label,
+                               weight=cls_weight, bias=cls_bias, name="pred")
+    prob = mx.sym.SoftmaxActivation(fc, name="softmax")
+    return mx.sym.Group([prob] + out_states)
+
+
+class LSTMInferenceModel:
+    """Step-wise LSTM LM evaluation with carried states (reference
+    rnn_model.py).  States live in the executor's arg arrays; each forward
+    copies the state outputs back in for the next step."""
+
+    def __init__(self, num_lstm_layer, input_size, num_hidden, num_embed,
+                 num_label, arg_params, ctx=None, dropout=0.0):
+        self.num_lstm_layer = num_lstm_layer
+        self.sym = lstm_inference_symbol(num_lstm_layer, input_size,
+                                         num_hidden, num_embed, num_label,
+                                         dropout)
+        batch_size = 1
+        init_c = [("l%d_init_c" % l, (batch_size, num_hidden))
+                  for l in range(num_lstm_layer)]
+        init_h = [("l%d_init_h" % l, (batch_size, num_hidden))
+                  for l in range(num_lstm_layer)]
+        data_shape = [("data", (batch_size,))]
+        input_shapes = dict(init_c + init_h + data_shape)
+        ctx = ctx or mx.current_context()
+        self.executor = self.sym.simple_bind(ctx, grad_req="null",
+                                             **input_shapes)
+        for key, arr in self.executor.arg_dict.items():
+            if key in arg_params:
+                arr[:] = arg_params[key].asnumpy()
+
+        self._state_names = []
+        for i in range(num_lstm_layer):
+            self._state_names.append("l%d_init_c" % i)
+            self._state_names.append("l%d_init_h" % i)
+
+    def forward(self, input_data, new_seq=False):
+        """input_data: (1,) token id array; returns (num_label,) probs."""
+        if new_seq:
+            for key in self._state_names:
+                self.executor.arg_dict[key][:] = 0.0
+        self.executor.arg_dict["data"][:] = np.asarray(input_data,
+                                                       np.float32)
+        self.executor.forward(is_train=False)
+        outs = self.executor.outputs
+        for key, state_out in zip(self._state_names, outs[1:]):
+            self.executor.arg_dict[key][:] = state_out.asnumpy()
+        return outs[0].asnumpy()[0]

@@ -1,0 +1,88 @@
+"""Posterior dump for decoding (reference example/speech-demo/
+decode_mxnet.py capability): load a trained acoustic checkpoint, run every
+utterance of a feature archive through the net, and write per-frame
+log-posteriors to an output archive — the hand-off point to an external
+WFST decoder (the reference piped these into Kaldi's latgen).
+
+    python decode_mxnet.py --model-prefix lstm_proj --epoch 6 \
+        --archive synthetic_train.npz --output posteriors.npz
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+import io_util
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model-prefix", type=str, default="lstm_proj")
+    parser.add_argument("--epoch", type=int, default=6)
+    parser.add_argument("--archive", type=str, required=True)
+    parser.add_argument("--output", type=str, default="posteriors.npz")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--seq-len", type=int, default=12)
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-proj", type=int, default=64)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model_prefix, args.epoch)
+    feats, _ = io_util.read_archive(args.archive)
+    stats = args.archive + ".stats.npz"
+    if os.path.exists(stats):
+        st = np.load(stats)
+        feats = io_util.apply_cmvn(feats, st["mean"], st["std"])
+
+    mod = mx.mod.Module(net, context=[mx.cpu()],
+                        data_names=("data", "init_c", "init_h"))
+    bs, T = args.batch_size, args.seq_len
+    # the loss head keeps its label input; feed a dummy label at decode
+    # time (forward(is_train=False) emits pure posteriors regardless)
+    mod.bind(data_shapes=[("data", (bs, T, next(iter(feats.values()))
+                                    .shape[1])),
+                          ("init_c", (bs, args.num_hidden)),
+                          ("init_h", (bs, args.num_proj))],
+             label_shapes=[("softmax_label", (bs, T))], for_training=False)
+    mod.set_params(arg_params, aux_params)
+    dummy_label = mx.nd.zeros((bs, T))
+
+    out = {}
+    zeros_c = mx.nd.zeros((bs, args.num_hidden))
+    zeros_h = mx.nd.zeros((bs, args.num_proj))
+    for utt, f in feats.items():
+        # window the utterance like training; batch the windows
+        windows = []
+        for lo in range(0, f.shape[0], T):
+            w = f[lo:lo + T]
+            if w.shape[0] < T:
+                w = np.pad(w, ((0, T - w.shape[0]), (0, 0)))
+            windows.append(w)
+        probs = []
+        for lo in range(0, len(windows), bs):
+            chunk = windows[lo:lo + bs]
+            pad_rows = bs - len(chunk)
+            batch_x = np.stack(chunk + [np.zeros_like(chunk[0])] * pad_rows)
+            batch = mx.io.DataBatch(
+                data=[mx.nd.array(batch_x), zeros_c, zeros_h],
+                label=[dummy_label])
+            mod.forward(batch, is_train=False)
+            p = mod.get_outputs()[0].asnumpy()       # (T*bs, senone)
+            p = p.reshape(T, bs, -1).transpose(1, 0, 2)
+            probs.append(p[:len(chunk)].reshape(len(chunk) * T, -1))
+        post = np.concatenate(probs, axis=0)[:f.shape[0]]
+        out[utt] = np.log(post + 1e-12).astype(np.float32)
+    np.savez_compressed(args.output, **out)
+    logging.info("wrote log-posteriors for %d utterances to %s",
+                 len(out), args.output)
+    print("DECODED %d" % len(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Utterance IO for the speech demo (reference example/speech-demo/io_util.py
++ make_stats.py capability, minus Kaldi: features live in a portable .npz
+archive instead of Kaldi ark/scp).
+
+An archive maps utterance-id -> (frames, feat_dim) float32 features and,
+for training archives, utterance-id -> (frames,) int labels stored under
+"<utt>/labels".  TruncatedSentenceIter yields fixed-length windows with
+zero-padded tails — the truncated-BPTT layout the reference used for
+acoustic LSTMs.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def write_archive(path, feats, labels=None):
+    """feats: dict utt -> (T, D) array; labels: dict utt -> (T,) ints."""
+    blob = dict(feats)
+    if labels:
+        for utt, lab in labels.items():
+            blob[utt + "/labels"] = np.asarray(lab)
+    np.savez_compressed(path, **blob)
+
+
+def read_archive(path):
+    """Returns (feats, labels) dicts (labels possibly empty)."""
+    data = np.load(path)
+    feats, labels = {}, {}
+    for key in data.files:
+        if key.endswith("/labels"):
+            labels[key[:-len("/labels")]] = data[key]
+        else:
+            feats[key] = data[key].astype(np.float32)
+    return feats, labels
+
+
+def make_synthetic_archive(path, num_utts=64, feat_dim=40, num_senone=16,
+                           min_frames=20, max_frames=60, seed=0):
+    """Synthetic 'speech': each senone paints a fixed pattern into the
+    filterbank bins plus noise (CI-light stand-in for real features)."""
+    rng = np.random.RandomState(seed)
+    patterns = rng.randn(num_senone, feat_dim).astype(np.float32)
+    feats, labels = {}, {}
+    for u in range(num_utts):
+        T = rng.randint(min_frames, max_frames + 1)
+        lab = rng.randint(0, num_senone, T)
+        f = patterns[lab] + 0.5 * rng.randn(T, feat_dim).astype(np.float32)
+        feats["utt%04d" % u] = f.astype(np.float32)
+        labels["utt%04d" % u] = lab
+    write_archive(path, feats, labels)
+    return path
+
+
+def compute_stats(feats):
+    """Global mean/std over all frames (reference make_stats.py)."""
+    stacked = np.concatenate(list(feats.values()), axis=0)
+    mean = stacked.mean(axis=0)
+    std = stacked.std(axis=0) + 1e-5
+    return mean, std
+
+
+def apply_cmvn(feats, mean, std):
+    return {u: (f - mean) / std for u, f in feats.items()}
+
+
+class TruncatedSentenceIter(mx.io.DataIter):
+    """Fixed-length frame windows with zero padding (reference io_util
+    TruncatedSentenceIter): each utterance is cut into seq_len windows;
+    short tails are padded and their frames masked out of the label with
+    ignore_label -1."""
+
+    def __init__(self, feats, labels, batch_size, seq_len,
+                 num_hidden, num_proj, ignore_label=-1):
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        feat_dim = next(iter(feats.values())).shape[1]
+        X, y = [], []
+        for utt, f in feats.items():
+            lab = labels.get(utt)
+            for lo in range(0, f.shape[0], seq_len):
+                window = f[lo:lo + seq_len]
+                pad = seq_len - window.shape[0]
+                if pad:
+                    window = np.pad(window, ((0, pad), (0, 0)))
+                X.append(window)
+                if lab is not None:
+                    lw = lab[lo:lo + seq_len].astype(np.float32)
+                    if pad:
+                        lw = np.concatenate([lw, np.full(pad, ignore_label,
+                                                         np.float32)])
+                    y.append(lw)
+        n = len(X) - len(X) % batch_size
+        if n == 0:
+            raise ValueError("fewer windows than one batch")
+        X = np.stack(X[:n])
+        data = {"data": X,
+                "init_c": np.zeros((n, num_hidden), np.float32),
+                "init_h": np.zeros((n, num_proj), np.float32)}
+        label = {"softmax_label": np.stack(y[:n])} if y else None
+        self._inner = mx.io.NDArrayIter(data, label, batch_size=batch_size,
+                                        shuffle=bool(y))
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def __iter__(self):
+        return iter(self._inner)

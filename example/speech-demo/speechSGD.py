@@ -1,0 +1,47 @@
+"""Momentum-scheduled SGD (reference example/speech-demo/speechSGD.py):
+identical to SGD except the lr_scheduler returns (lr, momentum) pairs, so
+momentum can ramp in after warmup — the schedule acoustic models used."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.optimizer import Optimizer, register
+from mxnet_tpu.ndarray import zeros
+
+
+@register
+class speechSGD(Optimizer):
+    """SGD whose (lr, momentum) both come from the scheduler."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def _get_lr_mom(self, index):
+        if self.lr_scheduler is not None:
+            sched = self.lr_scheduler(self.num_update)
+            lr, mom = sched if isinstance(sched, tuple) else (sched,
+                                                              self.momentum)
+        else:
+            lr, mom = self.lr, self.momentum
+        lr *= self.lr_mult.get(self.idx2name.get(index, index), 1.0)
+        return lr, mom
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, mom = self._get_lr_mom(index)
+        wd = self._get_wd(index)
+        g = self._preprocess_grad(grad)
+        w = weight._get()
+        if state is not None:
+            m = mom * state._get() - lr * g - lr * wd * w
+            state._set(m)
+            weight._set(w + m)
+        else:
+            weight._set(w - lr * (g + wd * w))

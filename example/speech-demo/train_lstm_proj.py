@@ -66,11 +66,17 @@ def lstm_proj_net(seq_len, feat_dim, num_hidden, num_proj, num_senone):
     label = mx.sym.Variable("softmax_label")  # (batch, T)
     label_t = mx.sym.transpose(label)
     label_flat = mx.sym.Reshape(label_t, shape=(-1,))
-    return mx.sym.SoftmaxOutput(pred, label=label_flat, name="softmax")
+    # padded tail frames carry label -1 and drop out of the gradient
+    return mx.sym.SoftmaxOutput(pred, label=label_flat, use_ignore=True,
+                                ignore_label=-1, name="softmax")
 
 
 def main():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--train-archive", type=str,
+                        help=".npz utterance archive (io_util.py); omitted "
+                        "= generate a synthetic one (CI mode)")
+    parser.add_argument("--model-prefix", type=str, default="lstm_proj")
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--seq-len", type=int, default=12)
     parser.add_argument("--feat-dim", type=int, default=40)
@@ -78,43 +84,64 @@ def main():
     parser.add_argument("--num-proj", type=int, default=64)
     parser.add_argument("--num-senone", type=int, default=16)
     parser.add_argument("--num-epochs", type=int, default=6)
+    parser.add_argument("--momentum-warmup", type=int, default=50,
+                        help="updates before momentum 0.9 kicks in "
+                        "(speechSGD schedule)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
-    # synthetic "speech": senone identity painted into the filterbank bins
-    rng = np.random.RandomState(0)
-    n = 1024
-    labels = rng.randint(0, args.num_senone, size=(n, args.seq_len))
-    feats = np.zeros((n, args.seq_len, args.feat_dim), np.float32)
-    for s in range(args.num_senone):
-        pattern = rng.randn(args.feat_dim).astype(np.float32)
-        feats[labels == s] = pattern
-    feats += 0.5 * rng.randn(*feats.shape).astype(np.float32)
+    import io_util
+    from speechSGD import speechSGD
+
+    archive = args.train_archive
+    if not archive:
+        archive = os.path.join(os.path.dirname(__file__) or ".",
+                               "synthetic_train.npz")
+    if not os.path.exists(archive):
+        io_util.make_synthetic_archive(archive, feat_dim=args.feat_dim,
+                                       num_senone=args.num_senone)
+    feats, labels = io_util.read_archive(archive)
+    mean, std = io_util.compute_stats(feats)        # make_stats.py step
+    feats = io_util.apply_cmvn(feats, mean, std)
+    np.savez(archive + ".stats.npz", mean=mean, std=std)
 
     bs = args.batch_size
-    iter_data = {
-        "data": feats,
-        "init_c": np.zeros((n, args.num_hidden), np.float32),
-        "init_h": np.zeros((n, args.num_proj), np.float32),
-    }
-    train = mx.io.NDArrayIter(iter_data,
-                              {"softmax_label": labels.astype(np.float32)},
-                              batch_size=bs, shuffle=True)
+    train = io_util.TruncatedSentenceIter(feats, labels, bs, args.seq_len,
+                                          args.num_hidden, args.num_proj)
     net = lstm_proj_net(args.seq_len, args.feat_dim, args.num_hidden,
                         args.num_proj, args.num_senone)
     mod = mx.mod.Module(net, context=[mx.cpu()],
                         data_names=("data", "init_c", "init_h"))
+
+    warmup = args.momentum_warmup
+
+    class MomentumRamp(mx.lr_scheduler.LRScheduler):
+        """(lr, momentum) schedule: momentum off during warmup.  The
+        optimizer overwrites base_lr with its learning_rate at init."""
+        def __call__(self, num_update):
+            return (self.base_lr, 0.0 if num_update < warmup else 0.9)
     def frame_ce(label, pred):
-        """CE with t-major alignment (pred rows are time-major; the stock
-        CrossEntropy metric assumes batch-major labels)."""
+        """CE with t-major alignment and padding-frame masking (pred rows
+        are time-major; padded frames carry label -1)."""
         lt = np.asarray(label).astype(int).T.reshape(-1)
         p = np.asarray(pred)
-        return float(-np.log(p[np.arange(len(lt)), lt] + 1e-9).mean())
+        keep = lt >= 0
+        return float(-np.log(p[np.arange(len(lt))[keep], lt[keep]]
+                             + 1e-9).mean())
 
-    mod.fit(train, num_epoch=args.num_epochs, optimizer="adam",
+    mod.fit(train, num_epoch=args.num_epochs, optimizer="speechSGD",
             initializer=mx.init.Xavier(),
-            optimizer_params={"learning_rate": 1e-3, "clip_gradient": 5.0},
+            # nonzero momentum allocates the state; the schedule then
+            # controls the effective value per update (0 during warmup)
+            optimizer_params={"learning_rate": 0.02, "momentum": 0.9,
+                              "lr_scheduler": MomentumRamp(),
+                              "clip_gradient": 5.0},
             eval_metric=mx.metric.np_metric(frame_ce, name="frame-ce"))
+
+    # checkpoint for decode_mxnet.py (reference two-artifact format)
+    arg_p, aux_p = mod.get_params()
+    mx.model.save_checkpoint(args.model_prefix, args.num_epochs, net,
+                             arg_p, aux_p)
 
     train.reset()
     correct = total = 0
@@ -123,8 +150,9 @@ def main():
         out = mod.get_outputs()[0].asnumpy()
         pred = out.reshape(args.seq_len, bs, -1).argmax(axis=2).T
         truth = batch.label[0].asnumpy().astype(int)
-        correct += (pred == truth).sum()
-        total += truth.size
+        keep = truth >= 0
+        correct += (pred[keep] == truth[keep]).sum()
+        total += keep.sum()
     print("frame accuracy: %.3f" % (correct / total))
     assert correct / total > 0.7
 

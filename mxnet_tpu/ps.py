@@ -502,13 +502,31 @@ class PSWorkerClient:
         self._locks = [threading.Lock() for _ in self._conns]
         self._sched_lock = threading.Lock()
         self._closed = False
+        self._fatal = False
         # the stop handshake distinguishes a clean exit from a death (the
         # scheduler aborts the job on EOF-without-stop).  Most training
         # scripts never call kv.close() themselves (reference parity), so
-        # make interpreter exit clean automatically; a crash or os._exit
-        # still skips this and is correctly treated as a death.
+        # make interpreter exit clean automatically.  atexit also runs
+        # after an UNHANDLED EXCEPTION though — that is a crash, and must
+        # reach the scheduler as one, so the excepthook marks the process
+        # fatal and the handler then skips the handshake (raw EOF ->
+        # dead-peer abort).  os._exit / signals skip atexit entirely and
+        # are likewise detected as deaths.
         import atexit
-        atexit.register(self.close)
+        import sys as _sys
+        prev_hook = _sys.excepthook
+
+        def _mark_fatal(tp, val, tb):
+            self._fatal = True
+            prev_hook(tp, val, tb)
+
+        _sys.excepthook = _mark_fatal
+        atexit.register(self._atexit_close)
+
+    def _atexit_close(self):
+        if self._fatal:
+            return   # crashed: let the EOF trigger the scheduler abort
+        self.close()
 
     @staticmethod
     def _recv(conn, what):

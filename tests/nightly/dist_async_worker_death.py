@@ -32,6 +32,7 @@ def main():
     out = mx.nd.zeros(shape)
     kv.pull(7, out=out)
 
+    mode = sys.argv[1] if len(sys.argv) > 1 else "exit"
     if rank == 1:
         # simulate a crash: no kv close, no scheduler stop handshake.
         # The delay parks rank 0 in the barrier first, so the abort
@@ -39,6 +40,11 @@ def main():
         import time
         time.sleep(2.0)
         sys.stdout.flush()
+        if mode == "raise":
+            # unhandled exception: atexit still runs, but the excepthook
+            # marks the client fatal so the stop handshake is skipped and
+            # the scheduler sees a death, not a clean exit
+            raise ValueError("simulated worker crash")
         os._exit(0)
 
     try:

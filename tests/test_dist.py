@@ -65,13 +65,16 @@ def test_dist_async_kvstore_2workers_2servers():
     assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr
 
 
-def test_dist_async_worker_death_fails_fast():
-    """Kill a worker mid-job: the scheduler's dead-peer detection must
-    abort the job quickly with a clean message (no hang)."""
+@pytest.mark.parametrize("mode,port", [("exit", 9094), ("raise", 9093)])
+def test_dist_async_worker_death_fails_fast(mode, port):
+    """Kill a worker mid-job (hard os._exit, or an unhandled exception —
+    whose atexit must NOT masquerade as a clean stop): the scheduler's
+    dead-peer detection must abort the job quickly with a clean message
+    (no hang)."""
     import time
     t0 = time.monotonic()
-    res = _launch(2, "tests/nightly/dist_async_worker_death.py", servers=1,
-                  port=9094, timeout=120)
+    res = _launch(2, "tests/nightly/dist_async_worker_death.py %s" % mode,
+                  servers=1, port=port, timeout=120)
     elapsed = time.monotonic() - t0
     assert res.returncode != 0, res.stdout + res.stderr
     # dead-peer detection fired at the scheduler...

@@ -1,0 +1,81 @@
+"""Example-corpus integration tests: every flagship example must run
+end-to-end from the command line in its CI-light (synthetic-data) mode.
+The reference used its examples as de-facto integration tests (nightly
+test_all.sh drove train_mnist/train_cifar10); this file does the same."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(rel_dir, argv, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    return subprocess.run([sys.executable] + argv, capture_output=True,
+                          text=True, timeout=timeout, env=env,
+                          cwd=os.path.join(ROOT, rel_dir))
+
+
+def test_mnist_bucket_example():
+    res = _run("example/image-classification",
+               ["mnist_bucket.py", "--synthetic", "--num-epochs", "1"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "bucket usage counts" in res.stderr + res.stdout
+
+
+def test_char_rnn_example_trains_and_samples():
+    res = _run("example/rnn",
+               ["char_rnn.py", "--num-epochs", "1", "--seq-len", "8",
+                "--num-hidden", "32", "--num-embed", "16", "--sample", "20"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "SAMPLE>" in res.stdout, res.stdout + res.stderr
+
+
+def test_speech_demo_pipeline(tmp_path):
+    arch = str(tmp_path / "train.npz")
+    prefix = str(tmp_path / "am")
+    # a missing archive path is auto-filled with synthetic utterances
+    res = _run("example/speech-demo",
+               ["train_lstm_proj.py", "--num-epochs", "4",
+                "--train-archive", arch, "--model-prefix", prefix])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "frame accuracy" in res.stdout, res.stdout + res.stderr
+
+    res = _run("example/speech-demo",
+               ["decode_mxnet.py", "--archive", arch, "--epoch", "4",
+                "--model-prefix", prefix,
+                "--output", str(tmp_path / "post.npz")])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "DECODED" in res.stdout, res.stdout + res.stderr
+
+
+def test_ndsb_list_and_submission(tmp_path):
+    res = _run("example/kaggle-ndsb1",
+               ["gen_img_list.py", "--demo", "--stratified"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "train" in res.stdout
+    res = _run("example/kaggle-ndsb1", ["submission_dsb.py"])
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+@pytest.mark.slow
+def test_train_cifar10_synthetic():
+    res = _run("example/image-classification",
+               ["train_cifar10.py", "--synthetic", "--num-epochs", "1",
+                "--batch-size", "16", "--num-examples", "64"], timeout=560)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "Train-accuracy" in res.stderr + res.stdout
+
+
+@pytest.mark.slow
+def test_train_cifar10_mirroring_synthetic():
+    res = _run("example/image-classification",
+               ["train_cifar10_mirroring.py", "--synthetic",
+                "--num-epochs", "1", "--batch-size", "16",
+                "--num-examples", "64"], timeout=560)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "Train-accuracy" in res.stderr + res.stdout
