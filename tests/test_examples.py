@@ -54,12 +54,22 @@ def test_speech_demo_pipeline(tmp_path):
 
 
 def test_ndsb_list_and_submission(tmp_path):
-    res = _run("example/kaggle-ndsb1",
-               ["gen_img_list.py", "--demo", "--stratified"])
-    assert res.returncode == 0, res.stdout + res.stderr
-    assert "train" in res.stdout
-    res = _run("example/kaggle-ndsb1", ["submission_dsb.py"])
-    assert res.returncode == 0, res.stdout + res.stderr
+    import shutil
+    try:
+        res = _run("example/kaggle-ndsb1",
+                   ["gen_img_list.py", "--demo", "--stratified"])
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "train" in res.stdout
+        res = _run("example/kaggle-ndsb1", ["submission_dsb.py"])
+        assert res.returncode == 0, res.stdout + res.stderr
+    finally:
+        base = os.path.join(ROOT, "example", "kaggle-ndsb1")
+        shutil.rmtree(os.path.join(base, "demo_tree"), ignore_errors=True)
+        for fn in ("smoke_test.lst", "submission.csv"):
+            try:
+                os.remove(os.path.join(base, fn))
+            except OSError:
+                pass
 
 
 @pytest.mark.slow
