@@ -1,0 +1,467 @@
+/*!
+ * \file MxNetCpp.hpp
+ * \brief C++ frontend over the C ABI (include/c_api.h).
+ *
+ * The reference proved its C ABI by carrying full language bindings on it
+ * (R-package/src Rcpp glue, scala-package JNI, matlab/+mxnet).  This
+ * package is the same proof for the TPU build in the one extra language
+ * the toolchain ships: a real class library — NDArray, Symbol, Operator
+ * builder, Executor with simple-bind, optimizers, metrics — every call of
+ * which crosses the C ABI exactly as an external binding would.  Nothing
+ * here touches the python package or internal headers; `include/c_api.h`
+ * is the only dependency.
+ *
+ * Usage (see tests/cpp/cpp_package_test.cc for a full training loop):
+ *
+ *   using namespace mxnet::cpp;
+ *   auto net = Operator("FullyConnected")
+ *                  .SetParam("num_hidden", 64)
+ *                  .SetInput("data", Symbol::Variable("data"))
+ *                  .CreateSymbol("fc1");
+ */
+#ifndef MXNET_CPP_MXNETCPP_HPP_
+#define MXNET_CPP_MXNETCPP_HPP_
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <random>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../../../include/c_api.h"
+
+namespace mxnet {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string("MXNet C API error: ") +
+                             MXGetLastError());
+  }
+}
+
+/*! \brief Device context: (dev_type, dev_id); cpu=1, gpu=2, tpu=4. */
+class Context {
+ public:
+  Context(int dev_type, int dev_id) : type_(dev_type), id_(dev_id) {}
+  static Context cpu(int id = 0) { return Context(1, id); }
+  static Context tpu(int id = 0) { return Context(4, id); }
+  int dev_type() const { return type_; }
+  int dev_id() const { return id_; }
+
+ private:
+  int type_, id_;
+};
+
+/*! \brief RAII NDArray over NDArrayHandle with host copy helpers and
+ *  registered-function arithmetic (the MXFuncInvoke path every binding
+ *  uses). */
+class NDArray {
+ public:
+  NDArray() : handle_(nullptr, &NDArray::Free) {}
+
+  NDArray(const std::vector<mx_uint> &shape, const Context &ctx,
+          bool delay_alloc = false) : handle_(nullptr, &NDArray::Free) {
+    NDArrayHandle h;
+    Check(MXNDArrayCreate(shape.data(), shape.size(), ctx.dev_type(),
+                          ctx.dev_id(), delay_alloc ? 1 : 0, &h));
+    handle_.reset(h, &NDArray::Free);
+  }
+
+  NDArray(const std::vector<float> &data, const std::vector<mx_uint> &shape,
+          const Context &ctx) : NDArray(shape, ctx) {
+    SyncCopyFromCPU(data);
+  }
+
+  static NDArray FromHandle(NDArrayHandle h) {
+    NDArray a;
+    a.handle_.reset(h, &NDArray::Free);
+    return a;
+  }
+  /*! \brief wrap a handle owned elsewhere (e.g. executor outputs). */
+  static NDArray Borrow(NDArrayHandle h) {
+    NDArray a;
+    a.handle_ = std::shared_ptr<void>(h, [](void *) {});
+    return a;
+  }
+
+  NDArrayHandle handle() const { return handle_.get(); }
+
+  void SyncCopyFromCPU(const std::vector<float> &data) {
+    Check(MXNDArraySyncCopyFromCPU(handle(), data.data(), data.size()));
+  }
+
+  std::vector<float> SyncCopyToCPU() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(handle(), out.data(), out.size()));
+    return out;
+  }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim;
+    const mx_uint *data;
+    Check(MXNDArrayGetShape(handle(), &ndim, &data));
+    return std::vector<mx_uint>(data, data + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+
+  void WaitToRead() const { Check(MXNDArrayWaitToRead(handle())); }
+  static void WaitAll() { Check(MXNDArrayWaitAll()); }
+
+  void Save(const std::string &fname,
+            const std::vector<std::string> &names) const {
+    const char *keys[1] = {names.empty() ? nullptr : names[0].c_str()};
+    NDArrayHandle hs[1] = {handle()};
+    Check(MXNDArraySave(fname.c_str(), 1, hs,
+                        names.empty() ? nullptr : keys));
+  }
+
+  /*! \brief invoke a registered imperative function (mx.nd.* parity). */
+  static void Invoke(const std::string &fname,
+                     const std::vector<NDArrayHandle> &use,
+                     const std::vector<float> &scalars,
+                     const std::vector<NDArrayHandle> &mutate) {
+    FunctionHandle fn;
+    Check(MXGetFunction(fname.c_str(), &fn));
+    Check(MXFuncInvoke(fn, const_cast<NDArrayHandle *>(use.data()),
+                       const_cast<float *>(scalars.data()),
+                       const_cast<NDArrayHandle *>(mutate.data())));
+  }
+
+  NDArray Binary(const std::string &op, const NDArray &rhs) const {
+    NDArray out(Shape(), CurrentContext());
+    Invoke(op, {handle(), rhs.handle()}, {}, {out.handle()});
+    return out;
+  }
+  NDArray Scalar(const std::string &op, float s) const {
+    NDArray out(Shape(), CurrentContext());
+    Invoke(op, {handle()}, {s}, {out.handle()});
+    return out;
+  }
+  NDArray operator+(const NDArray &r) const { return Binary("_plus", r); }
+  NDArray operator-(const NDArray &r) const { return Binary("_minus", r); }
+  NDArray operator*(const NDArray &r) const { return Binary("_mul", r); }
+  NDArray operator*(float s) const { return Scalar("_mul_scalar", s); }
+
+  Context CurrentContext() const {
+    int t, i;
+    Check(MXNDArrayGetContext(handle(), &t, &i));
+    return Context(t, i);
+  }
+
+ private:
+  static void Free(void *h) {
+    if (h != nullptr) MXNDArrayFree(h);
+  }
+  std::shared_ptr<void> handle_;
+};
+
+/*! \brief Symbol wrapper: variables, composition, shape inference, JSON. */
+class Symbol {
+ public:
+  Symbol() : handle_(nullptr, &Symbol::Free) {}
+
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+
+  static Symbol FromJSONFile(const std::string &fname) {
+    SymbolHandle h;
+    Check(MXSymbolCreateFromFile(fname.c_str(), &h));
+    return Symbol(h);
+  }
+
+  explicit Symbol(SymbolHandle h) : handle_(h, &Symbol::Free) {}
+
+  SymbolHandle handle() const { return handle_.get(); }
+  bool IsNull() const { return handle_ == nullptr; }
+
+  std::vector<std::string> ListArguments() const {
+    mx_uint n;
+    const char **names;
+    Check(MXSymbolListArguments(handle(), &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+
+  std::vector<std::string> ListAuxiliaryStates() const {
+    mx_uint n;
+    const char **names;
+    Check(MXSymbolListAuxiliaryStates(handle(), &n, &names));
+    return std::vector<std::string>(names, names + n);
+  }
+
+  std::string ToJSON() const {
+    const char *json;
+    Check(MXSymbolSaveToJSON(handle(), &json));
+    return json;
+  }
+
+  /*! \brief infer all argument/output shapes from named input shapes. */
+  void InferShape(
+      const std::map<std::string, std::vector<mx_uint>> &known,
+      std::vector<std::vector<mx_uint>> *arg_shapes,
+      std::vector<std::vector<mx_uint>> *out_shapes,
+      std::vector<std::vector<mx_uint>> *aux_shapes) const {
+    std::vector<const char *> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> sdata;
+    for (const auto &kv : known) {
+      keys.push_back(kv.first.c_str());
+      sdata.insert(sdata.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(sdata.size());
+    }
+    mx_uint in_sz, out_sz, aux_sz;
+    const mx_uint *in_nd, *out_nd, *aux_nd;
+    const mx_uint **in_sh, **out_sh, **aux_sh;
+    int complete;
+    Check(MXSymbolInferShape(handle(), keys.size(), keys.data(),
+                             indptr.data(), sdata.data(), &in_sz, &in_nd,
+                             &in_sh, &out_sz, &out_nd, &out_sh, &aux_sz,
+                             &aux_nd, &aux_sh, &complete));
+    if (!complete) throw std::runtime_error("InferShape incomplete");
+    auto unpack = [](mx_uint n, const mx_uint *nd, const mx_uint **sh,
+                     std::vector<std::vector<mx_uint>> *out) {
+      if (out == nullptr) return;
+      out->clear();
+      for (mx_uint i = 0; i < n; ++i)
+        out->emplace_back(sh[i], sh[i] + nd[i]);
+    };
+    unpack(in_sz, in_nd, in_sh, arg_shapes);
+    unpack(out_sz, out_nd, out_sh, out_shapes);
+    unpack(aux_sz, aux_nd, aux_sh, aux_shapes);
+  }
+
+ private:
+  static void Free(void *h) {
+    if (h != nullptr) MXSymbolFree(h);
+  }
+  std::shared_ptr<void> handle_;
+};
+
+/*! \brief Operator builder (cpp-package idiom): params as strings, inputs
+ *  as symbols, CreateSymbol(name) composes through the C ABI. */
+class Operator {
+ public:
+  explicit Operator(const std::string &op_name) : op_name_(op_name) {}
+
+  template <typename T>
+  Operator &SetParam(const std::string &key, const T &value) {
+    std::ostringstream os;
+    os << value;
+    params_[key] = os.str();
+    return *this;
+  }
+
+  Operator &SetInput(const std::string &name, const Symbol &sym) {
+    input_keys_.push_back(name);
+    inputs_.push_back(sym);
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string &name) {
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    SymbolHandle h;
+    Check(MXSymbolCreateAtomicSymbol(op_name_.c_str(), keys.size(),
+                                     keys.data(), vals.data(), &h));
+    Symbol sym(h);
+    std::vector<const char *> in_keys;
+    std::vector<SymbolHandle> in_handles;
+    for (size_t i = 0; i < inputs_.size(); ++i) {
+      in_keys.push_back(input_keys_[i].c_str());
+      in_handles.push_back(inputs_[i].handle());
+    }
+    Check(MXSymbolCompose(sym.handle(), name.c_str(), in_handles.size(),
+                          in_keys.data(), in_handles.data()));
+    return sym;
+  }
+
+ private:
+  std::string op_name_;
+  std::map<std::string, std::string> params_;
+  std::vector<std::string> input_keys_;
+  std::vector<Symbol> inputs_;
+};
+
+/*! \brief Executor: simple-bind (infer + allocate) and train/eval steps. */
+class Executor {
+ public:
+  /*! \brief reference simple_bind: infer shapes from data shapes, allocate
+   *  args/grads/aux on ctx, bind.  grad_req: 0 null, 1 write, 3 add. */
+  Executor(const Symbol &sym, const Context &ctx,
+           const std::map<std::string, std::vector<mx_uint>> &input_shapes,
+           mx_uint default_grad_req = 1)
+      : sym_(sym) {
+    std::vector<std::vector<mx_uint>> arg_shapes, out_shapes, aux_shapes;
+    sym.InferShape(input_shapes, &arg_shapes, &out_shapes, &aux_shapes);
+    arg_names_ = sym.ListArguments();
+    for (size_t i = 0; i < arg_names_.size(); ++i) {
+      args_.emplace_back(arg_shapes[i], ctx);
+      bool is_input = input_shapes.count(arg_names_[i]) > 0;
+      grad_req_.push_back(is_input ? 0 : default_grad_req);
+      grads_.emplace_back(arg_shapes[i], ctx);
+    }
+    for (const auto &s : aux_shapes) aux_.emplace_back(s, ctx);
+
+    std::vector<NDArrayHandle> argh, gradh, auxh;
+    for (auto &a : args_) argh.push_back(a.handle());
+    for (auto &g : grads_) gradh.push_back(g.handle());
+    for (auto &a : aux_) auxh.push_back(a.handle());
+    ExecutorHandle h;
+    Check(MXExecutorBind(sym.handle(), ctx.dev_type(), ctx.dev_id(),
+                         argh.size(), argh.data(), gradh.data(),
+                         grad_req_.data(), auxh.size(),
+                         auxh.empty() ? nullptr : auxh.data(), &h));
+    handle_.reset(h, [](void *p) { MXExecutorFree(p); });
+  }
+
+  NDArray &Arg(const std::string &name) {
+    for (size_t i = 0; i < arg_names_.size(); ++i)
+      if (arg_names_[i] == name) return args_[i];
+    throw std::runtime_error("no argument named " + name);
+  }
+  NDArray &Grad(const std::string &name) {
+    for (size_t i = 0; i < arg_names_.size(); ++i)
+      if (arg_names_[i] == name) return grads_[i];
+    throw std::runtime_error("no argument named " + name);
+  }
+  const std::vector<std::string> &ArgNames() const { return arg_names_; }
+  std::vector<NDArray> &Args() { return args_; }
+  std::vector<NDArray> &Grads() { return grads_; }
+  const std::vector<mx_uint> &GradReq() const { return grad_req_; }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle_.get(), is_train ? 1 : 0));
+  }
+
+  void Backward() {
+    Check(MXExecutorBackward(handle_.get(), 0, nullptr));
+  }
+
+  std::vector<NDArray> Outputs() const {
+    mx_uint n;
+    NDArrayHandle *outs;
+    Check(MXExecutorOutputs(handle_.get(), &n, &outs));
+    std::vector<NDArray> res;
+    // ABI convention: each returned handle is a fresh table entry the
+    // caller frees (tests/cpp/test_c_api.cc does the same) — own them,
+    // or every Outputs() call leaks one pinned array per output
+    for (mx_uint i = 0; i < n; ++i)
+      res.push_back(NDArray::FromHandle(outs[i]));
+    return res;
+  }
+
+  std::string DebugStr() const {
+    const char *s;
+    Check(MXExecutorPrint(handle_.get(), &s));
+    return s;
+  }
+
+ private:
+  Symbol sym_;
+  std::vector<std::string> arg_names_;
+  std::vector<NDArray> args_, grads_, aux_;
+  std::vector<mx_uint> grad_req_;
+  std::shared_ptr<void> handle_;
+};
+
+/*! \brief Xavier-ish uniform initializer (host-side RNG, like every
+ *  binding seeds params before the first device touch). */
+class Uniform {
+ public:
+  explicit Uniform(float scale = 0.07f, unsigned seed = 0)
+      : scale_(scale), rng_(seed) {}
+  void operator()(const std::string &name, NDArray *arr) {
+    std::vector<float> host(arr->Size());
+    if (name.find("bias") != std::string::npos) {
+      std::fill(host.begin(), host.end(), 0.0f);
+    } else {
+      std::uniform_real_distribution<float> dist(-scale_, scale_);
+      for (auto &v : host) v = dist(rng_);
+    }
+    arr->SyncCopyFromCPU(host);
+  }
+
+ private:
+  float scale_;
+  std::mt19937 rng_;
+};
+
+/*! \brief SGD with momentum over the imperative-function path: the same
+ *  update rule optimizer.py's SGD runs, executed via MXFuncInvoke. */
+class SGDOptimizer {
+ public:
+  SGDOptimizer(float lr, float momentum = 0.0f, float wd = 0.0f,
+               float rescale_grad = 1.0f)
+      : lr_(lr), momentum_(momentum), wd_(wd), rescale_(rescale_grad) {}
+
+  void Update(size_t index, NDArray *weight, NDArray &grad) {
+    NDArray g = grad.Scalar("_mul_scalar", rescale_);
+    if (wd_ != 0.0f) g = g + (*weight * wd_);
+    NDArray step = g * lr_;
+    if (momentum_ != 0.0f) {
+      auto it = mom_.find(index);
+      if (it == mom_.end())
+        it = mom_.emplace(index, step * 0.0f).first;
+      NDArray &m = it->second;
+      // m = momentum*m - step; w = w + m  (in-place through the ABI:
+      // the mutate var may also be a use var, jnp arrays are immutable)
+      NDArray::Invoke("_mul_scalar", {m.handle()}, {momentum_},
+                      {m.handle()});
+      NDArray::Invoke("_minus", {m.handle(), step.handle()}, {},
+                      {m.handle()});
+      NDArray::Invoke("_plus", {weight->handle(), m.handle()}, {},
+                      {weight->handle()});
+    } else {
+      NDArray::Invoke("_minus", {weight->handle(), step.handle()}, {},
+                      {weight->handle()});
+    }
+  }
+
+ private:
+  float lr_, momentum_, wd_, rescale_;
+  std::map<size_t, NDArray> mom_;
+};
+
+/*! \brief classification accuracy over (prob, label) batches. */
+class Accuracy {
+ public:
+  void Update(const std::vector<float> &labels,
+              const std::vector<float> &probs, size_t num_classes) {
+    size_t n = labels.size();
+    for (size_t i = 0; i < n; ++i) {
+      size_t best = 0;
+      for (size_t c = 1; c < num_classes; ++c)
+        if (probs[i * num_classes + c] > probs[i * num_classes + best])
+          best = c;
+      correct_ += (static_cast<size_t>(labels[i]) == best);
+      total_ += 1;
+    }
+  }
+  float Get() const { return total_ ? float(correct_) / total_ : 0.0f; }
+  void Reset() { correct_ = total_ = 0; }
+
+ private:
+  size_t correct_ = 0, total_ = 0;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_MXNETCPP_HPP_

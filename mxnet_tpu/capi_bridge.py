@@ -205,6 +205,13 @@ _FUNC_SIGNATURES = {
 }
 
 
+def _scalar_params(op) -> List[str]:
+    """Required scalar params of a registry op (the SimpleOp scalar-family
+    convention: Param("scalar", float, required=True))."""
+    return [x.name for x in op.params
+            if x.required and x.name == "scalar"]
+
+
 def func_describe(name: str) -> List[int]:
     """[num_use_vars, num_scalars, num_mutate_vars, type_mask]; mirrors
     MXFuncDescribe (c_api.h:299-312)."""
@@ -214,8 +221,9 @@ def func_describe(name: str) -> List[int]:
     from .ops.registry import get_op
     try:
         op = get_op(name)
-        p = op.parse_params({})
-        return [len(op.list_arguments(p)), 0, 1, 1]
+        scalars = _scalar_params(op)
+        p = op.parse_params({s: 0.0 for s in scalars})
+        return [len(op.list_arguments(p)), len(scalars), 1, 1]
     except Exception:
         return [1, 0, 1, 1]
 
@@ -258,13 +266,25 @@ def func_invoke(name: str, use_handles: List[int], scalars: List[float],
     ins = [_get(h) for h in use_handles]
     outs = [_get(h) for h in mutate_handles]
     args = ins + list(scalars)
+    kwargs = {}
+    if name not in _FUNC_SIGNATURES and scalars:
+        # registry ops take their scalars as named params (SimpleOp
+        # scalar family); map the positional ABI scalars onto them
+        from .ops.registry import get_op
+        try:
+            names = _scalar_params(get_op(name))
+        except Exception:
+            names = []
+        if names:
+            args = list(ins)
+            kwargs = dict(zip(names, scalars))
     if not outs:
-        fn(*args)
+        fn(*args, **kwargs)
         return
     if _accepts_out(fn):
-        fn(*args, out=outs[0])
+        fn(*args, out=outs[0], **kwargs)
         return
-    res = fn(*args)
+    res = fn(*args, **kwargs)
     if isinstance(res, (list, tuple)):
         res = res[0]
     if isinstance(res, nd.NDArray):

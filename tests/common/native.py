@@ -1,0 +1,27 @@
+"""Shared harness for native (C/C++) tests that link libmxtpu_capi.so:
+one g++ invocation and one subprocess environment, so every native test
+builds and runs the same way."""
+import os
+import subprocess
+import sysconfig
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CAPI_LIB = os.path.join(ROOT, "mxnet_tpu", "libmxtpu_capi.so")
+
+
+def build_and_run(cc_file, out_binary, argv=(), timeout=600):
+    """Compile `cc_file` against the C ABI library and run it with the
+    embedded-interpreter environment (PYTHONPATH at repo root, CPU jax).
+    Returns the CompletedProcess of the run."""
+    subprocess.run(
+        ["g++", "-O1", "-std=c++17",
+         "-I" + sysconfig.get_paths()["include"],
+         cc_file, "-o", out_binary, CAPI_LIB,
+         "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu")],
+        check=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run([out_binary] + list(argv), env=env,
+                          capture_output=True, text=True, timeout=timeout)

@@ -8,15 +8,13 @@ compiles tests/cpp/test_c_api.cc against include/c_api.h, (3) runs it in a
 clean subprocess (the embedded interpreter must not inherit pytest's).
 """
 import os
-import subprocess
 import sys
-import sysconfig
 
 import numpy as np
 import pytest
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CAPI_LIB = os.path.join(ROOT, "mxnet_tpu", "libmxtpu_capi.so")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+from native import ROOT, CAPI_LIB, build_and_run
 
 
 def _write_checkpoint(prefix):
@@ -39,21 +37,9 @@ def test_c_api_end_to_end(tmp_path):
     prefix = str(tmp_path / "capimlp")
     _write_checkpoint(prefix)
 
-    binary = str(tmp_path / "test_c_api")
-    includes = sysconfig.get_paths()["include"]
-    compile_cmd = [
-        "g++", "-O1", "-std=c++17", "-I" + includes,
+    result = build_and_run(
         os.path.join(ROOT, "tests", "cpp", "test_c_api.cc"),
-        "-o", binary, CAPI_LIB,
-        "-Wl,-rpath," + os.path.join(ROOT, "mxnet_tpu"),
-    ]
-    subprocess.run(compile_cmd, check=True)
-
-    env = dict(os.environ)
-    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    result = subprocess.run([binary, prefix], env=env, capture_output=True,
-                            text=True, timeout=600)
+        str(tmp_path / "test_c_api"), argv=[prefix])
     sys.stderr.write(result.stderr)
     assert result.returncode == 0, result.stdout + result.stderr
     assert "ALL C API TESTS PASSED" in result.stdout

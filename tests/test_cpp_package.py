@@ -1,0 +1,26 @@
+"""Build + run the C++ frontend training test against libmxtpu_capi.so.
+
+The reference proved its C ABI with full non-python bindings (R/Scala/
+Matlab); cpp-package/ is this build's equivalent, and this wrapper is its
+ModuleSuite: compile tests/cpp/cpp_package_test.cc (which uses ONLY
+cpp-package headers + the C ABI) and train an MLP classifier from C++ to
+an accuracy gate.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+from native import ROOT, CAPI_LIB, build_and_run
+
+
+@pytest.mark.skipif(not os.path.exists(CAPI_LIB),
+                    reason="libmxtpu_capi.so not built (run make)")
+def test_cpp_package_trains_mlp(tmp_path):
+    result = build_and_run(
+        os.path.join(ROOT, "tests", "cpp", "cpp_package_test.cc"),
+        str(tmp_path / "cpp_package_test"))
+    sys.stderr.write(result.stderr)
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "CPP PACKAGE TRAINING PASSED" in result.stdout
