@@ -119,12 +119,26 @@ class NDArray {
   void WaitToRead() const { Check(MXNDArrayWaitToRead(handle())); }
   static void WaitAll() { Check(MXNDArrayWaitAll()); }
 
-  void Save(const std::string &fname,
-            const std::vector<std::string> &names) const {
-    const char *keys[1] = {names.empty() ? nullptr : names[0].c_str()};
+  void Save(const std::string &fname, const std::string &name = "") const {
+    const char *keys[1] = {name.c_str()};
     NDArrayHandle hs[1] = {handle()};
     Check(MXNDArraySave(fname.c_str(), 1, hs,
-                        names.empty() ? nullptr : keys));
+                        name.empty() ? nullptr : keys));
+  }
+
+  /*! \brief save several named arrays to one file (checkpoint format). */
+  static void Save(const std::string &fname,
+                   const std::vector<std::string> &names,
+                   const std::vector<NDArray> &arrays) {
+    if (names.size() != arrays.size())
+      throw std::runtime_error("Save: names/arrays size mismatch");
+    std::vector<const char *> keys;
+    std::vector<NDArrayHandle> hs;
+    for (size_t i = 0; i < arrays.size(); ++i) {
+      keys.push_back(names[i].c_str());
+      hs.push_back(arrays[i].handle());
+    }
+    Check(MXNDArraySave(fname.c_str(), hs.size(), hs.data(), keys.data()));
   }
 
   /*! \brief invoke a registered imperative function (mx.nd.* parity). */
@@ -315,7 +329,12 @@ class Executor {
       args_.emplace_back(arg_shapes[i], ctx);
       bool is_input = input_shapes.count(arg_names_[i]) > 0;
       grad_req_.push_back(is_input ? 0 : default_grad_req);
-      grads_.emplace_back(arg_shapes[i], ctx);
+      // null grad handle for req=0 inputs (the ABI accepts it): no
+      // device buffer is held for data/label gradients
+      if (is_input)
+        grads_.emplace_back();
+      else
+        grads_.emplace_back(arg_shapes[i], ctx);
     }
     for (const auto &s : aux_shapes) aux_.emplace_back(s, ctx);
 
