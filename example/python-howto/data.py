@@ -1,0 +1,41 @@
+"""Shared MNIST iterator helper (reference example/python-howto/data.py):
+the two-line way examples get train/val iterators.  Falls back to
+synthetic digits when the MNIST files are absent so dependent examples
+stay runnable anywhere."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def mnist_iterator(batch_size, input_shape, data_dir="data/"):
+    """Return (train, val) iterators yielding `input_shape` images."""
+    flat = len(input_shape) == 1
+    train_img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(train_img):
+        train = mx.io.MNISTIter(
+            image=train_img,
+            label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+            batch_size=batch_size, flat=flat, shuffle=True)
+        val = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=batch_size, flat=flat)
+        return train, val
+
+    # synthetic fallback: separable fake digits
+    rng = np.random.RandomState(0)
+    n = 40 * batch_size
+    y = rng.randint(0, 10, n)
+    X = rng.rand(n, int(np.prod(input_shape))).astype(np.float32) * 0.1
+    X[np.arange(n), y * 7] = 1.0
+    X = X.reshape((n,) + tuple(input_shape))
+    split = n * 4 // 5
+    train = mx.io.NDArrayIter(X[:split], y[:split].astype(np.float32),
+                              batch_size=batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(X[split:], y[split:].astype(np.float32),
+                            batch_size=batch_size)
+    return train, val
