@@ -9,7 +9,8 @@ from .inception_v3 import get_inception_v3
 from .resnet import get_resnet, get_resnet50
 from .inception_bn import get_inception_bn, get_inception_bn_28small
 from .vgg import get_vgg
-from .lstm import lstm_unroll, lstm_cell, LSTMState, LSTMParam
+from .lstm import (lstm_unroll, lstm_unroll_scan, lstm_cell,
+                   LSTMState, LSTMParam)
 from .dcgan import make_generator, make_discriminator
 from .fcn import get_fcn32s, get_fcn16s
 from .rcnn import get_fast_rcnn, get_rpn
@@ -18,7 +19,8 @@ from .gru import gru_unroll, gru_cell, rnn_unroll, rnn_cell, GRUState, \
 
 __all__ = ["get_mlp", "get_lenet", "get_resnet", "get_resnet50",
            "get_inception_bn", "get_inception_bn_28small", "get_vgg",
-           "lstm_unroll", "lstm_cell", "LSTMState", "LSTMParam",
+           "lstm_unroll", "lstm_unroll_scan", "lstm_cell", "LSTMState",
+           "LSTMParam",
            "make_generator", "make_discriminator", "get_fcn32s", "get_fcn16s",
            "get_fast_rcnn", "get_rpn", "gru_unroll", "gru_cell",
            "rnn_unroll", "rnn_cell", "GRUState", "GRUParam", "RNNState",

@@ -39,6 +39,27 @@ def lstm_cell(num_hidden, indata, prev_state, param, seqidx, layeridx,
     return LSTMState(c=next_c, h=next_h)
 
 
+def _lm_embed(input_size, num_embed):
+    """Shared LM front: token ids -> embeddings (both unroll forms)."""
+    data = sym.Variable("data")
+    return sym.Embedding(data=data, input_dim=input_size,
+                         weight=sym.Variable("embed_weight"),
+                         output_dim=num_embed, name="embed")
+
+
+def _lm_head(hidden_flat, num_label):
+    """Shared LM tail: time-major flattened hiddens -> softmax over the
+    time-major flattened labels (both unroll forms; keeps the
+    checkpoint-interchange guarantee in one place)."""
+    pred = sym.FullyConnected(data=hidden_flat, num_hidden=num_label,
+                              weight=sym.Variable("cls_weight"),
+                              bias=sym.Variable("cls_bias"), name="pred")
+    label = sym.Variable("softmax_label")
+    label_t = sym.transpose(data=label)
+    label_flat = sym.Reshape(data=label_t, target_shape=(0,), shape=(-1,))
+    return sym.SoftmaxOutput(data=pred, label=label_flat, name="softmax")
+
+
 def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
                 num_label, dropout=0.0, ctx_groups=None):
     """Unrolled LSTM LM (reference lstm.py lstm_unroll).
@@ -46,9 +67,6 @@ def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
     ctx_groups: optional list of group names per layer for model-parallel
     placement (example/model-parallel-lstm capability).
     """
-    embed_weight = sym.Variable("embed_weight")
-    cls_weight = sym.Variable("cls_weight")
-    cls_bias = sym.Variable("cls_bias")
     param_cells = []
     last_states = []
     for i in range(num_lstm_layer):
@@ -61,10 +79,7 @@ def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
             c=sym.Variable("l%d_init_c" % i),
             h=sym.Variable("l%d_init_h" % i)))
 
-    data = sym.Variable("data")
-    label = sym.Variable("softmax_label")
-    embed = sym.Embedding(data=data, input_dim=input_size, weight=embed_weight,
-                          output_dim=num_embed, name="embed")
+    embed = _lm_embed(input_size, num_embed)
     wordvec = sym.SliceChannel(data=embed, num_outputs=seq_len,
                                squeeze_axis=True, name="wordvec_slice")
 
@@ -93,11 +108,7 @@ def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
         hidden_all.append(hidden)
 
     hidden_concat = sym.Concat(*hidden_all, dim=0)
-    pred = sym.FullyConnected(data=hidden_concat, num_hidden=num_label,
-                              weight=cls_weight, bias=cls_bias, name="pred")
-    label_t = sym.transpose(data=label)
-    label_flat = sym.Reshape(data=label_t, target_shape=(0,), shape=(-1,))
-    return sym.SoftmaxOutput(data=pred, label=label_flat, name="softmax")
+    return _lm_head(hidden_concat, num_label)
 
 
 def lstm_inference_symbol(num_lstm_layer, input_size, num_hidden, num_embed,
@@ -105,3 +116,44 @@ def lstm_inference_symbol(num_lstm_layer, input_size, num_hidden, num_embed,
     """Single-step inference symbol (reference lstm.py lstm_inference_symbol)."""
     return lstm_unroll(num_lstm_layer, 1, input_size, num_hidden, num_embed,
                        num_label, dropout)
+
+
+def lstm_unroll_scan(num_lstm_layer, seq_len, input_size, num_hidden,
+                     num_embed, num_label, dropout=0.0):
+    """Same LM as lstm_unroll, lowered through the fused scan-based RNN op
+    (ops/rnn.py) instead of seq_len x layers unrolled cells.
+
+    Drop-in: identical argument names (data, softmax_label, l%d_init_c/h,
+    l%d_i2h/h2h weights, embed/cls params), identical gate layout — a
+    checkpoint trained with one form loads into the other.  Compile time
+    is sequence-length independent (one lax.scan), which is what makes
+    long buckets cheap (docs/bucketing.md).
+    """
+    L, H = num_lstm_layer, num_hidden
+    embed = _lm_embed(input_size, num_embed)                   # (B, T, E)
+    x = sym.transpose(embed, axes=(1, 0, 2))                   # (T, B, E)
+
+    def stacked(prefix):
+        parts = [sym.expand_dims(sym.Variable("l%d_init_%s" % (i, prefix)),
+                                 axis=0) for i in range(L)]
+        if L == 1:
+            return parts[0]
+        return sym.Concat(*parts, num_args=L, dim=0)           # (L, B, H)
+
+    weight_inputs = {}
+    for i in range(L):
+        for w in ("i2h_weight", "i2h_bias", "h2h_weight", "h2h_bias"):
+            n = "l%d_%s" % (i, w)
+            weight_inputs[n] = sym.Variable(n)
+
+    rnn = sym.RNN(x, state=stacked("h"), state_cell=stacked("c"),
+                  state_size=H, num_layers=L, mode="lstm", p=dropout,
+                  name="rnn", **weight_inputs)                 # (T, B, H)
+    if dropout > 0.0:
+        # lstm_unroll applies output dropout on every timestep's final
+        # hidden before the classifier; match it (the RNN op itself only
+        # does between-layer dropout)
+        rnn = sym.Dropout(data=rnn, p=dropout)
+
+    flat = sym.Reshape(rnn, shape=(-1, H))                     # (T*B, H)
+    return _lm_head(flat, num_label)

@@ -9,6 +9,7 @@ from .registry import (OpDef, OpContext, Param, register_op,
 from . import tensor  # noqa: F401  (registers elementwise/broadcast/reduce/matrix)
 from . import nn      # noqa: F401  (registers NN layers)
 from . import special  # noqa: F401 (registers ROIPooling/SpatialTransformer/Correlation)
+from . import rnn     # noqa: F401  (registers the fused scan-based RNN)
 
 __all__ = ["OpDef", "OpContext", "Param", "register_op", "register_simple_op",
            "get_op", "list_ops"]

@@ -1,0 +1,150 @@
+"""Fused recurrent operator lowered through ``lax.scan``.
+
+TPU-first extension beyond v0.7 parity (the reference era unrolled RNNs in
+python symbol construction, example/rnn/lstm.py; the cuDNN-fused ``RNN``
+op arrived later).  Unrolling builds seq_len x layers distinct graph
+nodes: XLA compile time grows with sequence length and every timestep is
+its own small kernel.  ``RNN`` expresses the time loop as one
+``lax.scan`` — compile time is sequence-length independent, the per-step
+body is one fused (4H x [E+H]) matmul pair that tiles the MXU, and JAX
+differentiates through the scan (no hand-written backward).
+
+Interface (mxnet-1.x RNN flavor, unpacked weights):
+  arguments: data (T, B, input) +
+             l{i}_i2h_weight/bias, l{i}_h2h_weight/bias per layer +
+             state (L, B, H) [+ state_cell (L, B, H) for lstm]
+  outputs:   output (T, B, H) [+ state (+ state_cell) when
+             state_outputs=True]
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import OpDef, Param, register_op
+
+__all__ = []
+
+
+def _gates(mode: str) -> int:
+    return {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}[mode]
+
+
+@register_op("RNN", hint="rnn")
+class RNNOp(OpDef):
+    """Multi-layer unidirectional recurrent block over lax.scan."""
+
+    params = [Param("state_size", int, required=True),
+              Param("num_layers", int, required=True),
+              Param("mode", str, required=True,
+                    enum=["rnn_relu", "rnn_tanh", "gru", "lstm"]),
+              Param("p", float, default=0.0),
+              Param("state_outputs", bool, default=False)]
+    needs_rng = True   # inter-layer dropout
+
+    def list_arguments(self, p):
+        names = ["data"]
+        for i in range(p.num_layers):
+            names += ["l%d_i2h_weight" % i, "l%d_i2h_bias" % i,
+                      "l%d_h2h_weight" % i, "l%d_h2h_bias" % i]
+        names.append("state")
+        if p.mode == "lstm":
+            names.append("state_cell")
+        return names
+
+    def list_outputs(self, p):
+        outs = ["output"]
+        if p.state_outputs:
+            outs.append("state")
+            if p.mode == "lstm":
+                outs.append("state_cell")
+        return outs
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None] * len(self.list_outputs(p)), []
+        T, B, E = d
+        H, L, G = p.state_size, p.num_layers, _gates(p.mode)
+        shapes = [d]
+        for i in range(L):
+            in_dim = E if i == 0 else H
+            shapes += [(G * H, in_dim), (G * H,), (G * H, H), (G * H,)]
+        state_shape = (L, B, H)
+        shapes.append(state_shape)
+        if p.mode == "lstm":
+            shapes.append(state_shape)
+        outs = [(T, B, H)]
+        if p.state_outputs:
+            outs.append(state_shape)
+            if p.mode == "lstm":
+                outs.append(state_shape)
+        return shapes, outs, []
+
+    def forward(self, p, inputs, aux, ctx):
+        H, L, G = p.state_size, p.num_layers, _gates(p.mode)
+        data = inputs[0]
+        weights = inputs[1:1 + 4 * L]
+        h0 = inputs[1 + 4 * L]
+        c0 = inputs[2 + 4 * L] if p.mode == "lstm" else None
+        mode = p.mode
+
+        def cell(wi, bi, wh, bh, x, h, c):
+            # one fused matmul pair per step: (B,E)@(E,GH) + (B,H)@(H,GH)
+            if mode == "gru":
+                # keep the two matmuls separate: the candidate slice
+                # needs the reset gate applied to the recurrent term only
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                r = jax.nn.sigmoid(gi[:, :H] + gh[:, :H])
+                z = jax.nn.sigmoid(gi[:, H:2 * H] + gh[:, H:2 * H])
+                n = jnp.tanh(gi[:, 2 * H:] + r * gh[:, 2 * H:])
+                return (1 - z) * n + z * h, None
+            g = x @ wi.T + bi + h @ wh.T + bh
+            if mode == "lstm":
+                # gate slice order matches models/lstm.py lstm_cell:
+                # [in, transform, forget, out]
+                i = jax.nn.sigmoid(g[:, :H])
+                u = jnp.tanh(g[:, H:2 * H])
+                f = jax.nn.sigmoid(g[:, 2 * H:3 * H])
+                o = jax.nn.sigmoid(g[:, 3 * H:])
+                c_new = f * c + i * u
+                h_new = o * jnp.tanh(c_new)
+                return h_new, c_new
+            act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+            return act(g), None
+
+        layer_in = data
+        finals_h, finals_c = [], []
+        keys = (jax.random.split(ctx.rng, L)
+                if (ctx.rng is not None and p.p > 0.0) else [None] * L)
+        for i in range(L):
+            wi, bi, wh, bh = weights[4 * i:4 * i + 4]
+            h_init = h0[i]
+            c_init = c0[i] if c0 is not None else jnp.zeros_like(h_init)
+
+            def step(carry, x, wi=wi, bi=bi, wh=wh, bh=bh):
+                h, c = carry
+                h_new, c_new = cell(wi, bi, wh, bh, x, h, c)
+                return (h_new, c_new if c_new is not None else c), h_new
+
+            (h_fin, c_fin), outs = lax.scan(step, (h_init, c_init),
+                                            layer_in)
+            finals_h.append(h_fin)
+            finals_c.append(c_fin)
+            layer_in = outs
+            if p.p > 0.0 and ctx.is_train and i < L - 1 \
+                    and keys[i] is not None:
+                keep = jax.random.bernoulli(keys[i], 1.0 - p.p,
+                                            layer_in.shape)
+                layer_in = jnp.where(keep, layer_in / (1.0 - p.p), 0.0)
+
+        outputs = [layer_in]
+        if p.state_outputs:
+            outputs.append(jnp.stack(finals_h))
+            if p.mode == "lstm":
+                outputs.append(jnp.stack(finals_c))
+        return outputs

@@ -1,0 +1,142 @@
+"""Fused scan-based RNN operator (ops/rnn.py): parity with the unrolled
+cells, gradients, and the drop-in lstm_unroll_scan builder."""
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import lstm_unroll, lstm_unroll_scan
+from check_utils import check_numeric_gradient, reldiff
+
+rng = np.random.RandomState(42)
+
+
+def _rnn_location(mode, T=3, B=2, E=4, H=5, L=1):
+    gates = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}[mode]
+    loc = {"data": rng.uniform(-0.5, 0.5, (T, B, E)).astype(np.float32)}
+    for i in range(L):
+        in_dim = E if i == 0 else H
+        loc["l%d_i2h_weight" % i] = rng.uniform(
+            -0.3, 0.3, (gates * H, in_dim)).astype(np.float32)
+        loc["l%d_i2h_bias" % i] = rng.uniform(
+            -0.1, 0.1, (gates * H,)).astype(np.float32)
+        loc["l%d_h2h_weight" % i] = rng.uniform(
+            -0.3, 0.3, (gates * H, H)).astype(np.float32)
+        loc["l%d_h2h_bias" % i] = rng.uniform(
+            -0.1, 0.1, (gates * H,)).astype(np.float32)
+    loc["state"] = rng.uniform(-0.2, 0.2, (L, B, H)).astype(np.float32)
+    if mode == "lstm":
+        loc["state_cell"] = rng.uniform(-0.2, 0.2,
+                                        (L, B, H)).astype(np.float32)
+    return loc
+
+
+@pytest.mark.parametrize("mode", ["rnn_tanh", "gru", "lstm"])
+def test_rnn_op_shapes_and_grad(mode):
+    x = mx.sym.Variable("data")
+    sym = mx.sym.RNN(x, state_size=5, num_layers=1, mode=mode, name="r")
+    loc = _rnn_location(mode)
+    shapes = {k: v.shape for k, v in loc.items()}
+    # rename auto-created arg names to match location keys
+    args = sym.list_arguments()
+    loc2 = {}
+    for a in args:
+        base = a.replace("r_", "", 1) if a.startswith("r_") else a
+        loc2[a] = loc[base]
+    _, out_shapes, _ = sym.infer_shape(
+        **{k: v.shape for k, v in loc2.items()})
+    assert tuple(out_shapes[0]) == (3, 2, 5)
+    check_numeric_gradient(sym, loc2, numeric_eps=1e-2, check_eps=0.08)
+
+
+def test_rnn_op_state_outputs():
+    x = mx.sym.Variable("data")
+    sym = mx.sym.RNN(x, state_size=5, num_layers=2, mode="lstm",
+                     state_outputs=True, name="r")
+    loc = _rnn_location("lstm", L=2)
+    args = sym.list_arguments()
+    loc2 = {a: loc[a.replace("r_", "", 1) if a.startswith("r_") else a]
+            for a in args}
+    ex = sym.simple_bind(mx.current_context(), grad_req="null",
+                         **{k: v.shape for k, v in loc2.items()})
+    for k, v in loc2.items():
+        ex.arg_dict[k][:] = v
+    ex.forward(is_train=False)
+    assert len(ex.outputs) == 3
+    assert ex.outputs[0].shape == (3, 2, 5)   # output
+    assert ex.outputs[1].shape == (2, 2, 5)   # final h, both layers
+    assert ex.outputs[2].shape == (2, 2, 5)   # final c
+    # final h of the last layer equals output at the last timestep
+    assert np.allclose(ex.outputs[1].asnumpy()[-1],
+                       ex.outputs[0].asnumpy()[-1], atol=1e-6)
+
+
+@pytest.mark.parametrize("layers", [1, 2])
+def test_scan_lstm_matches_unrolled(layers):
+    """lstm_unroll_scan and lstm_unroll share weight names, gate layout,
+    and semantics: identical params -> identical outputs and gradients."""
+    T, B, V, H, E = 4, 3, 11, 6, 5
+    net_a = lstm_unroll(layers, T, V, H, E, V)
+    net_b = lstm_unroll_scan(layers, T, V, H, E, V)
+
+    shapes = {"data": (B, T), "softmax_label": (B, T)}
+    for i in range(layers):
+        shapes["l%d_init_c" % i] = (B, H)
+        shapes["l%d_init_h" % i] = (B, H)
+
+    vals = {"data": rng.randint(0, V, (B, T)).astype(np.float32),
+            "softmax_label": rng.randint(0, V, (B, T)).astype(np.float32)}
+    for i in range(layers):
+        vals["l%d_init_c" % i] = np.zeros((B, H), np.float32)
+        vals["l%d_init_h" % i] = np.zeros((B, H), np.float32)
+
+    outs, grads = [], []
+    for net in (net_a, net_b):
+        arg_shapes, _, _ = net.infer_shape(**shapes)
+        names = net.list_arguments()
+        ex = net.simple_bind(mx.current_context(), grad_req="write",
+                             **shapes)
+        prng = np.random.RandomState(7)
+        for n, s in zip(names, arg_shapes):
+            if n in vals:
+                ex.arg_dict[n][:] = vals[n]
+            else:
+                ex.arg_dict[n][:] = prng.uniform(-0.2, 0.2, s)
+        ex.forward(is_train=True)
+        ex.backward()
+        outs.append(ex.outputs[0].asnumpy())
+        grads.append({n: ex.grad_dict[n].asnumpy() for n in names
+                      if ex.grad_dict.get(n) is not None
+                      and "init" not in n and n != "data"
+                      and n != "softmax_label"})
+    assert reldiff(outs[0], outs[1]) < 1e-4
+    for k in grads[0]:
+        assert reldiff(grads[0][k], grads[1][k]) < 1e-3, k
+
+
+def test_scan_lstm_trains():
+    """End-to-end: the scan form converges on a toy copy task through the
+    fused Module path."""
+    T, B, V, H, E = 6, 8, 5, 32, 16
+    mx.random.seed(0)
+    net = lstm_unroll_scan(1, T, V, H, E, V)
+    n = 128
+    X = rng.randint(1, V, (n, T)).astype(np.float32)
+    y = X.copy()   # predict the input token (easy memorization)
+    data = {"data": X,
+            "l0_init_c": np.zeros((n, H), np.float32),
+            "l0_init_h": np.zeros((n, H), np.float32)}
+    it = mx.io.NDArrayIter(data, {"softmax_label": y}, batch_size=B)
+    mod = mx.mod.Module(net, data_names=("data", "l0_init_c", "l0_init_h"),
+                        context=mx.current_context())
+    mod.fit(it, num_epoch=25, eval_metric="ce", optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    it.reset()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0].asnumpy()       # (T*B, V) t-major
+    pred = out.reshape(T, B, V).argmax(axis=2).T
+    acc = (pred == batch.label[0].asnumpy()).mean()
+    assert acc > 0.9, acc

@@ -1,0 +1,7 @@
+"""TPU re-run of tests/test_rnn_op.py (reference: tests/python/gpu/
+test_operator_gpu.py re-collects the unit suite on the accelerator)."""
+from _mirror import tpu_gate
+
+pytestmark = tpu_gate()
+
+from test_rnn_op import *  # noqa: F401,F403,E402
