@@ -7,6 +7,8 @@ multi-host = the same mesh spanning processes over ICI+DCN.
 """
 from .mesh import make_mesh, dp_sharding, replicated, Mesh, NamedSharding, PartitionSpec
 from .data_parallel import DPTrainStep
+from .pipeline import GPipeTrainStep, pipeline_apply
 
 __all__ = ["make_mesh", "dp_sharding", "replicated", "Mesh", "NamedSharding",
-           "PartitionSpec", "DPTrainStep"]
+           "PartitionSpec", "DPTrainStep", "GPipeTrainStep",
+           "pipeline_apply"]

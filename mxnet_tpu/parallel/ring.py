@@ -125,18 +125,11 @@ def make_ring_attention(mesh: Mesh, axis: str = "sp", causal: bool = False,
 
     Returns fn(q, k, v) taking GLOBAL (B, T, H, D) arrays sharded on T.
     """
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from .mesh import shard_map_norep
 
     inner = ring_attention if impl == "ring" else ulysses_attention
     fn = functools.partial(inner, axis_name=axis, causal=causal)
     spec = P(None, axis, None, None)
-    try:
-        sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec, check_vma=False)
-    except TypeError:  # older shard_map spelling
-        sharded = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                            out_specs=spec, check_rep=False)
+    sharded = shard_map_norep(fn, mesh, in_specs=(spec, spec, spec),
+                              out_specs=spec)
     return jax.jit(sharded)
