@@ -163,6 +163,19 @@ class BucketingModule(BaseModule):
             items = [(k, v[0], v[1]) for k, v in bucket_shapes.items()]
         else:
             items = [tuple(it) for it in bucket_shapes]
+        # already-bound but still-cold buckets (e.g. the default bucket
+        # right after bind(): never forwarded, empty executable cache)
+        # get warmed at their bound shapes too — a prepared module must
+        # not compile anything inside the loop.  Buckets that have
+        # already run keep their live outputs/gradients untouched.
+        listed = {it[0] for it in items}
+        for key, mod in self._buckets.items():
+            if key in listed:
+                continue
+            cold = all(not ex._jit_cache
+                       for ex in mod._exec_group.execs)
+            if cold:
+                items.append((key, mod._data_shapes, mod._label_shapes))
 
         keep = self._curr_module
         for key, data_shapes, label_shapes in items:

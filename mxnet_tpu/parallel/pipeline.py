@@ -43,6 +43,12 @@ def pipeline_apply(stage_fn: Callable, mesh: Mesh, stacked_params, micros,
     over `axis`); micros has leading dim M (replicated).
     """
     S = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                "stacked param leading dim %d != pipeline stages %d "
+                "(each leaf must stack one slice per pp-axis device)"
+                % (leaf.shape[0], S))
 
     def run(params, micros_in):
         # params leaves: (1, ...) — this device's stage slice
@@ -86,9 +92,10 @@ def pipeline_apply(stage_fn: Callable, mesh: Mesh, stacked_params, micros,
 class GPipeTrainStep:
     """Microbatched pipeline training step over a ``pp`` mesh axis.
 
-    model: head_fn(head_params, x) -> h0        (replicated, e.g. encoder)
-           S x stage_fn(stage_params_i, h) -> h (pipelined stack)
-           loss_fn(tail_params, h, label) -> scalar loss (replicated head)
+    model: S x stage_fn(stage_params_i, h) -> h  (pipelined stack)
+           loss_fn(tail_params, h, label) -> scalar loss (replicated
+           tail; put any non-pipelined encoder/embedding inside stage 0's
+           parameters or precompute it into the input batch)
 
     Gradients flow back through the pipeline via autodiff (reverse
     ppermute hops); the optimizer update (SGD) runs replicated — the

@@ -338,6 +338,9 @@ def test_bucketing_prepare_precompiles():
     for key in (4, 6):
         for ex in mod._buckets[key]._exec_group.execs:
             assert ex._jit_cache, key
+    cache_snapshot = {key: [set(ex._jit_cache) for ex in
+                            mod._buckets[key]._exec_group.execs]
+                      for key in mod._buckets}
     # prepare must not disturb the current module or training
     assert mod._curr_module is mod._buckets[8]
     mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
@@ -357,6 +360,12 @@ def test_bucketing_prepare_precompiles():
     params_after = mod.get_params()[0]
     assert any(np.abs(params_after[k].asnumpy() - params_before[k]).max() > 0
                for k in params_before)
+    # the docs/bucketing.md guarantee: a prepared run triggers no new
+    # program compilation inside the training loop
+    for key, snaps in cache_snapshot.items():
+        now = [set(ex._jit_cache) for ex in
+               mod._buckets[key]._exec_group.execs]
+        assert now == snaps, (key, snaps, now)
 
 
 def test_bucketing_prepare_keeps_shared_params_consistent():
@@ -405,3 +414,37 @@ def test_bucketing_prepare_keeps_shared_params_consistent():
     pb = run(prepared=False)
     for k in pb:
         assert np.abs(pa[k] - pb[k]).max() < 1e-6, k
+
+
+def test_bucketing_prepare_preserves_live_state():
+    """prepare() must not clobber outputs/gradients of buckets that have
+    already run; only cold buckets get the zero-batch warmup."""
+    np.random.seed(1)
+    mx.random.seed(1)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=10, output_dim=8, name="emb")
+        feat = mx.sym.sum_axis(emb, axis=1)
+        net = mx.sym.FullyConnected(feat, num_hidden=2, name="out")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.current_context())
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    from mxnet_tpu.io import DataBatch
+    X = np.random.randint(0, 10, (8, 8)).astype(np.float32)
+    y = (X.sum(axis=1) > 36).astype(np.float32)
+    b = DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)],
+                  bucket_key=8, pad=0,
+                  provide_data=[("data", (8, 8))],
+                  provide_label=[("softmax_label", (8,))])
+    mod.forward(b, is_train=True)
+    live_out = mod.get_outputs()[0].asnumpy().copy()
+
+    mod.prepare({4: ([("data", (8, 4))], [("softmax_label", (8,))])})
+    # the default bucket already ran: its outputs survive prepare
+    assert np.allclose(mod.get_outputs()[0].asnumpy(), live_out)
+    assert 4 in mod._buckets
