@@ -78,12 +78,7 @@ def build_module(batch=32, seq_len=32, num_hidden=200, num_embed=200,
     return mod, mx.io.DataBatch(data=data, label=label)
 
 
-def _sync(mod):
-    import jax
-    if mod._fused_state is not None:
-        jax.block_until_ready(next(iter(mod._fused_state["params"].values())))
-    else:
-        mod.get_outputs()[0].asnumpy()
+from bench import _sync  # noqa: E402  (same sync rule for both benches)
 
 
 def run(batch=32, seq_len=32, warmup=5, iters=50, windows=3):

@@ -166,32 +166,46 @@ class BucketingModule(BaseModule):
         # already-bound but still-cold buckets (e.g. the default bucket
         # right after bind(): never forwarded, empty executable cache)
         # get warmed at their bound shapes too — a prepared module must
-        # not compile anything inside the loop.  Buckets that have
-        # already run keep their live outputs/gradients untouched.
+        # not compile anything inside the loop.
         listed = {it[0] for it in items}
         for key, mod in self._buckets.items():
-            if key in listed:
-                continue
-            cold = all(not ex._jit_cache
-                       for ex in mod._exec_group.execs)
-            if cold:
+            if key not in listed and self._is_cold(mod):
                 items.append((key, mod._data_shapes, mod._label_shapes))
 
         keep = self._curr_module
         for key, data_shapes, label_shapes in items:
             self.switch_bucket(key, data_shapes, label_shapes)
             mod = self._curr_module
+            if not self._is_cold(mod):
+                # already compiled AND holding live outputs/gradients in
+                # its (shared) exec group — warming again would clobber
+                # them for nothing
+                continue
             batch = DataBatch(
                 data=[nd_zeros(s) for _, s in data_shapes],
                 label=[nd_zeros(s) for _, s in (label_shapes or [])],
                 bucket_key=key,
                 provide_data=list(data_shapes),
                 provide_label=list(label_shapes) if label_shapes else None)
-            mod.forward(batch, is_train=self.for_training)
-            if self.for_training:
-                mod.backward()
+            if mod._fused is not None and self.for_training:
+                # fused single-program path: compile the donated step on
+                # a throwaway copy of the state (running the real step
+                # would both donate the live buffers and apply a
+                # zero-gradient optimizer update)
+                mod._fused_warmup(batch)
+            else:
+                mod.forward(batch, is_train=self.for_training)
+                if self.for_training:
+                    mod.backward()
         waitall()
         self._curr_module = keep
+
+    @staticmethod
+    def _is_cold(mod):
+        """True when no program has been compiled for this bucket yet."""
+        if mod._fused is not None:
+            return mod._fused._step is None
+        return all(not ex._jit_cache for ex in mod._exec_group.execs)
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=None, force_init=False):

@@ -116,6 +116,17 @@ class FusedTrainStep:
         return {"params": params, "opt": opt, "aux": aux, "fixed": fixed,
                 "t": t}
 
+    def hparam_signature(self):
+        """Snapshot of the optimizer hyperparameters baked into the
+        compiled step (everything except lr, which rides in as a runtime
+        scalar).  Module.update compares this per batch: a mutation
+        (set_lr_mult, wd change, ...) drops back to the classic path,
+        which resolves them per update like the reference."""
+        opt = self.optimizer
+        return (tuple(sorted(opt.lr_mult.items())),
+                tuple(sorted(opt.wd_mult.items())),
+                opt.wd, opt.rescale_grad, opt.clip_gradient)
+
     def make_batch(self, data_batch) -> Dict[str, jnp.ndarray]:
         """Shard one DataBatch over the dp axis of the mesh."""
         sh = self._batched()

@@ -369,6 +369,7 @@ class Module(BaseModule):
                 self._fixed_param_names, self._optimizer,
                 label_shapes=self._label_shapes, remat=remat,
                 compute_dtype=cdt)
+            self._fused_hsig = self._fused.hparam_signature()
         except MXNetError:
             self._fused = None
 
@@ -450,6 +451,20 @@ class Module(BaseModule):
             from .. import random as _random
             self._fused_key = _random.new_key()
 
+    def _fused_warmup(self, data_batch):
+        """Compile the fused step program off the hot loop without
+        touching training state: the step runs on a throwaway deep copy
+        (the program donates its inputs, so the live state must not be
+        passed), and the compiled executable is cached by shape/dtype so
+        the first real batch replays it."""
+        assert self._fused is not None
+        import jax
+        import jax.numpy as jnp
+        self._fused_ensure_state()
+        pend = self._fused.make_batch(data_batch)
+        state_copy = jax.tree_util.tree_map(jnp.copy, self._fused_state)
+        self._fused.step(state_copy, pend, self._fused_key)
+
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
         self._disable_fused("optimizer borrowed")
@@ -510,16 +525,22 @@ class Module(BaseModule):
             self.optimizer_initialized
         self._params_dirty = True
         if self._fused is not None and self._fused_pending is not None:
-            self._fused_t += 1
-            # scheduler parity: one optimizer step per batch, lr resolved
-            # in python and fed in as a scalar (no recompile)
-            self._optimizer.num_update = max(self._optimizer.num_update,
-                                             self._fused_t)
-            self._fused_state, outs = self._fused.step(
-                self._fused_state, self._fused_pending, self._fused_key)
-            self._fused_outputs = [NDArray(o) for o in outs]
-            self._fused_pending = None
-            return
+            if self._fused.hparam_signature() != self._fused_hsig:
+                # the program baked the old lr_mult/wd/rescale/clip;
+                # honor the mutation like the classic path does (the
+                # pending batch is replayed through the exec group)
+                self._disable_fused("optimizer hyperparameters changed")
+            else:
+                self._fused_t += 1
+                # scheduler parity: one optimizer step per batch, lr
+                # resolved in python and fed in as a scalar (no recompile)
+                self._optimizer.num_update = max(self._optimizer.num_update,
+                                                 self._fused_t)
+                self._fused_state, outs = self._fused.step(
+                    self._fused_state, self._fused_pending, self._fused_key)
+                self._fused_outputs = [NDArray(o) for o in outs]
+                self._fused_pending = None
+                return
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
                                       self._exec_group.grad_arrays,

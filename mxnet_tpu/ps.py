@@ -164,11 +164,20 @@ class Scheduler:
         # one connection per role-process; scheduler exits once every worker
         # has sent "stop" and every connection closed.
         conns_expected = self.num_workers + self.num_servers
-        for _ in range(conns_expected):
+        accepted = 0
+        while accepted < conns_expected:
             try:
                 conn = self.listener.accept()
-            except (OSError, EOFError):
-                break   # listener closed by _abort during rendezvous
+            except Exception:
+                # listener closed by _abort -> stop accepting; anything
+                # else (failed auth handshake, stray probe/reset) must
+                # not consume a rendezvous slot — keep accepting
+                if self._abort_reason is not None:
+                    break
+                logging.getLogger(__name__).warning(
+                    "scheduler: dropped a failed connection handshake")
+                continue
+            accepted += 1
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)
             t.start()

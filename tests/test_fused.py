@@ -386,3 +386,29 @@ def test_disable_fused_carries_momentum():
     classic = run(disable_after=0)
     for k in classic:
         assert np.abs(mixed[k] - classic[k]).max() < 1e-4, k
+
+
+def test_fused_honors_hyperparameter_mutation():
+    """Mutating optimizer hyperparameters mid-training (set_lr_mult to
+    freeze a layer — reference API) must take effect: the fused program
+    baked the old values, so the module falls back to the classic path."""
+    mx.random.seed(5)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    it = _data()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+    assert mod._fused is not None
+
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True); mod.backward(); mod.update()
+    frozen = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+
+    mod._optimizer.set_lr_mult({"fc1_weight": 0.0})   # freeze fc1
+    for _ in range(3):
+        mod.forward(batch, is_train=True); mod.backward(); mod.update()
+    assert mod._fused is None   # dropped to the classic path
+    after = mod.get_params()[0]
+    assert np.allclose(after["fc1_weight"].asnumpy(), frozen), \
+        "frozen layer moved"
+    assert np.abs(after["fc2_weight"].asnumpy()).sum() > 0
