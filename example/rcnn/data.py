@@ -1,0 +1,84 @@
+"""Synthetic detection data + ROI minibatch sampling for the Fast R-CNN
+example (reference example/rcnn/rcnn/{minibatch,data_iter}.py capability).
+
+Images contain one bright rectangle per class on a noisy background;
+proposals are jittered copies of ground truth plus random background
+boxes, labeled fg/bg by IoU with bbox-regression targets — the standard
+Fast R-CNN minibatch recipe in miniature."""
+import numpy as np
+
+from rcnn_util import bbox_overlaps, bbox_transform
+
+
+def make_image(rng, size=64, num_classes=3):
+    """One (3, size, size) image with a single object; returns (img,
+    gt_box, gt_class in 1..num_classes)."""
+    img = rng.rand(3, size, size).astype(np.float32) * 0.2
+    cls = rng.randint(1, num_classes + 1)
+    w = rng.randint(size // 4, size // 2)
+    h = rng.randint(size // 4, size // 2)
+    x1 = rng.randint(0, size - w)
+    y1 = rng.randint(0, size - h)
+    # class identity encoded in which channel lights up
+    img[cls - 1, y1:y1 + h, x1:x1 + w] = 1.0
+    return img, np.array([x1, y1, x1 + w - 1, y1 + h - 1], np.float32), cls
+
+
+def sample_rois(rng, gt_box, gt_class, num_rois=16, fg_frac=0.5,
+                size=64, num_classes=3, fg_thresh=0.5):
+    """ROI minibatch: jittered ground-truth copies + random background
+    boxes; labels by IoU; bbox targets only on foreground rois
+    (class-specific slots, reference minibatch.py)."""
+    n_fg = int(num_rois * fg_frac)
+    rois = []
+    for _ in range(num_rois):
+        if len(rois) < n_fg:
+            # perturb shift AND scale so foreground training covers the
+            # whole IoU 0.5..1.0 band (proposals at test time are dense
+            # anchors, not near-exact boxes)
+            cx = (gt_box[0] + gt_box[2]) / 2 + rng.uniform(-6, 6)
+            cy = (gt_box[1] + gt_box[3]) / 2 + rng.uniform(-6, 6)
+            w = (gt_box[2] - gt_box[0] + 1) * rng.uniform(0.7, 1.4)
+            h = (gt_box[3] - gt_box[1] + 1) * rng.uniform(0.7, 1.4)
+            box = np.clip([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], 0, size - 1)
+        else:
+            w = rng.randint(8, size // 2)
+            h = rng.randint(8, size // 2)
+            x1 = rng.randint(0, size - w)
+            y1 = rng.randint(0, size - h)
+            box = np.array([x1, y1, x1 + w - 1, y1 + h - 1], np.float32)
+        rois.append(box)
+    rois = np.asarray(rois, np.float32)
+    ious = bbox_overlaps(rois, gt_box[None])[:, 0]
+    labels = np.where(ious >= fg_thresh, gt_class, 0).astype(np.float32)
+
+    targets = np.zeros((num_rois, 4 * (num_classes + 1)), np.float32)
+    weights = np.zeros_like(targets)
+    fg = labels > 0
+    if fg.any():
+        deltas = bbox_transform(rois[fg], np.tile(gt_box, (fg.sum(), 1)))
+        for i, roi_i in enumerate(np.where(fg)[0]):
+            c = int(labels[roi_i])
+            targets[roi_i, 4 * c:4 * c + 4] = deltas[i]
+            weights[roi_i, 4 * c:4 * c + 4] = 1.0
+    return rois, labels, targets, weights
+
+
+def make_batch(rng, batch_images=2, num_rois=16, size=64, num_classes=3):
+    """Stacked Fast R-CNN inputs: data (B,3,S,S), rois (B*R, 5) with the
+    batch index in column 0, labels/targets/weights flattened."""
+    data, all_rois, labels, targets, weights = [], [], [], [], []
+    for b in range(batch_images):
+        img, gt, cls = make_image(rng, size, num_classes)
+        r, l, t, w = sample_rois(rng, gt, cls, num_rois, size=size,
+                                 num_classes=num_classes)
+        data.append(img)
+        all_rois.append(np.concatenate(
+            [np.full((num_rois, 1), b, np.float32), r], axis=1))
+        labels.append(l)
+        targets.append(t)
+        weights.append(w)
+    return (np.stack(data), np.concatenate(all_rois),
+            np.concatenate(labels), np.concatenate(targets),
+            np.concatenate(weights))

@@ -1,0 +1,89 @@
+"""Train Fast R-CNN on synthetic detection data (reference
+example/rcnn/train.py + rcnn/solver.py capability): joint softmax
+classification over ROIs + smooth-L1 bbox regression, through the Module
+API with a custom multi-loss metric.
+
+    python train_fast_rcnn.py --num-epochs 8
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models.rcnn import get_fast_rcnn
+from data import make_batch
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tpus", type=str)
+    parser.add_argument("--num-classes", type=int, default=3)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--batches-per-epoch", type=int, default=16)
+    parser.add_argument("--batch-images", type=int, default=2)
+    parser.add_argument("--num-rois", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=0.005)
+    parser.add_argument("--model-prefix", type=str)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    C = args.num_classes + 1   # + background
+    net = get_fast_rcnn(num_classes=C, pooled_size=(4, 4),
+                        spatial_scale=0.5, small=True)
+
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else [mx.cpu()]
+    R = args.batch_images * args.num_rois
+    mod = mx.mod.Module(net, data_names=("data", "rois"),
+                        label_names=("label", "bbox_target", "bbox_weight"),
+                        context=ctx)
+    mod.bind(data_shapes=[("data", (args.batch_images, 3, 64, 64)),
+                          ("rois", (R, 5))],
+             label_shapes=[("label", (R,)),
+                           ("bbox_target", (R, 4 * C)),
+                           ("bbox_weight", (R, 4 * C))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9, "wd": 5e-4})
+
+    rng = np.random.RandomState(0)
+    from mxnet_tpu.io import DataBatch
+    for epoch in range(args.num_epochs):
+        correct = total = 0
+        bbox_loss_sum = 0.0
+        for _ in range(args.batches_per_epoch):
+            data, rois, labels, targets, weights = make_batch(
+                rng, args.batch_images, args.num_rois,
+                num_classes=args.num_classes)
+            batch = DataBatch(
+                data=[mx.nd.array(data), mx.nd.array(rois)],
+                label=[mx.nd.array(labels), mx.nd.array(targets),
+                       mx.nd.array(weights)])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            cls_prob, bbox_loss = mod.get_outputs()
+            pred = cls_prob.asnumpy().argmax(axis=1)
+            correct += (pred == labels).sum()
+            total += len(labels)
+            bbox_loss_sum += float(np.abs(bbox_loss.asnumpy()).mean())
+        logging.info("Epoch[%d] roi-accuracy=%.4f bbox-l1=%.4f", epoch,
+                     correct / total,
+                     bbox_loss_sum / args.batches_per_epoch)
+
+    acc = correct / total
+    print("final roi accuracy: %.4f" % acc)
+    assert acc > 0.8, acc
+    if args.model_prefix:
+        arg_p, aux_p = mod.get_params()
+        mx.model.save_checkpoint(args.model_prefix, args.num_epochs,
+                                 net, arg_p, aux_p)
+        logging.info("saved %s", args.model_prefix)
+
+
+if __name__ == "__main__":
+    main()

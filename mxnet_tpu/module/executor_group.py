@@ -75,22 +75,43 @@ class DataParallelExecutorGroup:
             else:
                 grad_req[name] = "null"
 
+        # inputs whose leading dim is NOT the batch size (Fast R-CNN rois
+        # and roi-level labels, attention masks, ...) are not sliced —
+        # each device gets the full array (with several devices such
+        # inputs cannot be split consistently with the image slice, the
+        # same limitation that made the reference's rcnn example carry
+        # its own MutableModule)
+        def _batch_major(s):
+            return len(s) >= 1 and s[0] == self.batch_size
+
+        if len(self.contexts) > 1 and any(
+                not _batch_major(s)
+                for _, s in data_shapes + (label_shapes or [])):
+            raise MXNetError(
+                "inputs whose leading dim is not the batch size cannot be "
+                "split across devices (they are replicated whole); bind "
+                "on a single context or restructure the input")
+
         self.execs = []
         for i, ctx in enumerate(self.contexts):
             n = self.slices[i].stop - self.slices[i].start
-            shapes = {name: tuple([n] + list(s[1:]))
+            shapes = {name: (tuple([n] + list(s[1:]))
+                             if _batch_major(s) else tuple(s))
                       for name, s in data_shapes + (label_shapes or [])}
             shared_exec = shared_group.execs[i] if shared_group else None
             self.execs.append(self.symbol.simple_bind(
                 ctx, grad_req=grad_req, type_dict=self.input_types,
                 shared_exec=shared_exec, **shapes))
 
-        self.data_arrays = [
-            [(self.slices[i], e.arg_dict[name]) for i, e in enumerate(self.execs)]
-            for name in self.data_names]
-        self.label_arrays = [
-            [(self.slices[i], e.arg_dict[name]) for i, e in enumerate(self.execs)]
-            for name in self.label_names]
+        def _targets(name, shape):
+            full = slice(0, shape[0] if shape else 1)
+            return [((self.slices[i] if _batch_major(shape) else full),
+                     e.arg_dict[name]) for i, e in enumerate(self.execs)]
+
+        self.data_arrays = [_targets(name, dict(data_shapes)[name])
+                            for name in self.data_names]
+        self.label_arrays = [_targets(name, dict(label_shapes or [])[name])
+                             for name in self.label_names]
         self.param_arrays = [
             [e.arg_dict[name] for e in self.execs]
             for name in self.param_names]
@@ -132,8 +153,12 @@ class DataParallelExecutorGroup:
         for i, exe in enumerate(self.execs):
             out_grads_slice = None
             if out_grads is not None:
-                out_grads_slice = [g[self.slices[i].start:self.slices[i].stop]
-                                   for g in out_grads]
+                # slice only batch-major heads; roi-level outputs (rcnn)
+                # carry all rows on every device
+                out_grads_slice = [
+                    g[self.slices[i].start:self.slices[i].stop]
+                    if g.shape[0] == self.batch_size else g
+                    for g in out_grads]
             exe.backward(out_grads=out_grads_slice)
 
     def get_outputs(self, merge_multi_context=True):
@@ -153,7 +178,9 @@ class DataParallelExecutorGroup:
 
     def update_metric(self, eval_metric, labels):
         for texec, islice in zip(self.execs, self.slices):
-            labels_slice = [label[islice.start:islice.stop] for label in labels]
+            labels_slice = [label[islice.start:islice.stop]
+                            if label.shape[0] == self.batch_size else label
+                            for label in labels]
             eval_metric.update(labels_slice, texec.outputs)
 
     def install_monitor(self, mon):

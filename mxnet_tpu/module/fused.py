@@ -120,12 +120,17 @@ class FusedTrainStep:
         """Snapshot of the optimizer hyperparameters baked into the
         compiled step (everything except lr, which rides in as a runtime
         scalar).  Module.update compares this per batch: a mutation
-        (set_lr_mult, wd change, ...) drops back to the classic path,
-        which resolves them per update like the reference."""
+        (set_lr_mult, wd change, momentum/beta change, ...) drops back to
+        the classic path, which resolves them per update like the
+        reference."""
         opt = self.optimizer
+        # fused_update_fn closures capture these per-optimizer scalars
+        baked = tuple((k, getattr(opt, k, None)) for k in
+                      ("momentum", "beta1", "beta2", "epsilon", "rho",
+                       "gamma1", "gamma2", "eps"))
         return (tuple(sorted(opt.lr_mult.items())),
                 tuple(sorted(opt.wd_mult.items())),
-                opt.wd, opt.rescale_grad, opt.clip_gradient)
+                opt.wd, opt.rescale_grad, opt.clip_gradient, baked)
 
     def make_batch(self, data_batch) -> Dict[str, jnp.ndarray]:
         """Shard one DataBatch over the dp axis of the mesh."""

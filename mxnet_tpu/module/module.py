@@ -528,8 +528,11 @@ class Module(BaseModule):
             if self._fused.hparam_signature() != self._fused_hsig:
                 # the program baked the old lr_mult/wd/rescale/clip;
                 # honor the mutation like the classic path does (the
-                # pending batch is replayed through the exec group)
+                # pending batch is replayed through the exec group).
+                # _disable_fused syncs params (clearing the dirty flag);
+                # the classic update below makes them dirty again.
                 self._disable_fused("optimizer hyperparameters changed")
+                self._params_dirty = True
             else:
                 self._fused_t += 1
                 # scheduler parity: one optimizer step per batch, lr

@@ -165,18 +165,23 @@ class Scheduler:
         # has sent "stop" and every connection closed.
         conns_expected = self.num_workers + self.num_servers
         accepted = 0
+        from multiprocessing import AuthenticationError
         while accepted < conns_expected:
             try:
                 conn = self.listener.accept()
-            except Exception:
-                # listener closed by _abort -> stop accepting; anything
-                # else (failed auth handshake, stray probe/reset) must
-                # not consume a rendezvous slot — keep accepting
-                if self._abort_reason is not None:
-                    break
+            except (AuthenticationError, ConnectionResetError,
+                    EOFError) as e:
+                # a PER-CONNECTION handshake failure (bad authkey, stray
+                # probe, peer killed mid-auth) must not consume a
+                # rendezvous slot — keep accepting
                 logging.getLogger(__name__).warning(
-                    "scheduler: dropped a failed connection handshake")
+                    "scheduler: dropped a failed connection handshake "
+                    "(%s)", e)
                 continue
+            except OSError:
+                # listener-level failure: closed by _abort, fd
+                # exhaustion, ... — accepting again cannot succeed
+                break
             accepted += 1
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)

@@ -89,3 +89,19 @@ def test_train_cifar10_mirroring_synthetic():
                 "--num-examples", "64"], timeout=560)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "Train-accuracy" in res.stderr + res.stdout
+
+
+@pytest.mark.slow
+def test_rcnn_train_and_demo():
+    """Fast R-CNN example: synthetic ROI training to an accuracy gate,
+    then the dense-proposal detection demo finds the planted object."""
+    res = _run("example/rcnn",
+               ["train_fast_rcnn.py", "--num-epochs", "10",
+                "--model-prefix", "/tmp/rcnn_ci"], timeout=560)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "final roi accuracy" in res.stdout
+    res = _run("example/rcnn",
+               ["demo.py", "--model-prefix", "/tmp/rcnn_ci",
+                "--epoch", "10"], timeout=560)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "DEMO-OK" in res.stdout, res.stdout + res.stderr

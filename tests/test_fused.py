@@ -405,9 +405,16 @@ def test_fused_honors_hyperparameter_mutation():
     frozen = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
 
     mod._optimizer.set_lr_mult({"fc1_weight": 0.0})   # freeze fc1
-    for _ in range(3):
-        mod.forward(batch, is_train=True); mod.backward(); mod.update()
+    mod.forward(batch, is_train=True); mod.backward(); mod.update()
     assert mod._fused is None   # dropped to the classic path
+    # the very first post-fallback update must be visible to get_params
+    # (regression: the fallback sync cleared the dirty flag, hiding it)
+    first = mod.get_params()[0]["fc2_weight"].asnumpy().copy()
+    mod.forward(batch, is_train=True); mod.backward(); mod.update()
+    assert np.abs(mod.get_params()[0]["fc2_weight"].asnumpy()
+                  - first).max() > 0
+    for _ in range(2):
+        mod.forward(batch, is_train=True); mod.backward(); mod.update()
     after = mod.get_params()[0]
     assert np.allclose(after["fc1_weight"].asnumpy(), frozen), \
         "frozen layer moved"

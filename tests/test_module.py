@@ -448,3 +448,54 @@ def test_bucketing_prepare_preserves_live_state():
     # the default bucket already ran: its outputs survive prepare
     assert np.allclose(mod.get_outputs()[0].asnumpy(), live_out)
     assert 4 in mod._buckets
+
+
+def test_module_non_batch_major_inputs():
+    """Inputs whose leading dim is not the batch size (Fast R-CNN rois:
+    R rois over B images) must not be sliced to the batch dim by the
+    executor group (regression: rois (R,5) was silently rebound to (B,5)
+    and outputs collapsed)."""
+    rng = np.random.RandomState(0)
+    B, R = 2, 12
+    data = mx.sym.Variable("data")            # (B, 4)
+    rois = mx.sym.Variable("rois")            # (R, 2) [batch_idx, feat]
+    # roi-level feature: gather image feature rows by roi batch index
+    # via Embedding over the batch index is overkill — use a simple
+    # concat-able formulation: scores over rois from their own features
+    net = mx.sym.FullyConnected(rois, num_hidden=3, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, data_names=("rois",),
+                        label_names=("softmax_label",),
+                        context=mx.current_context())
+    # rois batch-major dim (R) deliberately != any data batch; label has
+    # R rows too
+    mod.bind(data_shapes=[("rois", (R, 2))],
+             label_shapes=[("softmax_label", (R,))])
+    mod.init_params()
+    from mxnet_tpu.io import DataBatch
+    X = rng.rand(R, 2).astype(np.float32)
+    y = rng.randint(0, 3, R).astype(np.float32)
+    b = DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+    mod.forward(b, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (R, 3), out.shape
+
+    # the mixed case: batch-major data (B) + non-batch-major rois (R)
+    net2 = mx.sym.Group([
+        mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("rois"), num_hidden=3,
+                                  name="fc2"), name="sm"),
+        mx.sym.BlockGrad(mx.sym.Variable("data"))])
+    mod2 = mx.mod.Module(net2, data_names=("data", "rois"),
+                         label_names=("sm_label",),
+                         context=mx.current_context())
+    mod2.bind(data_shapes=[("data", (B, 4)), ("rois", (R, 2))],
+              label_shapes=[("sm_label", (R,))], for_training=False)
+    mod2.init_params()
+    b2 = DataBatch(data=[mx.nd.array(rng.rand(B, 4).astype(np.float32)),
+                         mx.nd.array(X)],
+                   label=[mx.nd.array(y)])
+    mod2.forward(b2, is_train=False)
+    outs = mod2.get_outputs()
+    assert outs[0].shape == (R, 3)
+    assert outs[1].shape == (B, 4)
