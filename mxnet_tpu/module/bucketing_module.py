@@ -156,6 +156,14 @@ class BucketingModule(BaseModule):
         """
         assert self.binded and self.params_initialized, \
             "call bind and init_params before prepare"
+        # cold buckets share arg/grad arrays with the live bucket
+        # (simple_bind shared_exec), so warming them between backward()
+        # and update() would overwrite the live bucket's pending
+        # gradients with zero-batch ones
+        assert not getattr(self._curr_module, "_grads_pending", False), \
+            "prepare() must not be called between backward() and " \
+            "update(): warming shares (and would clobber) the live " \
+            "bucket's pending gradient arrays"
         from ..io import DataBatch
         from ..ndarray import zeros as nd_zeros, waitall
 
@@ -197,6 +205,10 @@ class BucketingModule(BaseModule):
                 mod.forward(batch, is_train=self.for_training)
                 if self.for_training:
                     mod.backward()
+                    # the warmup's zero-batch grads are throwaway — no
+                    # update() will consume them, so they must not trip
+                    # the pending-gradient guard on a later prepare()
+                    mod._grads_pending = False
         waitall()
         self._curr_module = keep
 

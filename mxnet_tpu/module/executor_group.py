@@ -26,7 +26,7 @@ class DataParallelExecutorGroup:
                  workload, data_shapes, label_shapes, param_names,
                  for_training, inputs_need_grad, shared_group=None,
                  input_types=None, logger=logging, fixed_param_names=None,
-                 grad_req="write"):
+                 grad_req="write", no_slice_names=None):
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -38,6 +38,10 @@ class DataParallelExecutorGroup:
         self.input_types = input_types
         self.logger = logger
         self.fixed_param_names = fixed_param_names or []
+        # inputs the caller declares are NOT batch-major even if their
+        # leading dim happens to equal the batch size (rcnn rois with
+        # num_rois == batch_size would otherwise be silently split)
+        self.no_slice = frozenset(no_slice_names or ())
         self.shared_group = shared_group
 
         self.batch_size = None
@@ -81,22 +85,24 @@ class DataParallelExecutorGroup:
         # inputs cannot be split consistently with the image slice, the
         # same limitation that made the reference's rcnn example carry
         # its own MutableModule)
-        def _batch_major(s):
-            return len(s) >= 1 and s[0] == self.batch_size
+        def _batch_major(name, s):
+            return (name not in self.no_slice
+                    and len(s) >= 1 and s[0] == self.batch_size)
 
         if len(self.contexts) > 1 and any(
-                not _batch_major(s)
-                for _, s in data_shapes + (label_shapes or [])):
+                not _batch_major(name, s)
+                for name, s in data_shapes + (label_shapes or [])):
             raise MXNetError(
-                "inputs whose leading dim is not the batch size cannot be "
-                "split across devices (they are replicated whole); bind "
-                "on a single context or restructure the input")
+                "inputs whose leading dim is not the batch size (or that "
+                "bind() marked no-slice) cannot be split across devices "
+                "(they are replicated whole); bind on a single context or "
+                "restructure the input")
 
         self.execs = []
         for i, ctx in enumerate(self.contexts):
             n = self.slices[i].stop - self.slices[i].start
             shapes = {name: (tuple([n] + list(s[1:]))
-                             if _batch_major(s) else tuple(s))
+                             if _batch_major(name, s) else tuple(s))
                       for name, s in data_shapes + (label_shapes or [])}
             shared_exec = shared_group.execs[i] if shared_group else None
             self.execs.append(self.symbol.simple_bind(
@@ -105,7 +111,7 @@ class DataParallelExecutorGroup:
 
         def _targets(name, shape):
             full = slice(0, shape[0] if shape else 1)
-            return [((self.slices[i] if _batch_major(shape) else full),
+            return [((self.slices[i] if _batch_major(name, shape) else full),
                      e.arg_dict[name]) for i, e in enumerate(self.execs)]
 
         self.data_arrays = [_targets(name, dict(data_shapes)[name])
@@ -177,10 +183,14 @@ class DataParallelExecutorGroup:
         return self.input_grad_arrays
 
     def update_metric(self, eval_metric, labels):
+        names = list(self.label_names or [])
+        names += [None] * (len(labels) - len(names))
         for texec, islice in zip(self.execs, self.slices):
             labels_slice = [label[islice.start:islice.stop]
-                            if label.shape[0] == self.batch_size else label
-                            for label in labels]
+                            if (name not in self.no_slice
+                                and label.shape[0] == self.batch_size)
+                            else label
+                            for name, label in zip(names, labels)]
             eval_metric.update(labels_slice, texec.outputs)
 
     def install_monitor(self, mon):

@@ -35,6 +35,20 @@ from ..ndarray import NDArray
 __all__ = ["FusedTrainStep"]
 
 
+def _hparams_undeclared(cls):
+    """True when the class providing this optimizer's fused_update_fn did
+    not also declare (or inherit from a more-derived class declaring)
+    ``fused_hparams`` — i.e. the baked-scalar snapshot could be blind to
+    state the closures capture."""
+    def definer(name):
+        for c in cls.__mro__:
+            if name in c.__dict__:
+                return c
+        return None
+    fu, fh = definer("fused_update_fn"), definer("fused_hparams")
+    return fh is None or not issubclass(fh, fu)
+
+
 class FusedTrainStep:
     """One donated XLA program per (shapes, dtypes): fwd+bwd+reduce+update.
 
@@ -68,6 +82,14 @@ class FusedTrainStep:
         fused = optimizer.fused_update_fn()
         if fused is None:
             raise MXNetError("optimizer has no fused form")
+        if _hparams_undeclared(type(optimizer)):
+            # a fused form whose baked scalars we cannot snapshot could be
+            # mutated mid-training without us noticing; refuse to fuse
+            raise MXNetError(
+                "optimizer %s overrides fused_update_fn without declaring "
+                "fused_hparams at the same (or a more derived) class; "
+                "falling back to the per-param update path"
+                % type(optimizer).__name__)
         self._opt_init, self._opt_update = fused
         # static per-param schedule factors (reference lr_mult/wd_mult and
         # the bias/gamma/beta wd rule, resolved by NAME not index)
@@ -124,10 +146,12 @@ class FusedTrainStep:
         the classic path, which resolves them per update like the
         reference."""
         opt = self.optimizer
-        # fused_update_fn closures capture these per-optimizer scalars
-        baked = tuple((k, getattr(opt, k, None)) for k in
-                      ("momentum", "beta1", "beta2", "epsilon", "rho",
-                       "gamma1", "gamma2", "eps"))
+        # each optimizer class declares which of its scalars the
+        # fused_update_fn closures capture (optimizer.fused_hparams);
+        # FusedTrainStep.__init__ refused any fused form without the
+        # declaration, so nothing baked can escape this snapshot
+        baked = tuple((k, getattr(opt, k, None))
+                      for k in sorted(opt.fused_hparams))
         return (tuple(sorted(opt.lr_mult.items())),
                 tuple(sorted(opt.wd_mult.items())),
                 opt.wd, opt.rescale_grad, opt.clip_gradient, baked)

@@ -74,6 +74,10 @@ class Module(BaseModule):
         self._fused_key = None
         self._monitor_installed = False
         self._borrowed_optimizer = False
+        # classic-path backward has run but update() hasn't: the exec
+        # group's grad arrays hold live gradients (guards bucketing
+        # prepare(), whose shared-exec warmup would clobber them)
+        self._grads_pending = False
         # set when this module's exec group is lent to a sibling (bucketing):
         # the shared arrays are then the single source of truth, so the
         # private donated fused state must never engage
@@ -175,12 +179,27 @@ class Module(BaseModule):
     # -- bind ----------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
+             grad_req="write", no_slice_names=None):
+        """``no_slice_names``: input/label names that must NOT be batch-
+        sliced across devices even when their leading dim equals the batch
+        size (e.g. rcnn rois with num_rois == batch_size); they are
+        replicated whole instead of silently split."""
         if force_rebind:
             self._reset_bind()
         if self.binded:
             self.logger.warning("Already binded, ignoring bind()")
             return
+        if no_slice_names:
+            # a typo here would silently re-enable the batch-slicing the
+            # caller asked to prevent — validate before any state changes
+            # so a failed bind leaves the module cleanly unbound
+            known = {n for n, _ in data_shapes}
+            known |= {n for n, _ in (label_shapes or [])}
+            unknown = sorted(set(no_slice_names) - known)
+            if unknown:
+                raise MXNetError("no_slice_names %s match no bound data/"
+                                 "label input (have: %s)"
+                                 % (unknown, sorted(known)))
 
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
@@ -192,6 +211,7 @@ class Module(BaseModule):
         self._data_shapes = list(data_shapes)
         self._label_shapes = list(label_shapes) if label_shapes else None
         self._grad_req = grad_req
+        self._no_slice_names = tuple(no_slice_names or ())
 
         shared_group = None
         if shared_module is not None:
@@ -213,7 +233,7 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group,
             logger=self.logger, fixed_param_names=self._fixed_param_names,
-            grad_req=grad_req)
+            grad_req=grad_req, no_slice_names=self._no_slice_names)
 
         if shared_module is not None:
             self.params_initialized = True
@@ -250,7 +270,8 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             self.for_training, self.inputs_need_grad, None,
             logger=self.logger, fixed_param_names=self._fixed_param_names,
-            grad_req=getattr(self, "_grad_req", "write"))
+            grad_req=getattr(self, "_grad_req", "write"),
+            no_slice_names=getattr(self, "_no_slice_names", ()))
         if self._fused is not None:
             self._fused.label_shapes = dict(self._label_shapes or [])
         if self.params_initialized:
@@ -370,7 +391,11 @@ class Module(BaseModule):
                 label_shapes=self._label_shapes, remat=remat,
                 compute_dtype=cdt)
             self._fused_hsig = self._fused.hparam_signature()
-        except MXNetError:
+        except MXNetError as e:
+            # _fusable() already vetted the config, so a refusal here is
+            # abnormal (e.g. fused_update_fn without a fused_hparams
+            # declaration) — surface why the slow path engaged
+            self.logger.warning("fused train step disabled: %s", e)
             self._fused = None
 
     def _disable_fused(self, reason, replay_backward=True):
@@ -518,6 +543,7 @@ class Module(BaseModule):
             self._disable_fused("explicit head gradients",
                                 replay_backward=False)
         self._exec_group.backward(out_grads=out_grads)
+        self._grads_pending = True
 
     def update(self):
         """reference module.py:377-394."""
@@ -554,6 +580,7 @@ class Module(BaseModule):
                            updater=self._updater,
                            num_device=len(self._context),
                            kvstore=self._kvstore)
+        self._grads_pending = False
 
     def _fused_live(self):
         return self._fused is not None and (self._fused_outputs is not None

@@ -119,6 +119,12 @@ class RNNOp(OpDef):
 
         layer_in = data
         finals_h, finals_c = [], []
+        if p.p > 0.0 and L > 1 and ctx.is_train and ctx.rng is None:
+            # silently training without the requested regularization would
+            # be invisible to the user; fail loudly instead
+            raise ValueError(
+                "RNN: p=%g inter-layer dropout requires an rng at training "
+                "time, but the executor supplied none" % p.p)
         keys = (jax.random.split(ctx.rng, L)
                 if (ctx.rng is not None and p.p > 0.0) else [None] * L)
         for i in range(L):

@@ -139,6 +139,13 @@ class Optimizer:
         step count. Returns None when the optimizer has no functional form
         (e.g. SGLD's host randomness); callers then fall back to the
         per-param NDArray update path.
+
+        Any class overriding this MUST also declare ``fused_hparams``: the
+        attribute names its closures bake in (momentum, betas, ...). The
+        fused step snapshots those per batch to detect mid-training
+        mutations; an optimizer that provides a fused form without the
+        declaration is not fused at all (classic path), so an undeclared
+        scalar can never be applied stale.
         """
         return None
 
@@ -149,6 +156,8 @@ register = Optimizer.register
 @register
 class SGD(Optimizer):
     """SGD with momentum and weight decay (reference optimizer.py:163)."""
+
+    fused_hparams = ("momentum",)
 
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
@@ -190,6 +199,8 @@ class SGD(Optimizer):
 @register
 class NAG(SGD):
     """Nesterov accelerated SGD (reference optimizer.py:235)."""
+
+    fused_hparams = ("momentum",)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -247,6 +258,8 @@ class ccSGD(SGD):
 class Adam(Optimizer):
     """Adam (reference optimizer.py:404; Kingma & Ba 2014)."""
 
+    fused_hparams = ("beta1", "beta2", "epsilon")
+
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, decay_factor=(1 - 1e-8), **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -299,6 +312,8 @@ class Adam(Optimizer):
 class AdaGrad(Optimizer):
     """AdaGrad (reference optimizer.py:475; Duchi et al 2011)."""
 
+    fused_hparams = ("float_stable_eps",)
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -331,6 +346,8 @@ class AdaGrad(Optimizer):
 @register
 class RMSProp(Optimizer):
     """RMSProp (reference optimizer.py:512; Tieleman & Hinton / Graves 2013)."""
+
+    fused_hparams = ("gamma1", "gamma2")
 
     def __init__(self, learning_rate=0.002, gamma1=0.95, gamma2=0.9, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
@@ -378,6 +395,8 @@ class RMSProp(Optimizer):
 class AdaDelta(Optimizer):
     """AdaDelta (reference optimizer.py:568; Zeiler 2012)."""
 
+    fused_hparams = ("rho", "epsilon")
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
         self.rho = rho
@@ -419,6 +438,8 @@ class AdaDelta(Optimizer):
 @register
 class Test(Optimizer):
     """Test optimizer: weight += grad (reference optimizer.py:620)."""
+
+    fused_hparams = ()
 
     def create_state(self, index, weight):
         return zeros(weight.shape, weight.context, dtype=weight.dtype)

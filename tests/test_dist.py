@@ -108,8 +108,11 @@ def test_gke_launcher_manifest():
     assert res.returncode == 0, res.stdout + res.stderr
     import yaml
     docs = {d["kind"]: d for d in yaml.safe_load_all(res.stdout)}
-    # headless Service backs the coordinator's per-pod DNS name
-    assert docs["Service"]["spec"]["clusterIP"] is None
+    # headless Service backs the coordinator's per-pod DNS name; the API
+    # requires the literal STRING "None" — a YAML null would leave the
+    # field unset and k8s would allocate a normal ClusterIP, so the
+    # {name}-0.{name} records the rendezvous depends on would not exist
+    assert docs["Service"]["spec"]["clusterIP"] == "None"
     job = docs["Job"]
     assert job["spec"]["completions"] == 4
     assert job["spec"]["completionMode"] == "Indexed"

@@ -419,3 +419,65 @@ def test_fused_honors_hyperparameter_mutation():
     assert np.allclose(after["fc1_weight"].asnumpy(), frozen), \
         "frozen layer moved"
     assert np.abs(after["fc2_weight"].asnumpy()).sum() > 0
+
+
+def test_undeclared_fused_hparams_disable_fusion():
+    """An optimizer that overrides fused_update_fn without declaring
+    fused_hparams could have a baked scalar mutated mid-training with no
+    fallback trigger — so such an optimizer must not fuse at all."""
+    import jax.numpy as jnp
+
+    @mx.optimizer.register
+    class Undeclared(mx.optimizer.Optimizer):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.secret = 1.0
+
+        def update(self, index, weight, grad, state):
+            self._update_count(index)
+            weight._set(weight._get()
+                        - self.secret * self._preprocess_grad(grad))
+
+        def fused_update_fn(self):
+            secret = self.secret
+
+            def init_state(w):
+                return None
+
+            def update(w, g, state, lr, wd, t):
+                return w - secret * g, None
+            return init_state, update
+
+    mod, _ = _train(False, optimizer="undeclared", optimizer_params={})
+    # even with fusion requested, the undeclared optimizer stays classic
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+        mod.fit(_data(), num_epoch=1, optimizer="undeclared",
+                optimizer_params={})
+        assert mod._fused is None
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_declared_fused_hparams_catch_mutation():
+    """A declared baked scalar mutated mid-training must drop the module
+    to the classic path (same contract as the built-in momentum test) —
+    including names the old hard-coded list missed (adagrad's
+    float_stable_eps)."""
+    mx.random.seed(5)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    it = _data()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="adagrad",
+                       optimizer_params={"learning_rate": 0.1})
+    assert mod._fused is not None
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True); mod.backward(); mod.update()
+    assert mod._fused is not None
+    mod._optimizer.float_stable_eps = 0.5   # mutate the baked scalar
+    mod.forward(batch, is_train=True); mod.backward(); mod.update()
+    assert mod._fused is None, \
+        "mutation of a declared baked hparam did not trigger fallback"

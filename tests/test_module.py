@@ -499,3 +499,82 @@ def test_module_non_batch_major_inputs():
     outs = mod2.get_outputs()
     assert outs[0].shape == (R, 3)
     assert outs[1].shape == (B, 4)
+
+
+def test_bucketing_prepare_rejects_pending_grads():
+    """prepare() between backward() and update() would clobber the live
+    bucket's pending gradients through the shared exec arrays — it must
+    refuse instead of corrupting the step."""
+    np.random.seed(2)
+    mx.random.seed(2)
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=10, output_dim=8, name="emb")
+        feat = mx.sym.sum_axis(emb, axis=1)
+        net = mx.sym.FullyConnected(feat, num_hidden=2, name="out")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.current_context())
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    from mxnet_tpu.io import DataBatch
+    X = np.random.randint(0, 10, (8, 8)).astype(np.float32)
+    y = (X.sum(axis=1) > 36).astype(np.float32)
+    b = DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)],
+                  bucket_key=8, pad=0,
+                  provide_data=[("data", (8, 8))],
+                  provide_label=[("softmax_label", (8,))])
+    mod.forward(b, is_train=True)
+    mod.backward()
+    if mod._curr_module._grads_pending:   # classic path: grads are live
+        with pytest.raises(AssertionError, match="between backward"):
+            mod.prepare({4: ([("data", (8, 4))], [("softmax_label", (8,))])})
+    mod.update()
+    # after the step commits, warming is safe again
+    mod.prepare({4: ([("data", (8, 4))], [("softmax_label", (8,))])})
+    assert 4 in mod._buckets
+    # the warmup's own throwaway backward must not trip the guard on a
+    # second prepare()
+    mod.prepare({6: ([("data", (8, 6))], [("softmax_label", (8,))])})
+    assert 6 in mod._buckets
+
+
+def test_no_slice_names_mark_coincident_batch_dim():
+    """An input whose leading dim coincidentally equals the batch size
+    (rcnn rois with num_rois == batch_size) can be marked no-slice at
+    bind time: multi-device binds then refuse to split it instead of
+    silently slicing, and single-device metric updates leave it whole."""
+    B = 4
+    rois = mx.sym.Variable("rois")            # (B, 3) but NOT batch-major
+    net = mx.sym.FullyConnected(rois, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    # multi-device: marked input cannot be split -> explicit error, not a
+    # silent per-device slice
+    mod = mx.mod.Module(net, data_names=("rois",),
+                        label_names=("softmax_label",),
+                        context=[mx.cpu(0), mx.cpu(1)])
+    with pytest.raises(mx.base.MXNetError, match="no-slice"):
+        mod.bind(data_shapes=[("rois", (B, 3))],
+                 label_shapes=[("softmax_label", (B,))],
+                 no_slice_names=("rois",))
+
+    # single device: binds fine and the exec group replicates it whole
+    mod = mx.mod.Module(net, data_names=("rois",),
+                        label_names=("softmax_label",),
+                        context=mx.cpu(0))
+    # a typo in the marker list fails eagerly instead of silently
+    # re-enabling the slicing it was meant to prevent
+    with pytest.raises(mx.base.MXNetError, match="match no bound"):
+        mod.bind(data_shapes=[("rois", (B, 3))],
+                 label_shapes=[("softmax_label", (B,))],
+                 no_slice_names=("roi",))
+    mod.bind(data_shapes=[("rois", (B, 3))],
+             label_shapes=[("softmax_label", (B,))],
+             no_slice_names=("rois",))
+    (slc, _), = mod._exec_group.data_arrays[0]
+    assert (slc.start, slc.stop) == (0, B)

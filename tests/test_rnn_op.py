@@ -140,3 +140,26 @@ def test_scan_lstm_trains():
     pred = out.reshape(T, B, V).argmax(axis=2).T
     acc = (pred == batch.label[0].asnumpy()).mean()
     assert acc > 0.9, acc
+
+
+def test_rnn_dropout_without_rng_raises():
+    """p>0 inter-layer dropout at training time with no rng threaded in
+    must fail loudly — silently training unregularized would be invisible."""
+    from mxnet_tpu.ops.registry import _OP_REGISTRY, OpContext
+    op = _OP_REGISTRY["RNN"]
+    p = op.parse_params({"state_size": 5, "num_layers": 2, "mode": "lstm",
+                         "p": 0.5})
+    loc = _rnn_location("lstm", L=2)
+    inputs = [loc[n] for n in op.list_arguments(p) if n != "data"]
+    inputs.insert(0, loc["data"])
+    with pytest.raises(ValueError, match="dropout requires an rng"):
+        op.forward(p, inputs, [], OpContext(is_train=True, rng=None))
+    # eval mode needs no rng (dropout is identity)
+    outs = op.forward(p, inputs, [], OpContext(is_train=False, rng=None))
+    assert outs[0].shape == (3, 2, 5)
+    # single-layer nets have no inter-layer dropout to lose: no raise
+    p1 = op.parse_params({"state_size": 5, "num_layers": 1, "mode": "lstm",
+                          "p": 0.5})
+    loc1 = _rnn_location("lstm", L=1)
+    ins1 = [loc1[n] for n in op.list_arguments(p1)]
+    op.forward(p1, ins1, [], OpContext(is_train=True, rng=None))

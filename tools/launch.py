@@ -132,7 +132,11 @@ kind: Service
 metadata:
   name: {name}
 spec:
-  clusterIP: null
+  # literal string "None" (quoted): a YAML null would leave the field
+  # unset and k8s would allocate a ClusterIP, so the headless per-pod
+  # DNS records ({name}-0.{name}) the Job's rendezvous needs would
+  # never exist
+  clusterIP: "None"
   selector:
     app: {name}
 ---
