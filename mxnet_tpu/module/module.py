@@ -605,8 +605,13 @@ class Module(BaseModule):
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and self.inputs_need_grad
-        return self._exec_group.get_input_grads(
+        grads = self._exec_group.get_input_grads(
             merge_multi_context=merge_multi_context)
+        # grad-only flows (backward without an optimizer/update) have now
+        # consumed the gradients: release the pending flag or bucketing
+        # prepare() would stay locked out with no update() to clear it
+        self._grads_pending = False
+        return grads
 
     def update_metric(self, eval_metric, labels):
         if self._fused_live():
