@@ -70,6 +70,9 @@ class Module(BaseModule):
         self._fused_state = None
         self._fused_pending = None
         self._fused_outputs = None
+        # post-step state stashed by an early commit (get_outputs between
+        # forward and update); update() installs it without re-running
+        self._fused_next = None
         self._fused_t = 0
         self._fused_key = None
         self._monitor_installed = False
@@ -165,6 +168,7 @@ class Module(BaseModule):
         self._fused_state = None
         self._fused_pending = None
         self._fused_outputs = None
+        self._fused_next = None
 
     def _sync_params_from_devices(self):
         if self._fused is not None and self._fused_state is not None:
@@ -263,6 +267,7 @@ class Module(BaseModule):
         # fused state itself is shape-independent and survives)
         self._fused_pending = None
         self._fused_outputs = None
+        self._fused_next = None
         self._data_shapes = list(data_shapes)
         self._label_shapes = list(label_shapes) if label_shapes else None
         self._exec_group = DataParallelExecutorGroup(
@@ -376,6 +381,7 @@ class Module(BaseModule):
         self._fused_state = None
         self._fused_pending = None
         self._fused_outputs = None
+        self._fused_next = None
         if not self._fusable():
             return
         import os
@@ -451,6 +457,7 @@ class Module(BaseModule):
         self._fused_state = None
         self._fused_pending = None
         self._fused_outputs = None
+        self._fused_next = None
         if pend is not None:
             # an uncommitted batch (forward recorded, update not yet run):
             # replay it through the exec group so the caller's next
@@ -490,6 +497,25 @@ class Module(BaseModule):
         state_copy = jax.tree_util.tree_map(jnp.copy, self._fused_state)
         self._fused.step(state_copy, pend, self._fused_key)
 
+    def _fused_commit_early(self):
+        """Run the pending batch's committed step on a COPY of the live
+        state: outputs land in _fused_outputs, the post-step state is
+        stashed in _fused_next for update() to install.  The pre-step
+        state survives so an hparam mutation between here and update()
+        can still take the classic-replay fallback, and a new forward()
+        can discard the speculation entirely."""
+        import jax
+        import jax.numpy as jnp
+        # resolve lr exactly as update() will (monotonic, so a discarded
+        # speculation leaves at most num_update == t+1 early)
+        self._optimizer.num_update = max(self._optimizer.num_update,
+                                         self._fused_t + 1)
+        state_copy = jax.tree_util.tree_map(jnp.copy, self._fused_state)
+        new_state, outs = self._fused.step(
+            state_copy, self._fused_pending, self._fused_key)
+        self._fused_outputs = [NDArray(o) for o in outs]
+        self._fused_next = (new_state, self._fused_outputs)
+
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
         self._disable_fused("optimizer borrowed")
@@ -517,6 +543,10 @@ class Module(BaseModule):
                 self._fused_ensure_state()
                 self._fused_pending = self._fused.make_batch(data_batch)
                 self._fused_outputs = None
+                # a stashed early commit belongs to the superseded batch;
+                # dropping it leaves params untouched (the speculative
+                # step ran on a copy), which is exactly eval semantics
+                self._fused_next = None
                 return
             if self._fused_state is not None:
                 # eval on the live training params without syncing them
@@ -565,9 +595,20 @@ class Module(BaseModule):
                 # resolved in python and fed in as a scalar (no recompile)
                 self._optimizer.num_update = max(self._optimizer.num_update,
                                                  self._fused_t)
-                self._fused_state, outs = self._fused.step(
-                    self._fused_state, self._fused_pending, self._fused_key)
-                self._fused_outputs = [NDArray(o) for o in outs]
+                if self._fused_next is not None:
+                    # the committed step already ran when outputs were
+                    # read between forward and update; install its state
+                    # AND its outputs (an interleaved eval forward may
+                    # have overwritten _fused_outputs) — no second
+                    # evaluation
+                    self._fused_state, self._fused_outputs = \
+                        self._fused_next
+                    self._fused_next = None
+                else:
+                    self._fused_state, outs = self._fused.step(
+                        self._fused_state, self._fused_pending,
+                        self._fused_key)
+                    self._fused_outputs = [NDArray(o) for o in outs]
                 self._fused_pending = None
                 return
         if self._update_on_kvstore:
@@ -590,14 +631,24 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if self._fused_live():
             if self._fused_outputs is None:
-                # outputs requested between forward and update: evaluate
-                # without committing the optimizer step, with the SAME
-                # rng the committed step will use (t+1 fold)
-                import jax as _jax
-                key = _jax.random.fold_in(self._fused_key, self._fused_t + 1)
-                outs = self._fused.forward_only(
-                    self._fused_state, self._fused_pending, key, True)
-                self._fused_outputs = [NDArray(o) for o in outs]
+                # outputs requested between forward and update: run the
+                # COMMITTED step now on a copy of the state and stash the
+                # result for update() to install — the user-facing order
+                # forward(); update_metric(); update() then costs ONE
+                # evaluation, same as fit()'s order
+                if self._fused.hparam_signature() == self._fused_hsig:
+                    self._fused_commit_early()
+                else:
+                    # hparams mutated since forward: nothing may commit
+                    # with the baked values; evaluate only (update() will
+                    # fall back and replay classic), with the SAME rng
+                    # fold the step would use
+                    import jax as _jax
+                    key = _jax.random.fold_in(self._fused_key,
+                                              self._fused_t + 1)
+                    outs = self._fused.forward_only(
+                        self._fused_state, self._fused_pending, key, True)
+                    self._fused_outputs = [NDArray(o) for o in outs]
             if merge_multi_context:
                 return list(self._fused_outputs)
             return [[o] for o in self._fused_outputs]

@@ -481,3 +481,138 @@ def test_declared_fused_hparams_catch_mutation():
     mod.forward(batch, is_train=True); mod.backward(); mod.update()
     assert mod._fused is None, \
         "mutation of a declared baked hparam did not trigger fallback"
+
+
+def test_one_evaluation_per_batch_both_call_orders():
+    """The fused path must cost exactly one compiled-program execution
+    per batch whether the caller uses fit()'s order (update before
+    update_metric) or the natural user order (update_metric first)."""
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+        it = _data()
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(optimizer_params={"learning_rate": 0.5,
+                                             "momentum": 0.9})
+        assert mod._fused is not None
+        calls = {"step": 0, "fwd": 0}
+        real_step, real_fwd = mod._fused.step, mod._fused.forward_only
+
+        def step(*a, **k):
+            calls["step"] += 1
+            return real_step(*a, **k)
+
+        def fwd(*a, **k):
+            calls["fwd"] += 1
+            return real_fwd(*a, **k)
+
+        mod._fused.step, mod._fused.forward_only = step, fwd
+        m = mx.metric.Accuracy()
+        batch = next(iter(it))
+
+        # fit() order: forward, backward, update, update_metric
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        mod.update_metric(m, batch.label)
+        assert (calls["step"], calls["fwd"]) == (1, 0)
+
+        # user order: forward, backward, update_metric, update
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update_metric(m, batch.label)
+        mod.update()
+        assert (calls["step"], calls["fwd"]) == (2, 0), calls
+
+        # the two orders must also produce the same trajectory as ever
+        w = mod.get_params()[0]["fc2_weight"].asnumpy()
+        assert np.isfinite(w).all()
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_early_commit_discarded_by_new_forward():
+    """A speculative early commit (outputs read mid-batch) must be
+    dropped — params untouched — when the user abandons the batch with a
+    new forward() instead of calling update()."""
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+        it = _data()
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+        batch = next(iter(it))
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        # snapshot the LIVE device state (host _arg_params would stay
+        # untouched either way and prove nothing)
+        w0 = np.asarray(mod._fused_state["params"]["fc2_weight"]).copy()
+        mod.get_outputs()               # speculative commit happens here
+        assert mod._fused_next is not None
+        w_mid = np.asarray(mod._fused_state["params"]["fc2_weight"])
+        assert np.allclose(w0, w_mid), "early commit mutated live state"
+        mod.forward(batch, is_train=True)   # abandon the batch
+        assert mod._fused_next is None
+        w1 = np.asarray(mod._fused_state["params"]["fc2_weight"])
+        assert np.allclose(w0, w1), "abandoned speculation leaked an update"
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_early_commit_then_hparam_mutation_falls_back():
+    """Mutating a baked hparam AFTER outputs were read early but BEFORE
+    update() must still honor the mutation via the classic replay (the
+    speculative step ran on a copy, so the pre-update state survives)."""
+    mx.random.seed(5)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    it = _data()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+    assert mod._fused is not None
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.get_outputs()                    # speculative commit
+    params = mod.get_params()[0]
+    frozen = params["fc1_weight"].asnumpy().copy()
+    fc2_before = params["fc2_weight"].asnumpy().copy()
+    mod._optimizer.set_lr_mult({"fc1_weight": 0.0})
+    mod.update()                         # must fall back, honoring lr_mult
+    assert mod._fused is None
+    after = mod.get_params()[0]
+    assert np.allclose(after["fc1_weight"].asnumpy(), frozen)
+    # the non-frozen layer must actually have taken the step
+    assert np.abs(after["fc2_weight"].asnumpy() - fc2_before).max() > 0
+
+
+def test_interleaved_eval_after_early_commit_restores_train_outputs():
+    """forward(train); get_outputs() (early commit); forward(val,
+    is_train=False); update() — update_metric after update must score the
+    TRAIN batch's outputs, not the leftover eval outputs."""
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+        it = _data()
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+        batches = list(it)
+        train_b, val_b = batches[0], batches[1]
+        mod.forward(train_b, is_train=True)
+        mod.backward()
+        train_outs = mod.get_outputs()[0].asnumpy().copy()  # early commit
+        mod.forward(val_b, is_train=False)                  # interleaved eval
+        val_outs = mod.get_outputs()[0].asnumpy().copy()
+        assert not np.allclose(train_outs, val_outs)
+        mod.update()
+        restored = mod.get_outputs()[0].asnumpy()
+        assert np.allclose(restored, train_outs), \
+            "update() left the eval batch's outputs installed"
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
