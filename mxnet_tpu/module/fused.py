@@ -16,8 +16,12 @@ jit-compiled XLA program over the device mesh:
 Engaged automatically by ``Module.init_optimizer`` when semantics allow
 (see Module._fusable); anything it can't express (monitor, ctx_group,
 grad_req!='write', optimizers without a functional form, shared/bucketing
-executors, dist kvstores) falls back to the reference path unchanged.
-Disable with MXNET_FUSED_TRAIN=0.
+executors, dist_async kvstores) falls back to the reference path
+unchanged.  dist_sync kvstores fuse too (``global_dp``): the mesh spans
+every process's devices, each worker feeds its batch as its slice of the
+global array, and the cross-process gradient reduction is a GSPMD
+collective instead of kvstore round trips.  Disable with
+MXNET_FUSED_TRAIN=0.
 """
 from __future__ import annotations
 
@@ -66,11 +70,25 @@ class FusedTrainStep:
                  label_names: Sequence[str], param_names: Sequence[str],
                  fixed_param_names: Sequence[str], optimizer,
                  label_shapes=None, remat: bool = False,
-                 compute_dtype=None):
+                 compute_dtype=None, global_dp: bool = False):
         devices = [c.jax_device() for c in contexts]
         if len(set(devices)) != len(devices):
             raise MXNetError("fused step needs distinct devices")
-        self.mesh = Mesh(np.array(devices), ("dp",))
+        self.global_dp = global_dp
+        if global_dp:
+            # multi-host dist_sync: ONE mesh over every process's devices;
+            # GSPMD turns the dp gradient mean into cross-process
+            # collectives (ICI within a slice, DCN across) — no kvstore
+            # round trips in the hot loop (reference kvstore_dist.h:65-98
+            # semantics at "python pushes one pointer" cost)
+            if set(devices) != set(jax.local_devices()):
+                raise MXNetError(
+                    "dist_sync fused step needs the module bound on every "
+                    "local device (%d bound, %d local)"
+                    % (len(devices), jax.local_device_count()))
+            self.mesh = Mesh(np.array(jax.devices()), ("dp",))
+        else:
+            self.mesh = Mesh(np.array(devices), ("dp",))
         self.data_names = tuple(data_names)
         self.label_names = tuple(label_names)
         self.label_shapes = dict(label_shapes or [])
@@ -117,20 +135,38 @@ class FusedTrainStep:
     def _batched(self):
         return NamedSharding(self.mesh, P("dp"))
 
+    def _multiprocess(self):
+        return self.global_dp and jax.process_count() > 1
+
     def init_state(self, arg_params: Dict[str, NDArray],
                    aux_params: Dict[str, NDArray]):
         """Build the device-resident train state from host param dicts."""
         rep = self._replicated()
 
-        def put(v):
-            a = v._get() if isinstance(v, NDArray) else jnp.asarray(v)
+        def host(v):
+            a = v._get() if isinstance(v, NDArray) else v
+            return np.asarray(a)
+        tree = {
+            "params": {n: host(arg_params[n]) for n in self.train_names},
+            "fixed": {n: host(arg_params[n]) for n in self.fixed_names},
+            "aux": {n: host(aux_params[n]) for n in self.aux_names},
+        }
+        if self._multiprocess():
+            # dist init semantics: rank 0's value wins everywhere
+            # (reference kvstore_dist init); a global device_put needs
+            # identical host values on every process anyway.  ONE pytree
+            # collective, not one per tensor.
+            from jax.experimental import multihost_utils as mhu
+            tree = mhu.broadcast_one_to_all(tree)
+
+        def put(a):
             # device_put may alias the caller's buffer when it already
             # lives here; the state is donated every step, so it must own
             # fresh storage or the source NDArrays get deleted under it
             return jnp.copy(jax.device_put(a, rep))
-        params = {n: put(arg_params[n]) for n in self.train_names}
-        fixed = {n: put(arg_params[n]) for n in self.fixed_names}
-        aux = {n: put(aux_params[n]) for n in self.aux_names}
+        params = {n: put(a) for n, a in tree["params"].items()}
+        fixed = {n: put(a) for n, a in tree["fixed"].items()}
+        aux = {n: put(a) for n, a in tree["aux"].items()}
         opt = {n: self._opt_init(w) for n, w in params.items()}
         # the step counter lives on device and increments in-program: a
         # host-built scalar would cost one transfer per step
@@ -157,8 +193,13 @@ class FusedTrainStep:
                 opt.wd, opt.rescale_grad, opt.clip_gradient, baked)
 
     def make_batch(self, data_batch) -> Dict[str, jnp.ndarray]:
-        """Shard one DataBatch over the dp axis of the mesh."""
+        """Shard one DataBatch over the dp axis of the mesh.  In
+        multi-process (dist_sync) mode each process contributes its OWN
+        batch as its slice of the global array — the reference's
+        data-partitioned-by-rank contract, with the global batch being
+        num_workers x the bound batch size."""
         sh = self._batched()
+        mp = self._multiprocess()
 
         def put(arr):
             a = arr._get()
@@ -166,6 +207,9 @@ class FusedTrainStep:
             # pipeline): hand it through untouched
             if getattr(a, "sharding", None) == sh:
                 return a
+            if mp:
+                return jax.make_array_from_process_local_data(
+                    sh, np.asarray(a))
             return jax.device_put(a, sh)
         out = {}
         for name, arr in zip(self.data_names, data_batch.data):
@@ -180,8 +224,33 @@ class FusedTrainStep:
                 shape = self.label_shapes.get(name)
                 if shape is None:
                     raise MXNetError("missing label %r" % name)
-                out[name] = jax.device_put(jnp.zeros(shape, jnp.float32), sh)
+                if mp:
+                    out[name] = jax.make_array_from_process_local_data(
+                        sh, np.zeros(shape, np.float32))
+                else:
+                    out[name] = jax.device_put(
+                        jnp.zeros(shape, jnp.float32), sh)
         return out
+
+    def host_outputs(self, outs, batch) -> List[NDArray]:
+        """Wrap program outputs for host-side consumers (update_metric,
+        get_outputs).  Single-process arrays wrap as-is; multi-process
+        global arrays come back as THIS worker's rows (batch-major
+        outputs) or the full replicated value, matching the reference's
+        per-worker metric semantics.  ``batch`` is the program input dict
+        the outputs came from — its leading dim is the global row count
+        (a stale module-level row count would mis-slice after an
+        interleaved eval of a different batch size)."""
+        if not self._multiprocess():
+            return [NDArray(o) for o in outs]
+        from jax.experimental import multihost_utils as mhu
+        rows = batch[self.data_names[0]].shape[0] if self.data_names else None
+        res = []
+        for o in outs:
+            spec = P("dp") if (o.ndim >= 1 and o.shape[0] == rows) else P()
+            local = mhu.global_array_to_host_local_array(o, self.mesh, spec)
+            res.append(NDArray(np.asarray(local)))
+        return res
 
     # -- compiled programs ---------------------------------------------------
     def _build_step(self):
@@ -246,6 +315,10 @@ class FusedTrainStep:
         if self._step is None:
             self._build_step()
         lr = self.optimizer.base_lr()
+        if self._multiprocess():
+            # a host scalar is replicated implicitly; an uncommitted
+            # device scalar cannot join a multi-process computation
+            return self._step(state, batch, np.float32(lr), base_key)
         if self._lr_cache is None or self._lr_cache[0] != lr:
             # lr changes only when the scheduler fires; keep the device
             # scalar resident between changes
@@ -263,9 +336,17 @@ class FusedTrainStep:
         """Pull the live state back into host-side NDArray dicts. Copies:
         the state buffers are donated to the next step, which would delete
         the arrays under any NDArray handed out here."""
+        if self._multiprocess():
+            # replicated global arrays: every local device holds the full
+            # value — materialize from the first addressable shard
+            def host(x):
+                return NDArray(np.array(x.addressable_data(0)))
+        else:
+            def host(x):
+                return NDArray(jnp.copy(x))
         for n in self.train_names:
-            arg_params[n] = NDArray(jnp.copy(state["params"][n]))
+            arg_params[n] = host(state["params"][n])
         for n in self.fixed_names:
-            arg_params[n] = NDArray(jnp.copy(state["fixed"][n]))
+            arg_params[n] = host(state["fixed"][n])
         for n in self.aux_names:
-            aux_params[n] = NDArray(jnp.copy(state["aux"][n]))
+            aux_params[n] = host(state["aux"][n])

@@ -73,6 +73,9 @@ class Module(BaseModule):
         # post-step state stashed by an early commit (get_outputs between
         # forward and update); update() installs it without re-running
         self._fused_next = None
+        # multi-process eval ran worker-locally through the exec group:
+        # outputs live there, not in _fused_outputs
+        self._fused_eval_local = False
         self._fused_t = 0
         self._fused_key = None
         self._monitor_installed = False
@@ -359,7 +362,10 @@ class Module(BaseModule):
         if self._optimizer.fused_update_fn() is None:
             return False
         kv = self._kvstore
-        if kv is not None and "dist" in kv.type:
+        if kv is not None and "dist" in kv.type and \
+                "dist_sync" not in kv.type:
+            # dist_async is inherently a host-side service (stale-weight
+            # semantics); only the synchronous family fuses
             return False
         # ctx_group placement needs the node-level eager executor
         if any("ctx_group" in a for a in self._symbol.attr_dict().values()):
@@ -390,12 +396,14 @@ class Module(BaseModule):
         # master weights (the fp16-era capability mapped the TPU way)
         cdt = os.environ.get("MXNET_COMPUTE_DTYPE") or None
         try:
+            gdp = (self._kvstore is not None
+                   and "dist_sync" in self._kvstore.type)
             self._fused = FusedTrainStep(
                 self._symbol, self._context, self._data_names,
                 self._label_names, self._param_names,
                 self._fixed_param_names, self._optimizer,
                 label_shapes=self._label_shapes, remat=remat,
-                compute_dtype=cdt)
+                compute_dtype=cdt, global_dp=gdp)
             self._fused_hsig = self._fused.hparam_signature()
         except MXNetError as e:
             # _fusable() already vetted the config, so a refusal here is
@@ -411,6 +419,7 @@ class Module(BaseModule):
         init time — a pull would otherwise revert training)."""
         if self._fused is None:
             return
+        fused = self._fused
         pend = self._fused_pending
         if self._fused_state is not None:
             self._sync_params_from_devices()
@@ -465,9 +474,17 @@ class Module(BaseModule):
             # bind-time zero buffers
             from ..io import DataBatch
             eg = self._exec_group
+            if fused._multiprocess():
+                # pend holds GLOBAL arrays; the exec group wants this
+                # worker's rows back
+                def back(n):
+                    return fused.host_outputs([pend[n]], pend)[0]
+            else:
+                def back(n):
+                    return NDArray(pend[n])
             batch = DataBatch(
-                data=[NDArray(pend[n]) for n in eg.data_names],
-                label=[NDArray(pend[n]) for n in eg.label_names])
+                data=[back(n) for n in eg.data_names],
+                label=[back(n) for n in eg.label_names])
             eg.forward(batch, True)
             if replay_backward:
                 eg.backward()
@@ -481,7 +498,22 @@ class Module(BaseModule):
                                                        self._aux_params)
             self._fused_t = 0
             from .. import random as _random
-            self._fused_key = _random.new_key()
+            key = _random.new_key()
+            if self._fused._multiprocess():
+                # every worker must hold the SAME key (it is a replicated
+                # program input; in-program folds keep dropout etc
+                # consistent across the global batch): rank 0 wins.
+                # device_put accepts only HOST values for cross-process
+                # shardings, so ship the raw key data and re-wrap on the
+                # global mesh (all processes in lockstep).
+                import numpy as _np
+                import jax
+                from jax.experimental import multihost_utils as mhu
+                kd = _np.asarray(mhu.broadcast_one_to_all(
+                    _np.asarray(jax.random.key_data(key))))
+                key = jax.random.wrap_key_data(
+                    jax.device_put(kd, self._fused._replicated()))
+            self._fused_key = key
 
     def _fused_warmup(self, data_batch):
         """Compile the fused step program off the hot loop without
@@ -513,7 +545,8 @@ class Module(BaseModule):
         state_copy = jax.tree_util.tree_map(jnp.copy, self._fused_state)
         new_state, outs = self._fused.step(
             state_copy, self._fused_pending, self._fused_key)
-        self._fused_outputs = [NDArray(o) for o in outs]
+        self._fused_outputs = self._fused.host_outputs(
+            outs, self._fused_pending)
         self._fused_next = (new_state, self._fused_outputs)
 
     def borrow_optimizer(self, shared_module):
@@ -543,19 +576,32 @@ class Module(BaseModule):
                 self._fused_ensure_state()
                 self._fused_pending = self._fused.make_batch(data_batch)
                 self._fused_outputs = None
+                self._fused_eval_local = False
                 # a stashed early commit belongs to the superseded batch;
                 # dropping it leaves params untouched (the speculative
                 # step ran on a copy), which is exactly eval semantics
                 self._fused_next = None
                 return
             if self._fused_state is not None:
+                if self._fused._multiprocess():
+                    # multi-process eval stays WORKER-LOCAL (reference
+                    # dist semantics: validation never synchronizes
+                    # workers — uneven per-rank shard counts would
+                    # deadlock a collective program): sync the live
+                    # params once and run the classic exec group
+                    if self._params_dirty:
+                        self._sync_params_from_devices()
+                    self._exec_group.forward(data_batch, False)
+                    self._fused_eval_local = True
+                    self._fused_outputs = None
+                    return
                 # eval on the live training params without syncing them
                 # back through the exec group; a pending train batch stays
                 # pending (the eval must not eat the next update)
+                batch = self._fused.make_batch(data_batch)
                 outs = self._fused.forward_only(
-                    self._fused_state, self._fused.make_batch(data_batch),
-                    self._fused_key, False)
-                self._fused_outputs = [NDArray(o) for o in outs]
+                    self._fused_state, batch, self._fused_key, False)
+                self._fused_outputs = self._fused.host_outputs(outs, batch)
                 return
         self._exec_group.forward(data_batch, is_train)
 
@@ -608,8 +654,10 @@ class Module(BaseModule):
                     self._fused_state, outs = self._fused.step(
                         self._fused_state, self._fused_pending,
                         self._fused_key)
-                    self._fused_outputs = [NDArray(o) for o in outs]
+                    self._fused_outputs = self._fused.host_outputs(
+                        outs, self._fused_pending)
                 self._fused_pending = None
+                self._fused_eval_local = False
                 return
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
@@ -629,6 +677,10 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused_eval_local:
+            # last forward was a worker-local multi-process eval
+            return self._exec_group.get_outputs(
+                merge_multi_context=merge_multi_context)
         if self._fused_live():
             if self._fused_outputs is None:
                 # outputs requested between forward and update: run the
@@ -648,7 +700,8 @@ class Module(BaseModule):
                                               self._fused_t + 1)
                     outs = self._fused.forward_only(
                         self._fused_state, self._fused_pending, key, True)
-                    self._fused_outputs = [NDArray(o) for o in outs]
+                    self._fused_outputs = self._fused.host_outputs(
+                        outs, self._fused_pending)
             if merge_multi_context:
                 return list(self._fused_outputs)
             return [[o] for o in self._fused_outputs]
@@ -665,6 +718,9 @@ class Module(BaseModule):
         return grads
 
     def update_metric(self, eval_metric, labels):
+        if self._fused_eval_local:
+            self._exec_group.update_metric(eval_metric, labels)
+            return
         if self._fused_live():
             eval_metric.update(labels, self.get_outputs())
             return

@@ -43,6 +43,13 @@ def main():
     mod = mx.mod.Module(net, context=mx.cpu())
     mod.fit(it, num_epoch=6, kvstore=kv,
             optimizer_params={"learning_rate": 0.5})
+    # dist_sync must ride the fused global-mesh train step (one donated
+    # XLA program per batch, cross-process psum by GSPMD) — not the
+    # per-param python kvstore loop
+    import os as _os
+    if _os.environ.get("MXNET_FUSED_TRAIN", "1") != "0":
+        assert mod._fused is not None and mod._fused.global_dp, \
+            "dist_sync training did not engage the fused path"
     Xv, yv = make_blobs(400, seed=99)
     val = mx.io.NDArrayIter(Xv, yv, batch_size=50)
     acc = mod.score(val, "acc")[0][1]

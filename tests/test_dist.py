@@ -119,3 +119,12 @@ def test_gke_launcher_manifest():
     args = job["spec"]["template"]["spec"]["containers"][0]["args"][0]
     assert "MXNET_TPU_WORKER_ID=$JOB_COMPLETION_INDEX" in args
     assert "python train.py" in args
+
+
+def test_dist_fused_hotloop_no_perparam_kvstore_traffic():
+    """dist_sync trains through the fused global-mesh step: zero kvstore
+    push/pull calls per batch (the reference's 'python only pushes
+    pointers' contract held across processes)."""
+    res = _launch(2, "tests/nightly/dist_fused_hotloop.py", port=9092)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr
