@@ -22,7 +22,20 @@ import time
 import numpy as np
 
 BASELINE_TOKENS_S_PER_CHIP = 80000.0
-TRAIN_MFLOP_PER_TOKEN = 21.0
+
+
+def train_mflop_per_token(num_layer=2, hidden=200, embed=200, vocab=10000):
+    """Analytic train cost per token: layer 0 sees an (E+H)-wide fused
+    gate input, every later layer an (H+H)-wide one (its input is the
+    previous layer's H-wide output); plus the H->vocab softmax
+    projection.  2 FLOPs/MAC; backward ~2x forward."""
+    fwd = (2 * 4 * hidden * (embed + hidden)
+           + (num_layer - 1) * 2 * 4 * hidden * (2 * hidden)
+           + 2 * hidden * vocab)
+    return 3.0 * fwd / 1e6
+
+
+TRAIN_MFLOP_PER_TOKEN = train_mflop_per_token()
 
 
 def build_module(batch=32, seq_len=32, num_hidden=200, num_embed=200,
@@ -81,8 +94,10 @@ def build_module(batch=32, seq_len=32, num_hidden=200, num_embed=200,
 from bench import _sync  # noqa: E402  (same sync rule for both benches)
 
 
-def run(batch=32, seq_len=32, warmup=5, iters=50, windows=3):
-    mod, staged = build_module(batch=batch, seq_len=seq_len)
+def run(batch=32, seq_len=32, num_hidden=200, num_embed=200,
+        warmup=5, iters=50, windows=3):
+    mod, staged = build_module(batch=batch, seq_len=seq_len,
+                               num_hidden=num_hidden, num_embed=num_embed)
     for _ in range(warmup):
         mod.forward(staged, is_train=True)
         mod.backward()
