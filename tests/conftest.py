@@ -41,3 +41,6 @@ jax.config.update("jax_platforms", "cpu")
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running example integration test")
+    config.addinivalue_line(
+        "markers", "tpu_smoke: bounded on-chip tier — one representative "
+        "test per TPU mirror subsystem (tests/tpu/test_tpu_smoke.py)")
