@@ -41,7 +41,13 @@ bin/im2rec: src/im2rec.cc src/recordio.cc src/recordio.h
 test: all
 	python -m pytest tests/ -q
 
+# full CI gate (lint + build + unit + amalgamation + dist [+ on-chip
+# smoke when MXNET_TPU_TESTS=1]); reference tests/travis/run_test.sh.
+# Run one stage with: make ci STAGES=lint
+ci:
+	STAGES="$(STAGES)" sh tests/ci/run_ci.sh
+
 clean:
 	rm -f $(LIB) $(CAPI_LIB) $(PREDICT_LIB) bin/im2rec
 
-.PHONY: all test clean
+.PHONY: all test ci clean

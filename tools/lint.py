@@ -1,0 +1,76 @@
+"""Repo lint: the CI gate's first stage (reference tests/travis/run_test.sh
+ran pylint + cpplint; this image ships no linters, so the checks that
+matter are vendored: python syntax, tabs, trailing whitespace, long
+lines, and C++ trailing whitespace/tabs-in-indent).
+
+Usage: python tools/lint.py  (exit 0 clean, 1 with findings listed)
+"""
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAX_LEN = 100
+SKIP_DIRS = {".git", "__pycache__", ".claude", "node_modules",
+             ".venv", "venv", "build", "dist", ".eggs"}
+
+
+def py_files():
+    for base, dirs, files in os.walk(ROOT):
+        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(base, f)
+
+
+def cc_files():
+    for sub in ("src", "include", "tests/cpp", "amalgamation",
+                "cpp-package", "example/cpp"):
+        top = os.path.join(ROOT, sub)
+        for base, dirs, files in os.walk(top):
+            dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+            for f in files:
+                if f.endswith((".cc", ".h", ".hpp", ".c")):
+                    yield os.path.join(base, f)
+
+
+def main():
+    problems = []
+    for path in py_files():
+        rel = os.path.relpath(path, ROOT)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                ast.parse(f.read(), filename=rel)
+        except SyntaxError as e:
+            problems.append("%s:%s: syntax error: %s"
+                            % (rel, e.lineno, e.msg))
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for i, line in enumerate(f, 1):
+                line = line.rstrip("\n")
+                if "\t" in line:
+                    problems.append("%s:%d: tab character" % (rel, i))
+                if line != line.rstrip():
+                    problems.append("%s:%d: trailing whitespace" % (rel, i))
+                if len(line) > MAX_LEN:
+                    problems.append("%s:%d: line length %d > %d"
+                                    % (rel, i, len(line), MAX_LEN))
+    for path in cc_files():
+        rel = os.path.relpath(path, ROOT)
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for i, line in enumerate(f, 1):
+                line = line.rstrip("\n")
+                if line != line.rstrip():
+                    problems.append("%s:%d: trailing whitespace" % (rel, i))
+                indent = line[:len(line) - len(line.lstrip())]
+                if "\t" in indent:
+                    problems.append("%s:%d: tab in indentation" % (rel, i))
+    for p in problems:
+        print(p)
+    print("lint: %d finding(s) over %s"
+          % (len(problems), "python + C++ sources"))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
