@@ -1,0 +1,15 @@
+"""Two-stage detection package for the alternate-training example
+(reference example/rcnn/rcnn/ + helper/: proposal generation, anchor
+targets, ROI sampling, VOC evaluation — rebuilt TPU-first: every
+module-facing tensor has a STATIC shape (fixed proposal counts, fixed
+ROI batches) so the compiled train/infer programs never retrace)."""
+
+import os as _os
+import sys as _sys
+
+# one repo-root path hook for the whole package (submodules import
+# mxnet_tpu directly; running from a source checkout needs the root)
+_ROOT = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "..", "..", "..")
+if _os.path.abspath(_ROOT) not in [_os.path.abspath(p) for p in _sys.path]:
+    _sys.path.insert(0, _os.path.abspath(_ROOT))

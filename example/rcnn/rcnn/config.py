@@ -1,0 +1,55 @@
+"""Detection config (reference example/rcnn/rcnn/config.py).
+
+One flat namespace of defaults, sized for the synthetic CI dataset;
+``Config(img_size=..., ...)`` overrides any field.  The reference kept
+a global `config` dict mutated by tools/; explicit instances keep the
+four alternate-training stages independent.
+"""
+
+
+class Config:
+    # dataset / image
+    img_size = 64
+    num_classes = 3          # foreground classes; +1 background at heads
+    feat_stride = 2          # small trunk: one 2x pool
+    spatial_scale = 0.5      # ROIPooling scale vs the pooled trunk
+
+    # anchors (base*scale spans the synthetic object sizes 16..32 px)
+    anchor_base = 8
+    anchor_scales = (2, 3, 4)
+    anchor_ratios = (0.5, 1.0, 2.0)
+
+    # RPN training (anchor target assignment)
+    rpn_batch = 64           # anchors scored per image (fg+bg)
+    rpn_fg_fraction = 0.5
+    rpn_fg_iou = 0.6         # >= : positive
+    rpn_bg_iou = 0.3         # <  : negative; between: ignore (-1)
+
+    # proposal generation
+    pre_nms_top = 256
+    post_nms_top = 32        # STATIC proposal count per image (padded)
+    proposal_nms = 0.7
+    min_box = 4              # discard degenerate proposals (pixels)
+
+    # Fast R-CNN ROI sampling
+    roi_batch = 16           # rois per image fed to the head (static)
+    roi_fg_fraction = 0.5
+    roi_fg_iou = 0.5
+
+    # inference
+    test_nms = 0.3
+    score_thresh = 0.05
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            if not hasattr(type(self), k):
+                raise AttributeError("unknown config field %r" % k)
+            setattr(self, k, v)
+
+    @property
+    def num_anchors(self):
+        return len(self.anchor_scales) * len(self.anchor_ratios)
+
+    @property
+    def feat_size(self):
+        return self.img_size // self.feat_stride

@@ -1,0 +1,31 @@
+"""Synthetic multi-object detection dataset (stands in for PASCAL VOC —
+zero-egress image; reference helper/dataset/pascal_voc.py supplies the
+same interface: images + per-image gt boxes/classes).
+
+Each image plants 1-2 axis-aligned rectangles; class identity is the
+channel that lights up, so a conv trunk can genuinely learn it.
+"""
+import numpy as np
+
+
+def make_image(rng, cfg, max_objects=2):
+    size = cfg.img_size
+    img = rng.rand(3, size, size).astype(np.float32) * 0.2
+    n = rng.randint(1, max_objects + 1)
+    boxes, classes = [], []
+    for _ in range(n):
+        cls = rng.randint(1, cfg.num_classes + 1)
+        w = rng.randint(size // 4, size // 2)
+        h = rng.randint(size // 4, size // 2)
+        x1 = rng.randint(0, size - w)
+        y1 = rng.randint(0, size - h)
+        img[cls - 1, y1:y1 + h, x1:x1 + w] = 1.0
+        boxes.append([x1, y1, x1 + w - 1, y1 + h - 1])
+        classes.append(cls)
+    return (img, np.asarray(boxes, np.float32),
+            np.asarray(classes, np.int64))
+
+
+def make_dataset(cfg, n_images, seed=0, max_objects=2):
+    rng = np.random.RandomState(seed)
+    return [make_image(rng, cfg, max_objects) for _ in range(n_images)]

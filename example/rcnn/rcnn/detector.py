@@ -1,0 +1,59 @@
+"""Two-stage inference (reference rcnn/detector.py + tools/test_net.py):
+RPN forward -> proposals -> Fast R-CNN forward -> class-specific bbox
+regression -> per-class NMS -> detections.
+
+Both stages run as fixed-shape Modules bound once; per-image plumbing
+is numpy.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch
+
+from .bbox import bbox_pred, clip_boxes, nms
+from .proposal import gen_proposals
+
+
+class Detector:
+    def __init__(self, rpn_mod, rcnn_mod, cfg):
+        self.rpn = rpn_mod
+        self.rcnn = rcnn_mod
+        self.cfg = cfg
+
+    def propose(self, img):
+        """RPN stage for one image -> (props, mask, scores)."""
+        cfg = self.cfg
+        A, F = cfg.num_anchors, cfg.feat_size
+        self.rpn.forward(DataBatch(data=[mx.nd.array(img[None])], label=[]),
+                         is_train=False)
+        prob, deltas = [o.asnumpy() for o in self.rpn.get_outputs()]
+        fg = prob[0, 1].reshape(A, F, F)
+        return gen_proposals(fg, deltas[0], cfg)
+
+    def detect(self, img, img_id=0):
+        """Full two-stage detection -> {cls: [(img_id, score, box4)]}."""
+        cfg = self.cfg
+        props, mask, _ = self.propose(img)
+        R = cfg.post_nms_top
+        rois = np.concatenate([np.zeros((R, 1), np.float32), props], axis=1)
+        self.rcnn.forward(DataBatch(data=[mx.nd.array(img[None]),
+                                          mx.nd.array(rois)], label=[]),
+                          is_train=False)
+        probs, deltas = [o.asnumpy() for o in self.rcnn.get_outputs()]
+
+        dets = {}
+        for cls in range(1, cfg.num_classes + 1):
+            boxes = clip_boxes(
+                bbox_pred(props, deltas[:, 4 * cls:4 * cls + 4]),
+                cfg.img_size, cfg.img_size)
+            scores = probs[:, cls] * mask   # padded rows score 0
+            keep = scores > cfg.score_thresh
+            if not keep.any():
+                continue
+            cand = np.concatenate([boxes[keep], scores[keep, None]], axis=1)
+            for i in nms(cand, cfg.test_nms):
+                x1, y1, x2, y2, s = cand[i]
+                dets.setdefault(cls, []).append(
+                    (img_id, float(s), float(x1), float(y1),
+                     float(x2), float(y2)))
+        return dets

@@ -1,0 +1,50 @@
+"""Training metrics for both stages (reference rcnn/metric.py):
+objectness accuracy that honors the -1 ignore label, the RPN/RCNN
+log-losses, and the smooth-L1 magnitudes.  All vectorized, all reading
+the multi-output head layout directly.
+"""
+import numpy as np
+
+from mxnet_tpu.metric import EvalMetric
+
+
+class RPNAccuracy(EvalMetric):
+    """Objectness accuracy over non-ignored anchors; preds[0] is the
+    (B, 2, N) softmax, labels[0] the (B, N) -1/0/1 targets."""
+
+    def __init__(self):
+        super().__init__("rpn_acc")
+
+    def update(self, labels, preds):
+        prob = preds[0].asnumpy()
+        lab = labels[0].asnumpy()
+        pick = prob.argmax(axis=1)
+        valid = lab != -1
+        self.sum_metric += int((pick[valid] == lab[valid]).sum())
+        self.num_inst += int(valid.sum())
+
+
+class RCNNAccuracy(EvalMetric):
+    """ROI classification accuracy (preds[0] = (R, C) probs)."""
+
+    def __init__(self):
+        super().__init__("rcnn_acc")
+
+    def update(self, labels, preds):
+        prob = preds[0].asnumpy()
+        lab = labels[0].asnumpy().astype(np.int64)
+        self.sum_metric += int((prob.argmax(axis=1) == lab).sum())
+        self.num_inst += lab.size
+
+
+class SmoothL1Metric(EvalMetric):
+    """Mean of the emitted smooth-L1 loss map (preds[index])."""
+
+    def __init__(self, name="l1", index=1):
+        self._index = index
+        super().__init__(name)
+
+    def update(self, labels, preds):
+        val = preds[self._index].asnumpy()
+        self.sum_metric += float(val.sum())
+        self.num_inst += 1

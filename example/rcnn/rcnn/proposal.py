@@ -1,0 +1,59 @@
+"""RPN proposal generation (reference rcnn/rpn/proposal.py
+ProposalOperator, as host-side plumbing between the two compiled
+stages).
+
+scores/deltas arrive in the RPN head layout ((2A, H, W) softmax over
+the first axis pairs, (4A, H, W) deltas); output is a FIXED-size
+(post_nms_top, 4) box array plus a validity mask — static shapes keep
+the downstream Fast R-CNN program from retracing per image.
+"""
+import numpy as np
+
+from .bbox import (bbox_pred, clip_boxes, generate_anchors, nms,
+                   shift_anchors)
+
+
+def anchor_grid(cfg):
+    base = generate_anchors(base=cfg.anchor_base, ratios=cfg.anchor_ratios,
+                            scales=cfg.anchor_scales)
+    return shift_anchors(base, cfg.feat_size, cfg.feat_size,
+                         cfg.feat_stride)
+
+
+def gen_proposals(fg_scores, deltas, cfg):
+    """One image: (A,H,W) foreground scores + (4A,H,W) deltas ->
+    (post_nms_top, 4) proposals, (post_nms_top,) validity mask,
+    (post_nms_top,) scores (zero-padded)."""
+    A = cfg.num_anchors
+    h, w = fg_scores.shape[-2:]
+    # (A,H,W) -> (H*W*A,) matching shift_anchors' row-major grid ordering
+    scores = fg_scores.reshape(A, h * w).T.ravel()
+    dl = deltas.reshape(A, 4, h * w).transpose(2, 0, 1).reshape(-1, 4)
+
+    anchors = anchor_grid(cfg)
+    boxes = clip_boxes(bbox_pred(anchors, dl), cfg.img_size, cfg.img_size)
+
+    ws = boxes[:, 2] - boxes[:, 0] + 1
+    hs = boxes[:, 3] - boxes[:, 1] + 1
+    valid = (ws >= cfg.min_box) & (hs >= cfg.min_box)
+    boxes, scores = boxes[valid], scores[valid]
+
+    order = scores.argsort()[::-1][:cfg.pre_nms_top]
+    boxes, scores = boxes[order], scores[order]
+    dets = np.concatenate([boxes, scores[:, None]], axis=1)
+    keep = nms(dets, cfg.proposal_nms)[:cfg.post_nms_top]
+
+    out = np.zeros((cfg.post_nms_top, 4), np.float32)
+    out_scores = np.zeros((cfg.post_nms_top,), np.float32)
+    mask = np.zeros((cfg.post_nms_top,), bool)
+    k = len(keep)
+    if k:
+        out[:k] = boxes[keep]
+        out_scores[:k] = scores[keep]
+        mask[:k] = True
+    else:
+        # never emit an empty proposal set: the downstream static-shape
+        # head still needs SOME box; fall back to the whole image
+        out[0] = [0, 0, cfg.img_size - 1, cfg.img_size - 1]
+        mask[0] = True
+    return out, mask, out_scores

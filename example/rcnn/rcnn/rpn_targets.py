@@ -1,0 +1,60 @@
+"""Anchor target assignment for RPN training (reference
+rcnn/rpn — the AnchorLoader / assign_anchor path).
+
+Given the anchor grid and one image's ground-truth boxes, produce:
+  labels        (A*H*W,)  1 fg / 0 bg / -1 ignore (subsampled to
+                          cfg.rpn_batch, fg capped at rpn_fg_fraction)
+  bbox_targets  (A*H*W, 4) regression deltas, nonzero only on fg
+  bbox_weights  (A*H*W, 4) 1.0 on fg rows
+
+Assignment rule (Ren et al. 2015): positives are anchors with IoU >=
+rpn_fg_iou to any gt PLUS the best anchor per gt (so every object gets
+at least one positive); negatives are IoU < rpn_bg_iou; the rest are
+ignored.  Anchors crossing the image boundary are ignored outright.
+"""
+import numpy as np
+
+from .bbox import bbox_overlaps, bbox_transform
+
+
+def assign_anchor_targets(anchors, gt_boxes, cfg, rng):
+    n = anchors.shape[0]
+    labels = np.full((n,), -1.0, np.float32)
+    bbox_targets = np.zeros((n, 4), np.float32)
+    bbox_weights = np.zeros((n, 4), np.float32)
+
+    inside = ((anchors[:, 0] >= 0) & (anchors[:, 1] >= 0)
+              & (anchors[:, 2] < cfg.img_size)
+              & (anchors[:, 3] < cfg.img_size))
+    idx_in = np.where(inside)[0]
+    if idx_in.size == 0 or len(gt_boxes) == 0:
+        return labels, bbox_targets, bbox_weights
+
+    ious = bbox_overlaps(anchors[idx_in], gt_boxes)      # (I, G)
+    best_gt = ious.argmax(axis=1)
+    best_iou = ious[np.arange(idx_in.size), best_gt]
+
+    labels[idx_in[best_iou < cfg.rpn_bg_iou]] = 0.0
+    labels[idx_in[best_iou >= cfg.rpn_fg_iou]] = 1.0
+    # the single best anchor per gt is always positive
+    per_gt_best = ious.argmax(axis=0)
+    labels[idx_in[per_gt_best]] = 1.0
+
+    # subsample to the fixed training batch: cap foreground first, then
+    # fill with background (reference assign_anchor subsampling)
+    fg = np.where(labels == 1.0)[0]
+    max_fg = int(cfg.rpn_batch * cfg.rpn_fg_fraction)
+    if fg.size > max_fg:
+        labels[rng.choice(fg, fg.size - max_fg, replace=False)] = -1.0
+        fg = np.where(labels == 1.0)[0]
+    bg = np.where(labels == 0.0)[0]
+    max_bg = cfg.rpn_batch - fg.size
+    if bg.size > max_bg:
+        labels[rng.choice(bg, bg.size - max_bg, replace=False)] = -1.0
+
+    fg = np.where(labels == 1.0)[0]
+    if fg.size:
+        gt_of = bbox_overlaps(anchors[fg], gt_boxes).argmax(axis=1)
+        bbox_targets[fg] = bbox_transform(anchors[fg], gt_boxes[gt_of])
+        bbox_weights[fg] = 1.0
+    return labels, bbox_targets, bbox_weights

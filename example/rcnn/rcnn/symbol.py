@@ -1,0 +1,83 @@
+"""Training/inference symbols for the two stages (reference
+rcnn/symbol.py).
+
+The shared trunk comes from mxnet_tpu.models.rcnn._trunk so RPN and
+Fast R-CNN checkpoints interchange trunk weights by name — that weight
+handoff IS the alternate-training scheme.
+"""
+import mxnet_tpu as mx
+from mxnet_tpu.models.rcnn import _trunk, get_fast_rcnn  # noqa: F401
+
+
+def _rpn_head(A, small=True):
+    """The ONE definition of the RPN stack (trunk -> 3x3 conv -> score +
+    deltas); train and test symbols both derive from it, so the weight
+    names the alternate-training handoff depends on cannot drift."""
+    data = mx.sym.Variable("data")
+    feat = _trunk(data, small=small)
+    conv = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                              num_filter=256, name="rpn_conv")
+    relu = mx.sym.Activation(conv, act_type="relu")
+    score = mx.sym.Convolution(relu, kernel=(1, 1), num_filter=2 * A,
+                               name="rpn_cls_score")
+    deltas = mx.sym.Convolution(relu, kernel=(1, 1), num_filter=4 * A,
+                                name="rpn_bbox_pred")
+    return score, deltas
+
+
+def get_rpn_train(cfg, small=True):
+    """RPN with BOTH losses (reference symbol.get_vgg_rpn): 2-way
+    objectness softmax per anchor (ignore label -1) + smooth-L1 on the
+    positive anchors' deltas.
+
+    Inputs: data (B,3,S,S); rpn_label (B, A*F*F);
+            rpn_bbox_target/weight (B, 4A, F, F).
+    """
+    A = cfg.num_anchors
+    score, deltas = _rpn_head(A, small)
+
+    # (B, 2A, F, F) -> (B, 2, A*F*F): a 2-way softmax per anchor cell
+    score_2 = mx.sym.Reshape(score, shape=(0, 2, -1),
+                             name="rpn_cls_score_reshape")
+    label = mx.sym.Variable("rpn_label")
+    cls_prob = mx.sym.SoftmaxOutput(score_2, label=label, multi_output=True,
+                                    use_ignore=True, ignore_label=-1,
+                                    normalization="valid",
+                                    name="rpn_cls_prob")
+    tgt = mx.sym.Variable("rpn_bbox_target")
+    wgt = mx.sym.Variable("rpn_bbox_weight")
+    l1 = mx.sym.smooth_l1(wgt * (deltas - tgt), sigma=3.0, name="rpn_l1")
+    bbox_loss = mx.sym.MakeLoss(l1, grad_scale=1.0 / cfg.rpn_batch,
+                                name="rpn_bbox_loss")
+    return mx.sym.Group([cls_prob, bbox_loss])
+
+
+def get_rpn_test(cfg, small=True):
+    """Inference RPN: softmax objectness + raw deltas (no labels)."""
+    A = cfg.num_anchors
+    score, deltas = _rpn_head(A, small)
+    score_2 = mx.sym.Reshape(score, shape=(0, 2, -1))
+    prob = mx.sym.SoftmaxActivation(score_2, mode="channel",
+                                    name="rpn_cls_prob")
+    return mx.sym.Group([prob, deltas])
+
+
+def get_rcnn_test(cfg, small=True):
+    """Inference Fast R-CNN: class probs + bbox deltas over given rois."""
+    C = cfg.num_classes + 1
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    feat = _trunk(data, small=small)
+    pool = mx.sym.ROIPooling(feat, rois, pooled_size=(4, 4),
+                             spatial_scale=cfg.spatial_scale,
+                             name="roi_pool")
+    flat = mx.sym.Flatten(pool)
+    fc6 = mx.sym.FullyConnected(flat, num_hidden=128, name="fc6")
+    relu6 = mx.sym.Activation(fc6, act_type="relu")
+    fc7 = mx.sym.FullyConnected(relu6, num_hidden=128, name="fc7")
+    relu7 = mx.sym.Activation(fc7, act_type="relu")
+    cls_score = mx.sym.FullyConnected(relu7, num_hidden=C, name="cls_score")
+    cls_prob = mx.sym.SoftmaxActivation(cls_score, name="cls_prob")
+    deltas = mx.sym.FullyConnected(relu7, num_hidden=4 * C,
+                                   name="bbox_pred")
+    return mx.sym.Group([cls_prob, deltas])

@@ -1,0 +1,74 @@
+"""PASCAL VOC detection evaluation (reference
+helper/dataset/voc_eval.py): per-class precision/recall + average
+precision (both the 11-point VOC07 interpolation and the continuous
+AUC), and mAP over classes.
+
+Inputs are framework-free numpy:
+  detections: {cls: [(img_id, score, x1, y1, x2, y2), ...]}
+  annotations: {img_id: (gt_boxes (G,4), gt_classes (G,))}
+"""
+import numpy as np
+
+from .bbox import bbox_overlaps
+
+
+def voc_ap(recall, precision, use_07_metric=False):
+    """AP from a recall/precision curve."""
+    if use_07_metric:
+        ap = 0.0
+        for t in np.arange(0.0, 1.1, 0.1):
+            p = precision[recall >= t].max() if (recall >= t).any() else 0.0
+            ap += p / 11.0
+        return float(ap)
+    # continuous: envelope precision, integrate over recall steps
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(mpre.size - 1, 0, -1):
+        mpre[i - 1] = max(mpre[i - 1], mpre[i])
+    steps = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(np.sum((mrec[steps + 1] - mrec[steps]) * mpre[steps + 1]))
+
+
+def eval_class(dets, annotations, cls, iou_thresh=0.5, use_07_metric=False):
+    """AP for one class.  Greedy matching, score-descending; each gt box
+    matches at most one detection (extras are false positives)."""
+    npos = sum(int((gt_cls == cls).sum())
+               for _, (gt_boxes, gt_cls) in annotations.items())
+    rows = sorted(dets.get(cls, []), key=lambda r: -r[1])
+    if not rows or npos == 0:
+        return 0.0, np.zeros(0), np.zeros(0)
+
+    matched = {img: np.zeros(int((gc == cls).sum()), bool)
+               for img, (gb, gc) in annotations.items()}
+    tp = np.zeros(len(rows))
+    fp = np.zeros(len(rows))
+    for i, (img, _, x1, y1, x2, y2) in enumerate(rows):
+        gt_boxes, gt_cls = annotations[img]
+        sel = gt_cls == cls
+        if not sel.any():
+            fp[i] = 1
+            continue
+        ious = bbox_overlaps(np.array([[x1, y1, x2, y2]], np.float32),
+                             gt_boxes[sel])[0]
+        j = int(ious.argmax())
+        if ious[j] >= iou_thresh and not matched[img][j]:
+            tp[i] = 1
+            matched[img][j] = True
+        else:
+            fp[i] = 1
+    tp_cum = np.cumsum(tp)
+    fp_cum = np.cumsum(fp)
+    recall = tp_cum / npos
+    precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+    return voc_ap(recall, precision, use_07_metric), recall, precision
+
+
+def eval_detections(dets, annotations, num_classes, iou_thresh=0.5,
+                    use_07_metric=False):
+    """Per-class APs + mAP (classes 1..num_classes; 0 is background)."""
+    aps = {}
+    for cls in range(1, num_classes + 1):
+        ap, _, _ = eval_class(dets, annotations, cls, iou_thresh,
+                              use_07_metric)
+        aps[cls] = ap
+    return aps, float(np.mean(list(aps.values()))) if aps else 0.0

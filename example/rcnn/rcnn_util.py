@@ -1,92 +1,6 @@
-"""Detection utilities for the Fast R-CNN example (reference
-example/rcnn/{helper,rcnn/rpn,utils} capability, compacted): anchors,
-bbox transforms, IoU, and NMS — the numpy plumbing every two-stage
-detector needs."""
-import numpy as np
-
-
-def generate_anchors(base=16, ratios=(0.5, 1, 2), scales=(8, 16, 32)):
-    """Anchor boxes (x1,y1,x2,y2) centered on a base cell (reference
-    rpn/generate_anchor.py)."""
-    anchors = []
-    cx = cy = (base - 1) / 2.0
-    area = base * base
-    for r in ratios:
-        w = np.sqrt(area / r)
-        h = w * r
-        for s in scales:
-            ws, hs = w * s / 2.0, h * s / 2.0
-            anchors.append([cx - ws + 0.5, cy - hs + 0.5,
-                            cx + ws - 0.5, cy + hs - 0.5])
-    return np.asarray(anchors, np.float32)
-
-
-def shift_anchors(anchors, feat_h, feat_w, stride):
-    """Tile base anchors over the feature map grid."""
-    sx = np.arange(feat_w) * stride
-    sy = np.arange(feat_h) * stride
-    gx, gy = np.meshgrid(sx, sy)
-    shifts = np.stack([gx.ravel(), gy.ravel(), gx.ravel(), gy.ravel()], 1)
-    return (anchors[None] + shifts[:, None]).reshape(-1, 4).astype(np.float32)
-
-
-def bbox_overlaps(a, b):
-    """IoU matrix (len(a), len(b))."""
-    area_a = (a[:, 2] - a[:, 0] + 1) * (a[:, 3] - a[:, 1] + 1)
-    area_b = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
-    iw = np.clip(np.minimum(a[:, None, 2], b[None, :, 2])
-                 - np.maximum(a[:, None, 0], b[None, :, 0]) + 1, 0, None)
-    ih = np.clip(np.minimum(a[:, None, 3], b[None, :, 3])
-                 - np.maximum(a[:, None, 1], b[None, :, 1]) + 1, 0, None)
-    inter = iw * ih
-    return inter / (area_a[:, None] + area_b[None] - inter)
-
-
-def bbox_transform(rois, gt):
-    """Regression targets (dx, dy, dw, dh) mapping rois -> gt boxes
-    (reference helper/processing/bbox_regression.py)."""
-    rw = rois[:, 2] - rois[:, 0] + 1.0
-    rh = rois[:, 3] - rois[:, 1] + 1.0
-    rx = rois[:, 0] + rw * 0.5
-    ry = rois[:, 1] + rh * 0.5
-    gw = gt[:, 2] - gt[:, 0] + 1.0
-    gh = gt[:, 3] - gt[:, 1] + 1.0
-    gx = gt[:, 0] + gw * 0.5
-    gy = gt[:, 1] + gh * 0.5
-    return np.stack([(gx - rx) / rw, (gy - ry) / rh,
-                     np.log(gw / rw), np.log(gh / rh)], 1).astype(np.float32)
-
-
-def bbox_pred(rois, deltas):
-    """Apply regression deltas to rois (inverse of bbox_transform)."""
-    rw = rois[:, 2] - rois[:, 0] + 1.0
-    rh = rois[:, 3] - rois[:, 1] + 1.0
-    rx = rois[:, 0] + rw * 0.5
-    ry = rois[:, 1] + rh * 0.5
-    px = deltas[:, 0] * rw + rx
-    py = deltas[:, 1] * rh + ry
-    pw = np.exp(deltas[:, 2]) * rw
-    ph = np.exp(deltas[:, 3]) * rh
-    return np.stack([px - pw * 0.5, py - ph * 0.5,
-                     px + pw * 0.5, py + ph * 0.5], 1).astype(np.float32)
-
-
-def clip_boxes(boxes, h, w):
-    boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, w - 1)
-    boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, h - 1)
-    return boxes
-
-
-def nms(dets, thresh):
-    """Greedy non-maximum suppression; dets = (N,5) [x1,y1,x2,y2,score];
-    returns kept indices (reference helper/processing/nms.py)."""
-    order = dets[:, 4].argsort()[::-1]
-    keep = []
-    while order.size:
-        i = order[0]
-        keep.append(int(i))
-        if order.size == 1:
-            break
-        ious = bbox_overlaps(dets[i:i + 1, :4], dets[order[1:], :4])[0]
-        order = order[1:][ious <= thresh]
-    return keep
+"""Back-compat shim: the detection numpy plumbing now lives in the
+rcnn/ package (rcnn/bbox.py) shared by the alternate-training system;
+this module keeps the original flat imports working for demo.py and
+train_fast_rcnn.py."""
+from rcnn.bbox import (bbox_overlaps, bbox_pred, bbox_transform,   # noqa: F401
+                       clip_boxes, generate_anchors, nms, shift_anchors)

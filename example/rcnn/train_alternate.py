@@ -1,0 +1,208 @@
+"""Faster R-CNN alternate training (reference
+example/rcnn/tools/train_alternate.py; Ren et al. 2015 §3.2):
+
+  step 1  train RPN from scratch
+  step 2  generate proposals with RPN-1; train Fast R-CNN on them
+  step 3  retrain RPN with the detector's trunk FROZEN (shared features)
+  step 4  regenerate proposals with RPN-2; retrain the Fast R-CNN head
+          on the same frozen trunk
+
+The result is one shared conv trunk serving both stages.  Runs
+CI-light on the synthetic dataset (rcnn/dataset.py) and ends with a
+VOC-style mAP evaluation (rcnn/voc_eval.py) over a held-out set:
+
+    python train_alternate.py --epochs 8 --train-images 64 --map-gate 0.5
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from rcnn.config import Config
+from rcnn.dataset import make_dataset
+from rcnn.detector import Detector
+from rcnn.loader import AnchorLoader, ROIIter
+from rcnn.metric import RCNNAccuracy, RPNAccuracy, SmoothL1Metric
+from rcnn.symbol import get_fast_rcnn, get_rcnn_test, get_rpn_test, \
+    get_rpn_train
+from rcnn.voc_eval import eval_detections
+
+
+def trunk_param_names(cfg):
+    """Conv-trunk weights shared between the two stages: the arg names
+    the RPN and Fast R-CNN symbols have in common."""
+    rpn_args = set(get_rpn_train(cfg).list_arguments())
+    rcnn_args = set(get_fast_rcnn(num_classes=cfg.num_classes + 1,
+                                  pooled_size=(4, 4),
+                                  spatial_scale=cfg.spatial_scale,
+                                  small=True).list_arguments())
+    inputs = {"data", "rois", "label", "bbox_target", "bbox_weight",
+              "rpn_label", "rpn_bbox_target", "rpn_bbox_weight"}
+    return sorted((rpn_args & rcnn_args) - inputs)
+
+
+def fit(symbol, it, cfg, metric, epochs, lr, data_names, label_names,
+        arg_params=None, fixed=None, ctx=None, no_slice=()):
+    mod = mx.mod.Module(symbol, data_names=data_names,
+                        label_names=label_names,
+                        context=ctx or mx.current_context(),
+                        fixed_param_names=fixed)
+    mod.bind(it.provide_data, it.provide_label, no_slice_names=no_slice)
+    mod.init_params(mx.init.Xavier(), arg_params=arg_params,
+                    allow_missing=True)
+    mod.init_optimizer(optimizer_params={"learning_rate": lr,
+                                         "momentum": 0.9, "wd": 5e-4})
+    for epoch in range(epochs):
+        metric.reset()
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        logging.info("epoch %d %s=%.4f", epoch, *metric.get())
+    return mod
+
+
+def train_rpn(dataset, cfg, epochs, lr, arg_params=None, fixed=None,
+              ctx=None, seed=0):
+    it = AnchorLoader(dataset, cfg, seed=seed)
+    sym = get_rpn_train(cfg)
+    return fit(sym, it, cfg, RPNAccuracy(), epochs, lr,
+               data_names=["data"],
+               label_names=["rpn_label", "rpn_bbox_target",
+                            "rpn_bbox_weight"],
+               arg_params=arg_params, fixed=fixed, ctx=ctx)
+
+
+def rpn_proposals(rpn_mod, dataset, cfg, ctx=None):
+    """Run the trained RPN over the whole set (reference
+    rcnn/rpn/generate.py)."""
+    test = mx.mod.Module(get_rpn_test(cfg), data_names=["data"],
+                         label_names=[],
+                         context=ctx or mx.current_context())
+    test.bind([("data", (1, 3, cfg.img_size, cfg.img_size))],
+              for_training=False)
+    arg_p, aux_p = rpn_mod.get_params()
+    test.init_params(arg_params=arg_p, aux_params=aux_p,
+                     allow_missing=True)
+    det = Detector(test, None, cfg)
+    return [det.propose(img) for img, _, _ in dataset]
+
+
+def train_rcnn(dataset, proposals, cfg, epochs, lr, arg_params=None,
+               fixed=None, ctx=None, seed=0):
+    it = ROIIter(dataset, proposals, cfg, seed=seed)
+    sym = get_fast_rcnn(num_classes=cfg.num_classes + 1, pooled_size=(4, 4),
+                        spatial_scale=cfg.spatial_scale, small=True)
+    return fit(sym, it, cfg, RCNNAccuracy(), epochs, lr,
+               data_names=["data", "rois"],
+               label_names=["label", "bbox_target", "bbox_weight"],
+               arg_params=arg_params, fixed=fixed, ctx=ctx,
+               no_slice=("rois",))
+
+
+def evaluate(rpn_mod, rcnn_mod, test_set, cfg, ctx=None):
+    """Shared-trunk two-stage inference + VOC mAP."""
+    ctx = ctx or mx.current_context()
+    rpn_test = mx.mod.Module(get_rpn_test(cfg), data_names=["data"],
+                             label_names=[], context=ctx)
+    rpn_test.bind([("data", (1, 3, cfg.img_size, cfg.img_size))],
+                  for_training=False)
+    p, a = rpn_mod.get_params()
+    rpn_test.init_params(arg_params=p, aux_params=a, allow_missing=True)
+
+    rcnn_test = mx.mod.Module(get_rcnn_test(cfg),
+                              data_names=["data", "rois"],
+                              label_names=[], context=ctx)
+    R = cfg.post_nms_top
+    rcnn_test.bind([("data", (1, 3, cfg.img_size, cfg.img_size)),
+                    ("rois", (R, 5))], for_training=False,
+                   no_slice_names=("rois",))
+    p, a = rcnn_mod.get_params()
+    rcnn_test.init_params(arg_params=p, aux_params=a, allow_missing=True)
+
+    det = Detector(rpn_test, rcnn_test, cfg)
+    all_dets, annotations = {}, {}
+    for i, (img, gt_boxes, gt_classes) in enumerate(test_set):
+        annotations[i] = (gt_boxes, gt_classes)
+        for cls, rows in det.detect(img, img_id=i).items():
+            all_dets.setdefault(cls, []).extend(rows)
+    return eval_detections(all_dets, annotations, cfg.num_classes)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tpus", type=str)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--train-images", type=int, default=64)
+    ap.add_argument("--test-images", type=int, default=16)
+    ap.add_argument("--map-gate", type=float, default=0.0,
+                    help="assert final mAP >= this (CI gate)")
+    ap.add_argument("--model-prefix", type=str)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    cfg = Config()
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else mx.current_context()
+    mx.random.seed(3)
+    train_set = make_dataset(cfg, args.train_images, seed=1)
+    test_set = make_dataset(cfg, args.test_images, seed=2)
+    shared = trunk_param_names(cfg)
+    logging.info("shared trunk params: %s", shared)
+
+    logging.info("=== step 1: train RPN-1 (from scratch)")
+    rpn1 = train_rpn(train_set, cfg, args.epochs, args.lr, ctx=ctx, seed=10)
+
+    logging.info("=== step 2: RPN-1 proposals -> train Fast R-CNN-1")
+    props1 = rpn_proposals(rpn1, train_set, cfg, ctx=ctx)
+    rcnn1 = train_rcnn(train_set, props1, cfg, args.epochs, args.lr,
+                       ctx=ctx, seed=11)
+
+    logging.info("=== step 3: retrain RPN on the detector trunk (frozen)")
+    rcnn1_params = rcnn1.get_params()[0]
+    rpn2 = train_rpn(train_set, cfg, args.epochs, args.lr,
+                     arg_params=rcnn1_params, fixed=shared, ctx=ctx,
+                     seed=12)
+
+    logging.info("=== step 4: RPN-2 proposals -> retrain the head "
+                 "(trunk frozen)")
+    props2 = rpn_proposals(rpn2, train_set, cfg, ctx=ctx)
+    rcnn2 = train_rcnn(train_set, props2, cfg, args.epochs, args.lr,
+                       arg_params=rcnn1_params, fixed=shared, ctx=ctx,
+                       seed=13)
+
+    # the two stages now share one trunk: assert it byte-identical
+    p_rpn = rpn2.get_params()[0]
+    p_rcnn = rcnn2.get_params()[0]
+    for n in shared:
+        assert np.allclose(p_rpn[n].asnumpy(), p_rcnn[n].asnumpy()), \
+            "trunk diverged on %s" % n
+
+    aps, mean_ap = evaluate(rpn2, rcnn2, test_set, cfg, ctx=ctx)
+    for cls, ap_v in sorted(aps.items()):
+        logging.info("class %d AP = %.4f", cls, ap_v)
+    print("mAP=%.4f" % mean_ap)
+
+    if args.model_prefix:
+        rpn2.symbol.save("%s-rpn-symbol.json" % args.model_prefix)
+        mx.model.save_checkpoint("%s-rpn" % args.model_prefix,
+                                 args.epochs, rpn2.symbol, p_rpn,
+                                 rpn2.get_params()[1])
+        mx.model.save_checkpoint("%s-rcnn" % args.model_prefix,
+                                 args.epochs, rcnn2.symbol, p_rcnn,
+                                 rcnn2.get_params()[1])
+    if args.map_gate:
+        assert mean_ap >= args.map_gate, \
+            "mAP gate failed: %.4f < %.2f" % (mean_ap, args.map_gate)
+        print("PASSED")
+
+
+if __name__ == "__main__":
+    main()

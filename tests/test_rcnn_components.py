@@ -1,0 +1,135 @@
+"""Faster R-CNN alternate-training components (example/rcnn/rcnn/):
+anchor targets, proposal generation, ROI sampling, VOC evaluation —
+the plumbing the reference exercised via example/rcnn/test/ and its
+training tools."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+RCNN_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "example", "rcnn")
+sys.path.insert(0, RCNN_DIR)
+
+from rcnn.config import Config          # noqa: E402
+from rcnn.bbox import bbox_overlaps, bbox_pred, bbox_transform  # noqa: E402
+from rcnn.proposal import anchor_grid, gen_proposals  # noqa: E402
+from rcnn.rpn_targets import assign_anchor_targets    # noqa: E402
+from rcnn.voc_eval import eval_detections, voc_ap     # noqa: E402
+
+
+@pytest.fixture
+def cfg():
+    return Config()
+
+
+def test_bbox_transform_roundtrip():
+    rng = np.random.RandomState(0)
+    rois = np.abs(rng.rand(12, 4)) * 20
+    rois[:, 2:] += rois[:, :2] + 5
+    gt = rois + rng.uniform(-2, 2, rois.shape)
+    gt[:, 2:] = np.maximum(gt[:, 2:], gt[:, :2] + 1)
+    deltas = bbox_transform(rois, gt.astype(np.float32))
+    back = bbox_pred(rois, deltas)
+    assert np.abs(back - gt).max() < 1e-3
+
+
+def test_anchor_targets_cover_every_gt(cfg):
+    rng = np.random.RandomState(1)
+    anchors = anchor_grid(cfg)
+    gt = np.array([[8, 8, 31, 31], [40, 20, 60, 50]], np.float32)
+    labels, targets, weights = assign_anchor_targets(anchors, gt, cfg, rng)
+    fg = np.where(labels == 1.0)[0]
+    assert fg.size >= 2                      # at least one anchor per gt
+    # every positive regresses to the gt it overlaps most
+    ious = bbox_overlaps(anchors[fg], gt)
+    best = ious.argmax(axis=1)
+    rebuilt = bbox_pred(anchors[fg], targets[fg])
+    assert np.abs(rebuilt - gt[best]).max() < 1e-2
+    assert (weights[fg] == 1.0).all()
+    # batch discipline: at most rpn_batch scored anchors, fg capped
+    scored = np.sum(labels != -1.0)
+    assert scored <= cfg.rpn_batch
+    assert fg.size <= cfg.rpn_batch * cfg.rpn_fg_fraction + 1
+
+
+def test_gen_proposals_static_shape_and_recall(cfg):
+    """A score map peaked on the gt's anchor must yield a proposal set
+    with high IoU to the gt — the static-shape contract included."""
+    rng = np.random.RandomState(2)
+    anchors = anchor_grid(cfg)
+    gt = np.array([[16, 16, 39, 39]], np.float32)
+    ious = bbox_overlaps(anchors, gt)[:, 0]
+    A, F = cfg.num_anchors, cfg.feat_size
+    # grid-major anchor index (pos*A + a) -> head layout (a, pos)
+    scores_flat = ious.reshape(F * F, A).T.reshape(A, F, F)
+    deltas = np.zeros((4 * A, F, F), np.float32)
+    props, mask, scores = gen_proposals(scores_flat, deltas, cfg)
+    assert props.shape == (cfg.post_nms_top, 4)
+    assert mask.shape == (cfg.post_nms_top,)
+    assert mask.any()
+    best = bbox_overlaps(props[mask], gt)[:, 0].max()
+    assert best > 0.7, "peaked scores did not surface the gt box"
+    # NMS sparsity: kept proposals must not overlap above the threshold
+    kept = props[mask]
+    if len(kept) > 1:
+        m = bbox_overlaps(kept, kept)
+        np.fill_diagonal(m, 0)
+        assert m.max() <= cfg.proposal_nms + 1e-6
+
+
+def test_gen_proposals_never_empty(cfg):
+    A, F = cfg.num_anchors, cfg.feat_size
+    props, mask, _ = gen_proposals(np.zeros((A, F, F), np.float32) - 10,
+                                   np.zeros((4 * A, F, F), np.float32),
+                                   cfg)
+    assert mask.any()          # whole-image fallback
+
+
+def test_voc_ap_known_values():
+    # perfect detector: AP 1 under both metrics
+    r = np.array([0.5, 1.0])
+    p = np.array([1.0, 1.0])
+    assert voc_ap(r, p) == pytest.approx(1.0)
+    assert voc_ap(r, p, use_07_metric=True) == pytest.approx(1.0)
+    # half the detections wrong, found half the objects
+    r = np.array([0.25, 0.25, 0.5, 0.5])
+    p = np.array([1.0, 0.5, 0.66, 0.5])
+    assert 0.2 < voc_ap(r, p) < 0.5
+
+
+def test_eval_detections_end_to_end():
+    gt = {0: (np.array([[0, 0, 9, 9], [20, 20, 29, 29]], np.float32),
+              np.array([1, 2])),
+          1: (np.array([[5, 5, 14, 14]], np.float32), np.array([1]))}
+    dets = {
+        1: [(0, 0.9, 0, 0, 9, 9),       # exact hit
+            (1, 0.8, 5, 5, 14, 14),     # exact hit
+            (1, 0.7, 40, 40, 49, 49)],  # false positive
+        2: [(0, 0.6, 20, 20, 29, 29)],  # exact hit
+    }
+    aps, mean_ap = eval_detections(dets, gt, num_classes=2)
+    assert aps[1] == pytest.approx(1.0)      # fps rank below the hits
+    assert aps[2] == pytest.approx(1.0)
+    assert mean_ap == pytest.approx(1.0)
+    # duplicate detections on one gt: second is a false positive
+    dets = {1: [(0, 0.9, 0, 0, 9, 9), (0, 0.8, 0, 0, 9, 9)],
+            2: [(0, 0.6, 20, 20, 29, 29)]}
+    aps, _ = eval_detections(dets, gt, num_classes=2)
+    assert aps[1] < 1.0
+
+
+@pytest.mark.slow
+def test_train_alternate_end_to_end():
+    """The 4-step schedule runs CI-light and passes the mAP gate."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "train_alternate.py", "--epochs", "5",
+         "--train-images", "32", "--test-images", "8",
+         "--map-gate", "0.4"],
+        cwd=RCNN_DIR, env=env, capture_output=True, text=True, timeout=560)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "PASSED" in res.stdout, res.stdout + res.stderr
