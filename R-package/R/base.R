@@ -1,0 +1,42 @@
+# Internal state + loader (reference R-package/R/zzz.R .onLoad).
+#
+# The package runs in two modes: installed (R CMD INSTALL, .onLoad fires)
+# or sourced from a checkout via load.R — both end in mx.internal.load(),
+# which dyn.load()s the compiled glue and points it at libmxtpu_capi.so.
+
+.mx.env <- new.env(parent = emptyenv())
+
+mx.internal.load <- function(glue.so, capi.so) {
+  if (!is.null(glue.so)) dyn.load(glue.so)   # NULL when useDynLib did it
+  .Call("mxg_load", capi.so)
+  .mx.env$func.names <- .Call("mxg_list_function_names")
+  .mx.env$creator.names <- .Call("mxg_sym_list_creator_names")
+  invisible(TRUE)
+}
+
+mx.set.seed <- function(seed) {
+  invisible(.Call("mxg_random_seed", as.integer(seed)))
+}
+
+# device descriptors (codes match capi_bridge.py: cpu=1, tpu=4)
+mx.cpu <- function(dev.id = 0L) {
+  structure(list(device = "cpu", device_typeid = 1L,
+                 device_id = as.integer(dev.id)), class = "MXContext")
+}
+
+mx.tpu <- function(dev.id = 0L) {
+  structure(list(device = "tpu", device_typeid = 4L,
+                 device_id = as.integer(dev.id)), class = "MXContext")
+}
+
+.mx.func.index <- function(name) {
+  idx <- match(name, .mx.env$func.names)
+  if (is.na(idx)) stop("unknown ndarray function: ", name)
+  idx - 1L          # glue indexes the registry 0-based
+}
+
+.mx.creator.index <- function(name) {
+  idx <- match(name, .mx.env$creator.names)
+  if (is.na(idx)) stop("unknown operator: ", name)
+  idx - 1L
+}

@@ -1,0 +1,63 @@
+# Executor over the C ABI (reference R-package/R/executor.R):
+# mx.simple.bind allocates argument/gradient arrays from inferred
+# shapes and binds, mx.exec.* drive forward/backward and read outputs.
+
+mx.simple.bind <- function(symbol, ctx = mx.cpu(), grad.req = "write", ...) {
+  inferred <- mx.symbol.infer.shape(symbol, ...)
+  if (!inferred$complete) stop("shape inference incomplete")
+  arg.names <- arguments.MXSymbol(symbol)
+  input.names <- names(list(...))
+
+  req.code <- c(null = 0L, write = 1L, add = 3L)[[grad.req]]
+  args <- list()
+  grads <- list()
+  reqs <- integer(length(arg.names))
+  for (i in seq_along(arg.names)) {
+    n <- arg.names[[i]]
+    shape <- inferred$arg.shapes[[n]]
+    args[[i]] <- mx.nd.zeros(shape, ctx)
+    if (grad.req != "null" && !(n %in% input.names)) {
+      grads[[i]] <- mx.nd.zeros(shape, ctx)
+      reqs[[i]] <- req.code
+    } else {
+      grads[i] <- list(NULL)
+      reqs[[i]] <- 0L
+    }
+  }
+  aux <- lapply(inferred$aux.shapes, function(s) mx.nd.zeros(s, ctx))
+
+  h <- .Call("mxg_exec_bind", symbol$handle, ctx$device_typeid,
+             ctx$device_id,
+             lapply(args, function(x) x$handle),
+             lapply(grads, function(g) if (is.null(g)) NULL else g$handle),
+             reqs,
+             lapply(aux, function(x) x$handle))
+  names(args) <- arg.names
+  names(grads) <- arg.names
+  structure(list(handle = h, symbol = symbol, arg.arrays = args,
+                 grad.arrays = grads, aux.arrays = aux, ctx = ctx),
+            class = "MXExecutor")
+}
+
+mx.exec.forward <- function(executor, is.train = TRUE) {
+  .Call("mxg_exec_forward", executor$handle, as.integer(is.train))
+  invisible(executor)
+}
+
+mx.exec.backward <- function(executor) {
+  .Call("mxg_exec_backward", executor$handle, list())
+  invisible(executor)
+}
+
+mx.exec.outputs <- function(executor) {
+  lapply(.Call("mxg_exec_outputs", executor$handle), function(h) {
+    structure(list(handle = h), class = "MXNDArray")
+  })
+}
+
+# update one bound argument in place (device array keeps its identity,
+# so the executor sees the new values on the next forward)
+mx.exec.update.arg <- function(executor, name, r.array) {
+  mx.nd.copyto(executor$arg.arrays[[name]], as.double(r.array))
+  invisible(executor)
+}

@@ -1,0 +1,29 @@
+# Array-backed data iterator (reference R-package/R/io.R mx.io.arrayiter):
+# batches an R matrix (rows = samples) + label vector, dropping the tail
+# partial batch like the framework's NDArrayIter default.
+
+mx.io.arrayiter <- function(data, label, batch.size = 32, shuffle = FALSE) {
+  n <- nrow(data)
+  it <- new.env(parent = emptyenv())
+  it$data <- data
+  it$label <- label
+  it$batch.size <- batch.size
+  it$shuffle <- shuffle
+  it$order <- seq_len(n)
+  it$cursor <- 0L
+  class(it) <- "MXArrayIter"
+  it
+}
+
+mx.io.reset <- function(iter) {
+  iter$cursor <- 0L
+  if (iter$shuffle) iter$order <- sample(nrow(iter$data))
+  invisible(iter)
+}
+
+mx.io.next <- function(iter) {
+  if (iter$cursor + iter$batch.size > nrow(iter$data)) return(NULL)
+  idx <- iter$order[(iter$cursor + 1):(iter$cursor + iter$batch.size)]
+  iter$cursor <- iter$cursor + iter$batch.size
+  list(data = iter$data[idx, , drop = FALSE], label = iter$label[idx])
+}

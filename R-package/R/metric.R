@@ -1,0 +1,10 @@
+# Metrics (reference R-package/R/metric.R): list of (init, update, get).
+
+mx.metric.accuracy <- list(
+  init = function() c(0, 0),
+  update = function(state, label, pred.probs) {
+    pick <- max.col(pred.probs) - 1   # classes are 0-based
+    state + c(sum(pick == label), length(label))
+  },
+  get = function(state) state[1] / max(state[2], 1)
+)

@@ -1,0 +1,118 @@
+# FeedForward training (reference R-package/R/model.R
+# mx.model.FeedForward.create): executor-level training loop with an
+# R-side SGD(+momentum) updater — the reference R binding likewise ran
+# its updater through the binding layer rather than a server process.
+
+mx.model.init.params <- function(symbol, input.shapes, initializer.scale) {
+  inferred <- do.call(mx.symbol.infer.shape,
+                      c(list(symbol), input.shapes))
+  arg.names <- arguments.MXSymbol(symbol)
+  params <- list()
+  for (n in arg.names) {
+    if (n %in% names(input.shapes)) next
+    shape <- inferred$arg.shapes[[n]]
+    if (grepl("bias$|beta$", n)) {
+      params[[n]] <- array(0, dim = shape)
+    } else if (grepl("gamma$", n)) {
+      params[[n]] <- array(1, dim = shape)
+    } else {
+      fan.in <- prod(shape) / shape[[length(shape)]]
+      sd <- sqrt(2.0 / fan.in)
+      params[[n]] <- array(rnorm(prod(shape), sd = sd), dim = shape)
+    }
+  }
+  params
+}
+
+mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
+                                        num.round = 10,
+                                        learning.rate = 0.1,
+                                        momentum = 0.9,
+                                        array.batch.size = 32,
+                                        eval.metric = mx.metric.accuracy,
+                                        verbose = TRUE) {
+  batch <- array.batch.size
+  feat <- ncol(X)
+  # R dim order is the REVERSE of the framework's (column-major vs
+  # row-major, reference R binding convention): framework (batch, feat)
+  # is R c(feat, batch)
+  input.shapes <- list(data = c(feat, batch),
+                       softmax_label = batch)
+  exec <- do.call(mx.simple.bind,
+                  c(list(symbol, ctx = ctx, grad.req = "write"),
+                    input.shapes))
+  params <- mx.model.init.params(symbol, input.shapes, 0.07)
+  for (n in names(params)) mx.exec.update.arg(exec, n, params[[n]])
+  momenta <- lapply(params, function(p) array(0, dim = dim(p)))
+
+  iter <- mx.io.arrayiter(X, y, batch.size = batch, shuffle = TRUE)
+  for (round in seq_len(num.round)) {
+    state <- eval.metric$init()
+    mx.io.reset(iter)
+    repeat {
+      b <- mx.io.next(iter)
+      if (is.null(b)) break
+      # row-major batch: feed t(data) so R's column-major memory lines
+      # up with the framework's (batch, feat) layout
+      mx.exec.update.arg(exec, "data", t(b$data))
+      mx.exec.update.arg(exec, "softmax_label", b$label)
+      mx.exec.forward(exec, is.train = TRUE)
+      mx.exec.backward(exec)
+      probs <- t(as.array(mx.exec.outputs(exec)[[1]]))
+      state <- eval.metric$update(state, b$label, probs)
+      for (n in names(params)) {
+        g <- as.array(exec$grad.arrays[[n]])
+        dim(g) <- dim(params[[n]])
+        momenta[[n]] <- momentum * momenta[[n]] -
+          learning.rate * (g / batch)
+        params[[n]] <- params[[n]] + momenta[[n]]
+        mx.exec.update.arg(exec, n, params[[n]])
+      }
+    }
+    if (verbose) {
+      cat(sprintf("Round [%d] Train-accuracy=%.4f\n", round,
+                  eval.metric$get(state)))
+    }
+  }
+  structure(list(symbol = symbol, params = params, exec = exec,
+                 batch = batch), class = "MXFeedForwardModel")
+}
+
+predict.MXFeedForwardModel <- function(object, X, ...) {
+  exec <- object$exec
+  batch <- object$batch
+  n <- nrow(X)
+  out <- NULL
+  i <- 1
+  while (i <= n) {
+    idx <- i:min(i + batch - 1, n)
+    chunk <- X[idx, , drop = FALSE]
+    if (nrow(chunk) < batch) {
+      # the executor's batch shape is fixed: pad the tail, trim after
+      pad <- matrix(0, batch - nrow(chunk), ncol(X))
+      chunk <- rbind(chunk, pad)
+    }
+    mx.exec.update.arg(exec, "data", t(chunk))
+    mx.exec.forward(exec, is.train = FALSE)
+    probs <- t(as.array(mx.exec.outputs(exec)[[1]]))
+    out <- rbind(out, probs[seq_along(idx), , drop = FALSE])
+    i <- i + batch
+  }
+  out
+}
+
+mx.model.save <- function(model, prefix, iteration) {
+  mx.symbol.save(model$symbol, sprintf("%s-symbol.json", prefix))
+  nds <- lapply(model$params, mx.nd.array)
+  names(nds) <- paste0("arg:", names(model$params))
+  mx.nd.save(nds, sprintf("%s-%04d.params", prefix, iteration))
+  invisible(TRUE)
+}
+
+mx.model.load <- function(prefix, iteration) {
+  symbol <- mx.symbol.load(sprintf("%s-symbol.json", prefix))
+  nds <- mx.nd.load(sprintf("%s-%04d.params", prefix, iteration))
+  params <- lapply(nds, as.array)
+  names(params) <- sub("^arg:", "", names(params))
+  list(symbol = symbol, params = params)
+}

@@ -1,0 +1,116 @@
+# NDArray over the C ABI (reference R-package/R/ndarray.R).
+#
+# Layout convention, same as the reference R binding: R is column-major
+# and the framework row-major, so an R array of dim (a, b, c) becomes an
+# NDArray of shape (c, b, a) with identical memory — dim() on the R side
+# always shows the R-order dims (rev of the framework shape).
+
+mx.nd.internal.new <- function(shape.rowmajor, ctx = mx.cpu()) {
+  handle <- .Call("mxg_nd_create", as.integer(shape.rowmajor),
+                  ctx$device_typeid, ctx$device_id)
+  structure(list(handle = handle), class = "MXNDArray")
+}
+
+mx.nd.array <- function(src.array, ctx = mx.cpu()) {
+  if (is.null(dim(src.array))) dim(src.array) <- length(src.array)
+  nd <- mx.nd.internal.new(rev(dim(src.array)), ctx)
+  # column-major R memory == row-major framework memory under the
+  # reversed shape: copy verbatim
+  .Call("mxg_nd_copy_from", nd$handle, as.double(src.array))
+  nd
+}
+
+mx.nd.zeros <- function(shape, ctx = mx.cpu()) {
+  # `shape` in R order, like the reference binding
+  nd <- mx.nd.internal.new(rev(as.integer(shape)), ctx)
+  .Call("mxg_nd_copy_from", nd$handle, double(prod(shape)))
+  nd
+}
+
+mx.nd.ones <- function(shape, ctx = mx.cpu()) {
+  nd <- mx.nd.internal.new(rev(as.integer(shape)), ctx)
+  .Call("mxg_nd_copy_from", nd$handle, rep(1.0, prod(shape)))
+  nd
+}
+
+mx.nd.shape <- function(nd) rev(.Call("mxg_nd_shape", nd$handle))
+
+as.array.MXNDArray <- function(x, ...) {
+  vals <- .Call("mxg_nd_copy_to", x$handle)
+  dim(vals) <- rev(.Call("mxg_nd_shape", x$handle))
+  vals
+}
+
+as.matrix.MXNDArray <- function(x, ...) {
+  a <- as.array(x)
+  if (length(dim(a)) != 2) stop("not a 2-d NDArray")
+  a
+}
+
+mx.nd.copyto <- function(dst, src.vec) {
+  .Call("mxg_nd_copy_from", dst$handle, as.double(src.vec))
+  invisible(dst)
+}
+
+mx.nd.waitall <- function() invisible(.Call("mxg_nd_waitall"))
+
+mx.nd.save <- function(ndarray.list, filename) {
+  handles <- lapply(ndarray.list, function(x) x$handle)
+  .Call("mxg_nd_save", filename, handles, names(ndarray.list))
+  invisible(TRUE)
+}
+
+mx.nd.load <- function(filename) {
+  res <- .Call("mxg_nd_load", filename)
+  out <- lapply(res[[1]], function(h) {
+    structure(list(handle = h), class = "MXNDArray")
+  })
+  names(out) <- res[[2]]
+  out
+}
+
+# registry-function invocation (reference mx.nd.internal.dispatch):
+# out-of-place unary/binary ops route through MXFuncInvoke with one
+# mutate var receiving the result.
+mx.nd.internal.invoke <- function(fname, use.list, scalars, ctx = mx.cpu()) {
+  idx <- .mx.func.index(fname)
+  # MXFuncInvoke sizes its reads from MXFuncDescribe, not from what we
+  # pass — a mismatch would read past our buffers, so stop loudly
+  desc <- .Call("mxg_func_describe", idx)
+  if (desc[1] != length(use.list) || desc[2] != length(scalars) ||
+      desc[3] != 1) {
+    stop(sprintf("%s expects %d inputs/%d scalars/%d outputs, got %d/%d/1",
+                 fname, desc[1], desc[2], desc[3],
+                 length(use.list), length(scalars)))
+  }
+  out <- mx.nd.internal.new(.Call("mxg_nd_shape", use.list[[1]]$handle), ctx)
+  .Call("mxg_func_invoke", idx,
+        lapply(use.list, function(x) x$handle),
+        as.double(scalars), list(out$handle))
+  out
+}
+
+Ops.MXNDArray <- function(e1, e2) {
+  bin <- c("+" = "_plus", "-" = "_minus", "*" = "_mul", "/" = "_div")
+  sca <- c("+" = "_plus_scalar", "-" = "_minus_scalar",
+           "*" = "_mul_scalar", "/" = "_div_scalar")
+  op <- .Generic
+  if (!op %in% names(bin)) stop("unsupported NDArray op: ", op)
+  if (inherits(e1, "MXNDArray") && inherits(e2, "MXNDArray")) {
+    mx.nd.internal.invoke(bin[[op]], list(e1, e2), double(0))
+  } else if (inherits(e1, "MXNDArray")) {
+    mx.nd.internal.invoke(sca[[op]], list(e1), as.double(e2))
+  } else {
+    if (op %in% c("-", "/")) {
+      rsca <- c("-" = "_rminus_scalar", "/" = "_rdiv_scalar")
+      mx.nd.internal.invoke(rsca[[op]], list(e2), as.double(e1))
+    } else {
+      mx.nd.internal.invoke(sca[[op]], list(e2), as.double(e1))
+    }
+  }
+}
+
+print.MXNDArray <- function(x, ...) {
+  cat("<MXNDArray", paste(mx.nd.shape(x), collapse = "x"), ">\n")
+  invisible(x)
+}

@@ -1,0 +1,98 @@
+# Symbolic graphs over the C ABI (reference R-package/R/symbol.R).
+#
+# Operators are generated from the registry: mx.symbol.FullyConnected,
+# mx.symbol.Activation, ... all route through one constructor that
+# splits R arguments into symbol inputs (MXSymbolCompose) and string
+# parameters (MXSymbolCreateAtomicSymbol), exactly how the reference
+# R binding marshalled its ... arguments.
+
+mx.symbol.Variable <- function(name) {
+  structure(list(handle = .Call("mxg_sym_create_variable", name)),
+            class = "MXSymbol")
+}
+
+mx.symbol.load.json <- function(json) {
+  structure(list(handle = .Call("mxg_sym_from_json", json)),
+            class = "MXSymbol")
+}
+
+mx.symbol.load <- function(filename) {
+  mx.symbol.load.json(paste(readLines(filename), collapse = "\n"))
+}
+
+mx.symbol.save <- function(symbol, filename) {
+  writeLines(mx.symbol.tojson(symbol), filename)
+  invisible(TRUE)
+}
+
+mx.symbol.tojson <- function(symbol) .Call("mxg_sym_tojson", symbol$handle)
+
+arguments.MXSymbol <- function(symbol) {
+  .Call("mxg_sym_list_arguments", symbol$handle)
+}
+
+outputs.MXSymbol <- function(symbol) {
+  .Call("mxg_sym_list_outputs", symbol$handle)
+}
+
+mx.symbol.infer.shape <- function(symbol, ...) {
+  kw <- list(...)
+  # shapes arrive in R dim order; the ABI wants framework (row-major)
+  shapes <- lapply(kw, function(s) rev(as.integer(s)))
+  res <- .Call("mxg_sym_infer_shape", symbol$handle, names(kw), shapes)
+  to.r <- function(lst) lapply(lst, function(s) rev(s))
+  arg.shapes <- to.r(res[[1]])
+  names(arg.shapes) <- arguments.MXSymbol(symbol)
+  list(arg.shapes = arg.shapes, out.shapes = to.r(res[[2]]),
+       aux.shapes = to.r(res[[3]]), complete = res[[4]] != 0)
+}
+
+.mx.param.to.string <- function(v) {
+  if (is.logical(v)) return(ifelse(v, "True", "False"))
+  if (is.numeric(v) && length(v) > 1) {
+    return(paste0("(", paste(as.integer(v), collapse = ", "), ")"))
+  }
+  as.character(v)
+}
+
+# the one generic operator constructor
+mx.symbol.internal.create <- function(op.name, args) {
+  name <- ""
+  if (!is.null(args$name)) {
+    name <- args$name
+    args$name <- NULL
+  }
+  is.sym <- vapply(args, function(a) inherits(a, "MXSymbol"), logical(1))
+  sym.args <- args[is.sym]
+  str.args <- args[!is.sym]
+  keys <- names(str.args)
+  vals <- vapply(str.args, .mx.param.to.string, character(1))
+  h <- .Call("mxg_sym_create_atomic", .mx.creator.index(op.name),
+             as.character(keys), as.character(vals))
+  sym <- structure(list(handle = h), class = "MXSymbol")
+  ckeys <- names(sym.args)
+  if (is.null(ckeys) || any(ckeys == "")) ckeys <- NULL
+  .Call("mxg_sym_compose", sym$handle, name,
+        if (is.null(ckeys)) NULL else as.character(ckeys),
+        lapply(sym.args, function(s) s$handle))
+  sym
+}
+
+# generate mx.symbol.<Op> wrappers for the whole registry at load time
+mx.symbol.internal.export <- function(envir = parent.frame()) {
+  for (op in .mx.env$creator.names) {
+    local({
+      op.name <- op
+      fn <- function(...) {
+        mx.symbol.internal.create(op.name, list(...))
+      }
+      assign(paste0("mx.symbol.", op.name), fn, envir = envir)
+    })
+  }
+}
+
+print.MXSymbol <- function(x, ...) {
+  cat("<MXSymbol outputs:",
+      paste(outputs.MXSymbol(x), collapse = ", "), ">\n")
+  invisible(x)
+}

@@ -1,0 +1,23 @@
+# Installed-package load hook (R CMD INSTALL builds src/ into the
+# package DLL named mxnet.tpu, declared in NAMESPACE useDynLib; the
+# source-checkout path uses load.R + mx.internal.load instead).
+#
+# The native core is located via MXNET_TPU_HOME (the repository root
+# holding mxnet_tpu/libmxtpu_capi.so).  Without it, loading defers
+# until the user calls mx.internal.load() explicitly.
+
+.onLoad <- function(libname, pkgname) {
+  root <- Sys.getenv("MXNET_TPU_HOME", "")
+  if (!nzchar(root)) {
+    packageStartupMessage(
+      "mxnet.tpu: set MXNET_TPU_HOME (repo root) or call ",
+      "mx.internal.load(glue.so, capi.so) before use")
+    return(invisible())
+  }
+  capi <- file.path(root, "mxnet_tpu", "libmxtpu_capi.so")
+  .Call("mxg_load", capi)
+  .mx.env$func.names <- .Call("mxg_list_function_names")
+  .mx.env$creator.names <- .Call("mxg_sym_list_creator_names")
+  mx.symbol.internal.export(parent.env(environment()))
+  invisible()
+}

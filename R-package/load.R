@@ -1,0 +1,36 @@
+# Source-checkout loader (no R CMD INSTALL needed):
+#
+#   source("R-package/load.R"); mxnet.load()
+#
+# Builds the glue with R CMD SHLIB on first use, dyn.load()s it, points
+# it at mxnet_tpu/libmxtpu_capi.so, and exports the mx.symbol.<Op>
+# operator wrappers.  The embedded interpreter needs PYTHONPATH to
+# include the repo root BEFORE R starts (see tests/test_r_package.py).
+
+# captured while source() is still on the stack — inside mxnet.load()
+# the sourcing frame is gone and $ofile would be NULL
+.mxnet.load.root <- tryCatch(
+  normalizePath(file.path(dirname(sys.frame(1)$ofile), "..")),
+  error = function(e) getwd())
+
+mxnet.load <- function(root = .mxnet.load.root) {
+  pkg <- file.path(root, "R-package")
+  for (f in c("base.R", "ndarray.R", "symbol.R", "executor.R", "io.R",
+              "metric.R", "model.R")) {
+    source(file.path(pkg, "R", f))
+  }
+  glue.src <- file.path(pkg, "src", "mxnet_glue.c")
+  glue.so <- file.path(pkg, "src",
+                       paste0("mxnet_glue", .Platform$dynlib.ext))
+  if (!file.exists(glue.so) ||
+      file.mtime(glue.so) < file.mtime(glue.src)) {
+    ret <- system2(file.path(R.home("bin"), "R"),
+                   c("CMD", "SHLIB", shQuote(glue.src)))
+    if (ret != 0) stop("R CMD SHLIB failed")
+  }
+  capi <- file.path(root, "mxnet_tpu", "libmxtpu_capi.so")
+  if (!file.exists(capi)) stop("build the native core first: make")
+  mx.internal.load(glue.so, capi)
+  mx.symbol.internal.export(globalenv())
+  invisible(TRUE)
+}

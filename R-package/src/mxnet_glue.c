@@ -1,0 +1,541 @@
+/*
+ * R glue for the TPU-native framework's C ABI (include/c_api.h).
+ *
+ * Reference analogue: R-package/src/ (Rcpp bindings over
+ * include/mxnet/c_api.h).  This glue is plain C over R's .Call API so
+ * it builds with nothing but `R CMD SHLIB mxnet_glue.c` — no Rcpp.
+ * libmxtpu_capi.so is dlopen'd at runtime (mxg_load) and every MX*
+ * entry point resolved with dlsym; handles cross into R as external
+ * pointers with finalizers.
+ *
+ * Build:  R CMD SHLIB mxnet_glue.c
+ * Load:   dyn.load("mxnet_glue.so"); .Call("mxg_load", path_to_capi_so)
+ */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <R.h>
+#include <Rinternals.h>
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef const void *FunctionHandle;
+typedef const void *AtomicSymbolCreator;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+
+/* ---- resolved entry points ------------------------------------------- */
+static struct {
+  void *dl;
+  const char *(*GetLastError)(void);
+  int (*RandomSeed)(int);
+  int (*NDArrayCreateEx)(const mx_uint *, mx_uint, int, int, int, int,
+                         NDArrayHandle *);
+  int (*NDArraySyncCopyFromCPU)(NDArrayHandle, const void *, size_t);
+  int (*NDArraySyncCopyToCPU)(NDArrayHandle, void *, size_t);
+  int (*NDArrayWaitAll)(void);
+  int (*NDArrayFree)(NDArrayHandle);
+  int (*NDArrayGetShape)(NDArrayHandle, mx_uint *, const mx_uint **);
+  int (*NDArraySave)(const char *, mx_uint, NDArrayHandle *, const char **);
+  int (*NDArrayLoad)(const char *, mx_uint *, NDArrayHandle **, mx_uint *,
+                     const char ***);
+  int (*ListFunctions)(mx_uint *, FunctionHandle **);
+  int (*FuncGetInfo)(FunctionHandle, const char **, const char **, mx_uint *,
+                     const char ***, const char ***, const char ***);
+  int (*FuncDescribe)(FunctionHandle, mx_uint *, mx_uint *, mx_uint *, int *);
+  int (*FuncInvoke)(FunctionHandle, NDArrayHandle *, mx_float *,
+                    NDArrayHandle *);
+  int (*SymbolListAtomicSymbolCreators)(mx_uint *, AtomicSymbolCreator **);
+  int (*SymbolGetAtomicSymbolInfo)(AtomicSymbolCreator, const char **,
+                                   const char **, mx_uint *, const char ***,
+                                   const char ***, const char ***,
+                                   const char **);
+  int (*SymbolCreateAtomicSymbol)(AtomicSymbolCreator, mx_uint, const char **,
+                                  const char **, SymbolHandle *);
+  int (*SymbolCreateVariable)(const char *, SymbolHandle *);
+  int (*SymbolCreateFromJSON)(const char *, SymbolHandle *);
+  int (*SymbolSaveToJSON)(SymbolHandle, const char **);
+  int (*SymbolFree)(SymbolHandle);
+  int (*SymbolCompose)(SymbolHandle, const char *, mx_uint, const char **,
+                       SymbolHandle *);
+  int (*SymbolListArguments)(SymbolHandle, mx_uint *, const char ***);
+  int (*SymbolListOutputs)(SymbolHandle, mx_uint *, const char ***);
+  int (*SymbolListAuxiliaryStates)(SymbolHandle, mx_uint *, const char ***);
+  int (*SymbolInferShape)(SymbolHandle, mx_uint, const char **,
+                          const mx_uint *, const mx_uint *, mx_uint *,
+                          const mx_uint **, const mx_uint ***, mx_uint *,
+                          const mx_uint **, const mx_uint ***, mx_uint *,
+                          const mx_uint **, const mx_uint ***, int *);
+  int (*ExecutorBind)(SymbolHandle, int, int, mx_uint, NDArrayHandle *,
+                      NDArrayHandle *, mx_uint *, mx_uint, NDArrayHandle *,
+                      ExecutorHandle *);
+  int (*ExecutorForward)(ExecutorHandle, int);
+  int (*ExecutorBackward)(ExecutorHandle, mx_uint, NDArrayHandle *);
+  int (*ExecutorOutputs)(ExecutorHandle, mx_uint *, NDArrayHandle **);
+  int (*ExecutorFree)(ExecutorHandle);
+  /* registries cached at load */
+  mx_uint n_funcs;
+  FunctionHandle *funcs;
+  mx_uint n_creators;
+  AtomicSymbolCreator *creators;
+} mxg;
+
+static void chk(int ret) {
+  if (ret != 0) Rf_error("mxnet_tpu: %s", mxg.GetLastError());
+}
+
+#define RESOLVE(field, sym_name)                                   \
+  do {                                                             \
+    *(void **)(&mxg.field) = dlsym(mxg.dl, sym_name);              \
+    if (mxg.field == NULL) Rf_error("missing symbol %s", sym_name); \
+  } while (0)
+
+SEXP mxg_load(SEXP path) {
+  /* guard on the LAST field assigned: a failed half-load (missing
+   * symbol, registry error) must retry fully on the next call instead
+   * of reporting success with NULL function pointers */
+  if (mxg.funcs != NULL) return R_NilValue;
+  const char *p = CHAR(STRING_ELT(path, 0));
+  mxg.dl = dlopen(p, RTLD_NOW | RTLD_GLOBAL);
+  if (mxg.dl == NULL) Rf_error("dlopen(%s): %s", p, dlerror());
+  RESOLVE(GetLastError, "MXGetLastError");
+  RESOLVE(RandomSeed, "MXRandomSeed");
+  RESOLVE(NDArrayCreateEx, "MXNDArrayCreateEx");
+  RESOLVE(NDArraySyncCopyFromCPU, "MXNDArraySyncCopyFromCPU");
+  RESOLVE(NDArraySyncCopyToCPU, "MXNDArraySyncCopyToCPU");
+  RESOLVE(NDArrayWaitAll, "MXNDArrayWaitAll");
+  RESOLVE(NDArrayFree, "MXNDArrayFree");
+  RESOLVE(NDArrayGetShape, "MXNDArrayGetShape");
+  RESOLVE(NDArraySave, "MXNDArraySave");
+  RESOLVE(NDArrayLoad, "MXNDArrayLoad");
+  RESOLVE(ListFunctions, "MXListFunctions");
+  RESOLVE(FuncGetInfo, "MXFuncGetInfo");
+  RESOLVE(FuncDescribe, "MXFuncDescribe");
+  RESOLVE(FuncInvoke, "MXFuncInvoke");
+  RESOLVE(SymbolListAtomicSymbolCreators, "MXSymbolListAtomicSymbolCreators");
+  RESOLVE(SymbolGetAtomicSymbolInfo, "MXSymbolGetAtomicSymbolInfo");
+  RESOLVE(SymbolCreateAtomicSymbol, "MXSymbolCreateAtomicSymbol");
+  RESOLVE(SymbolCreateVariable, "MXSymbolCreateVariable");
+  RESOLVE(SymbolCreateFromJSON, "MXSymbolCreateFromJSON");
+  RESOLVE(SymbolSaveToJSON, "MXSymbolSaveToJSON");
+  RESOLVE(SymbolFree, "MXSymbolFree");
+  RESOLVE(SymbolCompose, "MXSymbolCompose");
+  RESOLVE(SymbolListArguments, "MXSymbolListArguments");
+  RESOLVE(SymbolListOutputs, "MXSymbolListOutputs");
+  RESOLVE(SymbolListAuxiliaryStates, "MXSymbolListAuxiliaryStates");
+  RESOLVE(SymbolInferShape, "MXSymbolInferShape");
+  RESOLVE(ExecutorBind, "MXExecutorBind");
+  RESOLVE(ExecutorForward, "MXExecutorForward");
+  RESOLVE(ExecutorBackward, "MXExecutorBackward");
+  RESOLVE(ExecutorOutputs, "MXExecutorOutputs");
+  RESOLVE(ExecutorFree, "MXExecutorFree");
+  /* the registry ARRAYS are arena-backed in the ABI (invalidated by
+   * the next call); the interned handle VALUES persist — copy each
+   * array immediately, before any further MX* call */
+  FunctionHandle *funcs_tmp;
+  chk(mxg.ListFunctions(&mxg.n_funcs, &funcs_tmp));
+  mxg.funcs =
+      (FunctionHandle *)malloc(mxg.n_funcs * sizeof(FunctionHandle));
+  memcpy(mxg.funcs, funcs_tmp, mxg.n_funcs * sizeof(FunctionHandle));
+  AtomicSymbolCreator *creators_tmp;
+  chk(mxg.SymbolListAtomicSymbolCreators(&mxg.n_creators, &creators_tmp));
+  mxg.creators = (AtomicSymbolCreator *)malloc(
+      mxg.n_creators * sizeof(AtomicSymbolCreator));
+  memcpy(mxg.creators, creators_tmp,
+         mxg.n_creators * sizeof(AtomicSymbolCreator));
+  return R_NilValue;
+}
+
+SEXP mxg_random_seed(SEXP seed) {
+  chk(mxg.RandomSeed(Rf_asInteger(seed)));
+  return R_NilValue;
+}
+
+/* ---- handles ---------------------------------------------------------- */
+static void nd_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    mxg.NDArrayFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void sym_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    mxg.SymbolFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void exec_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    mxg.ExecutorFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static SEXP wrap_handle(void *h, void (*fin)(SEXP)) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+static void *unwrap(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h == NULL) Rf_error("handle already freed");
+  return h;
+}
+
+/* ---- NDArray ----------------------------------------------------------- */
+SEXP mxg_nd_create(SEXP shape, SEXP dev_type, SEXP dev_id) {
+  mx_uint dims[8];
+  int nd = LENGTH(shape);
+  if (nd > 8) Rf_error("ndim > 8");
+  for (int i = 0; i < nd; ++i) dims[i] = (mx_uint)INTEGER(shape)[i];
+  NDArrayHandle out;
+  chk(mxg.NDArrayCreateEx(dims, (mx_uint)nd, Rf_asInteger(dev_type),
+                          Rf_asInteger(dev_id), 0, /*f32*/ 0, &out));
+  return wrap_handle(out, nd_finalizer);
+}
+
+SEXP mxg_nd_copy_from(SEXP h, SEXP data) {
+  size_t n = (size_t)XLENGTH(data);
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  const double *src = REAL(data);
+  for (size_t i = 0; i < n; ++i) buf[i] = (float)src[i];
+  chk(mxg.NDArraySyncCopyFromCPU(unwrap(h), buf, n));
+  return R_NilValue;
+}
+
+SEXP mxg_nd_shape(SEXP h) {
+  mx_uint nd;
+  const mx_uint *dims;
+  chk(mxg.NDArrayGetShape(unwrap(h), &nd, &dims));
+  SEXP out = PROTECT(Rf_allocVector(INTSXP, nd));
+  for (mx_uint i = 0; i < nd; ++i) INTEGER(out)[i] = (int)dims[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxg_nd_copy_to(SEXP h) {
+  mx_uint nd;
+  const mx_uint *dims;
+  chk(mxg.NDArrayGetShape(unwrap(h), &nd, &dims));
+  size_t n = 1;
+  for (mx_uint i = 0; i < nd; ++i) n *= dims[i];
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  chk(mxg.NDArraySyncCopyToCPU(unwrap(h), buf, n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)n));
+  for (size_t i = 0; i < n; ++i) REAL(out)[i] = (double)buf[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxg_nd_waitall(void) {
+  chk(mxg.NDArrayWaitAll());
+  return R_NilValue;
+}
+
+SEXP mxg_nd_save(SEXP fname, SEXP handles, SEXP names) {
+  int n = LENGTH(handles);
+  NDArrayHandle *hs =
+      (NDArrayHandle *)R_alloc((size_t)n, sizeof(NDArrayHandle));
+  const char **ks = (const char **)R_alloc((size_t)n, sizeof(char *));
+  for (int i = 0; i < n; ++i) {
+    hs[i] = unwrap(VECTOR_ELT(handles, i));
+    ks[i] = CHAR(STRING_ELT(names, i));
+  }
+  chk(mxg.NDArraySave(CHAR(STRING_ELT(fname, 0)), (mx_uint)n, hs, ks));
+  return R_NilValue;
+}
+
+SEXP mxg_nd_load(SEXP fname) {
+  mx_uint n, n_names;
+  NDArrayHandle *arrs;
+  const char **names;
+  chk(mxg.NDArrayLoad(CHAR(STRING_ELT(fname, 0)), &n, &arrs, &n_names,
+                      &names));
+  SEXP hs = PROTECT(Rf_allocVector(VECSXP, n));
+  for (mx_uint i = 0; i < n; ++i)
+    SET_VECTOR_ELT(hs, i, wrap_handle(arrs[i], nd_finalizer));
+  SEXP nm = PROTECT(Rf_allocVector(STRSXP, n_names));
+  for (mx_uint i = 0; i < n_names; ++i)
+    SET_STRING_ELT(nm, i, Rf_mkChar(names[i]));
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, 2));
+  SET_VECTOR_ELT(out, 0, hs);
+  SET_VECTOR_ELT(out, 1, nm);
+  UNPROTECT(3);
+  return out;
+}
+
+/* ---- function registry ------------------------------------------------- */
+SEXP mxg_list_function_names(void) {
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, mxg.n_funcs));
+  for (mx_uint i = 0; i < mxg.n_funcs; ++i) {
+    const char *name, *desc;
+    mx_uint na;
+    const char **an, **at, **ad;
+    chk(mxg.FuncGetInfo(mxg.funcs[i], &name, &desc, &na, &an, &at, &ad));
+    SET_STRING_ELT(out, i, Rf_mkChar(name));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxg_func_describe(SEXP idx) {
+  mx_uint nu, ns, nm;
+  int mask;
+  chk(mxg.FuncDescribe(mxg.funcs[Rf_asInteger(idx)], &nu, &ns, &nm, &mask));
+  SEXP out = PROTECT(Rf_allocVector(INTSXP, 4));
+  INTEGER(out)[0] = (int)nu;
+  INTEGER(out)[1] = (int)ns;
+  INTEGER(out)[2] = (int)nm;
+  INTEGER(out)[3] = mask;
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxg_func_invoke(SEXP idx, SEXP use, SEXP scalars, SEXP mutate) {
+  int nu = LENGTH(use), ns = LENGTH(scalars), nm = LENGTH(mutate);
+  NDArrayHandle *uh =
+      (NDArrayHandle *)R_alloc((size_t)(nu > 0 ? nu : 1), sizeof(void *));
+  NDArrayHandle *mh =
+      (NDArrayHandle *)R_alloc((size_t)(nm > 0 ? nm : 1), sizeof(void *));
+  mx_float *sc = (mx_float *)R_alloc((size_t)(ns > 0 ? ns : 1),
+                                     sizeof(mx_float));
+  for (int i = 0; i < nu; ++i) uh[i] = unwrap(VECTOR_ELT(use, i));
+  for (int i = 0; i < nm; ++i) mh[i] = unwrap(VECTOR_ELT(mutate, i));
+  for (int i = 0; i < ns; ++i) sc[i] = (mx_float)REAL(scalars)[i];
+  chk(mxg.FuncInvoke(mxg.funcs[Rf_asInteger(idx)], uh, sc, mh));
+  return R_NilValue;
+}
+
+/* ---- symbol ------------------------------------------------------------ */
+SEXP mxg_sym_list_creator_names(void) {
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, mxg.n_creators));
+  for (mx_uint i = 0; i < mxg.n_creators; ++i) {
+    const char *name, *desc, *kv;
+    mx_uint na;
+    const char **an, **at, **ad;
+    chk(mxg.SymbolGetAtomicSymbolInfo(mxg.creators[i], &name, &desc, &na,
+                                      &an, &at, &ad, &kv));
+    SET_STRING_ELT(out, i, Rf_mkChar(name));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxg_sym_create_atomic(SEXP idx, SEXP keys, SEXP vals) {
+  int n = LENGTH(keys);
+  const char **ks = (const char **)R_alloc((size_t)(n > 0 ? n : 1),
+                                           sizeof(char *));
+  const char **vs = (const char **)R_alloc((size_t)(n > 0 ? n : 1),
+                                           sizeof(char *));
+  for (int i = 0; i < n; ++i) {
+    ks[i] = CHAR(STRING_ELT(keys, i));
+    vs[i] = CHAR(STRING_ELT(vals, i));
+  }
+  SymbolHandle out;
+  chk(mxg.SymbolCreateAtomicSymbol(mxg.creators[Rf_asInteger(idx)],
+                                   (mx_uint)n, ks, vs, &out));
+  return wrap_handle(out, sym_finalizer);
+}
+
+SEXP mxg_sym_create_variable(SEXP name) {
+  SymbolHandle out;
+  chk(mxg.SymbolCreateVariable(CHAR(STRING_ELT(name, 0)), &out));
+  return wrap_handle(out, sym_finalizer);
+}
+
+SEXP mxg_sym_from_json(SEXP json) {
+  SymbolHandle out;
+  chk(mxg.SymbolCreateFromJSON(CHAR(STRING_ELT(json, 0)), &out));
+  return wrap_handle(out, sym_finalizer);
+}
+
+SEXP mxg_sym_tojson(SEXP sym) {
+  const char *json;
+  chk(mxg.SymbolSaveToJSON(unwrap(sym), &json));
+  return Rf_mkString(json);
+}
+
+SEXP mxg_sym_compose(SEXP sym, SEXP name, SEXP keys, SEXP args) {
+  int n = LENGTH(args);
+  const char **ks = NULL;
+  if (!Rf_isNull(keys)) {
+    ks = (const char **)R_alloc((size_t)(n > 0 ? n : 1), sizeof(char *));
+    for (int i = 0; i < n; ++i) ks[i] = CHAR(STRING_ELT(keys, i));
+  }
+  SymbolHandle *hs =
+      (SymbolHandle *)R_alloc((size_t)(n > 0 ? n : 1), sizeof(void *));
+  for (int i = 0; i < n; ++i) hs[i] = unwrap(VECTOR_ELT(args, i));
+  chk(mxg.SymbolCompose(unwrap(sym), CHAR(STRING_ELT(name, 0)), (mx_uint)n,
+                        ks, hs));
+  return R_NilValue;
+}
+
+static SEXP str_array(mx_uint n, const char **arr) {
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (mx_uint i = 0; i < n; ++i) SET_STRING_ELT(out, i, Rf_mkChar(arr[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxg_sym_list_arguments(SEXP sym) {
+  mx_uint n;
+  const char **arr;
+  chk(mxg.SymbolListArguments(unwrap(sym), &n, &arr));
+  return str_array(n, arr);
+}
+
+SEXP mxg_sym_list_outputs(SEXP sym) {
+  mx_uint n;
+  const char **arr;
+  chk(mxg.SymbolListOutputs(unwrap(sym), &n, &arr));
+  return str_array(n, arr);
+}
+
+SEXP mxg_sym_list_aux(SEXP sym) {
+  mx_uint n;
+  const char **arr;
+  chk(mxg.SymbolListAuxiliaryStates(unwrap(sym), &n, &arr));
+  return str_array(n, arr);
+}
+
+static SEXP shape_list(mx_uint n, const mx_uint *ndims,
+                       const mx_uint **data) {
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, n));
+  for (mx_uint i = 0; i < n; ++i) {
+    SEXP s = Rf_allocVector(INTSXP, ndims[i]);
+    SET_VECTOR_ELT(out, i, s);
+    for (mx_uint j = 0; j < ndims[i]; ++j)
+      INTEGER(s)[j] = (int)data[i][j];
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxg_sym_infer_shape(SEXP sym, SEXP keys, SEXP shapes) {
+  int n = LENGTH(keys);
+  const char **ks = (const char **)R_alloc((size_t)(n > 0 ? n : 1),
+                                           sizeof(char *));
+  mx_uint *ind = (mx_uint *)R_alloc((size_t)n + 1, sizeof(mx_uint));
+  int total = 0;
+  for (int i = 0; i < n; ++i) total += LENGTH(VECTOR_ELT(shapes, i));
+  mx_uint *flat = (mx_uint *)R_alloc((size_t)(total > 0 ? total : 1),
+                                     sizeof(mx_uint));
+  ind[0] = 0;
+  int pos = 0;
+  for (int i = 0; i < n; ++i) {
+    ks[i] = CHAR(STRING_ELT(keys, i));
+    SEXP s = VECTOR_ELT(shapes, i);
+    for (int j = 0; j < LENGTH(s); ++j) flat[pos++] = (mx_uint)INTEGER(s)[j];
+    ind[i + 1] = (mx_uint)pos;
+  }
+  mx_uint in_n, out_n, aux_n;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_d, **out_d, **aux_d;
+  int complete;
+  chk(mxg.SymbolInferShape(unwrap(sym), (mx_uint)n, ks, ind, flat, &in_n,
+                           &in_nd, &in_d, &out_n, &out_nd, &out_d, &aux_n,
+                           &aux_nd, &aux_d, &complete));
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, 4));
+  SET_VECTOR_ELT(out, 0, shape_list(in_n, in_nd, in_d));
+  SET_VECTOR_ELT(out, 1, shape_list(out_n, out_nd, out_d));
+  SET_VECTOR_ELT(out, 2, shape_list(aux_n, aux_nd, aux_d));
+  SET_VECTOR_ELT(out, 3, Rf_ScalarInteger(complete));
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---- executor ---------------------------------------------------------- */
+SEXP mxg_exec_bind(SEXP sym, SEXP dev_type, SEXP dev_id, SEXP in_args,
+                   SEXP arg_grads, SEXP grad_req, SEXP aux) {
+  int n = LENGTH(in_args), na = LENGTH(aux);
+  NDArrayHandle *args =
+      (NDArrayHandle *)R_alloc((size_t)(n > 0 ? n : 1), sizeof(void *));
+  NDArrayHandle *grads =
+      (NDArrayHandle *)R_alloc((size_t)(n > 0 ? n : 1), sizeof(void *));
+  mx_uint *req = (mx_uint *)R_alloc((size_t)(n > 0 ? n : 1),
+                                    sizeof(mx_uint));
+  NDArrayHandle *auxs =
+      (NDArrayHandle *)R_alloc((size_t)(na > 0 ? na : 1), sizeof(void *));
+  for (int i = 0; i < n; ++i) {
+    args[i] = unwrap(VECTOR_ELT(in_args, i));
+    SEXP g = VECTOR_ELT(arg_grads, i);
+    grads[i] = Rf_isNull(g) ? NULL : unwrap(g);
+    req[i] = (mx_uint)INTEGER(grad_req)[i];
+  }
+  for (int i = 0; i < na; ++i) auxs[i] = unwrap(VECTOR_ELT(aux, i));
+  ExecutorHandle out;
+  chk(mxg.ExecutorBind(unwrap(sym), Rf_asInteger(dev_type),
+                       Rf_asInteger(dev_id), (mx_uint)n, args, grads, req,
+                       (mx_uint)na, auxs, &out));
+  return wrap_handle(out, exec_finalizer);
+}
+
+SEXP mxg_exec_forward(SEXP ex, SEXP is_train) {
+  chk(mxg.ExecutorForward(unwrap(ex), Rf_asInteger(is_train)));
+  return R_NilValue;
+}
+
+SEXP mxg_exec_backward(SEXP ex, SEXP head_grads) {
+  int n = LENGTH(head_grads);
+  NDArrayHandle *hs =
+      (NDArrayHandle *)R_alloc((size_t)(n > 0 ? n : 1), sizeof(void *));
+  for (int i = 0; i < n; ++i) hs[i] = unwrap(VECTOR_ELT(head_grads, i));
+  chk(mxg.ExecutorBackward(unwrap(ex), (mx_uint)n, hs));
+  return R_NilValue;
+}
+
+SEXP mxg_exec_outputs(SEXP ex) {
+  mx_uint n;
+  NDArrayHandle *outs;
+  chk(mxg.ExecutorOutputs(unwrap(ex), &n, &outs));
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, n));
+  for (mx_uint i = 0; i < n; ++i)
+    SET_VECTOR_ELT(out, i, wrap_handle(outs[i], nd_finalizer));
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---- registration ------------------------------------------------------ */
+static const R_CallMethodDef call_methods[] = {
+    {"mxg_load", (DL_FUNC)&mxg_load, 1},
+    {"mxg_random_seed", (DL_FUNC)&mxg_random_seed, 1},
+    {"mxg_nd_create", (DL_FUNC)&mxg_nd_create, 3},
+    {"mxg_nd_copy_from", (DL_FUNC)&mxg_nd_copy_from, 2},
+    {"mxg_nd_copy_to", (DL_FUNC)&mxg_nd_copy_to, 1},
+    {"mxg_nd_shape", (DL_FUNC)&mxg_nd_shape, 1},
+    {"mxg_nd_waitall", (DL_FUNC)&mxg_nd_waitall, 0},
+    {"mxg_nd_save", (DL_FUNC)&mxg_nd_save, 3},
+    {"mxg_nd_load", (DL_FUNC)&mxg_nd_load, 1},
+    {"mxg_list_function_names", (DL_FUNC)&mxg_list_function_names, 0},
+    {"mxg_func_describe", (DL_FUNC)&mxg_func_describe, 1},
+    {"mxg_func_invoke", (DL_FUNC)&mxg_func_invoke, 4},
+    {"mxg_sym_list_creator_names", (DL_FUNC)&mxg_sym_list_creator_names, 0},
+    {"mxg_sym_create_atomic", (DL_FUNC)&mxg_sym_create_atomic, 3},
+    {"mxg_sym_create_variable", (DL_FUNC)&mxg_sym_create_variable, 1},
+    {"mxg_sym_from_json", (DL_FUNC)&mxg_sym_from_json, 1},
+    {"mxg_sym_tojson", (DL_FUNC)&mxg_sym_tojson, 1},
+    {"mxg_sym_compose", (DL_FUNC)&mxg_sym_compose, 4},
+    {"mxg_sym_list_arguments", (DL_FUNC)&mxg_sym_list_arguments, 1},
+    {"mxg_sym_list_outputs", (DL_FUNC)&mxg_sym_list_outputs, 1},
+    {"mxg_sym_list_aux", (DL_FUNC)&mxg_sym_list_aux, 1},
+    {"mxg_sym_infer_shape", (DL_FUNC)&mxg_sym_infer_shape, 3},
+    {"mxg_exec_bind", (DL_FUNC)&mxg_exec_bind, 7},
+    {"mxg_exec_forward", (DL_FUNC)&mxg_exec_forward, 2},
+    {"mxg_exec_backward", (DL_FUNC)&mxg_exec_backward, 2},
+    {"mxg_exec_outputs", (DL_FUNC)&mxg_exec_outputs, 1},
+    {NULL, NULL, 0}};
+
+void R_init_mxnet_glue(DllInfo *dll) {
+  R_registerRoutines(dll, NULL, call_methods, NULL, NULL);
+  R_useDynamicSymbols(dll, TRUE);
+}

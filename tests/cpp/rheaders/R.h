@@ -1,0 +1,2 @@
+/* mock forwarding header (no R in this image): see ../rmock.h */
+#include "../rmock.h"

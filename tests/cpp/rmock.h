@@ -1,0 +1,143 @@
+/*
+ * Minimal mock of the R C API surface that R-package/src/mxnet_glue.c
+ * consumes — just enough to EXECUTE the glue in this image (which has
+ * no R installation) against the real libmxtpu_capi.so.  The real
+ * build path is `R CMD SHLIB mxnet_glue.c`; this header exists so the
+ * test suite can prove the glue's marshalling end-to-end anyway.
+ *
+ * SEXPs are heap-allocated tagged records; allocations are leaked (the
+ * test process is short-lived, like R's GC arena would reclaim them).
+ */
+#ifndef MXTPU_TESTS_RMOCK_H_
+#define MXTPU_TESTS_RMOCK_H_
+
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef long R_xlen_t;
+
+#ifndef TRUE
+#define TRUE 1
+#define FALSE 0
+#endif
+
+typedef struct sexp_rec {
+  int type; /* 0 nil, 1 int, 2 real, 3 str, 4 vec, 5 charsxp, 6 extptr */
+  long len;
+  int *ints;
+  double *reals;
+  struct sexp_rec **elts; /* vec elements or str charsxps */
+  char *chars;            /* charsxp payload */
+  void *ptr;              /* extptr payload */
+  void (*fin)(struct sexp_rec *);
+} *SEXP;
+
+#define NILSXP 0
+#define INTSXP 1
+#define REALSXP 2
+#define STRSXP 3
+#define VECSXP 4
+
+static struct sexp_rec rmock_nil = {0, 0, NULL, NULL, NULL, NULL, NULL, NULL};
+#define R_NilValue (&rmock_nil)
+
+static SEXP rmock_new(int type, long len) {
+  SEXP s = (SEXP)calloc(1, sizeof(struct sexp_rec));
+  s->type = type;
+  s->len = len;
+  if (type == INTSXP) s->ints = (int *)calloc(len ? len : 1, sizeof(int));
+  if (type == REALSXP)
+    s->reals = (double *)calloc(len ? len : 1, sizeof(double));
+  if (type == STRSXP || type == VECSXP)
+    s->elts = (SEXP *)calloc(len ? len : 1, sizeof(SEXP));
+  return s;
+}
+
+static SEXP Rf_allocVector(int type, long len) { return rmock_new(type, len); }
+static int LENGTH(SEXP s) { return (int)s->len; }
+static long XLENGTH(SEXP s) { return s->len; }
+static int *INTEGER(SEXP s) { return s->ints; }
+static double *REAL(SEXP s) { return s->reals; }
+static SEXP VECTOR_ELT(SEXP s, long i) { return s->elts[i]; }
+static void SET_VECTOR_ELT(SEXP s, long i, SEXP v) { s->elts[i] = v; }
+static SEXP STRING_ELT(SEXP s, long i) { return s->elts[i]; }
+static void SET_STRING_ELT(SEXP s, long i, SEXP v) { s->elts[i] = v; }
+static const char *CHAR(SEXP s) { return s->chars; }
+
+static SEXP Rf_mkChar(const char *c) {
+  SEXP s = rmock_new(5, (long)strlen(c));
+  s->chars = (char *)malloc(strlen(c) + 1);
+  memcpy(s->chars, c, strlen(c) + 1);
+  return s;
+}
+
+static SEXP Rf_mkString(const char *c) {
+  SEXP s = rmock_new(STRSXP, 1);
+  s->elts[0] = Rf_mkChar(c);
+  return s;
+}
+
+static SEXP Rf_ScalarInteger(int v) {
+  SEXP s = rmock_new(INTSXP, 1);
+  s->ints[0] = v;
+  return s;
+}
+
+static int Rf_asInteger(SEXP s) {
+  if (s->type == INTSXP) return s->ints[0];
+  if (s->type == REALSXP) return (int)s->reals[0];
+  fprintf(stderr, "rmock: asInteger on type %d\n", s->type);
+  exit(1);
+}
+
+static int Rf_isNull(SEXP s) { return s == R_NilValue || s->type == NILSXP; }
+
+static void Rf_error(const char *fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "rmock Rf_error: ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+  exit(1);
+}
+
+static char *R_alloc(size_t n, int size) {
+  return (char *)calloc(n ? n : 1, (size_t)size);
+}
+
+#define PROTECT(x) (x)
+#define UNPROTECT(n) ((void)(n))
+
+static SEXP R_MakeExternalPtr(void *p, SEXP tag, SEXP prot) {
+  (void)tag;
+  (void)prot;
+  SEXP s = rmock_new(6, 0);
+  s->ptr = p;
+  return s;
+}
+static void *R_ExternalPtrAddr(SEXP s) { return s->ptr; }
+static void R_ClearExternalPtr(SEXP s) { s->ptr = NULL; }
+static void R_RegisterCFinalizerEx(SEXP s, void (*fin)(SEXP), int onexit) {
+  (void)onexit;
+  s->fin = fin;
+}
+
+/* registration stubs */
+typedef void *DL_FUNC;
+typedef struct {
+  const char *name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+typedef struct DllInfo DllInfo;
+static void R_registerRoutines(DllInfo *dll, const void *a,
+                               const R_CallMethodDef *b, const void *c,
+                               const void *d) {
+  (void)dll; (void)a; (void)b; (void)c; (void)d;
+}
+static void R_useDynamicSymbols(DllInfo *dll, int v) { (void)dll; (void)v; }
+
+#endif /* MXTPU_TESTS_RMOCK_H_ */
