@@ -141,6 +141,24 @@ class NDArray {
     Check(MXNDArraySave(fname.c_str(), hs.size(), hs.data(), keys.data()));
   }
 
+  /*! \brief load named arrays from one file (checkpoint format). */
+  static std::map<std::string, NDArray> Load(const std::string &fname) {
+    mx_uint n, n_names;
+    NDArrayHandle *arrs;
+    const char **names;
+    Check(MXNDArrayLoad(fname.c_str(), &n, &arrs, &n_names, &names));
+    // own every handle BEFORE validating: a throw must free them, not
+    // pin them in the bridge table forever
+    std::vector<NDArray> owned;
+    for (mx_uint i = 0; i < n; ++i)
+      owned.push_back(NDArray::FromHandle(arrs[i]));
+    if (n_names != n)
+      throw std::runtime_error("Load: unnamed arrays in " + fname);
+    std::map<std::string, NDArray> out;
+    for (mx_uint i = 0; i < n; ++i) out.emplace(names[i], owned[i]);
+    return out;
+  }
+
   /*! \brief invoke a registered imperative function (mx.nd.* parity). */
   static void Invoke(const std::string &fname,
                      const std::vector<NDArrayHandle> &use,
@@ -325,6 +343,7 @@ class Executor {
     std::vector<std::vector<mx_uint>> arg_shapes, out_shapes, aux_shapes;
     sym.InferShape(input_shapes, &arg_shapes, &out_shapes, &aux_shapes);
     arg_names_ = sym.ListArguments();
+    aux_names_ = sym.ListAuxiliaryStates();
     for (size_t i = 0; i < arg_names_.size(); ++i) {
       args_.emplace_back(arg_shapes[i], ctx);
       bool is_input = input_shapes.count(arg_names_[i]) > 0;
@@ -365,6 +384,13 @@ class Executor {
   std::vector<NDArray> &Grads() { return grads_; }
   const std::vector<mx_uint> &GradReq() const { return grad_req_; }
 
+  const std::vector<std::string> &AuxNames() const { return aux_names_; }
+  NDArray &Aux(const std::string &name) {
+    for (size_t i = 0; i < aux_names_.size(); ++i)
+      if (aux_names_[i] == name) return aux_[i];
+    throw std::runtime_error("no auxiliary state named " + name);
+  }
+
   void Forward(bool is_train) {
     Check(MXExecutorForward(handle_.get(), is_train ? 1 : 0));
   }
@@ -394,7 +420,7 @@ class Executor {
 
  private:
   Symbol sym_;
-  std::vector<std::string> arg_names_;
+  std::vector<std::string> arg_names_, aux_names_;
   std::vector<NDArray> args_, grads_, aux_;
   std::vector<mx_uint> grad_req_;
   std::shared_ptr<void> handle_;
@@ -478,6 +504,208 @@ class Accuracy {
 
  private:
   size_t correct_ = 0, total_ = 0;
+};
+
+/*! \brief host-array data iterator (python NDArrayIter / scala
+ *  NDArrayIter parity): batches a flat row-major feature matrix plus a
+ *  label vector, dropping the tail partial batch. */
+class NDArrayIter {
+ public:
+  NDArrayIter(std::vector<float> data, std::vector<float> labels,
+              size_t feat_dim, size_t batch)
+      : data_(std::move(data)), labels_(std::move(labels)),
+        feat_(feat_dim), batch_(batch), cursor_(0) {
+    if (labels_.size() * feat_ != data_.size())
+      throw std::runtime_error("NDArrayIter: data/label size mismatch");
+  }
+
+  void Reset() { cursor_ = 0; }
+  size_t BatchSize() const { return batch_; }
+  size_t FeatDim() const { return feat_; }
+
+  bool Next() {
+    if ((cursor_ + 1) * batch_ > labels_.size()) return false;
+    ++cursor_;
+    return true;
+  }
+
+  std::vector<float> Data() const {
+    size_t lo = (cursor_ - 1) * batch_ * feat_;
+    return std::vector<float>(data_.begin() + lo,
+                              data_.begin() + lo + batch_ * feat_);
+  }
+
+  std::vector<float> Label() const {
+    size_t lo = (cursor_ - 1) * batch_;
+    return std::vector<float>(labels_.begin() + lo,
+                              labels_.begin() + lo + batch_);
+  }
+
+ private:
+  std::vector<float> data_, labels_;
+  size_t feat_, batch_, cursor_;
+};
+
+/*! \brief Module-level API (what scala-package's ModuleSuite exercised):
+ *  bind + init params/optimizer + fit/score/predict + checkpointing, all
+ *  over the Executor.  Data symbol "data", label "softmax_label". */
+class Module {
+ public:
+  Module(const Symbol &net, const Context &ctx)
+      : net_(net), ctx_(ctx) {}
+
+  void Bind(size_t batch, size_t feat_dim) {
+    std::map<std::string, std::vector<mx_uint>> shapes = {
+        {"data", {static_cast<mx_uint>(batch),
+                  static_cast<mx_uint>(feat_dim)}},
+        {"softmax_label", {static_cast<mx_uint>(batch)}}};
+    exec_.reset(new Executor(net_, ctx_, shapes));
+  }
+
+  void InitParams(Uniform init) {
+    RequireBound();
+    for (const auto &name : exec_->ArgNames()) {
+      if (IsInput(name)) continue;
+      init(name, &exec_->Arg(name));
+    }
+  }
+
+  /*! \brief overwrite bound parameters/aux states by name (checkpoint
+   *  restore; python "arg:NAME" / "aux:NAME" convention). */
+  void SetParams(const std::map<std::string, NDArray> &params) {
+    RequireBound();
+    for (const auto &kv : params) {
+      std::string name = kv.first;
+      bool is_aux = name.rfind("aux:", 0) == 0;
+      if (is_aux || name.rfind("arg:", 0) == 0) name = name.substr(4);
+      if (IsInput(name)) continue;
+      NDArray &dst = is_aux ? exec_->Aux(name) : exec_->Arg(name);
+      dst.SyncCopyFromCPU(kv.second.SyncCopyToCPU());
+    }
+  }
+
+  void InitOptimizer(const SGDOptimizer &opt) {
+    opt_.reset(new SGDOptimizer(opt));
+  }
+
+  /*! \brief one fit epoch over the iterator; returns train accuracy of
+   *  the pass when num_classes > 0. */
+  float FitEpoch(NDArrayIter *iter, size_t num_classes = 0) {
+    RequireBound();
+    if (!opt_) throw std::runtime_error("InitOptimizer first");
+    Accuracy acc;
+    const auto &names = exec_->ArgNames();
+    iter->Reset();
+    while (iter->Next()) {
+      std::vector<float> labels = iter->Label();
+      exec_->Arg("data").SyncCopyFromCPU(iter->Data());
+      exec_->Arg("softmax_label").SyncCopyFromCPU(labels);
+      exec_->Forward(true);
+      if (num_classes > 0) {
+        acc.Update(labels, exec_->Outputs()[0].SyncCopyToCPU(),
+                   num_classes);
+      }
+      exec_->Backward();
+      for (size_t i = 0; i < names.size(); ++i) {
+        if (exec_->GradReq()[i] == 0) continue;
+        opt_->Update(i, &exec_->Args()[i], exec_->Grads()[i]);
+      }
+    }
+    return acc.Get();
+  }
+
+  void Fit(NDArrayIter *iter, size_t epochs) {
+    for (size_t e = 0; e < epochs; ++e) FitEpoch(iter);
+  }
+
+  /*! \brief per-batch class probabilities over the iterator. */
+  std::vector<float> Predict(NDArrayIter *iter) {
+    RequireBound();
+    std::vector<float> out;
+    iter->Reset();
+    while (iter->Next()) {
+      exec_->Arg("data").SyncCopyFromCPU(iter->Data());
+      exec_->Forward(false);
+      auto probs = exec_->Outputs()[0].SyncCopyToCPU();
+      out.insert(out.end(), probs.begin(), probs.end());
+    }
+    return out;
+  }
+
+  float Score(NDArrayIter *iter, size_t num_classes) {
+    RequireBound();
+    Accuracy acc;
+    iter->Reset();
+    while (iter->Next()) {
+      exec_->Arg("data").SyncCopyFromCPU(iter->Data());
+      exec_->Forward(false);
+      acc.Update(iter->Label(), exec_->Outputs()[0].SyncCopyToCPU(),
+                 num_classes);
+    }
+    return acc.Get();
+  }
+
+  /*! \brief python-compatible checkpoint: prefix-symbol.json +
+   *  prefix-%04d.params with arg:/aux: key prefixes. */
+  void SaveCheckpoint(const std::string &prefix, int epoch) {
+    RequireBound();
+    {
+      std::string json = net_.ToJSON();
+      std::string fname = prefix + "-symbol.json";
+      FILE *f = std::fopen(fname.c_str(), "w");
+      if (f == nullptr)
+        throw std::runtime_error("cannot write " + fname);
+      size_t written = std::fwrite(json.data(), 1, json.size(), f);
+      int closed = std::fclose(f);
+      // a truncated symbol file must fail HERE, not as a parse error
+      // long after the training run that produced it is gone
+      if (written != json.size() || closed != 0)
+        throw std::runtime_error("short write to " + fname);
+    }
+    std::vector<std::string> names;
+    std::vector<NDArray> arrays;
+    for (const auto &name : exec_->ArgNames()) {
+      if (IsInput(name)) continue;
+      names.push_back("arg:" + name);
+      arrays.push_back(exec_->Arg(name));
+    }
+    for (const auto &name : exec_->AuxNames()) {
+      names.push_back("aux:" + name);
+      arrays.push_back(exec_->Aux(name));
+    }
+    char fname[512];
+    std::snprintf(fname, sizeof(fname), "%s-%04d.params", prefix.c_str(),
+                  epoch);
+    NDArray::Save(fname, names, arrays);
+  }
+
+  /*! \brief load symbol + params saved by SaveCheckpoint (or by the
+   *  python/R bindings — same format). */
+  static Module LoadCheckpoint(const std::string &prefix, int epoch,
+                               const Context &ctx, size_t batch,
+                               size_t feat_dim) {
+    Symbol net = Symbol::FromJSONFile(prefix + "-symbol.json");
+    Module mod(net, ctx);
+    mod.Bind(batch, feat_dim);
+    char fname[512];
+    std::snprintf(fname, sizeof(fname), "%s-%04d.params", prefix.c_str(),
+                  epoch);
+    mod.SetParams(NDArray::Load(fname));
+    return mod;
+  }
+
+ private:
+  static bool IsInput(const std::string &name) {
+    return name == "data" || name == "softmax_label";
+  }
+  void RequireBound() const {
+    if (!exec_) throw std::runtime_error("call Bind first");
+  }
+
+  Symbol net_;
+  Context ctx_;
+  std::shared_ptr<Executor> exec_;
+  std::shared_ptr<SGDOptimizer> opt_;
 };
 
 }  // namespace cpp

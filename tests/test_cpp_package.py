@@ -20,7 +20,37 @@ from native import ROOT, CAPI_LIB, build_and_run
 def test_cpp_package_trains_mlp(tmp_path):
     result = build_and_run(
         os.path.join(ROOT, "tests", "cpp", "cpp_package_test.cc"),
-        str(tmp_path / "cpp_package_test"))
+        str(tmp_path / "cpp_package_test"),
+        argv=[str(tmp_path / "ckpt")])
     sys.stderr.write(result.stderr)
     assert result.returncode == 0, result.stdout + result.stderr
     assert "CPP PACKAGE TRAINING PASSED" in result.stdout
+    assert "CPP PACKAGE MODULE PASSED" in result.stdout
+
+
+@pytest.mark.skipif(not os.path.exists(CAPI_LIB),
+                    reason="libmxtpu_capi.so not built (run make)")
+def test_cpp_checkpoint_loads_in_python(tmp_path):
+    """The C++ Module's checkpoint is the python format: the binary
+    writes /tmp/cpp_module_ckpt-{symbol.json,0012.params}, python
+    load_checkpoint must read it and run a forward."""
+    prefix = str(tmp_path / "cpp_module_ckpt")
+    result = build_and_run(
+        os.path.join(ROOT, "tests", "cpp", "cpp_package_test.cc"),
+        str(tmp_path / "cpp_package_test"), argv=[prefix])
+    assert result.returncode == 0, result.stdout + result.stderr
+
+    import numpy as np
+    import mxnet_tpu as mx
+    net, arg_p, aux_p = mx.model.load_checkpoint(prefix, 12)
+    assert "fc1_weight" in arg_p
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([("data", (4, 10))], [("softmax_label", (4,))],
+             for_training=False)
+    mod.init_params(arg_params=arg_p, aux_params=aux_p, allow_missing=True)
+    from mxnet_tpu.io import DataBatch
+    X = np.random.RandomState(0).randn(4, 10).astype(np.float32)
+    mod.forward(DataBatch(data=[mx.nd.array(X)], label=[]), is_train=False)
+    probs = mod.get_outputs()[0].asnumpy()
+    assert probs.shape == (4, 4)
+    assert np.allclose(probs.sum(axis=1), 1.0, atol=1e-4)
