@@ -1,0 +1,55 @@
+"""One-pass stylization with a trained generator (reference
+end_to_end/boost_inference.py): load the generator checkpoint, forward
+images through it, write the stylized result — no optimization loop.
+
+    python boost_inference.py --model-prefix /tmp/style_gen --epoch 4 \
+        --out /tmp/styled.npy [--image photo.jpg]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                "..", "..", ".."))
+import mxnet_tpu as mx
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", required=True)
+    ap.add_argument("--epoch", type=int, required=True)
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--image", help="input image (needs Pillow); omitted "
+                    "= a synthetic test image")
+    ap.add_argument("--out", default="/tmp/styled.npy")
+    args = ap.parse_args()
+
+    net, arg_p, aux_p = mx.model.load_checkpoint(args.model_prefix,
+                                                 args.epoch)
+    if args.image:
+        from PIL import Image
+        img = Image.open(args.image).resize((args.size, args.size))
+        data = np.asarray(img, np.float32).transpose(2, 0, 1)[None, :3]
+    else:
+        rng = np.random.RandomState(1)
+        from boost_train import synthetic_content
+        data = synthetic_content(rng, 1, args.size)
+
+    mod = mx.mod.Module(net, data_names=["data"], label_names=[],
+                        context=mx.current_context())
+    mod.bind([("data", (1, 3, args.size, args.size))], None,
+             for_training=False)
+    mod.init_params(arg_params=arg_p, aux_params=aux_p, allow_missing=True)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(data)], label=[]),
+                is_train=False)
+    styled = mod.get_outputs()[0].asnumpy()
+    np.save(args.out, styled)
+    print("styled image %s -> %s (range %.1f..%.1f)"
+          % (styled.shape, args.out, styled.min(), styled.max()))
+    print("BOOST-INFERENCE-OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Train a feed-forward style generator against perceptual losses
+(reference end_to_end/boost_train.py).  CI-light: synthetic content
+images + a procedural style image; the same loop takes real images via
+--content-dir/--style-image when Pillow is available.
+
+    python boost_train.py --epochs 4 --model-prefix /tmp/gen
+
+The full batch body — generator forward, descriptor forward, Gram
+matrices, losses, generator backward, SGD update — runs as one
+compiled program (see perceptual.py); the reference needed one
+executor round trip per descriptor layer per batch.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                "..", "..", ".."))
+import mxnet_tpu as mx
+from generator import generator_v3, generator_v4
+from perceptual import build_train_symbol, descriptor_only
+
+
+def synthetic_content(rng, n, size):
+    """Blocky 'photographs': random rectangles over a gradient."""
+    imgs = np.zeros((n, 3, size, size), np.float32)
+    ramp = np.linspace(0, 255, size, dtype=np.float32)
+    for i in range(n):
+        imgs[i] += ramp[None, None, :]
+        for _ in range(4):
+            c = rng.rand(3) * 255
+            w, h = rng.randint(size // 4, size // 2, 2)
+            x, y = rng.randint(0, size - w), rng.randint(0, size - h)
+            imgs[i, :, y:y + h, x:x + w] = c[:, None, None]
+    return imgs
+
+
+def synthetic_style(size):
+    """A 'style': diagonal stripes — strong, simple Gram statistics."""
+    img = np.zeros((1, 3, size, size), np.float32)
+    for y in range(size):
+        for x in range(size):
+            img[0, :, y, x] = 255.0 * ((x + y) // 4 % 2)
+    img[0, 1] *= 0.3
+    return img
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--generator", choices=["v3", "v4"], default="v3")
+    ap.add_argument("--size", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batches-per-epoch", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=1e-5)
+    ap.add_argument("--style-weight", type=float, default=1.0)
+    ap.add_argument("--content-weight", type=float, default=4.0)
+    ap.add_argument("--model-prefix", type=str, default="/tmp/style_gen")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+    mx.random.seed(5)
+
+    gen = (generator_v3 if args.generator == "v3" else generator_v4)()
+    loss = build_train_symbol(gen, style_weight=args.style_weight,
+                              content_weight=args.content_weight)
+
+    # freeze every descriptor weight: only the generator trains
+    fixed = [n for n in loss.list_arguments() if n.startswith("vgg_")]
+    B, S = args.batch, args.size
+    feat_map = S // 4        # descriptor stage-3 resolution
+    data_shapes = [("data", (B, 3, S, S)),
+                   ("content_target", (B, 128, feat_map, feat_map)),
+                   ("style_gram_0", (B, 32, 32)),
+                   ("style_gram_1", (B, 64, 64)),
+                   ("style_gram_2", (B, 128, 128))]
+    mod = mx.mod.Module(loss, data_names=[n for n, _ in data_shapes],
+                        label_names=[], context=mx.current_context(),
+                        fixed_param_names=fixed)
+    mod.bind(data_shapes, None)
+    mod.init_params(mx.init.Xavier(magnitude=1.0))
+    mod.init_optimizer(optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9,
+                                         "clip_gradient": 10.0})
+
+    # descriptor module (SHARED weights) computes the targets
+    desc = mx.mod.Module(descriptor_only(), data_names=["data"],
+                         label_names=[], context=mx.current_context())
+    desc.bind([("data", (B, 3, S, S))], None, for_training=False)
+    arg_p, aux_p = mod.get_params()
+    vgg_params = {k: v for k, v in arg_p.items() if k.startswith("vgg_")}
+    desc.init_params(arg_params=vgg_params, aux_params=aux_p,
+                     allow_missing=True)
+
+    def targets_for(content):
+        desc.forward(mx.io.DataBatch(data=[mx.nd.array(content)],
+                                     label=[]), is_train=False)
+        feats = [o.asnumpy() for o in desc.get_outputs()]
+        grams = []
+        for f in feats:
+            flat = f.reshape(f.shape[0], f.shape[1], -1)
+            grams.append(np.einsum("bcx,bdx->bcd", flat, flat))
+        return feats[-1], grams
+
+    style = np.repeat(synthetic_style(S), B, axis=0)
+    _, style_grams = targets_for(style)
+
+    first_loss = last_loss = None
+    for epoch in range(args.epochs):
+        total = 0.0
+        for _ in range(args.batches_per_epoch):
+            content = synthetic_content(rng, B, S)
+            content_feat, _ = targets_for(content)
+            batch = mx.io.DataBatch(
+                data=[mx.nd.array(content), mx.nd.array(content_feat)] +
+                     [mx.nd.array(g) for g in style_grams],
+                label=[])
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            total += float(mod.get_outputs()[0].asnumpy())
+        avg = total / args.batches_per_epoch
+        if first_loss is None:
+            first_loss = avg
+        last_loss = avg
+        logging.info("epoch %d perceptual loss %.4g", epoch, avg)
+
+    arg_p, aux_p = mod.get_params()
+    gen_args = {k: v for k, v in arg_p.items() if not k.startswith("vgg_")}
+    mx.model.save_checkpoint(args.model_prefix, args.epochs, gen,
+                             gen_args, aux_p)
+    print("loss %ss: first=%.6g last=%.6g" % (args.generator, first_loss,
+                                              last_loss))
+    assert last_loss < first_loss, "perceptual loss did not improve"
+    print("BOOST-TRAIN-OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Feed-forward style-transfer generators (reference
+example/neural-style/end_to_end/gen_v3.py / gen_v4.py; Johnson et al.
+2016): conv-BN-LeakyReLU downsampling, deconv upsampling back to image
+resolution, tanh output scaled to pixel range.  One forward pass
+stylizes an image — no per-image optimization loop."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                "..", "..", ".."))
+import mxnet_tpu as mx
+
+
+def _conv(data, nf, name, kernel=(5, 5), stride=(2, 2), pad=(2, 2)):
+    body = mx.sym.Convolution(data, num_filter=nf, kernel=kernel,
+                              stride=stride, pad=pad, name=name + "_conv")
+    body = mx.sym.BatchNorm(body, fix_gamma=False, name=name + "_bn")
+    return mx.sym.LeakyReLU(body, act_type="leaky", name=name + "_act")
+
+
+def _deconv(data, nf, name, kernel=(6, 6), stride=(2, 2), pad=(2, 2),
+            out=False):
+    body = mx.sym.Deconvolution(data, num_filter=nf, kernel=kernel,
+                                stride=stride, pad=pad, no_bias=True,
+                                name=name + "_deconv")
+    body = mx.sym.BatchNorm(body, fix_gamma=False, name=name + "_bn")
+    if out:
+        # tanh -> pixel range, as the reference's output head
+        return mx.sym.Activation(body, act_type="tanh", name=name + "_tanh")
+    return mx.sym.LeakyReLU(body, act_type="leaky", name=name + "_act")
+
+
+def generator_v3(prefix="g3"):
+    """3-down/3-up encoder-decoder (reference gen_v3)."""
+    data = mx.sym.Variable("data")
+    body = _conv(data, 32, prefix + "_c1")
+    body = _conv(body, 64, prefix + "_c2")
+    body = _conv(body, 128, prefix + "_c3")
+    body = _deconv(body, 64, prefix + "_d1")
+    body = _deconv(body, 32, prefix + "_d2")
+    out = _deconv(body, 3, prefix + "_d3", out=True)
+    # [-1, 1] -> [0, 255]-ish pixel range
+    return out * 127.0 + 128.0
+
+
+def generator_v4(prefix="g4"):
+    """v3 plus a stride-1 refinement stage and a residual-style skip
+    from the input (reference gen_v4's deeper variant)."""
+    data = mx.sym.Variable("data")
+    body = _conv(data, 32, prefix + "_c1")
+    body = _conv(body, 64, prefix + "_c2")
+    body = _conv(body, 128, prefix + "_c3")
+    body = _deconv(body, 64, prefix + "_d1")
+    body = _deconv(body, 32, prefix + "_d2")
+    body = _deconv(body, 16, prefix + "_d3")
+    body = _conv(body, 16, prefix + "_r1", kernel=(3, 3), stride=(1, 1),
+                 pad=(1, 1))
+    raw = mx.sym.Convolution(body, num_filter=3, kernel=(3, 3),
+                             stride=(1, 1), pad=(1, 1),
+                             name=prefix + "_out_conv")
+    out = mx.sym.Activation(raw, act_type="tanh", name=prefix + "_tanh")
+    # residual around the input keeps colors anchored to the content
+    return out * 127.0 + data * 0.5 + 64.0

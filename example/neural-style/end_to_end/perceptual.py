@@ -1,0 +1,79 @@
+"""In-graph perceptual losses for generator training (reference
+end_to_end/basic.py computed Gram matrices through separate executor
+round trips per layer; here content loss + per-layer Gram style losses
+are SYMBOLS composed onto the generator, so the whole training step —
+generator forward, descriptor forward, losses, generator backward —
+compiles into one fused XLA program)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                "..", "..", ".."))
+import mxnet_tpu as mx
+
+
+def descriptor(data, prefix="vgg"):
+    """Small VGG-ish descriptor returning per-stage relu features
+    (reference model_vgg19.py capability; load converted weights for
+    real runs, random weights still rank styles consistently)."""
+    feats = []
+    body = data
+    for stage, (nf, n) in enumerate([(32, 2), (64, 2), (128, 2)]):
+        for i in range(n):
+            body = mx.sym.Convolution(
+                body, kernel=(3, 3), pad=(1, 1), num_filter=nf,
+                name="%s_conv%d_%d" % (prefix, stage + 1, i + 1))
+            body = mx.sym.Activation(
+                body, act_type="relu",
+                name="%s_relu%d_%d" % (prefix, stage + 1, i + 1))
+        feats.append(body)
+        if stage < 2:
+            body = mx.sym.Pooling(body, pool_type="avg", kernel=(2, 2),
+                                  stride=(2, 2),
+                                  name="%s_pool%d" % (prefix, stage + 1))
+    return feats
+
+
+def gram(feat, channels, name):
+    """Symbolic Gram matrix: (B, C, H, W) -> (B, C, C) / (C*H*W)."""
+    flat = mx.sym.Reshape(feat, shape=(0, channels, -1),
+                          name=name + "_flat")
+    flat_t = mx.sym.transpose(flat, axes=(0, 2, 1), name=name + "_flat_t")
+    return mx.sym.batch_dot(flat, flat_t, name=name + "_gram")
+
+
+def build_train_symbol(gen_out, style_weight=1.0, content_weight=1.0):
+    """Compose descriptor + losses over a generator output symbol.
+
+    Extra inputs created here (fed per batch / per style):
+      content_target  — descriptor stage-3 features of the content image
+      style_gram_{i}  — Gram targets of the style image per stage
+    Returns (loss_symbol, descriptor_arg_names_prefix) — every argument
+    named vgg_* must be frozen (fixed_param_names) and shared with the
+    target-computing descriptor module.
+    """
+    channels = [32, 64, 128]
+    feats = descriptor(gen_out)
+    losses = []
+    content_target = mx.sym.Variable("content_target")
+    diff = feats[-1] - content_target
+    closs = mx.sym.sum(mx.sym.square(diff), name="content_sse")
+    losses.append(closs * content_weight)
+    for i, (f, c) in enumerate(zip(feats, channels)):
+        g = gram(f, c, "style%d" % i)
+        target = mx.sym.Variable("style_gram_%d" % i)
+        sloss = mx.sym.sum(mx.sym.square(g - target),
+                           name="style%d_sse" % i)
+        # normalize per layer like the reference's style weights
+        losses.append(sloss * (style_weight / (c * c)))
+    total = losses[0]
+    for piece in losses[1:]:
+        total = total + piece
+    return mx.sym.MakeLoss(total, name="perceptual_loss")
+
+
+def descriptor_only(prefix="vgg"):
+    """Stand-alone descriptor symbol for computing targets."""
+    data = mx.sym.Variable("data")
+    feats = descriptor(data, prefix)
+    return mx.sym.Group(feats)

@@ -1,0 +1,40 @@
+"""Config handling (reference example/speech-demo/config_util.py):
+layered settings — a .cfg file (configparser sections) overridden by
+command-line --section.key=value pairs — so recipes like run_ami.sh can
+swap datasets/models without editing code.
+"""
+import argparse
+import configparser
+
+
+def parse_args(default_cfg, argv=None):
+    """Returns (cfg, args): cfg is the ConfigParser after applying
+    --section.key=value overrides; unknown dotted flags become
+    overrides, everything else errors like the reference."""
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configfile", default=default_cfg)
+    args, rest = ap.parse_known_args(argv)
+    cfg = configparser.ConfigParser()
+    read = cfg.read(args.configfile)
+    if not read:
+        raise FileNotFoundError(args.configfile)
+    for item in rest:
+        if not item.startswith("--") or "=" not in item:
+            raise ValueError("unrecognized argument %r "
+                             "(expected --section.key=value)" % item)
+        key, value = item[2:].split("=", 1)
+        if "." not in key:
+            raise ValueError("override %r must be section.key" % key)
+        section, option = key.split(".", 1)
+        if not cfg.has_section(section):
+            cfg.add_section(section)
+        cfg.set(section, option, value)
+    return cfg, args
+
+
+def get(cfg, section, option, fallback=None, type_fn=str):
+    if cfg.has_option(section, option):
+        return type_fn(cfg.get(section, option))
+    if fallback is None:
+        raise KeyError("missing config [%s] %s" % (section, option))
+    return fallback

@@ -1,11 +1,20 @@
 """Posterior dump for decoding (reference example/speech-demo/
-decode_mxnet.py capability): load a trained acoustic checkpoint, run every
+decode_mxnet.py): load a trained acoustic checkpoint, run every
 utterance of a feature archive through the net, and write per-frame
-log-posteriors to an output archive — the hand-off point to an external
-WFST decoder (the reference piped these into Kaldi's latgen).
+log-posteriors (minus log-priors when counts are given) to an output
+archive — the hand-off point to an external WFST decoder (the reference
+piped these into Kaldi's latgen-faster-mapped).
 
-    python decode_mxnet.py --model-prefix lstm_proj --epoch 6 \
-        --archive synthetic_train.npz --output posteriors.npz
+Two archive modes share the loop:
+
+  npz   (portable):    --archive feats.npz --output post.npz
+  Kaldi (binary ark):  --feats-ark feats.ark --out-ark post.ark
+                       [--counts-ark counts.ark]
+                       [--stats-ark stats.ark | --stats-npz stats.npz]
+
+Network geometry (hidden/projection sizes) is derived from the
+checkpoint weights — no flags to keep in sync.  Utterances pad to a
+small set of bucket lengths so only a few programs compile.
 """
 import argparse
 import logging
@@ -18,70 +27,123 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import mxnet_tpu as mx
 import io_util
 
+BUCKET_STEP = 16
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--model-prefix", type=str, default="lstm_proj")
-    parser.add_argument("--epoch", type=int, default=6)
-    parser.add_argument("--archive", type=str, required=True)
-    parser.add_argument("--output", type=str, default="posteriors.npz")
-    parser.add_argument("--batch-size", type=int, default=32)
-    parser.add_argument("--seq-len", type=int, default=12)
-    parser.add_argument("--num-hidden", type=int, default=128)
-    parser.add_argument("--num-proj", type=int, default=64)
-    args = parser.parse_args()
-    logging.basicConfig(level=logging.INFO)
 
-    net, arg_params, aux_params = mx.model.load_checkpoint(
-        args.model_prefix, args.epoch)
-    feats, _ = io_util.read_archive(args.archive)
-    stats = args.archive + ".stats.npz"
-    if os.path.exists(stats):
-        st = np.load(stats)
-        feats = io_util.apply_cmvn(feats, st["mean"], st["std"])
+def bucket_len(t):
+    return max(BUCKET_STEP, ((t + BUCKET_STEP - 1) // BUCKET_STEP)
+               * BUCKET_STEP)
 
-    mod = mx.mod.Module(net, context=[mx.cpu()],
-                        data_names=("data", "init_c", "init_h"))
-    bs, T = args.batch_size, args.seq_len
-    # the loss head keeps its label input; feed a dummy label at decode
-    # time (forward(is_train=False) emits pure posteriors regardless)
-    mod.bind(data_shapes=[("data", (bs, T, next(iter(feats.values()))
-                                    .shape[1])),
-                          ("init_c", (bs, args.num_hidden)),
-                          ("init_h", (bs, args.num_proj))],
-             label_shapes=[("softmax_label", (bs, T))], for_training=False)
-    mod.set_params(arg_params, aux_params)
-    dummy_label = mx.nd.zeros((bs, T))
+
+def decode_utterances(feats, arg_p, aux_p, num_senone, log_prior=None):
+    """{utt: (T, D) normalized feats} -> {utt: (T, senone) log-post}.
+    Whole utterances run through bucket-length programs, zero initial
+    state, batch 1 (reference decode geometry)."""
+    from train_lstm_proj import lstm_proj_net
+
+    feat_dim = arg_p["l0_i2h_weight"].shape[1]
+    # geometry from the checkpoint itself: proj FC weight is (proj, H)
+    num_proj, num_hidden = arg_p["l0_proj_weight"].shape
+
+    mods = {}
+    zeros_c = mx.nd.zeros((1, num_hidden))
+    zeros_h = mx.nd.zeros((1, num_proj))
+
+    def module_for(T):
+        if T not in mods:
+            net = lstm_proj_net(T, feat_dim, num_hidden, num_proj,
+                                num_senone)
+            mod = mx.mod.Module(net, context=mx.cpu(),
+                                data_names=("data", "init_c", "init_h"),
+                                label_names=("softmax_label",))
+            mod.bind([("data", (1, T, feat_dim)),
+                      ("init_c", (1, num_hidden)),
+                      ("init_h", (1, num_proj))],
+                     [("softmax_label", (1, T))], for_training=False)
+            mod.init_params(arg_params=arg_p, aux_params=aux_p,
+                            allow_missing=True)
+            mods[T] = (mod, mx.nd.zeros((1, T)))
+        return mods[T]
 
     out = {}
-    zeros_c = mx.nd.zeros((bs, args.num_hidden))
-    zeros_h = mx.nd.zeros((bs, args.num_proj))
     for utt, f in feats.items():
-        # window the utterance like training; batch the windows
-        windows = []
-        for lo in range(0, f.shape[0], T):
-            w = f[lo:lo + T]
-            if w.shape[0] < T:
-                w = np.pad(w, ((0, T - w.shape[0]), (0, 0)))
-            windows.append(w)
-        probs = []
-        for lo in range(0, len(windows), bs):
-            chunk = windows[lo:lo + bs]
-            pad_rows = bs - len(chunk)
-            batch_x = np.stack(chunk + [np.zeros_like(chunk[0])] * pad_rows)
-            batch = mx.io.DataBatch(
-                data=[mx.nd.array(batch_x), zeros_c, zeros_h],
-                label=[dummy_label])
-            mod.forward(batch, is_train=False)
-            p = mod.get_outputs()[0].asnumpy()       # (T*bs, senone)
-            p = p.reshape(T, bs, -1).transpose(1, 0, 2)
-            probs.append(p[:len(chunk)].reshape(len(chunk) * T, -1))
-        post = np.concatenate(probs, axis=0)[:f.shape[0]]
-        out[utt] = np.log(post + 1e-12).astype(np.float32)
-    np.savez_compressed(args.output, **out)
-    logging.info("wrote log-posteriors for %d utterances to %s",
-                 len(out), args.output)
-    print("DECODED %d" % len(out))
+        T0 = f.shape[0]
+        T = bucket_len(T0)
+        padded = np.zeros((1, T, feat_dim), np.float32)
+        padded[0, :T0] = f
+        mod, dummy_label = module_for(T)
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(padded), zeros_c, zeros_h],
+            label=[dummy_label])
+        mod.forward(batch, is_train=False)
+        post = mod.get_outputs()[0].asnumpy().reshape(T, num_senone)[:T0]
+        loglike = np.log(np.maximum(post, 1e-10))
+        if log_prior is not None:
+            loglike = loglike - log_prior
+        out[utt] = loglike.astype(np.float32)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", type=str, default="lstm_proj")
+    ap.add_argument("--epoch", type=int, default=6)
+    # portable npz mode (auto-applies <archive>.stats.npz when present)
+    ap.add_argument("--archive", type=str)
+    ap.add_argument("--output", type=str, default="posteriors.npz")
+    # Kaldi ark mode
+    ap.add_argument("--feats-ark", type=str)
+    ap.add_argument("--out-ark", type=str)
+    ap.add_argument("--counts-ark", help="senone count vector ('counts') "
+                    "for the log-prior subtraction")
+    ap.add_argument("--stats-ark", help="make_stats.py output "
+                    "(mean + inv_std vectors)")
+    ap.add_argument("--stats-npz", help="training-side stats "
+                    "(mean + raw std)")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    if bool(args.archive) == bool(args.feats_ark):
+        ap.error("exactly one of --archive / --feats-ark is required")
+
+    _, arg_p, aux_p = mx.model.load_checkpoint(args.model_prefix,
+                                               args.epoch)
+    num_senone = arg_p["cls_weight"].shape[0]
+
+    if args.archive:
+        feats, _ = io_util.read_archive(args.archive)
+        stats = args.archive + ".stats.npz"
+        if os.path.exists(stats):
+            st = np.load(stats)
+            feats = io_util.apply_cmvn(feats, st["mean"], st["std"])
+        out = decode_utterances(feats, arg_p, aux_p, num_senone)
+        np.savez_compressed(args.output, **out)
+        logging.info("wrote log-posteriors for %d utterances to %s",
+                     len(out), args.output)
+        print("DECODED %d" % len(out))
+        return
+
+    from io_func import read_ark, write_ark_scp
+    feats = {utt: mat for utt, mat in read_ark(args.feats_ark)}
+    if args.stats_ark:
+        # make_stats.py format: mean and INVERSE stddev -> multiply
+        stats = dict(read_ark(args.stats_ark))
+        mean, inv_std = stats["mean"], stats["inv_std"]
+        feats = {u: ((f - mean) * inv_std).astype(np.float32)
+                 for u, f in feats.items()}
+    elif args.stats_npz:
+        # training-side format: mean and RAW stddev -> divide
+        st = np.load(args.stats_npz)
+        feats = io_util.apply_cmvn(feats, st["mean"], st["std"])
+
+    log_prior = None
+    if args.counts_ark:
+        counts = dict(read_ark(args.counts_ark))["counts"]
+        prior = counts / counts.sum()
+        log_prior = np.log(np.maximum(prior, 1e-10))
+
+    out = decode_utterances(feats, arg_p, aux_p, num_senone, log_prior)
+    write_ark_scp(args.out_ark, out, args.out_ark + ".scp")
+    print("DECODED %d -> %s" % (len(out), args.out_ark))
 
 
 if __name__ == "__main__":

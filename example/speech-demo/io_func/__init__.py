@@ -1,0 +1,7 @@
+"""Kaldi-format feature IO (reference example/speech-demo/io_func/):
+binary ark/scp matrix archives, the interchange format every Kaldi
+recipe speaks.  kaldi_io implements the byte-level format; the higher
+level iterators in ../io_util.py consume either these archives or the
+portable .npz ones."""
+from .kaldi_io import (read_ark, read_mat, read_scp, read_vec,  # noqa: F401
+                       write_ark_scp, write_mat, write_vec)

@@ -1,0 +1,157 @@
+"""Kaldi binary ark/scp read/write (reference io_func/feat_io.py +
+kaldi_io.py capability, byte-for-byte the Kaldi on-disk format).
+
+Archive ("ark") layout per entry:
+
+    <utt-id> ' ' '\\0' 'B'  <object>
+
+where a float32 matrix object is
+
+    'F' 'M' ' '  '\\x04' <int32 rows>  '\\x04' <int32 cols>  <row-major f32>
+
+and a float32 vector object is  'F' 'V' ' ' '\\x04' <int32 dim> <f32...>.
+A "scp" index line is  `<utt-id> <path>:<offset>` with the offset
+pointing at the '\\0B' binary marker — exactly what Kaldi's
+copy-feats/copy-matrix emit, so archives written here are readable by
+Kaldi tools and vice versa.
+"""
+import struct
+
+import numpy as np
+
+
+def _write_token(f, tok):
+    f.write(tok.encode("ascii") + b" ")
+
+
+def _write_int32(f, v):
+    f.write(b"\x04" + struct.pack("<i", v))
+
+
+def _read_exact(f, n):
+    data = f.read(n)
+    if len(data) != n:
+        raise EOFError("truncated kaldi stream")
+    return data
+
+
+def _read_int32(f):
+    marker = _read_exact(f, 1)
+    if marker != b"\x04":
+        raise ValueError("expected int32 size marker, got %r" % marker)
+    return struct.unpack("<i", _read_exact(f, 4))[0]
+
+
+def write_mat(f, mat):
+    """One binary float32 matrix at the current position; returns the
+    offset of the '\\0B' marker (what an scp line points at)."""
+    mat = np.ascontiguousarray(mat, dtype=np.float32)
+    offset = f.tell()
+    f.write(b"\x00B")
+    _write_token(f, "FM")
+    _write_int32(f, mat.shape[0])
+    _write_int32(f, mat.shape[1])
+    f.write(mat.tobytes())
+    return offset
+
+
+def write_vec(f, vec):
+    vec = np.ascontiguousarray(vec, dtype=np.float32)
+    offset = f.tell()
+    f.write(b"\x00B")
+    _write_token(f, "FV")
+    _write_int32(f, vec.shape[0])
+    f.write(vec.tobytes())
+    return offset
+
+
+def _read_object(f):
+    marker = _read_exact(f, 2)
+    if marker != b"\x00B":
+        raise ValueError("not in kaldi binary mode (marker %r)" % marker)
+    tok = b""
+    while not tok.endswith(b" "):
+        tok += _read_exact(f, 1)
+    kind = tok.strip().decode("ascii")
+    if kind == "FM":
+        rows = _read_int32(f)
+        cols = _read_int32(f)
+        data = _read_exact(f, 4 * rows * cols)
+        return np.frombuffer(data, np.float32).reshape(rows, cols).copy()
+    if kind == "FV":
+        dim = _read_int32(f)
+        data = _read_exact(f, 4 * dim)
+        return np.frombuffer(data, np.float32).copy()
+    raise ValueError("unsupported kaldi object type %r" % kind)
+
+
+def read_mat(f):
+    obj = _read_object(f)
+    if obj.ndim != 2:
+        raise ValueError("expected a matrix, found a vector")
+    return obj
+
+
+def read_vec(f):
+    obj = _read_object(f)
+    if obj.ndim != 1:
+        raise ValueError("expected a vector, found a matrix")
+    return obj
+
+
+def _read_key(f):
+    """utt-id up to the separating space; None at EOF."""
+    key = b""
+    while True:
+        c = f.read(1)
+        if not c:
+            return None if not key else key.decode("utf-8")
+        if c == b" ":
+            return key.decode("utf-8")
+        key += c
+
+
+def write_ark_scp(ark_path, entries, scp_path=None):
+    """Write {utt: matrix-or-vector} into one ark (+ optional scp
+    index).  Insertion order is preserved (Kaldi archives are ordered)."""
+    scp_lines = []
+    with open(ark_path, "wb") as ark:
+        for utt, value in entries.items():
+            ark.write(utt.encode("utf-8") + b" ")
+            value = np.asarray(value)
+            off = (write_vec(ark, value) if value.ndim == 1
+                   else write_mat(ark, value))
+            scp_lines.append("%s %s:%d" % (utt, ark_path, off))
+    if scp_path is not None:
+        with open(scp_path, "w") as scp:
+            scp.write("\n".join(scp_lines) + "\n")
+
+
+def read_ark(ark_path):
+    """Yield (utt, array) in archive order."""
+    with open(ark_path, "rb") as f:
+        while True:
+            key = _read_key(f)
+            if key is None:
+                return
+            yield key, _read_object(f)
+
+
+def read_scp(scp_path):
+    """Random-access reader over an scp index: returns {utt: loader}
+    where loader() seeks and reads just that utterance."""
+    table = {}
+    with open(scp_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            utt, where = line.split(None, 1)
+            path, off = where.rsplit(":", 1)
+
+            def loader(path=path, off=int(off)):
+                with open(path, "rb") as g:
+                    g.seek(off)
+                    return _read_object(g)
+            table[utt] = loader
+    return table

@@ -111,3 +111,30 @@ class TruncatedSentenceIter(mx.io.DataIter):
 
     def __iter__(self):
         return iter(self._inner)
+
+
+def read_kaldi(feats_ark, labels_ark=None):
+    """Kaldi-format entry point (io_func/): feature matrices from a
+    binary ark, optional per-frame labels from a second ark holding
+    1-d vectors (alignment dumps)."""
+    from io_func import read_ark
+    feats = {utt: mat for utt, mat in read_ark(feats_ark)}
+    labels = {}
+    if labels_ark:
+        for utt, vec in read_ark(labels_ark):
+            labels[utt] = np.asarray(vec).astype(np.int64)
+    return feats, labels
+
+
+def write_kaldi(feats_ark, feats, labels_ark=None, labels=None,
+                scp=True):
+    """Inverse of read_kaldi: features as float32 matrices, labels as
+    float vectors (Kaldi has no integer vectors in this layer)."""
+    from io_func import write_ark_scp
+    write_ark_scp(feats_ark, feats,
+                  feats_ark + ".scp" if scp else None)
+    if labels_ark and labels:
+        write_ark_scp(labels_ark,
+                      {u: np.asarray(v, np.float32) for u, v in
+                       labels.items()},
+                      labels_ark + ".scp" if scp else None)

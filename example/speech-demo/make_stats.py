@@ -1,0 +1,43 @@
+"""Global CMVN statistics over a feature archive (reference
+example/speech-demo/make_stats.py): accumulate frame count, per-dim sum
+and squared sum, write mean/inv-stddev vectors to a stats ark that
+decode_mxnet.py consumes via --stats-ark (normalization is
+(frame - mean) * inv_std).
+
+    python make_stats.py feats.ark stats.ark
+"""
+import sys
+
+import numpy as np
+
+from io_func import read_ark, write_ark_scp
+
+
+def accumulate(ark_path):
+    n, s, sq = 0, None, None
+    for _, mat in read_ark(ark_path):
+        if mat.ndim != 2:
+            continue
+        if s is None:
+            s = np.zeros(mat.shape[1], np.float64)
+            sq = np.zeros(mat.shape[1], np.float64)
+        n += mat.shape[0]
+        s += mat.sum(axis=0)
+        sq += np.square(mat).sum(axis=0)
+    if n == 0:
+        raise ValueError("no frames in %s" % ark_path)
+    mean = s / n
+    var = np.maximum(sq / n - np.square(mean), 1e-8)
+    return mean.astype(np.float32), (1.0 / np.sqrt(var)).astype(np.float32)
+
+
+def main():
+    feats_ark, stats_ark = sys.argv[1], sys.argv[2]
+    mean, istd = accumulate(feats_ark)
+    write_ark_scp(stats_ark, {"mean": mean, "inv_std": istd})
+    print("make_stats: %d dims, mean[0]=%.4f inv_std[0]=%.4f"
+          % (mean.shape[0], mean[0], istd[0]))
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,11 @@ def main():
     parser.add_argument("--train-archive", type=str,
                         help=".npz utterance archive (io_util.py); omitted "
                         "= generate a synthetic one (CI mode)")
+    parser.add_argument("--train-ark", type=str,
+                        help="Kaldi binary feature ark (io_func/) — used "
+                        "with --label-ark instead of --train-archive")
+    parser.add_argument("--label-ark", type=str,
+                        help="Kaldi ark of per-frame alignment vectors")
     parser.add_argument("--model-prefix", type=str, default="lstm_proj")
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--seq-len", type=int, default=12)
@@ -93,17 +98,26 @@ def main():
     import io_util
     from speechSGD import speechSGD
 
-    archive = args.train_archive
-    if not archive:
-        archive = os.path.join(os.path.dirname(__file__) or ".",
-                               "synthetic_train.npz")
-    if not os.path.exists(archive):
-        io_util.make_synthetic_archive(archive, feat_dim=args.feat_dim,
-                                       num_senone=args.num_senone)
-    feats, labels = io_util.read_archive(archive)
+    if args.train_ark:
+        # Kaldi pipeline mode: binary ark features + alignment ark
+        if not args.label_ark:
+            raise SystemExit("--train-ark requires --label-ark "
+                             "(per-frame alignment vectors)")
+        feats, labels = io_util.read_kaldi(args.train_ark, args.label_ark)
+        stats_base = args.train_ark
+    else:
+        archive = args.train_archive
+        if not archive:
+            archive = os.path.join(os.path.dirname(__file__) or ".",
+                                   "synthetic_train.npz")
+        if not os.path.exists(archive):
+            io_util.make_synthetic_archive(archive, feat_dim=args.feat_dim,
+                                           num_senone=args.num_senone)
+        feats, labels = io_util.read_archive(archive)
+        stats_base = archive
     mean, std = io_util.compute_stats(feats)        # make_stats.py step
     feats = io_util.apply_cmvn(feats, mean, std)
-    np.savez(archive + ".stats.npz", mean=mean, std=std)
+    np.savez(stats_base + ".stats.npz", mean=mean, std=std)
 
     bs = args.batch_size
     train = io_util.TruncatedSentenceIter(feats, labels, bs, args.seq_len,

@@ -105,3 +105,39 @@ def test_rcnn_train_and_demo():
                 "--epoch", "10"], timeout=560)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "DEMO-OK" in res.stdout, res.stdout + res.stderr
+
+
+@pytest.mark.slow
+def test_neural_style_end_to_end_generator(tmp_path):
+    """Feed-forward style transfer (end_to_end/): perceptual-loss
+    generator training must reduce the loss, and the saved generator
+    must stylize a fresh image in one forward pass."""
+    prefix = str(tmp_path / "gen")
+    res = _run("example/neural-style/end_to_end",
+               ["boost_train.py", "--epochs", "3",
+                "--batches-per-epoch", "6", "--model-prefix", prefix],
+               timeout=420)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "BOOST-TRAIN-OK" in res.stdout
+    res = _run("example/neural-style/end_to_end",
+               ["boost_inference.py", "--model-prefix", prefix,
+                "--epoch", "3", "--out", str(tmp_path / "styled.npy")],
+               timeout=420)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "BOOST-INFERENCE-OK" in res.stdout
+    import numpy as np
+    styled = np.load(str(tmp_path / "styled.npy"))
+    assert styled.shape == (1, 3, 64, 64)
+    assert 0 <= styled.min() and styled.max() <= 300  # pixel-ish range
+
+
+@pytest.mark.slow
+def test_neural_style_generator_v4(tmp_path):
+    """The deeper residual generator variant trains too."""
+    prefix = str(tmp_path / "gen4")
+    res = _run("example/neural-style/end_to_end",
+               ["boost_train.py", "--generator", "v4", "--epochs", "2",
+                "--batches-per-epoch", "4", "--model-prefix", prefix],
+               timeout=420)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "BOOST-TRAIN-OK" in res.stdout
