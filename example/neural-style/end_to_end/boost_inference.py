@@ -30,8 +30,9 @@ def main():
                                                  args.epoch)
     if args.image:
         from PIL import Image
-        img = Image.open(args.image).resize((args.size, args.size))
-        data = np.asarray(img, np.float32).transpose(2, 0, 1)[None, :3]
+        img = Image.open(args.image).convert("RGB").resize(
+            (args.size, args.size))
+        data = np.asarray(img, np.float32).transpose(2, 0, 1)[None]
     else:
         rng = np.random.RandomState(1)
         from boost_train import synthetic_content

@@ -35,7 +35,9 @@ def descriptor(data, prefix="vgg"):
 
 
 def gram(feat, channels, name):
-    """Symbolic Gram matrix: (B, C, H, W) -> (B, C, C) / (C*H*W)."""
+    """Symbolic Gram matrix: (B, C, H, W) -> (B, C, C), UNNORMALIZED —
+    build_train_symbol scales each layer's loss by style_weight/C^2
+    instead (targets in boost_train.py use the same raw einsum)."""
     flat = mx.sym.Reshape(feat, shape=(0, channels, -1),
                           name=name + "_flat")
     flat_t = mx.sym.transpose(flat, axes=(0, 2, 1), name=name + "_flat_t")
@@ -48,9 +50,9 @@ def build_train_symbol(gen_out, style_weight=1.0, content_weight=1.0):
     Extra inputs created here (fed per batch / per style):
       content_target  — descriptor stage-3 features of the content image
       style_gram_{i}  — Gram targets of the style image per stage
-    Returns (loss_symbol, descriptor_arg_names_prefix) — every argument
-    named vgg_* must be frozen (fixed_param_names) and shared with the
-    target-computing descriptor module.
+    Returns the MakeLoss symbol.  Every argument named vgg_* must be
+    frozen (fixed_param_names) and shared with the target-computing
+    descriptor module.
     """
     channels = [32, 64, 128]
     feats = descriptor(gen_out)

@@ -60,8 +60,9 @@ def decode_utterances(feats, arg_p, aux_p, num_senone, log_prior=None):
                       ("init_c", (1, num_hidden)),
                       ("init_h", (1, num_proj))],
                      [("softmax_label", (1, T))], for_training=False)
-            mod.init_params(arg_params=arg_p, aux_params=aux_p,
-                            allow_missing=True)
+            # strict: a checkpoint missing any weight must error, not
+            # silently random-fill and decode garbage
+            mod.set_params(arg_p, aux_p)
             mods[T] = (mod, mx.nd.zeros((1, T)))
         return mods[T]
 
@@ -104,6 +105,8 @@ def main():
     logging.basicConfig(level=logging.INFO)
     if bool(args.archive) == bool(args.feats_ark):
         ap.error("exactly one of --archive / --feats-ark is required")
+    if args.feats_ark and not args.out_ark:
+        ap.error("--feats-ark requires --out-ark")
 
     _, arg_p, aux_p = mx.model.load_checkpoint(args.model_prefix,
                                                args.epoch)
