@@ -1,0 +1,107 @@
+classdef model < handle
+%MODEL Load a trained checkpoint and run prediction from MATLAB.
+%
+% The MATLAB surface the reference shipped (matlab/+mxnet/model.m),
+% rebuilt over this framework's predict ABI (include/c_predict_api.h,
+% libmxtpu_predict.so).  Prediction only, like the reference: load the
+% two checkpoint artifacts, set input, forward, read outputs.
+%
+%   model = mxnet.model;
+%   model.load('mlp', 10);               % mlp-symbol.json + mlp-0010.params
+%   probs = model.forward(X);            % X: (features, batch) single
+%
+% MATLAB-only (Octave lacks loadlibrary/calllib).  This image ships
+% no MATLAB, so the package is
+% untested here (same status the reference's matlab binding had -- no CI
+% ever ran it).  The ABI underneath is exercised by tests/test_c_api.py
+% and the amalgamation tests; callmxnet.m documents the library setup.
+
+properties
+  symbol    % symbol JSON text
+  params    % raw bytes of the .params blob
+  verbose   % print timing info
+end
+
+properties (Access = private)
+  predictor        % libpointer to the PredictorHandle
+  prev_input_size  % re-create the predictor only when shapes change
+end
+
+methods
+  function obj = model()
+    obj.predictor = libpointer('voidPtr', 0);
+    obj.prev_input_size = [];
+    obj.verbose = false;
+  end
+
+  function delete(obj)
+    obj.free_predictor();
+  end
+
+  function load(obj, prefix, epoch)
+  %LOAD read prefix-symbol.json and prefix-%04d.params
+    fid = fopen([prefix, '-symbol.json'], 'r');
+    assert(fid >= 0, ['cannot open ', prefix, '-symbol.json']);
+    obj.symbol = fread(fid, inf, 'char=>char')';
+    fclose(fid);
+    fid = fopen(sprintf('%s-%04d.params', prefix, epoch), 'rb');
+    assert(fid >= 0, 'cannot open the params blob');
+    obj.params = fread(fid, inf, 'uint8=>uint8');
+    fclose(fid);
+    obj.free_predictor();
+  end
+
+  function out = forward(obj, data)
+  %FORWARD run one batch through the net; data is (features..., batch)
+  % in MATLAB column-major order — exactly the row-major (batch,
+  % features...) layout the framework expects, memory verbatim.
+    siz = size(data);
+    if ~isequal(siz, obj.prev_input_size)
+      obj.free_predictor();
+      obj.prev_input_size = siz;
+    end
+    if obj.predictor.Value == 0
+      if obj.verbose
+        fprintf('create predictor with input size [%s]\n', ...
+                num2str(siz));
+      end
+      % MATLAB dims reversed = framework shape
+      shape = uint32(fliplr(siz));
+      indptr = uint32([0, numel(shape)]);
+      callmxnet('MXPredCreate', obj.symbol, ...
+                libpointer('voidPtr', obj.params), ...
+                int32(numel(obj.params)), int32(1), int32(0), ...
+                uint32(1), {'data'}, indptr, shape, obj.predictor);
+    end
+    callmxnet('MXPredSetInput', obj.predictor, 'data', ...
+              single(data(:)), uint32(numel(data)));
+    callmxnet('MXPredForward', obj.predictor);
+
+    % read output 0
+    shape_ptr = libpointer('uint32PtrPtr');
+    ndim = libpointer('uint32Ptr', 0);
+    callmxnet('MXPredGetOutputShape', obj.predictor, uint32(0), ...
+              shape_ptr, ndim);
+    setdatatype(shape_ptr.Value, 'uint32Ptr', double(ndim.Value));
+    oshape = double(shape_ptr.Value.Value');
+    n = prod(oshape);
+    buf = libpointer('singlePtr', single(zeros(1, n)));
+    callmxnet('MXPredGetOutput', obj.predictor, uint32(0), buf, ...
+              uint32(n));
+    setdatatype(buf, 'singlePtr', n);
+    % framework row-major -> MATLAB column-major under reversed dims
+    % (pad 1-d outputs: reshape needs at least two size elements)
+    out = reshape(buf.Value, [fliplr(oshape), 1]);
+  end
+end
+
+methods (Access = private)
+  function free_predictor(obj)
+    if obj.predictor.Value ~= 0
+      callmxnet('MXPredFree', obj.predictor);
+      obj.predictor = libpointer('voidPtr', 0);
+    end
+  end
+end
+
+end

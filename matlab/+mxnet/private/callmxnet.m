@@ -1,0 +1,26 @@
+function callmxnet(func, varargin)
+%CALLMXNET call a predict-ABI entry point, checking the return code.
+%
+% MATLAB-only (Octave does not implement loadlibrary/calllib).
+% Loads libmxtpu_predict.so on first use.  Set the environment variable
+% MXNET_TPU_HOME to the repository root (the library lives in
+% mxnet_tpu/), and start MATLAB with PYTHONPATH containing that
+% root — the library embeds the CPython interpreter hosting the JAX
+% runtime, like every other binding of this framework.
+
+if ~libisloaded('libmxtpu_predict')
+  root = getenv('MXNET_TPU_HOME');
+  assert(~isempty(root), 'set MXNET_TPU_HOME to the repository root');
+  lib = fullfile(root, 'mxnet_tpu', 'libmxtpu_predict.so');
+  % attribute-free mirror of include/c_predict_api.h: loadlibrary's
+  % parser cannot digest the GCC visibility attribute in the real header
+  hdr = fullfile(root, 'matlab', '+mxnet', 'private', ...
+                 'mxtpu_predict_matlab.h');
+  assert(exist(lib, 'file') == 2, 'build the native core first: make');
+  loadlibrary(lib, hdr, 'alias', 'libmxtpu_predict');
+end
+
+assert(ischar(func), 'func must be a string');
+ret = calllib('libmxtpu_predict', func, varargin{:});
+assert(ret == 0, ['call to ', func, ' failed']);
+end

@@ -1,0 +1,52 @@
+/*
+ * MATLAB-safe declaration set for the predict ABI.
+ *
+ * loadlibrary's header parser cannot digest GCC attribute extensions
+ * (include/c_predict_api.h marks every entry point with
+ * __attribute__((visibility("default")))), so callmxnet.m hands it this
+ * attribute-free mirror instead — the reference solved the same problem
+ * by expanding its DLL macro to nothing off-Windows.  Keep in sync with
+ * include/c_predict_api.h (the symbols and signatures are the ABI).
+ */
+#ifndef MXTPU_PREDICT_MATLAB_H_
+#define MXTPU_PREDICT_MATLAB_H_
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+const char *MXGetLastError();
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys, PredictorHandle *out);
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+int MXPredForward(PredictorHandle handle);
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left);
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+int MXPredFree(PredictorHandle handle);
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length);
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim);
+int MXNDListFree(NDListHandle handle);
+
+#endif /* MXTPU_PREDICT_MATLAB_H_ */

@@ -1,0 +1,13 @@
+%% Smoke demo: load a checkpoint written by any binding and predict.
+% Train something first, e.g. from python:
+%   python -c "see docs/tutorials/train_first_model.md"  (saves 'first_model')
+setenv('MXNET_TPU_HOME', fullfile(pwd, '..'));
+addpath(pwd);
+
+model = mxnet.model;
+model.verbose = true;
+model.load('first_model', 8);
+X = single(randn(16, 32));        % (features, batch)
+probs = model.forward(X);
+assert(all(abs(sum(probs, 1) - 1) < 1e-4));  % softmax rows
+fprintf('MATLAB binding forward OK: output %s\n', mat2str(size(probs)));
