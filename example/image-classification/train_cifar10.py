@@ -12,7 +12,6 @@ import logging
 import os
 import sys
 
-import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import mxnet_tpu as mx
@@ -44,20 +43,7 @@ def parse_args():
 
 
 def get_iterator(args, kv):
-    data_shape = (3, 28, 28)
-    rank = kv.rank if kv else 0
-    nworker = kv.num_workers if kv else 1
-
-    if args.synthetic:
-        rng = np.random.RandomState(42 + rank)
-        n = min(args.num_examples, 2 * args.batch_size * 4)
-        X = rng.rand(n, *data_shape).astype(np.float32)
-        y = rng.randint(0, 10, n).astype(np.float32)
-        train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
-                                  shuffle=True)
-        val = mx.io.NDArrayIter(X[:args.batch_size], y[:args.batch_size],
-                                batch_size=args.batch_size)
-        return train, val
+    return train_model.cifar_iterators(args, kv)
 
     train = mx.io.ImageRecordIter(
         path_imgrec=os.path.join(args.data_dir, "train.rec"),

@@ -6,10 +6,45 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import numpy as np
+
 import mxnet_tpu as mx
 
 
-def fit(args, network, data_loader):
+def cifar_iterators(args, kv, data_shape=(3, 32, 32), **rec_kwargs):
+    """Shared CIFAR data pipeline (train_cifar10*.py): synthetic CI-light
+    tensors, or packed RecordIO with mean subtraction and sharding."""
+    rank = kv.rank if kv else 0
+    nworker = kv.num_workers if kv else 1
+
+    if args.synthetic:
+        rng = np.random.RandomState(42 + rank)
+        n = min(args.num_examples, 2 * args.batch_size * 4)
+        X = rng.rand(n, *data_shape).astype(np.float32)
+        y = rng.randint(0, 10, n).astype(np.float32)
+        train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                                  shuffle=True)
+        val = mx.io.NDArrayIter(X[:args.batch_size], y[:args.batch_size],
+                                batch_size=args.batch_size)
+        return train, val
+
+    train = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(args.data_dir, "train.rec"),
+        mean_img=os.path.join(args.data_dir, "mean.bin"),
+        data_shape=data_shape, batch_size=args.batch_size,
+        rand_crop=True, rand_mirror=True,
+        num_parts=nworker, part_index=rank, **rec_kwargs)
+    val = mx.io.ImageRecordIter(
+        path_imgrec=os.path.join(args.data_dir, "test.rec"),
+        mean_img=os.path.join(args.data_dir, "mean.bin"),
+        rand_crop=False, rand_mirror=False,
+        data_shape=data_shape, batch_size=args.batch_size,
+        num_parts=nworker, part_index=rank)
+    return train, val
+
+
+def fit(args, network, data_loader, optimizer="sgd",
+        optimizer_params=None):
     # devices: --tpus takes precedence (north star: --gpus -> --tpus only)
     devs = None
     if getattr(args, "tpus", None):
@@ -41,12 +76,34 @@ def fit(args, network, data_loader):
             step=max(int(epoch_size * args.lr_factor_epoch), 1),
             factor=args.lr_factor)
 
-    model = mx.model.FeedForward(
-        symbol=network, ctx=devs, num_epoch=args.num_epochs,
-        learning_rate=args.lr, momentum=0.9, wd=0.00001,
-        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
-        arg_params=arg_params, aux_params=aux_params,
-        begin_epoch=begin_epoch, lr_scheduler=lr_scheduler)
+    if isinstance(optimizer, mx.optimizer.Optimizer):
+        # pre-built optimizer object (scripts needing wd_mult etc.):
+        # attach the schedule/lr here, FeedForward uses it as-is
+        optimizer.lr = args.lr
+        if lr_scheduler is not None:
+            lr_scheduler.base_lr = args.lr
+            optimizer.lr_scheduler = lr_scheduler
+        optimizer.rescale_grad = 1.0 / args.batch_size
+        model = mx.model.FeedForward(
+            symbol=network, ctx=devs, num_epoch=args.num_epochs,
+            optimizer=optimizer,
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            arg_params=arg_params, aux_params=aux_params,
+            begin_epoch=begin_epoch)
+    else:
+        # momentum only where the optimizer has it — adam etc. would
+        # reject the kwarg at construction
+        opt_kwargs = {"wd": 0.00001}
+        if optimizer in ("sgd", "nag", "ccsgd"):
+            opt_kwargs["momentum"] = 0.9
+        opt_kwargs.update(optimizer_params or {})
+        model = mx.model.FeedForward(
+            symbol=network, ctx=devs, num_epoch=args.num_epochs,
+            optimizer=optimizer, learning_rate=args.lr,
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            arg_params=arg_params, aux_params=aux_params,
+            begin_epoch=begin_epoch, lr_scheduler=lr_scheduler,
+            **opt_kwargs)
 
     train, val = data_loader(args, kv)
     model.fit(X=train, eval_data=val, kvstore=kv,

@@ -469,7 +469,7 @@ class ImageRecordIter(DataIter):
                  preprocess_threads=4, prefetch_buffer=4, resize=0,
                  max_rotate_angle=0, max_random_contrast=0.0,
                  max_random_illumination=0.0, random_h=0, random_s=0,
-                 random_l=0, **kwargs):
+                 random_l=0, pad=0, **kwargs):
         super().__init__()
         from . import recordio as _recordio
         self.batch_size = batch_size
@@ -483,6 +483,9 @@ class ImageRecordIter(DataIter):
         # resize shorter edge, random rotation, contrast/illumination
         # jitter, HSL channel shifts
         self.resize = resize
+        # zero-pad each side before cropping (reference image_aug_default
+        # pad param — the CIFAR 4-pixel-pad + random-crop recipe)
+        self.pad_pixels = int(pad)
         self.max_rotate_angle = max_rotate_angle
         self.max_random_contrast = max_random_contrast
         self.max_random_illumination = max_random_illumination
@@ -587,6 +590,9 @@ class ImageRecordIter(DataIter):
             # raw-packed records: stored as flattened CHW float/uint8
             arr = np.frombuffer(raw, dtype=np.uint8)
             img = arr.astype(np.float32).reshape(self.data_shape)
+        if self.pad_pixels:
+            p = self.pad_pixels
+            img = np.pad(img, ((0, 0), (p, p), (p, p)))
         c, h, w = self.data_shape
         _, ih, iw = img.shape
         if ih < h or iw < w:

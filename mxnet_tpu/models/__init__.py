@@ -6,7 +6,7 @@ from .lenet import get_lenet
 from .alexnet import get_alexnet
 from .googlenet import get_googlenet
 from .inception_v3 import get_inception_v3
-from .resnet import get_resnet, get_resnet50
+from .resnet import get_resnet, get_resnet50, get_resnet_cifar
 from .inception_bn import get_inception_bn, get_inception_bn_28small
 from .vgg import get_vgg
 from .lstm import (lstm_unroll, lstm_unroll_scan, lstm_cell,
@@ -18,6 +18,7 @@ from .gru import gru_unroll, gru_cell, rnn_unroll, rnn_cell, GRUState, \
     GRUParam, RNNState, RNNParam
 
 __all__ = ["get_mlp", "get_lenet", "get_resnet", "get_resnet50",
+           "get_resnet_cifar",
            "get_inception_bn", "get_inception_bn_28small", "get_vgg",
            "lstm_unroll", "lstm_unroll_scan", "lstm_cell", "LSTMState",
            "LSTMParam",

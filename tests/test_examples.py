@@ -141,3 +141,15 @@ def test_neural_style_generator_v4(tmp_path):
                timeout=420)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "BOOST-TRAIN-OK" in res.stdout
+
+
+@pytest.mark.slow
+def test_train_cifar10_resnet_synthetic():
+    """The 6n+2 CIFAR residual network (reference
+    train_cifar10_resnet.py reproduction) trains CI-light."""
+    res = _run("example/image-classification",
+               ["train_cifar10_resnet.py", "--depth", "20", "--synthetic",
+                "--num-epochs", "2", "--batch-size", "32",
+                "--num-examples", "256"], timeout=420)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "Train-accuracy" in res.stderr + res.stdout

@@ -235,3 +235,34 @@ def test_image_record_iter_threaded_decode(tmp_path):
     assert len(serial) == len(threaded) == 2
     for a, b in zip(serial, threaded):
         assert np.array_equal(a, b)
+
+
+def test_image_record_iter_pad_crop(tmp_path):
+    """pad=N zero-pads each side before the crop (the CIFAR 4-pixel-pad
+    + random-crop recipe): with pad == data size the crop window moves,
+    so repeated passes over one image must produce differing batches."""
+    pytest.importorskip("PIL")
+    frec = str(tmp_path / "img.rec")
+    writer = recordio.MXRecordIO(frec, "w")
+    C, H, W = 3, 8, 8
+    img = (np.arange(C * H * W).reshape(C, H, W) % 255).astype(np.uint8)
+    writer.write(recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0),
+                                   img.transpose(1, 2, 0), img_fmt=".png"))
+    writer.close()
+
+    it = mx.io.ImageRecordIter(path_imgrec=frec, data_shape=(C, H, W),
+                               batch_size=1, pad=2, rand_crop=True)
+    np.random.seed(0)
+    seen = set()
+    for _ in range(12):
+        it.reset()
+        batch = next(iter(it))
+        assert batch.data[0].shape == (1, C, H, W)
+        seen.add(batch.data[0].asnumpy().tobytes())
+    assert len(seen) > 1, "pad+rand_crop never moved the crop window"
+
+    # pad with center crop (no rand_crop) keeps the original pixels
+    it = mx.io.ImageRecordIter(path_imgrec=frec, data_shape=(C, H, W),
+                               batch_size=1, pad=2)
+    out = next(iter(it)).data[0].asnumpy()[0]
+    assert np.allclose(out, img.astype(np.float32), atol=2.0)
