@@ -60,4 +60,6 @@ if __name__ == "__main__":
     opt = mx.optimizer.NAG(momentum=0.9, wd=args.wd)
     opt.set_wd_mult({n: 1.0 for n in net.list_arguments()
                      if n.endswith(("_bias", "_gamma", "_beta"))})
-    train_model.fit(args, net, get_iterator, optimizer=opt)
+    model = train_model.fit(args, net, get_iterator, optimizer=opt)
+    if args.save_model_prefix:
+        model.save(args.save_model_prefix)

@@ -11,9 +11,11 @@ import numpy as np
 import mxnet_tpu as mx
 
 
-def cifar_iterators(args, kv, data_shape=(3, 32, 32), **rec_kwargs):
+def cifar_iterators(args, kv, data_shape=(3, 32, 32), mean_img=True,
+                    **rec_kwargs):
     """Shared CIFAR data pipeline (train_cifar10*.py): synthetic CI-light
-    tensors, or packed RecordIO with mean subtraction and sharding."""
+    tensors, or packed RecordIO with sharding.  ``mean_img=False`` skips
+    the mean.bin subtraction for networks that normalize in-graph."""
     rank = kv.rank if kv else 0
     nworker = kv.num_workers if kv else 1
 
@@ -28,18 +30,19 @@ def cifar_iterators(args, kv, data_shape=(3, 32, 32), **rec_kwargs):
                                 batch_size=args.batch_size)
         return train, val
 
+    mean = {}
+    if mean_img:
+        mean = {"mean_img": os.path.join(args.data_dir, "mean.bin")}
     train = mx.io.ImageRecordIter(
         path_imgrec=os.path.join(args.data_dir, "train.rec"),
-        mean_img=os.path.join(args.data_dir, "mean.bin"),
         data_shape=data_shape, batch_size=args.batch_size,
         rand_crop=True, rand_mirror=True,
-        num_parts=nworker, part_index=rank, **rec_kwargs)
+        num_parts=nworker, part_index=rank, **mean, **rec_kwargs)
     val = mx.io.ImageRecordIter(
         path_imgrec=os.path.join(args.data_dir, "test.rec"),
-        mean_img=os.path.join(args.data_dir, "mean.bin"),
         rand_crop=False, rand_mirror=False,
         data_shape=data_shape, batch_size=args.batch_size,
-        num_parts=nworker, part_index=rank)
+        num_parts=nworker, part_index=rank, **mean)
     return train, val
 
 
@@ -83,7 +86,8 @@ def fit(args, network, data_loader, optimizer="sgd",
         if lr_scheduler is not None:
             lr_scheduler.base_lr = args.lr
             optimizer.lr_scheduler = lr_scheduler
-        optimizer.rescale_grad = 1.0 / args.batch_size
+        nworker = kv.num_workers if (kv and "dist" in kv.type) else 1
+        optimizer.rescale_grad = 1.0 / (args.batch_size * nworker)
         model = mx.model.FeedForward(
             symbol=network, ctx=devs, num_epoch=args.num_epochs,
             optimizer=optimizer,

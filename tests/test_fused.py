@@ -616,3 +616,28 @@ def test_interleaved_eval_after_early_commit_restores_train_outputs():
             "update() left the eval batch's outputs installed"
     finally:
         os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_sharded_weight_update_matches_replicated():
+    """MXNET_SHARD_WEIGHT_UPDATE=1 (cross-replica sharded weight update,
+    Xu et al. 2020): identical training trajectory, optimizer state
+    resident SHARDED over the dp axis."""
+    ctxs = [mx.cpu(i) for i in range(4)]
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    try:
+        _, base = _train(True, ctxs)
+        os.environ["MXNET_SHARD_WEIGHT_UPDATE"] = "1"
+        mx.random.seed(7)
+        mod = mx.mod.Module(_mlp(), context=ctxs)
+        mod.fit(_data(), num_epoch=3,
+                optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+        assert mod._fused is not None and mod._fused.shard_update
+        sharded = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+        for k in base:
+            assert np.abs(base[k] - sharded[k]).max() < 1e-4, k
+        # momentum for a dp-divisible param must live sharded at rest
+        st = mod._fused_state["opt"]["fc1_weight"]
+        assert "dp" in str(st.sharding.spec), st.sharding
+    finally:
+        os.environ.pop("MXNET_SHARD_WEIGHT_UPDATE", None)
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
