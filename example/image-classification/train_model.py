@@ -86,7 +86,7 @@ def fit(args, network, data_loader, optimizer="sgd",
         if lr_scheduler is not None:
             lr_scheduler.base_lr = args.lr
             optimizer.lr_scheduler = lr_scheduler
-        nworker = kv.num_workers if (kv and "dist" in kv.type) else 1
+        nworker = kv.num_workers if (kv and "dist_sync" in kv.type) else 1
         optimizer.rescale_grad = 1.0 / (args.batch_size * nworker)
         model = mx.model.FeedForward(
             symbol=network, ctx=devs, num_epoch=args.num_epochs,

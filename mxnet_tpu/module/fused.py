@@ -196,12 +196,16 @@ class FusedTrainStep:
             # replicate-then-reshard would spike peak HBM by exactly the
             # amount this mode exists to save.
             opt = {}
+            init_cache = {}   # one compile per (shape, dtype), not per param
             for n, w in params.items():
-                struct = jax.eval_shape(self._opt_init, w)
-                shardings = jax.tree_util.tree_map(self._update_spec,
-                                                   struct)
-                opt[n] = jax.jit(self._opt_init,
-                                 out_shardings=shardings)(w)
+                key = (tuple(w.shape), str(w.dtype))
+                if key not in init_cache:
+                    struct = jax.eval_shape(self._opt_init, w)
+                    shardings = jax.tree_util.tree_map(self._update_spec,
+                                                       struct)
+                    init_cache[key] = jax.jit(self._opt_init,
+                                              out_shardings=shardings)
+                opt[n] = init_cache[key](w)
         else:
             opt = {n: self._opt_init(w) for n, w in params.items()}
         # the step counter lives on device and increments in-program: a
@@ -373,6 +377,21 @@ class FusedTrainStep:
             self._lr_cache = (lr, jnp.asarray(lr, jnp.float32))
         return self._step(state, batch, self._lr_cache[1], base_key)
 
+    def gather_update_leaf(self, x):
+        """One sharded-at-rest optimizer-state leaf -> replicated (and,
+        multi-process, host-materializable).  The classic-updater
+        fallback consumes replicated per-param state; handing it raw
+        dp shards would crash (non-addressable) or silently feed it a
+        layout it cannot use."""
+        if x is None:
+            return None
+        gathered = jax.jit(lambda a: a,
+                           out_shardings=self._replicated())(x)
+        # materialize through host: the classic path mixes this with
+        # per-device arrays, and a mesh-committed array would poison
+        # every eager op it meets with a device mismatch
+        return jnp.asarray(np.asarray(gathered.addressable_data(0)))
+
     def aot_compile(self, state, batch, base_key):
         """Ahead-of-time compile the step for exactly these avals,
         install the executable as the step program, and return its
@@ -406,14 +425,13 @@ class FusedTrainStep:
         """Pull the live state back into host-side NDArray dicts. Copies:
         the state buffers are donated to the next step, which would delete
         the arrays under any NDArray handed out here."""
-        if self._multiprocess():
-            # replicated global arrays: every local device holds the full
-            # value — materialize from the first addressable shard
-            def host(x):
-                return NDArray(np.array(x.addressable_data(0)))
-        else:
-            def host(x):
-                return NDArray(jnp.copy(x))
+        # Materialize through host in BOTH cases (the docstring's
+        # contract): a jnp.copy would stay committed to the fused mesh,
+        # and a mesh-committed weight leaking into the classic per-device
+        # path (kvstore re-seed on fallback, exec-group updates) poisons
+        # every eager op it meets with a device mismatch.
+        def host(x):
+            return NDArray(jnp.asarray(np.asarray(x.addressable_data(0))))
         for n in self.train_names:
             arg_params[n] = host(state["params"][n])
         for n in self.fixed_names:

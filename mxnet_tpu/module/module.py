@@ -456,6 +456,14 @@ class Module(BaseModule):
                     st = opt_states.get(n)
                     if st is None:
                         continue
+                    if fused.shard_update:
+                        # sharded-at-rest state must be gathered
+                        # before the per-param host updater owns it
+                        def _gather(s):
+                            if isinstance(s, (tuple, list)):
+                                return tuple(_gather(e) for e in s)
+                            return fused.gather_update_leaf(s)
+                        st = _gather(st)
                     if self._update_on_kvstore:
                         updater.states[i] = _to_nd(st)
                     else:

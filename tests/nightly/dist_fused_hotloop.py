@@ -45,6 +45,8 @@ def main():
     mod.init_optimizer(kvstore=kv, optimizer_params={"learning_rate": 0.1})
     assert mod._fused is not None and mod._fused.global_dp, \
         "fused dist path did not engage"
+    if os.environ.get("MXNET_SHARD_WEIGHT_UPDATE") == "1":
+        assert mod._fused.shard_update, "sharded update did not engage"
     init_pushes, init_pulls = calls["push"], calls["pull"]
 
     n_batches = 0

@@ -10,8 +10,9 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(n, script, timeout=110, servers=0, port=None):
+def _launch(n, script, timeout=110, servers=0, port=None, extra_env=None):
     env = dict(os.environ)
+    env.update(extra_env or {})
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = ""
     env.pop("XLA_FLAGS", None)  # workers use default 1 cpu device each
@@ -126,5 +127,15 @@ def test_dist_fused_hotloop_no_perparam_kvstore_traffic():
     push/pull calls per batch (the reference's 'python only pushes
     pointers' contract held across processes)."""
     res = _launch(2, "tests/nightly/dist_fused_hotloop.py", port=9092)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr
+
+
+def test_dist_fused_hotloop_sharded_weight_update():
+    """The cross-replica sharded weight update composes with the
+    multi-process global mesh: optimizer state shards across WORKERS
+    and the hot loop still does zero per-param kvstore work."""
+    res = _launch(2, "tests/nightly/dist_fused_hotloop.py", port=9091,
+                  extra_env={"MXNET_SHARD_WEIGHT_UPDATE": "1"})
     assert res.returncode == 0, res.stdout + res.stderr
     assert res.stdout.count("PASSED") == 2, res.stdout + res.stderr

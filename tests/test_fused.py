@@ -641,3 +641,40 @@ def test_sharded_weight_update_matches_replicated():
     finally:
         os.environ.pop("MXNET_SHARD_WEIGHT_UPDATE", None)
         os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_sharded_update_survives_classic_fallback():
+    """Mid-training hparam mutation under MXNET_SHARD_WEIGHT_UPDATE=1:
+    the fallback must gather the dp-sharded optimizer state before
+    handing it to the per-param host updater."""
+    ctxs = [mx.cpu(i) for i in range(4)]
+    os.environ["MXNET_FUSED_TRAIN"] = "1"
+    os.environ["MXNET_SHARD_WEIGHT_UPDATE"] = "1"
+    try:
+        mx.random.seed(5)
+        mod = mx.mod.Module(_mlp(), context=ctxs)
+        it = _data()
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params()
+        # adagrad: real per-param state to gather, and (unlike momentum
+        # SGD) lr_mult=0 really freezes the weight — no inertia term
+        mod.init_optimizer(optimizer="adagrad",
+                           optimizer_params={"learning_rate": 0.5})
+        assert mod._fused is not None and mod._fused.shard_update
+        batch = next(iter(it))
+        mod.forward(batch, is_train=True); mod.backward(); mod.update()
+        mod._optimizer.set_lr_mult({"fc1_weight": 0.0})
+        mod.forward(batch, is_train=True); mod.backward(); mod.update()
+        assert mod._fused is None            # classic path engaged
+        frozen = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+        fc2_before = mod.get_params()[0]["fc2_weight"].asnumpy().copy()
+        mod.forward(batch, is_train=True); mod.backward(); mod.update()
+        after = mod.get_params()[0]
+        assert np.allclose(after["fc1_weight"].asnumpy(), frozen)
+        # the carried (gathered) adagrad history keeps training fc2
+        assert np.abs(after["fc2_weight"].asnumpy()
+                      - fc2_before).max() > 0
+        assert np.isfinite(after["fc2_weight"].asnumpy()).all()
+    finally:
+        os.environ.pop("MXNET_SHARD_WEIGHT_UPDATE", None)
+        os.environ.pop("MXNET_FUSED_TRAIN", None)
