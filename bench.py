@@ -119,22 +119,59 @@ def run(batch, warmup=5, iters=30, windows=3):
     return sorted(rates)[len(rates) // 2], flops / batch if flops else 0.0
 
 
+# Watchdog against a wedged device tunnel: the hang sits inside backend
+# init / a compile without returning to the interpreter (a SIGALRM
+# handler never runs — measured), but the blocked call releases the GIL,
+# so a daemon thread can still emit the failure line instead of hanging
+# the driver.  The deadline is a HEARTBEAT: each leg of the bench feeds
+# it, so slow-but-responsive runs (cold compiles, OOM retries across
+# batch sizes) never trip it — only >540s with zero progress does.
+_WATCHDOG = {"deadline": None, "done": False}
+
+
+def _feed_watchdog(seconds=540):
+    _WATCHDOG["deadline"] = time.monotonic() + seconds
+
+
+def _watchdog_loop():
+    import os
+    while not _WATCHDOG["done"]:
+        time.sleep(10)
+        if _WATCHDOG["done"]:
+            return
+        if time.monotonic() > _WATCHDOG["deadline"]:
+            sys.stderr.write("bench: watchdog fired — device "
+                             "unresponsive\n")
+            print(json.dumps(
+                {"metric": "resnet50_train_throughput_per_chip",
+                 "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+                 "error": "device watchdog timeout"}), flush=True)
+            os._exit(2)
+
+
 def main():
     import os
+    import threading
+
+    _feed_watchdog()
+    threading.Thread(target=_watchdog_loop, daemon=True).start()
     os.environ.setdefault("MXNET_COMPUTE_DTYPE", "bfloat16")
     value, step_flops_per_img = None, 0.0
     for batch in (512, 256, 128, 64, 32):
         try:
+            _feed_watchdog()          # each attempt gets a fresh budget
             value, step_flops_per_img = run(batch)
             break
         except Exception as e:  # OOM etc: halve the batch
             sys.stderr.write("bench: batch %d failed (%s)\n" % (batch, e))
     if value is None:
+        _WATCHDOG["done"] = True
         print(json.dumps({"metric": "resnet50_train_throughput_per_chip",
                           "value": 0.0, "unit": "images/sec",
-                          "vs_baseline": 0.0}))
+                          "vs_baseline": 0.0}), flush=True)
         return
     try:
+        _feed_watchdog()
         peak = probe_peak_tflops()
         mfu = value * TRAIN_GFLOP_PER_IMG * 1e9 / (peak * 1e12)
         hfu = (value * step_flops_per_img / (peak * 1e12)
@@ -160,11 +197,13 @@ def main():
     # process, same peak probe — the only comparison this tunnel allows.
     try:
         from bench_lstm import run as lstm_run, train_mflop_per_token
+        _feed_watchdog()
         tok = lstm_run(batch=256, iters=20, windows=3)
         line["lstm_tokens_per_sec"] = round(tok, 1)
         if peak:
             line["lstm_mfu"] = round(
                 tok * train_mflop_per_token() * 1e6 / (peak * 1e12), 4)
+        _feed_watchdog()
         tok_big = lstm_run(batch=256, num_hidden=1024, num_embed=1024,
                            iters=10, windows=3)
         line["lstm_h1024_tokens_per_sec"] = round(tok_big, 1)
@@ -174,7 +213,8 @@ def main():
                 * 1e6 / (peak * 1e12), 4)
     except Exception as e:
         sys.stderr.write("bench: lstm leg failed (%s)\n" % e)
-    print(json.dumps(line))
+    _WATCHDOG["done"] = True
+    print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":
