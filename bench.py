@@ -21,12 +21,81 @@ import time
 import numpy as np
 
 BASELINE_IMG_S_PER_CHIP = 128.0  # MXNet-CUDA TitanX img/s/GPU (BASELINE.md)
+# Sanity band for the measured peak: no single chip this bench can see is
+# below 10 or above 1000 TF/s.  A probe outside the band means the tunnel
+# clock is lying (round-2 artifact recorded 66,500 "TF/s"); absolute
+# numbers are then meaningless and only in-process ratios (mfu/hfu) hold.
+PEAK_SANE_TFLOPS = (10.0, 1000.0)
 # ResNet-50 @224 analytic model cost: ~4.1 GFLOP forward per image,
 # backward ~2x forward -> the conventional MFU numerator.  The EXECUTED
 # flops of the compiled step (XLA cost analysis, same 2mnk convention as
 # the probe: verified ratio 1.0 on a plain matmul) are measured at run
 # time and reported as hfu/train_gflop_per_img_xla -- docs/perf.md.
 TRAIN_GFLOP_PER_IMG = 12.3
+
+
+_PREFLIGHT_CODE = """
+import sys
+import jax, jax.numpy as jnp
+plat = jax.devices()[0].platform
+x = jnp.ones((512, 512), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print("preflight ok:", plat, flush=True)
+if plat == "cpu":
+    # an absent/broken accelerator plugin falls back to CPU silently;
+    # publishing CPU throughput as chip numbers would be worse than
+    # failing -- make the fallback loud
+    sys.stderr.write("silent CPU fallback: no accelerator backend\\n")
+    sys.exit(8)
+"""
+
+
+def clock_is_suspect(peak_tflops):
+    """True when the probe's absolute number cannot be real hardware."""
+    return bool(peak_tflops) and not (
+        PEAK_SANE_TFLOPS[0] <= peak_tflops <= PEAK_SANE_TFLOPS[1])
+
+
+def device_preflight(timeout_s=None, retries=1):
+    """Bounded-time device health check in a SUBPROCESS (a wedged backend
+    hangs inside native code and cannot be interrupted in-process; a child
+    can simply be killed).  Returns None if healthy, else a diagnosis
+    string.  One retry: transient tunnel drops recover in seconds."""
+    import os
+    import signal
+    import subprocess
+    if timeout_s is None:
+        timeout_s = int(os.environ.get("MXNET_BENCH_PREFLIGHT_S", "55"))
+    diag = None
+    for attempt in range(retries + 1):
+        # Popen in its own session + killpg on timeout: subprocess.run
+        # would only kill the direct child and then block in an untimed
+        # communicate() while any wedged helper grandchild keeps the
+        # captured pipes open — the exact hang this check exists to bound.
+        p = subprocess.Popen(
+            [sys.executable, "-c", _PREFLIGHT_CODE],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True)
+        try:
+            _, err = p.communicate(timeout=timeout_s)
+            if p.returncode == 0:
+                return None
+            diag = "preflight rc=%d: %s" % (
+                p.returncode, (err or "").strip()[-300:])
+            sys.stderr.write("bench: %s\n" % diag)
+            return diag   # deterministic failure: retrying is pointless
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except OSError:
+                p.kill()
+            try:
+                p.communicate(timeout=10)
+            except Exception:
+                pass
+            diag = "preflight timed out after %ds (device wedged?)" % timeout_s
+        sys.stderr.write("bench: %s (attempt %d)\n" % (diag, attempt + 1))
+    return diag
 
 
 def probe_peak_tflops(iters=16, n=8192, windows=3):
@@ -106,6 +175,7 @@ def run(batch, warmup=5, iters=30, windows=3):
         mod.forward(staged, is_train=True)
         mod.backward()
         mod.update()
+        _feed_watchdog()   # per-step progress counts as a heartbeat
     _sync(mod)
     rates = []
     for _ in range(windows):   # median window: the tunnel clock is noisy
@@ -114,64 +184,84 @@ def run(batch, warmup=5, iters=30, windows=3):
             mod.forward(staged, is_train=True)
             mod.backward()
             mod.update()
-        _sync(mod)
+            _feed_watchdog()   # async dispatch blocks once queues fill, so
+        _sync(mod)             # a wedge still starves the heartbeat
+        _feed_watchdog()
         rates.append(batch * iters / (time.perf_counter() - t0))
     return sorted(rates)[len(rates) // 2], flops / batch if flops else 0.0
 
 
-# Watchdog against a wedged device tunnel: the hang sits inside backend
-# init / a compile without returning to the interpreter (a SIGALRM
-# handler never runs — measured), but the blocked call releases the GIL,
-# so a daemon thread can still emit the failure line instead of hanging
-# the driver.  The deadline is a HEARTBEAT: each leg of the bench feeds
-# it, so slow-but-responsive runs (cold compiles, OOM retries across
-# batch sizes) never trip it — only >540s with zero progress does.
-_WATCHDOG = {"deadline": None, "done": False}
+# Once the primary ResNet metric is measured, main() stashes its JSON line
+# here so a later wedge (peak probe, optional LSTM legs) degrades to "the
+# measured number + an error note" instead of discarding the round's
+# artifact as 0.0.
+_PARTIAL_LINE = None
 
 
-def _feed_watchdog(seconds=540):
-    _WATCHDOG["deadline"] = time.monotonic() + seconds
+def _bench_timeout(phase):
+    sys.stderr.write("bench: watchdog fired — device unresponsive "
+                     "(phase=%s)\n" % phase)
+    if _PARTIAL_LINE is not None:
+        line = dict(_PARTIAL_LINE)
+        line["error"] = ("device watchdog timeout in optional leg "
+                         "(phase=%s); primary metric measured" % phase)
+    else:
+        line = {"metric": "resnet50_train_throughput_per_chip",
+                "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+                "error": "device watchdog timeout (phase=%s)" % phase}
+    print(json.dumps(line), flush=True)
 
 
-def _watchdog_loop():
-    import os
-    while not _WATCHDOG["done"]:
-        time.sleep(10)
-        if _WATCHDOG["done"]:
-            return
-        if time.monotonic() > _WATCHDOG["deadline"]:
-            sys.stderr.write("bench: watchdog fired — device "
-                             "unresponsive\n")
-            print(json.dumps(
-                {"metric": "resnet50_train_throughput_per_chip",
-                 "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
-                 "error": "device watchdog timeout"}), flush=True)
-            os._exit(2)
+def _make_bench_watchdog():
+    from harness_watchdog import HeartbeatWatchdog
+    return HeartbeatWatchdog(_bench_timeout, exit_code=2, budget_s=540,
+                             poll_s=10)
+
+
+_wd = _make_bench_watchdog()
+
+
+def _feed_watchdog(phase=None):
+    _wd.feed(phase)
 
 
 def main():
     import os
-    import threading
 
-    _feed_watchdog()
-    threading.Thread(target=_watchdog_loop, daemon=True).start()
+    _feed_watchdog("preflight")
+    _wd.start()
     os.environ.setdefault("MXNET_COMPUTE_DTYPE", "bfloat16")
+    diag = device_preflight()
+    if diag is not None:
+        _wd.stop()
+        print(json.dumps(
+            {"metric": "resnet50_train_throughput_per_chip",
+             "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0,
+             "error": "device unavailable: %s" % diag}), flush=True)
+        sys.exit(2)   # same rc the watchdog uses for this condition
     value, step_flops_per_img = None, 0.0
     for batch in (512, 256, 128, 64, 32):
         try:
-            _feed_watchdog()          # each attempt gets a fresh budget
+            _feed_watchdog("train-batch")  # each attempt: fresh budget
             value, step_flops_per_img = run(batch)
             break
         except Exception as e:  # OOM etc: halve the batch
             sys.stderr.write("bench: batch %d failed (%s)\n" % (batch, e))
     if value is None:
-        _WATCHDOG["done"] = True
+        _wd.stop()
         print(json.dumps({"metric": "resnet50_train_throughput_per_chip",
                           "value": 0.0, "unit": "images/sec",
-                          "vs_baseline": 0.0}), flush=True)
-        return
+                          "vs_baseline": 0.0,
+                          "error": "all batch sizes failed"}), flush=True)
+        sys.exit(1)
+    global _PARTIAL_LINE
+    _PARTIAL_LINE = {
+        "metric": "resnet50_train_throughput_per_chip",
+        "value": round(value, 2), "unit": "images/sec",
+        "vs_baseline": round(value / BASELINE_IMG_S_PER_CHIP, 3),
+        "path": "module_api_fused"}
     try:
-        _feed_watchdog()
+        _feed_watchdog("peak-probe")
         peak = probe_peak_tflops()
         mfu = value * TRAIN_GFLOP_PER_IMG * 1e9 / (peak * 1e12)
         hfu = (value * step_flops_per_img / (peak * 1e12)
@@ -179,11 +269,17 @@ def main():
     except Exception as e:
         sys.stderr.write("bench: peak probe failed (%s)\n" % e)
         peak, mfu, hfu = 0.0, 0.0, 0.0
+    # Clock sanity clamp: value and peak share one clock, so their RATIO
+    # (mfu/hfu) survives a lying clock while the absolutes do not.  When
+    # the probe lands outside the physically possible band, say so and
+    # refuse to publish a baseline comparison built on that clock.
+    clock_suspect = clock_is_suspect(peak)
     line = {
         "metric": "resnet50_train_throughput_per_chip",
         "value": round(value, 2),
         "unit": "images/sec",
-        "vs_baseline": round(value / BASELINE_IMG_S_PER_CHIP, 3),
+        "vs_baseline": (None if clock_suspect
+                        else round(value / BASELINE_IMG_S_PER_CHIP, 3)),
         "path": "module_api_fused",
         "mfu": round(mfu, 4),
         "hfu": round(hfu, 4),
@@ -191,19 +287,25 @@ def main():
         if step_flops_per_img else None,
         "peak_tflops": round(peak, 1),
     }
+    if clock_suspect:
+        line["clock_suspect"] = True
+        line["note"] = ("probe outside [%g, %g] TF/s: tunnel clock "
+                        "untrustworthy; only in-process ratios (mfu/hfu) "
+                        "are meaningful" % PEAK_SANE_TFLOPS)
+    _PARTIAL_LINE = dict(line)   # LSTM legs are optional: preserve this
     # second north star (VERDICT r2 #8): the PTB LSTM tokens/sec + MFU,
     # plus the hidden=1024 datapoint proving the MXU-tiling lever
     # (docs/perf.md: 200-wide gates are sub-tile by construction).  Same
     # process, same peak probe — the only comparison this tunnel allows.
     try:
         from bench_lstm import run as lstm_run, train_mflop_per_token
-        _feed_watchdog()
+        _feed_watchdog("lstm")
         tok = lstm_run(batch=256, iters=20, windows=3)
         line["lstm_tokens_per_sec"] = round(tok, 1)
         if peak:
             line["lstm_mfu"] = round(
                 tok * train_mflop_per_token() * 1e6 / (peak * 1e12), 4)
-        _feed_watchdog()
+        _feed_watchdog("lstm-h1024")
         tok_big = lstm_run(batch=256, num_hidden=1024, num_embed=1024,
                            iters=10, windows=3)
         line["lstm_h1024_tokens_per_sec"] = round(tok_big, 1)
@@ -213,7 +315,7 @@ def main():
                 * 1e6 / (peak * 1e12), 4)
     except Exception as e:
         sys.stderr.write("bench: lstm leg failed (%s)\n" % e)
-    _WATCHDOG["done"] = True
+    _wd.stop()
     print(json.dumps(line), flush=True)
 
 

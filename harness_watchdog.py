@@ -1,0 +1,57 @@
+"""Heartbeat watchdog shared by the driver-facing harness scripts
+(bench.py, __graft_entry__.py).
+
+The hang worth guarding sits inside backend init or a compile that never
+returns to the interpreter: a SIGALRM handler never runs there (measured),
+but the blocked call releases the GIL, so a daemon thread still can emit a
+parseable failure line and hard-exit instead of eating the driver's budget.
+
+The deadline is a HEARTBEAT — each phase/step of the harness feeds it — so
+slow-but-progressing runs (cold compiles, OOM retries) never trip it; only
+sustained zero progress does.
+"""
+import os
+import threading
+import time
+
+
+class HeartbeatWatchdog:
+    """Daemon-thread deadline that `on_timeout(phase)` + os._exit()s when
+    starved.  feed() extends the deadline and optionally names the phase."""
+
+    def __init__(self, on_timeout, exit_code, budget_s=540, poll_s=5):
+        self._on_timeout = on_timeout
+        self._exit_code = exit_code
+        self._budget_s = budget_s
+        self._poll_s = poll_s
+        self._deadline = None
+        self._done = False
+        self._gen = 0     # start() bumps it; stale loop threads retire
+        self.phase = "init"
+
+    def feed(self, phase=None, seconds=None):
+        if phase is not None:
+            self.phase = phase
+        self._deadline = time.monotonic() + (
+            self._budget_s if seconds is None else seconds)
+
+    def start(self):
+        self.feed()     # never start against a stale (expired) deadline
+        self._done = False          # support repeat in-process runs
+        self._gen += 1
+        threading.Thread(target=self._loop, args=(self._gen,),
+                         daemon=True).start()
+
+    def stop(self):
+        self._done = True
+
+    def _loop(self, gen):
+        while not self._done and gen == self._gen:
+            time.sleep(self._poll_s)
+            if self._done or gen != self._gen:
+                return
+            if time.monotonic() > self._deadline:
+                try:
+                    self._on_timeout(self.phase)
+                finally:
+                    os._exit(self._exit_code)

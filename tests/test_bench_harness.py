@@ -1,0 +1,137 @@
+"""The driver-facing verification harness must be chip-proof.
+
+Round-3 postmortem: a wedged device tunnel cost the round both driver
+artifacts (BENCH_r03 = 0.0, MULTICHIP_r03 rc=124) because dryrun_multichip
+touched the real backend before its CPU fallback and bench.py had no
+bounded preflight.  These tests pin the fixes:
+
+  - dryrun_multichip forces jax_platforms=cpu BEFORE any backend init and
+    runs green in a subprocess with no env help (hermetic);
+  - its watchdog emits a parseable failure line and exits 3 on stall;
+  - bench.device_preflight bounds a wedged device to seconds, in a child;
+  - bench.clock_is_suspect rejects physically impossible probe numbers
+    (round-2 artifact recorded 66,500 "TF/s" on one chip).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_clock_suspect_band():
+    import bench
+    assert not bench.clock_is_suspect(90.0)      # plausible single chip
+    assert not bench.clock_is_suspect(918.0)     # plausible big chip
+    assert bench.clock_is_suspect(66500.8)       # the round-2 artifact
+    assert bench.clock_is_suspect(0.4)           # too slow to be a TPU
+    assert not bench.clock_is_suspect(0.0)       # "no probe" is not suspect
+
+
+def test_preflight_bounds_a_wedged_device(monkeypatch):
+    """A child that never answers must come back as a diagnosis string in
+    ~timeout seconds, not hang."""
+    import bench
+    monkeypatch.setattr(bench, "_PREFLIGHT_CODE",
+                        "import time; time.sleep(3600)")
+    diag = bench.device_preflight(timeout_s=2, retries=0)
+    assert diag is not None and "timed out" in diag
+
+
+def test_preflight_passes_on_healthy_backend(monkeypatch):
+    import bench
+    monkeypatch.setattr(bench, "_PREFLIGHT_CODE", "print('ok')")
+    assert bench.device_preflight(timeout_s=30, retries=0) is None
+
+
+def test_preflight_reports_crash_rc(monkeypatch):
+    import bench
+    monkeypatch.setattr(bench, "_PREFLIGHT_CODE",
+                        "import sys; sys.stderr.write('boom'); sys.exit(7)")
+    diag = bench.device_preflight(timeout_s=30, retries=0)
+    assert diag is not None and "rc=7" in diag and "boom" in diag
+
+
+def test_preflight_rejects_silent_cpu_fallback():
+    """An absent/broken accelerator plugin silently falls back to CPU;
+    the preflight child must treat that as UNHEALTHY (publishing CPU
+    throughput as chip numbers would be worse than failing).  Run the
+    real preflight code with the platform pinned to cpu."""
+    import bench
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", bench._PREFLIGHT_CODE],
+                       env=env, cwd=REPO, timeout=120,
+                       capture_output=True, text=True)
+    assert r.returncode == 8, (r.returncode, r.stderr[-300:])
+    assert "CPU fallback" in r.stderr
+
+
+def test_bench_timeout_preserves_measured_primary(monkeypatch, capsys):
+    """A wedge in an optional leg (probe/LSTM) must not zero out an
+    already-measured ResNet number."""
+    import bench
+    monkeypatch.setattr(bench, "_PARTIAL_LINE",
+                        {"metric": "resnet50_train_throughput_per_chip",
+                         "value": 123.4, "unit": "images/sec"})
+    bench._bench_timeout("lstm")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 123.4
+    assert "optional leg" in out["error"] and "phase=lstm" in out["error"]
+    monkeypatch.setattr(bench, "_PARTIAL_LINE", None)
+    bench._bench_timeout("train-batch")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 0.0 and "phase=train-batch" in out["error"]
+
+
+def test_watchdog_restart_not_stale():
+    """stop() + untimed gap + start() must not fire from the old deadline,
+    and stale loop threads must retire on restart (generation token)."""
+    import time as _t
+    from harness_watchdog import HeartbeatWatchdog
+    fired = []
+    wd = HeartbeatWatchdog(fired.append, exit_code=9, budget_s=30,
+                           poll_s=0.05)
+    wd.feed("a", seconds=0.01)
+    wd.stop()
+    _t.sleep(0.1)          # old deadline is now expired
+    wd.start()             # must re-feed: no fire from the stale deadline
+    _t.sleep(0.3)
+    wd.stop()
+    assert fired == []
+    assert wd._gen == 1
+
+
+def test_dryrun_watchdog_emits_parseable_failure():
+    """Simulated stall: the watchdog must print the FAILED line and exit 3
+    instead of eating the driver's budget."""
+    code = (
+        "import time\n"
+        "import __graft_entry__ as g\n"
+        "g._dryrun_wd = wd = g._make_dryrun_watchdog()\n"
+        "wd._poll_s = 1\n"
+        "wd.start()\n"
+        "wd.feed('simulated', seconds=1)\n"
+        "time.sleep(60)\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, timeout=90,
+                       capture_output=True, text=True)
+    assert r.returncode == 3
+    assert "dryrun_multichip FAILED" in r.stdout
+    assert "phase=simulated" in r.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_hermetic_no_env_help():
+    """The full 8-device dryrun must succeed in a fresh interpreter with
+    JAX_PLATFORMS/XLA_FLAGS scrubbed — i.e. without the driver's env and
+    regardless of real-chip health."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        cwd=REPO, env=env, timeout=600, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "dryrun_multichip OK" in r.stdout
