@@ -81,6 +81,9 @@ static struct {
   FunctionHandle *funcs;
   mx_uint n_creators;
   AtomicSymbolCreator *creators;
+  /* set only after EVERY step of mxg_load succeeded; a half-load
+   * (missing symbol, registry error, failed malloc) retries fully */
+  int loaded;
 } mxg;
 
 static void chk(int ret) {
@@ -94,11 +97,9 @@ static void chk(int ret) {
   } while (0)
 
 SEXP mxg_load(SEXP path) {
-  /* guard on the LAST field assigned: a failed half-load (missing
-   * symbol, registry error) must retry fully on the next call instead
-   * of reporting success with NULL function pointers */
-  if (mxg.funcs != NULL) return R_NilValue;
+  if (mxg.loaded) return R_NilValue;
   const char *p = CHAR(STRING_ELT(path, 0));
+  if (mxg.dl != NULL) dlclose(mxg.dl);  /* leftover of a failed half-load */
   mxg.dl = dlopen(p, RTLD_NOW | RTLD_GLOBAL);
   if (mxg.dl == NULL) Rf_error("dlopen(%s): %s", p, dlerror());
   RESOLVE(GetLastError, "MXGetLastError");
@@ -137,15 +138,22 @@ SEXP mxg_load(SEXP path) {
    * array immediately, before any further MX* call */
   FunctionHandle *funcs_tmp;
   chk(mxg.ListFunctions(&mxg.n_funcs, &funcs_tmp));
+  free(mxg.funcs);
   mxg.funcs =
-      (FunctionHandle *)malloc(mxg.n_funcs * sizeof(FunctionHandle));
-  memcpy(mxg.funcs, funcs_tmp, mxg.n_funcs * sizeof(FunctionHandle));
+      (FunctionHandle *)malloc((size_t)mxg.n_funcs * sizeof(FunctionHandle));
+  if (mxg.funcs == NULL && mxg.n_funcs > 0)
+    Rf_error("mxnet_tpu: out of memory caching %u functions", mxg.n_funcs);
+  memcpy(mxg.funcs, funcs_tmp, (size_t)mxg.n_funcs * sizeof(FunctionHandle));
   AtomicSymbolCreator *creators_tmp;
   chk(mxg.SymbolListAtomicSymbolCreators(&mxg.n_creators, &creators_tmp));
+  free(mxg.creators);
   mxg.creators = (AtomicSymbolCreator *)malloc(
-      mxg.n_creators * sizeof(AtomicSymbolCreator));
+      (size_t)mxg.n_creators * sizeof(AtomicSymbolCreator));
+  if (mxg.creators == NULL && mxg.n_creators > 0)
+    Rf_error("mxnet_tpu: out of memory caching %u ops", mxg.n_creators);
   memcpy(mxg.creators, creators_tmp,
-         mxg.n_creators * sizeof(AtomicSymbolCreator));
+         (size_t)mxg.n_creators * sizeof(AtomicSymbolCreator));
+  mxg.loaded = 1;
   return R_NilValue;
 }
 

@@ -136,8 +136,13 @@ class NDArrayIter(DataIter):
 
         self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
         self.num_source = len(self.data_list)
-        self.cursor = -batch_size
         self.last_batch_handle = last_batch_handle
+        # Epoch position: `_batch_start` is the first row of the batch most
+        # recently handed out (None before the epoch's first batch), and
+        # `_wrap_carry` counts head rows a wrapped final batch has already
+        # served, so roll_over mode can begin the next epoch past them.
+        self._batch_start = None
+        self._wrap_carry = 0
 
     @property
     def provide_data(self):
@@ -150,18 +155,31 @@ class NDArrayIter(DataIter):
                 for k, v in self.label]
 
     def hard_reset(self):
-        self.cursor = -self.batch_size
+        """Forget the epoch position entirely, including any roll-over."""
+        self._batch_start = None
+        self._wrap_carry = 0
 
     def reset(self):
+        # After exhaustion, `_batch_start` sits one batch stride past the
+        # last served batch; its overshoot beyond the data end equals the
+        # head rows a wrapped final batch already consumed.  roll_over
+        # starts the next epoch after them; a mid-epoch reset (no
+        # overshoot) starts from the top.
+        carry = 0
         if self.last_batch_handle == "roll_over" and \
-                self.cursor > self.num_data:
-            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
-        else:
-            self.cursor = -self.batch_size
+                self._batch_start is not None:
+            carry = max(0, self._batch_start - self.num_data)
+        self._wrap_carry = carry
+        self._batch_start = None
 
     def iter_next(self):
-        self.cursor += self.batch_size
-        return self.cursor < self.num_data
+        if self._batch_start is None:
+            self._batch_start = self._wrap_carry
+        elif self._batch_start < self.num_data:
+            self._batch_start += self.batch_size
+        # once exhausted, further probes are no-ops: a consumer retrying
+        # next() after StopIteration must not inflate the roll_over carry
+        return self._batch_start < self.num_data
 
     def next(self):
         if self.iter_next():
@@ -169,14 +187,21 @@ class NDArrayIter(DataIter):
                              pad=self.getpad(), index=None)
         raise StopIteration
 
+    def _overhang(self):
+        """Rows by which the current batch sticks out past the data end."""
+        if self._batch_start is None:
+            return 0
+        return max(0, self._batch_start + self.batch_size - self.num_data)
+
     def _getdata(self, data_source):
-        assert self.cursor < self.num_data, "DataIter need reset."
-        if self.cursor + self.batch_size <= self.num_data:
-            return [nd_array(v[self.cursor:self.cursor + self.batch_size])
+        start = self._batch_start
+        assert start is not None and start < self.num_data, \
+            "DataIter need reset."
+        if not self._overhang():
+            return [nd_array(v[start:start + self.batch_size])
                     for _, v in data_source]
-        # padding: wrap around
-        pad = self.batch_size - self.num_data + self.cursor
-        return [nd_array(np.concatenate([v[self.cursor:], v[:pad]], axis=0))
+        rows = np.arange(start, start + self.batch_size)
+        return [nd_array(v.take(rows, axis=0, mode="wrap"))
                 for _, v in data_source]
 
     def getdata(self):
@@ -186,10 +211,7 @@ class NDArrayIter(DataIter):
         return self._getdata(self.label)
 
     def getpad(self):
-        if self.last_batch_handle == "pad" and \
-                self.cursor + self.batch_size > self.num_data:
-            return self.cursor + self.batch_size - self.num_data
-        return 0
+        return self._overhang() if self.last_batch_handle == "pad" else 0
 
 
 class ResizeIter(DataIter):

@@ -287,10 +287,24 @@ class FusedTrainStep:
         rows = batch[self.data_names[0]].shape[0] if self.data_names else None
         res = []
         for o in outs:
-            spec = P("dp") if (o.ndim >= 1 and o.shape[0] == rows) else P()
-            local = mhu.global_array_to_host_local_array(o, self.mesh, spec)
+            local = mhu.global_array_to_host_local_array(
+                o, self.mesh, self._host_spec(o, rows))
             res.append(NDArray(np.asarray(local)))
         return res
+
+    @staticmethod
+    def _host_spec(o, rows):
+        """Batch-major (slice this worker's rows) vs replicated (keep
+        whole), decided from the output's ACTUAL sharding: a replicated
+        output whose leading dim merely coincides with the global batch
+        must not be sliced.  Falls back to the row-count heuristic only
+        when the sharding exposes no named spec."""
+        spec = getattr(getattr(o, "sharding", None), "spec", None)
+        if spec is not None:
+            lead = spec[0] if len(spec) else None
+            names = lead if isinstance(lead, tuple) else (lead,)
+            return P("dp") if "dp" in names else P()
+        return P("dp") if (o.ndim >= 1 and o.shape[0] == rows) else P()
 
     # -- compiled programs ---------------------------------------------------
     def _build_step(self):

@@ -171,7 +171,7 @@ class Module(BaseModule):
         self._fused_state = None
         self._fused_pending = None
         self._fused_outputs = None
-        self._fused_next = None
+        self._discard_speculation()
 
     def _sync_params_from_devices(self):
         if self._fused is not None and self._fused_state is not None:
@@ -270,7 +270,7 @@ class Module(BaseModule):
         # fused state itself is shape-independent and survives)
         self._fused_pending = None
         self._fused_outputs = None
-        self._fused_next = None
+        self._discard_speculation()
         self._data_shapes = list(data_shapes)
         self._label_shapes = list(label_shapes) if label_shapes else None
         self._exec_group = DataParallelExecutorGroup(
@@ -387,7 +387,7 @@ class Module(BaseModule):
         self._fused_state = None
         self._fused_pending = None
         self._fused_outputs = None
-        self._fused_next = None
+        self._discard_speculation()
         if not self._fusable():
             return
         import os
@@ -537,6 +537,16 @@ class Module(BaseModule):
         state_copy = jax.tree_util.tree_map(jnp.copy, self._fused_state)
         self._fused.step(state_copy, pend, self._fused_key)
 
+    def _discard_speculation(self):
+        """Drop a stashed early-committed step WITHOUT applying it, rolling
+        back the optimizer step count _fused_commit_early pre-advanced (an
+        lr scheduler keyed on num_update must not run permanently ahead).
+        Discard-with-replay sites (_disable_fused) do NOT use this: there
+        the batch still commits classically, so the advance stands."""
+        if self._fused_next is not None and self._optimizer is not None:
+            self._optimizer.num_update = self._fused_prev_num_update
+        self._fused_next = None
+
     def _fused_commit_early(self):
         """Run the pending batch's committed step on a COPY of the live
         state: outputs land in _fused_outputs, the post-step state is
@@ -546,8 +556,10 @@ class Module(BaseModule):
         can discard the speculation entirely."""
         import jax
         import jax.numpy as jnp
-        # resolve lr exactly as update() will (monotonic, so a discarded
-        # speculation leaves at most num_update == t+1 early)
+        # resolve lr exactly as update() will; remember the pre-bump count
+        # so a discarded speculation can put it back (an lr scheduler keyed
+        # on num_update must not fire a step early)
+        self._fused_prev_num_update = self._optimizer.num_update
         self._optimizer.num_update = max(self._optimizer.num_update,
                                          self._fused_t + 1)
         state_copy = jax.tree_util.tree_map(jnp.copy, self._fused_state)
@@ -587,8 +599,9 @@ class Module(BaseModule):
                 self._fused_eval_local = False
                 # a stashed early commit belongs to the superseded batch;
                 # dropping it leaves params untouched (the speculative
-                # step ran on a copy), which is exactly eval semantics
-                self._fused_next = None
+                # step ran on a copy), which is exactly eval semantics —
+                # including the optimizer step count it pre-advanced
+                self._discard_speculation()
                 return
             if self._fused_state is not None:
                 if self._fused._multiprocess():
@@ -719,10 +732,14 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and self.inputs_need_grad
         grads = self._exec_group.get_input_grads(
             merge_multi_context=merge_multi_context)
-        # grad-only flows (backward without an optimizer/update) have now
-        # consumed the gradients: release the pending flag or bucketing
-        # prepare() would stay locked out with no update() to clear it
-        self._grads_pending = False
+        # grad-only flows (backward with no optimizer to ever call
+        # update()) have now consumed the gradients: release the pending
+        # flag or bucketing prepare() would stay locked out.  With an
+        # optimizer initialized the PARAM gradients are still live until
+        # update() runs (GAN-style flows read input grads first), so the
+        # flag must hold.
+        if not self.optimizer_initialized:
+            self._grads_pending = False
         return grads
 
     def update_metric(self, eval_metric, labels):

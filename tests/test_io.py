@@ -266,3 +266,23 @@ def test_image_record_iter_pad_crop(tmp_path):
                                batch_size=1, pad=2)
     out = next(iter(it)).data[0].asnumpy()[0]
     assert np.allclose(out, img.astype(np.float32), atol=2.0)
+
+
+def test_ndarrayiter_rollover_tolerates_extra_probes():
+    """A consumer retrying next() after StopIteration must not inflate
+    the roll_over carry: the next epoch starts exactly past the rows the
+    wrapped batch consumed, however many times the end was probed."""
+    import numpy as np
+    X = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = mx.io.NDArrayIter(X, np.arange(10, dtype=np.float32),
+                           batch_size=4, last_batch_handle="roll_over")
+    rows_ep1 = [b.data[0].asnumpy().ravel().tolist() for b in it]
+    assert rows_ep1[-1] == [8.0, 9.0, 0.0, 1.0]
+    for _ in range(3):   # extra drains after exhaustion
+        try:
+            it.next()
+        except StopIteration:
+            pass
+    it.reset()
+    first = it.next().data[0].asnumpy().ravel().tolist()
+    assert first == [2.0, 3.0, 4.0, 5.0], first

@@ -578,3 +578,66 @@ def test_no_slice_names_mark_coincident_batch_dim():
              no_slice_names=("rois",))
     (slc, _), = mod._exec_group.data_arrays[0]
     assert (slc.start, slc.stop) == (0, B)
+
+
+def test_input_grads_do_not_release_pending_param_grads():
+    """GAN-style flow: read input grads, THEN update().  The input-grad
+    read must not release the backward-to-update guard while an optimizer
+    still owns the pending param gradients (a bucketing prepare() in that
+    window could clobber them)."""
+    np.random.seed(3)
+    mx.random.seed(3)
+    X, y = make_blobs(n=40)
+    it = mx.io.NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(mlp_sym(), context=mx.current_context())
+    mod.bind(it.provide_data, it.provide_label, inputs_need_grad=True)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    b = next(iter(it))
+    mod.forward(b, is_train=True)
+    mod.backward()
+    assert mod._grads_pending
+    g = mod.get_input_grads()
+    assert g[0].shape == X.shape
+    assert mod._grads_pending, \
+        "input-grad read released the guard with an optimizer live"
+    mod.update()
+    assert not mod._grads_pending
+
+    # grad-only flow (no optimizer): the read IS the consumer and must
+    # release the guard, as before
+    mod2 = mx.mod.Module(mlp_sym(), context=mx.current_context())
+    mod2.bind(it.provide_data, it.provide_label, inputs_need_grad=True)
+    mod2.init_params()
+    mod2.forward(b, is_train=True)
+    mod2.backward()
+    mod2.get_input_grads()
+    assert not mod2._grads_pending
+
+
+def test_discarded_speculation_restores_num_update():
+    """forward(); get_outputs(); forward() — the early-committed step of
+    the first batch is discarded, so the optimizer's step count must roll
+    back or an lr scheduler keyed on num_update fires one step early."""
+    np.random.seed(4)
+    mx.random.seed(4)
+    X, y = make_blobs(n=80)
+    it = mx.io.NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(mlp_sym(), context=mx.current_context())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    if mod._fused is None:
+        pytest.skip("fused train path not engaged")
+    batches = list(it)
+    mod.forward(batches[0], is_train=True)
+    before = mod._optimizer.num_update
+    mod.get_outputs()          # speculative early commit bumps the count
+    assert mod._fused_next is not None
+    assert mod._optimizer.num_update == before + 1
+    mod.forward(batches[1], is_train=True)   # discards the speculation
+    assert mod._fused_next is None
+    assert mod._optimizer.num_update == before, \
+        "discarded speculation left num_update one ahead"
+    mod.update()               # commits batch 1 as the real step 1
+    assert mod._optimizer.num_update == before + 1
