@@ -1,0 +1,29 @@
+// Scala/JVM binding build. Requires a JDK (javac for JNI headers) and
+// sbt; this image ships neither, so CI proves the JNI layer JVM-free
+// instead (tests/cpp/test_jni_glue.cc under the mocked
+// tests/cpp/jniheaders/jni.h). With a JVM present:
+//
+//   1. build the native glue:
+//        g++ -O2 -std=c++14 -fPIC -shared \
+//            -I$JAVA_HOME/include -I$JAVA_HOME/include/linux \
+//            native/src/main/native/mxnet_tpu_jni.cc \
+//            -o native/libmxnet_tpu_jni.so -ldl
+//   2. sbt test   (with -Djava.library.path=native and
+//                  MXNET_TPU_LIBRARY=/path/to/libmxtpu_capi.so)
+name := "mxnet-tpu-core"
+
+organization := "ml.dmlc"
+
+version := "0.1.0-SNAPSHOT"
+
+scalaVersion := "2.12.18"
+
+Compile / scalaSource := baseDirectory.value / "core" / "src" / "main" / "scala"
+
+Test / scalaSource := baseDirectory.value / "core" / "src" / "test" / "scala"
+
+libraryDependencies += "org.scalatest" %% "scalatest" % "3.0.8" % Test
+
+Test / fork := true
+
+Test / javaOptions += s"-Djava.library.path=${baseDirectory.value / "native"}"

@@ -1,0 +1,38 @@
+package ml.dmlc.mxnet_tpu
+
+/**
+ * Handle types, the resolved native library, and the error protocol.
+ * Reference counterpart: scala-package/core/.../Base.scala — here handles
+ * are plain Longs over the flat-array JNI surface (see
+ * native/src/main/native/mxnet_tpu_jni.cc) instead of wrapper classes fed
+ * by per-element JNI callbacks.
+ */
+object Base {
+  type NDArrayHandle = Long
+  type FunctionHandle = Long
+  type SymbolHandle = Long
+  type ExecutorHandle = Long
+  type KVStoreHandle = Long
+  type OptimizerHandle = Long
+
+  class MXNetError(val message: String) extends Exception(message)
+
+  private[mxnet_tpu] val _LIB = new LibInfo
+
+  {
+    // so files are searched next to the loaded jni library; the path to
+    // libmxtpu_capi.so comes from MXNET_TPU_LIBRARY or the default layout
+    val lib = sys.env.getOrElse("MXNET_TPU_LIBRARY",
+      "mxnet_tpu/libmxtpu_capi.so")
+    System.loadLibrary("mxnet_tpu_jni")
+    checkCall(_LIB.nativeLibInit(lib))
+  }
+
+  def checkCall(ret: Int): Unit = {
+    if (ret != 0) {
+      throw new MXNetError(_LIB.mxGetLastError())
+    }
+  }
+
+  def notifyShutdown(): Unit = checkCall(_LIB.mxNotifyShutdown())
+}

@@ -1,0 +1,41 @@
+package ml.dmlc.mxnet_tpu
+
+/** Training callbacks (reference Callback.scala). */
+object Callback {
+
+  trait BatchEndCallback {
+    def invoke(epoch: Int, nBatch: Int, evalMetric: EvalMetric): Unit
+  }
+
+  trait EpochEndCallback {
+    def invoke(epoch: Int, symbol: Symbol,
+               argParams: Map[String, NDArray],
+               auxParams: Map[String, NDArray]): Unit
+  }
+
+  class Speedometer(batchSize: Int, frequent: Int = 50)
+      extends BatchEndCallback {
+    private var init = false
+    private var tic = 0L
+    private var lastCount = 0
+
+    override def invoke(epoch: Int, count: Int,
+                        metric: EvalMetric): Unit = {
+      if (lastCount > count) init = false
+      lastCount = count
+      if (init) {
+        if (count % frequent == 0) {
+          val speed = frequent.toDouble * batchSize /
+            ((System.currentTimeMillis() - tic) / 1000.0)
+          val (name, value) = metric.get
+          printf("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s=%f\n",
+                 epoch, count, speed, name, value)
+          tic = System.currentTimeMillis()
+        }
+      } else {
+        init = true
+        tic = System.currentTimeMillis()
+      }
+    }
+  }
+}

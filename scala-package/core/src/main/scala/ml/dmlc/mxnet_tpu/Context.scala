@@ -1,0 +1,34 @@
+package ml.dmlc.mxnet_tpu
+
+/**
+ * Execution device (reference Context.scala).  devtype 1 = cpu, 2 = tpu:
+ * the accelerator slot the reference reserved for gpu is the TPU mesh
+ * position here (mxnet_tpu/context.py).
+ */
+class Context(val deviceType: String, val deviceId: Int = 0)
+    extends Serializable {
+  val deviceTypeid: Int = Context.devstr2type(deviceType)
+
+  def withScope[T](body: => T): T = {
+    val old = Context._default.get()
+    Context._default.set(this)
+    try body finally Context._default.set(old)
+  }
+
+  override def equals(o: Any): Boolean = o match {
+    case c: Context => c.deviceTypeid == deviceTypeid && c.deviceId == deviceId
+    case _ => false
+  }
+  override def hashCode(): Int = deviceTypeid * 131 + deviceId
+  override def toString: String = s"$deviceType($deviceId)"
+}
+
+object Context {
+  private val devstr2type = Map("cpu" -> 1, "tpu" -> 2, "gpu" -> 2)
+  private[mxnet_tpu] val _default =
+    new ThreadLocal[Context] { override def initialValue(): Context = cpu() }
+
+  def cpu(deviceId: Int = 0): Context = new Context("cpu", deviceId)
+  def tpu(deviceId: Int = 0): Context = new Context("tpu", deviceId)
+  def defaultCtx: Context = _default.get()
+}

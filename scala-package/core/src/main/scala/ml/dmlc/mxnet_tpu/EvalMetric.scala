@@ -1,0 +1,50 @@
+package ml.dmlc.mxnet_tpu
+
+/** Evaluation metrics (reference EvalMetric.scala). */
+abstract class EvalMetric(val name: String) {
+  protected var sumMetric: Double = 0.0
+  protected var numInst: Int = 0
+
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray]): Unit
+
+  def reset(): Unit = {
+    sumMetric = 0.0
+    numInst = 0
+  }
+
+  def get: (String, Float) =
+    (name, if (numInst == 0) Float.NaN else (sumMetric / numInst).toFloat)
+}
+
+class Accuracy extends EvalMetric("accuracy") {
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray])
+      : Unit = {
+    require(labels.length == preds.length)
+    for ((label, pred) <- labels.zip(preds)) {
+      val probs = pred.toArray
+      val y = label.toArray
+      val classes = pred.shape(1)
+      for (i <- y.indices) {
+        var arg = 0
+        var best = probs(i * classes)
+        for (c <- 1 until classes) {
+          if (probs(i * classes + c) > best) { best = probs(i * classes + c); arg = c }
+        }
+        if (arg == y(i).toInt) sumMetric += 1
+        numInst += 1
+      }
+    }
+  }
+}
+
+class MAE extends EvalMetric("mae") {
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray])
+      : Unit = {
+    for ((label, pred) <- labels.zip(preds)) {
+      val y = label.toArray
+      val p = pred.toArray
+      sumMetric += y.zip(p).map { case (a, b) => math.abs(a - b) }.sum
+      numInst += y.length
+    }
+  }
+}

@@ -1,0 +1,45 @@
+package ml.dmlc.mxnet_tpu
+
+import ml.dmlc.mxnet_tpu.Base._
+
+/**
+ * Bound computation graph (reference Executor.scala): owns the arg /
+ * grad / aux arrays it was bound with; forward/backward run the jitted
+ * program behind MXExecutorForward/Backward.
+ */
+class Executor private[mxnet_tpu](
+    private[mxnet_tpu] val handle: ExecutorHandle,
+    val symbol: Symbol,
+    val argArrays: IndexedSeq[NDArray],
+    val gradArrays: IndexedSeq[NDArray],
+    val auxArrays: IndexedSeq[NDArray]) {
+
+  lazy val argDict: Map[String, NDArray] =
+    symbol.listArguments().zip(argArrays).toMap
+  lazy val gradDict: Map[String, NDArray] =
+    symbol.listArguments().zip(gradArrays).filter(_._2 != null).toMap
+
+  def forward(isTrain: Boolean = false): Unit =
+    checkCall(_LIB.mxExecutorForward(handle, if (isTrain) 1 else 0))
+
+  def backward(headGrads: IndexedSeq[NDArray] = IndexedSeq.empty): Unit =
+    checkCall(_LIB.mxExecutorBackward(handle,
+                                      headGrads.map(_.handle).toArray))
+
+  def outputs: IndexedSeq[NDArray] = {
+    val hs = _LIB.mxExecutorOutputs(handle)
+    require(hs != null, _LIB.mxGetLastError())
+    hs.map(new NDArray(_, writable = false)).toIndexedSeq
+  }
+
+  def dispose(): Unit = checkCall(_LIB.mxExecutorFree(handle))
+}
+
+object Executor {
+  def gradReqCode(req: String): Int = req match {
+    case "null" => 0
+    case "write" => 1
+    case "add" => 3
+    case other => throw new MXNetError(s"unknown grad req $other")
+  }
+}

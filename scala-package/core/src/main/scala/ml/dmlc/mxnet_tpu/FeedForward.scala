@@ -1,0 +1,184 @@
+package ml.dmlc.mxnet_tpu
+
+import ml.dmlc.mxnet_tpu.Base._
+
+/**
+ * User-facing training model (reference FeedForward.scala + Model.scala):
+ * bind once, then per batch forward/backward and a native optimizer step
+ * per parameter — the identical loop tests/cpp/test_jni_glue.cc proves
+ * end-to-end through this binding's JNI layer.
+ */
+class FeedForward(val symbol: Symbol, val ctx: Context = Context.cpu(),
+                  numEpoch: Int = 10, optimizer: Optimizer = SGD(),
+                  initializer: Initializer = new Uniform(0.07f),
+                  batchEndCallback: Option[Callback.BatchEndCallback] = None,
+                  epochEndCallback: Option[Callback.EpochEndCallback] = None,
+                  group2ctx: Map[String, Context] = Map.empty) {
+
+  private var executor: Executor = _
+  private var argNames: IndexedSeq[String] = _
+  private var auxNames: IndexedSeq[String] = _
+  private var paramIdx: IndexedSeq[Int] = _
+  private var dataIdx: Int = -1
+  private var labelIdx: Int = -1
+
+  def argParams: Map[String, NDArray] =
+    paramIdx.map(i => argNames(i) -> executor.argArrays(i)).toMap
+
+  def auxParams: Map[String, NDArray] =
+    auxNames.zip(executor.auxArrays).toMap
+
+  /** Bind and initialize; `params`/`aux` (e.g. a loaded checkpoint)
+   * override the initializer per matching name. */
+  def init(provideData: Map[String, Shape], provideLabel: Map[String, Shape],
+           params: Map[String, NDArray] = Map.empty,
+           aux: Map[String, NDArray] = Map.empty): Unit = {
+    if (executor != null) return
+    argNames = symbol.listArguments()
+    auxNames = symbol.listAuxiliaryStates()
+    val known = provideData ++ provideLabel
+    val (argShapes, _, auxShapes) = symbol.inferShape(known)
+    require(argShapes.nonEmpty, "shape inference incomplete")
+    val args = argNames.zip(argShapes).map { case (name, s) =>
+      val arr = NDArray.zeros(s, ctx)
+      if (!known.contains(name)) {
+        params.get(name) match {
+          case Some(p) => p.copyTo(arr)
+          case None => initializer(name, arr)
+        }
+      }
+      arr
+    }
+    val grads = argNames.zip(argShapes).map { case (name, s) =>
+      if (known.contains(name)) null.asInstanceOf[NDArray]
+      else NDArray.zeros(s, ctx)
+    }
+    val reqs = argNames.map(n => if (known.contains(n)) 0 else 1)
+    val auxArrs = auxNames.zip(auxShapes).map { case (name, s) =>
+      val arr = NDArray.zeros(s, ctx)
+      aux.get(name) match {
+        case Some(p) => p.copyTo(arr)
+        case None => initializer(name, arr)
+      }
+      arr
+    }
+    executor = symbol.bind(ctx, args, grads, reqs, auxArrs, group2ctx)
+    paramIdx = argNames.indices.filter(i => !known.contains(argNames(i)))
+    dataIdx = argNames.indexWhere(provideData.contains)
+    labelIdx = argNames.indexWhere(provideLabel.contains)
+  }
+
+  private def requireBound(): Unit =
+    require(executor != null,
+            "model not bound: call fit() or init(provideData, provideLabel)")
+
+  /** Metric update that honors the final wrapped batch: the last `pad`
+   * rows are duplicates and must not be scored. */
+  private def updateMetric(metric: EvalMetric, batch: DataBatch): Unit = {
+    val outs = executor.outputs
+    if (batch.pad == 0) {
+      metric.update(batch.label, outs)
+    } else {
+      val keep = batch.label.head.shape(0) - batch.pad
+      metric.update(IndexedSeq(batch.label.head.slice(0, keep)),
+                    IndexedSeq(outs.head.slice(0, keep)))
+    }
+  }
+
+  def fit(trainData: DataIter, evalData: Option[DataIter] = None,
+          evalMetric: EvalMetric = new Accuracy): Unit = {
+    init(trainData.provideData, trainData.provideLabel)
+    // loss-head gradients are batch-summed; unless the caller pinned a
+    // rescale, normalize like the python FeedForward does
+    if (!optimizer.hasParam("rescale_grad")) {
+      optimizer.setParam("rescale_grad",
+                         (1.0f / trainData.batchSize).toString)
+    }
+    for (epoch <- 0 until numEpoch) {
+      trainData.reset()
+      evalMetric.reset()
+      var nBatch = 0
+      while (trainData.hasNext) {
+        val batch = trainData.next()
+        batch.data.head.copyTo(executor.argArrays(dataIdx))
+        batch.label.head.copyTo(executor.argArrays(labelIdx))
+        executor.forward(isTrain = true)
+        executor.backward()
+        for (i <- paramIdx) {
+          optimizer.update(i, executor.argArrays(i), executor.gradArrays(i))
+        }
+        updateMetric(evalMetric, batch)
+        nBatch += 1
+        batchEndCallback.foreach(_.invoke(epoch, nBatch, evalMetric))
+      }
+      val (name, value) = evalMetric.get
+      printf("Epoch[%d] Train-%s=%f\n", epoch, name, value)
+      evalData.foreach { ed =>
+        val (n, v) = score(ed)
+        printf("Epoch[%d] Validation-%s=%f\n", epoch, n, v)
+      }
+      epochEndCallback.foreach(_.invoke(epoch, symbol, argParams, auxParams))
+    }
+  }
+
+  def score(evalData: DataIter,
+            evalMetric: EvalMetric = new Accuracy): (String, Float) = {
+    requireBound()
+    evalData.reset()
+    evalMetric.reset()
+    while (evalData.hasNext) {
+      val batch = evalData.next()
+      batch.data.head.copyTo(executor.argArrays(dataIdx))
+      executor.forward(isTrain = false)
+      updateMetric(evalMetric, batch)
+    }
+    evalMetric.get
+  }
+
+  /** Per-batch output rows, padded duplicates of the final wrapped batch
+   * dropped. */
+  def predict(evalData: DataIter): IndexedSeq[Array[Float]] = {
+    requireBound()
+    evalData.reset()
+    val out = scala.collection.mutable.ArrayBuffer.empty[Array[Float]]
+    while (evalData.hasNext) {
+      val batch = evalData.next()
+      batch.data.head.copyTo(executor.argArrays(dataIdx))
+      executor.forward(isTrain = false)
+      val head = executor.outputs.head
+      val arr = if (batch.pad == 0) head
+                else head.slice(0, head.shape(0) - batch.pad)
+      out += arr.toArray
+    }
+    out.toIndexedSeq
+  }
+
+  /** Checkpoint: symbol json + params blob with arg:/aux: prefixes, the
+   * cross-binding format the python/R/C++/MATLAB surfaces read
+   * (mxnet_tpu/model.py). */
+  def save(prefix: String, epoch: Int): Unit = {
+    requireBound()
+    val json = symbol.toJson
+    val w = new java.io.PrintWriter(s"$prefix-symbol.json")
+    try w.write(json) finally w.close()
+    val named = argParams.map { case (k, v) => s"arg:$k" -> v } ++
+      auxParams.map { case (k, v) => s"aux:$k" -> v }
+    NDArray.save(f"$prefix%s-$epoch%04d.params", named)
+  }
+}
+
+object FeedForward {
+  /** (symbol, argParams, auxParams) from a cross-binding checkpoint;
+   * feed them to init() to get a scoring-ready model. */
+  def load(prefix: String, epoch: Int, ctx: Context = Context.cpu())
+      : (Symbol, Map[String, NDArray], Map[String, NDArray]) = {
+    val json = scala.io.Source.fromFile(s"$prefix-symbol.json").mkString
+    val sym = Symbol.loadJson(json)
+    val all = NDArray.load(f"$prefix%s-$epoch%04d.params")
+    val arg = all.collect { case (k, v) if k.startsWith("arg:") =>
+      k.stripPrefix("arg:") -> v }
+    val aux = all.collect { case (k, v) if k.startsWith("aux:") =>
+      k.stripPrefix("aux:") -> v }
+    (sym, arg, aux)
+  }
+}

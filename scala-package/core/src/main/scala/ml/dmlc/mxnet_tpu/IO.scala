@@ -1,0 +1,47 @@
+package ml.dmlc.mxnet_tpu
+
+/** Data iteration (reference IO.scala): DataBatch/DataIter protocol plus
+ * the in-memory NDArrayIter with pad semantics. */
+case class DataBatch(data: IndexedSeq[NDArray], label: IndexedSeq[NDArray],
+                     pad: Int)
+
+abstract class DataIter extends Iterator[DataBatch] {
+  def reset(): Unit
+  def batchSize: Int
+  def provideData: Map[String, Shape]
+  def provideLabel: Map[String, Shape]
+}
+
+/** In-memory iterator over host arrays; last partial batch wraps with a
+ * recorded pad count (mxnet_tpu/io.py NDArrayIter semantics). */
+class NDArrayIter(data: Array[Float], label: Array[Float],
+                  numData: Int, dim: Int, val batchSize: Int,
+                  dataName: String = "data",
+                  labelName: String = "softmax_label",
+                  ctx: Context = Context.cpu()) extends DataIter {
+  require(numData >= batchSize, "batchSize larger than data")
+  private var start = 0
+  private val dataArr = NDArray.empty(Shape(batchSize, dim), ctx)
+  private val labelArr = NDArray.empty(Shape(batchSize), ctx)
+
+  def provideData: Map[String, Shape] =
+    Map(dataName -> Shape(batchSize, dim))
+  def provideLabel: Map[String, Shape] = Map(labelName -> Shape(batchSize))
+
+  def reset(): Unit = start = 0
+
+  def hasNext: Boolean = start < numData
+
+  def next(): DataBatch = {
+    val xb = new Array[Float](batchSize * dim)
+    val yb = new Array[Float](batchSize)
+    for (i <- 0 until batchSize) {
+      val src = (start + i) % numData   // wrap the final partial batch
+      System.arraycopy(data, src * dim, xb, i * dim, dim)
+      yb(i) = label(src)
+    }
+    val pad = math.max(0, start + batchSize - numData)
+    start += batchSize
+    DataBatch(IndexedSeq(dataArr.set(xb)), IndexedSeq(labelArr.set(yb)), pad)
+  }
+}

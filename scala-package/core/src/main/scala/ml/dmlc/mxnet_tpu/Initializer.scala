@@ -1,0 +1,58 @@
+package ml.dmlc.mxnet_tpu
+
+/** Weight initializers (reference Initializer.scala): name-pattern rules
+ * shared by every binding — bias/beta/moving_mean zero, gamma/moving_var
+ * one, weights by the concrete scheme. */
+abstract class Initializer {
+  def apply(name: String, arr: NDArray): Unit = {
+    if (name.endsWith("bias") || name.endsWith("beta") ||
+        name.endsWith("moving_mean")) {
+      arr.set(0f)
+    } else if (name.endsWith("gamma") || name.endsWith("moving_var")) {
+      arr.set(1f)
+    } else {
+      initWeight(name, arr)
+    }
+  }
+
+  protected def initWeight(name: String, arr: NDArray): Unit
+}
+
+class Uniform(scale: Float = 0.07f) extends Initializer {
+  protected def initWeight(name: String, arr: NDArray): Unit = {
+    val rnd = new scala.util.Random(name.hashCode)
+    arr.set(Array.fill(arr.size)((rnd.nextFloat() * 2 - 1) * scale))
+  }
+}
+
+class Normal(sigma: Float = 0.01f) extends Initializer {
+  protected def initWeight(name: String, arr: NDArray): Unit = {
+    val rnd = new scala.util.Random(name.hashCode)
+    arr.set(Array.fill(arr.size)(rnd.nextGaussian().toFloat * sigma))
+  }
+}
+
+/** Xavier/Glorot: scale by fan-in/fan-out (reference Initializer.scala). */
+class Xavier(rndType: String = "uniform", factorType: String = "avg",
+             magnitude: Float = 3f) extends Initializer {
+  protected def initWeight(name: String, arr: NDArray): Unit = {
+    val shape = arr.shape
+    val fanOut = shape(0).toFloat
+    val fanIn = shape.drop(1).product.toFloat
+    val factor = factorType match {
+      case "avg" => (fanIn + fanOut) / 2f
+      case "in" => fanIn
+      case "out" => fanOut
+      case other => throw new Base.MXNetError(s"bad factor_type $other")
+    }
+    val scale = math.sqrt(magnitude / factor).toFloat
+    val rnd = new scala.util.Random(name.hashCode)
+    rndType match {
+      case "uniform" =>
+        arr.set(Array.fill(arr.size)((rnd.nextFloat() * 2 - 1) * scale))
+      case "gaussian" =>
+        arr.set(Array.fill(arr.size)(rnd.nextGaussian().toFloat * scale))
+      case other => throw new Base.MXNetError(s"bad rnd_type $other")
+    }
+  }
+}

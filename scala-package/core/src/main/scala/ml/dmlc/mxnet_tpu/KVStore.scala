@@ -1,0 +1,52 @@
+package ml.dmlc.mxnet_tpu
+
+import ml.dmlc.mxnet_tpu.Base._
+
+/** Key-value store over the ABI (reference KVStore.scala): local for
+ * single-process aggregation; dist_sync/dist_async ride the same entry
+ * points when launched under tools/launch.py. */
+class KVStore private[mxnet_tpu](private val handle: KVStoreHandle) {
+
+  def init(keys: Array[Int], values: Array[NDArray]): Unit =
+    checkCall(_LIB.mxKVStoreInit(handle, keys, values.map(_.handle)))
+
+  def push(keys: Array[Int], values: Array[NDArray],
+           priority: Int = 0): Unit =
+    checkCall(_LIB.mxKVStorePush(handle, keys, values.map(_.handle),
+                                 priority))
+
+  def pull(keys: Array[Int], outs: Array[NDArray],
+           priority: Int = 0): Unit =
+    checkCall(_LIB.mxKVStorePull(handle, keys, outs.map(_.handle),
+                                 priority))
+
+  def `type`: String = {
+    val t = _LIB.mxKVStoreGetType(handle)
+    require(t != null, _LIB.mxGetLastError())
+    t
+  }
+
+  def rank: Int = {
+    val out = new Array[Int](1)
+    checkCall(_LIB.mxKVStoreGetRank(handle, out))
+    out(0)
+  }
+
+  def numWorkers: Int = {
+    val out = new Array[Int](1)
+    checkCall(_LIB.mxKVStoreGetGroupSize(handle, out))
+    out(0)
+  }
+
+  def barrier(): Unit = checkCall(_LIB.mxKVStoreBarrier(handle))
+
+  def dispose(): Unit = checkCall(_LIB.mxKVStoreFree(handle))
+}
+
+object KVStore {
+  def create(kvType: String = "local"): KVStore = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxKVStoreCreate(kvType, out))
+    new KVStore(out(0))
+  }
+}

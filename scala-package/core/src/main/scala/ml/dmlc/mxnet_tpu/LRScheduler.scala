@@ -1,0 +1,23 @@
+package ml.dmlc.mxnet_tpu
+
+/** Learning-rate schedules keyed on the update count
+ * (reference LRScheduler.scala). */
+abstract class LRScheduler(var baseLR: Float = 0.01f) {
+  def apply(numUpdate: Int): Float
+}
+
+class FactorScheduler(step: Int, factor: Float) extends LRScheduler {
+  require(step >= 1, "step must be at least 1")
+  require(factor < 1f, "factor must decay")
+  private var count = 0
+  private var decay = 1f   // baseLR is owned by the optimizer and may be
+                           // assigned after construction: never snapshot it
+
+  def apply(numUpdate: Int): Float = {
+    if (numUpdate > count + step) {
+      count += step
+      decay *= factor
+    }
+    baseLR * decay
+  }
+}

@@ -1,0 +1,113 @@
+package ml.dmlc.mxnet_tpu
+
+/**
+ * JNI natives. One-to-one with
+ * native/src/main/native/mxnet_tpu_jni.cc: flat primitive arrays in and
+ * out (a single JNI crossing per ABI call), out-handles in a
+ * caller-allocated Array[Long](1), rc passed through (0 ok / -1 error
+ * with the message in mxGetLastError).  The same surface is executed
+ * JVM-free under tests/cpp/jniheaders/jni.h by tests/cpp/test_jni_glue.cc.
+ */
+class LibInfo {
+  @native def nativeLibInit(libPath: String): Int
+  @native def mxGetLastError(): String
+  @native def mxRandomSeed(seed: Int): Int
+  @native def mxNotifyShutdown(): Int
+
+  // NDArray
+  @native def mxNDArrayCreateEx(shape: Array[Int], devType: Int, devId: Int,
+                                delayAlloc: Int, dtype: Int,
+                                out: Array[Long]): Int
+  @native def mxNDArrayCreateNone(out: Array[Long]): Int
+  @native def mxNDArrayFree(handle: Long): Int
+  @native def mxNDArrayWaitAll(): Int
+  @native def mxNDArrayWaitToRead(handle: Long): Int
+  @native def mxNDArraySyncCopyFromCPU(handle: Long, source: Array[Float],
+                                       size: Int): Int
+  @native def mxNDArraySyncCopyToCPU(handle: Long, dest: Array[Float],
+                                     size: Int): Int
+  @native def mxNDArrayGetShape(handle: Long): Array[Int]
+  @native def mxNDArrayGetContext(handle: Long, devTypeId: Array[Int]): Int
+  @native def mxNDArraySlice(handle: Long, begin: Int, end: Int,
+                             out: Array[Long]): Int
+  @native def mxNDArrayAt(handle: Long, idx: Int, out: Array[Long]): Int
+  @native def mxNDArrayReshape(handle: Long, dims: Array[Int],
+                               out: Array[Long]): Int
+  @native def mxNDArraySave(fname: String, handles: Array[Long],
+                            keys: Array[String]): Int
+  // out2(0) <- Array[Long] handles, out2(1) <- Array[String] names
+  @native def mxNDArrayLoad(fname: String, out2: Array[AnyRef]): Int
+
+  // function registry
+  @native def mxListFunctions(): Array[Long]
+  @native def mxFuncGetName(handle: Long): String
+  @native def mxFuncDescribe(handle: Long, out4: Array[Int]): Int
+  @native def mxFuncInvoke(fn: Long, useVars: Array[Long],
+                           scalars: Array[Float],
+                           mutateVars: Array[Long]): Int
+
+  // symbol
+  @native def mxSymbolListAtomicSymbolCreators(): Array[Long]
+  @native def mxSymbolGetAtomicSymbolName(creator: Long): String
+  @native def mxSymbolCreateAtomicSymbol(creator: Long, keys: Array[String],
+                                         vals: Array[String],
+                                         out: Array[Long]): Int
+  @native def mxSymbolCreateVariable(name: String, out: Array[Long]): Int
+  @native def mxSymbolCreateGroup(symbols: Array[Long],
+                                  out: Array[Long]): Int
+  @native def mxSymbolCreateFromJSON(json: String, out: Array[Long]): Int
+  @native def mxSymbolSaveToJSON(handle: Long): String
+  @native def mxSymbolFree(handle: Long): Int
+  @native def mxSymbolCopy(handle: Long, out: Array[Long]): Int
+  @native def mxSymbolCompose(handle: Long, name: String,
+                              keys: Array[String], args: Array[Long]): Int
+  @native def mxSymbolListArguments(handle: Long): Array[String]
+  @native def mxSymbolListOutputs(handle: Long): Array[String]
+  @native def mxSymbolListAuxiliaryStates(handle: Long): Array[String]
+  @native def mxSymbolSetAttr(handle: Long, key: String, value: String): Int
+  @native def mxSymbolGetAttr(handle: Long, key: String): String
+  @native def mxSymbolGetInternals(handle: Long, out: Array[Long]): Int
+  @native def mxSymbolGetOutput(handle: Long, index: Int,
+                                out: Array[Long]): Int
+  // out3 <- [argShapes, outShapes, auxShapes]: Array[Array[Int]] each
+  @native def mxSymbolInferShape(handle: Long, keys: Array[String],
+                                 shapes: Array[AnyRef],
+                                 out3: Array[AnyRef],
+                                 complete: Array[Int]): Int
+
+  // executor
+  @native def mxExecutorBindX(sym: Long, devType: Int, devId: Int,
+                              mapKeys: Array[String],
+                              mapDevTypes: Array[Int],
+                              mapDevIds: Array[Int], inArgs: Array[Long],
+                              argGrads: Array[Long], gradReqs: Array[Int],
+                              auxStates: Array[Long],
+                              out: Array[Long]): Int
+  @native def mxExecutorForward(handle: Long, isTrain: Int): Int
+  @native def mxExecutorBackward(handle: Long, headGrads: Array[Long]): Int
+  @native def mxExecutorOutputs(handle: Long): Array[Long]
+  @native def mxExecutorFree(handle: Long): Int
+
+  // optimizer
+  @native def mxOptimizerFindCreator(name: String, out: Array[Long]): Int
+  @native def mxOptimizerCreateOptimizer(creator: Long, keys: Array[String],
+                                         vals: Array[String],
+                                         out: Array[Long]): Int
+  @native def mxOptimizerUpdate(handle: Long, index: Int, weight: Long,
+                                grad: Long, lr: Float, wd: Float): Int
+  @native def mxOptimizerFree(handle: Long): Int
+
+  // kvstore
+  @native def mxKVStoreCreate(kvType: String, out: Array[Long]): Int
+  @native def mxKVStoreFree(handle: Long): Int
+  @native def mxKVStoreInit(handle: Long, keys: Array[Int],
+                            vals: Array[Long]): Int
+  @native def mxKVStorePush(handle: Long, keys: Array[Int],
+                            vals: Array[Long], priority: Int): Int
+  @native def mxKVStorePull(handle: Long, keys: Array[Int],
+                            vals: Array[Long], priority: Int): Int
+  @native def mxKVStoreGetType(handle: Long): String
+  @native def mxKVStoreGetRank(handle: Long, out: Array[Int]): Int
+  @native def mxKVStoreGetGroupSize(handle: Long, out: Array[Int]): Int
+  @native def mxKVStoreBarrier(handle: Long): Int
+}

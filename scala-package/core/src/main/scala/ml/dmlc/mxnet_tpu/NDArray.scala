@@ -1,0 +1,160 @@
+package ml.dmlc.mxnet_tpu
+
+import ml.dmlc.mxnet_tpu.Base._
+
+/**
+ * Imperative n-dimensional array over the C ABI (reference NDArray.scala).
+ * Arithmetic dispatches through the registered function table
+ * (MXListFunctions / MXFuncInvoke), the same registry the R and C++
+ * bindings drive; data moves as flat float arrays in one JNI crossing.
+ */
+class NDArray private[mxnet_tpu](private[mxnet_tpu] val handle: NDArrayHandle,
+                                 val writable: Boolean = true)
+    extends Serializable {
+
+  def shape: Shape = {
+    val s = _LIB.mxNDArrayGetShape(handle)
+    require(s != null, _LIB.mxGetLastError())
+    Shape(s.toSeq)
+  }
+
+  def size: Int = shape.product
+
+  def context: Context = {
+    val out = new Array[Int](2)
+    checkCall(_LIB.mxNDArrayGetContext(handle, out))
+    new Context(if (out(0) == 1) "cpu" else "tpu", out(1))
+  }
+
+  def toArray: Array[Float] = {
+    val data = new Array[Float](size)
+    checkCall(_LIB.mxNDArraySyncCopyToCPU(handle, data, data.length))
+    data
+  }
+
+  def toScalar: Float = {
+    require(size == 1, "array is not a scalar")
+    toArray(0)
+  }
+
+  def set(values: Array[Float]): NDArray = {
+    require(writable, "array is not writable")
+    checkCall(_LIB.mxNDArraySyncCopyFromCPU(handle, values, values.length))
+    this
+  }
+
+  def set(value: Float): NDArray = set(Array.fill(size)(value))
+
+  def slice(begin: Int, end: Int): NDArray = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxNDArraySlice(handle, begin, end, out))
+    new NDArray(out(0), writable)
+  }
+
+  def at(idx: Int): NDArray = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxNDArrayAt(handle, idx, out))
+    new NDArray(out(0), writable)
+  }
+
+  def reshape(dims: Shape): NDArray = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxNDArrayReshape(handle, dims.toArray, out))
+    new NDArray(out(0), writable)
+  }
+
+  def copyTo(other: NDArray): NDArray = {
+    // identity through the registry (this + 0 -> other); the registry has
+    // no separate _copyto: cross-device movement is the executor's job
+    NDArray.invoke("_plus_scalar", Array(this), Array(other), Array(0f))
+    other
+  }
+
+  def copy(): NDArray = copyTo(NDArray.empty(shape, context))
+
+  def waitToRead(): Unit = checkCall(_LIB.mxNDArrayWaitToRead(handle))
+
+  def +(other: NDArray): NDArray = NDArray.binary("_plus", this, other)
+  def -(other: NDArray): NDArray = NDArray.binary("_minus", this, other)
+  def *(other: NDArray): NDArray = NDArray.binary("_mul", this, other)
+  def /(other: NDArray): NDArray = NDArray.binary("_div", this, other)
+  def +(s: Float): NDArray = NDArray.scalarOp("_plus_scalar", this, s)
+  def -(s: Float): NDArray = NDArray.scalarOp("_minus_scalar", this, s)
+  def *(s: Float): NDArray = NDArray.scalarOp("_mul_scalar", this, s)
+  def /(s: Float): NDArray = NDArray.scalarOp("_div_scalar", this, s)
+
+  def +=(other: NDArray): NDArray = {
+    NDArray.invoke("_plus", Array(this, other), Array(this)); this
+  }
+  def -=(other: NDArray): NDArray = {
+    NDArray.invoke("_minus", Array(this, other), Array(this)); this
+  }
+
+  def dispose(): Unit = checkCall(_LIB.mxNDArrayFree(handle))
+}
+
+object NDArray {
+  private lazy val functions: Map[String, FunctionHandle] = {
+    val handles = _LIB.mxListFunctions()
+    require(handles != null, _LIB.mxGetLastError())
+    handles.map(h => _LIB.mxFuncGetName(h) -> h).toMap
+  }
+
+  private[mxnet_tpu] def invoke(name: String, useVars: Array[NDArray],
+                                mutateVars: Array[NDArray],
+                                scalars: Array[Float] = Array.empty): Unit = {
+    val fn = functions.getOrElse(name,
+      throw new MXNetError(s"unknown ndarray function $name"))
+    checkCall(_LIB.mxFuncInvoke(fn, useVars.map(_.handle), scalars,
+                                mutateVars.map(_.handle)))
+  }
+
+  private def binary(name: String, lhs: NDArray, rhs: NDArray): NDArray = {
+    val out = empty(lhs.shape, lhs.context)
+    invoke(name, Array(lhs, rhs), Array(out))
+    out
+  }
+
+  private def scalarOp(name: String, lhs: NDArray, s: Float): NDArray = {
+    val out = empty(lhs.shape, lhs.context)
+    invoke(name, Array(lhs), Array(out), Array(s))
+    out
+  }
+
+  def empty(shape: Shape, ctx: Context = Context.defaultCtx): NDArray = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxNDArrayCreateEx(shape.toArray, ctx.deviceTypeid,
+                                     ctx.deviceId, 0, 0, out))
+    new NDArray(out(0))
+  }
+
+  def zeros(shape: Shape, ctx: Context = Context.defaultCtx): NDArray =
+    empty(shape, ctx).set(0f)
+
+  def ones(shape: Shape, ctx: Context = Context.defaultCtx): NDArray =
+    empty(shape, ctx).set(1f)
+
+  def array(values: Array[Float], shape: Shape,
+            ctx: Context = Context.defaultCtx): NDArray =
+    empty(shape, ctx).set(values)
+
+  def waitall(): Unit = checkCall(_LIB.mxNDArrayWaitAll())
+
+  def save(fname: String, arrays: Map[String, NDArray]): Unit = {
+    val (names, handles) = arrays.toSeq.unzip
+    checkCall(_LIB.mxNDArraySave(fname, handles.map(_.handle).toArray,
+                                 names.toArray))
+  }
+
+  def load(fname: String): Map[String, NDArray] = {
+    val out2 = new Array[AnyRef](2)
+    checkCall(_LIB.mxNDArrayLoad(fname, out2))
+    val handles = out2(0).asInstanceOf[Array[Long]]
+    val names = out2(1).asInstanceOf[Array[String]]
+    // a list-style save carries no names: key positionally rather than
+    // silently dropping every array (zip would truncate to the shorter)
+    val keys = if (names.length == handles.length) names
+               else handles.indices.map(_.toString).toArray
+    keys.zip(handles.map(new NDArray(_))).toMap
+  }
+}

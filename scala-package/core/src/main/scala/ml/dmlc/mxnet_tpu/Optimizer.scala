@@ -1,0 +1,82 @@
+package ml.dmlc.mxnet_tpu
+
+import ml.dmlc.mxnet_tpu.Base._
+
+/**
+ * Optimizers ride the NATIVE optimizer registry
+ * (MXOptimizerFindCreator/CreateOptimizer/Update) — the same fused
+ * update step every other binding uses, with per-index state held on the
+ * native side.  Reference Optimizer.scala reimplements SGD in Scala with
+ * NDArray ops; going through the ABI keeps one implementation and one
+ * momentum-state store for all bindings.
+ *
+ * The native handle is created on FIRST update: FeedForward.fit can
+ * still resolve a deferred rescale_grad (1/batchSize for batch-summed
+ * loss-head gradients) before any state exists.
+ */
+class Optimizer(name: String, initParams: Map[String, String],
+                var learningRate: Float, val wd: Float = 0f,
+                val lrScheduler: Option[LRScheduler] = None) {
+  private var params = initParams
+  private var handleOpt: Option[OptimizerHandle] = None
+  // update counts are PER INDEX (reference optimizer semantics): the
+  // scheduler sees iterations, not iterations x parameter count
+  private val numUpdate = scala.collection.mutable.Map.empty[Int, Int]
+
+  lrScheduler.foreach(_.baseLR = learningRate)
+
+  /** Set/override a creation-time parameter; only valid before the first
+   * update materializes the native handle. */
+  private[mxnet_tpu] def setParam(key: String, value: String): Unit = {
+    require(handleOpt.isEmpty, "optimizer already materialized")
+    params += (key -> value)
+  }
+
+  private[mxnet_tpu] def hasParam(key: String): Boolean =
+    params.contains(key)
+
+  private def handle: OptimizerHandle = handleOpt.getOrElse {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxOptimizerFindCreator(name, out))
+    val creator = out(0)
+    val (k, v) = params.toSeq.unzip
+    checkCall(_LIB.mxOptimizerCreateOptimizer(creator, k.toArray, v.toArray,
+                                              out))
+    handleOpt = Some(out(0))
+    out(0)
+  }
+
+  def update(index: Int, weight: NDArray, grad: NDArray): Unit = {
+    val t = numUpdate.getOrElse(index, 0) + 1
+    numUpdate(index) = t
+    val lr = lrScheduler.map(_.apply(t)).getOrElse(learningRate)
+    checkCall(_LIB.mxOptimizerUpdate(handle, index, weight.handle,
+                                     grad.handle, lr, wd))
+  }
+
+  def dispose(): Unit = handleOpt.foreach(h =>
+    checkCall(_LIB.mxOptimizerFree(h)))
+}
+
+object SGD {
+  /** Omitting rescaleGrad defers it: FeedForward.fit resolves it to
+   * 1/batchSize (loss-head grads are batch-summed). */
+  def apply(learningRate: Float = 0.01f, momentum: Float = 0f,
+            wd: Float = 0f, rescaleGrad: Float = 0f,
+            lrScheduler: Option[LRScheduler] = None): Optimizer = {
+    val params = Map("momentum" -> momentum.toString) ++
+      (if (rescaleGrad != 0f) Map("rescale_grad" -> rescaleGrad.toString)
+       else Map.empty)
+    new Optimizer("sgd", params, learningRate, wd, lrScheduler)
+  }
+}
+
+object Adam {
+  def apply(learningRate: Float = 0.001f, beta1: Float = 0.9f,
+            beta2: Float = 0.999f, epsilon: Float = 1e-8f,
+            wd: Float = 0f): Optimizer =
+    new Optimizer("adam",
+                  Map("beta1" -> beta1.toString, "beta2" -> beta2.toString,
+                      "epsilon" -> epsilon.toString),
+                  learningRate, wd)
+}

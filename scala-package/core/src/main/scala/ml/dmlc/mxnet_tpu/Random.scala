@@ -1,0 +1,26 @@
+package ml.dmlc.mxnet_tpu
+
+import ml.dmlc.mxnet_tpu.Base._
+
+/** Random sources (reference Random.scala): device-side sampling rides
+ * the registry ops; the seed goes through the ABI to the in-program
+ * PRNG key (mxnet_tpu/random.py). */
+object Random {
+  def seed(s: Int): Unit = checkCall(_LIB.mxRandomSeed(s))
+
+  def uniform(low: Float, high: Float, shape: Shape,
+              ctx: Context = Context.defaultCtx): NDArray = {
+    val out = NDArray.empty(shape, ctx)
+    NDArray.invoke("_sample_uniform", Array.empty, Array(out),
+                   Array(low, high))
+    out
+  }
+
+  def normal(mean: Float, stdvar: Float, shape: Shape,
+             ctx: Context = Context.defaultCtx): NDArray = {
+    val out = NDArray.empty(shape, ctx)
+    NDArray.invoke("_sample_normal", Array.empty, Array(out),
+                   Array(mean, stdvar))
+    out
+  }
+}

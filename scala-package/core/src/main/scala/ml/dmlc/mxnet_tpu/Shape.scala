@@ -1,0 +1,30 @@
+package ml.dmlc.mxnet_tpu
+
+/** Immutable tensor shape (reference Shape.scala). */
+class Shape(dims: Seq[Int]) extends Serializable {
+  private val shape = dims.toVector
+
+  def this(dims: Int*)(implicit d: DummyImplicit) = this(dims.toSeq)
+
+  def apply(i: Int): Int = shape(i)
+  def length: Int = shape.length
+  def product: Int = shape.foldLeft(1)(_ * _)
+  def toArray: Array[Int] = shape.toArray
+  def toVector: Vector[Int] = shape
+  def drop(n: Int): Shape = new Shape(shape.drop(n))
+  def slice(from: Int, until: Int): Shape = new Shape(shape.slice(from, until))
+  def head: Int = shape.head
+
+  override def equals(o: Any): Boolean = o match {
+    case s: Shape => s.toVector == shape
+    case _ => false
+  }
+  override def hashCode(): Int = shape.hashCode()
+  override def toString: String = s"(${shape.mkString(",")})"
+}
+
+object Shape {
+  def apply(dims: Int*): Shape = new Shape(dims.toSeq)
+  def apply(dims: Seq[Int])(implicit d: DummyImplicit): Shape =
+    new Shape(dims)
+}

@@ -1,0 +1,192 @@
+package ml.dmlc.mxnet_tpu
+
+import ml.dmlc.mxnet_tpu.Base._
+
+/**
+ * Symbolic graph node (reference Symbol.scala).  Operators come from the
+ * live creator registry (MXSymbolListAtomicSymbolCreators) rather than
+ * generated stubs: `Symbol.create("Convolution", ...)` works for every
+ * registered op, and the common layers get named helpers.
+ */
+class Symbol private[mxnet_tpu](private[mxnet_tpu] val handle: SymbolHandle)
+    extends Serializable {
+
+  def listArguments(): IndexedSeq[String] = {
+    val a = _LIB.mxSymbolListArguments(handle)
+    require(a != null, _LIB.mxGetLastError())
+    a.toIndexedSeq
+  }
+
+  def listOutputs(): IndexedSeq[String] = {
+    val a = _LIB.mxSymbolListOutputs(handle)
+    require(a != null, _LIB.mxGetLastError())
+    a.toIndexedSeq
+  }
+
+  def listAuxiliaryStates(): IndexedSeq[String] = {
+    val a = _LIB.mxSymbolListAuxiliaryStates(handle)
+    require(a != null, _LIB.mxGetLastError())
+    a.toIndexedSeq
+  }
+
+  def attr(key: String): Option[String] =
+    Option(_LIB.mxSymbolGetAttr(handle, key))
+
+  def setAttr(key: String, value: String): Unit =
+    checkCall(_LIB.mxSymbolSetAttr(handle, key, value))
+
+  def copy(): Symbol = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxSymbolCopy(handle, out))
+    new Symbol(out(0))
+  }
+
+  def getInternals(): Symbol = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxSymbolGetInternals(handle, out))
+    new Symbol(out(0))
+  }
+
+  def get(index: Int): Symbol = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxSymbolGetOutput(handle, index, out))
+    new Symbol(out(0))
+  }
+
+  def toJson: String = {
+    val s = _LIB.mxSymbolSaveToJSON(handle)
+    require(s != null, _LIB.mxGetLastError())
+    s
+  }
+
+  /** (argShapes, outShapes, auxShapes); empty seqs when incomplete. */
+  def inferShape(known: Map[String, Shape])
+      : (IndexedSeq[Shape], IndexedSeq[Shape], IndexedSeq[Shape]) = {
+    val (keys, shapes) = known.toSeq.unzip
+    val out3 = new Array[AnyRef](3)
+    val complete = new Array[Int](1)
+    checkCall(_LIB.mxSymbolInferShape(
+      handle, keys.toArray,
+      shapes.map(_.toArray.asInstanceOf[AnyRef]).toArray, out3, complete))
+    if (complete(0) == 0) {
+      (IndexedSeq.empty, IndexedSeq.empty, IndexedSeq.empty)
+    } else {
+      def grp(i: Int): IndexedSeq[Shape] =
+        out3(i).asInstanceOf[Array[AnyRef]]
+          .map(s => Shape(s.asInstanceOf[Array[Int]].toSeq)).toIndexedSeq
+      (grp(0), grp(1), grp(2))
+    }
+  }
+
+  /** Bind with explicit arrays (reference Symbol.bind). */
+  def bind(ctx: Context, args: IndexedSeq[NDArray],
+           argsGrad: IndexedSeq[NDArray], gradReqs: IndexedSeq[Int],
+           auxStates: IndexedSeq[NDArray] = IndexedSeq.empty,
+           group2ctx: Map[String, Context] = Map.empty): Executor = {
+    val (mapKeys, mapCtx) = group2ctx.toSeq.unzip
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxExecutorBindX(
+      handle, ctx.deviceTypeid, ctx.deviceId, mapKeys.toArray,
+      mapCtx.map(_.deviceTypeid).toArray, mapCtx.map(_.deviceId).toArray,
+      args.map(_.handle).toArray,
+      argsGrad.map(g => if (g == null) 0L else g.handle).toArray,
+      gradReqs.toArray, auxStates.map(_.handle).toArray, out))
+    new Executor(out(0), this, args, argsGrad, auxStates)
+  }
+
+  /** Allocate arg/grad arrays from inferred shapes and bind
+   * (reference Symbol.simpleBind). */
+  def simpleBind(ctx: Context, gradReq: String = "write",
+                 shapes: Map[String, Shape] = Map.empty,
+                 group2ctx: Map[String, Context] = Map.empty): Executor = {
+    val (argShapes, _, auxShapes) = inferShape(shapes)
+    require(argShapes.nonEmpty, "incomplete shapes for simpleBind")
+    val argNames = listArguments()
+    val req = Executor.gradReqCode(gradReq)
+    val args = argShapes.map(NDArray.zeros(_, ctx))
+    val grads = argNames.zip(argShapes).map { case (name, s) =>
+      if (req == 0 || shapes.contains(name)) null.asInstanceOf[NDArray]
+      else NDArray.zeros(s, ctx)
+    }
+    val reqs = argNames.map(n => if (shapes.contains(n)) 0 else req)
+    val aux = auxShapes.map(NDArray.zeros(_, ctx))
+    bind(ctx, args, grads, reqs, aux, group2ctx)
+  }
+
+  def dispose(): Unit = checkCall(_LIB.mxSymbolFree(handle))
+}
+
+object Symbol {
+  private lazy val creators: Map[String, Long] = {
+    val handles = _LIB.mxSymbolListAtomicSymbolCreators()
+    require(handles != null, _LIB.mxGetLastError())
+    handles.map(h => _LIB.mxSymbolGetAtomicSymbolName(h) -> h).toMap
+  }
+
+  def Variable(name: String): Symbol = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxSymbolCreateVariable(name, out))
+    new Symbol(out(0))
+  }
+
+  def Group(symbols: Symbol*): Symbol = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxSymbolCreateGroup(symbols.map(_.handle).toArray, out))
+    new Symbol(out(0))
+  }
+
+  def loadJson(json: String): Symbol = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxSymbolCreateFromJSON(json, out))
+    new Symbol(out(0))
+  }
+
+  /** Create any registered operator by name with keyword inputs +
+   * string-typed params — the whole op inventory, no generated stubs. */
+  def create(op: String, name: String, inputs: Map[String, Symbol],
+             params: Map[String, String] = Map.empty): Symbol = {
+    val creator = creators.getOrElse(op,
+      throw new MXNetError(s"unknown operator $op"))
+    val out = new Array[Long](1)
+    val (pk, pv) = params.toSeq.unzip
+    checkCall(_LIB.mxSymbolCreateAtomicSymbol(creator, pk.toArray,
+                                              pv.toArray, out))
+    val sym = new Symbol(out(0))
+    val (ik, iv) = inputs.toSeq.unzip
+    checkCall(_LIB.mxSymbolCompose(sym.handle, name, ik.toArray,
+                                   iv.map(_.handle).toArray))
+    sym
+  }
+
+  def listOperators(): IndexedSeq[String] = creators.keys.toIndexedSeq.sorted
+
+  // named helpers for the common layers
+  def FullyConnected(data: Symbol, numHidden: Int, name: String): Symbol =
+    create("FullyConnected", name, Map("data" -> data),
+           Map("num_hidden" -> numHidden.toString))
+
+  def Activation(data: Symbol, actType: String, name: String): Symbol =
+    create("Activation", name, Map("data" -> data),
+           Map("act_type" -> actType))
+
+  def Convolution(data: Symbol, kernel: Shape, numFilter: Int,
+                  name: String, params: Map[String, String] = Map.empty)
+      : Symbol =
+    create("Convolution", name, Map("data" -> data),
+           params + ("kernel" -> kernel.toString,
+                     "num_filter" -> numFilter.toString))
+
+  def Pooling(data: Symbol, kernel: Shape, poolType: String, name: String,
+              params: Map[String, String] = Map.empty): Symbol =
+    create("Pooling", name, Map("data" -> data),
+           params + ("kernel" -> kernel.toString, "pool_type" -> poolType))
+
+  def Flatten(data: Symbol, name: String): Symbol =
+    create("Flatten", name, Map("data" -> data))
+
+  def SoftmaxOutput(data: Symbol, name: String): Symbol =
+    create("SoftmaxOutput", name, Map("data" -> data))
+
+  def BatchNorm(data: Symbol, name: String): Symbol =
+    create("BatchNorm", name, Map("data" -> data))
+}

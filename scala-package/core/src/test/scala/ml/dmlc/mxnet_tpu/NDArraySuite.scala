@@ -1,0 +1,41 @@
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.{BeforeAndAfterAll, FunSuite}
+
+/** Reference NDArraySuite.scala analogue over the flat-array JNI layer. */
+class NDArraySuite extends FunSuite with BeforeAndAfterAll {
+  test("zeros/ones and round trip") {
+    val a = NDArray.zeros(Shape(2, 3))
+    assert(a.toArray.forall(_ == 0f))
+    val b = NDArray.ones(Shape(2, 3))
+    assert(b.toArray.forall(_ == 1f))
+    val c = NDArray.array(Array(1f, 2f, 3f, 4f, 5f, 6f), Shape(2, 3))
+    assert(c.toArray.toSeq == Seq(1f, 2f, 3f, 4f, 5f, 6f))
+    assert(c.shape == Shape(2, 3))
+  }
+
+  test("elementwise arithmetic via the registry") {
+    val a = NDArray.array(Array(1f, 2f, 3f, 4f), Shape(2, 2))
+    val b = NDArray.ones(Shape(2, 2))
+    assert((a + b).toArray.toSeq == Seq(2f, 3f, 4f, 5f))
+    assert((a - b).toArray.toSeq == Seq(0f, 1f, 2f, 3f))
+    assert((a * 2f).toArray.toSeq == Seq(2f, 4f, 6f, 8f))
+  }
+
+  test("slice and reshape") {
+    val a = NDArray.array((0 until 12).map(_.toFloat).toArray, Shape(4, 3))
+    val s = a.slice(1, 3)
+    assert(s.shape == Shape(2, 3))
+    assert(s.toArray.toSeq == (3 until 9).map(_.toFloat))
+    val r = a.reshape(Shape(3, 4))
+    assert(r.shape == Shape(3, 4))
+  }
+
+  test("save and load") {
+    val f = java.io.File.createTempFile("nd", ".params")
+    val a = NDArray.array(Array(1f, 2f, 3f), Shape(3))
+    NDArray.save(f.getPath, Map("a" -> a))
+    val loaded = NDArray.load(f.getPath)
+    assert(loaded("a").toArray.toSeq == Seq(1f, 2f, 3f))
+  }
+}

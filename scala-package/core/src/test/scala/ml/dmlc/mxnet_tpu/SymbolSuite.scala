@@ -1,0 +1,46 @@
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.FunSuite
+
+/** Reference SymbolSuite.scala analogue. */
+class SymbolSuite extends FunSuite {
+  private def mlp: Symbol = {
+    val data = Symbol.Variable("data")
+    val fc1 = Symbol.FullyConnected(data, 32, "fc1")
+    val act = Symbol.Activation(fc1, "relu", "relu1")
+    val fc2 = Symbol.FullyConnected(act, 4, "fc2")
+    Symbol.SoftmaxOutput(fc2, "softmax")
+  }
+
+  test("compose and list arguments") {
+    val net = mlp
+    assert(net.listArguments() == IndexedSeq(
+      "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+      "softmax_label"))
+    assert(net.listOutputs().length == 1)
+  }
+
+  test("json round trip") {
+    val net = mlp
+    val loaded = Symbol.loadJson(net.toJson)
+    assert(loaded.listArguments() == net.listArguments())
+  }
+
+  test("shape inference") {
+    val net = mlp
+    val (argShapes, outShapes, _) =
+      net.inferShape(Map("data" -> Shape(8, 64)))
+    assert(argShapes(1) == Shape(32, 64))      // fc1_weight
+    assert(outShapes.head == Shape(8, 4))
+  }
+
+  test("the whole operator inventory is reachable") {
+    val ops = Symbol.listOperators()
+    assert(ops.contains("Convolution") && ops.contains("RNN") &&
+           ops.contains("ROIPooling"))
+    val conv = Symbol.create(
+      "Convolution", "conv1", Map("data" -> Symbol.Variable("data")),
+      Map("kernel" -> "(3,3)", "num_filter" -> "8"))
+    assert(conv.listArguments().contains("conv1_weight"))
+  }
+}

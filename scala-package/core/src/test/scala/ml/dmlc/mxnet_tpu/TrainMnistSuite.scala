@@ -1,0 +1,64 @@
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.FunSuite
+
+/**
+ * The binding's acceptance bar (reference scala-package train suites):
+ * an MNIST-style MLP reaches >= 0.95 test accuracy.  Synthetic class
+ * blobs stand in for MNIST pixels (zero-egress image) — the same gate
+ * the R binding and the JVM-free JNI-glue test
+ * (tests/cpp/test_jni_glue.cc) enforce.
+ */
+class TrainMnistSuite extends FunSuite {
+  private def blobs(n: Int, dim: Int, classes: Int, seed: Int)
+      : (Array[Float], Array[Float]) = {
+    val centerRnd = new scala.util.Random(999)
+    val centers = Array.fill(classes * dim)(centerRnd.nextGaussian() * 3)
+    val rnd = new scala.util.Random(seed)
+    val x = new Array[Float](n * dim)
+    val y = new Array[Float](n)
+    for (i <- 0 until n) {
+      val c = rnd.nextInt(classes)
+      y(i) = c.toFloat
+      for (d <- 0 until dim)
+        x(i * dim + d) =
+          (centers(c * dim + d) + rnd.nextGaussian() * 0.8).toFloat
+    }
+    (x, y)
+  }
+
+  test("MLP trains to >= 0.95 through the JNI layer") {
+    val (dim, classes, batch) = (64, 4, 40)
+    val (trainX, trainY) = blobs(800, dim, classes, 1)
+    val (testX, testY) = blobs(200, dim, classes, 2)
+
+    val data = Symbol.Variable("data")
+    val fc1 = Symbol.FullyConnected(data, 32, "fc1")
+    val act = Symbol.Activation(fc1, "relu", "relu1")
+    val fc2 = Symbol.FullyConnected(act, classes, "fc2")
+    val net = Symbol.SoftmaxOutput(fc2, "softmax")
+
+    // default SGD path: fit resolves rescale_grad to 1/batch itself
+    val model = new FeedForward(
+      net, Context.cpu(), numEpoch = 10,
+      optimizer = SGD(learningRate = 0.2f, momentum = 0.9f),
+      initializer = new Xavier(factorType = "in", magnitude = 2.34f))
+    model.fit(new NDArrayIter(trainX, trainY, 800, dim, batch))
+    val (_, acc) =
+      model.score(new NDArrayIter(testX, testY, 200, dim, batch))
+    assert(acc >= 0.95f, s"accuracy $acc")
+
+    // checkpoint round trip, then score through a freshly-bound model
+    val prefix = java.io.File.createTempFile("mlp", "").getPath
+    model.save(prefix, 10)
+    val (sym2, params2, aux2) = FeedForward.load(prefix, 10)
+    assert(sym2.listArguments() == net.listArguments())
+    assert(params2.size == 4)
+    val reloaded = new FeedForward(sym2, Context.cpu())
+    reloaded.init(Map("data" -> Shape(batch, dim)),
+                  Map("softmax_label" -> Shape(batch)), params2, aux2)
+    val (_, acc2) =
+      reloaded.score(new NDArrayIter(testX, testY, 200, dim, batch))
+    assert(acc2 >= 0.95f, s"reloaded accuracy $acc2")
+  }
+}

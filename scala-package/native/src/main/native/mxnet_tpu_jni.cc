@@ -1,0 +1,851 @@
+/*
+ * JNI glue for the Scala/JVM binding: marshals between JVM arrays and the
+ * C ABI (include/c_api.h), loaded at runtime with dlopen like the R glue
+ * (R-package/src/mxnet_glue.c).  Reference counterpart:
+ * scala-package/native/src/main/native/ml_dmlc_mxnet_native_c_api.cc —
+ * but where the reference calls back into Scala collection methods
+ * (ListBuffer.append per element), this glue exchanges flat primitive
+ * arrays in single JNI calls: fewer JVM crossings per ABI call, and the
+ * whole surface is drivable under a mocked jni.h (tests/cpp/jniheaders/)
+ * in images with no JVM.
+ *
+ * Conventions:
+ *   - handles are jlong (pointer-sized on every JVM);
+ *   - int-returning natives pass the ABI rc through (0 ok, -1 error,
+ *     message via mxGetLastError);
+ *   - natives returning jstring/array objects return null on error;
+ *   - out-handles land in a caller-allocated jlongArray of length 1.
+ */
+#include <jni.h>
+
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef const void *FunctionHandle;
+typedef const void *AtomicSymbolCreator;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+typedef void *OptimizerHandle;
+typedef const void *OptimizerCreator;
+
+/* ---- resolved ABI ---------------------------------------------------- */
+static struct {
+  void *dl;
+  const char *(*GetLastError)();
+  int (*RandomSeed)(int);
+  int (*NotifyShutdown)();
+  int (*NDArrayCreateEx)(const mx_uint *, mx_uint, int, int, int, int,
+                         NDArrayHandle *);
+  int (*NDArrayCreateNone)(NDArrayHandle *);
+  int (*NDArrayFree)(NDArrayHandle);
+  int (*NDArrayWaitAll)();
+  int (*NDArrayWaitToRead)(NDArrayHandle);
+  int (*NDArraySyncCopyFromCPU)(NDArrayHandle, const void *, size_t);
+  int (*NDArraySyncCopyToCPU)(NDArrayHandle, void *, size_t);
+  int (*NDArrayGetShape)(NDArrayHandle, mx_uint *, const mx_uint **);
+  int (*NDArrayGetContext)(NDArrayHandle, int *, int *);
+  int (*NDArraySlice)(NDArrayHandle, mx_uint, mx_uint, NDArrayHandle *);
+  int (*NDArrayAt)(NDArrayHandle, mx_uint, NDArrayHandle *);
+  int (*NDArrayReshape)(NDArrayHandle, int, int *, NDArrayHandle *);
+  int (*NDArraySave)(const char *, mx_uint, NDArrayHandle *, const char **);
+  int (*NDArrayLoad)(const char *, mx_uint *, NDArrayHandle **, mx_uint *,
+                     const char ***);
+  int (*ListFunctions)(mx_uint *, FunctionHandle **);
+  int (*GetFunction)(const char *, FunctionHandle *);
+  int (*FuncGetInfo)(FunctionHandle, const char **, const char **, mx_uint *,
+                     const char ***, const char ***, const char ***);
+  int (*FuncDescribe)(FunctionHandle, mx_uint *, mx_uint *, mx_uint *, int *);
+  int (*FuncInvoke)(FunctionHandle, NDArrayHandle *, mx_float *,
+                    NDArrayHandle *);
+  int (*SymbolListAtomicSymbolCreators)(mx_uint *, AtomicSymbolCreator **);
+  int (*SymbolGetAtomicSymbolInfo)(AtomicSymbolCreator, const char **,
+                                   const char **, mx_uint *, const char ***,
+                                   const char ***, const char ***,
+                                   const char **);
+  int (*SymbolCreateAtomicSymbol)(AtomicSymbolCreator, mx_uint, const char **,
+                                  const char **, SymbolHandle *);
+  int (*SymbolCreateVariable)(const char *, SymbolHandle *);
+  int (*SymbolCreateGroup)(mx_uint, SymbolHandle *, SymbolHandle *);
+  int (*SymbolCreateFromJSON)(const char *, SymbolHandle *);
+  int (*SymbolSaveToJSON)(SymbolHandle, const char **);
+  int (*SymbolFree)(SymbolHandle);
+  int (*SymbolCopy)(SymbolHandle, SymbolHandle *);
+  int (*SymbolCompose)(SymbolHandle, const char *, mx_uint, const char **,
+                       SymbolHandle *);
+  int (*SymbolListArguments)(SymbolHandle, mx_uint *, const char ***);
+  int (*SymbolListOutputs)(SymbolHandle, mx_uint *, const char ***);
+  int (*SymbolListAuxiliaryStates)(SymbolHandle, mx_uint *, const char ***);
+  int (*SymbolGetAttr)(SymbolHandle, const char *, const char **, int *);
+  int (*SymbolSetAttr)(SymbolHandle, const char *, const char *);
+  int (*SymbolGetInternals)(SymbolHandle, SymbolHandle *);
+  int (*SymbolGetOutput)(SymbolHandle, mx_uint, SymbolHandle *);
+  int (*SymbolInferShape)(SymbolHandle, mx_uint, const char **,
+                          const mx_uint *, const mx_uint *, mx_uint *,
+                          const mx_uint **, const mx_uint ***, mx_uint *,
+                          const mx_uint **, const mx_uint ***, mx_uint *,
+                          const mx_uint **, const mx_uint ***, int *);
+  int (*ExecutorBindX)(SymbolHandle, int, int, mx_uint, const char **,
+                       const int *, const int *, mx_uint, NDArrayHandle *,
+                       NDArrayHandle *, mx_uint *, mx_uint, NDArrayHandle *,
+                       ExecutorHandle *);
+  int (*ExecutorForward)(ExecutorHandle, int);
+  int (*ExecutorBackward)(ExecutorHandle, mx_uint, NDArrayHandle *);
+  int (*ExecutorOutputs)(ExecutorHandle, mx_uint *, NDArrayHandle **);
+  int (*ExecutorFree)(ExecutorHandle);
+  int (*OptimizerFindCreator)(const char *, OptimizerCreator *);
+  int (*OptimizerCreateOptimizer)(OptimizerCreator, mx_uint, const char **,
+                                  const char **, OptimizerHandle *);
+  int (*OptimizerFree)(OptimizerHandle);
+  int (*OptimizerUpdate)(OptimizerHandle, int, NDArrayHandle, NDArrayHandle,
+                         mx_float, mx_float);
+  int (*KVStoreCreate)(const char *, KVStoreHandle *);
+  int (*KVStoreFree)(KVStoreHandle);
+  int (*KVStoreInit)(KVStoreHandle, mx_uint, const int *, NDArrayHandle *);
+  int (*KVStorePush)(KVStoreHandle, mx_uint, const int *, NDArrayHandle *,
+                     int);
+  int (*KVStorePull)(KVStoreHandle, mx_uint, const int *, NDArrayHandle *,
+                     int);
+  int (*KVStoreGetType)(KVStoreHandle, const char **);
+  int (*KVStoreGetRank)(KVStoreHandle, int *);
+  int (*KVStoreGetGroupSize)(KVStoreHandle, int *);
+  int (*KVStoreBarrier)(KVStoreHandle);
+  int loaded;
+} jx;
+
+#define JX_RESOLVE(field, name)                            \
+  do {                                                     \
+    *(void **)(&jx.field) = dlsym(jx.dl, name);            \
+    if (jx.field == NULL) {                                \
+      snprintf(jx_init_err, sizeof(jx_init_err),           \
+               "missing symbol %s", name);                 \
+      return -1;                                           \
+    }                                                      \
+  } while (0)
+
+static char jx_init_err[256];
+
+/* ---- small marshalling helpers --------------------------------------- */
+namespace {
+
+struct JString {      // scoped UTF chars
+  JNIEnv *env;
+  jstring js;
+  const char *c;
+  JString(JNIEnv *e, jstring s) : env(e), js(s) {
+    c = s ? e->GetStringUTFChars(s, nullptr) : nullptr;
+  }
+  ~JString() {
+    if (c) env->ReleaseStringUTFChars(js, c);
+  }
+};
+
+// jobjectArray of jstring -> vector<string> (+ stable char* view)
+struct JStringArray {
+  std::vector<std::string> store;
+  std::vector<const char *> ptrs;
+  JStringArray(JNIEnv *env, jobjectArray arr) {
+    int n = arr ? env->GetArrayLength(arr) : 0;
+    store.reserve(n);
+    for (int i = 0; i < n; ++i) {
+      jstring js = (jstring)env->GetObjectArrayElement(arr, i);
+      const char *c = env->GetStringUTFChars(js, nullptr);
+      store.emplace_back(c ? c : "");
+      env->ReleaseStringUTFChars(js, c);
+    }
+    for (auto &s : store) ptrs.push_back(s.c_str());
+  }
+  mx_uint size() const { return (mx_uint)store.size(); }
+  const char **data() { return ptrs.empty() ? nullptr : ptrs.data(); }
+};
+
+std::vector<void *> handles_in(JNIEnv *env, jlongArray arr) {
+  std::vector<void *> v;
+  int n = arr ? env->GetArrayLength(arr) : 0;
+  if (n) {
+    std::vector<jlong> tmp(n);
+    env->GetLongArrayRegion(arr, 0, n, tmp.data());
+    for (jlong h : tmp) v.push_back((void *)(intptr_t)h);
+  }
+  return v;
+}
+
+void handle_out(JNIEnv *env, jlongArray out, void *h) {
+  jlong v = (jlong)(intptr_t)h;
+  env->SetLongArrayRegion(out, 0, 1, &v);
+}
+
+jlongArray handles_new(JNIEnv *env, mx_uint n, void *const *hs) {
+  jlongArray arr = env->NewLongArray(n);
+  std::vector<jlong> tmp(n);
+  for (mx_uint i = 0; i < n; ++i) tmp[i] = (jlong)(intptr_t)hs[i];
+  if (n) env->SetLongArrayRegion(arr, 0, n, tmp.data());
+  return arr;
+}
+
+jobjectArray strings_new(JNIEnv *env, mx_uint n, const char *const *ss) {
+  jclass scls = env->FindClass("java/lang/String");
+  jobjectArray arr = env->NewObjectArray(n, scls, nullptr);
+  for (mx_uint i = 0; i < n; ++i)
+    env->SetObjectArrayElement(arr, i, env->NewStringUTF(ss[i]));
+  return arr;
+}
+
+// one shape group (n arrays, each ndim[i] ints) -> jobjectArray of jintArray
+jobjectArray shapes_new(JNIEnv *env, mx_uint n, const mx_uint *ndims,
+                        const mx_uint *const *data) {
+  jclass icls = env->FindClass("[I");
+  jobjectArray arr = env->NewObjectArray(n, icls, nullptr);
+  for (mx_uint i = 0; i < n; ++i) {
+    jintArray s = env->NewIntArray(ndims[i]);
+    std::vector<jint> tmp(ndims[i]);
+    for (mx_uint j = 0; j < ndims[i]; ++j) tmp[j] = (jint)data[i][j];
+    if (ndims[i]) env->SetIntArrayRegion(s, 0, ndims[i], tmp.data());
+    env->SetObjectArrayElement(arr, i, s);
+  }
+  return arr;
+}
+
+}  // namespace
+
+#define H(x) ((void *)(intptr_t)(x))
+
+extern "C" {
+
+/* ---- init / error ---------------------------------------------------- */
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_nativeLibInit(
+    JNIEnv *env, jobject, jstring jpath) {
+  if (jx.loaded) return 0;
+  JString path(env, jpath);
+  if (jx.dl != NULL) dlclose(jx.dl);  /* failed half-load retry */
+  jx.dl = dlopen(path.c, RTLD_NOW | RTLD_GLOBAL);
+  if (jx.dl == NULL) {
+    snprintf(jx_init_err, sizeof(jx_init_err), "dlopen: %s", dlerror());
+    return -1;
+  }
+  JX_RESOLVE(GetLastError, "MXGetLastError");
+  JX_RESOLVE(RandomSeed, "MXRandomSeed");
+  JX_RESOLVE(NotifyShutdown, "MXNotifyShutdown");
+  JX_RESOLVE(NDArrayCreateEx, "MXNDArrayCreateEx");
+  JX_RESOLVE(NDArrayCreateNone, "MXNDArrayCreateNone");
+  JX_RESOLVE(NDArrayFree, "MXNDArrayFree");
+  JX_RESOLVE(NDArrayWaitAll, "MXNDArrayWaitAll");
+  JX_RESOLVE(NDArrayWaitToRead, "MXNDArrayWaitToRead");
+  JX_RESOLVE(NDArraySyncCopyFromCPU, "MXNDArraySyncCopyFromCPU");
+  JX_RESOLVE(NDArraySyncCopyToCPU, "MXNDArraySyncCopyToCPU");
+  JX_RESOLVE(NDArrayGetShape, "MXNDArrayGetShape");
+  JX_RESOLVE(NDArrayGetContext, "MXNDArrayGetContext");
+  JX_RESOLVE(NDArraySlice, "MXNDArraySlice");
+  JX_RESOLVE(NDArrayAt, "MXNDArrayAt");
+  JX_RESOLVE(NDArrayReshape, "MXNDArrayReshape");
+  JX_RESOLVE(NDArraySave, "MXNDArraySave");
+  JX_RESOLVE(NDArrayLoad, "MXNDArrayLoad");
+  JX_RESOLVE(ListFunctions, "MXListFunctions");
+  JX_RESOLVE(GetFunction, "MXGetFunction");
+  JX_RESOLVE(FuncGetInfo, "MXFuncGetInfo");
+  JX_RESOLVE(FuncDescribe, "MXFuncDescribe");
+  JX_RESOLVE(FuncInvoke, "MXFuncInvoke");
+  JX_RESOLVE(SymbolListAtomicSymbolCreators, "MXSymbolListAtomicSymbolCreators");
+  JX_RESOLVE(SymbolGetAtomicSymbolInfo, "MXSymbolGetAtomicSymbolInfo");
+  JX_RESOLVE(SymbolCreateAtomicSymbol, "MXSymbolCreateAtomicSymbol");
+  JX_RESOLVE(SymbolCreateVariable, "MXSymbolCreateVariable");
+  JX_RESOLVE(SymbolCreateGroup, "MXSymbolCreateGroup");
+  JX_RESOLVE(SymbolCreateFromJSON, "MXSymbolCreateFromJSON");
+  JX_RESOLVE(SymbolSaveToJSON, "MXSymbolSaveToJSON");
+  JX_RESOLVE(SymbolFree, "MXSymbolFree");
+  JX_RESOLVE(SymbolCopy, "MXSymbolCopy");
+  JX_RESOLVE(SymbolCompose, "MXSymbolCompose");
+  JX_RESOLVE(SymbolListArguments, "MXSymbolListArguments");
+  JX_RESOLVE(SymbolListOutputs, "MXSymbolListOutputs");
+  JX_RESOLVE(SymbolListAuxiliaryStates, "MXSymbolListAuxiliaryStates");
+  JX_RESOLVE(SymbolGetAttr, "MXSymbolGetAttr");
+  JX_RESOLVE(SymbolSetAttr, "MXSymbolSetAttr");
+  JX_RESOLVE(SymbolGetInternals, "MXSymbolGetInternals");
+  JX_RESOLVE(SymbolGetOutput, "MXSymbolGetOutput");
+  JX_RESOLVE(SymbolInferShape, "MXSymbolInferShape");
+  JX_RESOLVE(ExecutorBindX, "MXExecutorBindX");
+  JX_RESOLVE(ExecutorForward, "MXExecutorForward");
+  JX_RESOLVE(ExecutorBackward, "MXExecutorBackward");
+  JX_RESOLVE(ExecutorOutputs, "MXExecutorOutputs");
+  JX_RESOLVE(ExecutorFree, "MXExecutorFree");
+  JX_RESOLVE(OptimizerFindCreator, "MXOptimizerFindCreator");
+  JX_RESOLVE(OptimizerCreateOptimizer, "MXOptimizerCreateOptimizer");
+  JX_RESOLVE(OptimizerFree, "MXOptimizerFree");
+  JX_RESOLVE(OptimizerUpdate, "MXOptimizerUpdate");
+  JX_RESOLVE(KVStoreCreate, "MXKVStoreCreate");
+  JX_RESOLVE(KVStoreFree, "MXKVStoreFree");
+  JX_RESOLVE(KVStoreInit, "MXKVStoreInit");
+  JX_RESOLVE(KVStorePush, "MXKVStorePush");
+  JX_RESOLVE(KVStorePull, "MXKVStorePull");
+  JX_RESOLVE(KVStoreGetType, "MXKVStoreGetType");
+  JX_RESOLVE(KVStoreGetRank, "MXKVStoreGetRank");
+  JX_RESOLVE(KVStoreGetGroupSize, "MXKVStoreGetGroupSize");
+  JX_RESOLVE(KVStoreBarrier, "MXKVStoreBarrier");
+  jx.loaded = 1;
+  return 0;
+}
+
+JNIEXPORT jstring JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxGetLastError(
+    JNIEnv *env, jobject) {
+  if (!jx.loaded) return env->NewStringUTF(jx_init_err);
+  return env->NewStringUTF(jx.GetLastError());
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxRandomSeed(
+    JNIEnv *, jobject, jint seed) {
+  return jx.RandomSeed(seed);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNotifyShutdown(
+    JNIEnv *, jobject) {
+  return jx.NotifyShutdown();
+}
+
+/* ---- ndarray --------------------------------------------------------- */
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayCreateEx(
+    JNIEnv *env, jobject, jintArray jshape, jint devType, jint devId,
+    jint delayAlloc, jint dtype, jlongArray out) {
+  int ndim = env->GetArrayLength(jshape);
+  std::vector<jint> tmp(ndim);
+  env->GetIntArrayRegion(jshape, 0, ndim, tmp.data());
+  std::vector<mx_uint> shape(tmp.begin(), tmp.end());
+  NDArrayHandle h = nullptr;
+  int rc = jx.NDArrayCreateEx(shape.data(), (mx_uint)ndim, devType, devId,
+                              delayAlloc, dtype, &h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayCreateNone(
+    JNIEnv *env, jobject, jlongArray out) {
+  NDArrayHandle h = nullptr;
+  int rc = jx.NDArrayCreateNone(&h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayFree(
+    JNIEnv *, jobject, jlong h) {
+  return jx.NDArrayFree(H(h));
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayWaitAll(
+    JNIEnv *, jobject) {
+  return jx.NDArrayWaitAll();
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayWaitToRead(
+    JNIEnv *, jobject, jlong h) {
+  return jx.NDArrayWaitToRead(H(h));
+}
+
+JNIEXPORT jint JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArraySyncCopyFromCPU(
+    JNIEnv *env, jobject, jlong h, jfloatArray jdata, jint size) {
+  jfloat *data = env->GetFloatArrayElements(jdata, nullptr);
+  int rc = jx.NDArraySyncCopyFromCPU(H(h), data, (size_t)size);
+  env->ReleaseFloatArrayElements(jdata, data, JNI_ABORT);  /* no copy-back */
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArraySyncCopyToCPU(
+    JNIEnv *env, jobject, jlong h, jfloatArray jdata, jint size) {
+  jfloat *data = env->GetFloatArrayElements(jdata, nullptr);
+  int rc = jx.NDArraySyncCopyToCPU(H(h), data, (size_t)size);
+  env->ReleaseFloatArrayElements(jdata, data, 0);  /* commit */
+  return rc;
+}
+
+JNIEXPORT jintArray JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayGetShape(
+    JNIEnv *env, jobject, jlong h) {
+  mx_uint ndim = 0;
+  const mx_uint *data = nullptr;
+  if (jx.NDArrayGetShape(H(h), &ndim, &data) != 0) return nullptr;
+  jintArray out = env->NewIntArray(ndim);
+  std::vector<jint> tmp(data, data + ndim);
+  if (ndim) env->SetIntArrayRegion(out, 0, ndim, tmp.data());
+  return out;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayGetContext(
+    JNIEnv *env, jobject, jlong h, jintArray out2) {
+  int dt = 0, di = 0;
+  int rc = jx.NDArrayGetContext(H(h), &dt, &di);
+  if (rc == 0) {
+    jint v[2] = {dt, di};
+    env->SetIntArrayRegion(out2, 0, 2, v);
+  }
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArraySlice(
+    JNIEnv *env, jobject, jlong h, jint begin, jint end, jlongArray out) {
+  NDArrayHandle s = nullptr;
+  int rc = jx.NDArraySlice(H(h), begin, end, &s);
+  if (rc == 0) handle_out(env, out, s);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayAt(
+    JNIEnv *env, jobject, jlong h, jint idx, jlongArray out) {
+  NDArrayHandle s = nullptr;
+  int rc = jx.NDArrayAt(H(h), idx, &s);
+  if (rc == 0) handle_out(env, out, s);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayReshape(
+    JNIEnv *env, jobject, jlong h, jintArray jdims, jlongArray out) {
+  int ndim = env->GetArrayLength(jdims);
+  std::vector<jint> tmp(ndim);
+  env->GetIntArrayRegion(jdims, 0, ndim, tmp.data());
+  std::vector<int> dims(tmp.begin(), tmp.end());
+  NDArrayHandle s = nullptr;
+  int rc = jx.NDArrayReshape(H(h), ndim, dims.data(), &s);
+  if (rc == 0) handle_out(env, out, s);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArraySave(
+    JNIEnv *env, jobject, jstring jfname, jlongArray jhandles,
+    jobjectArray jkeys) {
+  JString fname(env, jfname);
+  std::vector<void *> hs = handles_in(env, jhandles);
+  JStringArray keys(env, jkeys);
+  return jx.NDArraySave(fname.c, (mx_uint)hs.size(),
+                        hs.empty() ? nullptr : hs.data(),
+                        keys.size() ? keys.data() : nullptr);
+}
+
+/* out2[0] <- jlongArray handles, out2[1] <- jobjectArray names */
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayLoad(
+    JNIEnv *env, jobject, jstring jfname, jobjectArray out2) {
+  JString fname(env, jfname);
+  mx_uint n = 0, nn = 0;
+  NDArrayHandle *arrs = nullptr;
+  const char **names = nullptr;
+  int rc = jx.NDArrayLoad(fname.c, &n, &arrs, &nn, &names);
+  if (rc != 0) return rc;
+  env->SetObjectArrayElement(out2, 0, handles_new(env, n, arrs));
+  env->SetObjectArrayElement(out2, 1, strings_new(env, nn, names));
+  return 0;
+}
+
+/* ---- function registry ----------------------------------------------- */
+JNIEXPORT jlongArray JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxListFunctions(
+    JNIEnv *env, jobject) {
+  mx_uint n = 0;
+  FunctionHandle *fns = nullptr;
+  if (jx.ListFunctions(&n, &fns) != 0) return nullptr;
+  return handles_new(env, n, (void *const *)fns);
+}
+
+JNIEXPORT jstring JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxFuncGetName(
+    JNIEnv *env, jobject, jlong h) {
+  const char *name = nullptr, *desc = nullptr;
+  mx_uint na = 0;
+  const char **an = nullptr, **at = nullptr, **ad = nullptr;
+  if (jx.FuncGetInfo((FunctionHandle)H(h), &name, &desc, &na, &an, &at, &ad)
+      != 0)
+    return nullptr;
+  return env->NewStringUTF(name);
+}
+
+/* out4 <- [num_use_vars, num_scalars, num_mutate_vars, type_mask] */
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxFuncDescribe(
+    JNIEnv *env, jobject, jlong h, jintArray out4) {
+  mx_uint nu = 0, ns = 0, nm = 0;
+  int mask = 0;
+  int rc = jx.FuncDescribe((FunctionHandle)H(h), &nu, &ns, &nm, &mask);
+  if (rc == 0) {
+    jint v[4] = {(jint)nu, (jint)ns, (jint)nm, mask};
+    env->SetIntArrayRegion(out4, 0, 4, v);
+  }
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxFuncInvoke(
+    JNIEnv *env, jobject, jlong fn, jlongArray juse, jfloatArray jscalars,
+    jlongArray jmut) {
+  std::vector<void *> use = handles_in(env, juse);
+  std::vector<void *> mut = handles_in(env, jmut);
+  int ns = jscalars ? env->GetArrayLength(jscalars) : 0;
+  std::vector<jfloat> sc(ns);
+  if (ns) env->GetFloatArrayRegion(jscalars, 0, ns, sc.data());
+  return jx.FuncInvoke((FunctionHandle)H(fn),
+                       use.empty() ? nullptr : use.data(),
+                       sc.empty() ? nullptr : sc.data(),
+                       mut.empty() ? nullptr : mut.data());
+}
+
+/* ---- symbol ---------------------------------------------------------- */
+JNIEXPORT jlongArray JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListAtomicSymbolCreators(
+    JNIEnv *env, jobject) {
+  mx_uint n = 0;
+  AtomicSymbolCreator *cs = nullptr;
+  if (jx.SymbolListAtomicSymbolCreators(&n, &cs) != 0) return nullptr;
+  return handles_new(env, n, (void *const *)cs);
+}
+
+JNIEXPORT jstring JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolGetAtomicSymbolName(
+    JNIEnv *env, jobject, jlong h) {
+  const char *name = nullptr, *desc = nullptr, *kv = nullptr;
+  mx_uint na = 0;
+  const char **an = nullptr, **at = nullptr, **ad = nullptr;
+  if (jx.SymbolGetAtomicSymbolInfo((AtomicSymbolCreator)H(h), &name, &desc,
+                                   &na, &an, &at, &ad, &kv) != 0)
+    return nullptr;
+  return env->NewStringUTF(name);
+}
+
+JNIEXPORT jint JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCreateAtomicSymbol(
+    JNIEnv *env, jobject, jlong creator, jobjectArray jkeys,
+    jobjectArray jvals, jlongArray out) {
+  JStringArray keys(env, jkeys), vals(env, jvals);
+  SymbolHandle h = nullptr;
+  int rc = jx.SymbolCreateAtomicSymbol((AtomicSymbolCreator)H(creator),
+                                       keys.size(), keys.data(), vals.data(),
+                                       &h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCreateVariable(
+    JNIEnv *env, jobject, jstring jname, jlongArray out) {
+  JString name(env, jname);
+  SymbolHandle h = nullptr;
+  int rc = jx.SymbolCreateVariable(name.c, &h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCreateGroup(
+    JNIEnv *env, jobject, jlongArray jsyms, jlongArray out) {
+  std::vector<void *> syms = handles_in(env, jsyms);
+  SymbolHandle h = nullptr;
+  int rc = jx.SymbolCreateGroup((mx_uint)syms.size(),
+                                syms.empty() ? nullptr : syms.data(), &h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCreateFromJSON(
+    JNIEnv *env, jobject, jstring jjson, jlongArray out) {
+  JString json(env, jjson);
+  SymbolHandle h = nullptr;
+  int rc = jx.SymbolCreateFromJSON(json.c, &h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jstring JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolSaveToJSON(
+    JNIEnv *env, jobject, jlong h) {
+  const char *json = nullptr;
+  if (jx.SymbolSaveToJSON(H(h), &json) != 0) return nullptr;
+  return env->NewStringUTF(json);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolFree(
+    JNIEnv *, jobject, jlong h) {
+  return jx.SymbolFree(H(h));
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCopy(
+    JNIEnv *env, jobject, jlong h, jlongArray out) {
+  SymbolHandle c = nullptr;
+  int rc = jx.SymbolCopy(H(h), &c);
+  if (rc == 0) handle_out(env, out, c);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCompose(
+    JNIEnv *env, jobject, jlong h, jstring jname, jobjectArray jkeys,
+    jlongArray jargs) {
+  JString name(env, jname);
+  JStringArray keys(env, jkeys);
+  std::vector<void *> args = handles_in(env, jargs);
+  return jx.SymbolCompose(H(h), name.c, (mx_uint)args.size(),
+                          keys.size() ? keys.data() : nullptr,
+                          args.empty() ? nullptr : args.data());
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListArguments(JNIEnv *env, jobject,
+                                                      jlong h) {
+  mx_uint n = 0;
+  const char **ss = nullptr;
+  if (jx.SymbolListArguments(H(h), &n, &ss) != 0) return nullptr;
+  return strings_new(env, n, ss);
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListOutputs(JNIEnv *env, jobject,
+                                                    jlong h) {
+  mx_uint n = 0;
+  const char **ss = nullptr;
+  if (jx.SymbolListOutputs(H(h), &n, &ss) != 0) return nullptr;
+  return strings_new(env, n, ss);
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListAuxiliaryStates(
+    JNIEnv *env, jobject, jlong h) {
+  mx_uint n = 0;
+  const char **ss = nullptr;
+  if (jx.SymbolListAuxiliaryStates(H(h), &n, &ss) != 0) return nullptr;
+  return strings_new(env, n, ss);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolSetAttr(
+    JNIEnv *env, jobject, jlong h, jstring jkey, jstring jval) {
+  JString key(env, jkey), val(env, jval);
+  return jx.SymbolSetAttr(H(h), key.c, val.c);
+}
+
+JNIEXPORT jstring JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolGetAttr(
+    JNIEnv *env, jobject, jlong h, jstring jkey) {
+  JString key(env, jkey);
+  const char *out = nullptr;
+  int ok = 0;
+  if (jx.SymbolGetAttr(H(h), key.c, &out, &ok) != 0 || !ok) return nullptr;
+  return env->NewStringUTF(out);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolGetInternals(
+    JNIEnv *env, jobject, jlong h, jlongArray out) {
+  SymbolHandle s = nullptr;
+  int rc = jx.SymbolGetInternals(H(h), &s);
+  if (rc == 0) handle_out(env, out, s);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolGetOutput(
+    JNIEnv *env, jobject, jlong h, jint idx, jlongArray out) {
+  SymbolHandle s = nullptr;
+  int rc = jx.SymbolGetOutput(H(h), (mx_uint)idx, &s);
+  if (rc == 0) handle_out(env, out, s);
+  return rc;
+}
+
+/* result <- [argShapes, outShapes, auxShapes] (each jobjectArray of
+ * jintArray), returns complete flag in out1[0]; null groups on error */
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolInferShape(
+    JNIEnv *env, jobject, jlong h, jobjectArray jkeys, jobjectArray jshapes,
+    jobjectArray out3, jintArray jcomplete) {
+  JStringArray keys(env, jkeys);
+  mx_uint nk = keys.size();
+  std::vector<mx_uint> ind(1, 0), flat;
+  for (mx_uint i = 0; i < nk; ++i) {
+    jintArray s = (jintArray)env->GetObjectArrayElement(jshapes, i);
+    int sn = env->GetArrayLength(s);
+    std::vector<jint> tmp(sn);
+    env->GetIntArrayRegion(s, 0, sn, tmp.data());
+    for (int j = 0; j < sn; ++j) flat.push_back((mx_uint)tmp[j]);
+    ind.push_back((mx_uint)flat.size());
+  }
+  mx_uint in_n = 0, out_n = 0, aux_n = 0;
+  const mx_uint *in_nd = nullptr, *out_nd = nullptr, *aux_nd = nullptr;
+  const mx_uint **in_d = nullptr, **out_d = nullptr, **aux_d = nullptr;
+  int complete = 0;
+  int rc = jx.SymbolInferShape(
+      H(h), nk, keys.data(), ind.data(), flat.data(), &in_n, &in_nd,
+      (const mx_uint ***)&in_d, &out_n, &out_nd, (const mx_uint ***)&out_d,
+      &aux_n, &aux_nd, (const mx_uint ***)&aux_d, &complete);
+  if (rc != 0) return rc;
+  env->SetObjectArrayElement(out3, 0, shapes_new(env, in_n, in_nd, in_d));
+  env->SetObjectArrayElement(out3, 1, shapes_new(env, out_n, out_nd, out_d));
+  env->SetObjectArrayElement(out3, 2, shapes_new(env, aux_n, aux_nd, aux_d));
+  jint c = complete;
+  env->SetIntArrayRegion(jcomplete, 0, 1, &c);
+  return 0;
+}
+
+/* ---- executor -------------------------------------------------------- */
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorBindX(
+    JNIEnv *env, jobject, jlong sym, jint devType, jint devId,
+    jobjectArray jmapKeys, jintArray jmapDevTypes, jintArray jmapDevIds,
+    jlongArray jinArgs, jlongArray jargGrads, jintArray jgradReqs,
+    jlongArray jauxStates, jlongArray out) {
+  JStringArray mapKeys(env, jmapKeys);
+  mx_uint nmap = mapKeys.size();
+  std::vector<jint> mdt(nmap), mdi(nmap);
+  if (nmap) {
+    env->GetIntArrayRegion(jmapDevTypes, 0, nmap, mdt.data());
+    env->GetIntArrayRegion(jmapDevIds, 0, nmap, mdi.data());
+  }
+  std::vector<int> map_dt(mdt.begin(), mdt.end());
+  std::vector<int> map_di(mdi.begin(), mdi.end());
+  std::vector<void *> in_args = handles_in(env, jinArgs);
+  std::vector<void *> grads = handles_in(env, jargGrads);
+  std::vector<void *> aux = handles_in(env, jauxStates);
+  int nreq = env->GetArrayLength(jgradReqs);
+  std::vector<jint> reqs_j(nreq);
+  env->GetIntArrayRegion(jgradReqs, 0, nreq, reqs_j.data());
+  std::vector<mx_uint> reqs(reqs_j.begin(), reqs_j.end());
+  ExecutorHandle ex = nullptr;
+  int rc = jx.ExecutorBindX(
+      H(sym), devType, devId, nmap, mapKeys.data(),
+      nmap ? map_dt.data() : nullptr, nmap ? map_di.data() : nullptr,
+      (mx_uint)in_args.size(), in_args.data(),
+      grads.empty() ? nullptr : grads.data(), reqs.data(),
+      (mx_uint)aux.size(), aux.empty() ? nullptr : aux.data(), &ex);
+  if (rc == 0) handle_out(env, out, ex);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorForward(
+    JNIEnv *, jobject, jlong ex, jint isTrain) {
+  return jx.ExecutorForward(H(ex), isTrain);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorBackward(
+    JNIEnv *env, jobject, jlong ex, jlongArray jheads) {
+  std::vector<void *> heads = handles_in(env, jheads);
+  return jx.ExecutorBackward(H(ex), (mx_uint)heads.size(),
+                             heads.empty() ? nullptr : heads.data());
+}
+
+JNIEXPORT jlongArray JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorOutputs(
+    JNIEnv *env, jobject, jlong ex) {
+  mx_uint n = 0;
+  NDArrayHandle *outs = nullptr;
+  if (jx.ExecutorOutputs(H(ex), &n, &outs) != 0) return nullptr;
+  return handles_new(env, n, outs);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorFree(
+    JNIEnv *, jobject, jlong ex) {
+  return jx.ExecutorFree(H(ex));
+}
+
+/* ---- optimizer ------------------------------------------------------- */
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxOptimizerFindCreator(
+    JNIEnv *env, jobject, jstring jname, jlongArray out) {
+  JString name(env, jname);
+  OptimizerCreator c = nullptr;
+  int rc = jx.OptimizerFindCreator(name.c, &c);
+  if (rc == 0) handle_out(env, out, (void *)c);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxOptimizerCreateOptimizer(
+    JNIEnv *env, jobject, jlong creator, jobjectArray jkeys,
+    jobjectArray jvals, jlongArray out) {
+  JStringArray keys(env, jkeys), vals(env, jvals);
+  OptimizerHandle h = nullptr;
+  int rc = jx.OptimizerCreateOptimizer((OptimizerCreator)H(creator),
+                                       keys.size(), keys.data(), vals.data(),
+                                       &h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxOptimizerUpdate(
+    JNIEnv *, jobject, jlong h, jint index, jlong w, jlong g, jfloat lr,
+    jfloat wd) {
+  return jx.OptimizerUpdate(H(h), index, H(w), H(g), lr, wd);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxOptimizerFree(
+    JNIEnv *, jobject, jlong h) {
+  return jx.OptimizerFree(H(h));
+}
+
+/* ---- kvstore --------------------------------------------------------- */
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreCreate(
+    JNIEnv *env, jobject, jstring jtype, jlongArray out) {
+  JString type(env, jtype);
+  KVStoreHandle h = nullptr;
+  int rc = jx.KVStoreCreate(type.c, &h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreFree(
+    JNIEnv *, jobject, jlong h) {
+  return jx.KVStoreFree(H(h));
+}
+
+static int kv_keys_vals(JNIEnv *env, jintArray jkeys, jlongArray jvals,
+                        std::vector<int> *keys, std::vector<void *> *vals) {
+  int n = env->GetArrayLength(jkeys);
+  std::vector<jint> tmp(n);
+  env->GetIntArrayRegion(jkeys, 0, n, tmp.data());
+  keys->assign(tmp.begin(), tmp.end());
+  *vals = handles_in(env, jvals);
+  return n;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreInit(
+    JNIEnv *env, jobject, jlong h, jintArray jkeys, jlongArray jvals) {
+  std::vector<int> keys;
+  std::vector<void *> vals;
+  int n = kv_keys_vals(env, jkeys, jvals, &keys, &vals);
+  return jx.KVStoreInit(H(h), (mx_uint)n, keys.data(), vals.data());
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStorePush(
+    JNIEnv *env, jobject, jlong h, jintArray jkeys, jlongArray jvals,
+    jint priority) {
+  std::vector<int> keys;
+  std::vector<void *> vals;
+  int n = kv_keys_vals(env, jkeys, jvals, &keys, &vals);
+  return jx.KVStorePush(H(h), (mx_uint)n, keys.data(), vals.data(), priority);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStorePull(
+    JNIEnv *env, jobject, jlong h, jintArray jkeys, jlongArray jvals,
+    jint priority) {
+  std::vector<int> keys;
+  std::vector<void *> vals;
+  int n = kv_keys_vals(env, jkeys, jvals, &keys, &vals);
+  return jx.KVStorePull(H(h), (mx_uint)n, keys.data(), vals.data(), priority);
+}
+
+JNIEXPORT jstring JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreGetType(
+    JNIEnv *env, jobject, jlong h) {
+  const char *t = nullptr;
+  if (jx.KVStoreGetType(H(h), &t) != 0) return nullptr;
+  return env->NewStringUTF(t);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreGetRank(
+    JNIEnv *env, jobject, jlong h, jintArray out) {
+  int r = 0;
+  int rc = jx.KVStoreGetRank(H(h), &r);
+  if (rc == 0) {
+    jint v = r;
+    env->SetIntArrayRegion(out, 0, 1, &v);
+  }
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreGetGroupSize(
+    JNIEnv *env, jobject, jlong h, jintArray out) {
+  int r = 0;
+  int rc = jx.KVStoreGetGroupSize(H(h), &r);
+  if (rc == 0) {
+    jint v = r;
+    env->SetIntArrayRegion(out, 0, 1, &v);
+  }
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreBarrier(
+    JNIEnv *, jobject, jlong h) {
+  return jx.KVStoreBarrier(H(h));
+}
+
+}  /* extern "C" */

@@ -1,0 +1,148 @@
+/*
+ * Minimal mock of the JNI C++ API surface that
+ * scala-package/native/src/main/native/mxnet_tpu_jni.cc consumes — just
+ * enough to EXECUTE the glue in this image (which has no JVM) against
+ * the real libmxtpu_capi.so, the same trick tests/cpp/rmock.h plays for
+ * the R glue.  The real build path compiles the glue against a JDK's
+ * jni.h unchanged; this header exists so the test suite can prove the
+ * JNI marshalling end-to-end anyway.
+ *
+ * Mock objects are heap-allocated tagged records; allocations are leaked
+ * (the test process is short-lived, as the JVM's GC would reclaim them).
+ */
+#ifndef MXTPU_TESTS_JNIMOCK_H_
+#define MXTPU_TESTS_JNIMOCK_H_
+
+#include <stdint.h>
+#include <string.h>
+
+#include <string>
+#include <vector>
+
+#define JNIEXPORT
+#define JNICALL
+#define JNI_ABORT 2
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef float jfloat;
+typedef uint8_t jboolean;
+typedef jint jsize;
+
+struct MockJObject {
+  int kind;  /* 0 plain, 1 string, 2 int[], 3 long[], 4 float[], 5 obj[] */
+  std::string str;
+  std::vector<jint> ints;
+  std::vector<jlong> longs;
+  std::vector<jfloat> floats;
+  std::vector<MockJObject *> objs;
+};
+
+typedef MockJObject *jobject;
+typedef MockJObject *jclass;
+typedef MockJObject *jstring;
+typedef MockJObject *jarray;
+typedef MockJObject *jintArray;
+typedef MockJObject *jlongArray;
+typedef MockJObject *jfloatArray;
+typedef MockJObject *jobjectArray;
+
+class JNIEnv {
+ public:
+  /* strings */
+  jstring NewStringUTF(const char *c) {
+    MockJObject *o = new MockJObject();
+    o->kind = 1;
+    o->str = c ? c : "";
+    return o;
+  }
+  const char *GetStringUTFChars(jstring s, jboolean *copied) {
+    if (copied) *copied = 0;
+    return s->str.c_str();
+  }
+  void ReleaseStringUTFChars(jstring, const char *) {}
+
+  /* array length (any array kind) */
+  jsize GetArrayLength(jarray a) {
+    switch (a->kind) {
+      case 2: return (jsize)a->ints.size();
+      case 3: return (jsize)a->longs.size();
+      case 4: return (jsize)a->floats.size();
+      case 5: return (jsize)a->objs.size();
+      default: return 0;
+    }
+  }
+
+  /* int arrays */
+  jintArray NewIntArray(jsize n) {
+    MockJObject *o = new MockJObject();
+    o->kind = 2;
+    o->ints.resize(n);
+    return o;
+  }
+  void GetIntArrayRegion(jintArray a, jsize start, jsize len, jint *buf) {
+    memcpy(buf, a->ints.data() + start, len * sizeof(jint));
+  }
+  void SetIntArrayRegion(jintArray a, jsize start, jsize len,
+                         const jint *buf) {
+    memcpy(a->ints.data() + start, buf, len * sizeof(jint));
+  }
+
+  /* long arrays */
+  jlongArray NewLongArray(jsize n) {
+    MockJObject *o = new MockJObject();
+    o->kind = 3;
+    o->longs.resize(n);
+    return o;
+  }
+  void GetLongArrayRegion(jlongArray a, jsize start, jsize len, jlong *buf) {
+    memcpy(buf, a->longs.data() + start, len * sizeof(jlong));
+  }
+  void SetLongArrayRegion(jlongArray a, jsize start, jsize len,
+                          const jlong *buf) {
+    memcpy(a->longs.data() + start, buf, len * sizeof(jlong));
+  }
+
+  /* float arrays */
+  jfloatArray NewFloatArray(jsize n) {
+    MockJObject *o = new MockJObject();
+    o->kind = 4;
+    o->floats.resize(n);
+    return o;
+  }
+  void GetFloatArrayRegion(jfloatArray a, jsize start, jsize len,
+                           jfloat *buf) {
+    memcpy(buf, a->floats.data() + start, len * sizeof(jfloat));
+  }
+  void SetFloatArrayRegion(jfloatArray a, jsize start, jsize len,
+                           const jfloat *buf) {
+    memcpy(a->floats.data() + start, buf, len * sizeof(jfloat));
+  }
+  jfloat *GetFloatArrayElements(jfloatArray a, jboolean *copied) {
+    if (copied) *copied = 0;
+    return a->floats.data();  /* direct view: release is a no-op */
+  }
+  void ReleaseFloatArrayElements(jfloatArray, jfloat *, jint) {}
+
+  /* object arrays */
+  jclass FindClass(const char *name) {
+    MockJObject *o = new MockJObject();
+    o->kind = 0;
+    o->str = name;
+    return o;
+  }
+  jobjectArray NewObjectArray(jsize n, jclass, jobject init) {
+    MockJObject *o = new MockJObject();
+    o->kind = 5;
+    o->objs.assign(n, init);
+    return o;
+  }
+  jobject GetObjectArrayElement(jobjectArray a, jsize i) {
+    return a->objs[i];
+  }
+  void SetObjectArrayElement(jobjectArray a, jsize i, jobject v) {
+    a->objs[i] = v;
+  }
+};
+
+#endif  /* MXTPU_TESTS_JNIMOCK_H_ */

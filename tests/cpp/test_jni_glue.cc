@@ -1,0 +1,432 @@
+/*
+ * Execute the Scala binding's JNI glue
+ * (scala-package/native/src/main/native/mxnet_tpu_jni.cc) against the
+ * real libmxtpu_capi.so, with the JNI API mocked (jniheaders/jni.h) —
+ * the JVM-less analogue of tests/cpp/test_r_glue.c.  Proves the JNI
+ * marshalling end-to-end at the binding's acceptance bar: an
+ * MNIST-style MLP (synthetic class blobs, zero-egress image) trains to
+ * >= 0.95 test accuracy purely through the JNI entry points — ndarray
+ * copies, symbol composition, shape inference, executor fwd/bwd, the
+ * native optimizer — plus the model-parallel (ctx_group) bind path
+ * (reference scala-package core ModelParallelSuite analogue), symbol
+ * JSON and param save/load round trips, and kvstore push/pull.
+ *
+ * Usage: test_jni_glue <path-to-libmxtpu_capi.so> <tmpdir>
+ */
+#include <jni.h>
+
+#include "../../scala-package/native/src/main/native/mxnet_tpu_jni.cc"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include <string>
+#include <vector>
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      fprintf(stderr, "CHECK failed at %d: %s\nlast error: %s\n",       \
+              __LINE__, #cond, last_error(&env));                       \
+      exit(1);                                                          \
+    }                                                                   \
+  } while (0)
+
+static JNIEnv env;
+
+static const char *last_error(JNIEnv *e) {
+  jstring s = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxGetLastError(e, nullptr);
+  return s ? s->str.c_str() : "(none)";
+}
+
+/* ---- mock-JVM array builders (what the Scala layer would allocate) --- */
+static jintArray mkints(const std::vector<jint> &v) {
+  jintArray a = env.NewIntArray((jsize)v.size());
+  if (!v.empty()) env.SetIntArrayRegion(a, 0, (jsize)v.size(), v.data());
+  return a;
+}
+
+static jlongArray mklongs(const std::vector<jlong> &v) {
+  jlongArray a = env.NewLongArray((jsize)v.size());
+  if (!v.empty()) env.SetLongArrayRegion(a, 0, (jsize)v.size(), v.data());
+  return a;
+}
+
+static jfloatArray mkfloats(const std::vector<jfloat> &v) {
+  jfloatArray a = env.NewFloatArray((jsize)v.size());
+  if (!v.empty()) env.SetFloatArrayRegion(a, 0, (jsize)v.size(), v.data());
+  return a;
+}
+
+static jobjectArray mkstrs(const std::vector<std::string> &v) {
+  jobjectArray a = env.NewObjectArray((jsize)v.size(), nullptr, nullptr);
+  for (size_t i = 0; i < v.size(); ++i)
+    env.SetObjectArrayElement(a, (jsize)i, env.NewStringUTF(v[i].c_str()));
+  return a;
+}
+
+static jlong out_handle(jlongArray ref) { return ref->longs[0]; }
+
+/* ---- thin call wrappers over the JNI natives ------------------------- */
+static jlong nd_create(const std::vector<jint> &shape) {
+  jlongArray ref = env.NewLongArray(1);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayCreateEx(
+            &env, nullptr, mkints(shape), 1 /*cpu*/, 0, 0, 0 /*f32*/, ref)
+        == 0);
+  return out_handle(ref);
+}
+
+static void nd_set(jlong h, const std::vector<jfloat> &v) {
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArraySyncCopyFromCPU(
+            &env, nullptr, h, mkfloats(v), (jint)v.size()) == 0);
+}
+
+static std::vector<jfloat> nd_get(jlong h, size_t n) {
+  jfloatArray buf = env.NewFloatArray((jsize)n);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArraySyncCopyToCPU(
+            &env, nullptr, h, buf, (jint)n) == 0);
+  return buf->floats;
+}
+
+static jlong find_creator(const char *want) {
+  jlongArray cs = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListAtomicSymbolCreators(
+      &env, nullptr);
+  CHECK(cs != nullptr);
+  for (jlong c : cs->longs) {
+    jstring nm = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolGetAtomicSymbolName(
+        &env, nullptr, c);
+    if (nm && nm->str == want) return c;
+  }
+  fprintf(stderr, "creator %s not found\n", want);
+  exit(1);
+}
+
+static jlong atomic(jlong creator, const std::vector<std::string> &keys,
+                    const std::vector<std::string> &vals) {
+  jlongArray ref = env.NewLongArray(1);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCreateAtomicSymbol(
+            &env, nullptr, creator, mkstrs(keys), mkstrs(vals), ref) == 0);
+  return out_handle(ref);
+}
+
+static void compose1(jlong sym, const char *name, jlong arg) {
+  std::vector<jlong> args = {arg};
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCompose(
+            &env, nullptr, sym, env.NewStringUTF(name),
+            mkstrs({"data"}), mklongs(args)) == 0);
+}
+
+static std::vector<std::string> list_args(jlong sym) {
+  jobjectArray a = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListArguments(
+      &env, nullptr, sym);
+  CHECK(a != nullptr);
+  std::vector<std::string> out;
+  for (MockJObject *o : a->objs) out.push_back(o->str);
+  return out;
+}
+
+/* 4-class blobs, the R gate's synthetic MNIST stand-in */
+struct Blobs {
+  std::vector<jfloat> X;
+  std::vector<jint> y;
+};
+
+static unsigned long lcg_state = 12345;
+static double lcg_unit() {   /* uniform [0,1) */
+  lcg_state = lcg_state * 6364136223846793005UL + 1442695040888963407UL;
+  return (double)((lcg_state >> 11) & 0xFFFFFFFFFFFFFUL) / (double)(1UL << 52);
+}
+static double lcg_gauss() {  /* Box-Muller */
+  double u1 = lcg_unit() + 1e-12, u2 = lcg_unit();
+  return sqrt(-2.0 * log(u1)) * cos(2.0 * M_PI * u2);
+}
+
+static Blobs make_blobs(int n, int dim, int classes, unsigned long seed) {
+  static std::vector<double> centers;  /* shared across train/test */
+  if (centers.empty()) {
+    unsigned long save = lcg_state;
+    lcg_state = 999;
+    for (int i = 0; i < 4 * 64; ++i) centers.push_back(lcg_gauss() * 3.0);
+    lcg_state = save;
+  }
+  lcg_state = seed;
+  Blobs b;
+  for (int i = 0; i < n; ++i) {
+    int c = (int)(lcg_unit() * classes);
+    if (c == classes) c = classes - 1;
+    b.y.push_back(c);
+    for (int d = 0; d < dim; ++d)
+      b.X.push_back((jfloat)(centers[c * dim + d] + lcg_gauss() * 0.8));
+  }
+  return b;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s libmxtpu_capi.so tmpdir\n", argv[0]);
+    return 2;
+  }
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_nativeLibInit(
+            &env, nullptr, env.NewStringUTF(argv[1])) == 0);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxRandomSeed(&env, nullptr, 7) == 0);
+
+  /* ---- ndarray round trip ---- */
+  jlong a = nd_create({2, 3});
+  nd_set(a, {1, 2, 3, 4, 5, 6});
+  std::vector<jfloat> got = nd_get(a, 6);
+  for (int i = 0; i < 6; ++i) CHECK(got[i] == i + 1);
+  jintArray shp = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayGetShape(
+      &env, nullptr, a);
+  CHECK(shp && shp->ints.size() == 2 && shp->ints[0] == 2 && shp->ints[1] == 3);
+
+  /* registry invoke through JNI: out = a + a */
+  jlongArray fns = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxListFunctions(&env,
+                                                                   nullptr);
+  CHECK(fns != nullptr);
+  jlong plus = 0;
+  for (jlong f : fns->longs) {
+    jstring nm = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxFuncGetName(&env, nullptr,
+                                                               f);
+    if (nm && nm->str == "_plus") plus = f;
+  }
+  CHECK(plus != 0);
+  jintArray d4 = env.NewIntArray(4);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxFuncDescribe(&env, nullptr, plus,
+                                                       d4) == 0);
+  CHECK(d4->ints[0] == 2 && d4->ints[2] == 1);
+  jlong sum = nd_create({2, 3});
+  std::vector<jlong> use = {a, a}, mut = {sum};
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxFuncInvoke(
+            &env, nullptr, plus, mklongs(use), mkfloats({}), mklongs(mut))
+        == 0);
+  got = nd_get(sum, 6);
+  for (int i = 0; i < 6; ++i) CHECK(got[i] == 2.0f * (i + 1));
+
+  /* ---- MLP symbol through JNI ---- */
+  jlong FC = find_creator("FullyConnected");
+  jlong ACT = find_creator("Activation");
+  jlong SM = find_creator("SoftmaxOutput");
+
+  jlongArray ref = env.NewLongArray(1);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCreateVariable(
+            &env, nullptr, env.NewStringUTF("data"), ref) == 0);
+  jlong data = out_handle(ref);
+  jlong fc1 = atomic(FC, {"num_hidden"}, {"32"});
+  compose1(fc1, "fc1", data);
+  jlong relu1 = atomic(ACT, {"act_type"}, {"relu"});
+  compose1(relu1, "relu1", fc1);
+  jlong fc2 = atomic(FC, {"num_hidden"}, {"4"});
+  compose1(fc2, "fc2", relu1);
+  jlong net = atomic(SM, {}, {});
+  compose1(net, "softmax", fc2);
+
+  std::vector<std::string> args = list_args(net);
+  CHECK(args.size() == 6);  /* data, fc1_w, fc1_b, fc2_w, fc2_b, label */
+
+  /* JSON round trip */
+  jstring json = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolSaveToJSON(
+      &env, nullptr, net);
+  CHECK(json != nullptr);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolCreateFromJSON(
+            &env, nullptr, json, ref) == 0);
+  CHECK(list_args(out_handle(ref)).size() == 6);
+
+  /* ---- infer shapes for batch 40 x 64 ---- */
+  const int kBatch = 40, kDim = 64, kClasses = 4;
+  jobjectArray out3 = env.NewObjectArray(3, nullptr, nullptr);
+  jintArray complete = env.NewIntArray(1);
+  jobjectArray shapes_in = env.NewObjectArray(1, nullptr, nullptr);
+  env.SetObjectArrayElement(shapes_in, 0, mkints({kBatch, kDim}));
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolInferShape(
+            &env, nullptr, net, mkstrs({"data"}), shapes_in, out3, complete)
+        == 0);
+  CHECK(complete->ints[0] == 1);
+  jobjectArray arg_shapes = (jobjectArray)env.GetObjectArrayElement(out3, 0);
+  CHECK(env.GetArrayLength(arg_shapes) == 6);
+
+  /* ---- create args + grads, bind ---- */
+  lcg_state = 42;
+  std::vector<jlong> in_args(6), grads(6);
+  std::vector<jint> reqs(6);
+  int data_idx = -1, label_idx = -1;
+  for (int i = 0; i < 6; ++i) {
+    jintArray s = (jintArray)env.GetObjectArrayElement(arg_shapes, i);
+    std::vector<jint> sv = s->ints;
+    in_args[i] = nd_create(sv);
+    long total = 1;
+    for (jint d : sv) total *= d;
+    bool is_io = args[i] == "data" || args[i] == "softmax_label";
+    if (args[i] == "data") data_idx = i;
+    if (args[i] == "softmax_label") label_idx = i;
+    std::vector<jfloat> init((size_t)total);
+    if (!is_io) {
+      double scale = sv.size() > 1 ? sqrt(2.0 / sv[1]) : 0.0;
+      for (long j = 0; j < total; ++j)
+        init[j] = (jfloat)(lcg_gauss() * scale);
+    }
+    nd_set(in_args[i], init);
+    if (is_io) {
+      grads[i] = 0;
+      reqs[i] = 0;  /* null grad */
+    } else {
+      grads[i] = nd_create(sv);
+      reqs[i] = 1;  /* write */
+    }
+  }
+  CHECK(data_idx >= 0 && label_idx >= 0);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorBindX(
+            &env, nullptr, net, 1, 0, mkstrs({}), mkints({}), mkints({}),
+            mklongs(in_args), mklongs(grads), mkints(reqs), mklongs({}),
+            ref) == 0);
+  jlong ex = out_handle(ref);
+
+  /* ---- native optimizer ---- */
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxOptimizerFindCreator(
+            &env, nullptr, env.NewStringUTF("sgd"), ref) == 0);
+  jlong sgd_creator = out_handle(ref);
+  /* rescale_grad = 1/batch: SoftmaxOutput grads are batch-summed, the
+   * same normalization FeedForward applies before its updater */
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxOptimizerCreateOptimizer(
+            &env, nullptr, sgd_creator, mkstrs({"momentum", "rescale_grad"}),
+            mkstrs({"0.9", "0.025"}), ref) == 0);
+  jlong opt = out_handle(ref);
+
+  /* ---- train: the binding's acceptance bar ---- */
+  Blobs train = make_blobs(800, kDim, kClasses, 1);
+  Blobs test = make_blobs(200, kDim, kClasses, 2);
+  const int kEpochs = 10, kBatches = 800 / kBatch;
+  for (int ep = 0; ep < kEpochs; ++ep) {
+    for (int b = 0; b < kBatches; ++b) {
+      std::vector<jfloat> xb(train.X.begin() + (size_t)b * kBatch * kDim,
+                             train.X.begin() + (size_t)(b + 1) * kBatch * kDim);
+      std::vector<jfloat> yb(kBatch);
+      for (int i = 0; i < kBatch; ++i) yb[i] = (jfloat)train.y[b * kBatch + i];
+      nd_set(in_args[data_idx], xb);
+      nd_set(in_args[label_idx], yb);
+      CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorForward(&env, nullptr,
+                                                              ex, 1) == 0);
+      CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorBackward(
+                &env, nullptr, ex, mklongs({})) == 0);
+      for (int i = 0; i < 6; ++i) {
+        if (grads[i] == 0) continue;
+        CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxOptimizerUpdate(
+                  &env, nullptr, opt, i, in_args[i], grads[i], 0.2f, 0.0f)
+              == 0);
+      }
+    }
+  }
+
+  /* ---- evaluate ---- */
+  int correct = 0, total_eval = 0;
+  for (int b = 0; b < 200 / kBatch; ++b) {
+    std::vector<jfloat> xb(test.X.begin() + (size_t)b * kBatch * kDim,
+                           test.X.begin() + (size_t)(b + 1) * kBatch * kDim);
+    nd_set(in_args[data_idx], xb);
+    nd_set(in_args[label_idx], std::vector<jfloat>(kBatch, 0.0f));
+    CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorForward(&env, nullptr, ex,
+                                                            0) == 0);
+    jlongArray outs = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorOutputs(
+        &env, nullptr, ex);
+    CHECK(outs && outs->longs.size() == 1);
+    std::vector<jfloat> probs = nd_get(outs->longs[0],
+                                       (size_t)kBatch * kClasses);
+    for (int i = 0; i < kBatch; ++i) {
+      int arg = 0;
+      for (int c = 1; c < kClasses; ++c)
+        if (probs[i * kClasses + c] > probs[i * kClasses + arg]) arg = c;
+      correct += (arg == test.y[b * kBatch + i]);
+      ++total_eval;
+    }
+  }
+  double acc = (double)correct / total_eval;
+  printf("jni glue MLP test accuracy: %.4f\n", acc);
+  CHECK(acc >= 0.95);
+
+  /* ---- param save/load round trip ---- */
+  char fname[512];
+  snprintf(fname, sizeof(fname), "%s/jni_mlp.params", argv[2]);
+  std::vector<jlong> save_h;
+  std::vector<std::string> save_k;
+  for (int i = 0; i < 6; ++i) {
+    if (i == data_idx || i == label_idx) continue;
+    save_h.push_back(in_args[i]);
+    save_k.push_back("arg:" + args[i]);
+  }
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArraySave(
+            &env, nullptr, env.NewStringUTF(fname), mklongs(save_h),
+            mkstrs(save_k)) == 0);
+  jobjectArray loaded = env.NewObjectArray(2, nullptr, nullptr);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayLoad(
+            &env, nullptr, env.NewStringUTF(fname), loaded) == 0);
+  jlongArray lh = (jlongArray)env.GetObjectArrayElement(loaded, 0);
+  jobjectArray ln = (jobjectArray)env.GetObjectArrayElement(loaded, 1);
+  CHECK(env.GetArrayLength(lh) == 4 && env.GetArrayLength(ln) == 4);
+  /* loaded weights equal the trained ones */
+  std::vector<jfloat> w0 = nd_get(save_h[0], 32 * kDim);
+  std::vector<jfloat> w0l = nd_get(lh->longs[0], 32 * kDim);
+  for (int i = 0; i < 32 * kDim; ++i) CHECK(w0[i] == w0l[i]);
+
+  /* ---- model parallel bind (ModelParallelSuite analogue) ---- */
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolSetAttr(
+            &env, nullptr, fc1, env.NewStringUTF("ctx_group"),
+            env.NewStringUTF("stage1")) == 0);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolSetAttr(
+            &env, nullptr, fc2, env.NewStringUTF("ctx_group"),
+            env.NewStringUTF("stage2")) == 0);
+  jstring got_attr = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolGetAttr(
+      &env, nullptr, fc1, env.NewStringUTF("ctx_group"));
+  CHECK(got_attr && got_attr->str == "stage1");
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorBindX(
+            &env, nullptr, net, 1, 0, mkstrs({"stage1", "stage2"}),
+            mkints({1, 1}), mkints({1, 2}), mklongs(in_args), mklongs(grads),
+            mkints(reqs), mklongs({}), ref) == 0);
+  jlong ex_mp = out_handle(ref);
+  std::vector<jfloat> xb(test.X.begin(), test.X.begin() + kBatch * kDim);
+  nd_set(in_args[data_idx], xb);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorForward(&env, nullptr,
+                                                          ex_mp, 0) == 0);
+  jlongArray mp_outs = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorOutputs(
+      &env, nullptr, ex_mp);
+  CHECK(mp_outs && mp_outs->longs.size() == 1);
+  std::vector<jfloat> mp_probs = nd_get(mp_outs->longs[0],
+                                        (size_t)kBatch * kClasses);
+  /* cross-device execution must agree with the single-device executor */
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorForward(&env, nullptr, ex,
+                                                          0) == 0);
+  jlongArray sd_outs = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorOutputs(
+      &env, nullptr, ex);
+  std::vector<jfloat> sd_probs = nd_get(sd_outs->longs[0],
+                                        (size_t)kBatch * kClasses);
+  for (int i = 0; i < kBatch * kClasses; ++i)
+    CHECK(fabs(mp_probs[i] - sd_probs[i]) < 1e-4);
+
+  /* ---- kvstore through JNI ---- */
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreCreate(
+            &env, nullptr, env.NewStringUTF("local"), ref) == 0);
+  jlong kv = out_handle(ref);
+  jstring kvt = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreGetType(&env,
+                                                                 nullptr, kv);
+  CHECK(kvt && kvt->str == "local");
+  jlong kw = nd_create({4});
+  nd_set(kw, {0, 0, 0, 0});
+  jlong kg = nd_create({4});
+  nd_set(kg, {1, 1, 1, 1});
+  std::vector<jlong> kws = {kw}, kgs = {kg};
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreInit(
+            &env, nullptr, kv, mkints({3}), mklongs(kws)) == 0);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStorePush(
+            &env, nullptr, kv, mkints({3}), mklongs(kgs), 0) == 0);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStorePull(
+            &env, nullptr, kv, mkints({3}), mklongs(kws), 0) == 0);
+  got = nd_get(kw, 4);
+  CHECK(got[0] == 1.0f && got[3] == 1.0f);
+  jintArray rank1 = env.NewIntArray(1);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreGetRank(&env, nullptr, kv,
+                                                         rank1) == 0);
+  CHECK(rank1->ints[0] == 0);
+
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayWaitAll(&env, nullptr) == 0);
+  printf("JNI GLUE TESTS PASSED\n");
+  return 0;
+}
