@@ -1,0 +1,89 @@
+"""Background-prefetching iterator wrapper (reference rcnn/data_iter.py):
+the loaders assemble targets host-side in numpy, so overlapping that
+work with the device step hides it.  One worker thread stays a couple of
+batches ahead; shapes are fixed, so the consumer sees the same protocol.
+
+The worker starts LAZILY on the first __next__ after a reset: repeated
+resets (protocol quirks like reset-then-iter) cost nothing, and a worker
+exception is re-raised in the consumer instead of silently truncating
+the epoch.
+"""
+import queue
+import threading
+
+
+class PrefetchingIter:
+    _DONE = object()
+
+    def __init__(self, base_iter, depth=2):
+        self.base = base_iter
+        self.provide_data = base_iter.provide_data
+        self.provide_label = base_iter.provide_label
+        self.depth = depth
+        self._q = None
+        self._thread = None
+        self._stop = False
+        self._pending = True   # a reset is owed before the next batch
+
+    def reset(self):
+        self._cancel()
+        self._pending = True
+
+    def _start(self):
+        self.base.reset()
+        self._q = queue.Queue(maxsize=self.depth)
+        self._stop = False
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        try:
+            for batch in self.base:
+                if not self._put(batch):
+                    return
+            self._put(self._DONE)
+        except BaseException as e:   # re-raised consumer-side
+            self._put(e)
+
+    def _put(self, item):
+        """Bounded put that yields to a cancel; False when cancelled."""
+        while not self._stop:
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _cancel(self):
+        if self._thread is None:
+            return
+        self._stop = True
+        while self._thread.is_alive():   # unblock a full queue
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        self._thread = None
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if self._pending:
+            self._start()
+            self._pending = False
+        item = self._q.get()
+        if item is self._DONE:
+            self._thread.join()
+            self._thread = None
+            self._pending = True
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._thread.join()
+            self._thread = None
+            self._pending = True
+            raise item
+        return item

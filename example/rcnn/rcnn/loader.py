@@ -10,9 +10,8 @@ import numpy as np
 import mxnet_tpu as mx
 from mxnet_tpu.io import DataBatch
 
-from .bbox import bbox_overlaps, bbox_transform
+from .minibatch import assign_rpn_minibatch, sample_rois
 from .proposal import anchor_grid
-from .rpn_targets import assign_anchor_targets
 
 
 class AnchorLoader:
@@ -45,33 +44,18 @@ class AnchorLoader:
         self.reset()
         return self
 
-    def _scatter(self, flat):
-        """(F*F*A, k) grid-major -> (k*A, F, F) conv layout."""
-        cfg = self.cfg
-        F, A = cfg.feat_size, cfg.num_anchors
-        k = flat.shape[1]
-        # inverse of proposal.py's read-out: index = pos * A + a
-        g = flat.reshape(F * F, A, k).transpose(1, 2, 0)   # (A, k, F*F)
-        return g.reshape(A * k, F, F)
-
     def __next__(self):
-        cfg = self.cfg
         if self._cursor + self.batch_images > len(self.dataset):
             raise StopIteration
         imgs, labels, targets, weights = [], [], [], []
         for i in range(self._cursor, self._cursor + self.batch_images):
             img, gt_boxes, _ = self.dataset[i]
-            lab, tgt, wgt = assign_anchor_targets(self.anchors, gt_boxes,
-                                                  cfg, self.rng)
-            imgs.append(img)
-            # label layout must match Reshape(score, (0, 2, -1)): the
-            # softmax runs over (2, A*F*F) where position index is
-            # a * F*F + cell  (channel-major) — scatter accordingly
-            F, A = cfg.feat_size, cfg.num_anchors
-            lab_g = lab.reshape(F * F, A).T.reshape(A * F * F)
-            labels.append(lab_g)
-            targets.append(self._scatter(tgt))
-            weights.append(self._scatter(wgt))
+            im, lab, tgt, wgt = assign_rpn_minibatch(
+                img, gt_boxes, self.anchors, self.cfg, self.rng)
+            imgs.append(im)
+            labels.append(lab)
+            targets.append(tgt)
+            weights.append(wgt)
         self._cursor += self.batch_images
         return DataBatch(
             data=[mx.nd.array(np.stack(imgs))],
@@ -88,6 +72,9 @@ class ROIIter:
     minibatch.sample_rois on real proposals, not jittered gt)."""
 
     def __init__(self, dataset, proposals, cfg, batch_images=2, seed=0):
+        assert len(proposals) >= len(dataset), \
+            "proposal set (%d) does not cover the dataset (%d)" % \
+            (len(proposals), len(dataset))
         self.dataset = dataset
         self.proposals = proposals
         self.cfg = cfg
@@ -112,49 +99,6 @@ class ROIIter:
         self.reset()
         return self
 
-    def _sample(self, props, mask, gt_boxes, gt_classes):
-        """Pick cfg.roi_batch rois from the proposal set + gt boxes
-        (gt added as in the reference so fg examples exist early)."""
-        cfg = self.cfg
-        cand = np.concatenate([props[mask], gt_boxes], axis=0)
-        ious = bbox_overlaps(cand, gt_boxes)
-        best = ious.argmax(axis=1)
-        best_iou = ious[np.arange(len(cand)), best]
-        fg_idx = np.where(best_iou >= cfg.roi_fg_iou)[0]
-        bg_idx = np.where(best_iou < cfg.roi_fg_iou)[0]
-        n_fg = min(int(cfg.roi_batch * cfg.roi_fg_fraction), fg_idx.size)
-        fg_idx = self.rng.choice(fg_idx, n_fg, replace=False) \
-            if fg_idx.size else fg_idx
-        n_bg = cfg.roi_batch - n_fg
-        if bg_idx.size == 0:
-            bg_idx = np.zeros((0,), int)
-        take_bg = self.rng.choice(bg_idx, n_bg,
-                                  replace=bg_idx.size < n_bg) \
-            if bg_idx.size else np.zeros((0,), int)
-        keep = np.concatenate([fg_idx, take_bg]).astype(int)
-        # pad by repeating entries if still short (tiny images)
-        while keep.size < cfg.roi_batch:
-            keep = np.concatenate([keep, keep[:cfg.roi_batch - keep.size]])
-        rois = cand[keep]
-        # labels/targets follow the KEPT rows' own IoU — a padded row
-        # that duplicates a foreground roi must stay foreground, or the
-        # same box trains as object and background in one batch
-        k_best = best[keep]
-        is_fg = best_iou[keep] >= cfg.roi_fg_iou
-        labels = np.where(is_fg, gt_classes[k_best], 0).astype(np.float32)
-
-        C = cfg.num_classes + 1
-        targets = np.zeros((cfg.roi_batch, 4 * C), np.float32)
-        weights = np.zeros_like(targets)
-        fg_rows = np.where(is_fg)[0]
-        if fg_rows.size:
-            deltas = bbox_transform(rois[fg_rows], gt_boxes[k_best[fg_rows]])
-            for j, i in enumerate(fg_rows):
-                c = int(labels[i])
-                targets[i, 4 * c:4 * c + 4] = deltas[j]
-                weights[i, 4 * c:4 * c + 4] = 1.0
-        return rois, labels, targets, weights
-
     def __next__(self):
         cfg = self.cfg
         if self._cursor + self.batch_images > len(self.dataset):
@@ -164,7 +108,8 @@ class ROIIter:
                                     self._cursor + self.batch_images)):
             img, gt_boxes, gt_classes = self.dataset[i]
             props, mask, _ = self.proposals[i]
-            r, l, t, w = self._sample(props, mask, gt_boxes, gt_classes)
+            r, l, t, w = sample_rois(props, mask, gt_boxes, gt_classes,
+                                     self.cfg, self.rng)
             imgs.append(img)
             rois.append(np.concatenate(
                 [np.full((cfg.roi_batch, 1), b, np.float32), r], axis=1))

@@ -1,0 +1,77 @@
+"""Minibatch assembly (reference rcnn/minibatch.py): the pure-numpy
+construction of one image's training arrays, shared by the loaders
+(loader.py) and any custom iterator.
+
+- RPN stage: per-anchor classification labels + bbox regression targets,
+  laid out to match the conv feature map the symbol reshapes over.
+- Fast R-CNN stage: sampled foreground/background rois with per-class
+  regression targets.
+"""
+import numpy as np
+
+from .bbox import bbox_overlaps, bbox_transform
+from .rpn_targets import assign_anchor_targets
+
+
+def scatter_to_conv(flat, cfg):
+    """(F*F*A, k) grid-major target rows -> (k*A, F, F) conv layout
+    (the inverse of proposal.py's read-out: index = pos * A + a)."""
+    F, A = cfg.feat_size, cfg.num_anchors
+    k = flat.shape[1]
+    g = flat.reshape(F * F, A, k).transpose(1, 2, 0)   # (A, k, F*F)
+    return g.reshape(A * k, F, F)
+
+
+def assign_rpn_minibatch(img, gt_boxes, anchors, cfg, rng):
+    """One image -> (data, rpn_label, rpn_bbox_target, rpn_bbox_weight)
+    in the shapes AnchorLoader batches up."""
+    lab, tgt, wgt = assign_anchor_targets(anchors, gt_boxes, cfg, rng)
+    # label layout must match Reshape(score, (0, 2, -1)): the softmax
+    # runs over (2, A*F*F) where position index is a * F*F + cell
+    # (channel-major) — scatter accordingly
+    F, A = cfg.feat_size, cfg.num_anchors
+    lab_g = lab.reshape(F * F, A).T.reshape(A * F * F)
+    return img, lab_g, scatter_to_conv(tgt, cfg), scatter_to_conv(wgt, cfg)
+
+
+def sample_rois(props, mask, gt_boxes, gt_classes, cfg, rng):
+    """Pick cfg.roi_batch rois from the proposal set + gt boxes (gt added
+    as in the reference so fg examples exist early) ->
+    (rois, labels, bbox_targets, bbox_weights)."""
+    cand = np.concatenate([props[mask], gt_boxes], axis=0)
+    ious = bbox_overlaps(cand, gt_boxes)
+    best = ious.argmax(axis=1)
+    best_iou = ious[np.arange(len(cand)), best]
+    fg_idx = np.where(best_iou >= cfg.roi_fg_iou)[0]
+    bg_idx = np.where(best_iou < cfg.roi_fg_iou)[0]
+    n_fg = min(int(cfg.roi_batch * cfg.roi_fg_fraction), fg_idx.size)
+    fg_idx = rng.choice(fg_idx, n_fg, replace=False) \
+        if fg_idx.size else fg_idx
+    n_bg = cfg.roi_batch - n_fg
+    if bg_idx.size == 0:
+        bg_idx = np.zeros((0,), int)
+    take_bg = rng.choice(bg_idx, n_bg, replace=bg_idx.size < n_bg) \
+        if bg_idx.size else np.zeros((0,), int)
+    keep = np.concatenate([fg_idx, take_bg]).astype(int)
+    # pad by repeating entries if still short (tiny images)
+    while keep.size < cfg.roi_batch:
+        keep = np.concatenate([keep, keep[:cfg.roi_batch - keep.size]])
+    rois = cand[keep]
+    # labels/targets follow the KEPT rows' own IoU — a padded row that
+    # duplicates a foreground roi must stay foreground, or the same box
+    # trains as object and background in one batch
+    k_best = best[keep]
+    is_fg = best_iou[keep] >= cfg.roi_fg_iou
+    labels = np.where(is_fg, gt_classes[k_best], 0).astype(np.float32)
+
+    C = cfg.num_classes + 1
+    targets = np.zeros((cfg.roi_batch, 4 * C), np.float32)
+    weights = np.zeros_like(targets)
+    fg_rows = np.where(is_fg)[0]
+    if fg_rows.size:
+        deltas = bbox_transform(rois[fg_rows], gt_boxes[k_best[fg_rows]])
+        for j, i in enumerate(fg_rows):
+            c = int(labels[i])
+            targets[i, 4 * c:4 * c + 4] = deltas[j]
+            weights[i, 4 * c:4 * c + 4] = 1.0
+    return rois, labels, targets, weights

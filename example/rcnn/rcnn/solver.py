@@ -1,0 +1,74 @@
+"""Stage trainer (reference rcnn/solver.py + rcnn/module.py): wraps a
+Module with the detection-specific conveniences the tools need —
+partial init from a previous stage's params, frozen trunk, resumable
+epochs, per-epoch checkpointing, batch/epoch callbacks.
+
+Where the reference carries a custom Module subclass for mutable data
+shapes, fixed-shape loaders make the stock Module sufficient; the
+solver is the orchestration layer only.
+"""
+import logging
+
+import mxnet_tpu as mx
+
+
+class Solver:
+    def __init__(self, symbol, data_names, label_names, ctx=None,
+                 arg_params=None, aux_params=None, fixed_param_names=None,
+                 begin_epoch=0, num_epoch=1, prefix=None,
+                 optimizer_params=None, no_slice_names=()):
+        self.symbol = symbol
+        self.data_names = list(data_names)
+        self.label_names = list(label_names)
+        self.ctx = ctx or mx.current_context()
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.fixed_param_names = fixed_param_names
+        self.begin_epoch = begin_epoch
+        self.num_epoch = num_epoch
+        self.prefix = prefix
+        self.optimizer_params = optimizer_params or {
+            "learning_rate": 0.01, "momentum": 0.9, "wd": 5e-4}
+        self.no_slice_names = tuple(no_slice_names)
+        self.module = None
+
+    def _bind(self, train_iter):
+        mod = mx.mod.Module(self.symbol, data_names=self.data_names,
+                            label_names=self.label_names,
+                            context=self.ctx,
+                            fixed_param_names=self.fixed_param_names)
+        mod.bind(train_iter.provide_data, train_iter.provide_label,
+                 no_slice_names=self.no_slice_names)
+        mod.init_params(mx.init.Xavier(), arg_params=self.arg_params,
+                        aux_params=self.aux_params, allow_missing=True)
+        mod.init_optimizer(optimizer_params=self.optimizer_params)
+        self.module = mod
+        return mod
+
+    def fit(self, train_iter, metric, batch_end_callback=None,
+            epoch_end_callback=None):
+        """Callbacks use the stock signatures (mx.callback.Speedometer /
+        do_checkpoint plug in directly)."""
+        from mxnet_tpu.model import BatchEndParam
+        mod = self.module or self._bind(train_iter)
+        for epoch in range(self.begin_epoch, self.num_epoch):
+            metric.reset()
+            n_batch = 0
+            for batch in train_iter:   # __iter__ resets the loader
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                mod.update()
+                mod.update_metric(metric, batch.label)
+                n_batch += 1
+                if batch_end_callback is not None:
+                    batch_end_callback(BatchEndParam(
+                        epoch=epoch, nbatch=n_batch, eval_metric=metric,
+                        locals=None))
+            logging.info("epoch %d %s=%.4f", epoch, *metric.get())
+            arg_p, aux_p = mod.get_params()
+            if epoch_end_callback is not None:
+                epoch_end_callback(epoch, self.symbol, arg_p, aux_p)
+            elif self.prefix:
+                mx.model.save_checkpoint(self.prefix, epoch + 1,
+                                         self.symbol, arg_p, aux_p)
+        return mod

@@ -81,3 +81,22 @@ def get_rcnn_test(cfg, small=True):
     deltas = mx.sym.FullyConnected(relu7, num_hidden=4 * C,
                                    name="bbox_pred")
     return mx.sym.Group([cls_prob, deltas])
+
+
+def get_fast_rcnn_train(cfg, small=True):
+    """Training symbol for the detection head stage, configured from cfg
+    (inputs: data, rois, label, bbox_target, bbox_weight)."""
+    return get_fast_rcnn(num_classes=cfg.num_classes + 1,
+                         pooled_size=(4, 4),
+                         spatial_scale=cfg.spatial_scale, small=small)
+
+
+def shared_trunk_params(cfg):
+    """Conv-trunk weights shared between the two stages: the arg names
+    the RPN and Fast R-CNN symbols have in common (what alternate
+    training freezes in steps 3-4)."""
+    rpn_args = set(get_rpn_train(cfg).list_arguments())
+    rcnn_args = set(get_fast_rcnn_train(cfg).list_arguments())
+    inputs = {"data", "rois", "label", "bbox_target", "bbox_weight",
+              "rpn_label", "rpn_bbox_target", "rpn_bbox_weight"}
+    return sorted((rpn_args & rcnn_args) - inputs)

@@ -1,0 +1,110 @@
+"""Testing/generation machinery (reference rcnn/tester.py +
+rcnn/rpn/generate.py): run a trained RPN over a dataset to produce
+proposals (with recall reporting), and run the full two-stage detector
+to produce detections + VOC mAP.  The tools/ CLIs are thin wrappers
+over these functions; train_alternate.py drives them in-process.
+"""
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+from .bbox import bbox_overlaps
+from .detector import Detector
+from .symbol import get_rcnn_test, get_rpn_test
+from .voc_eval import eval_detections
+
+
+def load_rpn_test(cfg, arg_params, aux_params, ctx=None):
+    """Bind the RPN test symbol with a trained stage's params."""
+    mod = mx.mod.Module(get_rpn_test(cfg), data_names=["data"],
+                        label_names=[],
+                        context=ctx or mx.current_context())
+    mod.bind([("data", (1, 3, cfg.img_size, cfg.img_size))],
+             for_training=False)
+    mod.init_params(arg_params=arg_params, aux_params=aux_params,
+                    allow_missing=True)
+    return mod
+
+
+def load_rcnn_test(cfg, arg_params, aux_params, ctx=None):
+    """Bind the Fast R-CNN test symbol with a trained stage's params."""
+    mod = mx.mod.Module(get_rcnn_test(cfg), data_names=["data", "rois"],
+                        label_names=[],
+                        context=ctx or mx.current_context())
+    R = cfg.post_nms_top
+    mod.bind([("data", (1, 3, cfg.img_size, cfg.img_size)),
+              ("rois", (R, 5))], for_training=False,
+             no_slice_names=("rois",))
+    mod.init_params(arg_params=arg_params, aux_params=aux_params,
+                    allow_missing=True)
+    return mod
+
+
+def generate_proposals(rpn_test_mod, dataset, cfg):
+    """RPN over the whole set -> [(props, mask, scores)] (reference
+    rcnn/rpn/generate.py)."""
+    det = Detector(rpn_test_mod, None, cfg)
+    return [det.propose(img) for img, _, _ in dataset]
+
+
+def proposal_recall(proposals, dataset, cfg, iou=0.5):
+    """Fraction of ground-truth boxes covered by some valid proposal at
+    the IoU threshold — the number test_rpn reports."""
+    covered = total = 0
+    for (props, mask, _), (_, gt_boxes, _) in zip(proposals, dataset):
+        total += len(gt_boxes)
+        valid = props[mask.astype(bool)] if mask.dtype != bool \
+            else props[mask]
+        if len(valid) == 0:
+            continue
+        ious = bbox_overlaps(valid, gt_boxes)
+        covered += int((ious.max(axis=0) >= iou).sum())
+    return covered / max(total, 1)
+
+
+def save_proposals(path, proposals, n_images=None, data_seed=None):
+    """Persist proposals between stage tools (npz, one entry triple per
+    image) plus the dataset identity they were generated on, so a
+    mismatched train_rcnn invocation fails loudly instead of silently
+    training on wrong labels."""
+    flat = {}
+    for i, (props, mask, scores) in enumerate(proposals):
+        flat["props_%d" % i] = props
+        flat["mask_%d" % i] = mask
+        flat["scores_%d" % i] = scores
+    flat["n"] = np.asarray(len(proposals))
+    if n_images is not None:
+        flat["n_images"] = np.asarray(n_images)
+    if data_seed is not None:
+        flat["data_seed"] = np.asarray(data_seed)
+    np.savez(path, **flat)
+
+
+def load_proposals(path, expect_images=None, expect_seed=None):
+    z = np.load(path)
+    n = int(z["n"])
+    for key, expect in (("n_images", expect_images),
+                        ("data_seed", expect_seed)):
+        if expect is not None and key in z and int(z[key]) != expect:
+            raise ValueError(
+                "proposal file %s was generated with %s=%d, this run uses "
+                "%d — regenerate with test_rpn.py" %
+                (path, key, int(z[key]), expect))
+    return [(z["props_%d" % i], z["mask_%d" % i], z["scores_%d" % i])
+            for i in range(n)]
+
+
+def test_detector(rpn_test_mod, rcnn_test_mod, test_set, cfg):
+    """Full two-stage inference over a set -> (per-class AP, mAP)."""
+    det = Detector(rpn_test_mod, rcnn_test_mod, cfg)
+    all_dets, annotations = {}, {}
+    for i, (img, gt_boxes, gt_classes) in enumerate(test_set):
+        annotations[i] = (gt_boxes, gt_classes)
+        for cls, rows in det.detect(img, img_id=i).items():
+            all_dets.setdefault(cls, []).extend(rows)
+    aps, mean_ap = eval_detections(all_dets, annotations, cfg.num_classes)
+    for cls, ap_v in sorted(aps.items()):
+        logging.info("class %d AP = %.4f", cls, ap_v)
+    return aps, mean_ap

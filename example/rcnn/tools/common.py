@@ -1,0 +1,44 @@
+"""Shared CLI plumbing for the stage tools (reference
+example/rcnn/tools/*): dataset regeneration (the synthetic VOC stand-in
+is seed-deterministic, so stages rebuild it instead of passing imdb
+pickles), context parsing, checkpoint loading."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                "..", "..", ".."))
+
+
+def base_parser(description):
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("--tpus", type=str, help="comma-separated device ids")
+    ap.add_argument("--train-images", type=int, default=64)
+    ap.add_argument("--test-images", type=int, default=16)
+    ap.add_argument("--data-seed", type=int, default=1)
+    ap.add_argument("--test-seed", type=int, default=2)
+    return ap
+
+
+def setup(args):
+    """-> (mx, cfg, ctx); import deferred so --help costs nothing."""
+    logging.basicConfig(level=logging.INFO)
+    import mxnet_tpu as mx
+    from rcnn.config import Config
+    cfg = Config()
+    mx.random.seed(3)
+    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
+        else mx.current_context()
+    return mx, cfg, ctx
+
+
+def train_set(cfg, args):
+    from rcnn.dataset import make_dataset
+    return make_dataset(cfg, args.train_images, seed=args.data_seed)
+
+
+def test_set(cfg, args):
+    from rcnn.dataset import make_dataset
+    return make_dataset(cfg, args.test_images, seed=args.test_seed)

@@ -1,0 +1,39 @@
+"""Stage tool: full two-stage detection eval (reference tools/test_net.py
++ rcnn/tester.py): load the RPN and Fast R-CNN checkpoints, run
+proposal -> classify -> regress -> NMS over the held-out set, print
+per-class AP and mAP.
+
+  python tools/test_net.py --rpn-prefix /tmp/rpn2 --rpn-epoch 8 \
+      --rcnn-prefix /tmp/rcnn2 --rcnn-epoch 8 --map-gate 0.5
+"""
+from common import base_parser, setup, test_set
+
+
+def main():
+    ap = base_parser("evaluate the two-stage detector (VOC mAP)")
+    ap.add_argument("--rpn-prefix", required=True)
+    ap.add_argument("--rpn-epoch", type=int, required=True)
+    ap.add_argument("--rcnn-prefix", required=True)
+    ap.add_argument("--rcnn-epoch", type=int, required=True)
+    ap.add_argument("--map-gate", type=float, default=0.0)
+    args = ap.parse_args()
+    mx, cfg, ctx = setup(args)
+
+    from rcnn.tester import load_rcnn_test, load_rpn_test, test_detector
+
+    _, rpn_arg, rpn_aux = mx.model.load_checkpoint(args.rpn_prefix,
+                                                   args.rpn_epoch)
+    _, rcnn_arg, rcnn_aux = mx.model.load_checkpoint(args.rcnn_prefix,
+                                                     args.rcnn_epoch)
+    rpn = load_rpn_test(cfg, rpn_arg, rpn_aux, ctx=ctx)
+    rcnn = load_rcnn_test(cfg, rcnn_arg, rcnn_aux, ctx=ctx)
+    _, mean_ap = test_detector(rpn, rcnn, test_set(cfg, args), cfg)
+    print("mAP=%.4f" % mean_ap)
+    if args.map_gate:
+        assert mean_ap >= args.map_gate, \
+            "mAP gate failed: %.4f < %.2f" % (mean_ap, args.map_gate)
+        print("PASSED")
+
+
+if __name__ == "__main__":
+    main()

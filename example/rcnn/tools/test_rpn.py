@@ -1,0 +1,40 @@
+"""Stage tool: evaluate a trained RPN and emit its proposals (reference
+tools/test_rpn.py + rcnn/rpn/generate.py): reports ground-truth recall
+at IoU 0.5 and saves the proposal set the next stage trains on.
+
+  python tools/test_rpn.py --prefix /tmp/rpn1 --epoch 8 \
+      --proposals /tmp/props1.npz
+"""
+from common import base_parser, setup, train_set
+
+
+def main():
+    ap = base_parser("evaluate RPN proposals + recall")
+    ap.add_argument("--prefix", required=True)
+    ap.add_argument("--epoch", type=int, required=True)
+    ap.add_argument("--proposals", required=True,
+                    help="npz path to write the proposal set to")
+    ap.add_argument("--recall-gate", type=float, default=0.0)
+    args = ap.parse_args()
+    mx, cfg, ctx = setup(args)
+
+    from rcnn.tester import (generate_proposals, load_rpn_test,
+                             proposal_recall, save_proposals)
+
+    _, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                         args.epoch)
+    rpn = load_rpn_test(cfg, arg_params, aux_params, ctx=ctx)
+    dataset = train_set(cfg, args)
+    proposals = generate_proposals(rpn, dataset, cfg)
+    recall = proposal_recall(proposals, dataset, cfg)
+    save_proposals(args.proposals, proposals,
+                   n_images=args.train_images, data_seed=args.data_seed)
+    print("recall@0.5=%.4f" % recall)
+    if args.recall_gate:
+        assert recall >= args.recall_gate, \
+            "recall gate failed: %.4f < %.2f" % (recall, args.recall_gate)
+        print("PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Stage tool: train the Fast R-CNN head on saved proposals (reference
+tools/train_rcnn.py).
+
+Steps 2 and 4 of alternate training:
+  step 2:  python tools/train_rcnn.py --prefix /tmp/rcnn1 \
+               --proposals /tmp/props1.npz
+  step 4:  python tools/train_rcnn.py --prefix /tmp/rcnn2 \
+               --proposals /tmp/props2.npz \
+               --init-prefix /tmp/rcnn1 --init-epoch 8 --freeze-trunk
+"""
+from common import base_parser, setup, train_set
+
+
+def main():
+    ap = base_parser("train the Fast R-CNN head on proposals")
+    ap.add_argument("--prefix", required=True)
+    ap.add_argument("--proposals", required=True,
+                    help="npz written by test_rpn.py")
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--begin-epoch", type=int, default=0)
+    ap.add_argument("--init-prefix")
+    ap.add_argument("--init-epoch", type=int, default=0)
+    ap.add_argument("--freeze-trunk", action="store_true")
+    ap.add_argument("--seed", type=int, default=11)
+    args = ap.parse_args()
+    mx, cfg, ctx = setup(args)
+
+    from rcnn.data_iter import PrefetchingIter
+    from rcnn.loader import ROIIter
+    from rcnn.metric import RCNNAccuracy
+    from rcnn.solver import Solver
+    from rcnn.symbol import get_fast_rcnn_train, shared_trunk_params
+    from rcnn.tester import load_proposals
+
+    arg_params = aux_params = None
+    if args.begin_epoch:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.prefix, args.begin_epoch)
+    elif args.init_prefix:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.init_prefix, args.init_epoch)
+
+    it = PrefetchingIter(
+        ROIIter(train_set(cfg, args),
+                load_proposals(args.proposals,
+                               expect_images=args.train_images,
+                               expect_seed=args.data_seed),
+                cfg, seed=args.seed))
+    solver = Solver(
+        get_fast_rcnn_train(cfg), data_names=["data", "rois"],
+        label_names=["label", "bbox_target", "bbox_weight"],
+        ctx=ctx, arg_params=arg_params, aux_params=aux_params,
+        fixed_param_names=shared_trunk_params(cfg)
+        if args.freeze_trunk else None,
+        begin_epoch=args.begin_epoch, num_epoch=args.epochs,
+        prefix=args.prefix,
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                          "wd": 5e-4},
+        no_slice_names=("rois",))
+    solver.fit(it, RCNNAccuracy(),
+               batch_end_callback=mx.callback.Speedometer(
+                   it.provide_data[0][1][0], frequent=20))
+    print("TRAIN-RCNN-DONE %s-%04d.params" % (args.prefix, args.epochs))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Stage tool: train the RPN (reference tools/train_rpn.py).
+
+Steps 1 and 3 of alternate training:
+  step 1:  python tools/train_rpn.py --prefix /tmp/rpn1
+  step 3:  python tools/train_rpn.py --prefix /tmp/rpn2 \
+               --init-prefix /tmp/rcnn1 --init-epoch 8 --freeze-trunk
+"""
+from common import base_parser, setup, train_set
+
+
+def main():
+    ap = base_parser("train the region proposal network")
+    ap.add_argument("--prefix", required=True,
+                    help="checkpoint prefix to write")
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--begin-epoch", type=int, default=0,
+                    help="resume from this epoch's checkpoint of --prefix")
+    ap.add_argument("--init-prefix", help="initialize from this checkpoint")
+    ap.add_argument("--init-epoch", type=int, default=0)
+    ap.add_argument("--freeze-trunk", action="store_true",
+                    help="fix the shared conv trunk (alternate step 3)")
+    ap.add_argument("--seed", type=int, default=10)
+    args = ap.parse_args()
+    mx, cfg, ctx = setup(args)
+
+    from rcnn.data_iter import PrefetchingIter
+    from rcnn.loader import AnchorLoader
+    from rcnn.metric import RPNAccuracy
+    from rcnn.solver import Solver
+    from rcnn.symbol import get_rpn_train, shared_trunk_params
+
+    arg_params = aux_params = None
+    if args.begin_epoch:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.prefix, args.begin_epoch)
+    elif args.init_prefix:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.init_prefix, args.init_epoch)
+
+    it = PrefetchingIter(
+        AnchorLoader(train_set(cfg, args), cfg, seed=args.seed))
+    solver = Solver(
+        get_rpn_train(cfg), data_names=["data"],
+        label_names=["rpn_label", "rpn_bbox_target", "rpn_bbox_weight"],
+        ctx=ctx, arg_params=arg_params, aux_params=aux_params,
+        fixed_param_names=shared_trunk_params(cfg)
+        if args.freeze_trunk else None,
+        begin_epoch=args.begin_epoch, num_epoch=args.epochs,
+        prefix=args.prefix,
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                          "wd": 5e-4})
+    solver.fit(it, RPNAccuracy(),
+               batch_end_callback=mx.callback.Speedometer(
+                   it.provide_data[0][1][0], frequent=20))
+    print("TRAIN-RPN-DONE %s-%04d.params" % (args.prefix, args.epochs))
+
+
+if __name__ == "__main__":
+    main()

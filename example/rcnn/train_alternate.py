@@ -23,49 +23,25 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import mxnet_tpu as mx
 from rcnn.config import Config
+from rcnn.data_iter import PrefetchingIter
 from rcnn.dataset import make_dataset
-from rcnn.detector import Detector
 from rcnn.loader import AnchorLoader, ROIIter
-from rcnn.metric import RCNNAccuracy, RPNAccuracy, SmoothL1Metric
-from rcnn.symbol import get_fast_rcnn, get_rcnn_test, get_rpn_test, \
-    get_rpn_train
-from rcnn.voc_eval import eval_detections
-
-
-def trunk_param_names(cfg):
-    """Conv-trunk weights shared between the two stages: the arg names
-    the RPN and Fast R-CNN symbols have in common."""
-    rpn_args = set(get_rpn_train(cfg).list_arguments())
-    rcnn_args = set(get_fast_rcnn(num_classes=cfg.num_classes + 1,
-                                  pooled_size=(4, 4),
-                                  spatial_scale=cfg.spatial_scale,
-                                  small=True).list_arguments())
-    inputs = {"data", "rois", "label", "bbox_target", "bbox_weight",
-              "rpn_label", "rpn_bbox_target", "rpn_bbox_weight"}
-    return sorted((rpn_args & rcnn_args) - inputs)
+from rcnn.metric import RCNNAccuracy, RPNAccuracy
+from rcnn.solver import Solver
+from rcnn.symbol import get_fast_rcnn_train, get_rpn_train, \
+    shared_trunk_params
+from rcnn.tester import generate_proposals, load_rcnn_test, \
+    load_rpn_test, test_detector
 
 
 def fit(symbol, it, cfg, metric, epochs, lr, data_names, label_names,
         arg_params=None, fixed=None, ctx=None, no_slice=()):
-    mod = mx.mod.Module(symbol, data_names=data_names,
-                        label_names=label_names,
-                        context=ctx or mx.current_context(),
-                        fixed_param_names=fixed)
-    mod.bind(it.provide_data, it.provide_label, no_slice_names=no_slice)
-    mod.init_params(mx.init.Xavier(), arg_params=arg_params,
-                    allow_missing=True)
-    mod.init_optimizer(optimizer_params={"learning_rate": lr,
-                                         "momentum": 0.9, "wd": 5e-4})
-    for epoch in range(epochs):
-        metric.reset()
-        it.reset()
-        for batch in it:
-            mod.forward(batch, is_train=True)
-            mod.backward()
-            mod.update()
-            mod.update_metric(metric, batch.label)
-        logging.info("epoch %d %s=%.4f", epoch, *metric.get())
-    return mod
+    solver = Solver(symbol, data_names, label_names, ctx=ctx,
+                    arg_params=arg_params, fixed_param_names=fixed,
+                    num_epoch=epochs, no_slice_names=no_slice,
+                    optimizer_params={"learning_rate": lr,
+                                      "momentum": 0.9, "wd": 5e-4})
+    return solver.fit(PrefetchingIter(it), metric)
 
 
 def train_rpn(dataset, cfg, epochs, lr, arg_params=None, fixed=None,
@@ -80,25 +56,16 @@ def train_rpn(dataset, cfg, epochs, lr, arg_params=None, fixed=None,
 
 
 def rpn_proposals(rpn_mod, dataset, cfg, ctx=None):
-    """Run the trained RPN over the whole set (reference
-    rcnn/rpn/generate.py)."""
-    test = mx.mod.Module(get_rpn_test(cfg), data_names=["data"],
-                         label_names=[],
-                         context=ctx or mx.current_context())
-    test.bind([("data", (1, 3, cfg.img_size, cfg.img_size))],
-              for_training=False)
+    """Run the trained RPN over the whole set (rcnn/tester.py)."""
     arg_p, aux_p = rpn_mod.get_params()
-    test.init_params(arg_params=arg_p, aux_params=aux_p,
-                     allow_missing=True)
-    det = Detector(test, None, cfg)
-    return [det.propose(img) for img, _, _ in dataset]
+    return generate_proposals(load_rpn_test(cfg, arg_p, aux_p, ctx=ctx),
+                              dataset, cfg)
 
 
 def train_rcnn(dataset, proposals, cfg, epochs, lr, arg_params=None,
                fixed=None, ctx=None, seed=0):
     it = ROIIter(dataset, proposals, cfg, seed=seed)
-    sym = get_fast_rcnn(num_classes=cfg.num_classes + 1, pooled_size=(4, 4),
-                        spatial_scale=cfg.spatial_scale, small=True)
+    sym = get_fast_rcnn_train(cfg)
     return fit(sym, it, cfg, RCNNAccuracy(), epochs, lr,
                data_names=["data", "rois"],
                label_names=["label", "bbox_target", "bbox_weight"],
@@ -107,32 +74,12 @@ def train_rcnn(dataset, proposals, cfg, epochs, lr, arg_params=None,
 
 
 def evaluate(rpn_mod, rcnn_mod, test_set, cfg, ctx=None):
-    """Shared-trunk two-stage inference + VOC mAP."""
-    ctx = ctx or mx.current_context()
-    rpn_test = mx.mod.Module(get_rpn_test(cfg), data_names=["data"],
-                             label_names=[], context=ctx)
-    rpn_test.bind([("data", (1, 3, cfg.img_size, cfg.img_size))],
-                  for_training=False)
+    """Shared-trunk two-stage inference + VOC mAP (rcnn/tester.py)."""
     p, a = rpn_mod.get_params()
-    rpn_test.init_params(arg_params=p, aux_params=a, allow_missing=True)
-
-    rcnn_test = mx.mod.Module(get_rcnn_test(cfg),
-                              data_names=["data", "rois"],
-                              label_names=[], context=ctx)
-    R = cfg.post_nms_top
-    rcnn_test.bind([("data", (1, 3, cfg.img_size, cfg.img_size)),
-                    ("rois", (R, 5))], for_training=False,
-                   no_slice_names=("rois",))
+    rpn_test = load_rpn_test(cfg, p, a, ctx=ctx)
     p, a = rcnn_mod.get_params()
-    rcnn_test.init_params(arg_params=p, aux_params=a, allow_missing=True)
-
-    det = Detector(rpn_test, rcnn_test, cfg)
-    all_dets, annotations = {}, {}
-    for i, (img, gt_boxes, gt_classes) in enumerate(test_set):
-        annotations[i] = (gt_boxes, gt_classes)
-        for cls, rows in det.detect(img, img_id=i).items():
-            all_dets.setdefault(cls, []).extend(rows)
-    return eval_detections(all_dets, annotations, cfg.num_classes)
+    rcnn_test = load_rcnn_test(cfg, p, a, ctx=ctx)
+    return test_detector(rpn_test, rcnn_test, test_set, cfg)
 
 
 def main():
@@ -142,6 +89,8 @@ def main():
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--train-images", type=int, default=64)
     ap.add_argument("--test-images", type=int, default=16)
+    ap.add_argument("--data-seed", type=int, default=1)
+    ap.add_argument("--test-seed", type=int, default=2)
     ap.add_argument("--map-gate", type=float, default=0.0,
                     help="assert final mAP >= this (CI gate)")
     ap.add_argument("--model-prefix", type=str)
@@ -152,9 +101,11 @@ def main():
     ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
         else mx.current_context()
     mx.random.seed(3)
-    train_set = make_dataset(cfg, args.train_images, seed=1)
-    test_set = make_dataset(cfg, args.test_images, seed=2)
-    shared = trunk_param_names(cfg)
+    train_set = make_dataset(cfg, args.train_images,
+                             seed=args.data_seed)
+    test_set = make_dataset(cfg, args.test_images,
+                            seed=args.test_seed)
+    shared = shared_trunk_params(cfg)
     logging.info("shared trunk params: %s", shared)
 
     logging.info("=== step 1: train RPN-1 (from scratch)")
@@ -186,8 +137,6 @@ def main():
             "trunk diverged on %s" % n
 
     aps, mean_ap = evaluate(rpn2, rcnn2, test_set, cfg, ctx=ctx)
-    for cls, ap_v in sorted(aps.items()):
-        logging.info("class %d AP = %.4f", cls, ap_v)
     print("mAP=%.4f" % mean_ap)
 
     if args.model_prefix:

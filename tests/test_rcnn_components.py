@@ -133,3 +133,43 @@ def test_train_alternate_end_to_end():
         cwd=RCNN_DIR, env=env, capture_output=True, text=True, timeout=560)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "PASSED" in res.stdout, res.stdout + res.stderr
+
+
+@pytest.mark.slow
+def test_rcnn_stage_tools(tmp_path):
+    """The 4-stage alternate schedule callable STAGE-BY-STAGE from the
+    tools/ CLIs (reference tools/{train_rpn,test_rpn,train_rcnn,
+    test_net}.py), checkpoints and proposal files handing off between
+    processes; the final eval prints mAP and passes the gate."""
+    tools = os.path.join(RCNN_DIR, "tools")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = str(tmp_path)
+    common = ["--train-images", "32", "--test-images", "8"]
+
+    def run(script, *args):
+        res = subprocess.run([sys.executable, script] + list(args) + common,
+                             cwd=tools, env=env, capture_output=True,
+                             text=True, timeout=560)
+        assert res.returncode == 0, res.stdout + res.stderr
+        return res.stdout
+
+    run("train_rpn.py", "--prefix", p + "/rpn1", "--epochs", "5")
+    out = run("test_rpn.py", "--prefix", p + "/rpn1", "--epoch", "5",
+              "--proposals", p + "/p1.npz", "--recall-gate", "0.8")
+    assert "PASSED" in out
+    run("train_rcnn.py", "--prefix", p + "/rcnn1",
+        "--proposals", p + "/p1.npz", "--epochs", "5")
+    run("train_rpn.py", "--prefix", p + "/rpn2", "--epochs", "5",
+        "--init-prefix", p + "/rcnn1", "--init-epoch", "5",
+        "--freeze-trunk")
+    run("test_rpn.py", "--prefix", p + "/rpn2", "--epoch", "5",
+        "--proposals", p + "/p2.npz")
+    run("train_rcnn.py", "--prefix", p + "/rcnn2",
+        "--proposals", p + "/p2.npz", "--epochs", "5",
+        "--init-prefix", p + "/rcnn1", "--init-epoch", "5",
+        "--freeze-trunk")
+    out = run("test_net.py", "--rpn-prefix", p + "/rpn2",
+              "--rpn-epoch", "5", "--rcnn-prefix", p + "/rcnn2",
+              "--rcnn-epoch", "5", "--map-gate", "0.4")
+    assert "mAP=" in out and "PASSED" in out
