@@ -1,7 +1,16 @@
 """Kaldi-format feature IO (reference example/speech-demo/io_func/):
-binary ark/scp matrix archives, the interchange format every Kaldi
-recipe speaks.  kaldi_io implements the byte-level format; the higher
-level iterators in ../io_util.py consume either these archives or the
-portable .npz ones."""
-from .kaldi_io import (read_ark, read_mat, read_scp, read_vec,  # noqa: F401
+
+- kaldi_io: the byte-level ark/scp format (binary + text archives);
+- feat_readers/: per-format readers (kaldi, htk, bvec, atrack) behind a
+  common (features, labels) protocol + corpus statistics;
+- feat_io: partitioned streaming reads over list files (DataReadStream);
+- kaldi_parser / model_io / convert2kaldi: nnet1 text interchange so
+  Kaldi's nnet-forward can decode networks trained here.
+
+The higher-level iterators in ../io_util.py consume these archives or
+the portable .npz ones."""
+from .feat_io import DataReadStream  # noqa: F401
+from .feat_readers import FeatureStats, get_reader  # noqa: F401
+from .kaldi_io import (read_ark, read_ark_ascii, read_mat,  # noqa: F401
+                       read_scp, read_vec, write_ark_ascii,
                        write_ark_scp, write_mat, write_vec)

@@ -1,0 +1,48 @@
+"""Convert a trained acoustic-model checkpoint to Kaldi nnet1 text
+(reference io_func/convert2kaldi.py): the bridge that lets Kaldi's
+nnet-forward decode with a network trained here.
+
+    python -m io_func.convert2kaldi --prefix mlp --epoch 10 \
+        --layers fc1,fc2,fc3 --out final.nnet
+
+Hidden layers become <AffineTransform>+<Sigmoid>, the last layer
+<AffineTransform>+<Softmax>.  The inverse (read_nnet -> arg_params) is
+in model_io/kaldi_parser, so conversions round-trip in the suite.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                ".."))
+
+
+def convert(arg_params, prefixes, out_path, activation="Sigmoid"):
+    from . import kaldi_parser, model_io
+    layers = model_io.layers_from_arg_params(arg_params, prefixes)
+    blocks = []
+    for i, (weight, bias) in enumerate(layers):
+        act = "Softmax" if i == len(layers) - 1 else activation
+        blocks.append((weight, bias, act))
+    kaldi_parser.write_nnet(out_path, blocks)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prefix", required=True)
+    ap.add_argument("--epoch", type=int, required=True)
+    ap.add_argument("--layers", required=True,
+                    help="comma-separated fc-layer name prefixes in order")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--activation", default="Sigmoid")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    _, arg_params, _ = mx.model.load_checkpoint(args.prefix, args.epoch)
+    convert(arg_params, args.layers.split(","), args.out,
+            activation=args.activation)
+    print("CONVERT2KALDI-OK %s" % args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Partitioned streaming feature reads (reference io_func/feat_io.py
+DataReadStream): a list file names one utterance's feature file (and
+optional label file) per line; the stream reads through format-
+dispatched feat_readers, optionally normalizes with saved FeatureStats,
+shuffles at the frame level within a bounded in-memory partition, and
+yields (X, y) chunks sized for device transfer.
+
+Where the reference buffered into pinned "gpu chunks", the partition
+here is just the host-side staging buffer ahead of the fused TPU step —
+the iterator protocol (load_next_partition / get_state / set_state)
+is preserved so training loops can checkpoint mid-corpus.
+"""
+import numpy as np
+
+from .feat_readers import FeatureStats, get_reader
+
+
+class DataReadStream:
+    def __init__(self, lst_file, file_format="kaldi", train_stat=None,
+                 partition_frames=4096, shuffle=False, seed=0,
+                 has_labels=True):
+        self.file_format = file_format
+        self.partition_frames = partition_frames
+        self.shuffle = shuffle
+        self.seed = seed
+        self.has_labels = has_labels
+        self.stats = FeatureStats.load(train_stat) if train_stat else None
+        self.entries = []     # (feature_file, label_file or None)
+        with open(lst_file) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                self.entries.append(
+                    (parts[0], parts[1] if len(parts) > 1 else None))
+        if not self.entries:
+            raise ValueError("empty list file %s" % lst_file)
+        self._entry_idx = 0
+        self._reader = None
+        self._rng = np.random.RandomState(seed)
+
+    # -- iterator state (mid-corpus checkpointing) -----------------------
+    def get_state(self):
+        """Resume-exact state: the live multi-utterance reader's position
+        is recorded (entry index + utterances consumed), and so is the
+        shuffle RNG's state — set_state replays the identical stream."""
+        st = {"entry_idx": self._entry_idx,
+              "rng": self._rng.get_state()}
+        if self._reader is not None:
+            st["reader_entry"] = self._entry_idx - 1
+            st["reader_pos"] = getattr(self._reader, "_pos", 0)
+        return st
+
+    def set_state(self, state):
+        self._entry_idx = state["entry_idx"]
+        self._reader = None
+        self._rng = np.random.RandomState(self.seed)
+        self._rng.set_state(state["rng"])
+        if "reader_entry" in state:
+            feat_f, label_f = self.entries[state["reader_entry"]]
+            self._reader = get_reader(
+                self.file_format, feat_f,
+                label_f if self.has_labels else None)
+            for _ in range(state["reader_pos"]):
+                self._reader.read()
+
+    def reset(self):
+        self._entry_idx = 0
+        self._reader = None
+        self._rng = np.random.RandomState(self.seed)
+
+    # -- reading ---------------------------------------------------------
+    def _next_utt(self):
+        """(feats, labels) of the next utterance; None at corpus end."""
+        while True:
+            if self._reader is None:
+                if self._entry_idx >= len(self.entries):
+                    return None
+                feat_f, label_f = self.entries[self._entry_idx]
+                self._entry_idx += 1
+                self._reader = get_reader(
+                    self.file_format, feat_f,
+                    label_f if self.has_labels else None)
+            feats, labels = self._reader.read()
+            if self._reader.is_done():
+                self._reader = None
+                if feats is None:
+                    continue   # reader exhausted exactly at boundary
+            if feats is not None:
+                if self.has_labels and labels is None:
+                    raise ValueError(
+                        "has_labels=True but no labels for an utterance "
+                        "of %s (missing label column in the list file?)"
+                        % self.entries[self._entry_idx - 1][0])
+                if self.stats is not None:
+                    feats = self.stats.apply(feats)
+                return feats, labels
+
+    def load_next_partition(self):
+        """Up to partition_frames frames -> (X float32, y int32 or None);
+        None when the corpus is exhausted."""
+        xs, ys, n = [], [], 0
+        while n < self.partition_frames:
+            nxt = self._next_utt()
+            if nxt is None:
+                break
+            feats, labels = nxt
+            xs.append(np.asarray(feats, np.float32))
+            if labels is not None:
+                ys.append(np.asarray(labels, np.int32))
+            n += len(feats)
+        if not xs:
+            return None
+        X = np.concatenate(xs, axis=0)
+        y = np.concatenate(ys, axis=0) if ys else None
+        if self.shuffle:
+            order = self._rng.permutation(len(X))
+            X = X[order]
+            y = y[order] if y is not None else None
+        return X, y
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        part = self.load_next_partition()
+        if part is None:
+            raise StopIteration
+        return part

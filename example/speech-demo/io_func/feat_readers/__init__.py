@@ -1,0 +1,26 @@
+"""Feature-file readers (reference io_func/feat_readers/): one reader
+class per on-disk format, a common (features, labels) protocol, and the
+corpus statistics accumulator."""
+from .common import BaseReader, ByteOrder, FeatureException  # noqa: F401
+from .stats import FeatureStats, StreamingVariance  # noqa: F401
+
+
+def get_reader(file_format, feature_file, label_file=None):
+    """Format-dispatched reader construction (reference common.getReader)."""
+    fmt = file_format.lower()
+    if fmt == "htk":
+        from .reader_htk import HtkReader
+        return HtkReader(feature_file, label_file, ByteOrder.BigEndian)
+    if fmt == "htk_little":
+        from .reader_htk import HtkReader
+        return HtkReader(feature_file, label_file, ByteOrder.LittleEndian)
+    if fmt == "bvec":
+        from .reader_bvec import BvecReader
+        return BvecReader(feature_file, label_file)
+    if fmt == "atrack":
+        from .reader_atrack import AtrackReader
+        return AtrackReader(feature_file, label_file)
+    if fmt == "kaldi":
+        from .reader_kaldi import KaldiReader
+        return KaldiReader(feature_file, label_file)
+    raise ValueError("unsupported feature format %r" % file_format)

@@ -1,0 +1,45 @@
+"""Shared reader protocol (reference io_func/feat_readers/common.py):
+every concrete reader returns (features float32 (T, D), labels int32
+(T,) or None) from its read() and exposes the utterance id."""
+import os
+
+import numpy as np
+
+
+class ByteOrder:
+    LittleEndian = 0
+    BigEndian = 1
+
+
+class FeatureException(Exception):
+    pass
+
+
+def read_label(filename):
+    """Per-frame integer labels, one value per line (or whitespace
+    separated)."""
+    return np.loadtxt(filename, ndmin=1).astype(np.int32)
+
+
+class BaseReader:
+    def __init__(self, feature_file, label_file, byte_order=None):
+        self.feature_file = feature_file
+        self.label_file = label_file
+        self.byte_order = byte_order
+        self.done = False
+
+    def read(self):
+        raise NotImplementedError
+
+    def is_done(self):
+        return self.done
+
+    def _mark_done(self):
+        self.done = True
+
+    def get_utt_id(self):
+        return os.path.basename(self.feature_file)
+
+    def _labels(self):
+        return None if self.label_file is None else \
+            read_label(self.label_file)

@@ -1,0 +1,41 @@
+"""atrack feature file (reference feat_readers/reader_atrack.py):
+7 big-endian int32 header words — magic 0x56782, frameSize, numSamples,
+0, 24, numSamples, frameSize — then big-endian float32 data."""
+import numpy as np
+
+from .common import BaseReader, FeatureException
+
+MAGIC = 0x56782
+
+
+class AtrackReader(BaseReader):
+    def _check_header(self, h):
+        ok = (h[0] == MAGIC and h[1] == h[6] and h[2] == h[5] and
+              h[3] == 0 and h[4] == 24)
+        if not ok:
+            raise FeatureException("bad atrack header in %s: %s"
+                                   % (self.feature_file, h.tolist()))
+
+    def read(self):
+        with open(self.feature_file, "rb") as f:
+            header = np.fromfile(f, np.dtype(">i4"), count=7)
+            if header.size != 7:
+                raise FeatureException("truncated atrack header in %s"
+                                       % self.feature_file)
+            self._check_header(header)
+            dim, n = int(header[1]), int(header[2])
+            samples = np.fromfile(f, np.dtype(">f4"), count=n * dim)
+        if samples.size != n * dim:
+            raise FeatureException("truncated atrack data in %s"
+                                   % self.feature_file)
+        self._mark_done()
+        return samples.astype(np.float32).reshape(n, dim), self._labels()
+
+
+def write_atrack(path, mat):
+    """Writer twin."""
+    mat = np.asarray(mat, np.float32)
+    n, dim = mat.shape
+    with open(path, "wb") as f:
+        np.asarray([MAGIC, dim, n, 0, 24, n, dim], ">i4").tofile(f)
+        mat.astype(">f4").tofile(f)

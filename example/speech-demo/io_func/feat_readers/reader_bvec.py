@@ -1,0 +1,30 @@
+"""Flat binary vector file (reference feat_readers/reader_bvec.py):
+big-endian header (int32 nSamples, int32 dim) followed by nSamples
+big-endian float32 rows."""
+import numpy as np
+
+from .common import BaseReader, FeatureException
+
+
+class BvecReader(BaseReader):
+    def read(self):
+        with open(self.feature_file, "rb") as f:
+            header = np.fromfile(f, np.dtype(">i4"), count=2)
+            if header.size != 2:
+                raise FeatureException("truncated bvec header in %s"
+                                       % self.feature_file)
+            n, dim = int(header[0]), int(header[1])
+            samples = np.fromfile(f, np.dtype(">f4"), count=n * dim)
+        if samples.size != n * dim:
+            raise FeatureException("truncated bvec data in %s"
+                                   % self.feature_file)
+        self._mark_done()
+        return samples.astype(np.float32).reshape(n, dim), self._labels()
+
+
+def write_bvec(path, mat):
+    """Writer twin so archives round-trip in the suite."""
+    mat = np.asarray(mat, np.float32)
+    with open(path, "wb") as f:
+        np.asarray([mat.shape[0], mat.shape[1]], ">i4").tofile(f)
+        mat.astype(">f4").tofile(f)

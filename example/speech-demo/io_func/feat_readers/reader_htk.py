@@ -1,0 +1,38 @@
+"""HTK feature file (reference feat_readers/reader_htk.py): 12-byte
+header (int32 nSamples, int32 samplePeriod, int16 sampleSize-in-bytes,
+int16 parmKind) then nSamples rows of sampleSize/4 float32s.  Byte
+order is configurable ('htk' = big-endian, 'htk_little')."""
+import numpy as np
+
+from .common import BaseReader, ByteOrder, FeatureException
+
+
+class HtkReader(BaseReader):
+    def read(self):
+        bo = ">" if self.byte_order == ByteOrder.BigEndian else "<"
+        with open(self.feature_file, "rb") as f:
+            head_t = np.dtype([("n", bo + "i4"), ("period", bo + "i4"),
+                               ("bytes", bo + "i2"), ("kind", bo + "i2")])
+            header = np.fromfile(f, head_t, count=1)
+            if header.size != 1:
+                raise FeatureException("truncated htk header in %s"
+                                       % self.feature_file)
+            n = int(header[0]["n"])
+            dim = int(header[0]["bytes"]) // 4
+            samples = np.fromfile(f, np.dtype(bo + "f4"), count=n * dim)
+        if samples.size != n * dim:
+            raise FeatureException("truncated htk data in %s"
+                                   % self.feature_file)
+        self._mark_done()
+        return samples.astype(np.float32).reshape(n, dim), self._labels()
+
+
+def write_htk(path, mat, sample_period=100000, parm_kind=9,
+              big_endian=True):
+    """Writer twin (parm_kind 9 = USER)."""
+    bo = ">" if big_endian else "<"
+    mat = np.asarray(mat, np.float32)
+    with open(path, "wb") as f:
+        np.asarray([mat.shape[0], sample_period], bo + "i4").tofile(f)
+        np.asarray([mat.shape[1] * 4, parm_kind], bo + "i2").tofile(f)
+        mat.astype(bo + "f4").tofile(f)

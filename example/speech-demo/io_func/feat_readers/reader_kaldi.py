@@ -1,0 +1,73 @@
+"""Kaldi archive reader (reference feat_readers/reader_kaldi.py — which
+shells out to kaldi binaries; here the byte-level format lives in
+../kaldi_io.py so no Kaldi installation is needed).
+
+`feature_file` accepts the rspecifier-ish forms
+    ark:/path/feats.ark          binary archive
+    ark,t:/path/feats.txt        text archive
+    scp:/path/feats.scp          indexed random access
+    /path/feats.ark              bare path = binary ark
+and labels come from an alignment ark (`label_file`, same forms) keyed
+by the same utterance ids.
+"""
+import numpy as np
+
+from .common import BaseReader, FeatureException
+
+
+def _parse_spec(spec):
+    if spec.startswith("ark,t:"):
+        return "ark_t", spec[len("ark,t:"):]
+    if spec.startswith("ark:"):
+        return "ark", spec[len("ark:"):]
+    if spec.startswith("scp:"):
+        return "scp", spec[len("scp:"):]
+    return "ark", spec
+
+
+def read_table(spec):
+    """Whole-table read -> ordered {utt: array}."""
+    from .. import kaldi_io
+    kind, path = _parse_spec(spec)
+    if kind == "ark":
+        return dict(kaldi_io.read_ark(path))
+    if kind == "ark_t":
+        return dict(kaldi_io.read_ark_ascii(path))
+    return kaldi_io.read_scp_table(path)
+
+
+class KaldiReader(BaseReader):
+    """Reads the WHOLE archive; read() yields one utterance per call
+    (the streaming protocol feat_io.DataReadStream drives)."""
+
+    def __init__(self, feature_file, label_file, byte_order=None):
+        super().__init__(feature_file, label_file, byte_order)
+        self._feats = read_table(feature_file)
+        self._labels_tab = (read_table(label_file)
+                            if label_file is not None else {})
+        self._order = list(self._feats)
+        self._pos = 0
+
+    def read(self):
+        if self._pos >= len(self._order):
+            self._mark_done()
+            return None, None
+        utt = self._order[self._pos]
+        self._pos += 1
+        self._cur_utt = utt
+        feats = np.asarray(self._feats[utt], np.float32)
+        labels = None
+        if self.label_file is not None:
+            if utt not in self._labels_tab:
+                raise FeatureException("no alignment for utterance %s"
+                                       % utt)
+            labels = np.asarray(self._labels_tab[utt]).astype(np.int32)
+            if labels.ndim != 1 or len(labels) != len(feats):
+                raise FeatureException(
+                    "alignment length %s != frames %d for %s"
+                    % (labels.shape, len(feats), utt))
+        return feats, labels
+
+    def get_utt_id(self):
+        return getattr(self, "_cur_utt", None) or \
+            super().get_utt_id()

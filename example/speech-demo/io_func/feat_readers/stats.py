@@ -1,0 +1,84 @@
+"""Feature statistics (reference feat_readers/stats.py): streaming
+per-dimension mean/variance (Welford) over any reader, persisted for
+CMVN at training/decode time."""
+import numpy as np
+
+
+class StreamingVariance:
+    """Numerically stable running mean/var; add() takes a frame or a
+    (T, D) block (vectorized Chan et al. merge, not a python loop per
+    frame)."""
+
+    def __init__(self, dim):
+        self.n = 0
+        self.mean = np.zeros(dim)
+        self.m2 = np.zeros(dim)
+
+    def add(self, x):
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        bn = x.shape[0]
+        if bn == 0:
+            return
+        bmean = x.mean(axis=0)
+        bm2 = ((x - bmean) ** 2).sum(axis=0)
+        delta = bmean - self.mean
+        total = self.n + bn
+        self.mean += delta * bn / total
+        self.m2 += bm2 + delta ** 2 * self.n * bn / total
+        self.n = total
+
+    def variance(self):
+        return self.m2 / max(self.n - 1, 1)
+
+    def inv_std(self):
+        return 1.0 / np.sqrt(np.maximum(self.variance(), 1e-12))
+
+
+class FeatureStats:
+    """mean/inv-std over a whole corpus, computed from a reader or list
+    of arrays, saved/loaded as npz (reference stats.py FeatureStats)."""
+
+    def __init__(self):
+        self.mean = None
+        self.inv_std = None
+        self.population = 0
+
+    def accumulate(self, blocks):
+        sv = None
+        for block in blocks:
+            block = np.asarray(block)
+            if sv is None:
+                sv = StreamingVariance(block.shape[-1])
+            sv.add(block)
+        if sv is None:
+            raise ValueError("no feature blocks to accumulate")
+        self.mean = sv.mean
+        self.inv_std = sv.inv_std()
+        self.population = sv.n
+        return self
+
+    def from_reader(self, reader):
+        def gen():
+            while not reader.is_done():
+                feats, _ = reader.read()
+                if feats is not None:
+                    yield feats
+        return self.accumulate(gen())
+
+    def apply(self, feats):
+        """CMVN: zero mean, unit variance."""
+        return ((np.asarray(feats) - self.mean) *
+                self.inv_std).astype(np.float32)
+
+    def save(self, path):
+        np.savez(path, mean=self.mean, inv_std=self.inv_std,
+                 population=self.population)
+
+    @classmethod
+    def load(cls, path):
+        z = np.load(path)
+        st = cls()
+        st.mean = z["mean"]
+        st.inv_std = z["inv_std"]
+        st.population = int(z["population"])
+        return st

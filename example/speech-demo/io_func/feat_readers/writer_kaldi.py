@@ -1,0 +1,48 @@
+"""Kaldi archive writer (reference feat_readers/writer_kaldi.py — which
+pipes through kaldi's copy-feats; here ../kaldi_io.py writes the bytes
+directly).  Supports binary ark(+scp) and text ark output."""
+from .. import kaldi_io
+
+
+class KaldiWriteOut:
+    """Incremental utterance writer:
+
+        w = KaldiWriteOut("/tmp/out.scp", "/tmp/out.ark")
+        w.open()
+        w.write(utt_id, mat)
+        ...
+        w.close()
+    """
+
+    def __init__(self, scp_path, ark_path, ascii=False):
+        self.scp_path = scp_path
+        self.ark_path = ark_path
+        self.ascii = ascii
+        self._ark = None
+        self._scp = None
+
+    def open(self):
+        if self.ascii:
+            self._ark = open(self.ark_path, "w")
+        else:
+            self._ark = open(self.ark_path, "wb")
+            self._scp = open(self.scp_path, "w") if self.scp_path else None
+        return self
+
+    def write(self, utt_id, value):
+        import numpy as np
+        value = np.asarray(value, np.float32)
+        if self.ascii:
+            self._ark.write(kaldi_io.format_ascii_entry(utt_id, value))
+            return
+        self._ark.write(utt_id.encode("utf-8") + b" ")
+        off = (kaldi_io.write_vec(self._ark, value) if value.ndim == 1
+               else kaldi_io.write_mat(self._ark, value))
+        if self._scp is not None:
+            self._scp.write("%s %s:%d\n" % (utt_id, self.ark_path, off))
+
+    def close(self):
+        if self._ark is not None:
+            self._ark.close()
+        if self._scp is not None:
+            self._scp.close()

@@ -137,10 +137,9 @@ def read_ark(ark_path):
             yield key, _read_object(f)
 
 
-def read_scp(scp_path):
-    """Random-access reader over an scp index: returns {utt: loader}
-    where loader() seeks and reads just that utterance."""
-    table = {}
+def read_scp_entries(scp_path):
+    """Parsed scp index -> [(utt, ark_path, offset)] in file order."""
+    out = []
     with open(scp_path) as f:
         for line in f:
             line = line.strip()
@@ -148,10 +147,96 @@ def read_scp(scp_path):
                 continue
             utt, where = line.split(None, 1)
             path, off = where.rsplit(":", 1)
+            out.append((utt, path, int(off)))
+    return out
 
-            def loader(path=path, off=int(off)):
-                with open(path, "rb") as g:
-                    g.seek(off)
-                    return _read_object(g)
-            table[utt] = loader
+
+def read_scp(scp_path):
+    """Random-access reader over an scp index: returns {utt: loader}
+    where loader() seeks and reads just that utterance."""
+    table = {}
+    for utt, path, off in read_scp_entries(scp_path):
+        def loader(path=path, off=off):
+            with open(path, "rb") as g:
+                g.seek(off)
+                return _read_object(g)
+        table[utt] = loader
     return table
+
+
+def read_scp_table(scp_path):
+    """Whole-table scp read with ONE open per underlying ark (grouped
+    seeks), not one per utterance."""
+    entries = read_scp_entries(scp_path)
+    by_path = {}
+    for utt, path, off in entries:
+        by_path.setdefault(path, []).append((utt, off))
+    loaded = {}
+    for path, group in by_path.items():
+        with open(path, "rb") as g:
+            for utt, off in sorted(group, key=lambda t: t[1]):
+                g.seek(off)
+                loaded[utt] = _read_object(g)
+    return {utt: loaded[utt] for utt, _, _ in entries}   # scp order
+
+
+def format_ascii_entry(utt, value):
+    """One text-mode archive entry as a string (the single source of the
+    ascii format — the incremental writer delegates here too)."""
+    value = np.asarray(value, np.float32)
+    if value.ndim == 1:
+        return "%s  [ %s ]\n" % (utt, " ".join("%g" % v for v in value))
+    if value.shape[0] == 0:
+        return "%s  [ ]\n" % utt   # zero-row matrix still terminates
+    lines = ["%s  [" % utt]
+    for i, row in enumerate(value):
+        tail = " ]" if i == len(value) - 1 else ""
+        lines.append("  %s%s" % (" ".join("%g" % v for v in row), tail))
+    return "\n".join(lines) + "\n"
+
+
+def write_ark_ascii(ark_path, entries):
+    """Text-mode archive (`copy-feats ark:... ark,t:...` output):
+
+        <utt-id>  [
+          r0c0 r0c1 ...
+          ...  rNcM ]
+
+    Vectors are a single bracketed row."""
+    with open(ark_path, "w") as f:
+        for utt, value in entries.items():
+            f.write(format_ascii_entry(utt, value))
+
+
+def read_ark_ascii(ark_path):
+    """Yield (utt, array) from a text-mode archive (matrices come back
+    2-D, single-bracketed-row entries 1-D)."""
+    with open(ark_path) as f:
+        utt, rows, one_line = None, [], False
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            if utt is None:
+                head, bracket = line.split(None, 1)
+                utt = head
+                rest = bracket.strip()
+                assert rest.startswith("["), "malformed ascii ark"
+                rest = rest[1:].strip()
+                one_line = rest.endswith("]")
+                if one_line:
+                    body = rest[:-1].split()
+                    yield utt, np.array(body, dtype=np.float32)
+                    utt, rows = None, []
+                elif rest:
+                    rows.append(np.array(rest.split(), dtype=np.float32))
+                continue
+            closing = line.endswith("]")
+            if closing:
+                line = line[:-1].strip()
+            if line:
+                rows.append(np.array(line.split(), dtype=np.float32))
+            if closing:
+                yield utt, (np.vstack(rows) if rows
+                            else np.zeros((0, 0), np.float32))
+                utt, rows = None, []

@@ -1,0 +1,105 @@
+"""Kaldi nnet1 text format parse/emit (reference io_func/kaldi_parser.py,
+which tokenizes `nnet-am-copy --binary=false` output): the subset the
+acoustic demo needs — <AffineTransform> blocks with their weight matrix
+and bias, separated by activation components.
+
+    <Nnet>
+    <AffineTransform> <out> <in>
+    <LearnRateCoef> 1 <BiasLearnRateCoef> 1 <MaxNorm> 0
+     [
+      w00 w01 ...
+      ... ]
+     [ b0 b1 ... ]
+    <Sigmoid> <out> <out>
+    ...
+    <Softmax> <out> <out>
+    </Nnet>
+"""
+import re
+
+import numpy as np
+
+ACTIVATIONS = ("Sigmoid", "Tanh", "ReLU", "Softmax")
+
+
+def _fmt_matrix(mat, indent="  "):
+    rows = ["%s%s" % (indent, " ".join("%g" % v for v in row))
+            for row in np.atleast_2d(mat)]
+    return " [\n" + "\n".join(rows) + " ]\n"
+
+
+def _fmt_vector(vec):
+    return " [ %s ]\n" % " ".join("%g" % v for v in np.asarray(vec))
+
+
+def write_nnet(path, layers):
+    """layers: [(weight (out, in), bias (out,), activation-or-None)];
+    the final activation is conventionally Softmax."""
+    with open(path, "w") as f:
+        f.write("<Nnet>\n")
+        for weight, bias, act in layers:
+            out_dim, in_dim = weight.shape
+            f.write("<AffineTransform> %d %d\n" % (out_dim, in_dim))
+            f.write("<LearnRateCoef> 1 <BiasLearnRateCoef> 1 "
+                    "<MaxNorm> 0\n")
+            f.write(_fmt_matrix(weight))
+            f.write(_fmt_vector(bias))
+            if act:
+                f.write("<%s> %d %d\n" % (act, out_dim, out_dim))
+        f.write("</Nnet>\n")
+
+
+def _tokens(text):
+    """Token stream with brackets and tags as standalone tokens."""
+    return re.findall(r"<[^>]+>|\[|\]|[^\s\[\]]+", text)
+
+
+def read_nnet(path):
+    """-> [(weight, bias, activation-or-None)], inverse of write_nnet
+    (accepts any well-formed nnet1 text with affine + activation
+    components)."""
+    with open(path) as f:
+        toks = _tokens(f.read())
+    layers = []
+    i = 0
+    cur = None   # [weight, bias]
+    while i < len(toks):
+        t = toks[i]
+        if t == "<AffineTransform>":
+            if cur is not None:
+                layers.append((cur[0], cur[1], None))
+            cur = [None, None]
+            i += 3   # tag, out, in
+            continue
+        if t.startswith("<") and t[1:-1] in ACTIVATIONS:
+            assert cur is not None, "activation before any affine layer"
+            layers.append((cur[0], cur[1], t[1:-1]))
+            cur = None
+            i += 3
+            continue
+        if t == "[":
+            j = i + 1
+            vals = []
+            while toks[j] != "]":
+                vals.append(toks[j])
+                j += 1
+            arr = np.array(vals, np.float32)
+            i = j + 1
+            # attach: first bracket block is the weight, second the bias
+            if cur is not None:
+                if cur[0] is None:
+                    cur[0] = arr
+                else:
+                    cur[1] = arr
+            continue
+        i += 1
+    if cur is not None:
+        layers.append((cur[0], cur[1], None))
+    # reshape flat weight blocks using the bias length
+    fixed = []
+    for weight, bias, act in layers:
+        if weight is not None and bias is not None and weight.ndim == 1:
+            out_dim = len(bias)
+            weight = weight.reshape(out_dim, -1)
+        fixed.append((weight, bias, act))
+    return fixed

@@ -1,0 +1,66 @@
+"""Portable network parameter IO (reference io_func/model_io.py): params
+as a json dict of `"<layer> <activation> <W|b>"` -> text-encoded array,
+readable by any tool in the pipeline, plus adapters between that format
+and a Module's arg_params (the bridge convert2kaldi.py crosses).
+"""
+import json
+
+import numpy as np
+
+
+def array_to_text(arr):
+    arr = np.atleast_2d(np.asarray(arr, np.float32))
+    return "\n".join(" ".join("%g" % v for v in row) for row in arr)
+
+
+def text_to_array(text):
+    rows = [np.array(line.split(), np.float32)
+            for line in text.strip().splitlines() if line.strip()]
+    mat = np.vstack(rows)
+    return mat[0] if mat.shape[0] == 1 else mat
+
+
+def save_params(path, layers, activation="sigmoid"):
+    """layers: [(W (out, in), b (out,))]; the trailing layer is the
+    softmax head by convention."""
+    blob = {}
+    for i, (weight, bias) in enumerate(layers):
+        blob["%d %s W" % (i, activation)] = array_to_text(weight)
+        blob["%d %s b" % (i, activation)] = array_to_text(bias)
+    with open(path, "w") as f:
+        json.dump(blob, f)
+
+
+def load_params(path, activation="sigmoid"):
+    """-> [(W, b)] in layer order."""
+    with open(path) as f:
+        blob = json.load(f)
+    layers = []
+    i = 0
+    while ("%d %s W" % (i, activation)) in blob:
+        weight = text_to_array(blob["%d %s W" % (i, activation)])
+        bias = np.atleast_1d(text_to_array(blob["%d %s b" % (i,
+                                                             activation)]))
+        layers.append((np.atleast_2d(weight), bias))
+        i += 1
+    return layers
+
+
+def layers_from_arg_params(arg_params, prefixes):
+    """Module arg_params -> [(W, b)] using fc-layer name prefixes in
+    order, e.g. ["fc1", "fc2", "fc3"]."""
+    out = []
+    for p in prefixes:
+        out.append((arg_params["%s_weight" % p].asnumpy(),
+                    arg_params["%s_bias" % p].asnumpy()))
+    return out
+
+
+def arg_params_from_layers(layers, prefixes):
+    """[(W, b)] -> {name: ndarray} for Module.init_params."""
+    import mxnet_tpu as mx
+    out = {}
+    for (weight, bias), p in zip(layers, prefixes):
+        out["%s_weight" % p] = mx.nd.array(weight)
+        out["%s_bias" % p] = mx.nd.array(bias)
+    return out

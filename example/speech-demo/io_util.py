@@ -113,16 +113,18 @@ class TruncatedSentenceIter(mx.io.DataIter):
         return iter(self._inner)
 
 
-def read_kaldi(feats_ark, labels_ark=None):
-    """Kaldi-format entry point (io_func/): feature matrices from a
-    binary ark, optional per-frame labels from a second ark holding
-    1-d vectors (alignment dumps)."""
-    from io_func import read_ark
-    feats = {utt: mat for utt, mat in read_ark(feats_ark)}
+def read_kaldi(feats_rspec, labels_rspec=None):
+    """Kaldi-format entry point (io_func/): features from an
+    rspecifier — `ark:...` binary, `ark,t:...` text, `scp:...` indexed,
+    or a bare ark path — with optional per-frame labels from a second
+    rspecifier holding 1-d vectors (alignment dumps)."""
+    from io_func.feat_readers.reader_kaldi import read_table
+    feats = {utt: np.asarray(mat, np.float32)
+             for utt, mat in read_table(feats_rspec).items()}
     labels = {}
-    if labels_ark:
-        for utt, vec in read_ark(labels_ark):
-            labels[utt] = np.asarray(vec).astype(np.int64)
+    if labels_rspec:
+        labels = {utt: np.asarray(vec).astype(np.int64)
+                  for utt, vec in read_table(labels_rspec).items()}
     return feats, labels
 
 

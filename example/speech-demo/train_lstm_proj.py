@@ -104,7 +104,9 @@ def main():
             raise SystemExit("--train-ark requires --label-ark "
                              "(per-frame alignment vectors)")
         feats, labels = io_util.read_kaldi(args.train_ark, args.label_ark)
-        stats_base = args.train_ark
+        # rspecifier forms (ark:/scp:/ark,t:) are not filenames: the
+        # stats sidecar sits next to the underlying file
+        stats_base = args.train_ark.split(":", 1)[-1]
     else:
         archive = args.train_archive
         if not archive:

@@ -168,3 +168,335 @@ def test_train_from_ark_and_decode_to_ark(tmp_path):
         # exponentiating must recover a distribution per frame
         post = np.exp(loglike + np.log(counts / counts.sum()))
         assert np.allclose(post.sum(axis=1), 1.0, atol=1e-3)
+
+
+def test_ascii_ark_roundtrip(tmp_path):
+    """Text-mode archives (`ark,t:`) round-trip matrices and vectors."""
+    from io_func import read_ark_ascii, write_ark_ascii
+    rng = np.random.RandomState(1)
+    entries = {
+        "m1": np.round(rng.randn(4, 3), 4).astype(np.float32),
+        "v1": np.round(rng.randn(6), 4).astype(np.float32),
+        "m2": np.round(rng.randn(1, 5), 4).astype(np.float32),
+    }
+    path = str(tmp_path / "t.txt")
+    write_ark_ascii(path, entries)
+    got = dict(read_ark_ascii(path))
+    assert set(got) == set(entries)
+    assert got["v1"].ndim == 1
+    for k in entries:
+        np.testing.assert_allclose(got[k], entries[k], rtol=1e-5)
+
+
+def test_feat_readers_roundtrip(tmp_path):
+    """Every non-kaldi on-disk format (htk big/little-endian, bvec,
+    atrack) writes and reads back bit-equal, with labels attached."""
+    from io_func.feat_readers import get_reader
+    from io_func.feat_readers.reader_atrack import write_atrack
+    from io_func.feat_readers.reader_bvec import write_bvec
+    from io_func.feat_readers.reader_htk import write_htk
+    rng = np.random.RandomState(2)
+    mat = rng.randn(11, 13).astype(np.float32)
+    labels = rng.randint(0, 5, 11)
+    lab_f = str(tmp_path / "lab.txt")
+    np.savetxt(lab_f, labels, fmt="%d")
+
+    cases = []
+    p = str(tmp_path / "f.htk")
+    write_htk(p, mat, big_endian=True)
+    cases.append(("htk", p))
+    p = str(tmp_path / "f.htkl")
+    write_htk(p, mat, big_endian=False)
+    cases.append(("htk_little", p))
+    p = str(tmp_path / "f.bvec")
+    write_bvec(p, mat)
+    cases.append(("bvec", p))
+    p = str(tmp_path / "f.atrack")
+    write_atrack(p, mat)
+    cases.append(("atrack", p))
+
+    for fmt, path in cases:
+        r = get_reader(fmt, path, lab_f)
+        feats, labs = r.read()
+        np.testing.assert_allclose(feats, mat, rtol=1e-6, err_msg=fmt)
+        np.testing.assert_array_equal(labs, labels, err_msg=fmt)
+
+
+def test_kaldi_reader_rspecifiers(tmp_path):
+    """The kaldi reader accepts ark:/ark,t:/scp: forms and aligns
+    labels by utterance id."""
+    from io_func import write_ark_ascii, write_ark_scp
+    from io_func.feat_readers import get_reader
+    rng = np.random.RandomState(3)
+    feats = {"u1": rng.randn(5, 4).astype(np.float32),
+             "u2": rng.randn(7, 4).astype(np.float32)}
+    aligns = {"u1": np.arange(5, dtype=np.float32),
+              "u2": np.arange(7, dtype=np.float32)}
+    ark = str(tmp_path / "f.ark")
+    scp = str(tmp_path / "f.scp")
+    write_ark_scp(ark, feats, scp)
+    lab_ark = str(tmp_path / "l.ark")
+    write_ark_scp(lab_ark, aligns)
+    txt = str(tmp_path / "f.txt")
+    write_ark_ascii(txt, feats)
+
+    for spec in ("ark:" + ark, ark, "scp:" + scp, "ark,t:" + txt):
+        r = get_reader("kaldi", spec, "ark:" + lab_ark)
+        seen = {}
+        while True:
+            f, l = r.read()
+            if f is None:
+                break
+            seen[r.get_utt_id()] = (f, l)
+        assert set(seen) == {"u1", "u2"}, spec
+        for utt in feats:
+            np.testing.assert_allclose(seen[utt][0], feats[utt],
+                                       rtol=1e-5, err_msg=spec)
+            np.testing.assert_array_equal(
+                seen[utt][1], aligns[utt].astype(np.int32), err_msg=spec)
+
+
+def test_feature_stats_streaming(tmp_path):
+    """Streaming Welford mean/inv-std equals the closed form; stats
+    persist and normalize."""
+    from io_func.feat_readers import FeatureStats
+    rng = np.random.RandomState(4)
+    blocks = [rng.randn(n, 6) * 3 + 1 for n in (50, 1, 33)]
+    st = FeatureStats().accumulate(blocks)
+    allx = np.concatenate(blocks)
+    np.testing.assert_allclose(st.mean, allx.mean(axis=0), rtol=1e-8)
+    np.testing.assert_allclose(1.0 / st.inv_std, allx.std(axis=0, ddof=1),
+                               rtol=1e-8)
+    path = str(tmp_path / "stats.npz")
+    st.save(path)
+    st2 = FeatureStats.load(path)
+    normed = st2.apply(allx)
+    assert abs(normed.mean()) < 1e-5 and abs(normed.std() - 1) < 1e-2
+
+
+def test_data_read_stream_partitions(tmp_path):
+    """DataReadStream over a list file: partitions cover every frame
+    exactly once, labels stay aligned, CMVN applies, and get/set_state
+    resumes mid-corpus."""
+    from io_func import DataReadStream, write_ark_scp
+    from io_func.feat_readers import FeatureStats
+    rng = np.random.RandomState(5)
+    lst_lines = []
+    total = 0
+    all_rows = []
+    for i in range(3):
+        T = 30 + 10 * i
+        feats = {"u%d" % i: rng.randn(T, 4).astype(np.float32) + i}
+        labs = {"u%d" % i: np.full(T, i, np.float32)}
+        fark = str(tmp_path / ("f%d.ark" % i))
+        lark = str(tmp_path / ("l%d.ark" % i))
+        write_ark_scp(fark, feats)
+        write_ark_scp(lark, labs)
+        lst_lines.append("%s %s" % (fark, lark))
+        total += T
+        all_rows.append(feats["u%d" % i])
+    lst = str(tmp_path / "train.lst")
+    open(lst, "w").write("\n".join(lst_lines) + "\n")
+
+    stats = FeatureStats().accumulate(all_rows)
+    stats_f = str(tmp_path / "train.stats.npz")
+    stats.save(stats_f)
+
+    stream = DataReadStream(lst, "kaldi", train_stat=stats_f,
+                            partition_frames=32)
+    frames = 0
+    label_sums = np.zeros(3)
+    for X, y in stream:
+        assert len(X) == len(y) and len(X) <= 32 + 40  # one utt overhang
+        frames += len(X)
+        for c in range(3):
+            label_sums[c] += (y == c).sum()
+    assert frames == total
+    assert label_sums.tolist() == [30, 40, 50]
+
+    # mid-corpus resume: state after first partition replays the rest
+    stream.reset()
+    first = stream.load_next_partition()
+    state = stream.get_state()
+    rest1 = []
+    while True:
+        p = stream.load_next_partition()
+        if p is None:
+            break
+        rest1.append(p[0])
+    stream.set_state(state)
+    rest2 = []
+    while True:
+        p = stream.load_next_partition()
+        if p is None:
+            break
+        rest2.append(p[0])
+    assert len(rest1) == len(rest2)
+    for a, b in zip(rest1, rest2):
+        np.testing.assert_array_equal(a, b)
+    assert first is not None
+
+
+def test_nnet1_text_roundtrip(tmp_path):
+    """kaldi_parser writes/parses nnet1 text; model_io json params
+    round-trip; convert2kaldi bridges a checkpoint to .nnet."""
+    from io_func import kaldi_parser, model_io
+    rng = np.random.RandomState(6)
+    layers = [(rng.randn(8, 5).astype(np.float32),
+               rng.randn(8).astype(np.float32), "Sigmoid"),
+              (rng.randn(3, 8).astype(np.float32),
+               rng.randn(3).astype(np.float32), "Softmax")]
+    nnet = str(tmp_path / "final.nnet")
+    kaldi_parser.write_nnet(nnet, layers)
+    got = kaldi_parser.read_nnet(nnet)
+    assert len(got) == 2
+    for (w, b, a), (w2, b2, a2) in zip(layers, got):
+        np.testing.assert_allclose(w2, w, rtol=1e-4)
+        np.testing.assert_allclose(b2, b, rtol=1e-4)
+        assert a2 == a
+
+    pjson = str(tmp_path / "params.json")
+    model_io.save_params(pjson, [(w, b) for w, b, _ in layers])
+    back = model_io.load_params(pjson)
+    for (w, b, _), (w2, b2) in zip(layers, back):
+        np.testing.assert_allclose(w2, np.atleast_2d(w), rtol=1e-4)
+        np.testing.assert_allclose(b2, b, rtol=1e-4)
+
+
+def test_convert2kaldi_from_checkpoint(tmp_path):
+    """End to end: train a tiny MLP, checkpoint it, convert to nnet1
+    text via the CLI, parse it back and verify the weights."""
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(7)
+    X = rng.randn(64, 10).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=6, name="fc1")
+    net = mx.sym.Activation(net, act_type="sigmoid")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "am")
+    arg_p, aux_p = mod.get_params()
+    mx.model.save_checkpoint(prefix, 1, net, arg_p, aux_p)
+
+    out = str(tmp_path / "final.nnet")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-m", "io_func.convert2kaldi", "--prefix", prefix,
+         "--epoch", "1", "--layers", "fc1,fc2", "--out", out],
+        cwd=SPEECH_DIR, env=env, capture_output=True, text=True,
+        timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "CONVERT2KALDI-OK" in res.stdout
+
+    from io_func import kaldi_parser
+    got = kaldi_parser.read_nnet(out)
+    assert len(got) == 2 and got[0][2] == "Sigmoid" and \
+        got[1][2] == "Softmax"
+    np.testing.assert_allclose(got[0][0], arg_p["fc1_weight"].asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got[1][1], arg_p["fc2_bias"].asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ascii_ark_zero_row_matrix(tmp_path):
+    """A zero-row matrix entry must terminate so following entries
+    survive."""
+    from io_func import read_ark_ascii, write_ark_ascii
+    entries = {"empty": np.zeros((0, 3), np.float32),
+               "after": np.ones((2, 2), np.float32)}
+    path = str(tmp_path / "z.txt")
+    write_ark_ascii(path, entries)
+    got = dict(read_ark_ascii(path))
+    assert set(got) == {"empty", "after"}
+    assert got["empty"].size == 0
+    np.testing.assert_array_equal(got["after"], entries["after"])
+
+
+def test_kaldi_writeout_incremental(tmp_path):
+    """The incremental writer produces archives the readers accept, in
+    both binary(+scp) and ascii modes."""
+    from io_func import read_ark, read_ark_ascii
+    from io_func.feat_readers.writer_kaldi import KaldiWriteOut
+    from io_func.kaldi_io import read_scp_table
+    rng = np.random.RandomState(8)
+    mats = {"a": rng.randn(3, 2).astype(np.float32),
+            "b": rng.randn(1, 2).astype(np.float32)}
+    ark = str(tmp_path / "w.ark")
+    scp = str(tmp_path / "w.scp")
+    w = KaldiWriteOut(scp, ark).open()
+    for u, m in mats.items():
+        w.write(u, m)
+    w.close()
+    got = dict(read_ark(ark))
+    for u in mats:
+        np.testing.assert_array_equal(got[u], mats[u])
+    got2 = read_scp_table(scp)
+    np.testing.assert_array_equal(got2["b"], mats["b"])
+
+    txt = str(tmp_path / "w.txt")
+    w = KaldiWriteOut(None, txt, ascii=True).open()
+    for u, m in mats.items():
+        w.write(u, m)
+    w.close()
+    got3 = dict(read_ark_ascii(txt))
+    np.testing.assert_allclose(got3["a"], mats["a"], rtol=1e-5)
+
+
+def test_data_read_stream_resume_mid_archive(tmp_path):
+    """A multi-utterance ark with a partition boundary inside it:
+    get_state/set_state must replay the remaining utterances exactly
+    (including the shuffle RNG stream)."""
+    from io_func import DataReadStream, write_ark_scp
+    rng = np.random.RandomState(9)
+    feats = {"u%d" % i: rng.randn(10, 3).astype(np.float32) + i
+             for i in range(6)}
+    labs = {u: np.full(10, int(u[1]), np.float32) for u in feats}
+    fark = str(tmp_path / "all.ark")
+    lark = str(tmp_path / "all_lab.ark")
+    write_ark_scp(fark, feats)
+    write_ark_scp(lark, labs)
+    lst = str(tmp_path / "one.lst")
+    open(lst, "w").write("%s %s\n" % (fark, lark))
+
+    def drain(stream):
+        parts = []
+        while True:
+            p = stream.load_next_partition()
+            if p is None:
+                break
+            parts.append(p)
+        return parts
+
+    # partition of 20 frames = 2 utts; boundary mid-archive after part 1
+    stream = DataReadStream(lst, "kaldi", partition_frames=20,
+                            shuffle=True, seed=3)
+    stream.reset()
+    stream.load_next_partition()
+    state = stream.get_state()
+    want = drain(stream)
+    assert len(want) == 2   # 4 utts remain -> two more partitions
+
+    stream2 = DataReadStream(lst, "kaldi", partition_frames=20,
+                             shuffle=True, seed=3)
+    stream2.set_state(state)
+    got = drain(stream2)
+    assert len(got) == len(want)
+    for (xa, ya), (xb, yb) in zip(want, got):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def test_data_read_stream_rejects_missing_labels(tmp_path):
+    from io_func import DataReadStream, write_ark_scp
+    fark = str(tmp_path / "f.ark")
+    write_ark_scp(fark, {"u": np.ones((4, 2), np.float32)})
+    lst = str(tmp_path / "nolab.lst")
+    open(lst, "w").write(fark + "\n")
+    stream = DataReadStream(lst, "kaldi", partition_frames=8)
+    with pytest.raises(ValueError, match="no labels"):
+        stream.load_next_partition()
