@@ -18,16 +18,7 @@ mx.set.seed <- function(seed) {
   invisible(.Call("mxg_random_seed", as.integer(seed)))
 }
 
-# device descriptors (codes match capi_bridge.py: cpu=1, tpu=4)
-mx.cpu <- function(dev.id = 0L) {
-  structure(list(device = "cpu", device_typeid = 1L,
-                 device_id = as.integer(dev.id)), class = "MXContext")
-}
-
-mx.tpu <- function(dev.id = 0L) {
-  structure(list(device = "tpu", device_typeid = 4L,
-                 device_id = as.integer(dev.id)), class = "MXContext")
-}
+# device descriptors live in context.R
 
 .mx.func.index <- function(name) {
   idx <- match(name, .mx.env$func.names)

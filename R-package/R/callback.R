@@ -1,0 +1,60 @@
+# Training callbacks (reference R-package/R/callback.R): closures
+# invoked by mx.model.FeedForward.create at batch/epoch boundaries with
+# (iteration, nbatch, metric.value).
+
+mx.callback.log.train.metric <- function(period, logger = NULL) {
+  function(iteration, nbatch, metric.value) {
+    if (nbatch %% period == 0) {
+      msg <- sprintf("Batch [%d] Train-metric=%f", nbatch, metric.value)
+      if (is.null(logger)) cat(msg, "\n") else logger(msg)
+    }
+    TRUE
+  }
+}
+
+mx.callback.log.speedometer <- function(batch.size, frequent = 50) {
+  env <- new.env(parent = emptyenv())
+  env$tic <- Sys.time()
+  env$last <- 0L
+  function(iteration, nbatch, metric.value) {
+    if (nbatch < env$last) env$tic <- Sys.time()   # new epoch
+    env$last <- nbatch
+    if (nbatch > 0 && nbatch %% frequent == 0) {
+      elapsed <- as.numeric(difftime(Sys.time(), env$tic, units = "secs"))
+      speed <- frequent * batch.size / max(elapsed, 1e-9)
+      cat(sprintf("Batch [%d] Speed: %.2f samples/sec Train-metric=%f\n",
+                  nbatch, speed, metric.value))
+      env$tic <- Sys.time()
+    }
+    TRUE
+  }
+}
+
+mx.callback.save.checkpoint <- function(prefix, period = 1) {
+  function(model, iteration) {
+    if (iteration %% period == 0) {
+      mx.model.save(model, prefix, iteration)
+      cat(sprintf("Model checkpoint saved to %s-%04d.params\n",
+                  prefix, iteration))
+    }
+    TRUE
+  }
+}
+
+# Stop when the metric stops improving (reference early-stop idiom).
+mx.callback.early.stop <- function(bad.steps = 3, maximize = TRUE) {
+  env <- new.env(parent = emptyenv())
+  env$best <- if (maximize) -Inf else Inf
+  env$bad <- 0L
+  function(iteration, nbatch, metric.value) {
+    better <- if (maximize) metric.value > env$best
+              else metric.value < env$best
+    if (better) {
+      env$best <- metric.value
+      env$bad <- 0L
+    } else {
+      env$bad <- env$bad + 1L
+    }
+    env$bad < bad.steps
+  }
+}

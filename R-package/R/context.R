@@ -1,0 +1,22 @@
+# Device descriptors (reference R-package/R/context.R).  Type ids match
+# capi_bridge.py: cpu=1, tpu=4; mx.gpu aliases the accelerator slot like
+# the python surface does.
+
+mx.cpu <- function(dev.id = 0L) {
+  structure(list(device = "cpu", device_typeid = 1L,
+                 device_id = as.integer(dev.id)), class = "MXContext")
+}
+
+mx.tpu <- function(dev.id = 0L) {
+  structure(list(device = "tpu", device_typeid = 4L,
+                 device_id = as.integer(dev.id)), class = "MXContext")
+}
+
+mx.gpu <- function(dev.id = 0L) mx.tpu(dev.id)
+
+is.MXContext <- function(x) inherits(x, "MXContext")
+
+print.MXContext <- function(x, ...) {
+  cat(sprintf("<MXContext %s(%d)>\n", x$device, x$device_id))
+  invisible(x)
+}

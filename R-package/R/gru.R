@@ -1,0 +1,54 @@
+# GRU builders (reference R-package/R/gru.R): update/reset-gated cell
+# unrolled like lstm.R, weights created once and shared across time.
+
+mx.gru.param <- function(param.prefix, layeridx = 0) {
+  nm <- function(part) sprintf("%s_l%d_%s", param.prefix, layeridx, part)
+  list(i2h.w = mx.symbol.Variable(nm("i2h_weight")),
+       i2h.b = mx.symbol.Variable(nm("i2h_bias")),
+       h2h.w = mx.symbol.Variable(nm("h2h_weight")),
+       h2h.b = mx.symbol.Variable(nm("h2h_bias")),
+       i2hc.w = mx.symbol.Variable(nm("i2hc_weight")),
+       i2hc.b = mx.symbol.Variable(nm("i2hc_bias")),
+       h2hc.w = mx.symbol.Variable(nm("h2hc_weight")),
+       h2hc.b = mx.symbol.Variable(nm("h2hc_bias")))
+}
+
+mx.gru.cell <- function(num.hidden, indata, prev.h, param, param.prefix,
+                        layeridx = 0, seqidx = 0) {
+  nm <- function(part) sprintf("%s_l%d_%s_t%d", param.prefix, layeridx,
+                               part, seqidx)
+  i2h <- mx.symbol.internal.create("FullyConnected", list(
+    data = indata, weight = param$i2h.w, bias = param$i2h.b,
+    num_hidden = num.hidden * 2, name = nm("i2h")))
+  h2h <- mx.symbol.internal.create("FullyConnected", list(
+    data = prev.h, weight = param$h2h.w, bias = param$h2h.b,
+    num_hidden = num.hidden * 2, name = nm("h2h")))
+  gates <- mx.symbol.internal.create("ElementWiseSum", list(
+    i2h, h2h, name = nm("gates")))
+  sliced <- mx.symbol.internal.create("SliceChannel", list(
+    data = gates, num_outputs = 2, axis = 1, name = nm("slice")))
+  update.gate <- mx.symbol.internal.create("Activation", list(
+    data = .mx.symbol.pick(sliced, 0), act_type = "sigmoid",
+    name = nm("z")))
+  reset.gate <- mx.symbol.internal.create("Activation", list(
+    data = .mx.symbol.pick(sliced, 1), act_type = "sigmoid",
+    name = nm("r")))
+  # candidate: htrans = tanh(W x + U (r * h))
+  i2h.c <- mx.symbol.internal.create("FullyConnected", list(
+    data = indata, weight = param$i2hc.w, bias = param$i2hc.b,
+    num_hidden = num.hidden, name = nm("i2hc")))
+  h2h.c <- mx.symbol.internal.create("FullyConnected", list(
+    data = reset.gate * prev.h, weight = param$h2hc.w,
+    bias = param$h2hc.b, num_hidden = num.hidden, name = nm("h2hc")))
+  h.trans <- mx.symbol.internal.create("Activation", list(
+    data = i2h.c + h2h.c, act_type = "tanh", name = nm("cand")))
+  prev.h + update.gate * (h.trans - prev.h)
+}
+
+mx.gru <- function(seq.len, num.hidden, num.label) {
+  param <- mx.gru.param("gru")
+  mx.rnn.buildgraph(
+    function(xt, h, t) mx.gru.cell(num.hidden, xt, h, param, "gru",
+                                   seqidx = t),
+    seq.len, num.label, prefix = "gru")
+}

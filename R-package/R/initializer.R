@@ -1,0 +1,62 @@
+# Weight initializers (reference R-package/R/initializer.R): shared
+# name-pattern rules (bias/beta/moving_mean zero, gamma/moving_var one)
+# with the scheme deciding weight draws.  An initializer is a closure
+# (name, shape) -> array.
+
+.mx.init.weight <- function(init, name, shape) {
+  if (grepl("bias$|beta$|moving_mean$", name)) {
+    array(0, dim = shape)
+  } else if (grepl("gamma$|moving_var$", name)) {
+    array(1, dim = shape)
+  } else {
+    init(name, shape)
+  }
+}
+
+mx.init.uniform <- function(scale = 0.07) {
+  function(name, shape) {
+    .mx.init.weight(function(n, s)
+      array(runif(prod(s), -scale, scale), dim = s), name, shape)
+  }
+}
+
+mx.init.normal <- function(sd = 0.01) {
+  function(name, shape) {
+    .mx.init.weight(function(n, s)
+      array(rnorm(prod(s), sd = sd), dim = s), name, shape)
+  }
+}
+
+mx.init.Xavier <- function(rnd_type = "uniform", factor_type = "avg",
+                           magnitude = 3) {
+  function(name, shape) {
+    .mx.init.weight(function(n, s) {
+      # R shapes are column-major reversed: fan.out is the LAST dim
+      fan.out <- s[[length(s)]]
+      fan.in <- prod(s) / fan.out
+      factor <- switch(factor_type,
+                       avg = (fan.in + fan.out) / 2,
+                       `in` = fan.in,
+                       out = fan.out,
+                       stop("bad factor_type: ", factor_type))
+      scale <- sqrt(magnitude / factor)
+      if (rnd_type == "uniform") {
+        array(runif(prod(s), -scale, scale), dim = s)
+      } else {
+        array(rnorm(prod(s), sd = scale), dim = s)
+      }
+    }, name, shape)
+  }
+}
+
+# Initialize every non-input argument of a symbol from inferred shapes.
+mx.init.create <- function(initializer, symbol, input.shapes) {
+  inferred <- do.call(mx.symbol.infer.shape,
+                      c(list(symbol), input.shapes))
+  params <- list()
+  for (n in arguments.MXSymbol(symbol)) {
+    if (n %in% names(input.shapes)) next
+    params[[n]] <- initializer(n, inferred$arg.shapes[[n]])
+  }
+  params
+}

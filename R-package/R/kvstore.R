@@ -1,0 +1,37 @@
+# Key-value store (reference R-package/R/kvstore.R): push/pull parameter
+# aggregation over the same C-ABI store every binding shares.
+
+mx.kv.create <- function(type = "local") {
+  handle <- .Call("mxg_kv_create", type)
+  structure(list(handle = handle), class = "MXKVStore")
+}
+
+mx.kv.init <- function(kv, keys, value.list) {
+  handles <- lapply(value.list, function(nd) nd$handle)
+  invisible(.Call("mxg_kv_init", kv$handle, as.integer(keys), handles))
+}
+
+mx.kv.push <- function(kv, keys, value.list, priority = 0L) {
+  handles <- lapply(value.list, function(nd) nd$handle)
+  invisible(.Call("mxg_kv_push", kv$handle, as.integer(keys), handles,
+                  as.integer(priority)))
+}
+
+mx.kv.pull <- function(kv, keys, out.list, priority = 0L) {
+  handles <- lapply(out.list, function(nd) nd$handle)
+  invisible(.Call("mxg_kv_pull", kv$handle, as.integer(keys), handles,
+                  as.integer(priority)))
+  out.list
+}
+
+mx.kv.type <- function(kv) .Call("mxg_kv_type", kv$handle)
+
+mx.kv.rank <- function(kv) .Call("mxg_kv_rank", kv$handle)
+
+mx.kv.num.workers <- function(kv) .Call("mxg_kv_num_workers", kv$handle)
+
+print.MXKVStore <- function(x, ...) {
+  cat(sprintf("<MXKVStore %s rank=%d/%d>\n", mx.kv.type(x),
+              mx.kv.rank(x), mx.kv.num.workers(x)))
+  invisible(x)
+}

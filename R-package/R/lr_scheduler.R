@@ -1,0 +1,20 @@
+# Learning-rate schedules (reference R-package/R/lr_scheduler.R):
+# closures (num.update, base.lr) -> lr, consumed by mx.opt.get.updater.
+
+mx.lr_scheduler.FactorScheduler <- function(step, factor,
+                                            stop_factor_lr = 1e-8) {
+  stopifnot(step >= 1, factor < 1)
+  function(num.update, base.lr) {
+    lr <- base.lr * factor ^ (num.update %/% step)
+    max(lr, stop_factor_lr)
+  }
+}
+
+mx.lr_scheduler.MultiFactorScheduler <- function(step, factor,
+                                                 stop_factor_lr = 1e-8) {
+  stopifnot(all(diff(step) > 0), factor < 1)
+  function(num.update, base.lr) {
+    lr <- base.lr * factor ^ sum(num.update > step)
+    max(lr, stop_factor_lr)
+  }
+}

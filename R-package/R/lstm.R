@@ -1,0 +1,62 @@
+# LSTM builders (reference R-package/R/lstm.R): the gated cell unrolled
+# through the shared rnn.R graph helper.  State is (c, h); gates come
+# from one fused 4x projection whose weights are created ONCE and
+# composed into every timestep (op names time-distinct, params shared —
+# the same layout mxnet_tpu/models/lstm.py uses).
+
+mx.lstm.param <- function(param.prefix, layeridx = 0) {
+  nm <- function(part) sprintf("%s_l%d_%s", param.prefix, layeridx, part)
+  list(i2h.w = mx.symbol.Variable(nm("i2h_weight")),
+       i2h.b = mx.symbol.Variable(nm("i2h_bias")),
+       h2h.w = mx.symbol.Variable(nm("h2h_weight")),
+       h2h.b = mx.symbol.Variable(nm("h2h_bias")))
+}
+
+mx.lstm.cell <- function(num.hidden, indata, prev.state, param,
+                         param.prefix, layeridx = 0, seqidx = 0) {
+  nm <- function(part) sprintf("%s_l%d_%s_t%d", param.prefix, layeridx,
+                               part, seqidx)
+  i2h <- mx.symbol.internal.create("FullyConnected", list(
+    data = indata, weight = param$i2h.w, bias = param$i2h.b,
+    num_hidden = num.hidden * 4, name = nm("i2h")))
+  h2h <- mx.symbol.internal.create("FullyConnected", list(
+    data = prev.state$h, weight = param$h2h.w, bias = param$h2h.b,
+    num_hidden = num.hidden * 4, name = nm("h2h")))
+  gates <- mx.symbol.internal.create("ElementWiseSum", list(
+    i2h, h2h, name = nm("gates")))
+  sliced <- mx.symbol.internal.create("SliceChannel", list(
+    data = gates, num_outputs = 4, axis = 1, name = nm("slice")))
+  act <- function(i, type, part) {
+    mx.symbol.internal.create("Activation", list(
+      data = .mx.symbol.pick(sliced, i), act_type = type,
+      name = nm(part)))
+  }
+  in.gate <- act(0, "sigmoid", "i")
+  in.trans <- act(1, "tanh", "g")
+  forget.gate <- act(2, "sigmoid", "f")
+  out.gate <- act(3, "sigmoid", "o")
+  next.c <- (forget.gate * prev.state$c) + (in.gate * in.trans)
+  tanh.c <- mx.symbol.internal.create("Activation", list(
+    data = next.c, act_type = "tanh", name = nm("tc")))
+  list(c = next.c, h = out.gate * tanh.c)
+}
+
+mx.lstm <- function(seq.len, num.hidden, num.label) {
+  param <- mx.lstm.param("lstm")
+  data <- mx.symbol.Variable("data")
+  slices <- mx.symbol.internal.create("SliceChannel", list(
+    data = data, num_outputs = seq.len, axis = 1, name = "lstm_slice"))
+  state <- list(c = mx.symbol.Variable("lstm_init_c"),
+                h = mx.symbol.Variable("lstm_init_h"))
+  for (t in seq_len(seq.len)) {
+    xt <- mx.symbol.internal.create("Flatten", list(
+      data = .mx.symbol.pick(slices, t - 1),
+      name = sprintf("lstm_flat_t%d", t)))
+    state <- mx.lstm.cell(num.hidden, xt, state, param, "lstm",
+                          seqidx = t)
+  }
+  fc <- mx.symbol.internal.create("FullyConnected", list(
+    data = state$h, num_hidden = num.label, name = "lstm_cls"))
+  mx.symbol.internal.create("SoftmaxOutput", list(
+    data = fc, name = "softmax"))
+}

@@ -1,4 +1,17 @@
 # Metrics (reference R-package/R/metric.R): list of (init, update, get).
+# `label` is 0-based class ids (or numeric targets); `pred.probs` has one
+# row per sample.
+
+mx.metric.custom <- function(name, feval) {
+  list(
+    init = function() c(0, 0),
+    update = function(state, label, pred.probs) {
+      state + c(feval(label, pred.probs), 1)
+    },
+    get = function(state) state[1] / max(state[2], 1),
+    name = name
+  )
+}
 
 mx.metric.accuracy <- list(
   init = function() c(0, 0),
@@ -6,5 +19,52 @@ mx.metric.accuracy <- list(
     pick <- max.col(pred.probs) - 1   # classes are 0-based
     state + c(sum(pick == label), length(label))
   },
-  get = function(state) state[1] / max(state[2], 1)
+  get = function(state) state[1] / max(state[2], 1),
+  name = "accuracy"
+)
+
+mx.metric.top_k_accuracy <- function(top_k = 5) {
+  list(
+    init = function() c(0, 0),
+    update = function(state, label, pred.probs) {
+      hits <- vapply(seq_along(label), function(i) {
+        top <- order(pred.probs[i, ], decreasing = TRUE)[seq_len(top_k)]
+        (label[i] + 1) %in% top
+      }, logical(1))
+      state + c(sum(hits), length(label))
+    },
+    get = function(state) state[1] / max(state[2], 1),
+    name = sprintf("top_%d_accuracy", top_k)
+  )
+}
+
+mx.metric.rmse <- list(
+  init = function() c(0, 0),
+  update = function(state, label, pred) {
+    state + c(sum((as.numeric(pred) - as.numeric(label))^2),
+              length(label))
+  },
+  get = function(state) sqrt(state[1] / max(state[2], 1)),
+  name = "rmse"
+)
+
+mx.metric.mae <- list(
+  init = function() c(0, 0),
+  update = function(state, label, pred) {
+    state + c(sum(abs(as.numeric(pred) - as.numeric(label))),
+              length(label))
+  },
+  get = function(state) state[1] / max(state[2], 1),
+  name = "mae"
+)
+
+# mean negative log-likelihood of the labeled class -> exp = perplexity
+mx.metric.perplexity <- list(
+  init = function() c(0, 0),
+  update = function(state, label, pred.probs) {
+    p <- pred.probs[cbind(seq_along(label), label + 1)]
+    state + c(-sum(log(pmax(p, 1e-10))), length(label))
+  },
+  get = function(state) exp(state[1] / max(state[2], 1)),
+  name = "perplexity"
 )

@@ -1,0 +1,47 @@
+# Convenience MLP interface (reference R-package/R/mlp.R mx.mlp): build
+# the stacked FullyConnected/Activation/SoftmaxOutput symbol and train
+# it through FeedForward in one call.
+
+mx.mlp.symbol <- function(hidden_node = c(), out_node,
+                          activation = "tanh",
+                          out_activation = "softmax") {
+  net <- mx.symbol.Variable("data")
+  acts <- if (length(hidden_node) == 0) character(0)
+          else rep(activation, length.out = length(hidden_node))
+  for (i in seq_along(hidden_node)) {
+    net <- mx.symbol.internal.create("FullyConnected", list(
+      data = net, num_hidden = hidden_node[[i]],
+      name = sprintf("fc%d", i)))
+    net <- mx.symbol.internal.create("Activation", list(
+      data = net, act_type = acts[[i]],
+      name = sprintf("act%d", i)))
+  }
+  net <- mx.symbol.internal.create("FullyConnected", list(
+    data = net, num_hidden = out_node,
+    name = sprintf("fc%d", length(hidden_node) + 1)))
+  if (out_activation == "softmax") {
+    mx.symbol.internal.create("SoftmaxOutput", list(data = net,
+                                                    name = "softmax"))
+  } else if (out_activation == "logistic") {
+    mx.symbol.internal.create("LogisticRegressionOutput", list(
+      data = net, name = "softmax"))
+  } else {
+    mx.symbol.internal.create("LinearRegressionOutput", list(
+      data = net, name = "softmax"))
+  }
+}
+
+mx.mlp <- function(data, label, hidden_node = c(), out_node,
+                   activation = "tanh", out_activation = "softmax",
+                   ctx = mx.cpu(), num.round = 10, learning.rate = 0.1,
+                   momentum = 0.9, array.batch.size = 32,
+                   eval.metric = mx.metric.accuracy, verbose = TRUE) {
+  net <- mx.mlp.symbol(hidden_node, out_node, activation, out_activation)
+  mx.model.FeedForward.create(net, data, label, ctx = ctx,
+                              num.round = num.round,
+                              learning.rate = learning.rate,
+                              momentum = momentum,
+                              array.batch.size = array.batch.size,
+                              eval.metric = eval.metric,
+                              verbose = verbose)
+}

@@ -30,6 +30,9 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
                                         momentum = 0.9,
                                         array.batch.size = 32,
                                         eval.metric = mx.metric.accuracy,
+                                        initializer = NULL,
+                                        batch.end.callback = NULL,
+                                        epoch.end.callback = NULL,
                                         verbose = TRUE) {
   batch <- array.batch.size
   feat <- ncol(X)
@@ -41,17 +44,25 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
   exec <- do.call(mx.simple.bind,
                   c(list(symbol, ctx = ctx, grad.req = "write"),
                     input.shapes))
-  params <- mx.model.init.params(symbol, input.shapes, 0.07)
+  params <- if (is.null(initializer)) {
+    mx.model.init.params(symbol, input.shapes, 0.07)
+  } else {
+    mx.init.create(initializer, symbol, input.shapes)
+  }
   for (n in names(params)) mx.exec.update.arg(exec, n, params[[n]])
   momenta <- lapply(params, function(p) array(0, dim = dim(p)))
 
   iter <- mx.io.arrayiter(X, y, batch.size = batch, shuffle = TRUE)
+  keep.going <- TRUE
   for (round in seq_len(num.round)) {
+    if (!keep.going) break
     state <- eval.metric$init()
     mx.io.reset(iter)
+    nbatch <- 0L
     repeat {
       b <- mx.io.next(iter)
       if (is.null(b)) break
+      nbatch <- nbatch + 1L
       # row-major batch: feed t(data) so R's column-major memory lines
       # up with the framework's (batch, feat) layout
       mx.exec.update.arg(exec, "data", t(b$data))
@@ -68,10 +79,21 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
         params[[n]] <- params[[n]] + momenta[[n]]
         mx.exec.update.arg(exec, n, params[[n]])
       }
+      if (!is.null(batch.end.callback)) {
+        ok <- batch.end.callback(round, nbatch, eval.metric$get(state))
+        if (identical(ok, FALSE)) keep.going <- FALSE
+      }
     }
     if (verbose) {
       cat(sprintf("Round [%d] Train-accuracy=%.4f\n", round,
                   eval.metric$get(state)))
+    }
+    if (!is.null(epoch.end.callback)) {
+      model.now <- structure(list(symbol = symbol, params = params,
+                                  exec = exec, batch = batch),
+                             class = "MXFeedForwardModel")
+      ok <- epoch.end.callback(model.now, round)
+      if (identical(ok, FALSE)) keep.going <- FALSE
     }
   }
   structure(list(symbol = symbol, params = params, exec = exec,
@@ -114,5 +136,8 @@ mx.model.load <- function(prefix, iteration) {
   nds <- mx.nd.load(sprintf("%s-%04d.params", prefix, iteration))
   params <- lapply(nds, as.array)
   names(params) <- sub("^arg:", "", names(params))
+  # a checkpoint from another binding may carry entries this symbol
+  # does not declare: drop them loudly rather than bind-time cryptically
+  params <- mx.util.filter.params(params, symbol)
   list(symbol = symbol, params = params)
 }

@@ -1,0 +1,47 @@
+# Optimizers (reference R-package/R/optimizer.R mx.opt.sgd/create/
+# get.updater).  Updates run through the NATIVE optimizer registry — one
+# momentum-state store shared with the python/C++/Scala bindings — with
+# the lr resolved in R per update (schedulers are R closures).
+
+mx.opt.create <- function(name, learning.rate = 0.01, momentum = NULL,
+                          wd = 0, rescale.grad = 1,
+                          lr_scheduler = NULL, ...) {
+  extra <- list(...)
+  keys <- c("rescale_grad", names(extra))
+  vals <- c(as.character(rescale.grad),
+            vapply(extra, as.character, ""))
+  if (!is.null(momentum)) {   # sgd-family only: adam has no momentum
+    keys <- c("momentum", keys)
+    vals <- c(as.character(momentum), vals)
+  }
+  handle <- .Call("mxg_opt_create", name, keys, vals)
+  structure(list(handle = handle, learning.rate = learning.rate,
+                 wd = wd, lr_scheduler = lr_scheduler),
+            class = "MXOptimizer")
+}
+
+mx.opt.sgd <- function(learning.rate = 0.01, momentum = 0, wd = 0,
+                       rescale.grad = 1, lr_scheduler = NULL) {
+  mx.opt.create("sgd", learning.rate = learning.rate,
+                momentum = momentum, wd = wd,
+                rescale.grad = rescale.grad, lr_scheduler = lr_scheduler)
+}
+
+# Stateful updater closure (reference mx.opt.get.updater).  The update
+# count the scheduler sees is PER INDEX (reference Optimizer
+# _update_count): with N parameter arrays, one batch advances the
+# schedule by one step, not N.
+mx.opt.get.updater <- function(optimizer) {
+  env <- new.env(parent = emptyenv())
+  env$counts <- list()
+  function(index, weight.nd, grad.nd) {
+    key <- as.character(index)
+    t <- if (is.null(env$counts[[key]])) 1L else env$counts[[key]] + 1L
+    env$counts[[key]] <- t
+    lr <- if (is.null(optimizer$lr_scheduler)) optimizer$learning.rate
+          else optimizer$lr_scheduler(t, optimizer$learning.rate)
+    invisible(.Call("mxg_opt_update", optimizer$handle,
+                    as.integer(index), weight.nd$handle, grad.nd$handle,
+                    as.double(lr), as.double(optimizer$wd)))
+  }
+}

@@ -1,0 +1,28 @@
+# Random ndarray sources (reference R-package/R/random.R): device-side
+# sampling through the registry ops; mx.set.seed (base.R) seeds the
+# in-program PRNG key these draw from.
+
+mx.runif <- function(shape, min = 0, max = 1, ctx = mx.cpu()) {
+  # `shape` in R order, like mx.nd.zeros
+  out <- mx.nd.internal.new(rev(as.integer(shape)), ctx)
+  .mx.nd.sample("_sample_uniform", out, c(min, max))
+  out
+}
+
+mx.rnorm <- function(shape, mean = 0, sd = 1, ctx = mx.cpu()) {
+  out <- mx.nd.internal.new(rev(as.integer(shape)), ctx)
+  .mx.nd.sample("_sample_normal", out, c(mean, sd))
+  out
+}
+
+.mx.nd.sample <- function(fname, out, scalars) {
+  idx <- .mx.func.index(fname)
+  desc <- .Call("mxg_func_describe", idx)
+  if (desc[1] != 0 || desc[2] != length(scalars)) {
+    stop(sprintf("%s expects %d inputs/%d scalars, got 0/%d",
+                 fname, desc[1], desc[2], length(scalars)))
+  }
+  .Call("mxg_func_invoke", idx, list(), as.double(scalars),
+        list(out$handle))
+  invisible(out)
+}

@@ -1,0 +1,68 @@
+# Recurrent network builders (reference R-package/R/rnn.R): symbolic
+# unrolled vanilla RNN over the operator registry, plus the shared
+# training-graph helper lstm.R/gru.R plug their cells into.
+#
+# Weight sharing across time is EXPLICIT: each layer's projection
+# weights are created once as Variables and composed into every
+# timestep (per-op names stay time-distinct; the parameters do not).
+
+mx.rnn.param <- function(param.prefix, layeridx = 0) {
+  nm <- function(part) sprintf("%s_l%d_%s", param.prefix, layeridx, part)
+  list(i2h.w = mx.symbol.Variable(nm("i2h_weight")),
+       i2h.b = mx.symbol.Variable(nm("i2h_bias")),
+       h2h.w = mx.symbol.Variable(nm("h2h_weight")),
+       h2h.b = mx.symbol.Variable(nm("h2h_bias")))
+}
+
+# One step: h' = act(W_i x + b_i + W_h h + b_h), weights from `param`
+mx.rnn.cell <- function(num.hidden, indata, prev.h, param, param.prefix,
+                        act.type = "tanh", layeridx = 0, seqidx = 0) {
+  nm <- function(part) sprintf("%s_l%d_%s_t%d", param.prefix, layeridx,
+                               part, seqidx)
+  i2h <- mx.symbol.internal.create("FullyConnected", list(
+    data = indata, weight = param$i2h.w, bias = param$i2h.b,
+    num_hidden = num.hidden, name = nm("i2h")))
+  h2h <- mx.symbol.internal.create("FullyConnected", list(
+    data = prev.h, weight = param$h2h.w, bias = param$h2h.b,
+    num_hidden = num.hidden, name = nm("h2h")))
+  total <- mx.symbol.internal.create("ElementWiseSum", list(
+    i2h, h2h, name = nm("sum")))
+  mx.symbol.internal.create("Activation", list(
+    data = total, act_type = act.type, name = nm("act")))
+}
+
+# Unrolled sequence classifier: slices seq.len timesteps, runs the
+# cell with one shared parameter set, softmax over the last state.
+mx.rnn.buildgraph <- function(step.fn, seq.len, num.label,
+                              prefix = "rnn") {
+  data <- mx.symbol.Variable("data")
+  slices <- mx.symbol.internal.create("SliceChannel", list(
+    data = data, num_outputs = seq.len, axis = 1,
+    name = paste0(prefix, "_slice")))
+  state <- mx.symbol.Variable(paste0(prefix, "_init_h"))
+  for (t in seq_len(seq.len)) {
+    xt <- mx.symbol.internal.create("Flatten", list(
+      data = .mx.symbol.pick(slices, t - 1),
+      name = sprintf("%s_flat_t%d", prefix, t)))
+    state <- step.fn(xt, state, t)
+  }
+  fc <- mx.symbol.internal.create("FullyConnected", list(
+    data = state, num_hidden = num.label,
+    name = paste0(prefix, "_cls")))
+  mx.symbol.internal.create("SoftmaxOutput", list(
+    data = fc, name = "softmax"))
+}
+
+.mx.symbol.pick <- function(multi.sym, index) {
+  structure(list(handle = .Call("mxg_sym_get_output", multi.sym$handle,
+                                as.integer(index))),
+            class = "MXSymbol")
+}
+
+mx.rnn <- function(seq.len, num.hidden, num.label, act.type = "tanh") {
+  param <- mx.rnn.param("rnn")
+  mx.rnn.buildgraph(
+    function(xt, h, t) mx.rnn.cell(num.hidden, xt, h, param, "rnn",
+                                   act.type = act.type, seqidx = t),
+    seq.len, num.label, prefix = "rnn")
+}

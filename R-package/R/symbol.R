@@ -96,3 +96,32 @@ print.MXSymbol <- function(x, ...) {
       paste(outputs.MXSymbol(x), collapse = ", "), ">\n")
   invisible(x)
 }
+
+# Elementwise symbol arithmetic (reference mxnet_generated.R operators):
+# dispatches the registry's _plus/_minus/_mul/_div creators, scalar
+# variants when one side is numeric.
+Ops.MXSymbol <- function(e1, e2) {
+  op <- .Generic
+  bin <- c("+" = "_plus", "-" = "_minus", "*" = "_mul", "/" = "_div")
+  sca <- c("+" = "_plus_scalar", "-" = "_minus_scalar",
+           "*" = "_mul_scalar", "/" = "_div_scalar")
+  rsca <- c("-" = "_rminus_scalar", "/" = "_rdiv_scalar")
+  if (missing(e2)) {   # unary +x / -x
+    if (op == "+") return(e1)
+    if (op == "-") {
+      return(mx.symbol.internal.create("_mul_scalar",
+                                       list(e1, scalar = -1)))
+    }
+    stop("unsupported unary symbol op: ", op)
+  }
+  if (!op %in% names(bin)) stop("unsupported symbol op: ", op)
+  if (inherits(e1, "MXSymbol") && inherits(e2, "MXSymbol")) {
+    mx.symbol.internal.create(bin[[op]], list(e1, e2))
+  } else if (inherits(e1, "MXSymbol")) {
+    mx.symbol.internal.create(sca[[op]], list(e1, scalar = e2))
+  } else if (op %in% names(rsca)) {
+    mx.symbol.internal.create(rsca[[op]], list(e2, scalar = e1))
+  } else {
+    mx.symbol.internal.create(sca[[op]], list(e2, scalar = e1))
+  }
+}

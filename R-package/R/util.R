@@ -1,0 +1,17 @@
+# Small shared helpers (reference R-package/R/util.R).
+
+# filter a param list against a symbol's arguments, warning on misses
+# (reference mx.util.filter.null + model arg checking)
+mx.util.filter.params <- function(params, symbol) {
+  known <- arguments.MXSymbol(symbol)
+  keep <- intersect(names(params), known)
+  dropped <- setdiff(names(params), known)
+  if (length(dropped) > 0) {
+    warning("dropping params absent from symbol: ",
+            paste(dropped, collapse = ", "))
+  }
+  params[keep]
+}
+
+is.MXNDArray <- function(x) inherits(x, "MXNDArray")
+is.MXSymbol <- function(x) inherits(x, "MXSymbol")

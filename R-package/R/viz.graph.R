@@ -1,0 +1,67 @@
+# Computation-graph rendering (reference R-package/R/viz.graph.R
+# graph.viz): emits Graphviz DOT from the symbol's json — viewable with
+# any dot renderer; no graph package dependency.
+
+graph.viz <- function(symbol, file = NULL) {
+  json <- mx.symbol.tojson(symbol)
+  parsed <- .mx.json.parse(json)
+  nodes <- parsed$nodes
+  lines <- c("digraph mxnet_tpu {", "  rankdir=BT;")
+  shapes <- c(null = "ellipse")
+  for (i in seq_along(nodes)) {
+    node <- nodes[[i]]
+    shape <- if (node$op == "null") "ellipse" else "box"
+    color <- if (node$op == "null") "lightblue" else "lightgoldenrod"
+    lines <- c(lines, sprintf(
+      "  n%d [label=\"%s\\n%s\", shape=%s, style=filled, fillcolor=%s];",
+      i - 1, node$name, node$op, shape, color))
+    for (input in node$inputs) {
+      lines <- c(lines, sprintf("  n%d -> n%d;", input[[1]], i - 1))
+    }
+  }
+  lines <- c(lines, "}")
+  dot <- paste(lines, collapse = "\n")
+  if (!is.null(file)) writeLines(dot, file)
+  invisible(dot)
+}
+
+# minimal json reader for the symbol format (nodes/op/name/inputs) —
+# avoids a jsonlite dependency; the format is machine-generated and
+# regular
+.mx.json.parse <- function(json) {
+  if (requireNamespace("jsonlite", quietly = TRUE)) {
+    return(jsonlite::fromJSON(json, simplifyVector = FALSE))
+  }
+  # fallback: walk the "nodes" array with a brace counter (node objects
+  # nest "attr"/"param" objects, so a flat regex cannot delimit them)
+  start <- regexpr('"nodes"\\s*:\\s*\\[', json)
+  stopifnot(start > 0)
+  chars <- strsplit(substring(json, start), "")[[1]]
+  node.texts <- character(0)
+  depth <- 0L
+  buf <- character(0)
+  for (ch in chars) {
+    if (ch == "{") depth <- depth + 1L
+    if (depth > 0) buf <- c(buf, ch)
+    if (ch == "}") {
+      depth <- depth - 1L
+      if (depth == 0L) {
+        node.texts <- c(node.texts, paste(buf, collapse = ""))
+        buf <- character(0)
+      }
+    }
+    if (ch == "]" && depth == 0L) break
+  }
+  nodes <- lapply(node.texts, function(txt) {
+    op <- sub('.*?"op"\\s*:\\s*"([^"]*)".*', "\\1", txt)
+    name <- sub('.*?"name"\\s*:\\s*"([^"]*)".*', "\\1", txt)
+    inputs.txt <- sub('.*"inputs"\\s*:\\s*\\[(.*?)\\]\\s*[,}].*',
+                      "\\1", txt)
+    pairs <- regmatches(inputs.txt,
+                        gregexpr("\\[\\s*[0-9]+", inputs.txt))[[1]]
+    inputs <- lapply(pairs, function(p)
+      list(as.integer(sub("\\[\\s*", "", p))))
+    list(op = op, name = name, inputs = inputs)
+  })
+  list(nodes = nodes)
+}

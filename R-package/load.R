@@ -15,8 +15,11 @@
 
 mxnet.load <- function(root = .mxnet.load.root) {
   pkg <- file.path(root, "R-package")
-  for (f in c("base.R", "ndarray.R", "symbol.R", "executor.R", "io.R",
-              "metric.R", "model.R")) {
+  for (f in c("base.R", "context.R", "util.R", "ndarray.R", "symbol.R",
+              "executor.R", "io.R", "random.R", "initializer.R",
+              "lr_scheduler.R", "optimizer.R", "metric.R", "callback.R",
+              "kvstore.R", "model.R", "mlp.R", "rnn.R", "lstm.R",
+              "gru.R", "viz.graph.R")) {
     source(file.path(pkg, "R", f))
   }
   glue.src <- file.path(pkg, "src", "mxnet_glue.c")

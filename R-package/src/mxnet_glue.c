@@ -26,6 +26,9 @@ typedef const void *FunctionHandle;
 typedef const void *AtomicSymbolCreator;
 typedef void *SymbolHandle;
 typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+typedef void *OptimizerHandle;
+typedef const void *OptimizerCreator;
 
 /* ---- resolved entry points ------------------------------------------- */
 static struct {
@@ -61,6 +64,7 @@ static struct {
   int (*SymbolFree)(SymbolHandle);
   int (*SymbolCompose)(SymbolHandle, const char *, mx_uint, const char **,
                        SymbolHandle *);
+  int (*SymbolGetOutput)(SymbolHandle, mx_uint, SymbolHandle *);
   int (*SymbolListArguments)(SymbolHandle, mx_uint *, const char ***);
   int (*SymbolListOutputs)(SymbolHandle, mx_uint *, const char ***);
   int (*SymbolListAuxiliaryStates)(SymbolHandle, mx_uint *, const char ***);
@@ -73,6 +77,23 @@ static struct {
                       NDArrayHandle *, mx_uint *, mx_uint, NDArrayHandle *,
                       ExecutorHandle *);
   int (*ExecutorForward)(ExecutorHandle, int);
+  int (*KVStoreCreate)(const char *, KVStoreHandle *);
+  int (*KVStoreFree)(KVStoreHandle);
+  int (*KVStoreInit)(KVStoreHandle, mx_uint, const int *, NDArrayHandle *);
+  int (*KVStorePush)(KVStoreHandle, mx_uint, const int *, NDArrayHandle *,
+                     int);
+  int (*KVStorePull)(KVStoreHandle, mx_uint, const int *, NDArrayHandle *,
+                     int);
+  int (*KVStoreGetType)(KVStoreHandle, const char **);
+  int (*KVStoreGetRank)(KVStoreHandle, int *);
+  int (*KVStoreGetGroupSize)(KVStoreHandle, int *);
+  int (*OptimizerFindCreator)(const char *, OptimizerCreator *);
+  int (*OptimizerCreateOptimizer)(OptimizerCreator, mx_uint,
+                                  const char **, const char **,
+                                  OptimizerHandle *);
+  int (*OptimizerFree)(OptimizerHandle);
+  int (*OptimizerUpdate)(OptimizerHandle, int, NDArrayHandle,
+                         NDArrayHandle, float, float);
   int (*ExecutorBackward)(ExecutorHandle, mx_uint, NDArrayHandle *);
   int (*ExecutorOutputs)(ExecutorHandle, mx_uint *, NDArrayHandle **);
   int (*ExecutorFree)(ExecutorHandle);
@@ -124,11 +145,24 @@ SEXP mxg_load(SEXP path) {
   RESOLVE(SymbolSaveToJSON, "MXSymbolSaveToJSON");
   RESOLVE(SymbolFree, "MXSymbolFree");
   RESOLVE(SymbolCompose, "MXSymbolCompose");
+  RESOLVE(SymbolGetOutput, "MXSymbolGetOutput");
   RESOLVE(SymbolListArguments, "MXSymbolListArguments");
   RESOLVE(SymbolListOutputs, "MXSymbolListOutputs");
   RESOLVE(SymbolListAuxiliaryStates, "MXSymbolListAuxiliaryStates");
   RESOLVE(SymbolInferShape, "MXSymbolInferShape");
   RESOLVE(ExecutorBind, "MXExecutorBind");
+  RESOLVE(KVStoreCreate, "MXKVStoreCreate");
+  RESOLVE(KVStoreFree, "MXKVStoreFree");
+  RESOLVE(KVStoreInit, "MXKVStoreInit");
+  RESOLVE(KVStorePush, "MXKVStorePush");
+  RESOLVE(KVStorePull, "MXKVStorePull");
+  RESOLVE(KVStoreGetType, "MXKVStoreGetType");
+  RESOLVE(KVStoreGetRank, "MXKVStoreGetRank");
+  RESOLVE(KVStoreGetGroupSize, "MXKVStoreGetGroupSize");
+  RESOLVE(OptimizerFindCreator, "MXOptimizerFindCreator");
+  RESOLVE(OptimizerCreateOptimizer, "MXOptimizerCreateOptimizer");
+  RESOLVE(OptimizerFree, "MXOptimizerFree");
+  RESOLVE(OptimizerUpdate, "MXOptimizerUpdate");
   RESOLVE(ExecutorForward, "MXExecutorForward");
   RESOLVE(ExecutorBackward, "MXExecutorBackward");
   RESOLVE(ExecutorOutputs, "MXExecutorOutputs");
@@ -514,6 +548,123 @@ SEXP mxg_exec_outputs(SEXP ex) {
 }
 
 /* ---- registration ------------------------------------------------------ */
+SEXP mxg_sym_get_output(SEXP sym, SEXP index) {
+  SymbolHandle out;
+  chk(mxg.SymbolGetOutput(unwrap(sym), (mx_uint)Rf_asInteger(index),
+                          &out));
+  return wrap_handle(out, sym_finalizer);
+}
+
+/* ---- KVStore + native optimizer (reference kvstore.R/optimizer.R
+ * surface; server-side state shared with every other binding) -------- */
+static void kv_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    mxg.KVStoreFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+static void opt_finalizer(SEXP ptr) {
+  void *h = R_ExternalPtrAddr(ptr);
+  if (h != NULL) {
+    mxg.OptimizerFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+SEXP mxg_kv_create(SEXP type) {
+  KVStoreHandle out;
+  chk(mxg.KVStoreCreate(CHAR(STRING_ELT(type, 0)), &out));
+  return wrap_handle(out, kv_finalizer);
+}
+
+static void kv_keys_vals(SEXP keys, SEXP vals, int *n_out, int **keys_out,
+                         NDArrayHandle **vals_out) {
+  int n = LENGTH(keys);
+  if (LENGTH(vals) != n) Rf_error("keys/vals length mismatch");
+  int *ks = (int *)R_alloc(n, sizeof(int));
+  NDArrayHandle *vs =
+      (NDArrayHandle *)R_alloc(n, sizeof(NDArrayHandle));
+  for (int i = 0; i < n; ++i) {
+    ks[i] = INTEGER(keys)[i];
+    vs[i] = unwrap(VECTOR_ELT(vals, i));
+  }
+  *n_out = n;
+  *keys_out = ks;
+  *vals_out = vs;
+}
+
+SEXP mxg_kv_init(SEXP kv, SEXP keys, SEXP vals) {
+  int n;
+  int *ks;
+  NDArrayHandle *vs;
+  kv_keys_vals(keys, vals, &n, &ks, &vs);
+  chk(mxg.KVStoreInit(unwrap(kv), (mx_uint)n, ks, vs));
+  return R_NilValue;
+}
+
+SEXP mxg_kv_push(SEXP kv, SEXP keys, SEXP vals, SEXP priority) {
+  int n;
+  int *ks;
+  NDArrayHandle *vs;
+  kv_keys_vals(keys, vals, &n, &ks, &vs);
+  chk(mxg.KVStorePush(unwrap(kv), (mx_uint)n, ks, vs,
+                      Rf_asInteger(priority)));
+  return R_NilValue;
+}
+
+SEXP mxg_kv_pull(SEXP kv, SEXP keys, SEXP vals, SEXP priority) {
+  int n;
+  int *ks;
+  NDArrayHandle *vs;
+  kv_keys_vals(keys, vals, &n, &ks, &vs);
+  chk(mxg.KVStorePull(unwrap(kv), (mx_uint)n, ks, vs,
+                      Rf_asInteger(priority)));
+  return R_NilValue;
+}
+
+SEXP mxg_kv_type(SEXP kv) {
+  const char *t;
+  chk(mxg.KVStoreGetType(unwrap(kv), &t));
+  return Rf_mkString(t);
+}
+
+SEXP mxg_kv_rank(SEXP kv) {
+  int r;
+  chk(mxg.KVStoreGetRank(unwrap(kv), &r));
+  return Rf_ScalarInteger(r);
+}
+
+SEXP mxg_kv_num_workers(SEXP kv) {
+  int r;
+  chk(mxg.KVStoreGetGroupSize(unwrap(kv), &r));
+  return Rf_ScalarInteger(r);
+}
+
+SEXP mxg_opt_create(SEXP name, SEXP keys, SEXP vals) {
+  OptimizerCreator creator;
+  chk(mxg.OptimizerFindCreator(CHAR(STRING_ELT(name, 0)), &creator));
+  int n = LENGTH(keys);
+  const char **ks = (const char **)R_alloc(n, sizeof(char *));
+  const char **vs = (const char **)R_alloc(n, sizeof(char *));
+  for (int i = 0; i < n; ++i) {
+    ks[i] = CHAR(STRING_ELT(keys, i));
+    vs[i] = CHAR(STRING_ELT(vals, i));
+  }
+  OptimizerHandle out;
+  chk(mxg.OptimizerCreateOptimizer(creator, (mx_uint)n, ks, vs, &out));
+  return wrap_handle(out, opt_finalizer);
+}
+
+SEXP mxg_opt_update(SEXP opt, SEXP index, SEXP weight, SEXP grad, SEXP lr,
+                    SEXP wd) {
+  chk(mxg.OptimizerUpdate(unwrap(opt), Rf_asInteger(index),
+                          unwrap(weight), unwrap(grad),
+                          (float)Rf_asReal(lr), (float)Rf_asReal(wd)));
+  return R_NilValue;
+}
+
 static const R_CallMethodDef call_methods[] = {
     {"mxg_load", (DL_FUNC)&mxg_load, 1},
     {"mxg_random_seed", (DL_FUNC)&mxg_random_seed, 1},
@@ -541,6 +692,16 @@ static const R_CallMethodDef call_methods[] = {
     {"mxg_exec_forward", (DL_FUNC)&mxg_exec_forward, 2},
     {"mxg_exec_backward", (DL_FUNC)&mxg_exec_backward, 2},
     {"mxg_exec_outputs", (DL_FUNC)&mxg_exec_outputs, 1},
+    {"mxg_sym_get_output", (DL_FUNC)&mxg_sym_get_output, 2},
+    {"mxg_kv_create", (DL_FUNC)&mxg_kv_create, 1},
+    {"mxg_kv_init", (DL_FUNC)&mxg_kv_init, 3},
+    {"mxg_kv_push", (DL_FUNC)&mxg_kv_push, 4},
+    {"mxg_kv_pull", (DL_FUNC)&mxg_kv_pull, 4},
+    {"mxg_kv_type", (DL_FUNC)&mxg_kv_type, 1},
+    {"mxg_kv_rank", (DL_FUNC)&mxg_kv_rank, 1},
+    {"mxg_kv_num_workers", (DL_FUNC)&mxg_kv_num_workers, 1},
+    {"mxg_opt_create", (DL_FUNC)&mxg_opt_create, 3},
+    {"mxg_opt_update", (DL_FUNC)&mxg_opt_update, 6},
     {NULL, NULL, 0}};
 
 void R_init_mxnet_glue(DllInfo *dll) {

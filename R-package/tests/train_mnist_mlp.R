@@ -53,3 +53,73 @@ stopifnot(length(reloaded$params) == length(model$params))
 
 stopifnot(acc >= 0.95)
 cat("R-PACKAGE TESTS PASSED\n")
+
+# ---- round-4 surface: optimizer/kvstore/metrics/builders ------------
+# exercised whenever Rscript is available (the mocked-header C test
+# covers the glue marshalling for these in every environment)
+
+# native optimizer + scheduler through the glue
+opt <- mx.opt.sgd(learning.rate = 0.1, momentum = 0.9,
+                  lr_scheduler = mx.lr_scheduler.FactorScheduler(100, 0.9))
+updater <- mx.opt.get.updater(opt)
+w <- mx.nd.array(array(0, dim = c(4)))
+g <- mx.nd.array(array(1, dim = c(4)))
+updater(0L, w, g)
+stopifnot(as.array(w)[1] < 0)
+
+# kvstore push/pull aggregation
+kv <- mx.kv.create("local")
+stopifnot(mx.kv.type(kv) == "local", mx.kv.rank(kv) == 0)
+kw <- mx.nd.zeros(4)
+mx.kv.init(kv, 3L, list(kw))
+mx.kv.push(kv, 3L, list(mx.nd.ones(4)))
+mx.kv.pull(kv, 3L, list(kw))
+stopifnot(all(as.array(kw) == 1))
+
+# device-side random draws
+mx.set.seed(7)
+r <- as.array(mx.runif(c(100), min = -1, max = 1))
+stopifnot(min(r) >= -1, max(r) <= 1, sd(r) > 0.3)
+
+# initializer zoo
+params <- mx.init.create(mx.init.Xavier(), net,
+                         list(data = c(64, 40), softmax_label = 40))
+stopifnot("fc1_weight" %in% names(params))
+
+# metric zoo sanity
+st <- mx.metric.rmse$init()
+st <- mx.metric.rmse$update(st, c(1, 2), c(1.5, 2.5))
+stopifnot(abs(mx.metric.rmse$get(st) - 0.5) < 1e-9)
+
+# recurrent builders compose + infer
+lstm.sym <- mx.lstm(seq.len = 4, num.hidden = 8, num.label = 3)
+stopifnot("lstm_l0_i2h_weight" %in% arguments.MXSymbol(lstm.sym))
+gru.sym <- mx.gru(seq.len = 4, num.hidden = 8, num.label = 3)
+stopifnot(length(outputs.MXSymbol(gru.sym)) == 1)
+
+# one-call MLP trains too
+mlp.model <- mx.mlp(train$X, train$y, hidden_node = c(16), out_node = 4,
+                    num.round = 3, array.batch.size = 40,
+                    learning.rate = 0.3, verbose = FALSE)
+mlp.probs <- predict(mlp.model, test$X)
+stopifnot(mean((max.col(mlp.probs) - 1) == test$y) > 0.5)
+
+# callbacks drive the training loop (batch + epoch end)
+ticks <- new.env(); ticks$n <- 0L
+cb.model <- mx.model.FeedForward.create(
+  net, train$X, train$y, num.round = 2, array.batch.size = 40,
+  learning.rate = 0.1, verbose = FALSE,
+  initializer = mx.init.Xavier(),
+  batch.end.callback = function(it, nb, v) {
+    ticks$n <- ticks$n + 1L; TRUE
+  },
+  epoch.end.callback = mx.callback.save.checkpoint(
+    file.path(tempdir(), "cbmlp"), period = 2))
+stopifnot(ticks$n == 2 * 20)
+stopifnot(file.exists(file.path(tempdir(), "cbmlp-0002.params")))
+
+# graph rendering emits DOT
+dot <- graph.viz(net)
+stopifnot(grepl("digraph", dot), grepl("fc1", dot))
+
+cat("R-PACKAGE EXTENDED SURFACE PASSED\n")

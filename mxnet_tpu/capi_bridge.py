@@ -206,10 +206,15 @@ _FUNC_SIGNATURES = {
 
 
 def _scalar_params(op) -> List[str]:
-    """Required scalar params of a registry op (the SimpleOp scalar-family
-    convention: Param("scalar", float, required=True))."""
-    return [x.name for x in op.params
-            if x.required and x.name == "scalar"]
+    """Params of a registry op passable as positional ABI scalars: the
+    SimpleOp scalar-family convention (Param("scalar", float,
+    required=True)), else every float-typed param in declared order
+    (the sample/clip families: low/high, loc/scale, a_min/a_max)."""
+    named = [x.name for x in op.params
+             if x.required and x.name == "scalar"]
+    if named:
+        return named
+    return [x.name for x in op.params if x.typ is float]
 
 
 def func_describe(name: str) -> List[int]:
@@ -222,8 +227,15 @@ def func_describe(name: str) -> List[int]:
     try:
         op = get_op(name)
         scalars = _scalar_params(op)
-        p = op.parse_params({s: 0.0 for s in scalars})
-        return [len(op.list_arguments(p)), len(scalars), 1, 1]
+        try:
+            p = op.parse_params({s: 0.0 for s in scalars})
+            nin = len(op.list_arguments(p))
+        except Exception:
+            # params beyond the scalars (e.g. the sample family's
+            # required `shape`, supplied at invoke time from the mutate
+            # target) block a dry parse; fall back to the declared arity
+            nin = getattr(op, "_nin", 1)
+        return [nin, len(scalars), 1, 1]
     except Exception:
         return [1, 0, 1, 1]
 
@@ -278,6 +290,19 @@ def func_invoke(name: str, use_handles: List[int], scalars: List[float],
         if names:
             args = list(ins)
             kwargs = dict(zip(names, scalars))
+    if name not in _FUNC_SIGNATURES and mutate_handles:
+        # ops with a required `shape` param and no inputs (the sample
+        # family) take it from the destination: the ABI's scalar channel
+        # cannot carry tuples
+        from .ops.registry import get_op
+        try:
+            op = get_op(name)
+            needs_shape = any(x.name == "shape" and x.required
+                              for x in op.params)
+        except Exception:
+            needs_shape = False
+        if needs_shape and "shape" not in kwargs:
+            kwargs["shape"] = tuple(outs[0].shape)
     if not outs:
         fn(*args, **kwargs)
         return

@@ -85,6 +85,18 @@ static SEXP Rf_ScalarInteger(int v) {
   return s;
 }
 
+static SEXP Rf_ScalarReal(double v) {
+  SEXP s = rmock_new(REALSXP, 1);
+  s->reals[0] = v;
+  return s;
+}
+
+static double Rf_asReal(SEXP s) {
+  if (s->type == REALSXP) return s->reals[0];
+  if (s->type == INTSXP) return (double)s->ints[0];
+  return 0.0;
+}
+
 static int Rf_asInteger(SEXP s) {
   if (s->type == INTSXP) return s->ints[0];
   if (s->type == REALSXP) return (int)s->reals[0];

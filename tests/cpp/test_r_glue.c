@@ -177,6 +177,50 @@ int main(int argc, char **argv) {
   got = mxg_nd_copy_to(VECTOR_ELT(VECTOR_ELT(loaded, 0), 0));
   for (int i = 0; i < 6; ++i) CHECK(REAL(got)[i] == vals[i]);
 
+  /* ---- multi-output indexing (rnn builders' SliceChannel path) ---- */
+  int sc_idx = str_index(cnames, "SliceChannel");
+  const char *sck[] = {"num_outputs", "axis"};
+  const char *scv[] = {"2", "1"};
+  SEXP sc = mxg_sym_create_atomic(Rf_ScalarInteger(sc_idx),
+                                  mkstrvec(2, sck), mkstrvec(2, scv));
+  SEXP sc_args = Rf_allocVector(VECSXP, 1);
+  SET_VECTOR_ELT(sc_args, 0, mxg_sym_create_variable(Rf_mkString("x")));
+  mxg_sym_compose(sc, Rf_mkString("split"), mkstrvec(1, dk), sc_args);
+  CHECK(LENGTH(mxg_sym_list_outputs(sc)) == 2);
+  SEXP half = mxg_sym_get_output(sc, Rf_ScalarInteger(1));
+  CHECK(LENGTH(mxg_sym_list_outputs(half)) == 1);
+
+  /* ---- kvstore + native optimizer through the glue ---- */
+  SEXP kv = mxg_kv_create(Rf_mkString("local"));
+  CHECK(strcmp(CHAR(STRING_ELT(mxg_kv_type(kv), 0)), "local") == 0);
+  CHECK(Rf_asInteger(mxg_kv_rank(kv)) == 0);
+  CHECK(Rf_asInteger(mxg_kv_num_workers(kv)) == 1);
+  int wshape[1] = {4};
+  SEXP kw = mxg_nd_create(mkintvec(1, wshape), dev0, id0);
+  double zeros4[4] = {0, 0, 0, 0}, ones4[4] = {1, 1, 1, 1};
+  mxg_nd_copy_from(kw, mkrealvec(4, zeros4));
+  SEXP kg = mxg_nd_create(mkintvec(1, wshape), dev0, id0);
+  mxg_nd_copy_from(kg, mkrealvec(4, ones4));
+  int key3[1] = {3};
+  SEXP kws = Rf_allocVector(VECSXP, 1);
+  SET_VECTOR_ELT(kws, 0, kw);
+  SEXP kgs = Rf_allocVector(VECSXP, 1);
+  SET_VECTOR_ELT(kgs, 0, kg);
+  mxg_kv_init(kv, mkintvec(1, key3), kws);
+  mxg_kv_push(kv, mkintvec(1, key3), kgs, Rf_ScalarInteger(0));
+  mxg_kv_pull(kv, mkintvec(1, key3), kws, Rf_ScalarInteger(0));
+  got = mxg_nd_copy_to(kw);
+  CHECK(REAL(got)[0] == 1.0 && REAL(got)[3] == 1.0);
+
+  const char *ok[] = {"momentum"};
+  const char *ov[] = {"0.9"};
+  SEXP opt = mxg_opt_create(Rf_mkString("sgd"), mkstrvec(1, ok),
+                            mkstrvec(1, ov));
+  mxg_opt_update(opt, Rf_ScalarInteger(0), kw, kg, Rf_ScalarReal(0.1),
+                 Rf_ScalarReal(0.0));
+  got = mxg_nd_copy_to(kw);
+  CHECK(REAL(got)[0] < 1.0); /* sgd stepped downhill on +1 grads */
+
   mxg_nd_waitall();
   printf("R GLUE TESTS PASSED\n");
   return 0;

@@ -54,3 +54,49 @@ def test_r_package_trains_mnist_mlp(tmp_path):
     sys.stderr.write(res.stderr)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "R-PACKAGE TESTS PASSED" in res.stdout
+
+
+def test_r_surface_depth_and_call_targets():
+    """Round-4 R deepening: (a) >= 15 reference R files have working
+    counterparts; (b) every .Call() target named anywhere in R/*.R is
+    registered in the glue's call_methods table (catches typos without
+    an R installation); (c) every new API is exported."""
+    import re
+    rdir = os.path.join(ROOT, "R-package", "R")
+    have = set(os.listdir(rdir))
+    counterparts = {  # repo file -> reference file(s) it covers
+        "base.R": ["zzz.R", "util.R"], "context.R": ["context.R"],
+        "ndarray.R": ["ndarray.R"], "symbol.R": ["symbol.R",
+                                                 "mxnet_generated.R"],
+        "executor.R": ["executor.R"], "io.R": ["io.R"],
+        "random.R": ["random.R"], "initializer.R": ["initializer.R"],
+        "optimizer.R": ["optimizer.R"],
+        "lr_scheduler.R": ["lr_scheduler.R"], "metric.R": ["metric.R"],
+        "callback.R": ["callback.R"], "kvstore.R": ["kvstore.R"],
+        "model.R": ["model.R"], "mlp.R": ["mlp.R"], "rnn.R": ["rnn.R"],
+        "lstm.R": ["lstm.R"], "gru.R": ["gru.R"],
+        "viz.graph.R": ["viz.graph.R"],
+    }
+    for f in counterparts:
+        assert f in have, f
+    covered = {r for f in counterparts for r in counterparts[f]}
+    assert len(covered) >= 15, sorted(covered)
+
+    glue = open(os.path.join(ROOT, "R-package", "src",
+                             "mxnet_glue.c")).read()
+    registered = set(re.findall(r'\{"(mxg_\w+)"', glue))
+    used = set()
+    for f in os.listdir(rdir):
+        body = open(os.path.join(rdir, f)).read()
+        used |= set(re.findall(r'\.Call\("(mxg_\w+)"', body))
+    missing = used - registered
+    assert not missing, "R calls unregistered glue entry points: %s" \
+        % sorted(missing)
+
+    ns = open(os.path.join(ROOT, "R-package", "NAMESPACE")).read()
+    for api in ["mx.opt.sgd", "mx.kv.create", "mx.lstm", "mx.gru",
+                "mx.rnn", "mx.mlp", "mx.init.Xavier",
+                "mx.lr_scheduler.FactorScheduler",
+                "mx.callback.save.checkpoint", "mx.runif",
+                "mx.metric.rmse", "graph.viz"]:
+        assert "export(%s)" % api in ns, api
