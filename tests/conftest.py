@@ -33,9 +33,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # sets jax's jax_platforms config to "axon,cpu", which takes precedence over
 # the JAX_PLATFORMS env var. Force it back to cpu-only before any backend
 # initializes so tests never touch the real TPU tunnel.
+# Persistent XLA compilation cache: the suite (and its many subprocess
+# tests) recompiles the same programs — MLP fits, ResNet blocks, glue
+# gates — every run.  This box has ONE core, so sharding can't hide
+# compile time; caching it across processes and runs can.  The env var
+# form propagates to every subprocess test automatically.
+_cache_base = os.environ.get(
+    "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache"))
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(_cache_base, "mxtpu_xla_cache"))
+try:
+    os.makedirs(_cache_dir, exist_ok=True)
+    # env-var form so SUBPROCESS tests inherit all three settings too
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_ENABLE_XLA_CACHES", "all")
+except OSError:   # read-only home: run uncached rather than not at all
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    _cache_dir = None
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+if _cache_dir is not None:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
 
 
 def pytest_configure(config):
