@@ -1,11 +1,13 @@
-"""Stacked autoencoder with layerwise pretraining + finetuning (reference
-example/autoencoder/{autoencoder.py,model.py} capability).
+"""Stacked (sparse) autoencoder model.
 
-Each layer is pretrained as a 1-hidden-layer denoising AE, then the full
-stack is finetuned end-to-end with LinearRegressionOutput reconstruction
-loss.  Every stage is one fused XLA program.
+Capability parity with reference example/autoencoder/autoencoder.py:1:
+``AutoEncoderModel`` builds per-layer pretraining stacks plus a full
+encoder/decoder, supports KL sparseness regularization, dropout at
+pretrain and finetune time, greedy layerwise pretraining feeding each
+layer the previous encoder's features, end-to-end finetuning, and a
+reconstruction-error eval.  Every stage runs as one fused XLA program
+through the raw-executor Solver.
 """
-import argparse
 import logging
 import os
 import sys
@@ -15,76 +17,196 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import mxnet_tpu as mx
 
-
-def ae_symbol(dims, noise=0.2):
-    """Encoder dims[0]->dims[-1] and mirrored decoder, reconstruction loss.
-    Layer names are depth-stable (enc_i / dec_i maps dims[i]<->dims[i+1])
-    so pretrained weights carry over when the stack grows."""
-    x = mx.sym.Variable("data")
-    net = mx.sym.Dropout(x, p=noise) if noise > 0 else x
-    for i, d in enumerate(dims[1:]):
-        net = mx.sym.FullyConnected(net, num_hidden=d, name="enc_%d" % i)
-        net = mx.sym.Activation(net, act_type="relu")
-    for j in reversed(range(len(dims) - 1)):
-        net = mx.sym.FullyConnected(net, num_hidden=dims[j],
-                                    name="dec_%d" % j)
-        if j > 0:
-            net = mx.sym.Activation(net, act_type="relu")
-    return mx.sym.LinearRegressionOutput(net, label=mx.sym.Variable(
-        "reconstruction_label"), name="rec")
+import model
+from solver import Monitor, Solver
 
 
-def train_ae(dims, data, ctx, batch_size, epochs, lr, noise,
-             arg_params=None):
-    it = mx.io.NDArrayIter(data, data.reshape(len(data), -1),
-                           batch_size=batch_size, shuffle=True,
-                           label_name="reconstruction_label")
-    mod = mx.mod.Module(ae_symbol(dims, noise), context=ctx,
-                        label_names=("reconstruction_label",))
-    mod.fit(it, num_epoch=epochs, optimizer="adam",
-            optimizer_params={"learning_rate": lr}, eval_metric="mse",
-            arg_params=arg_params, allow_missing=True)
-    return mod
+def _l2_norm(label, pred):
+    return np.mean(np.square(label - pred)) / 2.0
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--tpus", type=str)
-    parser.add_argument("--batch-size", type=int, default=128)
-    parser.add_argument("--pretrain-epochs", type=int, default=2)
-    parser.add_argument("--finetune-epochs", type=int, default=4)
-    args = parser.parse_args()
-    logging.basicConfig(level=logging.INFO)
-    ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
-        else [mx.cpu()]
+class AutoEncoderModel(model.MXModel):
+    def setup(self, dims, sparseness_penalty=None, pt_dropout=None,
+              ft_dropout=None, input_act=None, internal_act="relu",
+              output_act=None):
+        self.N = len(dims) - 1
+        self.dims = dims
+        self.stacks = []
+        self.pt_dropout, self.ft_dropout = pt_dropout, ft_dropout
+        self.input_act = input_act
+        self.internal_act, self.output_act = internal_act, output_act
 
-    rng = np.random.RandomState(0)
-    basis = rng.rand(16, 784).astype(np.float32)
-    codes = rng.rand(4096, 16).astype(np.float32)
-    data = (codes @ basis) / 16.0          # low-rank "images"
+        self.data = mx.sym.Variable("data")
+        for i in range(self.N):
+            decoder_act = input_act if i == 0 else internal_act
+            idropout = None if i == 0 else pt_dropout
+            encoder_act = output_act if i == self.N - 1 else internal_act
+            odropout = None if i == self.N - 1 else pt_dropout
+            stack, args, grads, mults, auxs = self.make_stack(
+                i, self.data, dims[i], dims[i + 1], sparseness_penalty,
+                idropout, odropout, encoder_act, decoder_act)
+            self.stacks.append(stack)
+            self.args.update(args)
+            self.args_grad.update(grads)
+            self.args_mult.update(mults)
+            self.auxs.update(auxs)
+        self.encoder, self.internals = self.make_encoder(
+            self.data, dims, sparseness_penalty, ft_dropout, internal_act,
+            output_act)
+        self.decoder = self.make_decoder(
+            self.encoder, dims, sparseness_penalty, ft_dropout,
+            internal_act, input_act)
+        if input_act == "softmax":
+            self.loss = self.decoder
+        else:
+            self.loss = mx.sym.LinearRegressionOutput(data=self.decoder,
+                                                      label=self.data)
 
-    dims = [784, 256, 64]
-    # layerwise pretraining: grow the stack one layer at a time, reusing
-    # the already-trained encoder/decoder weights (allow_missing binds them)
-    pretrained = None
-    for depth in range(2, len(dims) + 1):
-        mod = train_ae(dims[:depth], data, ctx, args.batch_size,
-                       args.pretrain_epochs, 1e-3, noise=0.2,
-                       arg_params=pretrained)
-        pretrained, _ = mod.get_params()
-        logging.info("pretrained stack depth %d", depth - 1)
+    def _maybe_sparse(self, x, act, tag, penalty):
+        """KL sparseness only makes sense on sigmoid activations."""
+        if act == "sigmoid" and penalty:
+            x = mx.sym.IdentityAttachKLSparseReg(data=x, name=tag,
+                                                 penalty=penalty)
+        return x
 
-    # finetune the full stack without input noise
-    mod = train_ae(dims, data, ctx, args.batch_size, args.finetune_epochs,
-                   1e-3, noise=0.0, arg_params=pretrained)
+    @staticmethod
+    def _activate(x, act):
+        """'softmax' is not an Activation type (true in the reference
+        too, where this path crashed); route it to SoftmaxActivation."""
+        if act == "softmax":
+            return mx.sym.SoftmaxActivation(data=x)
+        return mx.sym.Activation(data=x, act_type=act)
 
-    it = mx.io.NDArrayIter(data[:512], data[:512].reshape(512, -1),
-                           batch_size=args.batch_size,
-                           label_name="reconstruction_label")
-    mse = mx.metric.MSE()
-    mod.score(it, mse)
-    print("final reconstruction MSE: %.5f" % mse.get()[1])
+    def make_stack(self, istack, data, num_input, num_hidden,
+                   sparseness_penalty=None, idropout=None, odropout=None,
+                   encoder_act="relu", decoder_act="relu"):
+        """One layer's symmetric pretraining net (reference
+        autoencoder.py:52): dropout -> encode -> act -> dropout ->
+        decode -> act -> reconstruction loss against the stack input."""
+        x = data
+        if idropout:
+            x = mx.sym.Dropout(data=x, p=idropout)
+        x = mx.sym.FullyConnected(name="encoder_%d" % istack, data=x,
+                                  num_hidden=num_hidden)
+        if encoder_act:
+            x = self._activate(x, encoder_act)
+            x = self._maybe_sparse(x, encoder_act,
+                                   "sparse_encoder_%d" % istack,
+                                   sparseness_penalty)
+        if odropout:
+            x = mx.sym.Dropout(data=x, p=odropout)
+        x = mx.sym.FullyConnected(name="decoder_%d" % istack, data=x,
+                                  num_hidden=num_input)
+        if decoder_act == "softmax":
+            x = mx.sym.Softmax(data=x, label=data, prob_label=True)
+        elif decoder_act:
+            x = self._activate(x, decoder_act)
+            x = self._maybe_sparse(x, decoder_act,
+                                   "sparse_decoder_%d" % istack,
+                                   sparseness_penalty)
+            x = mx.sym.LinearRegressionOutput(data=x, label=data)
+        else:
+            x = mx.sym.LinearRegressionOutput(data=x, label=data)
 
+        init = mx.initializer.Uniform(0.07)
+        args, grads, mults = {}, {}, {}
+        for role, shape in (("encoder_%d_weight", (num_hidden, num_input)),
+                            ("encoder_%d_bias", (num_hidden,)),
+                            ("decoder_%d_weight", (num_input, num_hidden)),
+                            ("decoder_%d_bias", (num_input,))):
+            name = role % istack
+            args[name] = mx.nd.empty(shape, self.xpu)
+            grads[name] = mx.nd.empty(shape, self.xpu)
+            mults[name] = 2.0 if name.endswith("bias") else 1.0
+            init(name, args[name])
+        auxs = {}
+        if encoder_act == "sigmoid" and sparseness_penalty:
+            auxs["sparse_encoder_%d_moving_avg" % istack] = \
+                mx.nd.ones((num_hidden,), self.xpu) * 0.5
+        if decoder_act == "sigmoid" and sparseness_penalty:
+            auxs["sparse_decoder_%d_moving_avg" % istack] = \
+                mx.nd.ones((num_input,), self.xpu) * 0.5
+        return x, args, grads, mults, auxs
 
-if __name__ == "__main__":
-    main()
+    def make_encoder(self, data, dims, sparseness_penalty=None,
+                     dropout=None, internal_act="relu", output_act=None):
+        x = data
+        internals = []
+        N = len(dims) - 1
+        for i in range(N):
+            x = mx.sym.FullyConnected(name="encoder_%d" % i, data=x,
+                                      num_hidden=dims[i + 1])
+            act = internal_act if i < N - 1 else output_act
+            if act:
+                x = self._activate(x, act)
+                x = self._maybe_sparse(x, act, "sparse_encoder_%d" % i,
+                                       sparseness_penalty)
+            if dropout:
+                x = mx.sym.Dropout(data=x, p=dropout)
+            internals.append(x)
+        return x, internals
+
+    def make_decoder(self, feature, dims, sparseness_penalty=None,
+                     dropout=None, internal_act="relu", input_act=None):
+        x = feature
+        N = len(dims) - 1
+        for i in reversed(range(N)):
+            x = mx.sym.FullyConnected(name="decoder_%d" % i, data=x,
+                                      num_hidden=dims[i])
+            act = internal_act if i > 0 else input_act
+            if act:
+                x = self._activate(x, act)
+                x = self._maybe_sparse(x, act, "sparse_decoder_%d" % i,
+                                       sparseness_penalty)
+            if dropout and i > 0:
+                x = mx.sym.Dropout(data=x, p=dropout)
+        return x
+
+    def _make_solver(self, optimizer, l_rate, decay, lr_scheduler):
+        solver = Solver(optimizer, momentum=0.9, wd=decay,
+                        learning_rate=l_rate, lr_scheduler=lr_scheduler)
+        solver.set_metric(mx.metric.CustomMetric(_l2_norm))
+        solver.set_monitor(Monitor(1000))
+        return solver
+
+    def layerwise_pretrain(self, X, batch_size, n_iter, optimizer, l_rate,
+                           decay, lr_scheduler=None):
+        """Greedy pretraining: layer i trains on layer i-1's extracted
+        features (reference autoencoder.py:137)."""
+        solver = self._make_solver(optimizer, l_rate, decay, lr_scheduler)
+        data_iter = mx.io.NDArrayIter({"data": X}, batch_size=batch_size,
+                                      shuffle=True,
+                                      last_batch_handle="roll_over")
+        for i in range(self.N):
+            if i == 0:
+                iter_i = data_iter
+            else:
+                feats = model.extract_feature(
+                    self.internals[i - 1], self.args, self.auxs,
+                    data_iter, X.shape[0], self.xpu)
+                iter_i = mx.io.NDArrayIter(
+                    {"data": next(iter(feats.values()))},
+                    batch_size=batch_size, last_batch_handle="roll_over")
+            logging.info("Pre-training layer %d...", i)
+            solver.solve(self.xpu, self.stacks[i], self.args,
+                         self.args_grad, self.auxs, iter_i, 0, n_iter,
+                         self.args_mult)
+
+    def finetune(self, X, batch_size, n_iter, optimizer, l_rate, decay,
+                 lr_scheduler=None):
+        solver = self._make_solver(optimizer, l_rate, decay, lr_scheduler)
+        data_iter = mx.io.NDArrayIter({"data": X}, batch_size=batch_size,
+                                      shuffle=True,
+                                      last_batch_handle="roll_over")
+        logging.info("Fine tuning...")
+        solver.solve(self.xpu, self.loss, self.args, self.args_grad,
+                     self.auxs, data_iter, 0, n_iter, self.args_mult)
+
+    def eval(self, X, batch_size=100):
+        data_iter = mx.io.NDArrayIter({"data": X}, batch_size=batch_size,
+                                      shuffle=False,
+                                      last_batch_handle="pad")
+        Y = next(iter(model.extract_feature(
+            self.loss, self.args, self.auxs, data_iter, X.shape[0],
+            self.xpu).values()))
+        return np.mean(np.square(Y - X)) / 2.0

@@ -1,0 +1,18 @@
+"""MNIST-like data for the autoencoder example.
+
+Capability parity with reference example/autoencoder/data.py:1 (which
+fetched MNIST via sklearn — no egress here): a deterministic 784-d
+low-rank dataset scaled like the reference's mnist.data * 0.02, with
+10 latent classes so clustering structure exists for the SAE to find.
+"""
+import numpy as np
+
+
+def get_mnist(n=70000, seed=1234):
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(10, 784).astype(np.float32)
+    y = rng.randint(0, 10, size=n)
+    X = (templates[y] + 0.15 * rng.randn(n, 784).astype(np.float32))
+    X = np.clip(X, 0.0, None) * (255.0 * 0.02 / max(X.max(), 1e-6))
+    p = rng.permutation(n)
+    return X[p].astype(np.float32), y[p].astype(np.float64)

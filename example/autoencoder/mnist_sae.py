@@ -1,0 +1,58 @@
+"""Train the stacked autoencoder on (synthetic) MNIST.
+
+Capability parity with reference example/autoencoder/mnist_sae.py:1:
+784-500-500-2000-10 SAE with layerwise pretraining, finetuning,
+save/load round-trip, and train/val reconstruction error.  Iteration
+counts and layer widths are CLI-scalable so the same script serves CI.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+import data
+from autoencoder import AutoEncoderModel
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dims", type=int, nargs="+",
+                        default=[784, 500, 500, 2000, 10])
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--pretrain-iters", type=int, default=50000)
+    parser.add_argument("--finetune-iters", type=int, default=100000)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--lr-step", type=int, default=20000)
+    parser.add_argument("--num-examples", type=int, default=70000)
+    parser.add_argument("--save", default="mnist_pt.arg")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.DEBUG)
+
+    ae_model = AutoEncoderModel(mx.cpu(), args.dims, pt_dropout=0.2,
+                                internal_act="relu", output_act="relu")
+
+    X, _ = data.get_mnist(n=args.num_examples)
+    cut = int(len(X) * 6 / 7)
+    train_X, val_X = X[:cut], X[cut:]
+
+    ae_model.layerwise_pretrain(
+        train_X, args.batch_size, args.pretrain_iters, "sgd",
+        l_rate=args.lr, decay=0.0,
+        lr_scheduler=mx.lr_scheduler.FactorScheduler(args.lr_step, 0.1))
+    ae_model.finetune(
+        train_X, args.batch_size, args.finetune_iters, "sgd",
+        l_rate=args.lr, decay=0.0,
+        lr_scheduler=mx.lr_scheduler.FactorScheduler(args.lr_step, 0.1))
+    ae_model.save(args.save)
+    ae_model.load(args.save)
+    train_err = ae_model.eval(train_X)
+    val_err = ae_model.eval(val_X)
+    print("Training error: %.6f" % train_err)
+    print("Validation error: %.6f" % val_err)
+
+
+if __name__ == "__main__":
+    main()

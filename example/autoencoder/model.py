@@ -1,0 +1,66 @@
+"""Raw-executor model base for the autoencoder example.
+
+Capability parity with reference example/autoencoder/model.py:1:
+``MXModel`` (owns args/grads/lr-mults/auxs, pickle save/load) and
+``extract_feature`` (stream a dataset through a bound symbol, collect
+outputs on host).
+"""
+import os
+import pickle
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def extract_feature(sym, args, auxs, data_iter, N, xpu=None):
+    """Forward every batch of ``data_iter`` through ``sym``; returns
+    {output_name: (N, ...) array} (reference model.py:12)."""
+    xpu = xpu or mx.cpu()
+    input_names = [k for k, _ in data_iter.provide_data]
+    input_buffs = [mx.nd.empty(shape, ctx=xpu)
+                   for _, shape in data_iter.provide_data]
+    bound_args = dict(args, **dict(zip(input_names, input_buffs)))
+    exe = sym.bind(xpu, args=bound_args, aux_states=auxs)
+    collected = None
+    data_iter.hard_reset()
+    for batch in data_iter:
+        for data, buff in zip(batch.data, input_buffs):
+            buff[:] = data.asnumpy() if hasattr(data, "asnumpy") else data
+        outs = exe.forward(is_train=False)
+        if collected is None:
+            collected = [[] for _ in outs]
+        for acc, out in zip(collected, outs):
+            acc.append(out.asnumpy())
+    outputs = [np.concatenate(chunks, axis=0)[:N] for chunks in collected]
+    return dict(zip(sym.list_outputs(), outputs))
+
+
+class MXModel:
+    """Parameter-owning base: subclasses implement setup() to build
+    symbols and fill args/args_grad/args_mult/auxs (reference
+    model.py:37)."""
+
+    def __init__(self, xpu=None, *args, **kwargs):
+        self.xpu = xpu or mx.cpu()
+        self.loss = None
+        self.args = {}
+        self.args_grad = {}
+        self.args_mult = {}
+        self.auxs = {}
+        self.setup(*args, **kwargs)
+
+    def setup(self, *args, **kwargs):
+        raise NotImplementedError("must override this")
+
+    def save(self, fname):
+        with open(fname, "wb") as f:
+            pickle.dump({k: v.asnumpy() for k, v in self.args.items()}, f)
+
+    def load(self, fname):
+        with open(fname, "rb") as f:
+            for key, val in pickle.load(f).items():
+                if key in self.args:
+                    self.args[key][:] = val

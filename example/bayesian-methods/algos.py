@@ -1,0 +1,320 @@
+"""SG-MCMC and distillation training loops for Bayesian dark knowledge.
+
+Capability parity with reference example/bayesian-methods/algos.py:1
+(HMC, SGD, SGLD, DistilledSGLD) on mxnet_tpu executors.  The leapfrog
+integrator is factored out of step_HMC, and minibatches are drawn once
+per step with a shared index draw; each forward/backward is one jitted
+XLA program so the Python loop only moves O(#params) scalars.
+"""
+import logging
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from utils import get_executor, copy_param, sample_test_acc, \
+    sample_test_regression
+
+
+def calc_potential(exe, params, label_name, noise_precision, prior_precision):
+    """U(theta) = noise_prec/2 * ||f(x) - y||^2 + prior_prec/2 * ||theta||^2
+    (reference algos.py:8)."""
+    exe.copy_params_from(params)
+    exe.forward(is_train=False)
+    resid = exe.outputs[0].asnumpy() - exe.arg_dict[label_name].asnumpy()
+    u = 0.5 * noise_precision * float(np.square(resid).sum())
+    for v in params.values():
+        u += 0.5 * prior_precision * float(np.square(v.asnumpy()).sum())
+    return u
+
+
+def calc_grad(exe, exe_grads, params, X, Y, label_name=None, outgrad_f=None):
+    """One forward/backward at ``params`` on the (X, Y) already-sized
+    batch (reference algos.py:19)."""
+    exe.copy_params_from(params)
+    exe.arg_dict["data"][:] = X
+    if outgrad_f is None:
+        exe.arg_dict[label_name][:] = Y
+        exe.forward(is_train=True)
+        exe.backward()
+    else:
+        exe.forward(is_train=True)
+        exe.backward(outgrad_f(exe.outputs, Y))
+    for g in exe_grads.values():
+        g.wait_to_read()
+
+
+def _grads_at_current(exe, exe_grads):
+    """Forward/backward at the executor's resident params; returns host
+    copies of the gradients."""
+    exe.forward(is_train=True)
+    exe.backward()
+    return {k: g.asnumpy() for k, g in exe_grads.items()}
+
+
+def step_HMC(exe, exe_params, exe_grads, label_key, noise_precision,
+             prior_precision, L=10, eps=1e-6):
+    """One Hamiltonian Monte Carlo transition: momentum refresh, L
+    leapfrog steps, Metropolis accept/reject (reference algos.py:33)."""
+    start = {k: v.copyto(v.context) for k, v in exe_params.items()}
+    pos = {k: v.copyto(v.context) for k, v in exe_params.items()}
+    mom0 = {k: np.random.randn(*v.shape).astype(np.float32)
+            for k, v in exe_params.items()}
+    mom = {k: m.copy() for k, m in mom0.items()}
+
+    u0 = calc_potential(exe, start, label_key, noise_precision,
+                        prior_precision)
+    k0 = sum(0.5 * float(np.square(m).sum()) for m in mom0.values())
+
+    # Leapfrog: half momentum kick, L position drifts with full kicks
+    # between them, closing half kick folded into the last iteration.
+    exe.copy_params_from(pos)
+    g = _grads_at_current(exe, exe_grads)
+    for k in mom:
+        mom[k] -= 0.5 * eps * g[k]
+    for step in range(L):
+        for k in exe_params:
+            exe_params[k][:] = exe_params[k].asnumpy() + eps * mom[k]
+        g = _grads_at_current(exe, exe_grads)
+        kick = eps if step < L - 1 else 0.5 * eps
+        for k in mom:
+            mom[k] -= kick * g[k]
+    # snapshot ONLY the model params: arg_dict also holds the data/label
+    # input buffers, and including them would add a constant ~||X||^2
+    # term to u1 but not u0, silently zeroing the acceptance rate
+    end = {k: exe.arg_dict[k].copyto(mx.cpu()) for k in exe_params}
+
+    u1 = calc_potential(exe, end, label_key, noise_precision,
+                        prior_precision)
+    k1 = sum(0.5 * float(np.square(m).sum()) for m in mom.values())
+    if np.random.rand() < np.exp((u0 + k0) - (u1 + k1)):
+        exe.copy_params_from(end)
+        return end, 1
+    exe.copy_params_from(start)
+    return start, 0
+
+
+def HMC(sym, data_inputs, X, Y, X_test, Y_test, sample_num,
+        initializer=None, noise_precision=1 / 9.0, prior_precision=0.1,
+        learning_rate=1e-6, L=10, dev=None, thin=10, report_every=100000):
+    """Full-batch HMC posterior sampling (reference algos.py:84)."""
+    dev = dev or mx.cpu()
+    label_key = next(k for k in data_inputs if k != "data")
+    exe, params, grads, _ = get_executor(sym, dev, data_inputs, initializer)
+    exe.arg_dict["data"][:] = X
+    exe.arg_dict[label_key][:] = Y
+    pool, accepted = [], 0
+    tic = time.time()
+    for i in range(1, sample_num + 1):
+        sample, ok = step_HMC(exe, params, grads, label_key,
+                              noise_precision, prior_precision, L,
+                              learning_rate)
+        accepted += ok
+        if i % thin == 0:
+            pool.append(sample)
+        if i % report_every == 0:
+            mse = sample_test_regression(exe, X_test, Y_test,
+                                         sample_pool=pool or None,
+                                         minibatch_size=Y.shape[0],
+                                         save_path="regression_HMC.txt")
+            logging.info("HMC iter %d (%.1fs) MSE %.4f", i,
+                         time.time() - tic, mse)
+            tic = time.time()
+        exe.copy_params_from(sample)
+    logging.info("HMC accept ratio %.3f", accepted / float(sample_num))
+    return pool
+
+
+def _minibatch(rng, X, Y, size):
+    idx = rng.randint(0, X.shape[0], size=size)
+    return X[idx], Y[idx]
+
+
+def SGD(sym, data_inputs, X, Y, X_test, Y_test, total_iter_num, lr=None,
+        lr_scheduler=None, prior_precision=1, out_grad_f=None,
+        initializer=None, minibatch_size=100, dev=None, report_every=500):
+    """Plain MAP baseline the MCMC methods are compared against
+    (reference algos.py:113)."""
+    dev = dev or mx.cpu()
+    label_key = None if out_grad_f else \
+        next(k for k in data_inputs if k != "data")
+    exe, params, grads, _ = get_executor(sym, dev, data_inputs, initializer)
+    opt = mx.optimizer.create("sgd", learning_rate=lr,
+                              rescale_grad=X.shape[0] / minibatch_size,
+                              lr_scheduler=lr_scheduler, wd=prior_precision)
+    updater = mx.optimizer.get_updater(opt)
+    rng = np.random.RandomState(100)
+    tic = time.time()
+    for i in range(1, total_iter_num + 1):
+        xb, yb = _minibatch(rng, X, Y, minibatch_size)
+        exe.arg_dict["data"][:] = xb
+        if out_grad_f is None:
+            exe.arg_dict[label_key][:] = yb
+            exe.forward(is_train=True)
+            exe.backward()
+        else:
+            exe.forward(is_train=True)
+            exe.backward(out_grad_f(exe.outputs, nd.array(yb, ctx=dev)))
+        for k in sorted(params):
+            updater(k, grads[k], params[k])
+        if i % report_every == 0:
+            _, _, acc = sample_test_acc(exe, X_test, Y_test, label_num=10,
+                                        minibatch_size=100)
+            logging.info("SGD iter %d (%.1fs) test acc %.4f", i,
+                         time.time() - tic, acc)
+            tic = time.time()
+    return exe, params, grads
+
+
+def SGLD(sym, X, Y, X_test, Y_test, total_iter_num, data_inputs=None,
+         learning_rate=None, lr_scheduler=None, prior_precision=1,
+         out_grad_f=None, initializer=None, minibatch_size=100,
+         thin_interval=100, burn_in_iter_num=1000, task="classification",
+         dev=None, report_every=100000):
+    """Stochastic Gradient Langevin Dynamics: SGD + per-step Gaussian
+    noise at temperature matched to the step size; post-burn-in params
+    are collected (with their step size as importance weight) into a
+    posterior sample pool (reference algos.py:152)."""
+    dev = dev or mx.cpu()
+    label_key = None if out_grad_f else \
+        next(k for k in data_inputs if k != "data")
+    exe, params, grads, _ = get_executor(sym, dev, data_inputs, initializer)
+    opt = mx.optimizer.create("sgld", learning_rate=learning_rate,
+                              rescale_grad=X.shape[0] / minibatch_size,
+                              lr_scheduler=lr_scheduler, wd=prior_precision)
+    updater = mx.optimizer.get_updater(opt)
+    rng = np.random.RandomState(200)
+    pool = []
+    tic = time.time()
+    for i in range(1, total_iter_num + 1):
+        xb, yb = _minibatch(rng, X, Y, minibatch_size)
+        exe.arg_dict["data"][:] = xb
+        if out_grad_f is None:
+            exe.arg_dict[label_key][:] = yb
+            exe.forward(is_train=True)
+            exe.backward()
+        else:
+            exe.forward(is_train=True)
+            exe.backward(out_grad_f(exe.outputs, nd.array(yb, ctx=dev)))
+        for k in sorted(params):
+            updater(k, grads[k], params[k])
+        done_burn = i > burn_in_iter_num
+        if done_burn and (i - burn_in_iter_num) % thin_interval == 1 % max(thin_interval, 1):
+            lr_now = (opt.lr_scheduler(opt.num_update)
+                      if opt.lr_scheduler is not None else learning_rate)
+            pool.append([lr_now, copy_param(exe)])
+        if i % report_every == 0:
+            if task == "classification":
+                c, t, acc = sample_test_acc(exe, X_test, Y_test,
+                                            sample_pool=pool or None,
+                                            label_num=10,
+                                            minibatch_size=minibatch_size)
+                logging.info("SGLD iter %d (%.1fs) test %d/%d=%.4f", i,
+                             time.time() - tic, c, t, acc)
+            else:
+                mse = sample_test_regression(
+                    exe, X_test, Y_test, sample_pool=pool or None,
+                    minibatch_size=minibatch_size,
+                    save_path="regression_SGLD.txt")
+                logging.info("SGLD iter %d (%.1fs) MSE %.4f", i,
+                             time.time() - tic, mse)
+            tic = time.time()
+    return exe, pool
+
+
+def DistilledSGLD(teacher_sym, student_sym, teacher_data_inputs,
+                  student_data_inputs, X, Y, X_test, Y_test,
+                  total_iter_num, teacher_learning_rate,
+                  student_learning_rate, teacher_lr_scheduler=None,
+                  student_lr_scheduler=None,
+                  student_optimizing_algorithm="sgd", teacher_grad_f=None,
+                  student_grad_f=None, teacher_prior_precision=1,
+                  student_prior_precision=0.001, perturb_deviation=0.001,
+                  student_initializer=None, teacher_initializer=None,
+                  minibatch_size=100, task="classification", dev=None,
+                  report_every=2000):
+    """Bayesian dark knowledge (Korattikara et al. 2015): an SGLD
+    teacher explores the posterior while a point-estimate student is
+    distilled online to match the teacher's posterior-predictive on
+    perturbed inputs (reference algos.py:211)."""
+    dev = dev or mx.cpu()
+    t_exe, t_params, t_grads, _ = get_executor(
+        teacher_sym, dev, teacher_data_inputs, teacher_initializer)
+    s_exe, s_params, s_grads, _ = get_executor(
+        student_sym, dev, student_data_inputs, student_initializer)
+    t_label = None if teacher_grad_f else \
+        next(k for k in teacher_data_inputs if k != "data")
+    s_label = None if student_grad_f else \
+        next(k for k in student_data_inputs if k != "data")
+
+    t_opt = mx.optimizer.create(
+        "sgld", learning_rate=teacher_learning_rate,
+        rescale_grad=X.shape[0] / float(minibatch_size),
+        lr_scheduler=teacher_lr_scheduler, wd=teacher_prior_precision)
+    s_opt = mx.optimizer.create(
+        student_optimizing_algorithm, learning_rate=student_learning_rate,
+        rescale_grad=1.0 / float(minibatch_size),
+        lr_scheduler=student_lr_scheduler, wd=student_prior_precision)
+    t_updater = mx.optimizer.get_updater(t_opt)
+    s_updater = mx.optimizer.get_updater(s_opt)
+    rng = np.random.RandomState(300)
+    tic = time.time()
+
+    for i in range(1, total_iter_num + 1):
+        # teacher: one SGLD step on real data
+        xb, yb = _minibatch(rng, X, Y, minibatch_size)
+        t_exe.arg_dict["data"][:] = xb
+        if teacher_grad_f is None:
+            t_exe.arg_dict[t_label][:] = yb
+            t_exe.forward(is_train=True)
+            t_exe.backward()
+        else:
+            t_exe.forward(is_train=True)
+            t_exe.backward(teacher_grad_f(t_exe.outputs,
+                                          nd.array(yb, ctx=dev)))
+        for k in sorted(t_params):
+            t_updater(k, t_grads[k], t_params[k])
+
+        # student: distill the teacher's prediction on perturbed inputs
+        if task == "classification":
+            xs, _ = _minibatch(rng, X, Y, minibatch_size)
+            xs = xs + rng.normal(0, perturb_deviation,
+                                 xs.shape).astype("float32")
+        else:
+            xs = rng.uniform(-6, 6, xb.shape).astype("float32")
+        t_exe.arg_dict["data"][:] = xs
+        t_exe.forward(is_train=False)
+        teacher_pred = t_exe.outputs[0].copyto(mx.cpu())
+
+        s_exe.arg_dict["data"][:] = xs
+        if student_grad_f is None:
+            s_exe.arg_dict[s_label][:] = teacher_pred
+            s_exe.forward(is_train=True)
+            s_exe.backward()
+        else:
+            s_exe.forward(is_train=True)
+            s_exe.backward(student_grad_f(s_exe.outputs, teacher_pred))
+        for k in sorted(s_params):
+            s_updater(k, s_grads[k], s_params[k])
+
+        if i % report_every == 0:
+            if task == "classification":
+                sc, st, sa = sample_test_acc(s_exe, X_test, Y_test,
+                                             label_num=10,
+                                             minibatch_size=minibatch_size)
+                tc, tt, ta = sample_test_acc(t_exe, X_test, Y_test,
+                                             label_num=10,
+                                             minibatch_size=minibatch_size)
+                logging.info(
+                    "DSGLD iter %d (%.1fs) student %d/%d=%.4f "
+                    "teacher %d/%d=%.4f", i, time.time() - tic,
+                    sc, st, sa, tc, tt, ta)
+            else:
+                mse = sample_test_regression(
+                    s_exe, X_test, Y_test, minibatch_size=minibatch_size,
+                    save_path="regression_DSGLD.txt")
+                logging.info("DSGLD iter %d (%.1fs) student MSE %.4f", i,
+                             time.time() - tic, mse)
+            tic = time.time()
+    return s_exe, s_params, s_grads

@@ -1,0 +1,336 @@
+"""Bayesian dark knowledge demos (NIPS 2015) and the SGLD paper's toy
+posterior (ICML 2011).
+
+Capability parity with reference example/bayesian-methods/bdk_demo.py:1:
+custom numpy softmax ops, MLP/toy symbols, the full runner matrix
+(MNIST x {SGD, SGLD, DistilledSGLD}, toy x {SGLD, DistilledSGLD, HMC},
+synthetic SGLD) behind the same -d/-l/-t CLI.  Iteration counts default
+to TPU-friendly scaled-down values and are overridable with --iters;
+the synthetic demo writes its histogram to a text file instead of
+requiring matplotlib.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+from algos import HMC, SGD, SGLD, DistilledSGLD
+from data_loader import load_mnist, load_toy, load_synthetic
+from utils import BiasXavier, SGLDScheduler
+
+
+class CrossEntropySoftmax(mx.operator.NumpyOp):
+    """Softmax whose backward expects a dense (one-hot or soft) label —
+    the distillation target is the teacher's full distribution
+    (reference bdk_demo.py:13)."""
+
+    def __init__(self):
+        super().__init__(False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], in_shape[0]], [in_shape[0]]
+
+    def forward(self, in_data, out_data):
+        x = in_data[0]
+        z = np.exp(x - x.max(axis=1, keepdims=True)).astype("float32")
+        out_data[0][:] = z / z.sum(axis=1, keepdims=True)
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = out_data[0] - in_data[1]
+
+
+class LogSoftmax(mx.operator.NumpyOp):
+    """Log-domain softmax with the same dense-label backward; the
+    student trains against teacher probabilities in log space
+    (reference bdk_demo.py:42)."""
+
+    def __init__(self):
+        super().__init__(False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], in_shape[0]], [in_shape[0]]
+
+    def forward(self, in_data, out_data):
+        x = in_data[0]
+        shifted = (x - x.max(axis=1, keepdims=True)).astype("float32")
+        lse = np.log(np.exp(shifted).sum(axis=1, keepdims=True))
+        out_data[0][:] = (shifted - lse).astype("float32")
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = (np.exp(out_data[0]) - in_data[1]).astype("float32")
+
+
+def classification_student_grad(student_outputs, teacher_pred):
+    return [student_outputs[0] - teacher_pred]
+
+
+def regression_student_grad(student_outputs, teacher_pred,
+                            teacher_noise_precision):
+    """Gradient of the Gaussian NLL of the student's (mean, log-var)
+    head against the teacher's prediction (reference bdk_demo.py:78)."""
+    mean, log_var = student_outputs[0], student_outputs[1]
+    inv_var = nd.exp(-log_var)
+    g_mean = inv_var * (mean - teacher_pred)
+    sq = nd.square(mean - teacher_pred)
+    g_var = (1 - inv_var * (sq + 1.0 / teacher_noise_precision)) / 2
+    return [g_mean, g_var]
+
+
+def get_mnist_sym(output_op=None, num_hidden=400):
+    """3-layer relu MLP; head is SoftmaxOutput or a custom op
+    (reference bdk_demo.py:91)."""
+    net = mx.sym.Variable("data")
+    for i in (1, 2):
+        net = mx.sym.FullyConnected(data=net, name="mnist_fc%d" % i,
+                                    num_hidden=num_hidden)
+        net = mx.sym.Activation(data=net, name="mnist_relu%d" % i,
+                                act_type="relu")
+    net = mx.sym.FullyConnected(data=net, name="mnist_fc3", num_hidden=10)
+    if output_op is None:
+        return mx.sym.SoftmaxOutput(data=net, name="softmax")
+    return output_op(data=net, name="softmax")
+
+
+def get_toy_sym(teacher=True, teacher_noise_precision=None):
+    """Teacher: 1-hidden-layer regressor with the noise precision as the
+    loss grad scale.  Student: shared trunk with (mean, log-var) heads
+    (reference bdk_demo.py:123)."""
+    data = mx.sym.Variable("data")
+    if teacher:
+        h = mx.sym.FullyConnected(data=data, name="teacher_fc1",
+                                  num_hidden=100)
+        h = mx.sym.Activation(data=h, name="teacher_relu1", act_type="relu")
+        h = mx.sym.FullyConnected(data=h, name="teacher_fc2", num_hidden=1)
+        return mx.sym.LinearRegressionOutput(
+            data=h, name="teacher_output",
+            grad_scale=teacher_noise_precision)
+    h = mx.sym.FullyConnected(data=data, name="student_fc1", num_hidden=100)
+    h = mx.sym.Activation(data=h, name="student_relu1", act_type="relu")
+    mean = mx.sym.FullyConnected(data=h, name="student_mean", num_hidden=1)
+    var = mx.sym.FullyConnected(data=h, name="student_var", num_hidden=1)
+    return mx.sym.Group([mean, var])
+
+
+def synthetic_grad(X, theta, sigma1, sigma2, sigmax, rescale_grad=1.0,
+                   grad=None):
+    """Gradient of -log p(theta) - sum log p(x|theta) for the
+    two-component mixture posterior (reference bdk_demo.py:223),
+    vectorized over the minibatch."""
+    if grad is None:
+        grad = nd.empty(theta.shape, theta.context)
+    t1, t2 = (float(v) for v in theta.asnumpy())
+    vx = sigmax ** 2
+    X = np.atleast_1d(np.asarray(X, dtype=np.float64))
+    e1 = np.exp(-((X - t1) ** 2) / (2 * vx))
+    e2 = np.exp(-((X - t1 - t2) ** 2) / (2 * vx))
+    den = e1 + e2
+    d1 = ((e1 * (X - t1) / vx + e2 * (X - t1 - t2) / vx) / den).sum()
+    d2 = ((e2 * (X - t1 - t2) / vx) / den).sum()
+    out = np.array([-rescale_grad * d1 + t1 / sigma1 ** 2,
+                    -rescale_grad * d2 + t2 / sigma2 ** 2], dtype=np.float32)
+    grad[:] = out
+    return grad
+
+
+def dev():
+    return mx.cpu()
+
+
+def run_mnist_SGD(training_num=50000, total_iter_num=20000):
+    X, Y, X_test, Y_test = load_mnist(training_num)
+    batch = 100
+    net = get_mnist_sym()
+    data_inputs = {"data": nd.zeros((batch,) + X.shape[1:], ctx=dev()),
+                   "softmax_label": nd.zeros((batch,), ctx=dev())}
+    SGD(sym=net, dev=dev(), data_inputs=data_inputs, X=X, Y=Y,
+        X_test=X_test, Y_test=Y_test, total_iter_num=total_iter_num,
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        lr=5e-6, prior_precision=1.0, minibatch_size=batch)
+
+
+def run_mnist_SGLD(training_num=50000, total_iter_num=20000):
+    X, Y, X_test, Y_test = load_mnist(training_num)
+    batch = 100
+    net = get_mnist_sym()
+    data_inputs = {"data": nd.zeros((batch,) + X.shape[1:], ctx=dev()),
+                   "softmax_label": nd.zeros((batch,), ctx=dev())}
+    SGLD(sym=net, dev=dev(), data_inputs=data_inputs, X=X, Y=Y,
+         X_test=X_test, Y_test=Y_test, total_iter_num=total_iter_num,
+         initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+         learning_rate=4e-6, prior_precision=1.0, minibatch_size=batch,
+         thin_interval=100, burn_in_iter_num=1000,
+         report_every=max(total_iter_num // 4, 1))
+
+
+def run_mnist_DistilledSGLD(training_num=50000, total_iter_num=20000):
+    X, Y, X_test, Y_test = load_mnist(training_num)
+    batch = 100
+    # big-data and small-data hyperparameter regimes, as in the paper
+    if training_num >= 10000:
+        hidden, t_lr, s_lr, s_prior, perturb = 800, 1e-6, 1e-4, 0.1, 0.1
+    else:
+        hidden, t_lr, s_lr, s_prior, perturb = 400, 4e-5, 1e-4, 0.1, 0.001
+    teacher_net = get_mnist_sym(num_hidden=hidden)
+    student_net = get_mnist_sym(output_op=LogSoftmax(), num_hidden=hidden)
+    t_inputs = {"data": nd.zeros((batch,) + X.shape[1:], ctx=dev()),
+                "softmax_label": nd.zeros((batch,), ctx=dev())}
+    s_inputs = {"data": nd.zeros((batch,) + X.shape[1:], ctx=dev()),
+                "softmax_label": nd.zeros((batch, 10), ctx=dev())}
+    DistilledSGLD(
+        teacher_sym=teacher_net, student_sym=student_net,
+        teacher_data_inputs=t_inputs, student_data_inputs=s_inputs,
+        X=X, Y=Y, X_test=X_test, Y_test=Y_test,
+        total_iter_num=total_iter_num,
+        teacher_initializer=BiasXavier(factor_type="in", magnitude=1),
+        student_initializer=BiasXavier(factor_type="in", magnitude=1),
+        student_optimizing_algorithm="adam",
+        teacher_learning_rate=t_lr, student_learning_rate=s_lr,
+        teacher_prior_precision=1, student_prior_precision=s_prior,
+        perturb_deviation=perturb, minibatch_size=batch, dev=dev(),
+        report_every=max(total_iter_num // 4, 1))
+
+
+def run_toy_SGLD(total_iter_num=20000):
+    X, Y, X_test, Y_test = load_toy()
+    precision = 1.0 / 9.0
+    net = get_toy_sym(True, precision)
+    data_inputs = {"data": nd.zeros((1,) + X.shape[1:], ctx=dev()),
+                   "teacher_output_label": nd.zeros((1, 1), ctx=dev())}
+    SGLD(sym=net, data_inputs=data_inputs, X=X, Y=Y, X_test=X_test,
+         Y_test=Y_test, total_iter_num=total_iter_num,
+         initializer=mx.init.Uniform(0.07), learning_rate=1e-4,
+         prior_precision=0.1, burn_in_iter_num=1000, thin_interval=10,
+         task="regression", minibatch_size=1, dev=dev(),
+         report_every=max(total_iter_num // 4, 1))
+
+
+def run_toy_DistilledSGLD(total_iter_num=20000):
+    X, Y, X_test, Y_test = load_toy()
+    precision = 1.0
+    teacher_net = get_toy_sym(True, precision)
+    student_net = get_toy_sym(False)
+    t_inputs = {"data": nd.zeros((1,) + X.shape[1:], ctx=dev()),
+                "teacher_output_label": nd.zeros((1, 1), ctx=dev())}
+    s_inputs = {"data": nd.zeros((1,) + X.shape[1:], ctx=dev())}
+    DistilledSGLD(
+        teacher_sym=teacher_net, student_sym=student_net,
+        teacher_data_inputs=t_inputs, student_data_inputs=s_inputs,
+        X=X, Y=Y, X_test=X_test, Y_test=Y_test,
+        total_iter_num=total_iter_num,
+        teacher_initializer=mx.init.Uniform(0.07),
+        student_initializer=mx.init.Uniform(0.07),
+        teacher_learning_rate=1e-4, student_learning_rate=0.01,
+        student_lr_scheduler=mx.lr_scheduler.FactorScheduler(8000, 0.8),
+        student_grad_f=lambda outs, pred:
+            regression_student_grad(outs, pred, precision),
+        teacher_prior_precision=0.1, student_prior_precision=0.001,
+        perturb_deviation=0.1, minibatch_size=1, task="regression",
+        dev=dev(), report_every=max(total_iter_num // 4, 1))
+
+
+def run_toy_HMC(sample_num=3000):
+    X, Y, X_test, Y_test = load_toy()
+    batch = Y.shape[0]
+    net = get_toy_sym(True, 1 / 9.0)
+    data_inputs = {"data": nd.zeros((batch,) + X.shape[1:], ctx=dev()),
+                   "teacher_output_label": nd.zeros((batch, 1), ctx=dev())}
+    return HMC(net, data_inputs=data_inputs, X=X, Y=Y, X_test=X_test,
+               Y_test=Y_test, sample_num=sample_num,
+               initializer=mx.init.Uniform(0.07), prior_precision=1.0,
+               learning_rate=1e-3, L=10, dev=dev(),
+               report_every=max(sample_num // 3, 1))
+
+
+def run_synthetic_SGLD(total_iter_num=30000,
+                       save_path="synthetic_sgld_samples.txt"):
+    """Samples the banana-shaped 2-parameter posterior from the SGLD
+    paper; writes (theta1, theta2) draws to ``save_path`` for offline
+    plotting (reference bdk_demo.py:287 plots a 2-d histogram)."""
+    theta1, theta2 = 0.0, 1.0
+    sigma1, sigma2, sigmax = np.sqrt(10), 1.0, np.sqrt(2)
+    X = load_synthetic(theta1=theta1, theta2=theta2, sigmax=sigmax,
+                       num=100, seed=100)
+    scheduler = SGLDScheduler(begin_rate=0.01, end_rate=0.0001,
+                              total_iter_num=total_iter_num, factor=0.55)
+    opt = mx.optimizer.create("sgld", learning_rate=None, rescale_grad=1.0,
+                              lr_scheduler=scheduler, wd=0)
+    updater = mx.optimizer.get_updater(opt)
+    theta = mx.random.normal(0, 1, (2,), mx.cpu())
+    grad = nd.empty((2,), mx.cpu())
+    samples = np.zeros((total_iter_num, 2), dtype=np.float32)
+    tic = time.time()
+    for i in range(total_iter_num):
+        ind = np.random.randint(0, X.shape[0])
+        synthetic_grad(X[ind], theta, sigma1, sigma2, sigmax,
+                       rescale_grad=X.shape[0] / 1.0, grad=grad)
+        updater("theta", grad, theta)
+        samples[i] = theta.asnumpy()
+        if (i + 1) % 10000 == 0:
+            logging.info("synthetic SGLD iter %d (%.1fs)", i + 1,
+                         time.time() - tic)
+            tic = time.time()
+    np.savetxt(save_path, samples)
+    logging.info("wrote %d posterior draws to %s; sample mean (%.3f, %.3f)",
+                 total_iter_num, save_path,
+                 samples[:, 0].mean(), samples[:, 1].mean())
+    return samples
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Bayesian Dark Knowledge (NIPS 2015) and SGLD "
+                    "(ICML 2011) demos")
+    parser.add_argument("-d", "--dataset", type=int, default=1,
+                        help="0=toy regression, 1=MNIST, 2=SGLD synthetic")
+    parser.add_argument("-l", "--algorithm", type=int, default=2,
+                        help="0=SGD, 1=SGLD, 2=DistilledSGLD, 3=HMC (toy)")
+    parser.add_argument("-t", "--training", type=int, default=50000,
+                        help="number of training samples")
+    parser.add_argument("--iters", type=int, default=None,
+                        help="override total iteration/sample count")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    np.random.seed(100)
+    mx.random.seed(100)
+
+    n = args.iters
+    if args.dataset == 1:
+        runner = {0: run_mnist_SGD, 1: run_mnist_SGLD}.get(
+            args.algorithm, run_mnist_DistilledSGLD)
+        runner(args.training, **({"total_iter_num": n} if n else {}))
+    elif args.dataset == 0:
+        runner = {1: run_toy_SGLD, 2: run_toy_DistilledSGLD,
+                  3: run_toy_HMC}.get(args.algorithm)
+        if runner is None:
+            parser.error("toy dataset supports -l 1 (SGLD), 2 "
+                         "(DistilledSGLD), 3 (HMC)")
+        kw = {}
+        if n:
+            kw = {"sample_num": n} if runner is run_toy_HMC \
+                else {"total_iter_num": n}
+        runner(**kw)
+    else:
+        run_synthetic_SGLD(**({"total_iter_num": n} if n else {}))
+
+
+if __name__ == "__main__":
+    main()

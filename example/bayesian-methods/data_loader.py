@@ -1,0 +1,51 @@
+"""Datasets for the Bayesian dark-knowledge examples.
+
+Capability parity with reference example/bayesian-methods/data_loader.py:1.
+This image has zero network egress, so instead of downloading mnist.npz the
+MNIST loader synthesizes a deterministic 784-d 10-class problem (class-coded
+blob patterns plus noise) that an MLP actually has to learn; the toy cubic
+and the two-component synthetic posterior match the BDK / Welling & Teh
+setups exactly.
+"""
+import numpy as np
+
+
+def load_mnist(training_num=50000, test_num=10000, seed=0):
+    """784-d, 10-class stand-in for mnist.npz.  Each class k owns a fixed
+    random template; samples are template + N(0, 0.35) noise, pixel range
+    roughly [0, 2] like the reference's X/126.0 scaling."""
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(10, 784).astype(np.float32) * 2.0
+
+    def draw(n):
+        y = rng.randint(0, 10, size=n)
+        x = templates[y] + rng.randn(n, 784).astype(np.float32) * 0.35
+        return x.astype(np.float32), y.astype(np.float32)
+
+    X, Y = draw(training_num)
+    X_test, Y_test = draw(test_num)
+    return X, Y, X_test, Y_test
+
+
+def load_toy(train_num=20, test_num=300, seed=23):
+    """The BDK toy regression: y = x^3 + N(0, 3^2) on x in [-4, 4]
+    (reference data_loader.py:27 reads it from toy_data_train.txt; the
+    same distribution is generated here)."""
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-4.0, 4.0, size=(train_num, 1))
+    y = x ** 3 + rng.randn(train_num, 1) * 3.0
+    xt = np.linspace(-6.0, 6.0, test_num).reshape(test_num, 1)
+    yt = xt ** 3
+    return (x.astype(np.float32), y.astype(np.float32),
+            xt.astype(np.float32), yt.astype(np.float32))
+
+
+def load_synthetic(theta1, theta2, sigmax, num=20, seed=None):
+    """Draws from the two-component mixture 0.5 N(theta1, sigmax^2) +
+    0.5 N(theta1 + theta2, sigmax^2) whose posterior the synthetic SGLD
+    demo explores (reference data_loader.py:37)."""
+    rng = np.random.RandomState(seed)
+    pick = rng.randint(0, 2, size=num)
+    a = rng.normal(theta1, sigmax, size=num)
+    b = rng.normal(theta1 + theta2, sigmax, size=num)
+    return np.where(pick == 1, a, b).astype(np.float64)

@@ -1,0 +1,187 @@
+"""Shared helpers for the Bayesian dark-knowledge examples.
+
+Capability parity with reference example/bayesian-methods/utils.py:1
+(BiasXavier, SGLDScheduler, executor construction, parameter snapshots,
+Bayesian-model-averaged test scoring) rebuilt on mxnet_tpu's executor.
+Predictions are accumulated with numpy stacking instead of the
+reference's preallocated cursor arithmetic — the per-sample forward is
+a single jitted program on the TPU either way.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+class BiasXavier(mx.initializer.Xavier):
+    """Xavier that also initializes biases uniformly (reference
+    utils.py:7) instead of zeroing them — SG-MCMC chains mix faster
+    when they do not all start from the same bias point."""
+
+    def _init_bias(self, _, arr):
+        bound = float(np.sqrt(self.magnitude / arr.shape[0]))
+        arr[:] = np.random.uniform(-bound, bound, arr.shape).astype(np.float32)
+
+
+class SGLDScheduler(mx.lr_scheduler.LRScheduler):
+    """Polynomial step-size decay eps_t = a (b + t)^-gamma with (a, b)
+    solved from the requested begin/end rates (reference utils.py:12).
+    The Welling & Teh step-size condition needs gamma in (0.5, 1]."""
+
+    def __init__(self, begin_rate, end_rate, total_iter_num, factor):
+        super().__init__()
+        if not factor < 1.0:
+            raise ValueError("decay factor must be < 1 so the rate shrinks")
+        self.begin_rate, self.end_rate = begin_rate, end_rate
+        self.total_iter_num, self.factor = total_iter_num, factor
+        ratio = (begin_rate / end_rate) ** (1.0 / factor)
+        self.b = (total_iter_num - 1.0) / (ratio - 1.0)
+        self.a = begin_rate * (self.b ** factor)
+
+    def __call__(self, num_update):
+        self.base_lr = self.a * ((self.b + num_update) ** (-self.factor))
+        return self.base_lr
+
+
+def get_executor(sym, ctx, data_inputs, initializer=None):
+    """Bind ``sym`` with fresh param/grad buffers; everything not named
+    in ``data_inputs`` is a learnable (reference utils.py:30)."""
+    shapes = {k: v.shape for k, v in data_inputs.items()}
+    arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+    named = dict(zip(sym.list_arguments(), arg_shapes))
+    params = {n: mx.nd.zeros(s, ctx=ctx) for n, s in named.items()
+              if n not in data_inputs}
+    grads = {n: mx.nd.zeros(v.shape, ctx=ctx) for n, v in params.items()}
+    aux = {n: mx.nd.zeros(s, ctx=ctx)
+           for n, s in zip(sym.list_auxiliary_states(), aux_shapes)}
+    exe = sym.bind(ctx=ctx, args=dict(params, **data_inputs),
+                   args_grad=grads, aux_states=aux)
+    if initializer is not None:
+        for name, arr in params.items():
+            initializer(name, arr)
+    return exe, params, grads, aux
+
+
+def copy_param(exe, new_param=None):
+    """Snapshot the executor's current arguments to host arrays — SG-MCMC
+    keeps a pool of these posterior samples (reference utils.py:49)."""
+    if new_param is None:
+        return {k: v.copyto(mx.cpu()) for k, v in exe.arg_dict.items()}
+    for k in new_param:
+        exe.arg_dict[k].copyto(new_param[k])
+    return new_param
+
+
+def _pool_weights(sample_pool):
+    """Each pool entry is either a bare param dict (weight 1) or an
+    [lr, params] pair whose step size is its importance weight."""
+    raw = [s[0] if isinstance(s, list) else 1.0 for s in sample_pool]
+    total = float(sum(raw))
+    return [(w / total, s[1] if isinstance(s, list) else s)
+            for w, s in zip(raw, sample_pool)]
+
+
+def _forward_all(exe, X, minibatch_size):
+    """Run the bound executor over X in minibatches; returns the
+    concatenated first output as one host array."""
+    outs = []
+    for lo in range(0, X.shape[0], minibatch_size):
+        chunk = X[lo:lo + minibatch_size]
+        if chunk.shape[0] < minibatch_size:           # pad the tail batch
+            fill = np.repeat(chunk[-1:], minibatch_size - chunk.shape[0], 0)
+            padded = np.concatenate([chunk, fill], axis=0)
+        else:
+            padded = chunk
+        exe.arg_dict["data"][:] = padded
+        exe.forward(is_train=False)
+        outs.append(exe.outputs[0].asnumpy()[:chunk.shape[0]])
+    return np.concatenate(outs, axis=0)
+
+
+def sample_test_acc(exe, X, Y, sample_pool=None, label_num=None,
+                    minibatch_size=100):
+    """Classification accuracy, Bayesian-model-averaged over the sample
+    pool when one is given (reference utils.py:56)."""
+    if sample_pool is None:
+        pred = _forward_all(exe, X, minibatch_size)
+    else:
+        keep = copy_param(exe)
+        pred = 0.0
+        for ratio, param in _pool_weights(sample_pool):
+            exe.copy_params_from(param)
+            pred = pred + ratio * _forward_all(exe, X, minibatch_size)
+        exe.copy_params_from(keep)
+    correct = int((pred.argmax(axis=1) == Y.reshape(-1)).sum())
+    total = int(Y.shape[0])
+    return correct, total, correct / float(total)
+
+
+def sample_test_regression(exe, X, Y, sample_pool=None, minibatch_size=100,
+                           save_path="regression.txt"):
+    """Posterior-predictive mean/variance and MSE for the regression
+    tasks (reference utils.py:104).  With a pool, predictive variance is
+    the spread across the pool's member predictions; without one, the
+    network's own heteroscedastic head (outputs[1] = log variance) is
+    used."""
+    keep = copy_param(exe)
+    if sample_pool is not None:
+        member = []
+        for _, param in _pool_weights(sample_pool):
+            exe.copy_params_from(param)
+            member.append(_forward_all(exe, X, minibatch_size))
+        stack = np.stack(member, axis=0)              # (pool, N, 1)
+        mean, var = stack.mean(axis=0), stack.var(axis=0)
+    else:
+        outs, lvs = [], []
+        for lo in range(0, X.shape[0], minibatch_size):
+            chunk = X[lo:lo + minibatch_size]
+            if chunk.shape[0] < minibatch_size:
+                fill = np.repeat(chunk[-1:], minibatch_size - chunk.shape[0], 0)
+                chunk2 = np.concatenate([chunk, fill], 0)
+            else:
+                chunk2 = chunk
+            exe.arg_dict["data"][:] = chunk2
+            exe.forward(is_train=False)
+            exe_outs = exe.outputs
+            outs.append(exe_outs[0].asnumpy()[:chunk.shape[0]])
+            # nets without a log-variance head report zero variance
+            lvs.append(exe_outs[1].asnumpy()[:chunk.shape[0]]
+                       if len(exe_outs) > 1 else
+                       np.full((chunk.shape[0], 1), -np.inf, np.float32))
+        mean = np.concatenate(outs, 0)
+        var = np.exp(np.concatenate(lvs, 0))
+    exe.copy_params_from(keep)
+    mse = float(np.square(Y.reshape(-1) - mean.reshape(-1)).mean())
+    np.savetxt(save_path, np.concatenate(
+        [mean.reshape(len(mean), -1), var.reshape(len(var), -1)], axis=1))
+    return mse
+
+
+def pred_test(testing_data, exe, param_list=None, save_path="pred.txt"):
+    """Pointwise predictive mean/variance on the toy cubic task
+    (reference utils.py:140): column 0 of testing_data is x, ground
+    truth is x**3."""
+    xs = testing_data[:, :1].astype(np.float32)
+    if param_list is None:
+        mean_lv = []
+        for i in range(xs.shape[0]):
+            exe.arg_dict["data"][:] = xs[i:i + 1]
+            exe.forward(is_train=False)
+            mean_lv.append([float(exe.outputs[0].asnumpy().ravel()[0]),
+                            float(np.exp(exe.outputs[1].asnumpy().ravel()[0]))])
+        ret = np.array(mean_lv)
+    else:
+        per = np.zeros((xs.shape[0], len(param_list)))
+        for j, param in enumerate(param_list):
+            exe.copy_params_from(param)
+            for i in range(xs.shape[0]):
+                exe.arg_dict["data"][:] = xs[i:i + 1]
+                exe.forward(is_train=False)
+                per[i, j] = float(exe.outputs[0].asnumpy().ravel()[0])
+        ret = np.stack([per.mean(axis=1), per.var(axis=1)], axis=1)
+    np.savetxt(save_path, ret)
+    mse = float(np.square(ret[:, 0] - testing_data[:, 0] ** 3).mean())
+    return mse, ret

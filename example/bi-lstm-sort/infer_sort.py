@@ -1,0 +1,53 @@
+"""Sort a sequence with a trained bi-LSTM checkpoint.
+
+Capability parity with reference example/bi-lstm-sort/infer_sort.py:1:
+loads the lstm_sort.py checkpoint, runs the stateful inference model on
+the tokens given on the command line, and prints them in predicted
+sorted order.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+from rnn_model import BiLSTMInferenceModel
+from sort_io import default_build_vocab
+
+
+def MakeInput(char, vocab, arr):
+    arr[:] = np.array([vocab[char]])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("tokens", nargs="+",
+                        help="sequence to sort, e.g. 5 2 8 1 4")
+    parser.add_argument("--train", default="./data/sort.train.txt",
+                        help="corpus the vocab was built from")
+    parser.add_argument("--model-prefix", default="sort")
+    parser.add_argument("--epoch", type=int, default=1)
+    parser.add_argument("--num-hidden", type=int, default=300)
+    parser.add_argument("--num-embed", type=int, default=512)
+    args = parser.parse_args()
+
+    vocab = default_build_vocab(args.train)
+    rvocab = {v: k for k, v in vocab.items()}
+    _, arg_params, _ = mx.model.load_checkpoint(args.model_prefix,
+                                                args.epoch)
+    model = BiLSTMInferenceModel(
+        len(args.tokens), len(vocab), num_hidden=args.num_hidden,
+        num_embed=args.num_embed, num_label=len(vocab),
+        arg_params=arg_params, ctx=mx.cpu(), dropout=0.0)
+
+    data = np.array([[vocab[t] for t in args.tokens]], dtype=np.float32)
+    prob = model.forward(mx.nd.array(data), new_seq=True)
+    for k in range(len(args.tokens)):
+        print(rvocab[int(np.argmax(prob, axis=1)[k])])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Bidirectional LSTM symbols for the sorting task.
+
+Capability parity with reference example/bi-lstm-sort/lstm.py:1:
+``bi_lstm_unroll`` (concat-decode training symbol whose label arrives
+as (batch, seq) and is transposed/flattened to match the time-major
+concat) and ``bi_lstm_inference_symbol`` (batch-1 symbol that also
+exposes both directions' final states).  The cell itself comes from
+mxnet_tpu.models.lstm — both unrolls fuse into one XLA program.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+from mxnet_tpu.models.lstm import LSTMParam, LSTMState, lstm_cell
+
+lstm = lstm_cell  # reference-compatible alias
+
+
+def _shared_vars():
+    return (mx.sym.Variable("embed_weight"), mx.sym.Variable("cls_weight"),
+            mx.sym.Variable("cls_bias"))
+
+
+def _direction_params():
+    mk = lambda i: LSTMParam(
+        i2h_weight=mx.sym.Variable("l%d_i2h_weight" % i),
+        i2h_bias=mx.sym.Variable("l%d_i2h_bias" % i),
+        h2h_weight=mx.sym.Variable("l%d_h2h_weight" % i),
+        h2h_bias=mx.sym.Variable("l%d_h2h_bias" % i))
+    st = lambda i: LSTMState(c=mx.sym.Variable("l%d_init_c" % i),
+                             h=mx.sym.Variable("l%d_init_h" % i))
+    return mk(0), mk(1), [st(0), st(1)]
+
+
+def _bi_scan(wordvec, seq_len, num_hidden, fwd_param, bwd_param, states,
+             dropout=0.0):
+    """Run both directions over the embedded steps; returns per-step
+    [fwd_h ++ bwd_h] and the two final states."""
+    fwd_hidden = []
+    st = states[0]
+    for t in range(seq_len):
+        st = lstm_cell(num_hidden, indata=wordvec[t], prev_state=st,
+                       param=fwd_param, seqidx=t, layeridx=0,
+                       dropout=dropout)
+        fwd_hidden.append(st.h)
+    fwd_final = st
+
+    bwd_hidden = [None] * seq_len
+    st = states[1]
+    for t in reversed(range(seq_len)):
+        st = lstm_cell(num_hidden, indata=wordvec[t], prev_state=st,
+                       param=bwd_param, seqidx=t, layeridx=1,
+                       dropout=dropout)
+        bwd_hidden[t] = st.h
+    bwd_final = st
+
+    both = [mx.sym.Concat(f, b, dim=1)
+            for f, b in zip(fwd_hidden, bwd_hidden)]
+    return both, fwd_final, bwd_final
+
+
+def bi_lstm_unroll(seq_len, input_size, num_hidden, num_embed, num_label,
+                   dropout=0.0):
+    """Training symbol: concat every step (time-major) into one softmax
+    whose label is the transposed/flattened (batch, seq) label
+    (reference lstm.py:44)."""
+    embed_weight, cls_weight, cls_bias = _shared_vars()
+    fwd_param, bwd_param, states = _direction_params()
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data=data, input_dim=input_size,
+                             weight=embed_weight, output_dim=num_embed,
+                             name="embed")
+    wordvec = mx.sym.SliceChannel(data=embed, num_outputs=seq_len,
+                                  squeeze_axis=1)
+    both, _, _ = _bi_scan(wordvec, seq_len, num_hidden, fwd_param,
+                          bwd_param, states, dropout)
+    hidden_concat = mx.sym.Concat(*both, dim=0)
+    pred = mx.sym.FullyConnected(data=hidden_concat, num_hidden=num_label,
+                                 weight=cls_weight, bias=cls_bias,
+                                 name="pred")
+    label = mx.sym.transpose(data=label)
+    label = mx.sym.Reshape(data=label, target_shape=(0,), shape=(-1,))
+    return mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+
+
+def bi_lstm_inference_symbol(input_size, seq_len, num_hidden, num_embed,
+                             num_label, dropout=0.0):
+    """Inference symbol: same network plus the four final-state outputs
+    so a stateful decoder can carry them (reference lstm.py:107)."""
+    embed_weight, cls_weight, cls_bias = _shared_vars()
+    fwd_param, bwd_param, states = _direction_params()
+
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data=data, input_dim=input_size,
+                             weight=embed_weight, output_dim=num_embed,
+                             name="embed")
+    wordvec = mx.sym.SliceChannel(data=embed, num_outputs=seq_len,
+                                  squeeze_axis=1)
+    both, fwd_final, bwd_final = _bi_scan(wordvec, seq_len, num_hidden,
+                                          fwd_param, bwd_param, states)
+    hidden_concat = mx.sym.Concat(*both, dim=0)
+    fc = mx.sym.FullyConnected(data=hidden_concat, num_hidden=num_label,
+                               weight=cls_weight, bias=cls_bias,
+                               name="pred")
+    sm = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    outs = [sm]
+    for st in (fwd_final, bwd_final):
+        outs.extend([st.c, st.h])
+    return mx.sym.Group(outs)

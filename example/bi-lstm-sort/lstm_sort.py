@@ -1,9 +1,11 @@
-"""Bidirectional LSTM that learns to sort short digit sequences (reference
-example/bi-lstm-sort/{lstm_sort.py,sort_io.py} capability).
+"""Train a bidirectional LSTM to sort number sequences.
 
-A forward and a backward LSTM scan the input sequence; their per-step hidden
-states are concatenated and classified per position.  Both directions unroll
-into the same fused XLA program.
+Capability parity with reference example/bi-lstm-sort/lstm_sort.py:1:
+text-file corpus -> vocab -> bucketed iterator (labels are the sorted
+row), FeedForward.fit with a numpy Perplexity metric, checkpoint saved
+for infer_sort.py.  --synthetic generates the corpus in place of the
+reference's downloaded data/sort.train.txt; an exact-match sort
+accuracy sweep runs after training.
 """
 import argparse
 import logging
@@ -13,113 +15,101 @@ import sys
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "rnn"))
 import mxnet_tpu as mx
-from mxnet_tpu.models.lstm import lstm_cell, LSTMState, LSTMParam
 
-
-def bi_lstm_unroll(seq_len, input_dim, num_hidden, num_label):
-    embed_weight = mx.sym.Variable("embed_weight")
-    cls_weight = mx.sym.Variable("cls_weight")
-    cls_bias = mx.sym.Variable("cls_bias")
-
-    def make_param(tag):
-        return LSTMParam(
-            i2h_weight=mx.sym.Variable("%s_i2h_weight" % tag),
-            i2h_bias=mx.sym.Variable("%s_i2h_bias" % tag),
-            h2h_weight=mx.sym.Variable("%s_h2h_weight" % tag),
-            h2h_bias=mx.sym.Variable("%s_h2h_bias" % tag))
-
-    def make_state(tag):
-        return LSTMState(c=mx.sym.Variable("%s_init_c" % tag),
-                         h=mx.sym.Variable("%s_init_h" % tag))
-
-    fwd_param, bwd_param = make_param("fwd"), make_param("bwd")
-
-    data = mx.sym.Variable("data")            # (batch, seq_len) token ids
-    embed = mx.sym.Embedding(data, input_dim=input_dim, output_dim=num_hidden,
-                             weight=embed_weight, name="embed")
-    steps = mx.sym.SliceChannel(embed, num_outputs=seq_len, axis=1,
-                                squeeze_axis=True)
-
-    fwd_hidden = []
-    state = make_state("fwd")
-    for t in range(seq_len):
-        state = lstm_cell(num_hidden, indata=steps[t], prev_state=state,
-                          param=fwd_param, seqidx=t, layeridx=0)
-        fwd_hidden.append(state.h)
-
-    bwd_hidden = [None] * seq_len
-    state = make_state("bwd")
-    for t in reversed(range(seq_len)):
-        state = lstm_cell(num_hidden, indata=steps[t], prev_state=state,
-                          param=bwd_param, seqidx=t, layeridx=1)
-        bwd_hidden[t] = state.h
-
-    outs = []
-    for t in range(seq_len):
-        h = mx.sym.Concat(fwd_hidden[t], bwd_hidden[t], dim=1)
-        fc = mx.sym.FullyConnected(h, weight=cls_weight, bias=cls_bias,
-                                   num_hidden=num_label,
-                                   name="t%d_cls" % t)
-        outs.append(mx.sym.SoftmaxOutput(
-            fc, label=mx.sym.Variable("t%d_label" % t),
-            name="t%d_sm" % t))
-    return mx.sym.Group(outs)
-
-
-def make_data(n, seq_len, vocab, seed=0):
-    rng = np.random.RandomState(seed)
-    seqs = rng.randint(0, vocab, size=(n, seq_len))
-    sorted_seqs = np.sort(seqs, axis=1)
-    return seqs.astype(np.float32), sorted_seqs.astype(np.float32)
+from lstm import bi_lstm_unroll
+from sort_io import BucketSentenceIter, default_build_vocab, gen_sort_data
+from bucket_io import perplexity_metric as Perplexity
 
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--batch-size", type=int, default=50)
-    parser.add_argument("--num-epochs", type=int, default=10)
-    parser.add_argument("--seq-len", type=int, default=5)
-    parser.add_argument("--vocab", type=int, default=10)
-    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--train", default="./data/sort.train.txt")
+    parser.add_argument("--valid", default="./data/sort.valid.txt")
+    parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--num-hidden", type=int, default=300)
+    parser.add_argument("--num-embed", type=int, default=512)
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--seq-len", type=int, default=5,
+                        help="sequence length for --synthetic data")
+    parser.add_argument("--vocab-size", type=int, default=100,
+                        help="number range for --synthetic data")
+    parser.add_argument("--num-examples", type=int, default=10000)
+    parser.add_argument("--model-prefix", default="sort")
     args = parser.parse_args()
-    logging.basicConfig(level=logging.INFO)
+    logging.basicConfig(level=logging.DEBUG,
+                        format="%(asctime)-15s %(message)s")
 
-    seqs, sorted_seqs = make_data(4000, args.seq_len, args.vocab)
-    label_names = ["t%d_label" % t for t in range(args.seq_len)]
-    state_shapes = {"%s_init_%s" % (tag, s): (args.batch_size,
-                                              args.num_hidden)
-                    for tag in ("fwd", "bwd") for s in ("c", "h")}
-    # init states ride along as zero "data" inputs (truncated-BPTT style)
-    iter_data = {"data": seqs}
-    for k, shape in state_shapes.items():
-        iter_data[k] = np.zeros((len(seqs), shape[1]), np.float32)
-    labels = {label_names[t]: sorted_seqs[:, t] for t in range(args.seq_len)}
-    train = mx.io.NDArrayIter(iter_data, labels,
-                              batch_size=args.batch_size, shuffle=True)
+    if args.synthetic or not os.path.exists(args.train):
+        os.makedirs(os.path.dirname(args.train) or ".", exist_ok=True)
+        gen_sort_data(args.train, n_lines=args.num_examples,
+                      min_len=args.seq_len, max_len=args.seq_len,
+                      vocab_size=args.vocab_size, seed=0)
+        gen_sort_data(args.valid, n_lines=args.num_examples // 10,
+                      min_len=args.seq_len, max_len=args.seq_len,
+                      vocab_size=args.vocab_size, seed=1)
 
-    net = bi_lstm_unroll(args.seq_len, args.vocab, args.num_hidden,
-                         args.vocab)
-    mod = mx.mod.Module(net, context=[mx.cpu()],
-                        data_names=tuple(["data"] + sorted(state_shapes)),
-                        label_names=tuple(label_names))
-    mod.fit(train, num_epoch=args.num_epochs, optimizer="adam",
-            optimizer_params={"learning_rate": 5e-3},
-            eval_metric=mx.metric.CustomMetric(
-                lambda l, p: float((np.asarray(p).argmax(1) ==
-                                    np.asarray(l).astype(int)).mean()),
-                name="pos-acc"))
+    vocab = default_build_vocab(args.train)
+    num_lstm_layer = 2
 
-    # measure whole-sequence sort accuracy
-    train.reset()
+    init_states = [("l%d_init_%s" % (l, s),
+                    (args.batch_size, args.num_hidden))
+                   for l in range(num_lstm_layer) for s in "ch"]
+    data_train = BucketSentenceIter(args.train, vocab, [], args.batch_size,
+                                    init_states)
+    data_val = BucketSentenceIter(args.valid, vocab, [], args.batch_size,
+                                  init_states)
+
+    def sym_gen(seq_len):
+        return bi_lstm_unroll(seq_len, len(vocab),
+                              num_hidden=args.num_hidden,
+                              num_embed=args.num_embed,
+                              num_label=len(vocab))
+
+    buckets = data_train.buckets
+    symbol = sym_gen(buckets[0]) if len(buckets) == 1 else sym_gen
+
+    model = mx.model.FeedForward(
+        ctx=[mx.cpu(0)], symbol=symbol, num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=args.momentum, wd=0.00001,
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34))
+    model.fit(X=data_train, eval_data=data_val,
+              eval_metric=mx.metric.np(Perplexity),
+              batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                         50))
+    model.save(args.model_prefix)
+
+    # exact-match sort accuracy over the validation buckets.  The label
+    # reaches SoftmaxOutput through transpose+reshape, so shape
+    # inference needs the label shape — bind explicitly per bucket.
     correct = total = 0
-    for batch in train:
-        mod.forward(batch, is_train=False)
-        outs = [o.asnumpy().argmax(axis=1) for o in mod.get_outputs()]
-        pred = np.stack(outs, axis=1)
-        truth = np.stack([l.asnumpy() for l in batch.label], axis=1)
-        correct += (pred == truth).all(axis=1).sum()
-        total += pred.shape[0]
-    print("exact-sort accuracy: %.3f" % (correct / total))
+    exes = {}
+    data_val.reset()
+    for batch in data_val:
+        data = batch.data[0].asnumpy()
+        truth = batch.label[0].asnumpy()
+        seq_len = batch.bucket_key
+        if seq_len not in exes:
+            exe = sym_gen(seq_len).simple_bind(
+                mx.cpu(), grad_req="null",
+                data=(args.batch_size, seq_len),
+                softmax_label=(args.batch_size, seq_len),
+                **{n: s for n, s in init_states})
+            exe.copy_params_from(model.arg_params, model.aux_params)
+            exes[seq_len] = exe
+        exe = exes[seq_len]
+        exe.arg_dict["data"][:] = data
+        probs = exe.forward(is_train=False)[0].asnumpy()
+        # predictions come back time-major flattened: (seq*batch, vocab)
+        pred = probs.argmax(axis=1).reshape(seq_len, len(data)).T
+        correct += int((pred == truth).all(axis=1).sum())
+        total += len(data)
+    if total:
+        print("exact-sort accuracy: %.3f" % (correct / total))
 
 
 if __name__ == "__main__":

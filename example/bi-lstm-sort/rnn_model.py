@@ -1,0 +1,48 @@
+"""Stateful bi-LSTM inference wrapper.
+
+Capability parity with reference example/bi-lstm-sort/rnn_model.py:1:
+binds the inference symbol at batch size 1, loads trained arg_params,
+and carries the final LSTM states back into the init-state slots across
+forward calls.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+from lstm import bi_lstm_inference_symbol
+
+
+class BiLSTMInferenceModel:
+    def __init__(self, seq_len, input_size, num_hidden, num_embed,
+                 num_label, arg_params, ctx=None, dropout=0.0):
+        ctx = ctx or mx.cpu()
+        self.sym = bi_lstm_inference_symbol(input_size, seq_len, num_hidden,
+                                            num_embed, num_label, dropout)
+        shapes = {"data": (1, seq_len)}
+        for l in range(2):
+            shapes["l%d_init_c" % l] = (1, num_hidden)
+            shapes["l%d_init_h" % l] = (1, num_hidden)
+        self.executor = self.sym.simple_bind(ctx=ctx, grad_req="null",
+                                             **shapes)
+        for key, arr in arg_params.items():
+            if key in self.executor.arg_dict:
+                self.executor.arg_dict[key][:] = arr
+        self.state_names = ["l0_init_c", "l0_init_h",
+                            "l1_init_c", "l1_init_h"]
+
+    def forward(self, input_data, new_seq=False):
+        """Returns per-position class probabilities, shape
+        (seq_len, num_label); state carries over unless new_seq."""
+        if new_seq:
+            for key in self.state_names:
+                self.executor.arg_dict[key][:] = 0.0
+        self.executor.arg_dict["data"][:] = input_data
+        outs = self.executor.forward(is_train=False)
+        # outputs: [softmax, l0_c, l0_h, l1_c, l1_h] — fold states back
+        for key, out in zip(self.state_names, outs[1:]):
+            self.executor.arg_dict[key][:] = out.asnumpy()
+        return outs[0].asnumpy()

@@ -1,0 +1,182 @@
+"""Data pipeline for the bi-LSTM sorting task.
+
+Capability parity with reference example/bi-lstm-sort/sort_io.py:1:
+vocab building, frequency-driven bucket generation, SimpleBatch,
+DummyIter (fixed-batch speed testing), and a bucketed iterator whose
+labels are the per-row *sorted* input sequence.  A corpus generator is
+included since this image cannot download the reference's data files.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def gen_sort_data(path, n_lines=10000, min_len=3, max_len=8, vocab_size=100,
+                  seed=0):
+    """Write lines of space-separated random integers — the sort task's
+    training text."""
+    rng = np.random.RandomState(seed)
+    with open(path, "w") as f:
+        for _ in range(n_lines):
+            ln = rng.randint(min_len, max_len + 1)
+            f.write(" ".join(str(v) for v in
+                             rng.randint(0, vocab_size, size=ln)) + "\n")
+
+
+def default_read_content(path):
+    with open(path) as f:
+        return f.read().replace("\n", " <eos> ").replace(". ", " <eos> ")
+
+
+def default_build_vocab(path):
+    words = sorted(set(w for w in default_read_content(path).split(" ") if w))
+    vocab = {" ": 0}                       # 0 is the padding id
+    for i, w in enumerate(words):
+        vocab[w] = i + 1
+    return vocab
+
+
+def default_text2id(sentence, the_vocab):
+    return [the_vocab[w] for w in sentence.split(" ") if w and w in the_vocab]
+
+
+def default_gen_buckets(sentences, batch_size, the_vocab):
+    """Greedy frequency sweep: cut a bucket whenever the accumulated
+    sentence count since the last cut reaches a batch (reference
+    sort_io.py:46)."""
+    counts = {}
+    for s in sentences:
+        n = len(default_text2id(s, the_vocab))
+        if n:
+            counts[n] = counts.get(n, 0) + 1
+    buckets, pending = [], 0
+    for length in sorted(counts):
+        pending += counts[length]
+        if pending >= batch_size:
+            buckets.append(length)
+            pending = 0
+    if pending > 0:
+        buckets.append(max(counts))
+    return buckets
+
+
+class SimpleBatch:
+    """Minimal bucketed batch carrier (reference sort_io.py:76)."""
+
+    def __init__(self, data_names, data, label_names, label, bucket_key):
+        self.data, self.label = data, label
+        self.data_names, self.label_names = data_names, label_names
+        self.bucket_key = bucket_key
+        self.pad, self.index = 0, None
+
+    @property
+    def provide_data(self):
+        return [(n, x.shape) for n, x in zip(self.data_names, self.data)]
+
+    @property
+    def provide_label(self):
+        return [(n, x.shape) for n, x in zip(self.label_names, self.label)]
+
+
+class DummyIter(mx.io.DataIter):
+    """Replays one real batch forever — isolates compute speed from IO
+    (reference sort_io.py:95)."""
+
+    def __init__(self, real_iter):
+        super().__init__()
+        self.real_iter = real_iter
+        self.provide_data = real_iter.provide_data
+        self.provide_label = real_iter.provide_label
+        self.batch_size = real_iter.batch_size
+        self.the_batch = next(iter(real_iter))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.the_batch
+
+    next = __next__
+
+
+class BucketSentenceIter(mx.io.DataIter):
+    """Buckets integer sequences by length; each batch's label is the
+    row-wise sorted copy of its data (reference sort_io.py:113)."""
+
+    def __init__(self, path, vocab, buckets, batch_size, init_states,
+                 data_name="data", label_name="label",
+                 seperate_char=" <eos> ", text2id=None, read_content=None):
+        super().__init__()
+        self.text2id = text2id or default_text2id
+        self.read_content = read_content or default_read_content
+        sentences = self.read_content(path).split(seperate_char)
+        if not buckets:
+            buckets = default_gen_buckets(sentences, batch_size, vocab)
+        self.vocab_size = len(vocab)
+        self.data_name, self.label_name = data_name, label_name
+        self.buckets = sorted(buckets)
+        self.default_bucket_key = max(self.buckets)
+
+        per_bucket = [[] for _ in self.buckets]
+        for s in sentences:
+            ids = self.text2id(s, vocab)
+            if not ids:
+                continue
+            for i, cap in enumerate(self.buckets):
+                if cap >= len(ids):
+                    per_bucket[i].append(ids)
+                    break
+        self.data = []
+        for i, rows in enumerate(per_bucket):
+            arr = np.zeros((len(rows), self.buckets[i]))
+            for j, ids in enumerate(rows):
+                arr[j, :len(ids)] = ids
+            self.data.append(arr)
+
+        print("Summary of dataset ==================")
+        for cap, arr in zip(self.buckets, self.data):
+            print("bucket of len %3d : %d samples" % (cap, len(arr)))
+
+        self.batch_size = batch_size
+        self.init_states = init_states
+        self.init_state_arrays = [mx.nd.zeros(x[1]) for x in init_states]
+        self.provide_data = [("data", (batch_size,
+                                       self.default_bucket_key))] + \
+            list(init_states)
+        self.provide_label = [("softmax_label",
+                               (batch_size, self.default_bucket_key))]
+        self.make_data_iter_plan()
+
+    def make_data_iter_plan(self):
+        n_batches = [len(x) // self.batch_size for x in self.data]
+        self.data = [x[:n * self.batch_size]
+                     for x, n in zip(self.data, n_batches)]
+        plan = np.hstack([np.full(n, i, int)
+                          for i, n in enumerate(n_batches)]) \
+            if any(n_batches) else np.zeros((0,), int)
+        np.random.shuffle(plan)
+        self.bucket_plan = plan
+        self.bucket_idx_all = [np.random.permutation(len(x))
+                               for x in self.data]
+        self.bucket_curr_idx = [0] * len(self.data)
+
+    def __iter__(self):
+        state_names = [x[0] for x in self.init_states]
+        for i_bucket in self.bucket_plan:
+            pos = self.bucket_curr_idx[i_bucket]
+            rows = self.bucket_idx_all[i_bucket][pos:pos + self.batch_size]
+            self.bucket_curr_idx[i_bucket] += self.batch_size
+            data = self.data[i_bucket][rows]
+            label = np.sort(data, axis=1)      # the task: emit sorted input
+            yield SimpleBatch(
+                ["data"] + state_names,
+                [mx.nd.array(data)] + self.init_state_arrays,
+                ["softmax_label"], [mx.nd.array(label)],
+                self.buckets[i_bucket])
+
+    def reset(self):
+        self.bucket_curr_idx = [0] * len(self.data)

@@ -1,0 +1,147 @@
+"""MR-polarity data pipeline for the sentence CNN.
+
+Capability parity with reference
+example/cnn_text_classification/data_helpers.py:1: tokenizer cleaning,
+polarity-file loading (with a synthetic corpus generator since this
+image cannot download rt-polaritydata), padding, vocab building, id and
+word2vec input encodings, an epoch-shuffling batch iterator, and a
+text-format word2vec reader.
+"""
+import itertools
+import os
+import re
+from collections import Counter
+
+import numpy as np
+
+_POS_WORDS = ["good", "great", "fine", "superb", "moving", "smart",
+              "charming", "fresh", "fun", "beautiful", "honest", "warm"]
+_NEG_WORDS = ["bad", "dull", "flat", "tired", "boring", "mess", "weak",
+              "stale", "awful", "lazy", "cold", "hollow"]
+_FILLER = ["the", "movie", "film", "a", "it", "plot", "acting", "story",
+           "an", "is", "of", "and", "with", "this"]
+
+
+def gen_polarity_files(data_dir, n_each=2000, seed=0):
+    """Write rt-polarity.pos/.neg with sentiment-bearing synthetic
+    reviews so the pipeline exercises the real file format."""
+    rng = np.random.RandomState(seed)
+    os.makedirs(data_dir, exist_ok=True)
+
+    def sentence(words):
+        n = rng.randint(6, 14)
+        toks = [str(rng.choice(_FILLER)) for _ in range(n)]
+        for _ in range(rng.randint(2, 4)):
+            toks[rng.randint(0, n)] = str(rng.choice(words))
+        return " ".join(toks)
+
+    with open(os.path.join(data_dir, "rt-polarity.pos"), "w") as f:
+        f.write("\n".join(sentence(_POS_WORDS) for _ in range(n_each)))
+    with open(os.path.join(data_dir, "rt-polarity.neg"), "w") as f:
+        f.write("\n".join(sentence(_NEG_WORDS) for _ in range(n_each)))
+
+
+def clean_str(string):
+    """Tokenizer cleanup from Kim's CNN_sentence preprocessing
+    (reference data_helpers.py:7)."""
+    string = re.sub(r"[^A-Za-z0-9(),!?\'\`]", " ", string)
+    for contraction in ("'s", "'ve", "n't", "'re", "'d", "'ll"):
+        string = string.replace(contraction, " " + contraction)
+    for punct in (",", "!", "(", ")", "?"):
+        string = string.replace(punct, " %s " % punct)
+    return re.sub(r"\s{2,}", " ", string).strip().lower()
+
+
+def load_data_and_labels(data_dir="./data/rt-polaritydata"):
+    """Split sentences + 0/1 labels from the polarity pair files
+    (reference data_helpers.py:28); generates them if absent."""
+    pos_path = os.path.join(data_dir, "rt-polarity.pos")
+    if not os.path.exists(pos_path):
+        gen_polarity_files(data_dir)
+    with open(pos_path) as f:
+        positive = [s.strip() for s in f if s.strip()]
+    with open(os.path.join(data_dir, "rt-polarity.neg")) as f:
+        negative = [s.strip() for s in f if s.strip()]
+    x_text = [clean_str(s).split(" ") for s in positive + negative]
+    y = np.concatenate([np.ones(len(positive), int),
+                        np.zeros(len(negative), int)])
+    return [x_text, y]
+
+
+def pad_sentences(sentences, padding_word="</s>"):
+    """Right-pad every sentence to the longest length (reference
+    data_helpers.py:49)."""
+    max_len = max(len(s) for s in sentences)
+    return [s + [padding_word] * (max_len - len(s)) for s in sentences]
+
+
+def build_vocab(sentences):
+    """Frequency-ordered vocab and its inverse (reference
+    data_helpers.py:64)."""
+    counts = Counter(itertools.chain(*sentences))
+    vocabulary_inv = [w for w, _ in counts.most_common()]
+    vocabulary = {w: i for i, w in enumerate(vocabulary_inv)}
+    return [vocabulary, vocabulary_inv]
+
+
+def build_input_data(sentences, labels, vocabulary):
+    x = np.array([[vocabulary[w] for w in s] for s in sentences])
+    return [x, np.array(labels)]
+
+
+def build_input_data_with_word2vec(sentences, labels, word2vec):
+    """Encode each token as its pretrained vector; OOV maps to the
+    padding vector (reference data_helpers.py:86)."""
+    fallback = word2vec["</s>"]
+    x = np.array([[word2vec.get(w, fallback) for w in s]
+                  for s in sentences])
+    return [x, np.array(labels)]
+
+
+def load_data_with_word2vec(word2vec, data_dir="./data/rt-polaritydata"):
+    sentences, labels = load_data_and_labels(data_dir)
+    return build_input_data_with_word2vec(pad_sentences(sentences), labels,
+                                          word2vec)
+
+
+def load_data(data_dir="./data/rt-polaritydata"):
+    sentences, labels = load_data_and_labels(data_dir)
+    padded = pad_sentences(sentences)
+    vocabulary, vocabulary_inv = build_vocab(padded)
+    x, y = build_input_data(padded, labels, vocabulary)
+    return [x, y, vocabulary, vocabulary_inv]
+
+
+def batch_iter(data, batch_size, num_epochs):
+    """Shuffle-each-epoch minibatch generator (reference
+    data_helpers.py:127)."""
+    data = np.array(data, dtype=object)
+    n = len(data)
+    per_epoch = n // batch_size + 1
+    for _ in range(num_epochs):
+        order = np.random.permutation(n)
+        shuffled = data[order]
+        for b in range(per_epoch):
+            lo = b * batch_size
+            yield shuffled[lo:min(lo + batch_size, n)]
+
+
+def load_pretrained_word2vec(infile):
+    """Text-format word2vec: header line `vocab dim`, then
+    `word v1 ... vd` rows (reference data_helpers.py:144)."""
+    close = False
+    if isinstance(infile, str):
+        infile = open(infile)
+        close = True
+    word2vec = {}
+    try:
+        for idx, line in enumerate(infile):
+            parts = line.strip().split()
+            if idx == 0 and len(parts) == 2:
+                continue
+            word2vec[parts[0]] = np.array([float(v) for v in parts[1:]],
+                                          dtype=np.float32)
+    finally:
+        if close:
+            infile.close()
+    return word2vec

@@ -42,6 +42,175 @@ def text_cnn(vocab_size, num_embed, seq_len, filter_sizes=(3, 4, 5),
     return mx.sym.SoftmaxOutput(fc, name="softmax")
 
 
+# ---------------------------------------------------------------------------
+# Raw-executor training path (reference text_cnn.py:18-196): CNNModel +
+# setup_cnn_model + train_cnn with global grad-norm clipping, periodic lr
+# decay, and checkpointing.  with_embedding=True feeds pre-embedded
+# word2vec tensors; False learns the embedding table in-graph.
+# ---------------------------------------------------------------------------
+from collections import namedtuple
+import math
+import time
+
+CNNModel = namedtuple("CNNModel", ["cnn_exec", "symbol", "data", "label",
+                                   "param_blocks"])
+
+
+def make_text_cnn(sentence_size, num_embed, batch_size, vocab_size,
+                  num_label=2, filter_list=(3, 4, 5), num_filter=100,
+                  dropout=0.0, with_embedding=True):
+    input_x = mx.sym.Variable("data")
+    input_y = mx.sym.Variable("softmax_label")
+    if with_embedding:
+        conv_input = input_x          # (batch, 1, seq, embed) given directly
+    else:
+        embed = mx.sym.Embedding(data=input_x, input_dim=vocab_size,
+                                 output_dim=num_embed, name="vocab_embed")
+        conv_input = mx.sym.Reshape(
+            data=embed, shape=(batch_size, 1, sentence_size, num_embed))
+    pooled = []
+    for width in filter_list:
+        conv = mx.sym.Convolution(data=conv_input,
+                                  kernel=(width, num_embed),
+                                  num_filter=num_filter)
+        act = mx.sym.Activation(data=conv, act_type="relu")
+        pooled.append(mx.sym.Pooling(
+            data=act, pool_type="max",
+            kernel=(sentence_size - width + 1, 1), stride=(1, 1)))
+    concat = mx.sym.Concat(*pooled, dim=1)
+    h_pool = mx.sym.Reshape(data=concat,
+                            shape=(batch_size,
+                                   num_filter * len(filter_list)))
+    h_drop = mx.sym.Dropout(data=h_pool, p=dropout) if dropout > 0 \
+        else h_pool
+    fc = mx.sym.FullyConnected(data=h_drop,
+                               weight=mx.sym.Variable("cls_weight"),
+                               bias=mx.sym.Variable("cls_bias"),
+                               num_hidden=num_label)
+    return mx.sym.SoftmaxOutput(data=fc, label=input_y, name="softmax")
+
+
+def setup_cnn_model(ctx, batch_size, sentence_size, num_embed, vocab_size,
+                    dropout=0.5, initializer=None, with_embedding=True):
+    initializer = initializer or mx.initializer.Uniform(0.1)
+    cnn = make_text_cnn(sentence_size, num_embed, batch_size=batch_size,
+                        vocab_size=vocab_size, dropout=dropout,
+                        with_embedding=with_embedding)
+    arg_names = cnn.list_arguments()
+    if with_embedding:
+        shapes = {"data": (batch_size, 1, sentence_size, num_embed)}
+    else:
+        shapes = {"data": (batch_size, sentence_size)}
+    arg_shapes, _, _ = cnn.infer_shape(**shapes)
+    args = [mx.nd.zeros(s, ctx) for s in arg_shapes]
+    args_grad = {name: mx.nd.zeros(s, ctx)
+                 for s, name in zip(arg_shapes, arg_names)
+                 if name not in ("data", "softmax_label")}
+    exe = cnn.bind(ctx=ctx, args=args, args_grad=args_grad, grad_req="add")
+    arg_dict = dict(zip(arg_names, exe.arg_arrays))
+    blocks = []
+    for i, name in enumerate(arg_names):
+        if name in ("data", "softmax_label"):
+            continue
+        initializer(name, arg_dict[name])
+        blocks.append((i, arg_dict[name], args_grad[name], name))
+    return CNNModel(cnn_exec=exe, symbol=cnn, data=arg_dict["data"],
+                    label=arg_dict["softmax_label"], param_blocks=blocks)
+
+
+def train_cnn(model, X_train_batch, y_train_batch, X_dev_batch,
+              y_dev_batch, batch_size, optimizer="rmsprop",
+              max_grad_norm=5.0, learning_rate=0.0005, epoch=200,
+              checkpoint_dir="checkpoint", checkpoint_every=10):
+    m = model
+    opt = mx.optimizer.create(optimizer)
+    opt.lr = learning_rate
+    updater = mx.optimizer.get_updater(opt)
+
+    for it in range(epoch):
+        tic = time.time()
+        correct = total = 0
+        for lo in range(0, X_train_batch.shape[0] - batch_size + 1,
+                        batch_size):
+            m.data[:] = X_train_batch[lo:lo + batch_size]
+            m.label[:] = y_train_batch[lo:lo + batch_size]
+            m.cnn_exec.forward(is_train=True)
+            m.cnn_exec.backward()
+            pred = np.argmax(m.cnn_exec.outputs[0].asnumpy(), axis=1)
+            correct += int((pred == y_train_batch[lo:lo + batch_size])
+                           .sum())
+            total += batch_size
+
+            # global grad-norm clip, then update and zero (grad_req=add)
+            norm_sq = 0.0
+            for _, _, grad, _ in m.param_blocks:
+                grad /= batch_size
+                n = mx.nd.norm(grad).asscalar()
+                norm_sq += n * n
+            norm = math.sqrt(norm_sq)
+            for idx, weight, grad, _ in m.param_blocks:
+                if norm > max_grad_norm:
+                    grad *= (max_grad_norm / norm)
+                updater(idx, grad, weight)
+                grad[:] = 0.0
+
+        if it % 50 == 0 and it > 0:
+            opt.lr *= 0.5
+            print("reset learning rate to %g" % opt.lr, file=sys.stderr)
+
+        train_acc = 100.0 * correct / max(total, 1)
+        train_time = time.time() - tic
+
+        if (it + 1) % checkpoint_every == 0:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            m.symbol.save("%s/cnn-symbol.json" % checkpoint_dir)
+            save_dict = {"arg:%s" % k: v
+                         for k, v in m.cnn_exec.arg_dict.items()}
+            save_dict.update({"aux:%s" % k: v
+                              for k, v in m.cnn_exec.aux_dict.items()})
+            pname = "%s/cnn-%04d.params" % (checkpoint_dir, it)
+            mx.nd.save(pname, save_dict)
+            print("Saved checkpoint to %s" % pname, file=sys.stderr)
+
+        correct = total = 0
+        for lo in range(0, X_dev_batch.shape[0] - batch_size + 1,
+                        batch_size):
+            m.data[:] = X_dev_batch[lo:lo + batch_size]
+            m.cnn_exec.forward(is_train=False)
+            pred = np.argmax(m.cnn_exec.outputs[0].asnumpy(), axis=1)
+            correct += int((pred == y_dev_batch[lo:lo + batch_size]).sum())
+            total += batch_size
+        dev_acc = 100.0 * correct / max(total, 1)
+        print("Iter [%d] Train: Time: %.3fs, Training Accuracy: %.3f "
+              "--- Dev Accuracy thus far: %.3f"
+              % (it, train_time, train_acc, dev_acc), file=sys.stderr)
+    return dev_acc
+
+
+def train_without_pretrained_embedding(batch_size=50, epoch=20,
+                                       num_embed=300, data_dir=None):
+    """MR-polarity training with a learned embedding (reference
+    text_cnn.py:233): load_data -> shuffle -> 90/10 split -> raw loop."""
+    import data_helpers
+    kw = {"data_dir": data_dir} if data_dir else {}
+    x, y, vocab, _ = data_helpers.load_data(**kw)
+    vocab_size = len(vocab)
+    order = np.random.permutation(np.arange(len(y)))
+    x_shuffled, y_shuffled = x[order], y[order]
+    n_dev = max(batch_size, int(len(y) * 0.1))
+    x_train, x_dev = x_shuffled[:-n_dev], x_shuffled[-n_dev:]
+    y_train, y_dev = y_shuffled[:-n_dev], y_shuffled[-n_dev:]
+    sentence_size = x_train.shape[1]
+    print("Train/Dev split: %d/%d" % (len(y_train), len(y_dev)),
+          file=sys.stderr)
+
+    cnn_model = setup_cnn_model(mx.cpu(), batch_size, sentence_size,
+                                num_embed, vocab_size, dropout=0.5,
+                                with_embedding=False)
+    return train_cnn(cnn_model, x_train, y_train, x_dev, y_dev,
+                     batch_size, epoch=epoch)
+
+
 def synthetic_sentences(n, vocab_size, seq_len, seed=0):
     """Positive sentences contain tokens from the top half of the vocab."""
     rng = np.random.RandomState(seed)

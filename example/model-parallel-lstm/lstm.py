@@ -1,0 +1,440 @@
+"""Model-parallel unrolled LSTM library.
+
+Capability parity with reference example/model-parallel-lstm/lstm.py:1:
+per-timestep unrolled symbols whose embed / per-layer / decode stages
+live in distinct ``ctx_group``s, bucketed executor setup with memory
+sharing, a raw-executor training loop with global grad-norm clipping
+and perplexity-driven lr halving, and a 1-step sampling model.
+
+On mxnet_tpu the ctx_group placement is honoured by the eager
+(node-level) executor path; under whole-graph jit the groups become
+sharding hints.  Each timestep is its own symbol node so the dependency
+engine can overlap layers living on different devices — the same
+pipeline effect the reference got from its threaded engine.
+"""
+import math
+import time
+from collections import namedtuple
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+LSTMState = namedtuple("LSTMState", ["c", "h"])
+LSTMParam = namedtuple("LSTMParam", ["i2h_weight", "i2h_bias",
+                                     "h2h_weight", "h2h_bias"])
+LSTMModel = namedtuple("LSTMModel", ["rnn_exec", "symbol", "init_states",
+                                     "last_states", "seq_data",
+                                     "seq_labels", "seq_outputs",
+                                     "param_blocks"])
+# mxnet_tpu executors materialize outputs lazily (first forward()), so
+# models carry output *names*; these helpers resolve them post-forward.
+
+
+def seq_output_arrays(m):
+    outs = dict(zip(m.symbol.list_outputs(), m.rnn_exec.outputs))
+    return [outs[n] for n in m.seq_outputs]
+
+
+def last_state_arrays(m):
+    outs = dict(zip(m.symbol.list_outputs(), m.rnn_exec.outputs))
+    return [LSTMState(c=outs[c], h=outs[h]) for c, h in m.last_states]
+
+
+def lstm(num_hidden, indata, prev_state, param, seqidx, layeridx,
+         dropout=0.0):
+    """One LSTM cell step built from a single fused 4*h gate matmul
+    (reference lstm.py:17)."""
+    if dropout > 0.0:
+        indata = mx.sym.Dropout(data=indata, p=dropout)
+    i2h = mx.sym.FullyConnected(data=indata, weight=param.i2h_weight,
+                                bias=param.i2h_bias,
+                                num_hidden=num_hidden * 4,
+                                name="t%d_l%d_i2h" % (seqidx, layeridx))
+    h2h = mx.sym.FullyConnected(data=prev_state.h, weight=param.h2h_weight,
+                                bias=param.h2h_bias,
+                                num_hidden=num_hidden * 4,
+                                name="t%d_l%d_h2h" % (seqidx, layeridx))
+    gates = i2h + h2h
+    sliced = mx.sym.SliceChannel(gates, num_outputs=4,
+                                 name="t%d_l%d_slice" % (seqidx, layeridx))
+    in_gate = mx.sym.Activation(sliced[0], act_type="sigmoid")
+    in_trans = mx.sym.Activation(sliced[1], act_type="tanh")
+    forget = mx.sym.Activation(sliced[2], act_type="sigmoid")
+    out_gate = mx.sym.Activation(sliced[3], act_type="sigmoid")
+    next_c = (forget * prev_state.c) + (in_gate * in_trans)
+    next_h = out_gate * mx.sym.Activation(next_c, act_type="tanh")
+    return LSTMState(c=next_c, h=next_h)
+
+
+def lstm_unroll(num_lstm_layer, seq_len, input_size, num_hidden, num_embed,
+                num_label, dropout=0.0, concat_decode=True, use_loss=False):
+    """Unroll ``seq_len`` steps with stage-wise ctx_group placement
+    (reference lstm.py:43): the embedding table lives in group 'embed',
+    layer i in 'layer<i>', the softmax decoder in 'decode'."""
+    with mx.AttrScope(ctx_group="embed"):
+        embed_weight = mx.sym.Variable("embed_weight")
+    with mx.AttrScope(ctx_group="decode"):
+        cls_weight = mx.sym.Variable("cls_weight")
+        cls_bias = mx.sym.Variable("cls_bias")
+
+    cells, states = [], []
+    for i in range(num_lstm_layer):
+        with mx.AttrScope(ctx_group="layer%d" % i):
+            cells.append(LSTMParam(
+                i2h_weight=mx.sym.Variable("l%d_i2h_weight" % i),
+                i2h_bias=mx.sym.Variable("l%d_i2h_bias" % i),
+                h2h_weight=mx.sym.Variable("l%d_h2h_weight" % i),
+                h2h_bias=mx.sym.Variable("l%d_h2h_bias" % i)))
+            states.append(LSTMState(
+                c=mx.sym.Variable("l%d_init_c" % i),
+                h=mx.sym.Variable("l%d_init_h" % i)))
+
+    step_hidden = []
+    for t in range(seq_len):
+        with mx.AttrScope(ctx_group="embed"):
+            tok = mx.sym.Variable("t%d_data" % t)
+            h = mx.sym.Embedding(data=tok, weight=embed_weight,
+                                 input_dim=input_size,
+                                 output_dim=num_embed,
+                                 name="t%d_embed" % t)
+        for i in range(num_lstm_layer):
+            with mx.AttrScope(ctx_group="layer%d" % i):
+                nxt = lstm(num_hidden, indata=h, prev_state=states[i],
+                           param=cells[i], seqidx=t, layeridx=i,
+                           dropout=dropout if i > 0 else 0.0)
+            h = nxt.h
+            states[i] = nxt
+        if dropout > 0.0:
+            h = mx.sym.Dropout(data=h, p=dropout)
+        step_hidden.append(h)
+
+    heads = []
+    if concat_decode:
+        with mx.AttrScope(ctx_group="decode"):
+            allh = mx.sym.Concat(*step_hidden, dim=0)
+            fc = mx.sym.FullyConnected(data=allh, weight=cls_weight,
+                                       bias=cls_bias, num_hidden=num_label)
+            label = mx.sym.Variable("label")
+            heads.append(
+                mx.sym.softmax_cross_entropy(fc, label, name="sm")
+                if use_loss else
+                mx.sym.SoftmaxOutput(data=fc, label=label, name="sm"))
+    else:
+        for t in range(seq_len):
+            with mx.AttrScope(ctx_group="decode"):
+                fc = mx.sym.FullyConnected(data=step_hidden[t],
+                                           weight=cls_weight, bias=cls_bias,
+                                           num_hidden=num_label,
+                                           name="t%d_cls" % t)
+                label = mx.sym.Variable("t%d_label" % t)
+                heads.append(
+                    mx.sym.softmax_cross_entropy(fc, label,
+                                                 name="t%d_sm" % t)
+                    if use_loss else
+                    mx.sym.SoftmaxOutput(data=fc, label=label,
+                                         name="t%d_sm" % t))
+
+    # expose final states (grad-blocked) so samplers can carry them over
+    tails = []
+    for i, st in enumerate(states):
+        tails.append(mx.sym.BlockGrad(st.c, name="l%d_last_c" % i))
+        tails.append(mx.sym.BlockGrad(st.h, name="l%d_last_h" % i))
+    return mx.sym.Group(heads + tails)
+
+
+def is_param_name(name):
+    return name.endswith(("weight", "bias", "gamma", "beta"))
+
+
+def _input_shapes(arg_names, batch_size, num_hidden, seq_len):
+    shapes = {}
+    for name in arg_names:
+        if name.endswith(("init_c", "init_h")):
+            shapes[name] = (batch_size, num_hidden)
+        elif name.endswith("data"):
+            shapes[name] = (batch_size,)
+        elif name == "label":
+            shapes[name] = (batch_size * seq_len,)
+        elif name.endswith("label"):
+            shapes[name] = (batch_size,)
+    return shapes
+
+
+def setup_rnn_model(default_ctx, num_lstm_layer, seq_len, num_hidden,
+                    num_embed, num_label, batch_size, input_size,
+                    initializer, dropout=0.0, group2ctx=None,
+                    concat_decode=True, use_loss=False, buckets=None,
+                    verbose=True):
+    """Build one executor per bucket, binding the largest first so the
+    smaller ones share its arrays (reference lstm.py:142).  Returns
+    {bucket_len: LSTMModel}."""
+    group2ctx = group2ctx or {}
+    buckets = sorted(buckets or [seq_len], reverse=True)
+    models, biggest_exec = {}, None
+    # params/grads allocated once by the largest bucket and REUSED by the
+    # smaller ones — bind() with explicit args keeps whatever arrays it is
+    # handed, so sharing must happen here, not via shared_exec (which only
+    # shares through simple_bind's allocation path)
+    shared_params, shared_grads = {}, {}
+
+    for bucket_len in buckets:
+        sym = lstm_unroll(num_lstm_layer=num_lstm_layer, seq_len=bucket_len,
+                          input_size=input_size, num_hidden=num_hidden,
+                          num_embed=num_embed, num_label=num_label,
+                          dropout=dropout, concat_decode=concat_decode,
+                          use_loss=use_loss)
+        arg_names = sym.list_arguments()
+        internals = sym.get_internals()
+        shapes = _input_shapes(arg_names, batch_size, num_hidden, bucket_len)
+        arg_shapes, _, _ = sym.infer_shape(**shapes)
+
+        args, args_grad = [], {}
+        for name, shape in zip(arg_names, arg_shapes):
+            group = internals[name].attr("ctx_group")
+            ctx = group2ctx.get(group, default_ctx) if group else default_ctx
+            if is_param_name(name):
+                if name not in shared_params:
+                    shared_params[name] = mx.nd.zeros(shape, ctx)
+                    shared_grads[name] = mx.nd.zeros(shape, ctx)
+                    initializer(name, shared_params[name])
+                    if verbose:
+                        print("%s group=%s ctx=%s" % (name, group, ctx))
+                args.append(shared_params[name])
+                args_grad[name] = shared_grads[name]
+            else:
+                args.append(mx.nd.zeros(shape, ctx))
+
+        exe = sym.bind(default_ctx, args=args, args_grad=args_grad,
+                       grad_req="add", group2ctx=group2ctx,
+                       shared_exec=biggest_exec)
+        if biggest_exec is None:
+            biggest_exec = exe
+
+        arg_dict = dict(zip(arg_names, exe.arg_arrays))
+        blocks = []
+        for i, name in enumerate(arg_names):
+            if is_param_name(name):
+                blocks.append((i, arg_dict[name], args_grad[name], name))
+
+        init_states = [LSTMState(c=arg_dict["l%d_init_c" % i],
+                                 h=arg_dict["l%d_init_h" % i])
+                       for i in range(num_lstm_layer)]
+        if concat_decode:
+            seq_outputs = ["sm_output"]
+            seq_labels = [exe.arg_dict["label"]]
+        else:
+            seq_outputs = ["t%d_sm_output" % t for t in range(bucket_len)]
+            seq_labels = [exe.arg_dict["t%d_label" % t]
+                          for t in range(bucket_len)]
+        models[bucket_len] = LSTMModel(
+            rnn_exec=exe, symbol=sym, init_states=init_states,
+            last_states=None,
+            seq_data=[exe.arg_dict["t%d_data" % t]
+                      for t in range(bucket_len)],
+            seq_labels=seq_labels, seq_outputs=seq_outputs,
+            param_blocks=blocks)
+    return models
+
+
+def set_rnn_inputs(m, X, begin):
+    """Fill the per-timestep data/label slots from time-major data X
+    (rows are timesteps); labels are the next row (reference
+    lstm.py:242)."""
+    seq_len = len(m.seq_data)
+    batch_size = m.seq_data[0].shape[0]
+    for t in range(seq_len):
+        row = (begin + t) % X.shape[0]
+        nxt = (begin + t + 1) % X.shape[0]
+        m.seq_data[t][:] = X[row, :]
+        if not m.seq_labels:       # sampling model binds no label slots
+            continue
+        if len(m.seq_labels) == 1:
+            m.seq_labels[0][t * batch_size:(t + 1) * batch_size] = X[nxt, :]
+        else:
+            m.seq_labels[t][:] = X[nxt, :]
+
+
+def set_rnn_inputs_from_batch(m, batch, batch_seq_length, batch_size):
+    """Same, from a bucketed time-major DataBatch (reference
+    lstm.py:256)."""
+    X = batch.data
+    for t in range(batch_seq_length):
+        nxt = (t + 1) % batch_seq_length
+        x_row = X[t] if not hasattr(X[t], "asnumpy") else X[t].asnumpy()
+        y_row = X[nxt] if not hasattr(X[nxt], "asnumpy") else X[nxt].asnumpy()
+        m.seq_data[t][:] = x_row
+        if len(m.seq_labels) == 1:
+            m.seq_labels[0][t * batch_size:(t + 1) * batch_size] = y_row
+        else:
+            m.seq_labels[t][:] = y_row
+
+
+def calc_nll_concat(seq_label_probs, batch_size):
+    probs = np.maximum(seq_label_probs.asnumpy(), 1e-10)
+    return -np.log(probs).sum() / batch_size
+
+
+def calc_nll(seq_label_probs, batch_size, seq_len):
+    nll = 0.0
+    for t in range(seq_len):
+        probs = np.maximum(seq_label_probs[t].asnumpy(), 1e-10)
+        nll += -np.log(probs).sum() / batch_size
+    return nll
+
+
+def _clip_and_update(m, updater, batch_size, max_grad_norm):
+    """Global-norm gradient clipping across every param block, then one
+    optimizer step and grad reset (grad_req='add' accumulates)."""
+    total = 0.0
+    for _, _, grad, _ in m.param_blocks:
+        grad /= batch_size
+        n = mx.nd.norm(grad).asscalar()
+        total += n * n
+    total = math.sqrt(total)
+    scale = max_grad_norm / total if total > max_grad_norm else None
+    for idx, weight, grad, _ in m.param_blocks:
+        if scale is not None:
+            grad *= scale
+        updater(idx, grad, weight)
+        grad[:] = 0.0
+
+
+def _batch_nll(m, concat_decode, use_loss, batch_size, seq_len):
+    """Log-likelihood bookkeeping for one already-forwarded batch."""
+    outs = seq_output_arrays(m)
+    if use_loss:
+        return sum(float(o.asnumpy().sum()) for o in outs) / batch_size
+    if concat_decode:
+        probs = mx.nd.choose_element_0index(outs[0], m.seq_labels[0])
+        return calc_nll_concat(probs, batch_size)
+    probs = [mx.nd.choose_element_0index(o, l)
+             for o, l in zip(outs, m.seq_labels)]
+    return calc_nll(probs, batch_size, seq_len)
+
+
+def train_lstm(model, X_train_batch, X_val_batch, num_round, update_period,
+               concat_decode, batch_size, use_loss, optimizer="sgd",
+               half_life=2, max_grad_norm=5.0, log_period=28, **kwargs):
+    """Raw-executor training over bucketed batches with perplexity-driven
+    lr halving (reference lstm.py:282)."""
+    opt = mx.optimizer.create(optimizer, **kwargs)
+    updater = mx.optimizer.get_updater(opt)
+    step, last_perp = 0, float("inf")
+
+    for rnd in range(num_round):
+        train_nll, seen = 0.0, 0
+        tic = time.time()
+        for batch in X_train_batch:
+            seq_len = batch.bucket_key
+            m = model[seq_len]
+            for st in m.init_states:
+                st.c[:] = 0.0
+                st.h[:] = 0.0
+            set_rnn_inputs_from_batch(m, batch, seq_len, batch_size)
+            m.rnn_exec.forward(is_train=True)
+            if use_loss:
+                ctx = m.seq_labels[0].context
+                m.rnn_exec.backward([mx.nd.ones((1,), ctx)
+                                     for _ in m.seq_outputs])
+            else:
+                m.rnn_exec.backward()
+            train_nll += _batch_nll(m, concat_decode, use_loss,
+                                    batch_size, seq_len)
+            step += 1
+            if step % update_period == 0:
+                _clip_and_update(m, updater, batch_size, max_grad_norm)
+            seen += batch_size
+            if step % log_period == 0:
+                print("Iter [%d] Train: Time: %.3f sec, NLL=%.3f, "
+                      "Perp=%.3f" % (step, time.time() - tic,
+                                     train_nll / seen,
+                                     np.exp(train_nll / seen)))
+        print("Iter [%d] Train: Time: %.3f sec, NLL=%.3f, Perp=%.3f"
+              % (rnd, time.time() - tic, train_nll / seen,
+                 np.exp(train_nll / seen)))
+
+        val_nll, seen = 0.0, 0
+        for batch in X_val_batch:
+            seq_len = batch.bucket_key
+            m = model[seq_len]
+            for st in m.init_states:
+                st.c[:] = 0.0
+                st.h[:] = 0.0
+            set_rnn_inputs_from_batch(m, batch, seq_len, batch_size)
+            m.rnn_exec.forward(is_train=False)
+            val_nll += _batch_nll(m, concat_decode, use_loss,
+                                  batch_size, seq_len)
+            seen += batch_size
+        perp = np.exp(val_nll / seen)
+        print("Iter [%d] Val: NLL=%.3f, Perp=%.3f"
+              % (rnd, val_nll / seen, perp))
+        if last_perp - 1.0 < perp:
+            opt.lr *= 0.5
+            print("Reset learning rate to %g" % opt.lr)
+        last_perp = perp
+        X_val_batch.reset()
+        X_train_batch.reset()
+    return last_perp
+
+
+def setup_rnn_sample_model(ctx, params, num_lstm_layer, num_hidden,
+                           num_embed, num_label, batch_size, input_size,
+                           concat_decode=False):
+    """1-step executor that exposes last_states so generation can feed
+    them back (reference lstm.py:405)."""
+    sym = lstm_unroll(num_lstm_layer=num_lstm_layer, seq_len=1,
+                      input_size=input_size, num_hidden=num_hidden,
+                      num_embed=num_embed, num_label=num_label,
+                      concat_decode=concat_decode)
+    arg_names = sym.list_arguments()
+    shapes = _input_shapes(arg_names, batch_size, num_hidden, 1)
+    arg_shapes, _, _ = sym.infer_shape(**shapes)
+    args = [mx.nd.zeros(s, ctx) for s in arg_shapes]
+    arg_dict = dict(zip(arg_names, args))
+    for name, arr in params.items():
+        if name in arg_dict:
+            arg_dict[name][:] = arr
+    exe = sym.bind(ctx=ctx, args=args, args_grad=None, grad_req="null")
+    blocks = [(i, arr, None, name)
+              for i, (name, arr) in enumerate(params.items())]
+    init_states = [LSTMState(c=arg_dict["l%d_init_c" % i],
+                             h=arg_dict["l%d_init_h" % i])
+                   for i in range(num_lstm_layer)]
+    # output NAMES (resolved post-forward by last_state_arrays /
+    # seq_output_arrays)
+    last_states = [("l%d_last_c_output" % i, "l%d_last_h_output" % i)
+                   for i in range(num_lstm_layer)]
+    key = "sm_output" if concat_decode else "t0_sm_output"
+    return LSTMModel(rnn_exec=exe, symbol=sym, init_states=init_states,
+                     last_states=last_states,
+                     seq_data=[exe.arg_dict["t0_data"]],
+                     seq_labels=[], seq_outputs=[key],
+                     param_blocks=blocks)
+
+
+def sample_lstm(model, X_input_batch, seq_len, temperature=1.0,
+                sample=True, rng=None):
+    """Autoregressive generation from the 1-step model: temperature
+    sampling (vectorized gumbel draw instead of the reference's
+    per-row cdf walk, reference lstm.py:477) or greedy argmax."""
+    rng = rng or np.random.RandomState(0)
+    m = model
+    batch_size = m.seq_data[0].shape[0]
+    outputs = []
+    for _ in range(seq_len):
+        set_rnn_inputs(m, X_input_batch, 0)
+        m.rnn_exec.forward(is_train=False)
+        for init, last in zip(m.init_states, last_state_arrays(m)):
+            last.c.copyto(init.c)
+            last.h.copyto(init.h)
+        prob = np.clip(seq_output_arrays(m)[0].asnumpy(), 1e-6, 1 - 1e-6)
+        if sample:
+            logits = np.log(prob) / temperature
+            gumbel = -np.log(-np.log(rng.rand(*logits.shape)))
+            step_out = (logits + gumbel).argmax(axis=1)
+        else:
+            step_out = prob.argmax(axis=1)
+        outputs.append(step_out.astype(np.float32).reshape(batch_size, 1))
+        X_input_batch[:] = outputs[-1]
+    return outputs

@@ -1,0 +1,97 @@
+"""PennTreeBank language model, model-parallel across devices.
+
+Capability parity with reference example/model-parallel-lstm/lstm_ptb.py:1:
+word-level PTB LM with the per-layer ctx_group placement plan, bucketed
+time-major batches, grad-norm clipping and perplexity-driven lr decay.
+Without a downloaded PTB corpus (this image has no egress) --synthetic
+generates a Markov-chain corpus with the same iterator/bucket machinery.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "rnn"))
+import mxnet_tpu as mx
+
+import lstm
+from bucket_io import BucketSentenceIter, default_build_vocab, \
+    synthetic_markov_corpus
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--train", default="./data/ptb.train.txt")
+    parser.add_argument("--valid", default="./data/ptb.valid.txt")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="generate a Markov corpus instead of PTB")
+    parser.add_argument("--tokens", type=int, default=30000,
+                        help="--synthetic corpus size")
+    parser.add_argument("--batch-size", type=int, default=20)
+    parser.add_argument("--num-hidden", type=int, default=400)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--num-lstm-layer", type=int, default=8)
+    parser.add_argument("--num-round", type=int, default=25)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--max-grad-norm", type=float, default=5.0)
+    parser.add_argument("--num-devices", type=int, default=2)
+    parser.add_argument("--buckets", type=int, nargs="+",
+                        default=[8, 16, 24, 32, 60])
+    parser.add_argument("--dropout", type=float, default=0.5)
+    parser.add_argument("--concat-decode", action="store_true")
+    parser.add_argument("--use-softmax-output", action="store_true",
+                        help="SoftmaxOutput heads instead of "
+                             "softmax_cross_entropy loss heads")
+    args = parser.parse_args()
+
+    if args.synthetic or not os.path.exists(args.train):
+        os.makedirs(os.path.dirname(args.train) or ".", exist_ok=True)
+        if not os.path.exists(args.train):
+            synthetic_markov_corpus(args.train, n_tokens=args.tokens)
+        if not os.path.exists(args.valid):
+            synthetic_markov_corpus(args.valid, seed=8,
+                                    n_tokens=max(args.tokens // 5, 500))
+
+    dic = default_build_vocab(args.train)
+    vocab = len(dic) + 1
+    print("vocab=%d" % vocab)
+
+    init_states = [("l%d_init_%s" % (l, s),
+                    (args.batch_size, args.num_hidden))
+                   for l in range(args.num_lstm_layer) for s in "ch"]
+    train_iter = BucketSentenceIter(args.train, dic, list(args.buckets),
+                                    args.batch_size, init_states,
+                                    model_parallel=True)
+    val_iter = BucketSentenceIter(args.valid, dic, list(args.buckets),
+                                  args.batch_size, init_states,
+                                  model_parallel=True)
+
+    # placement plan: embed on the first device, decode on the last,
+    # LSTM layers spread evenly between (reference lstm_ptb.py:81)
+    ndev = args.num_devices
+    group2ctx = {"embed": mx.cpu(0), "decode": mx.cpu(ndev - 1)}
+    for i in range(args.num_lstm_layer):
+        group2ctx["layer%d" % i] = mx.cpu(i * ndev // args.num_lstm_layer)
+
+    use_loss = not args.use_softmax_output
+    model = lstm.setup_rnn_model(
+        mx.cpu(), group2ctx=group2ctx, concat_decode=args.concat_decode,
+        use_loss=use_loss, num_lstm_layer=args.num_lstm_layer,
+        seq_len=train_iter.default_bucket_key, num_hidden=args.num_hidden,
+        num_embed=args.num_embed, num_label=vocab,
+        batch_size=args.batch_size, input_size=vocab,
+        initializer=mx.initializer.Uniform(0.1), dropout=args.dropout,
+        buckets=list(args.buckets))
+
+    perp = lstm.train_lstm(
+        model, train_iter, val_iter, num_round=args.num_round,
+        concat_decode=args.concat_decode, use_loss=use_loss, half_life=2,
+        max_grad_norm=args.max_grad_norm, update_period=1,
+        learning_rate=args.lr, batch_size=args.batch_size, wd=0.0)
+    print("FINAL-VAL-PERP %.3f" % perp)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Bucketed LSTM LM through the Module API, with post-fit scoring.
+
+Capability parity with reference example/module/lstm_bucketing.py:1:
+BucketingModule (or plain Module when one bucket) over the rnn
+example's corpus machinery, numpy Perplexity metric, DummyIter speed
+mode, and `mod.score` on the validation iterator after fit — the point
+of this example over example/rnn/lstm_bucketing.py is that scoring and
+prediction reuse the already-bound bucket executors.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "rnn"))
+import mxnet_tpu as mx
+from mxnet_tpu.models import lstm_unroll
+
+from bucket_io import BucketSentenceIter, default_build_vocab, \
+    perplexity_metric, synthetic_markov_corpus
+
+
+class DummyIter(mx.io.DataIter):
+    """Replays one batch forever: measures compute with IO removed
+    (reference sort_io.py DummyIter, used by this example)."""
+
+    def __init__(self, real_iter, n_batches=50):
+        super().__init__()
+        self.provide_data = real_iter.provide_data
+        self.provide_label = real_iter.provide_label
+        self.batch_size = real_iter.batch_size
+        self.default_bucket_key = real_iter.default_bucket_key
+        self.the_batch = next(iter(real_iter))
+        self.n_batches = n_batches
+        self._served = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._served >= self.n_batches:
+            raise StopIteration
+        self._served += 1
+        return self.the_batch
+
+    next = __next__
+
+    def reset(self):
+        self._served = 0
+
+
+Perplexity = perplexity_metric
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--train", default="./data/ptb.train.txt")
+    parser.add_argument("--valid", default="./data/ptb.valid.txt")
+    parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--num-lstm-layer", type=int, default=2)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--buckets", type=int, nargs="+",
+                        default=[10, 20, 30, 40, 50, 60])
+    parser.add_argument("--dummy-data", action="store_true",
+                        help="replay one batch (IO-free speed test)")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.DEBUG,
+                        format="%(asctime)-15s %(message)s")
+
+    if args.synthetic or not os.path.exists(args.train):
+        os.makedirs(os.path.dirname(args.train) or ".", exist_ok=True)
+        if not os.path.exists(args.train):
+            synthetic_markov_corpus(args.train, vocab_size=150,
+                                    n_tokens=20000, seed=11,
+                                    stickiness=0.8, break_p=0.04)
+        if not os.path.exists(args.valid):
+            synthetic_markov_corpus(args.valid, vocab_size=150,
+                                    n_tokens=4000, seed=12,
+                                    stickiness=0.8, break_p=0.04)
+
+    vocab = default_build_vocab(args.train)
+    init_states = [("l%d_init_%s" % (l, s),
+                    (args.batch_size, args.num_hidden))
+                   for l in range(args.num_lstm_layer) for s in "ch"]
+    data_train = BucketSentenceIter(args.train, vocab, list(args.buckets),
+                                    args.batch_size, init_states)
+    data_val = BucketSentenceIter(args.valid, vocab, list(args.buckets),
+                                  args.batch_size, init_states)
+    if args.dummy_data:
+        data_train = DummyIter(data_train)
+        data_val = DummyIter(data_val, n_batches=10)
+
+    state_names = [x[0] for x in init_states]
+
+    def sym_gen(seq_len):
+        net = lstm_unroll(args.num_lstm_layer, seq_len, len(vocab) + 1,
+                          num_hidden=args.num_hidden,
+                          num_embed=args.num_embed,
+                          num_label=len(vocab) + 1)
+        return net, tuple(["data"] + state_names), ("softmax_label",)
+
+    if len(args.buckets) == 1:
+        net, d, l = sym_gen(args.buckets[0])
+        mod = mx.mod.Module(net, data_names=d, label_names=l,
+                            context=[mx.cpu()])
+    else:
+        mod = mx.mod.BucketingModule(
+            sym_gen, default_bucket_key=data_train.default_bucket_key,
+            context=[mx.cpu()])
+
+    mod.fit(data_train, eval_data=data_val, num_epoch=args.num_epochs,
+            eval_metric=mx.metric.np(Perplexity),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9,
+                              "wd": 0.00001})
+
+    # scoring reuses the bound bucket executors
+    metric = mx.metric.np(Perplexity)
+    mod.score(data_val, metric)
+    for name, val in metric.get_name_value():
+        logging.info("Validation-%s=%f", name, val)
+        print("SCORED %s=%f" % (name, val))
+
+
+if __name__ == "__main__":
+    main()

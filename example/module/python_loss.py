@@ -1,0 +1,81 @@
+"""Custom python loss through the module chain.
+
+Capability parity with reference example/module/python_loss.py:1: an MLP
+Module feeding a PythonLossModule whose multiclass-hinge gradient is
+computed in numpy (vectorized — the reference needed numba for its
+per-row loop), chained by SequentialModule with auto wiring.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def mc_hinge_grad(scores, labels):
+    """Subgradient of the Crammer-Singer multiclass hinge
+    max(0, 1 + max_{j != y} s_j - s_y): +1 at the argmax violating
+    class, -1 at the true class."""
+    scores = scores.asnumpy() if hasattr(scores, "asnumpy") else scores
+    labels = labels.asnumpy() if hasattr(labels, "asnumpy") else labels
+    labels = labels.astype(int)
+    n = scores.shape[0]
+    rows = np.arange(n)
+    margin = 1.0 + scores - scores[rows, labels][:, None]
+    margin[rows, labels] = 0.0
+    worst = margin.argmax(axis=1)
+    grad = np.zeros_like(scores)
+    np.subtract.at(grad, (rows, labels), 1.0)
+    np.add.at(grad, (rows, worst), 1.0)
+    return grad
+
+
+def make_data(batch_size, n=6000, seed=0):
+    rng = np.random.RandomState(seed)
+    means = 2.0 * rng.randn(10, 784).astype(np.float32)
+    y = rng.randint(0, 10, size=n)
+    x = means[y] + rng.randn(n, 784).astype(np.float32)
+    y = y.astype(np.float32)
+    cut = int(n * 0.85)
+    return (mx.io.NDArrayIter(x[:cut], y[:cut], batch_size=batch_size,
+                              shuffle=True),
+            mx.io.NDArrayIter(x[cut:], y[cut:], batch_size=batch_size))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--batch-size", type=int, default=100)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.DEBUG)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+
+    mlp = mx.mod.Module(fc3, context=[mx.cpu()], label_names=[])
+    loss = mx.mod.PythonLossModule(grad_func=mc_hinge_grad)
+    mod = mx.mod.SequentialModule() \
+        .add(mlp) \
+        .add(loss, take_labels=True, auto_wiring=True)
+
+    train, val = make_data(args.batch_size)
+    mod.fit(train, eval_data=val,
+            optimizer_params={"learning_rate": 0.01, "momentum": 0.9},
+            num_epoch=args.num_epochs)
+
+    # hinge scores: argmax is still the predicted class
+    metric = mx.metric.Accuracy()
+    mod.score(val, metric)
+    print("hinge-trained accuracy: %.3f" % metric.get()[1])
+
+
+if __name__ == "__main__":
+    main()

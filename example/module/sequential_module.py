@@ -1,0 +1,68 @@
+"""Chained Modules demo.
+
+Capability parity with reference example/module/sequential_module.py:1:
+two symbol Modules (feature trunk, classifier head) composed with
+SequentialModule — the head takes labels and auto-wires its 'data'
+input to the trunk's output.  Each sub-module can carry its own
+context list, the module-level analogue of pipeline placement.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def make_data(batch_size, n=6000, seed=0):
+    rng = np.random.RandomState(seed)
+    means = 2.0 * rng.randn(10, 784).astype(np.float32)
+    y = rng.randint(0, 10, size=n)
+    x = means[y] + rng.randn(n, 784).astype(np.float32)
+    cut = int(n * 0.85)
+    return (mx.io.NDArrayIter(x[:cut], y[:cut].astype(np.float32),
+                              batch_size=batch_size, shuffle=True),
+            mx.io.NDArrayIter(x[cut:], y[cut:].astype(np.float32),
+                              batch_size=batch_size))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=100)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.DEBUG)
+
+    # module 1: the feature trunk (no labels)
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    mod1 = mx.mod.Module(act1, label_names=[], context=[mx.cpu()])
+
+    # module 2: the classifier head — its 'data' is module 1's output
+    data = mx.sym.Variable("data")
+    fc2 = mx.sym.FullyConnected(data, name="fc2", num_hidden=64)
+    act2 = mx.sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    softmax = mx.sym.SoftmaxOutput(fc3, name="softmax")
+    mod2 = mx.mod.Module(softmax, context=[mx.cpu()])
+
+    mod_seq = mx.mod.SequentialModule()
+    mod_seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+
+    train, val = make_data(args.batch_size)
+    mod_seq.fit(train, eval_data=val,
+                optimizer_params={"learning_rate": 0.01, "momentum": 0.9},
+                num_epoch=args.num_epochs)
+
+    metric = mx.metric.Accuracy()
+    mod_seq.score(val, metric)
+    print("sequential accuracy: %.3f" % metric.get()[1])
+    assert metric.get()[1] > 0.5
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""CIFAR-10 through the raw Module API.
+
+Capability parity with reference example/module/train_cifar10.py:1: the
+same task as example/image-classification/train_cifar10.py but driven by
+mx.mod.Module directly — explicit checkpoint load/resume (begin_epoch),
+top-k accuracy metric set, FactorScheduler lr decay, Speedometer, and
+do_checkpoint, sharing the image-classification data pipeline.
+"""
+import argparse
+import logging
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "image-classification"))
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_inception_bn_28small, get_resnet_cifar
+
+import train_model
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="train an image classifier on cifar10 (Module API)")
+    parser.add_argument("--network", type=str,
+                        default="inception-bn-28-small",
+                        choices=["inception-bn-28-small", "resnet"])
+    parser.add_argument("--data-dir", type=str, default="cifar10/")
+    parser.add_argument("--synthetic", action="store_true")
+    parser.add_argument("--tpus", type=str)
+    parser.add_argument("--gpus", type=str, help="alias of --tpus")
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--lr-factor", type=float, default=1)
+    parser.add_argument("--lr-factor-epoch", type=float, default=1)
+    parser.add_argument("--clip-gradient", type=float)
+    parser.add_argument("--model-prefix", type=str)
+    parser.add_argument("--save-model-prefix", type=str)
+    parser.add_argument("--num-epochs", type=int, default=20)
+    parser.add_argument("--load-epoch", type=int)
+    parser.add_argument("--kv-store", type=str, default="local")
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    kv = mx.kvstore.create(args.kv_store)
+    logging.basicConfig(
+        level=logging.DEBUG,
+        format="%(asctime)-15s Node[" + str(kv.rank) + "] %(message)s")
+    logging.info("start with arguments %s", args)
+    logging.info("running on %s", platform.node())
+
+    if args.network == "resnet":
+        net = get_resnet_cifar(depth=20, num_classes=10)
+    else:
+        net = get_inception_bn_28small(num_classes=10)
+
+    train, val = train_model.cifar_iterators(args, kv,
+                                             data_shape=(3, 28, 28),
+                                             mean_img=False)
+    gpus = args.tpus or args.gpus
+    devs = [mx.tpu(int(i)) for i in gpus.split(",")] if gpus else [mx.cpu()]
+    mod = mx.mod.Module(net, context=devs)
+
+    arg_params = aux_params = None
+    begin_epoch = 0
+    if args.load_epoch is not None:
+        assert args.model_prefix is not None
+        logging.info("loading model from %s-%d...",
+                     args.model_prefix, args.load_epoch)
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch + 1
+
+    save_prefix = args.save_model_prefix or args.model_prefix
+    checkpoint = mx.callback.do_checkpoint(save_prefix) if save_prefix \
+        else None
+
+    optim = {"learning_rate": args.lr, "wd": 0.00001, "momentum": 0.9}
+    if args.lr_factor < 1:
+        epoch_size = max(args.num_examples // args.batch_size, 1)
+        optim["lr_scheduler"] = mx.lr_scheduler.FactorScheduler(
+            step=max(int(epoch_size * args.lr_factor_epoch), 1),
+            factor=args.lr_factor)
+    if args.clip_gradient is not None:
+        optim["clip_gradient"] = args.clip_gradient
+
+    eval_metrics = ["accuracy"]
+    for top_k in (5,):          # 10 classes: top_k must stay below 10
+        eval_metrics.append(mx.metric.create("top_k_accuracy",
+                                             top_k=top_k))
+
+    logging.info("start training for %d epochs...", args.num_epochs)
+    mod.fit(train, eval_data=val, optimizer_params=optim,
+            eval_metric=eval_metrics, num_epoch=args.num_epochs,
+            arg_params=arg_params, aux_params=aux_params,
+            begin_epoch=begin_epoch, kvstore=kv,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+            epoch_end_callback=checkpoint)
+    print("MODULE-CIFAR10-DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,13 +29,45 @@ def default_text2id(sentence, vocab):
     return [vocab[w] for w in sentence.split(" ") if w and w in vocab]
 
 
+def perplexity_metric(label, pred):
+    """Time-major LM perplexity: label arrives (batch, seq) while pred is
+    the time-major flattened (seq*batch, vocab) softmax — transpose to
+    line them up.  Shared by the bucketed LM examples."""
+    label = np.asarray(label).T.reshape((-1,))
+    pred = np.asarray(pred)
+    probs = np.maximum(pred[np.arange(label.size), label.astype(int)],
+                       1e-10)
+    return float(np.exp(-np.log(probs).mean()))
+
+
+def synthetic_markov_corpus(path, vocab_size=200, n_tokens=30000, seed=7,
+                            stickiness=0.85, break_p=0.05):
+    """First-order Markov text with sentence breaks: each token strongly
+    predicts a fixed successor, so an LM has real signal to fit.  Stands
+    in for the PTB download on machines without egress."""
+    rng = np.random.RandomState(seed)
+    nxt = rng.randint(0, vocab_size, size=vocab_size)
+    toks, cur = [], 0
+    for _ in range(n_tokens):
+        cur = nxt[cur] if rng.rand() < stickiness \
+            else rng.randint(0, vocab_size)
+        toks.append("w%d" % cur)
+        if rng.rand() < break_p:
+            toks.append("\n")
+    with open(path, "w") as f:
+        f.write(" ".join(toks).replace(" \n ", "\n"))
+
+
 class BucketSentenceIter(DataIter):
     """Group sentences by length bucket (reference bucket_io.py)."""
 
     def __init__(self, path, vocab, buckets, batch_size, init_states,
                  data_name="data", label_name="softmax_label",
-                 text2id=None, read_content=None):
+                 text2id=None, read_content=None, model_parallel=False):
         super().__init__()
+        # model_parallel: emit time-major (seq_len, batch) raw arrays for
+        # the per-timestep executors in example/model-parallel-lstm
+        self.model_parallel = model_parallel
         self.vocab_size = len(vocab)
         self.data_name = data_name
         self.label_name = label_name
@@ -116,9 +148,13 @@ class BucketSentenceIter(DataIter):
         idx = self.bucket_curr_idx[i_bucket]
         self.bucket_curr_idx[i_bucket] += self.batch_size
         data = self.data[i_bucket][idx:idx + self.batch_size]
+        seq_len = self.buckets[i_bucket]
+        if self.model_parallel:
+            # time-major raw rows; the consumer derives labels by shifting
+            return DataBatch(data=data.T.copy(), label=None, pad=0,
+                             bucket_key=seq_len)
         label = np.zeros_like(data)
         label[:, :-1] = data[:, 1:]
-        seq_len = self.buckets[i_bucket]
         data_all = [mx.nd.array(data)] + self.init_state_arrays
         label_all = [mx.nd.array(label)]
         data_names = [self.data_name] + [x[0] for x in self.init_states]

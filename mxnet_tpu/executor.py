@@ -273,6 +273,12 @@ class Executor:
                 out_grads = [out_grads]
             head_grads = [g._get() if isinstance(g, NDArray) else jnp.asarray(g)
                           for g in out_grads]
+            if len(head_grads) < len(self._outputs_nd):
+                # reference pads unsupplied head grads with zeros — callers
+                # commonly grad only the loss heads of a Group whose tail
+                # outputs (BlockGrad'd states) take no gradient
+                head_grads += [jnp.zeros_like(o._get())
+                               for o in self._outputs_nd[len(head_grads):]]
             if self._ctx is not None:
                 # caller-made head grads may live on another device (e.g.
                 # default-device TPU arrays fed to a cpu-ctx executor) —

@@ -485,6 +485,10 @@ class SoftmaxOutputOp(OpDef):
               Param("ignore_label", float, default=-1.0),
               Param("multi_output", bool, default=False),
               Param("use_ignore", bool, default=False),
+              # prob_label: label is a dense distribution shaped like the
+              # output (reference softmax.cc's deprecated Softmax form,
+              # used by the autoencoder example's softmax decoder)
+              Param("prob_label", bool, default=False),
               Param("normalization", str, default="null",
                     enum=["null", "batch", "valid"])]
 
@@ -495,7 +499,9 @@ class SoftmaxOutputOp(OpDef):
         d = in_shapes[0]
         if d is None:
             return in_shapes, [None], []
-        if p.multi_output:
+        if p.prob_label:
+            lshape = d
+        elif p.multi_output:
             lshape = (d[0],) + tuple(d[2:])
         else:
             lshape = (d[0],)
@@ -552,7 +558,12 @@ class _RegressionBase(OpDef):
         d = in_shapes[0]
         if d is None:
             return in_shapes, [None], []
-        if len(d) == 2 and d[1] == 1:
+        l = in_shapes[1] if len(in_shapes) > 1 else None
+        if l is not None and int(np.prod(l)) == int(np.prod(d)):
+            # reference accepts any label layout with matching element
+            # count ((N,1) vs (N,)); the backward reshapes to out.shape
+            lshape = l
+        elif len(d) == 2 and d[1] == 1:
             lshape = (d[0],)
         else:
             lshape = d

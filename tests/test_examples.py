@@ -143,6 +143,142 @@ def test_neural_style_generator_v4(tmp_path):
     assert "BOOST-TRAIN-OK" in res.stdout
 
 
+def test_bdk_toy_sgld_and_hmc(tmp_path):
+    """Bayesian dark-knowledge demos: toy-regression SGLD and HMC both
+    run their sampler loops and report a posterior-predictive MSE."""
+    res = _run("example/bayesian-methods",
+               ["bdk_demo.py", "-d", "0", "-l", "1", "--iters", "200"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "SGLD iter" in res.stderr + res.stdout
+
+    res = _run("example/bayesian-methods",
+               ["bdk_demo.py", "-d", "0", "-l", "3", "--iters", "12"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "accept ratio" in res.stderr + res.stdout
+
+
+def test_bdk_synthetic_sgld_posterior(tmp_path):
+    """The SGLD-paper synthetic posterior demo writes its draws and the
+    chain stays in the posterior's support."""
+    import numpy as np
+    res = _run("example/bayesian-methods",
+               ["bdk_demo.py", "-d", "2", "--iters", "800"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    draws = np.loadtxt(os.path.join(ROOT, "example/bayesian-methods",
+                                    "synthetic_sgld_samples.txt"))
+    assert draws.shape == (800, 2)
+    assert np.all(np.isfinite(draws))
+    # theta1 mode near 0, theta2 near 1 (loose: short chain)
+    assert abs(draws[500:, 0].mean()) < 3.0
+
+
+def test_module_sequential_and_python_loss():
+    """SequentialModule wiring: symbol->symbol chain, and a
+    PythonLossModule with a numpy multiclass-hinge gradient."""
+    res = _run("example/module", ["sequential_module.py",
+                                  "--num-epochs", "2"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "sequential accuracy" in res.stdout
+
+    res = _run("example/module", ["python_loss.py", "--num-epochs", "3"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    import re
+    m = re.search(r"hinge-trained accuracy: ([0-9.]+)", res.stdout)
+    assert m and float(m.group(1)) > 0.8, res.stdout + res.stderr
+
+
+def test_module_lstm_bucketing_scores(tmp_path):
+    """module/lstm_bucketing: BucketingModule fit + post-fit score on
+    the validation iterator."""
+    res = _run("example/module",
+               ["lstm_bucketing.py", "--synthetic", "--num-epochs", "1",
+                "--batch-size", "8", "--num-hidden", "32", "--num-embed",
+                "16", "--buckets", "8", "16",
+                "--train", str(tmp_path / "c.txt"),
+                "--valid", str(tmp_path / "v.txt")], timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "SCORED Perplexity" in res.stdout
+
+
+def test_model_parallel_lstm_ptb(tmp_path):
+    """model-parallel-lstm: per-layer ctx_group placement over 2 devices,
+    bucketed time-major batches, grad-clip training, val perplexity."""
+    res = _run("example/model-parallel-lstm",
+               ["lstm_ptb.py", "--synthetic", "--tokens", "1200",
+                "--num-lstm-layer", "2", "--num-hidden", "32",
+                "--num-embed", "16", "--num-round", "1", "--batch-size",
+                "4", "--buckets", "4", "8", "--dropout", "0",
+                "--train", str(tmp_path / "t.txt"),
+                "--valid", str(tmp_path / "v.txt")], timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "FINAL-VAL-PERP" in res.stdout
+
+
+def test_bi_lstm_sort_pipeline(tmp_path):
+    """bi-lstm-sort: text corpus -> buckets -> FeedForward training ->
+    checkpoint -> stateful inference CLI."""
+    train = str(tmp_path / "sort.train.txt")
+    prefix = str(tmp_path / "sort")
+    res = _run("example/bi-lstm-sort",
+               ["lstm_sort.py", "--synthetic", "--batch-size", "32",
+                "--num-hidden", "48", "--num-embed", "32", "--num-epochs",
+                "1", "--seq-len", "5", "--vocab-size", "20",
+                "--num-examples", "600", "--train", train,
+                "--valid", str(tmp_path / "sort.valid.txt"),
+                "--model-prefix", prefix], timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "exact-sort accuracy" in res.stdout
+
+    res = _run("example/bi-lstm-sort",
+               ["infer_sort.py", "5", "2", "8", "1", "4", "--train", train,
+                "--model-prefix", prefix, "--num-hidden", "48",
+                "--num-embed", "32"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert len(res.stdout.strip().splitlines()) == 5
+
+
+def test_autoencoder_sae_pipeline(tmp_path):
+    """autoencoder: layerwise pretrain -> finetune -> save/load ->
+    reconstruction eval through the raw-executor Solver."""
+    res = _run("example/autoencoder",
+               ["mnist_sae.py", "--dims", "784", "128", "32",
+                "--batch-size", "128", "--pretrain-iters", "40",
+                "--finetune-iters", "60", "--lr-step", "50",
+                "--num-examples", "2000",
+                "--save", str(tmp_path / "sae.arg")], timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "Validation error" in res.stdout
+
+
+def test_cnn_text_raw_executor(tmp_path):
+    """cnn_text_classification: data_helpers polarity pipeline + the
+    raw-executor train loop with grad clipping reaches signal."""
+    d = str(tmp_path / "rtpol")
+    code = ("import sys; sys.argv=['x']; "
+            "import data_helpers, text_cnn; "
+            "data_helpers.gen_polarity_files(%r, n_each=300); "
+            "acc = text_cnn.train_without_pretrained_embedding("
+            "batch_size=32, epoch=1, num_embed=32, data_dir=%r); "
+            "print('FINAL-DEV-ACC %%.2f' %% acc)" % (d, d))
+    res = _run("example/cnn_text_classification", ["-c", code],
+               timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "FINAL-DEV-ACC" in res.stdout
+
+
+@pytest.mark.slow
+def test_bdk_mnist_distilled_sgld():
+    """Teacher/student distillation runs on the synthetic MNIST stand-in
+    and the student reaches better-than-chance accuracy."""
+    import re
+    res = _run("example/bayesian-methods",
+               ["bdk_demo.py", "-d", "1", "-l", "2", "-t", "2000",
+                "--iters", "400"], timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    accs = re.findall(r"student \d+/\d+=([0-9.]+)", res.stderr + res.stdout)
+    assert accs and float(accs[-1]) > 0.3, res.stderr + res.stdout
+
+
 @pytest.mark.slow
 def test_train_cifar10_resnet_synthetic():
     """The 6n+2 CIFAR residual network (reference

@@ -6,5 +6,7 @@ pytestmark = tpu_gate()
 
 from test_fused import *  # noqa: F401,F403,E402
 
-# needs the 8-device CPU mesh; the TPU session exposes a single host device
+# need the 8-device CPU mesh; the TPU session exposes a single host device
 del test_fused_multi_device_matches_single  # noqa: F821
+del test_sharded_weight_update_matches_replicated  # noqa: F821
+del test_sharded_update_survives_classic_fallback  # noqa: F821
