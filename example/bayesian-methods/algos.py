@@ -58,7 +58,6 @@ def step_HMC(exe, exe_params, exe_grads, label_key, noise_precision,
     """One Hamiltonian Monte Carlo transition: momentum refresh, L
     leapfrog steps, Metropolis accept/reject (reference algos.py:33)."""
     start = {k: v.copyto(v.context) for k, v in exe_params.items()}
-    pos = {k: v.copyto(v.context) for k, v in exe_params.items()}
     mom0 = {k: np.random.randn(*v.shape).astype(np.float32)
             for k, v in exe_params.items()}
     mom = {k: m.copy() for k, m in mom0.items()}
@@ -69,7 +68,9 @@ def step_HMC(exe, exe_params, exe_grads, label_key, noise_precision,
 
     # Leapfrog: half momentum kick, L position drifts with full kicks
     # between them, closing half kick folded into the last iteration.
-    exe.copy_params_from(pos)
+    # calc_potential left `start` resident in the executor, which is the
+    # trajectory's starting point — integrate exe_params in place.
+    exe.copy_params_from(start)
     g = _grads_at_current(exe, exe_grads)
     for k in mom:
         mom[k] -= 0.5 * eps * g[k]

@@ -1,94 +1,253 @@
-"""DCGAN training (reference example/gan/dcgan.py capability).
+"""DCGAN training.
 
-Generator and discriminator trained adversarially with the Module API;
-the generator gradient comes from the discriminator's input grads
-(inputs_need_grad=True), exactly the reference flow.
+Capability parity with reference example/gan/dcgan.py:1: generator and
+discriminator Modules trained adversarially — D sees fake (label 0)
+then real (label 1) with gradients accumulated across the two passes,
+G's gradient arrives through D's input grads (inputs_need_grad=True).
+Includes the RandIter noise source, an ImageRecordIter-backed imagenet
+iterator, an MNIST-like synthetic dataset (the reference fetched MNIST
+via sklearn + cv2 resize; this image has no egress), binary-accuracy /
+cross-entropy metrics, PNG sample grids (PIL, replacing the reference's
+cv2.imshow), and per-epoch checkpointing.
 """
 import argparse
 import logging
 import os
 import sys
+from datetime import datetime
 
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import mxnet_tpu as mx
-from mxnet_tpu.models.dcgan import make_generator, make_discriminator
 from mxnet_tpu.io import DataBatch
+from mxnet_tpu.models.dcgan import make_generator, make_discriminator
+
+
+def get_mnist(image_size=64, n=8192, seed=0):
+    """MNIST stand-in: 10 class-coded blob templates upsampled to
+    (3, size, size), range [-1, 1] (reference dcgan.py:55)."""
+    rng = np.random.RandomState(seed)
+    base = rng.rand(10, 16, 16).astype(np.float32)
+    y = rng.randint(0, 10, size=n)
+    imgs = base[y] + 0.15 * rng.randn(n, 16, 16).astype(np.float32)
+    reps = image_size // 16
+    imgs = imgs.repeat(reps, axis=1).repeat(reps, axis=2)
+    imgs = np.clip(imgs, 0, 1) * 2.0 - 1.0
+    return np.tile(imgs[:, None], (1, 3, 1, 1))
+
+
+class RandIter(mx.io.DataIter):
+    """Endless N(0,1) code batches (reference dcgan.py:72)."""
+
+    def __init__(self, batch_size, ndim):
+        super().__init__()
+        self.batch_size, self.ndim = batch_size, ndim
+        self.provide_data = [("rand", (batch_size, ndim, 1, 1))]
+        self.provide_label = []
+
+    def iter_next(self):
+        return True
+
+    def getdata(self):
+        return [mx.random.normal(0, 1.0,
+                                 shape=(self.batch_size, self.ndim, 1, 1))]
+
+    def getlabel(self):
+        return []
+
+    def getpad(self):
+        return 0
+
+
+class ImagenetIter(mx.io.DataIter):
+    """RecordIO-backed real-image source scaled to [-1, 1] (reference
+    dcgan.py:85)."""
+
+    def __init__(self, path, batch_size, data_shape):
+        super().__init__()
+        self.internal = mx.io.ImageRecordIter(
+            path_imgrec=path, data_shape=data_shape,
+            batch_size=batch_size, rand_crop=True, rand_mirror=True)
+        self.provide_data = [("data", (batch_size,) + data_shape)]
+        self.provide_label = []
+
+    def reset(self):
+        self.internal.reset()
+
+    def next(self):
+        # ImageRecordIter exposes batches through next(), not getdata()
+        batch = self.internal.next()
+        from mxnet_tpu.io import DataBatch
+        scaled = [d * (2.0 / 255.0) - 1.0 for d in batch.data]
+        return DataBatch(data=scaled, label=[], pad=batch.pad, index=None)
+
+    def iter_next(self):
+        return self.internal.iter_next()
+
+
+def fill_buf(buf, i, img, shape):
+    m = buf.shape[1] // shape[0]
+    sx = (i % m) * shape[0]
+    sy = (i // m) * shape[1]
+    buf[sy:sy + shape[1], sx:sx + shape[0], :] = img
+
+
+def visual(title, X, out_dir="."):
+    """Tile a (N, C, H, W) batch into one PNG grid (reference
+    dcgan.py:119 showed it with cv2; headless here)."""
+    from PIL import Image
+    X = X.transpose((0, 2, 3, 1))
+    X = np.clip((X + 1.0) * (255.0 / 2.0), 0, 255).astype(np.uint8)
+    n = int(np.ceil(np.sqrt(X.shape[0])))
+    buff = np.zeros((n * X.shape[1], n * X.shape[2], X.shape[3]),
+                    dtype=np.uint8)
+    for i, img in enumerate(X):
+        fill_buf(buff, i, img, X.shape[1:3])
+    path = os.path.join(out_dir, "%s.png" % title)
+    Image.fromarray(buff).save(path)
+    return path
+
+
+def facc(label, pred):
+    pred, label = pred.ravel(), label.ravel()
+    return float(((pred > 0.5) == label).mean())
+
+
+def fentropy(label, pred):
+    pred, label = pred.ravel(), label.ravel()
+    return float(-(label * np.log(pred + 1e-12) +
+                   (1.0 - label) * np.log(1.0 - pred + 1e-12)).mean())
 
 
 def main():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--dataset", choices=["mnist", "imagenet"],
+                        default="mnist")
+    parser.add_argument("--imgnet-path", default="./train.rec")
     parser.add_argument("--tpus", type=str)
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--code-dim", type=int, default=100)
-    parser.add_argument("--num-iters", type=int, default=200)
+    parser.add_argument("--ngf", type=int, default=64)
+    parser.add_argument("--ndf", type=int, default=64)
+    parser.add_argument("--num-epochs", type=int, default=100)
+    parser.add_argument("--num-examples", type=int, default=8192)
     parser.add_argument("--lr", type=float, default=0.0002)
-    parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--beta1", type=float, default=0.5)
+    parser.add_argument("--image-size", type=int, default=64,
+                        choices=[64],
+                        help="the DCGAN generator upsamples 4->64 in "
+                             "four fixed stride-2 stages")
+    parser.add_argument("--check-point", action="store_true")
+    parser.add_argument("--visualize-every", type=int, default=0,
+                        help="dump PNG grids every N iters (0=off)")
+    parser.add_argument("--out-dir", default=".")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    os.makedirs(args.out_dir, exist_ok=True)
     ctx = [mx.tpu(int(i)) for i in args.tpus.split(",")] if args.tpus \
         else [mx.cpu()]
     bs = args.batch_size
 
-    gen = mx.mod.Module(make_generator(code_dim=args.code_dim),
-                        data_names=("rand",), label_names=None, context=ctx)
-    gen.bind(data_shapes=[("rand", (bs, args.code_dim, 1, 1))],
-             label_shapes=None, for_training=True, inputs_need_grad=False)
-    gen.init_params(mx.init.Normal(0.02))
-    gen.init_optimizer(optimizer="adam",
-                       optimizer_params={"learning_rate": args.lr,
-                                         "beta1": 0.5})
+    if args.dataset == "mnist":
+        X_train = get_mnist(args.image_size, n=args.num_examples)
+        train_iter = mx.io.NDArrayIter(X_train, batch_size=bs)
+    else:
+        train_iter = ImagenetIter(args.imgnet_path, bs,
+                                  (3, args.image_size, args.image_size))
+    rand_iter = RandIter(bs, args.code_dim)
 
-    disc = mx.mod.Module(make_discriminator(),
+    modG = mx.mod.Module(
+        make_generator(ngf=args.ngf, code_dim=args.code_dim),
+        data_names=("rand",), label_names=None, context=ctx)
+    modG.bind(data_shapes=rand_iter.provide_data, label_shapes=None,
+              for_training=True)
+    modG.init_params(mx.init.Normal(0.02))
+    modG.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "wd": 0.0, "beta1": args.beta1})
+
+    modD = mx.mod.Module(make_discriminator(ndf=args.ndf),
                          data_names=("data",), label_names=("label",),
                          context=ctx)
-    disc.bind(data_shapes=[("data", (bs, 3, args.image_size, args.image_size))],
+    modD.bind(data_shapes=train_iter.provide_data,
               label_shapes=[("label", (bs,))],
               for_training=True, inputs_need_grad=True)
-    disc.init_params(mx.init.Normal(0.02))
-    disc.init_optimizer(optimizer="adam",
+    modD.init_params(mx.init.Normal(0.02))
+    modD.init_optimizer(optimizer="adam",
                         optimizer_params={"learning_rate": args.lr,
-                                          "beta1": 0.5})
+                                          "wd": 0.0, "beta1": args.beta1})
 
-    rng = np.random.RandomState(0)
-    for it in range(args.num_iters):
-        # synthetic "real" data stand-in; plug an ImageRecordIter here
-        real = rng.rand(bs, 3, args.image_size, args.image_size).astype("f") * 2 - 1
-        z = rng.randn(bs, args.code_dim, 1, 1).astype("f")
+    mG = mx.metric.CustomMetric(fentropy)
+    mD = mx.metric.CustomMetric(fentropy)
+    mACC = mx.metric.CustomMetric(facc)
+    stamp = datetime.now().strftime("%Y_%m_%d-%H_%M")
+    label = mx.nd.zeros((bs,))
 
-        # G forward
-        gen.forward(DataBatch(data=[mx.nd.array(z)], label=[]), is_train=True)
-        fake = gen.get_outputs()[0]
+    logging.info("Training...")
+    for epoch in range(args.num_epochs):
+        train_iter.reset()
+        for t, batch in enumerate(train_iter):
+            rbatch = rand_iter.next()
+            modG.forward(rbatch, is_train=True)
+            outG = modG.get_outputs()
 
-        # D on fake (label 0), backprop into inputs
-        disc.forward(DataBatch(data=[fake], label=[mx.nd.zeros((bs,))]),
-                     is_train=True)
-        disc.backward()
-        grad_d_fake = [[g.copy() for g in grads]
-                       for grads in disc._exec_group.grad_arrays]
-        # D on real (label 1)
-        disc.forward(DataBatch(data=[mx.nd.array(real)],
-                               label=[mx.nd.ones((bs,))]), is_train=True)
-        disc.backward()
-        # accumulate D grads (fake + real) then update
-        for gw, gf in zip(disc._exec_group.grad_arrays, grad_d_fake):
-            for a, b in zip(gw, gf):
-                if a is not None:
-                    a[:] = a + b
-        disc.update()
+            # D on fake: keep the grads, update later with real's
+            label[:] = 0
+            modD.forward(DataBatch(data=outG, label=[label]),
+                         is_train=True)
+            modD.backward()
+            gradD = [[g.copy() for g in grads]
+                     for grads in modD._exec_group.grad_arrays]
+            modD.update_metric(mD, [label])
+            modD.update_metric(mACC, [label])
 
-        # G step: D(fake) with label 1, take input grads back through G
-        disc.forward(DataBatch(data=[fake], label=[mx.nd.ones((bs,))]),
-                     is_train=True)
-        disc.backward()
-        diff = disc.get_input_grads()[0]
-        gen.backward([diff])
-        gen.update()
+            # D on real, grads accumulated across the two passes
+            label[:] = 1
+            modD.forward(DataBatch(data=batch.data, label=[label]),
+                         is_train=True)
+            modD.backward()
+            for gradsr, gradsf in zip(modD._exec_group.grad_arrays,
+                                      gradD):
+                for gr, gf in zip(gradsr, gradsf):
+                    if gr is not None:
+                        gr[:] = gr + gf
+            modD.update()
+            modD.update_metric(mD, [label])
+            modD.update_metric(mACC, [label])
 
-        if it % 20 == 0:
-            d_out = disc.get_outputs()[0].asnumpy()
-            logging.info("iter %d  D(G(z))=%.3f", it, d_out.mean())
+            # G step: D(G(z)) toward label 1, grads via D's inputs
+            label[:] = 1
+            modD.forward(DataBatch(data=outG, label=[label]),
+                         is_train=True)
+            modD.backward()
+            diffD = modD.get_input_grads()
+            modG.backward(diffD)
+            modG.update()
+            mG.update([label], modD.get_outputs())
+
+            if (t + 1) % 10 == 0:
+                logging.info("epoch %d iter %d  %s=%.3f  G-ent=%.3f  "
+                             "D-ent=%.3f", epoch, t + 1,
+                             mACC.get()[0], mACC.get()[1],
+                             mG.get()[1], mD.get()[1])
+                mACC.reset()
+                mG.reset()
+                mD.reset()
+            if args.visualize_every and \
+                    (t + 1) % args.visualize_every == 0:
+                visual("gout", outG[0].asnumpy(), args.out_dir)
+                visual("data", batch.data[0].asnumpy(), args.out_dir)
+
+        if args.check_point:
+            logging.info("Saving...")
+            modG.save_params(os.path.join(
+                args.out_dir, "%s_G_%s-%04d.params"
+                % (args.dataset, stamp, epoch)))
+            modD.save_params(os.path.join(
+                args.out_dir, "%s_D_%s-%04d.params"
+                % (args.dataset, stamp, epoch)))
+    print("DCGAN-DONE")
 
 
 if __name__ == "__main__":

@@ -1,16 +1,24 @@
 #!/usr/bin/env python
-"""NDSB2 preprocessing (reference example/kaggle-ndsb2/Preprocessing.py:
-DICOM MRI -> 64x64 30-frame csv rows + systole/diastole volume labels).
+"""NDSB2 preprocessing.
 
-Zero-egress: synthesizes beating-heart-like sequences (a disc whose radius
-oscillates over the frame axis; "volume" = min disc area) into the same csv
-contract the real pipeline produced:
+Capability parity with reference example/kaggle-ndsb2/Preprocessing.py:1
+(DICOM MRI -> 64x64 30-frame csv rows + volume labels).  Zero-egress:
+synthesizes beating-heart-like sequences (a disc whose radius oscillates
+over the frame axis) into the same csv contract the real pipeline
+produced:
 
-  train-64x64-data.csv : one row per study, 30*64*64 floats
-  train-systole.csv    : one row per study, 600 CDF targets
+  train-64x64-data.csv        one row per study, frames*size*size floats
+  train-label.csv             study_id, systole, diastole
+  train-systole.csv           600-step CDF of the systolic volume
+  train-diastole.csv          600-step CDF of the diastolic volume
+  validate-64x64-data.csv     rows for prediction (several per study)
+  validate-label.csv          study_id per validate row
+  data/sample_submission_validate.csv  the Kaggle submission skeleton
 
 Point the csv writers at real DICOM-decoded arrays for the actual
-competition data."""
+competition data.
+"""
+import csv
 import os
 import sys
 
@@ -18,7 +26,8 @@ import numpy as np
 
 
 def make_sequence(rng, frames=10, size=32):
-    """Disc with oscillating radius; returns (sequence, systole_volume)."""
+    """Disc with oscillating radius; returns (sequence, systole_volume,
+    diastole_volume) — min/max disc area over the cycle."""
     t = np.linspace(0, 2 * np.pi, frames)
     base = rng.uniform(size * 0.15, size * 0.3)
     amp = rng.uniform(2.0, size * 0.1)
@@ -29,29 +38,68 @@ def make_sequence(rng, frames=10, size=32):
     for f in range(frames):
         mask = (xx - cx) ** 2 + (yy - cy) ** 2 <= radii[f] ** 2
         seq[f] = mask * 200.0 + rng.randn(size, size) * 5.0
-    systole = float(np.pi * radii.min() ** 2)
-    return seq, systole
+    area = np.pi * radii ** 2
+    return seq, float(area.min()), float(area.max())
 
 
-def encode_csv(label_data):
-    return np.array([(x < np.arange(600)) for x in label_data],
-                    dtype=np.uint8)
+def encode_label(label_data):
+    """Volume scalars -> 600-step CDF targets (reference Train.py:52)."""
+    systole = label_data[:, 1]
+    diastole = label_data[:, 2]
+    enc = lambda vals: np.array([(x < np.arange(600)) for x in vals],
+                                dtype=np.uint8)
+    return enc(systole), enc(diastole)
 
 
-def main(num_studies=32, frames=10, size=32):
+def encode_csv(label_csv, systole_csv, diastole_csv):
+    systole, diastole = encode_label(
+        np.loadtxt(label_csv, delimiter=","))
+    np.savetxt(systole_csv, systole, delimiter=",", fmt="%g")
+    np.savetxt(diastole_csv, diastole, delimiter=",", fmt="%g")
+
+
+def main(num_train=32, num_validate=8, views_per_study=2, frames=10,
+         size=32):
     here = os.path.dirname(os.path.abspath(__file__))
     rng = np.random.RandomState(0)
-    seqs, vols = [], []
-    for _ in range(num_studies):
-        seq, systole = make_sequence(rng, frames, size)
-        seqs.append(seq.reshape(-1))
-        vols.append(systole)
+
+    rows, labels = [], []
+    for sid in range(num_train):
+        seq, sys_v, dia_v = make_sequence(rng, frames, size)
+        rows.append(seq.reshape(-1))
+        labels.append((sid, sys_v, dia_v))
     np.savetxt(os.path.join(here, "train-64x64-data.csv"),
-               np.stack(seqs), delimiter=",", fmt="%.2f")
-    np.savetxt(os.path.join(here, "train-systole.csv"),
-               encode_csv(np.asarray(vols)), delimiter=",", fmt="%d")
-    print("wrote %d studies (%d frames, %dx%d)" % (num_studies, frames,
-                                                   size, size))
+               np.stack(rows), delimiter=",", fmt="%.2f")
+    np.savetxt(os.path.join(here, "train-label.csv"),
+               np.asarray(labels), delimiter=",", fmt="%.4f")
+    encode_csv(os.path.join(here, "train-label.csv"),
+               os.path.join(here, "train-systole.csv"),
+               os.path.join(here, "train-diastole.csv"))
+
+    # validate: several views per study, id-per-row sidecar, submission
+    # skeleton with one Systole and one Diastole row per study
+    vrows, vids = [], []
+    for sid in range(num_validate):
+        for _ in range(views_per_study):
+            seq, _, _ = make_sequence(rng, frames, size)
+            vrows.append(seq.reshape(-1))
+            vids.append(sid)
+    np.savetxt(os.path.join(here, "validate-64x64-data.csv"),
+               np.stack(vrows), delimiter=",", fmt="%.2f")
+    with open(os.path.join(here, "validate-label.csv"), "w") as f:
+        f.write("\n".join(str(i) for i in vids) + "\n")
+
+    os.makedirs(os.path.join(here, "data"), exist_ok=True)
+    with open(os.path.join(here, "data",
+                           "sample_submission_validate.csv"), "w") as f:
+        w = csv.writer(f, lineterminator="\n")
+        w.writerow(["Id"] + ["P%d" % i for i in range(600)])
+        for sid in range(num_validate):
+            for tgt in ("Diastole", "Systole"):
+                w.writerow(["%d_%s" % (sid, tgt)] + [0] * 600)
+
+    print("wrote %d train / %d validate studies (%d frames, %dx%d)"
+          % (num_train, num_validate, frames, size, size))
 
 
 if __name__ == "__main__":

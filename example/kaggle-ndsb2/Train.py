@@ -1,13 +1,18 @@
 #!/usr/bin/env python
-"""Second National Data Science Bowl — cardiac volume estimation (reference
-example/kaggle-ndsb2/Train.py): LeNet-style net over frame DIFFERENCES of a
-30-frame MRI sequence, 600-way cumulative-distribution output trained with
-LogisticRegressionOutput, scored by CRPS.
+"""Second National Data Science Bowl — cardiac volume estimation.
 
-Data comes from CSVIter files produced by Preprocessing.py (run it first;
-zero-egress synthetic volumes by default, same csv contract as the real
-competition pipeline: each row = flattened 30x64x64 sequence / 600 CDF
-labels)."""
+Capability parity with reference example/kaggle-ndsb2/Train.py:1: a
+LeNet-style net over frame DIFFERENCES of the MRI sequence with a
+600-way cumulative-distribution (LogisticRegressionOutput) head scored
+by CRPS; separate systole and diastole models; per-study averaging of
+validate predictions; training-set histogram fallback for missing
+studies; monotonic submission encoding into submission.csv.
+
+Data comes from the csv files produced by Preprocessing.py (run it
+first; zero-egress synthetic volumes by default, same csv contract as
+the competition pipeline).
+"""
+import csv
 import logging
 import os
 import sys
@@ -17,9 +22,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import numpy as np
 import mxnet_tpu as mx
 
+HERE = os.path.dirname(os.path.abspath(__file__))
 
-def get_lenet(frames=30, size=64):
-    """Frame-difference LeNet (reference Train.py get_lenet)."""
+
+def get_lenet(frames=30):
+    """Frame-difference LeNet (reference Train.py:16)."""
     source = mx.sym.Variable("data")
     source = (source - 128) * (1.0 / 128)
     sliced = mx.sym.SliceChannel(source, num_outputs=frames)
@@ -28,11 +35,13 @@ def get_lenet(frames=30, size=64):
     net = mx.sym.Convolution(source, kernel=(5, 5), num_filter=40)
     net = mx.sym.BatchNorm(net, fix_gamma=True)
     net = mx.sym.Activation(net, act_type="relu")
-    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
     net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=40)
     net = mx.sym.BatchNorm(net, fix_gamma=True)
     net = mx.sym.Activation(net, act_type="relu")
-    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
     flatten = mx.sym.Flatten(net)
     flatten = mx.sym.Dropout(flatten)
     fc1 = mx.sym.FullyConnected(data=flatten, num_hidden=600)
@@ -40,45 +49,118 @@ def get_lenet(frames=30, size=64):
 
 
 def CRPS(label, pred):
-    """Continuous Ranked Probability Score on the 600-bin CDF."""
-    for i in range(pred.shape[0]):
-        for j in range(pred.shape[1] - 1):
-            if pred[i, j] > pred[i, j + 1]:
-                pred[i, j + 1] = pred[i, j]
-    return np.sum(np.square(label - pred)) / label.size
+    """Continuous Ranked Probability Score on the 600-bin CDF, with the
+    monotonicity projection applied first (reference Train.py:40)."""
+    pred = np.maximum.accumulate(np.asarray(pred), axis=1)
+    return float(np.sum(np.square(label - pred)) / label.size)
 
 
-def encode_label(label_data):
-    """Volume scalar -> 600-step CDF (reference encode_label)."""
-    systole = label_data[:, 1]
-    systole_encode = np.array([(x < np.arange(600)) for x in systole],
-                              dtype=np.uint8)
-    return systole_encode
+def train_cdf_model(label_csv, frames, size, batch_size, num_epoch, lr):
+    data_train = mx.io.CSVIter(
+        data_csv=os.path.join(HERE, "train-64x64-data.csv"),
+        data_shape=(frames, size, size),
+        label_csv=label_csv, label_shape=(600,), batch_size=batch_size)
+    model = mx.model.FeedForward(
+        ctx=[mx.cpu()], symbol=get_lenet(frames=frames),
+        num_epoch=num_epoch, learning_rate=lr, wd=0.00001, momentum=0.9)
+    model.fit(X=data_train, eval_metric=mx.metric.np(CRPS))
+    return model
+
+
+def accumulate_result(validate_lst, prob):
+    """Average the per-view predictions of each study (reference
+    Train.py:139)."""
+    sums, counts = {}, {}
+    with open(validate_lst) as f:
+        for i, line in enumerate(csv.reader(f)):
+            if i >= prob.shape[0]:
+                break
+            idx = int(float(line[0]))
+            if idx not in counts:
+                counts[idx] = 0.0
+                sums[idx] = np.zeros((1, prob.shape[1]))
+            counts[idx] += 1
+            sums[idx] += prob[i, :]
+    return {k: sums[k] / counts[k] for k in counts}
+
+
+def doHist(data):
+    """Empirical CDF of the training volumes — the fallback for studies
+    with no usable frames (reference Train.py:166)."""
+    h = np.zeros(600)
+    for j in np.ceil(data).astype(int):
+        h[min(max(j, 0), 599):] += 1
+    return h / len(data)
+
+
+def submission_helper(pred):
+    """Project onto a monotone CDF (reference Train.py:180)."""
+    p = np.asarray(pred).reshape(-1)[:600]
+    return np.maximum.accumulate(p)
+
+
+def write_submission(systole_result, diastole_result, hSystole,
+                     hDiastole, out_path):
+    sample = os.path.join(HERE, "data", "sample_submission_validate.csv")
+    with open(sample) as fin, open(out_path, "w") as fout:
+        fi = csv.reader(fin)
+        fo = csv.writer(fout, lineterminator="\n")
+        fo.writerow(next(fi))
+        for line in fi:
+            idx = line[0]
+            key, target = idx.split("_")
+            key = int(key)
+            out = [idx]
+            if key in systole_result:
+                result = diastole_result if target == "Diastole" \
+                    else systole_result
+                out.extend(list(submission_helper(result[key])))
+            else:
+                print("Miss: %s" % idx)
+                out.extend(hDiastole if target == "Diastole" else hSystole)
+            fo.writerow(out)
 
 
 def main():
     logging.basicConfig(level=logging.INFO)
     frames, size = 10, 32          # small default so the demo runs quickly
-    here = os.path.dirname(os.path.abspath(__file__))
-    dtrain = os.path.join(here, "train-64x64-data.csv")
-    ltrain = os.path.join(here, "train-systole.csv")
-    if not os.path.exists(dtrain):
+    batch_size = int(os.environ.get("NDSB2_BATCH", "4"))
+    num_epoch = int(os.environ.get("NDSB2_EPOCHS", "2"))
+    if not os.path.exists(os.path.join(HERE, "train-64x64-data.csv")):
         print("run Preprocessing.py first")
         return 1
 
-    data_train = mx.io.CSVIter(data_csv=dtrain,
-                               data_shape=(frames, size, size),
-                               label_csv=ltrain, label_shape=(600,),
-                               batch_size=4)
-    net = get_lenet(frames=frames, size=size)
-    mod = mx.mod.Module(net, context=mx.cpu(),
-                        label_names=("softmax_label",))
-    crps = mx.metric.np(CRPS, name="CRPS")
-    mod.fit(data_train, num_epoch=2, eval_metric=crps,
-            optimizer_params={"learning_rate": 0.01, "momentum": 0.9,
-                              "wd": 1e-4})
-    mod.save_params(os.path.join(here, "ndsb2-lenet.params"))
-    logging.info("done")
+    logging.info("training systole net...")
+    systole_model = train_cdf_model(
+        os.path.join(HERE, "train-systole.csv"), frames, size,
+        batch_size, num_epoch, lr=0.001)
+    logging.info("training diastole net...")
+    diastole_model = train_cdf_model(
+        os.path.join(HERE, "train-diastole.csv"), frames, size,
+        batch_size, num_epoch, lr=0.001)
+
+    data_validate = mx.io.CSVIter(
+        data_csv=os.path.join(HERE, "validate-64x64-data.csv"),
+        data_shape=(frames, size, size), batch_size=1)
+    systole_prob = systole_model.predict(data_validate)
+    data_validate.reset()
+    diastole_prob = diastole_model.predict(data_validate)
+
+    systole_result = accumulate_result(
+        os.path.join(HERE, "validate-label.csv"), systole_prob)
+    diastole_result = accumulate_result(
+        os.path.join(HERE, "validate-label.csv"), diastole_prob)
+
+    train_csv = np.genfromtxt(os.path.join(HERE, "train-label.csv"),
+                              delimiter=",")
+    hSystole = doHist(train_csv[:, 1])
+    hDiastole = doHist(train_csv[:, 2])
+
+    out_path = os.path.join(HERE, "submission.csv")
+    write_submission(systole_result, diastole_result, hSystole,
+                     hDiastole, out_path)
+    logging.info("wrote %s", out_path)
+    print("NDSB2-SUBMISSION-DONE")
 
 
 if __name__ == "__main__":

@@ -417,10 +417,13 @@ def sample_lstm(model, X_input_batch, seq_len, temperature=1.0,
                 sample=True, rng=None):
     """Autoregressive generation from the 1-step model: temperature
     sampling (vectorized gumbel draw instead of the reference's
-    per-row cdf walk, reference lstm.py:477) or greedy argmax."""
+    per-row cdf walk, reference lstm.py:477) or greedy argmax.
+
+    ``X_input_batch`` is time-major (1, batch) — the same layout
+    set_rnn_inputs expects — and is overwritten in place with each
+    generated step.  Returns a list of (batch,) token arrays."""
     rng = rng or np.random.RandomState(0)
     m = model
-    batch_size = m.seq_data[0].shape[0]
     outputs = []
     for _ in range(seq_len):
         set_rnn_inputs(m, X_input_batch, 0)
@@ -435,6 +438,6 @@ def sample_lstm(model, X_input_batch, seq_len, temperature=1.0,
             step_out = (logits + gumbel).argmax(axis=1)
         else:
             step_out = prob.argmax(axis=1)
-        outputs.append(step_out.astype(np.float32).reshape(batch_size, 1))
-        X_input_batch[:] = outputs[-1]
+        outputs.append(step_out.astype(np.float32))
+        X_input_batch[0, :] = outputs[-1]
     return outputs

@@ -1,0 +1,91 @@
+"""Image pre/post-processing for the neural-style pipelines.
+
+Capability parity with reference
+example/neural-style/end_to_end/data_processing.py:1 — content/style
+loading with short-edge resize + random crop, VGG mean handling, and
+save with optional denoising.  Built on PIL + a numpy total-variation
+denoiser (the reference used skimage, absent from this image).
+"""
+import logging
+import random
+
+import numpy as np
+
+VGG_MEAN = np.array([123.68, 116.779, 103.939], dtype=np.float32)
+
+
+def _load_rgb(path):
+    from PIL import Image
+    return np.asarray(Image.open(path).convert("RGB"), dtype=np.float32)
+
+
+def _resize(img, new_hw):
+    from PIL import Image
+    pil = Image.fromarray(img.astype(np.uint8))
+    return np.asarray(pil.resize((new_hw[1], new_hw[0]), Image.BILINEAR),
+                      dtype=np.float32)
+
+
+def _to_chw_meansub(sample):
+    sample = sample.transpose(2, 0, 1).copy()
+    sample -= VGG_MEAN[:, None, None]
+    return sample[None]
+
+
+def PreprocessContentImage(path, short_edge, dshape=None):
+    """Resize so the short edge is ``short_edge``; random-crop to dshape
+    when given (reference data_processing.py:9)."""
+    img = _load_rgb(path)
+    factor = float(short_edge) / min(img.shape[:2])
+    new_hw = (int(img.shape[0] * factor), int(img.shape[1] * factor))
+    sample = _resize(img, new_hw)
+    if dshape is not None:
+        xstart = random.randint(0, sample.shape[0] - dshape[2])
+        ystart = random.randint(0, sample.shape[1] - dshape[3])
+        sample = sample[xstart:xstart + dshape[2],
+                        ystart:ystart + dshape[3], :]
+    return _to_chw_meansub(sample)
+
+
+def PreprocessStyleImage(path, shape):
+    """Resize the style image to exactly the content shape (reference
+    data_processing.py:36)."""
+    img = _load_rgb(path)
+    return _to_chw_meansub(_resize(img, (shape[2], shape[3])))
+
+
+def PostprocessImage(img):
+    """(1,3,H,W) net output -> uint8 HWC image (reference
+    data_processing.py:48)."""
+    out = img.reshape(img.shape[-3:]).copy()
+    out += VGG_MEAN[:, None, None]
+    return np.clip(out.transpose(1, 2, 0), 0, 255).astype(np.uint8)
+
+
+def _tv_denoise(img, weight=0.02, n_iter=30):
+    """Chambolle-style total-variation smoothing in plain numpy (the
+    reference called skimage.restoration.denoise_tv_chambolle)."""
+    x = img.astype(np.float32) / 255.0
+    u = x.copy()
+    px = np.zeros_like(u)
+    py = np.zeros_like(u)
+    tau, inv_w = 0.125, 1.0 / max(weight, 1e-8)
+    for _ in range(n_iter):
+        gx = np.roll(u, -1, axis=1) - u
+        gy = np.roll(u, -1, axis=0) - u
+        px_new = px + (tau * inv_w) * gx
+        py_new = py + (tau * inv_w) * gy
+        norm = np.maximum(1.0, np.sqrt(px_new ** 2 + py_new ** 2))
+        px, py = px_new / norm, py_new / norm
+        div = (px - np.roll(px, 1, axis=1)) + (py - np.roll(py, 1, axis=0))
+        u = x + weight * div
+    return np.clip(u * 255.0, 0, 255).astype(np.uint8)
+
+
+def SaveImage(img, filename, remove_noise=0.02):
+    from PIL import Image
+    logging.info("save output to %s", filename)
+    out = PostprocessImage(img)
+    if remove_noise:
+        out = _tv_denoise(out, weight=remove_noise)
+    Image.fromarray(out).save(filename)

@@ -1,0 +1,30 @@
+"""MNIST-like iterator pair for the custom-op examples.
+
+Capability parity with reference example/numpy-ops/data.py:1 (which
+wrapped the downloaded MNIST in MNISTIter); generates the synthetic
+784-d 10-class stand-in used across this example tree.
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+
+def mnist_iterator(batch_size, input_shape, n=6000, seed=0):
+    """Returns (train, val) NDArrayIters shaped like the reference's
+    MNIST pipeline."""
+    rng = np.random.RandomState(seed)
+    means = 2.0 * rng.randn(10, int(np.prod(input_shape))).astype("f")
+    y = rng.randint(0, 10, size=n)
+    X = (means[y] + rng.randn(n, means.shape[1]).astype("f")) \
+        .reshape((n,) + tuple(input_shape))
+    y = y.astype(np.float32)
+    cut = int(n * 5 / 6)
+    flat = X.reshape(n, -1) if len(input_shape) == 1 else X
+    train = mx.io.NDArrayIter(flat[:cut], y[:cut], batch_size=batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(flat[cut:], y[cut:], batch_size=batch_size)
+    return train, val

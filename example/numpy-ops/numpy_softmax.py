@@ -1,0 +1,65 @@
+"""MLP trained through a NumpyOp softmax, standalone driver.
+
+Capability parity with reference example/numpy-ops/numpy_softmax.py:1
+(custom_softmax.py in this tree additionally shows the CustomOp
+generation; this file keeps the reference's single-op driver shape over
+the shared data.py iterator pair).
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+from data import mnist_iterator
+
+
+class NumpySoftmax(mx.operator.NumpyOp):
+    def __init__(self):
+        super().__init__(False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]]
+
+    def forward(self, in_data, out_data):
+        x, y = in_data[0], out_data[0]
+        y[:] = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        label = in_data[1].reshape(-1).astype(int)
+        dx = in_grad[0]
+        dx[:] = out_data[0]
+        dx[np.arange(label.shape[0]), label] -= 1.0
+
+
+def main():
+    data = mx.symbol.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=128)
+    act1 = mx.symbol.Activation(data=fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(data=act1, name="fc2", num_hidden=64)
+    act2 = mx.symbol.Activation(data=fc2, name="relu2", act_type="relu")
+    fc3 = mx.symbol.FullyConnected(data=act2, name="fc3", num_hidden=10)
+    mlp = NumpySoftmax()(data=fc3, name="softmax")
+
+    train, val = mnist_iterator(batch_size=100, input_shape=(784,))
+    logging.basicConfig(level=logging.DEBUG)
+    model = mx.model.FeedForward(
+        ctx=mx.cpu(), symbol=mlp,
+        num_epoch=int(os.environ.get("NUMPY_SOFTMAX_EPOCHS", "5")),
+        learning_rate=0.1, momentum=0.9, wd=0.00001)
+    model.fit(X=train, eval_data=val)
+    print("NUMPY-SOFTMAX-DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""CTC-headed unrolled LSTM.
+
+Capability parity with reference example/warpctc/lstm.py:1: stacked
+LSTM over T steps, per-step class scores concatenated time-major into
+the (T*B, A) layout WarpCTC consumes, label cast/flattened in-graph.
+The cell comes from mxnet_tpu.models.lstm; the CTC loss/grad run inside
+the fused XLA program (plugins/warpctc.py) instead of a CUDA kernel.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+import mxnet_tpu.plugins.warpctc  # noqa: F401  (registers sym.WarpCTC)
+from mxnet_tpu.models.lstm import LSTMParam, LSTMState, lstm_cell
+
+lstm = lstm_cell  # reference-compatible alias
+
+
+def lstm_unroll(num_lstm_layer, seq_len, num_hidden, num_label,
+                batch_size, feat_dim, num_classes=11):
+    """data (batch, seq_len*feat_dim) -> stacked LSTM -> WarpCTC.
+
+    num_classes includes the blank at index 0 (11 = 10 digits + blank,
+    the reference's hardcoded FC width)."""
+    cells, states = [], []
+    for i in range(num_lstm_layer):
+        cells.append(LSTMParam(
+            i2h_weight=mx.sym.Variable("l%d_i2h_weight" % i),
+            i2h_bias=mx.sym.Variable("l%d_i2h_bias" % i),
+            h2h_weight=mx.sym.Variable("l%d_h2h_weight" % i),
+            h2h_bias=mx.sym.Variable("l%d_h2h_bias" % i)))
+        states.append(LSTMState(c=mx.sym.Variable("l%d_init_c" % i),
+                                h=mx.sym.Variable("l%d_init_h" % i)))
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    frames = mx.sym.Reshape(data, shape=(batch_size, seq_len, feat_dim))
+    steps = mx.sym.SliceChannel(frames, num_outputs=seq_len, axis=1,
+                                squeeze_axis=True)
+
+    cls_weight = mx.sym.Variable("cls_weight")
+    cls_bias = mx.sym.Variable("cls_bias")
+    step_scores = []
+    for t in range(seq_len):
+        h = steps[t]
+        for i in range(num_lstm_layer):
+            nxt = lstm_cell(num_hidden, indata=h, prev_state=states[i],
+                            param=cells[i], seqidx=t, layeridx=i)
+            h = nxt.h
+            states[i] = nxt
+        step_scores.append(mx.sym.FullyConnected(
+            data=h, weight=cls_weight, bias=cls_bias,
+            num_hidden=num_classes, name="t%d_cls" % t))
+
+    # time-major (T*B, A) for the CTC head; the plugin takes the
+    # (batch, label_length) 0-padded label directly (reference reshaped
+    # to warp-ctc's flat int layout instead)
+    pred = mx.sym.Concat(*step_scores, dim=0)
+    return mx.sym.WarpCTC(data=pred, label=label,
+                          label_length=num_label, input_length=seq_len)

@@ -1,0 +1,175 @@
+"""Captcha-style OCR with LSTM + CTC.
+
+Capability parity with reference example/warpctc/lstm_ocr.py:1: a
+variable-length (3-4 digit) string is rendered into an image, an LSTM
+scans the image columns, and WarpCTC aligns the unsegmented label; CTC
+greedy decode + exact-string accuracy drive evaluation.  The reference
+rendered through the `captcha` package + cv2 (not in this image), so
+images come from a deterministic synthetic glyph renderer with the same
+(batch, 80*30) column-major layout.
+"""
+import argparse
+import logging
+import os
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+import mxnet_tpu as mx
+
+from lstm import lstm_unroll
+
+SEQ_LENGTH = 80          # image columns = LSTM steps
+FEAT_DIM = 30            # image rows = per-step feature
+_GLYPHS = np.random.RandomState(1234).rand(10, FEAT_DIM, 18) > 0.55
+
+
+class SimpleBatch:
+    def __init__(self, data_names, data, label_names, label):
+        self.data, self.label = data, label
+        self.data_names, self.label_names = data_names, label_names
+        self.pad, self.index = 0, None
+
+    @property
+    def provide_data(self):
+        return [(n, x.shape) for n, x in zip(self.data_names, self.data)]
+
+    @property
+    def provide_label(self):
+        return [(n, x.shape) for n, x in zip(self.label_names, self.label)]
+
+
+def gen_rand():
+    """A random 3- or 4-digit string (reference lstm_ocr.py:32)."""
+    return "".join(str(random.randint(0, 9))
+                   for _ in range(random.randint(3, 4)))
+
+
+def render(buf, rng):
+    """Render the digit string into a (FEAT_DIM, SEQ_LENGTH) image:
+    fixed glyph bitmaps at jittered positions + noise, flattened
+    column-major so each LSTM step sees one column."""
+    img = np.zeros((FEAT_DIM, SEQ_LENGTH), np.float32)
+    x = 2 + rng.randint(0, 3)
+    for ch in buf:
+        g = _GLYPHS[int(ch)]
+        w = g.shape[1]
+        if x + w > SEQ_LENGTH:
+            break
+        img[:, x:x + w] += g
+        x += w + rng.randint(0, 3)
+    img += 0.2 * rng.randn(FEAT_DIM, SEQ_LENGTH).astype(np.float32)
+    return img.T.reshape(-1)          # (SEQ_LENGTH*FEAT_DIM,) column-major
+
+
+def get_label(buf):
+    """0-padded 1-based digit ids, width 4 (reference lstm_ocr.py:39)."""
+    ret = np.zeros(4)
+    for i, ch in enumerate(buf):
+        ret[i] = 1 + int(ch)
+    return ret
+
+
+class OCRIter(mx.io.DataIter):
+    """Generates `count` random captcha batches per epoch (reference
+    lstm_ocr.py:47)."""
+
+    def __init__(self, count, batch_size, num_label, init_states, seed=0):
+        super().__init__()
+        self.batch_size = batch_size
+        self.count = count
+        self.num_label = num_label
+        self.init_states = init_states
+        self.init_state_arrays = [mx.nd.zeros(x[1]) for x in init_states]
+        self.provide_data = [("data", (batch_size,
+                                       SEQ_LENGTH * FEAT_DIM))] + \
+            list(init_states)
+        self.provide_label = [("label", (batch_size, num_label))]
+        self.rng = np.random.RandomState(seed)
+
+    def __iter__(self):
+        state_names = [x[0] for x in self.init_states]
+        for _ in range(self.count):
+            data, label = [], []
+            for _ in range(self.batch_size):
+                num = gen_rand()
+                data.append(render(num, self.rng))
+                label.append(get_label(num))
+            yield SimpleBatch(
+                ["data"] + state_names,
+                [mx.nd.array(np.stack(data))] + self.init_state_arrays,
+                ["label"], [mx.nd.array(np.stack(label))])
+
+    def reset(self):
+        pass
+
+
+def ctc_label(p):
+    """Collapse repeats and drop blanks (reference lstm_ocr.py:85)."""
+    ret, prev = [], 0
+    for c in p:
+        if c != 0 and c != prev:
+            ret.append(c)
+        prev = c
+    return ret
+
+
+def make_accuracy(batch_size, seq_length):
+    """Exact-string CTC-decode accuracy (reference lstm_ocr.py:96)."""
+    def Accuracy(label, pred):
+        hit = 0.0
+        for i in range(batch_size):
+            path = [int(np.argmax(pred[k * batch_size + i]))
+                    for k in range(seq_length)]
+            decoded = ctc_label(path)
+            truth = [int(v) for v in label[i] if v != 0]
+            if decoded == truth:
+                hit += 1.0
+        return hit / batch_size
+    return Accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-hidden", type=int, default=100)
+    parser.add_argument("--num-lstm-layer", type=int, default=1)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.001)
+    parser.add_argument("--batches-per-epoch", type=int, default=100)
+    parser.add_argument("--model-prefix", default="ocr")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.DEBUG,
+                        format="%(asctime)-15s %(message)s")
+    random.seed(7)
+
+    num_label = 4
+    init_states = [("l%d_init_%s" % (l, s),
+                    (args.batch_size, args.num_hidden))
+                   for l in range(args.num_lstm_layer) for s in "ch"]
+    data_train = OCRIter(args.batches_per_epoch, args.batch_size,
+                         num_label, init_states, seed=0)
+    data_val = OCRIter(max(args.batches_per_epoch // 10, 2),
+                       args.batch_size, num_label, init_states, seed=1)
+
+    symbol = lstm_unroll(args.num_lstm_layer, SEQ_LENGTH,
+                         args.num_hidden, num_label,
+                         batch_size=args.batch_size, feat_dim=FEAT_DIM)
+    model = mx.model.FeedForward(
+        ctx=[mx.cpu()], symbol=symbol, num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=0.9, wd=0.00001,
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34))
+    print("begin fit")
+    model.fit(X=data_train, eval_data=data_val,
+              eval_metric=mx.metric.np(
+                  make_accuracy(args.batch_size, SEQ_LENGTH)),
+              batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                         50))
+    model.save(args.model_prefix)
+    print("OCR-TRAIN-DONE")
+
+
+if __name__ == "__main__":
+    main()

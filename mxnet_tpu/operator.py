@@ -39,6 +39,11 @@ class PythonOp:
     def get_symbol(self, *args, **kwargs):
         raise NotImplementedError("Must override this")
 
+    def __call__(self, *args, **kwargs):
+        # reference ops are applied by calling the instance
+        # (operator.py: __call__ = get_symbol)
+        return self.get_symbol(*args, **kwargs)
+
     def forward(self, in_data, out_data):
         raise NotImplementedError("Must override this")
 
@@ -76,9 +81,25 @@ class NumpyOp(PythonOp):
                 return op_ref.list_outputs()
 
             def infer_shape(self, p, in_shapes):
-                if any(s is None for s in in_shapes):
+                if in_shapes[0] is None:
                     return in_shapes, [None] * len(op_ref.list_outputs()), []
-                ins, outs = op_ref.infer_shape([list(s) for s in in_shapes])
+                # secondary inputs (labels) may be unknown — the op's own
+                # infer_shape derives them from the data shape, exactly
+                # the reference contract (operator.py PythonOp infer).
+                # Only that partial-shape case gets the lenient fallback;
+                # a raise with fully-known shapes is a real user bug and
+                # must propagate.
+                partial = any(s is None for s in in_shapes[1:])
+                shapes_arg = [list(s) if s is not None else None
+                              for s in in_shapes]
+                if partial:
+                    try:
+                        ins, outs = op_ref.infer_shape(shapes_arg)
+                    except Exception:
+                        return (in_shapes,
+                                [None] * len(op_ref.list_outputs()), [])
+                else:
+                    ins, outs = op_ref.infer_shape(shapes_arg)
                 return ([tuple(s) for s in ins], [tuple(s) for s in outs], [])
 
             def forward(self, p, inputs, aux, ctx):

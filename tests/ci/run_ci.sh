@@ -21,7 +21,7 @@
 set -e
 ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
 cd "$ROOT"
-STAGES="${STAGES:-lint build unit amalg dist smoke}"
+STAGES="${STAGES:-lint build unit examples amalg dist smoke}"
 
 for stage in $STAGES; do
   echo "=== ci: $stage ==="
@@ -33,10 +33,16 @@ for stage in $STAGES; do
       make all
       ;;
     unit)
-      # dist and amalgamation tests are owned by their dedicated stages;
-      # disjoint stages keep failures attributable and CI wall-clock flat
+      # dist, amalgamation, and example-corpus tests are owned by their
+      # dedicated stages; disjoint stages keep failures attributable and
+      # the unit gate's wall-clock flat
       python -m pytest tests/ -q --ignore=tests/test_dist.py \
-          --ignore=tests/test_amalgamation.py
+          --ignore=tests/test_amalgamation.py \
+          --ignore=tests/test_examples.py
+      ;;
+    examples)
+      # every example must run end-to-end in its synthetic CI-light mode
+      python -m pytest tests/test_examples.py -q
       ;;
     amalg)
       (cd amalgamation && make)

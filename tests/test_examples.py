@@ -11,10 +11,11 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(rel_dir, argv, timeout=420):
+def _run(rel_dir, argv, timeout=420, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = ""
+    env.update(extra_env or {})
     return subprocess.run([sys.executable] + argv, capture_output=True,
                           text=True, timeout=timeout, env=env,
                           cwd=os.path.join(ROOT, rel_dir))
@@ -264,6 +265,64 @@ def test_cnn_text_raw_executor(tmp_path):
                timeout=600)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "FINAL-DEV-ACC" in res.stdout
+
+
+def test_warpctc_ocr_trains(tmp_path):
+    """warpctc/lstm_ocr: synthetic captcha rendering, OCRIter, CTC
+    training with the exact-decode accuracy metric."""
+    res = _run("example/warpctc",
+               ["lstm_ocr.py", "--num-epochs", "1",
+                "--batches-per-epoch", "8", "--batch-size", "16",
+                "--num-hidden", "48",
+                "--model-prefix", str(tmp_path / "ocr")], timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OCR-TRAIN-DONE" in res.stdout
+
+
+def test_dcgan_adversarial_loop(tmp_path):
+    """gan/dcgan: D-on-fake/D-on-real grad accumulation, G through D's
+    input grads, PNG sample grids, checkpointing."""
+    res = _run("example/gan",
+               ["dcgan.py", "--num-epochs", "1", "--num-examples", "384",
+                "--batch-size", "32", "--ngf", "16", "--ndf", "16",
+                "--visualize-every", "10", "--check-point",
+                "--out-dir", str(tmp_path)], timeout=600)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "DCGAN-DONE" in res.stdout
+    assert any(f.suffix == ".png" for f in tmp_path.iterdir())
+    assert any(f.suffix == ".params" for f in tmp_path.iterdir())
+
+
+def test_numpy_ops_softmax_drivers():
+    """numpy-ops: NumpyOp driver and the Rtc-kernel NDArrayOp driver
+    both train through their custom softmax."""
+    res = _run("example/numpy-ops", ["numpy_softmax.py"],
+               extra_env={"NUMPY_SOFTMAX_EPOCHS": "2"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "NUMPY-SOFTMAX-DONE" in res.stdout
+
+    res = _run("example/numpy-ops", ["ndarray_softmax.py"],
+               extra_env={"NDARRAY_SOFTMAX_EPOCHS": "2"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "NDARRAY-SOFTMAX-DONE" in res.stdout
+
+
+def test_ndsb2_end_to_end():
+    """kaggle-ndsb2: synthetic preprocessing, systole+diastole CDF nets,
+    per-study averaging, histogram fallback, monotone submission."""
+    res = _run("example/kaggle-ndsb2", ["Preprocessing.py"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    res = _run("example/kaggle-ndsb2", ["Train.py"], timeout=600,
+               extra_env={"NDSB2_EPOCHS": "1"})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "NDSB2-SUBMISSION-DONE" in res.stdout
+    sub = os.path.join(ROOT, "example/kaggle-ndsb2/submission.csv")
+    import numpy as np
+    with open(sub) as f:
+        lines = f.read().strip().splitlines()
+    assert len(lines) == 17          # header + 8 studies x 2 targets
+    row = np.array([float(v) for v in lines[1].split(",")[1:]])
+    assert np.all(np.diff(row) >= 0)      # monotone CDF
 
 
 @pytest.mark.slow
