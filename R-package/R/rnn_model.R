@@ -1,0 +1,121 @@
+# RNN model setup / training / stateful inference over raw executors
+# (reference R-package/R/rnn_model.R capability): bind an unrolled RNN
+# symbol with inferred shapes, run the truncated-BPTT training loop
+# with carried states, and drive a 1-step inference executor whose
+# final states feed back into the init-state slots.
+
+is.param.name <- function(name) {
+  grepl("weight$", name) || grepl("bias$", name) ||
+    grepl("gamma$", name) || grepl("beta$", name)
+}
+
+# Bind `rnn.sym` at (seq.len, batch.size) and initialize every param
+# with `initializer`; returns list(rnn.exec, symbol, init.states.name).
+mx.rnn.setup.model <- function(rnn.sym, ctx = mx.cpu(), seq.len,
+                               num.hidden, batch.size,
+                               init.states.name,
+                               initializer = mx.init.uniform(0.1)) {
+  shapes <- list(symbol = rnn.sym, ctx = ctx, grad.req = "add")
+  for (name in init.states.name)
+    shapes[[name]] <- c(batch.size, num.hidden)
+  shapes[["data"]] <- c(batch.size, seq.len)
+  shapes[["softmax_label"]] <- c(batch.size, seq.len)
+  exec <- do.call(mx.simple.bind, shapes)
+  for (name in names(exec$arg.arrays)) {
+    if (is.param.name(name)) {
+      arr <- as.array(exec$arg.arrays[[name]])
+      mx.exec.update.arg(exec, name, initializer(name, dim(arr)))
+    }
+  }
+  list(rnn.exec = exec, symbol = rnn.sym,
+       init.states.name = init.states.name)
+}
+
+calc.nll <- function(probs, batch.size) {
+  -sum(log(pmax(probs, 1e-10))) / batch.size
+}
+
+# Truncated-BPTT training over (data, label) arrays shaped
+# (num.batch, batch.size, seq.len): zero states per batch, forward,
+# nll bookkeeping, backward, clipped update, grads reset (grad.req=add).
+mx.rnn.train <- function(model, data, label, num.epoch = 1,
+                         learning.rate = 0.1, wd = 0,
+                         clip.gradient = 5) {
+  m <- model$rnn.exec
+  param.names <- Filter(is.param.name, names(m$arg.arrays))
+  batch.size <- dim(data)[2]
+  nll.final <- NA
+  for (epoch in seq_len(num.epoch)) {
+    nll <- 0
+    for (b in seq_len(dim(data)[1])) {
+      for (name in model$init.states.name) {
+        arr <- as.array(m$arg.arrays[[name]])
+        mx.exec.update.arg(m, name, arr * 0)
+      }
+      mx.exec.update.arg(m, "data", data[b, , ])
+      mx.exec.update.arg(m, "softmax_label", label[b, , ])
+      mx.exec.forward(m, is.train = TRUE)
+      out <- as.array(mx.exec.outputs(m)[[1]])
+      flat.label <- as.integer(t(label[b, , ])) + 1L
+      probs <- out[cbind(seq_along(flat.label), flat.label)]
+      nll <- nll + calc.nll(probs, batch.size)
+      mx.exec.backward(m)
+      for (name in param.names) {
+        g <- as.array(m$grad.arrays[[name]]) / batch.size
+        gn <- sqrt(sum(g * g))
+        if (gn > clip.gradient) g <- g * (clip.gradient / gn)
+        w <- as.array(m$arg.arrays[[name]])
+        mx.exec.update.arg(m, name, w - learning.rate * g)
+        mx.nd.copyto(m$grad.arrays[[name]],
+                     as.double(g * 0))   # reset accumulation
+      }
+    }
+    nll.final <- nll / dim(data)[1]
+    cat(sprintf("Epoch [%d] Train-NLL=%.4f Perp=%.4f\n", epoch,
+                nll.final, exp(nll.final)))
+  }
+  invisible(list(model = model, nll = nll.final))
+}
+
+# 1-step inference model: binds at seq.len=1, loads trained params,
+# and carries the extra state outputs back into the init slots
+# (reference rnn_model.R mx.rnn.inference).
+mx.rnn.inference <- function(rnn.sym, arg.params, num.hidden,
+                             init.states.name, ctx = mx.cpu()) {
+  shapes <- list(symbol = rnn.sym, ctx = ctx, grad.req = "null")
+  for (name in init.states.name)
+    shapes[[name]] <- c(1, num.hidden)
+  shapes[["data"]] <- c(1, 1)
+  exec <- do.call(mx.simple.bind, shapes)
+  for (name in names(arg.params)) {
+    if (!is.null(exec$arg.arrays[[name]]))
+      mx.nd.copyto(exec$arg.arrays[[name]],
+                   as.double(arg.params[[name]]))
+  }
+  structure(list(rnn.exec = exec, symbol = rnn.sym,
+                 init.states.name = init.states.name),
+            class = "MXRNNInference")
+}
+
+# One decode step: feeds `token`, returns class probabilities, folds
+# the state outputs (everything after output 1) back into init slots.
+mx.rnn.forward <- function(inf.model, token, new.seq = FALSE) {
+  m <- inf.model$rnn.exec
+  if (new.seq) {
+    for (name in inf.model$init.states.name) {
+      arr <- as.array(m$arg.arrays[[name]])
+      mx.exec.update.arg(m, name, arr * 0)
+    }
+  }
+  mx.exec.update.arg(m, "data", matrix(token, 1, 1))
+  mx.exec.forward(m, is.train = FALSE)
+  outs <- mx.exec.outputs(m)
+  if (length(outs) > 1) {
+    for (i in seq_along(inf.model$init.states.name)) {
+      state.name <- inf.model$init.states.name[[i]]
+      mx.nd.copyto(m$arg.arrays[[state.name]],
+                   as.double(as.array(outs[[i + 1]])))
+    }
+  }
+  as.array(outs[[1]])
+}

@@ -14,7 +14,8 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import mxnet_tpu as mx
-from symbol_fcnxs import get_fcn32s_symbol, get_fcn16s_symbol
+from symbol_fcnxs import get_fcn32s_symbol, get_fcn16s_symbol, \
+    get_fcn8s_symbol
 from init_fcnxs import init_fcnxs_args
 from solver import Solver
 from data import SyntheticSegIter
@@ -24,7 +25,7 @@ def main():
     logging.basicConfig(level=logging.INFO)
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="fcn32s",
-                        choices=["fcn32s", "fcn16s"])
+                        choices=["fcn32s", "fcn16s", "fcn8s"])
     parser.add_argument("--epochs", type=int, default=2)
     parser.add_argument("--num-classes", type=int, default=4)
     parser.add_argument("--size", type=int, default=64)
@@ -33,8 +34,8 @@ def main():
     args = parser.parse_args()
 
     ctx = mx.tpu(0) if args.tpus else mx.cpu()
-    builder = (get_fcn32s_symbol if args.model == "fcn32s"
-               else get_fcn16s_symbol)
+    builder = {"fcn32s": get_fcn32s_symbol, "fcn16s": get_fcn16s_symbol,
+               "fcn8s": get_fcn8s_symbol}[args.model]
     net = builder(numclass=args.num_classes)
 
     it = SyntheticSegIter(num_classes=args.num_classes, size=args.size)
@@ -42,11 +43,16 @@ def main():
     arg_shapes, _, _ = net.infer_shape(**shapes)
     arg_shapes_dict = dict(zip(net.list_arguments(), arg_shapes))
 
+    # each stage carries the previous, finer stage's weights:
+    # vgg16 -> fcn32s -> fcn16s -> fcn8s (reference run_fcnxs.sh recipe)
     carry = None
-    prev = "%s32s-0000.params" % args.prefix
-    if args.model == "fcn16s" and os.path.exists(prev):
-        carry, _ = mx.model.load_checkpoint("%s32s" % args.prefix, 0)[1:]
-        logging.info("carrying %d arrays from fcn32s", len(carry))
+    prev_stage = {"fcn16s": "32s", "fcn8s": "16s"}.get(args.model)
+    if prev_stage and os.path.exists(
+            "%s%s-0000.params" % (args.prefix, prev_stage)):
+        carry, _ = mx.model.load_checkpoint(
+            "%s%s" % (args.prefix, prev_stage), 0)[1:]
+        logging.info("carrying %d arrays from fcn%s", len(carry),
+                     prev_stage)
     arg_dict = init_fcnxs_args(net, arg_shapes_dict, carry)
 
     solver = Solver(net, ctx, arg_dict, learning_rate=1e-3)

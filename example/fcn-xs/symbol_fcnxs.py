@@ -2,7 +2,7 @@
 with 1x1 score heads and bilinear-upsampling deconvolution fusion.  The
 graph builders live in mxnet_tpu.models.fcn; this module keeps the
 reference example's entry points."""
-from mxnet_tpu.models.fcn import get_fcn32s, get_fcn16s
+from mxnet_tpu.models.fcn import get_fcn32s, get_fcn16s, get_fcn8s
 
 
 def get_fcn32s_symbol(numclass=21, workspace_default=1024):
@@ -11,3 +11,7 @@ def get_fcn32s_symbol(numclass=21, workspace_default=1024):
 
 def get_fcn16s_symbol(numclass=21, workspace_default=1024):
     return get_fcn16s(num_classes=numclass)
+
+
+def get_fcn8s_symbol(numclass=21, workspace_default=1024):
+    return get_fcn8s(num_classes=numclass)

@@ -115,7 +115,7 @@ def main():
             context=[mx.cpu()])
 
     mod.fit(data_train, eval_data=data_val, num_epoch=args.num_epochs,
-            eval_metric=mx.metric.np(Perplexity),
+            eval_metric=mx.metric.np(Perplexity, name="Perplexity"),
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
             initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
             optimizer="sgd",
@@ -123,7 +123,7 @@ def main():
                               "wd": 0.00001})
 
     # scoring reuses the bound bucket executors
-    metric = mx.metric.np(Perplexity)
+    metric = mx.metric.np(Perplexity, name="Perplexity")
     mod.score(data_val, metric)
     for name, val in metric.get_name_value():
         logging.info("Validation-%s=%f", name, val)

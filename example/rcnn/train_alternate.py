@@ -147,6 +147,14 @@ def main():
         mx.model.save_checkpoint("%s-rcnn" % args.model_prefix,
                                  args.epochs, rcnn2.symbol, p_rcnn,
                                  rcnn2.get_params()[1])
+        # fold both stages into one deployable blob, the reference
+        # recipe's closing combine_model step (train_alternate.py:175)
+        from utils.combine_model import combine_model
+        combine_model("%s-rpn" % args.model_prefix, args.epochs,
+                      "%s-rcnn" % args.model_prefix, args.epochs,
+                      "%s-final" % args.model_prefix, 0)
+        print("combined final model: %s-final-0000.params"
+              % args.model_prefix)
     if args.map_gate:
         assert mean_ap >= args.map_gate, \
             "mAP gate failed: %.4f < %.2f" % (mean_ap, args.map_gate)

@@ -1,0 +1,16 @@
+"""Merge two checkpoints into one (reference
+example/rcnn/utils/combine_model.py:1) — the alternate-training recipe
+ends by folding the RPN and RCNN stage weights into a single deployable
+'final' model; arrays in the first checkpoint win on name clashes."""
+from .load_model import load_checkpoint
+from .save_model import save_checkpoint
+
+
+def combine_model(prefix1, epoch1, prefix2, epoch2, prefix_out,
+                  epoch_out):
+    args1, auxs1 = load_checkpoint(prefix1, epoch1)
+    args2, auxs2 = load_checkpoint(prefix2, epoch2)
+    args = dict(args2, **args1)
+    auxs = dict(auxs2, **auxs1)
+    save_checkpoint(prefix_out, epoch_out, args, auxs)
+    return args, auxs

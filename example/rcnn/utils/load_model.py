@@ -1,0 +1,35 @@
+"""Checkpoint loading helpers (reference example/rcnn/utils/load_model.py:1)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+import mxnet_tpu as mx
+
+
+def load_checkpoint(prefix, epoch):
+    """Read a '<prefix>-<epoch>.params' blob into (arg, aux) dicts."""
+    saved = mx.nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for key, val in saved.items():
+        kind, name = key.split(":", 1)
+        if kind == "arg":
+            arg_params[name] = val
+        elif kind == "aux":
+            aux_params[name] = val
+    return arg_params, aux_params
+
+
+def convert_context(params, ctx):
+    """Rebase every array onto ``ctx`` (reference load_model.py:28)."""
+    return {k: v.as_in_context(ctx) for k, v in params.items()}
+
+
+def load_param(prefix, epoch, convert=False, ctx=None):
+    """load_checkpoint plus optional context conversion (reference
+    load_model.py:40)."""
+    arg_params, aux_params = load_checkpoint(prefix, epoch)
+    if convert:
+        ctx = ctx or mx.cpu()
+        arg_params = convert_context(arg_params, ctx)
+        aux_params = convert_context(aux_params, ctx)
+    return arg_params, aux_params

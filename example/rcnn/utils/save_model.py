@@ -1,0 +1,13 @@
+"""Checkpoint saving helper (reference example/rcnn/utils/save_model.py:1)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+import mxnet_tpu as mx
+
+
+def save_checkpoint(prefix, epoch, arg_params, aux_params):
+    """Write (arg, aux) dicts to '<prefix>-<epoch>.params'."""
+    blob = {"arg:%s" % k: v for k, v in arg_params.items()}
+    blob.update({"aux:%s" % k: v for k, v in aux_params.items()})
+    mx.nd.save("%s-%04d.params" % (prefix, epoch), blob)

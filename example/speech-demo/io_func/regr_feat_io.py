@@ -1,0 +1,49 @@
+"""Paired-stream reader for regression tasks (feature-mapping /
+denoising AMs whose targets are another feature stream, not labels).
+
+Capability parity with reference
+example/speech-demo/io_func/regr_feat_io.py:1: two label-less
+DataReadStreams advanced in lockstep — one over the input list, one
+over the output list — yielding (input_feats, target_feats) per
+utterance, with the same checkpoint get/set_state surface as the
+underlying streams.
+"""
+from .feat_io import DataReadStream
+
+
+class RegrDataReadStream:
+    def __init__(self, input_lst_file, output_lst_file, **stream_kwargs):
+        stream_kwargs["has_labels"] = False
+        seed = stream_kwargs.setdefault("seed", 0)
+        # both streams must shuffle identically to stay paired
+        stream_kwargs["seed"] = seed
+        self.input = DataReadStream(input_lst_file, **stream_kwargs)
+        self.output = DataReadStream(output_lst_file, **stream_kwargs)
+
+    @classmethod
+    def from_dataset_args(cls, dataset_args, n_ins=None):
+        """Reference-shaped constructor: a dict with input_lst_file /
+        output_lst_file keys (reference regr_feat_io.py:14)."""
+        args = dict(dataset_args)
+        ins = args.pop("input_lst_file")
+        outs = args.pop("output_lst_file")
+        args.pop("has_labels", None)
+        return cls(ins, outs, **args)
+
+    def reset(self):
+        self.input.reset()
+        self.output.reset()
+
+    def get_state(self):
+        return (self.input.get_state(), self.output.get_state())
+
+    def set_state(self, state):
+        self.input.set_state(state[0])
+        self.output.set_state(state[1])
+
+    def __iter__(self):
+        for (in_feats, _), (out_feats, _) in zip(self.input, self.output):
+            assert len(in_feats) == len(out_feats), \
+                "paired lists out of sync (%d vs %d frames)" % (
+                    len(in_feats), len(out_feats))
+            yield in_feats, out_feats

@@ -1,0 +1,164 @@
+"""Misc helpers for the speech pipeline.
+
+Capability parity with reference example/speech-demo/io_func/utils.py:1:
+bool/spec parsing, activation registry (jnp functions instead of the
+reference's theano ops), subprocess streaming, pickle-with-json-fallback
+persistence, and Kahan summation for long accumulations.
+"""
+import datetime
+import json
+import logging
+import os
+import pickle
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+
+def getRunDir():
+    return os.path.dirname(os.path.realpath(sys.argv[0]))
+
+
+def setup_logger(logging_ini=None):
+    """Banner-style run header (reference utils.py:10 read a
+    logging.ini; a basicConfig default serves the same purpose)."""
+    if logging_ini is not None:
+        logging.config.fileConfig(logging_ini)
+    else:
+        logging.basicConfig(level=logging.INFO,
+                            format="%(asctime)-15s %(message)s")
+    logger = logging.getLogger(__name__)
+    logger.info("*" * 50)
+    logger.info(datetime.datetime.now().strftime("%Y-%m-%d %H:%M"))
+    logger.info("Host:   %s", socket.gethostname())
+    logger.info("PWD:    %s", os.getenv("PWD", "unknown"))
+    logger.info("Cmd:    %s", sys.argv)
+    logger.info("*" * 50)
+    return logger
+
+
+def to_bool(obj):
+    text = str(obj).lower()
+    if text in ("true", "1"):
+        return True
+    if text in ("false", "0"):
+        return False
+    raise ValueError("to_bool: cannot convert %r to bool" % obj)
+
+
+def line_with_arg(line):
+    line = line.strip()
+    return line != "" and not line.startswith("#")
+
+
+def parse_conv_spec(conv_spec, batch_size):
+    """'1x29x29:100,5x5,p2x2:200,4x4,p2x2,f' -> per-layer config dicts
+    (reference utils.py:38)."""
+    structure = conv_spec.replace("X", "x").split(":")
+    configs = []
+    for i in range(1, len(structure)):
+        elements = structure[i].split(",")
+        if i == 1:
+            in_maps, in_x, in_y = (int(v) for v in structure[0].split("x"))
+        else:
+            prev = configs[-1]["output_shape"]
+            in_maps, in_x, in_y = prev[1], prev[2], prev[3]
+        out_maps = int(elements[0])
+        f_x, f_y = (int(v) for v in elements[1].split("x"))
+        p_x, p_y = (int(v) for v in
+                    elements[2].lower().replace("p", "").split("x"))
+        configs.append({
+            "input_shape": (batch_size, in_maps, in_x, in_y),
+            "filter_shape": (out_maps, in_maps, f_x, f_y),
+            "poolsize": (p_x, p_y),
+            "output_shape": (batch_size, out_maps,
+                             (in_x - f_x + 1) // p_x,
+                             (in_y - f_y + 1) // p_y),
+            "flatten": len(elements) == 4 and elements[3] == "f",
+        })
+    return configs
+
+
+# -- activation registry (jnp-backed; reference used theano ops) ---------
+def _relu(x):
+    import jax.numpy as jnp
+    return jnp.maximum(x, 0)
+
+
+def _capped_relu(x):
+    import jax.numpy as jnp
+    return jnp.minimum(jnp.maximum(x, 0), 6)
+
+
+def _sigmoid(x):
+    import jax
+    return jax.nn.sigmoid(x)
+
+
+def _tanh(x):
+    import jax.numpy as jnp
+    return jnp.tanh(x)
+
+
+def _linear(x):
+    return x
+
+
+_ACTIVATIONS = {"sigmoid": _sigmoid, "tanh": _tanh, "relu": _relu,
+                "capped_relu": _capped_relu, "linear": _linear}
+
+
+def parse_activation(act_str):
+    return _ACTIVATIONS.get(act_str, _sigmoid)
+
+
+def activation_to_txt(act_func):
+    for name, fn in _ACTIVATIONS.items():
+        if fn is act_func:
+            return name
+    return "unknown"
+
+
+def parse_two_integers(argument_str):
+    ints = argument_str.split(":")[1].split(",")
+    return int(ints[0]), int(ints[1])
+
+
+def run_command(command):
+    """Stream a shell command's stdout line by line (reference
+    utils.py:112)."""
+    fnull = open(os.devnull, "w")
+    p = subprocess.Popen(command, stdout=subprocess.PIPE, stderr=fnull,
+                         shell=True)
+    return p, iter(p.stdout.readline, b"")
+
+
+def pickle_load(filename):
+    with open(filename, "rb") as f:
+        try:
+            return pickle.load(f)
+        except Exception:
+            pass
+    with open(filename) as f:
+        logging.info("not a pickle, loading as json: %s", filename)
+        return json.load(f)
+
+
+def pickle_save(obj, filename):
+    with open(filename + ".new", "wb") as f:
+        pickle.dump(obj, f)
+    os.rename(filename + ".new", filename)
+
+
+def makedirs(path):
+    os.makedirs(path, exist_ok=True)
+
+
+def kahan_add(total, carry, inc):
+    """Compensated summation step (reference utils.py:146 used theano's
+    no-assoc adds; float64 numpy keeps the same guarantee on host)."""
+    cs = np.float64(carry) + np.float64(inc)
+    s = np.float64(total) + cs
+    return s, cs - (s - np.float64(total))

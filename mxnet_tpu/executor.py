@@ -273,20 +273,25 @@ class Executor:
                 out_grads = [out_grads]
             head_grads = [g._get() if isinstance(g, NDArray) else jnp.asarray(g)
                           for g in out_grads]
+            if len(head_grads) > len(self._outputs_nd):
+                raise MXNetError(
+                    "backward() got %d out_grads for %d outputs"
+                    % (len(head_grads), len(self._outputs_nd)))
             if len(head_grads) < len(self._outputs_nd):
                 # reference pads unsupplied head grads with zeros — callers
                 # commonly grad only the loss heads of a Group whose tail
                 # outputs (BlockGrad'd states) take no gradient
                 head_grads += [jnp.zeros_like(o._get())
                                for o in self._outputs_nd[len(head_grads):]]
-            if self._ctx is not None:
-                # caller-made head grads may live on another device (e.g.
-                # default-device TPU arrays fed to a cpu-ctx executor) —
-                # rebase them so one jit sees one platform, the analogue of
-                # the reference's head-grad CopyFromTo at bind
-                # (graph_executor.cc:1003-1027)
-                dev = self._ctx.jax_device()
-                head_grads = [jax.device_put(g, dev) for g in head_grads]
+            # caller-made head grads may live on another device (default-
+            # device arrays fed to a cpu-ctx executor, or — model parallel —
+            # a loss head living on a non-default device).  Rebase each onto
+            # ITS output's device so the vjp never mixes assignments: the
+            # analogue of the reference's head-grad CopyFromTo at bind
+            # (graph_executor.cc:1003-1027)
+            head_grads = [
+                jax.device_put(g, list(o._get().devices())[0])
+                for g, o in zip(head_grads, self._outputs_nd)]
         args, aux = self._args_jax(), self._aux_jax()
         gargs = {k: args[k] for k in self._grad_names}
         sargs = {k: v for k, v in args.items() if k not in gargs}

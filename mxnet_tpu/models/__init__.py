@@ -12,7 +12,7 @@ from .vgg import get_vgg
 from .lstm import (lstm_unroll, lstm_unroll_scan, lstm_cell,
                    LSTMState, LSTMParam)
 from .dcgan import make_generator, make_discriminator
-from .fcn import get_fcn32s, get_fcn16s
+from .fcn import get_fcn32s, get_fcn16s, get_fcn8s
 from .rcnn import get_fast_rcnn, get_rpn
 from .gru import gru_unroll, gru_cell, rnn_unroll, rnn_cell, GRUState, \
     GRUParam, RNNState, RNNParam
@@ -22,7 +22,7 @@ __all__ = ["get_mlp", "get_lenet", "get_resnet", "get_resnet50",
            "get_inception_bn", "get_inception_bn_28small", "get_vgg",
            "lstm_unroll", "lstm_unroll_scan", "lstm_cell", "LSTMState",
            "LSTMParam",
-           "make_generator", "make_discriminator", "get_fcn32s", "get_fcn16s",
+           "make_generator", "make_discriminator", "get_fcn32s", "get_fcn16s", "get_fcn8s",
            "get_fast_rcnn", "get_rpn", "gru_unroll", "gru_cell",
            "rnn_unroll", "rnn_cell", "GRUState", "GRUParam", "RNNState",
            "RNNParam"]

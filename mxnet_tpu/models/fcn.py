@@ -34,9 +34,10 @@ def get_fcn32s(num_classes=21):
                              ignore_label=255, name="softmax")
 
 
-def get_fcn16s(num_classes=21):
-    """16x head fusing pool4 (fcn-16s skip architecture)."""
-    data = sym.Variable("data")
+def _fused_pool4(data, num_classes):
+    """pool5 score upsampled 2x and fused with the pool4 score — the
+    skip connection shared by fcn16s and fcn8s; one definition keeps the
+    layer names identical so stage-carried weights keep matching."""
     feats = _vgg_trunk(data)
     score5 = sym.Convolution(feats["pool5"], kernel=(1, 1),
                              num_filter=num_classes, name="score5")
@@ -45,9 +46,33 @@ def get_fcn16s(num_classes=21):
     score4 = sym.Convolution(feats["pool4"], kernel=(1, 1),
                              num_filter=num_classes, name="score4")
     up2c = sym.Crop(up2, score4, num_args=2, center_crop=True, name="crop4")
-    fused = sym.ElementWiseSum(up2c, score4, name="fuse16")
+    return sym.ElementWiseSum(up2c, score4, name="fuse16"), feats
+
+
+def get_fcn16s(num_classes=21):
+    """16x head fusing pool4 (fcn-16s skip architecture)."""
+    data = sym.Variable("data")
+    fused, _ = _fused_pool4(data, num_classes)
     up16 = sym.UpSampling(fused, scale=16, sample_type="bilinear",
                           num_filter=num_classes, name="up16")
     up16 = sym.Crop(up16, data, num_args=2, center_crop=True, name="crop16")
     return sym.SoftmaxOutput(up16, multi_output=True, use_ignore=True,
+                             ignore_label=255, name="softmax")
+
+
+def get_fcn8s(num_classes=21):
+    """8x head fusing pool4 AND pool3 (fcn-8s, the finest-grained
+    variant; reference symbol_fcnxs.py get_fcn8s_symbol)."""
+    data = sym.Variable("data")
+    fused4, feats = _fused_pool4(data, num_classes)
+    up4 = sym.UpSampling(fused4, scale=2, sample_type="bilinear",
+                         num_filter=num_classes, name="up4")
+    score3 = sym.Convolution(feats["pool3"], kernel=(1, 1),
+                             num_filter=num_classes, name="score3")
+    up4c = sym.Crop(up4, score3, num_args=2, center_crop=True, name="crop3")
+    fused3 = sym.ElementWiseSum(up4c, score3, name="fuse8")
+    up8 = sym.UpSampling(fused3, scale=8, sample_type="bilinear",
+                         num_filter=num_classes, name="up8")
+    up8 = sym.Crop(up8, data, num_args=2, center_crop=True, name="crop8")
+    return sym.SoftmaxOutput(up8, multi_output=True, use_ignore=True,
                              ignore_label=255, name="softmax")

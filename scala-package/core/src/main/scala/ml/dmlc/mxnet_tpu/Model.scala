@@ -1,0 +1,50 @@
+package ml.dmlc.mxnet_tpu
+
+import java.io.{File, FileOutputStream, FileInputStream}
+
+/**
+ * Checkpoint interchange (reference Model.scala saveCheckpoint /
+ * loadCheckpoint): the symbol goes to '<prefix>-symbol.json', the
+ * parameters to '<prefix>-<epoch>.params' in the same arg:/aux: blob
+ * format the python, R, C++ and MATLAB bindings read — one trained
+ * model loads from any binding.
+ */
+object Model {
+
+  def saveCheckpoint(prefix: String, epoch: Int, symbol: Symbol,
+                     argParams: Map[String, NDArray],
+                     auxParams: Map[String, NDArray]): Unit = {
+    writeFile(s"$prefix-symbol.json", symbol.toJson.getBytes("UTF-8"))
+    val blob = argParams.map { case (k, v) => (s"arg:$k", v) } ++
+      auxParams.map { case (k, v) => (s"aux:$k", v) }
+    NDArray.save(f"$prefix-$epoch%04d.params", blob)
+  }
+
+  def loadCheckpoint(prefix: String, epoch: Int)
+      : (Symbol, Map[String, NDArray], Map[String, NDArray]) = {
+    val symbol = Symbol.loadJson(readFile(s"$prefix-symbol.json"))
+    val blob = NDArray.load(f"$prefix-$epoch%04d.params")
+    val arg = scala.collection.mutable.Map.empty[String, NDArray]
+    val aux = scala.collection.mutable.Map.empty[String, NDArray]
+    blob.foreach { case (key, nd) =>
+      key.split(":", 2) match {
+        case Array("arg", name) => arg(name) = nd
+        case Array("aux", name) => aux(name) = nd
+        case _ => // ignore unprefixed entries
+      }
+    }
+    (symbol, arg.toMap, aux.toMap)
+  }
+
+  private def writeFile(path: String, bytes: Array[Byte]): Unit = {
+    val out = new FileOutputStream(path)
+    try out.write(bytes) finally out.close()
+  }
+
+  private def readFile(path: String): String = {
+    val f = new File(path)
+    val buf = new Array[Byte](f.length.toInt)
+    val in = new FileInputStream(f)
+    try { in.read(buf); new String(buf, "UTF-8") } finally in.close()
+  }
+}

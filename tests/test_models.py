@@ -134,6 +134,11 @@ def test_fcn_shapes():
     _, outs, _ = net16.infer_shape(data=(1, 3, 64, 64),
                                    softmax_label=(1, 64, 64))
     assert outs[0] == (1, 5, 64, 64)
+    from mxnet_tpu.models.fcn import get_fcn8s
+    net8 = get_fcn8s(num_classes=5)
+    _, outs, _ = net8.infer_shape(data=(1, 3, 64, 64),
+                                  softmax_label=(1, 64, 64))
+    assert outs[0] == (1, 5, 64, 64)
 
 
 def test_fast_rcnn_forward_backward():

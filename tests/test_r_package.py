@@ -76,6 +76,7 @@ def test_r_surface_depth_and_call_targets():
         "model.R": ["model.R"], "mlp.R": ["mlp.R"], "rnn.R": ["rnn.R"],
         "lstm.R": ["lstm.R"], "gru.R": ["gru.R"],
         "viz.graph.R": ["viz.graph.R"],
+        "rnn_model.R": ["rnn_model.R"],
     }
     for f in counterparts:
         assert f in have, f

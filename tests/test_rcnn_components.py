@@ -122,17 +122,20 @@ def test_eval_detections_end_to_end():
 
 
 @pytest.mark.slow
-def test_train_alternate_end_to_end():
+def test_train_alternate_end_to_end(tmp_path):
     """The 4-step schedule runs CI-light and passes the mAP gate."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    prefix = os.path.join(str(tmp_path), "alt")
     res = subprocess.run(
         [sys.executable, "train_alternate.py", "--epochs", "5",
          "--train-images", "32", "--test-images", "8",
-         "--map-gate", "0.4"],
+         "--map-gate", "0.4", "--model-prefix", prefix],
         cwd=RCNN_DIR, env=env, capture_output=True, text=True, timeout=560)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "PASSED" in res.stdout, res.stdout + res.stderr
+    # the closing combine_model step folds both stages into one blob
+    assert os.path.exists(prefix + "-final-0000.params"), res.stdout
 
 
 @pytest.mark.slow

@@ -500,3 +500,64 @@ def test_data_read_stream_rejects_missing_labels(tmp_path):
     stream = DataReadStream(lst, "kaldi", partition_frames=8)
     with pytest.raises(ValueError, match="no labels"):
         stream.load_next_partition()
+
+
+def test_regr_stream_pairs_two_feature_lists(tmp_path):
+    """RegrDataReadStream: two label-less streams advanced in lockstep
+    yield paired (input, target) partitions with matching frame counts
+    and the same shuffle order."""
+    from io_func import write_ark_scp
+    from io_func.regr_feat_io import RegrDataReadStream
+
+    rng = np.random.RandomState(3)
+    utts_in = {"u%d" % i: rng.randn(5 + i, 4).astype(np.float32)
+               for i in range(4)}
+    # target = input * 2 so pairing is checkable after shuffling
+    utts_out = {k: (v * 2.0).astype(np.float32)
+                for k, v in utts_in.items()}
+    ark_i, ark_o = str(tmp_path / "in.ark"), str(tmp_path / "out.ark")
+    write_ark_scp(ark_i, utts_in, str(tmp_path / "in.scp"))
+    write_ark_scp(ark_o, utts_out, str(tmp_path / "out.scp"))
+    with open(tmp_path / "in.lst", "w") as f:
+        f.write("%s\n" % ark_i)
+    with open(tmp_path / "out.lst", "w") as f:
+        f.write("%s\n" % ark_o)
+
+    stream = RegrDataReadStream(str(tmp_path / "in.lst"),
+                                str(tmp_path / "out.lst"),
+                                partition_frames=11, shuffle=True, seed=5)
+    total = 0
+    for x, y in stream:
+        assert x.shape == y.shape
+        np.testing.assert_allclose(y, x * 2.0, rtol=1e-6)
+        total += len(x)
+    assert total == sum(len(v) for v in utts_in.values())
+
+
+def test_io_utils_parsers():
+    """utils.py: conv-spec parsing, bool coercion, activation registry,
+    pickle/json fallback round-trip."""
+    import json
+    from io_func import utils
+
+    cfgs = utils.parse_conv_spec("1x29x29:100,5x5,p2x2:200,4x4,p2x2,f",
+                                 batch_size=16)
+    assert cfgs[0]["input_shape"] == (16, 1, 29, 29)
+    assert cfgs[0]["filter_shape"] == (100, 1, 5, 5)
+    assert cfgs[0]["output_shape"] == (16, 100, 12, 12)
+    assert cfgs[1]["flatten"]
+    assert cfgs[1]["input_shape"] == (16, 100, 12, 12)
+
+    assert utils.to_bool("True") and not utils.to_bool("0")
+    assert utils.parse_two_integers("x:3,7") == (3, 7)
+    assert utils.activation_to_txt(utils.parse_activation("relu")) == \
+        "relu"
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "obj")
+        utils.pickle_save({"a": 1}, p)
+        assert utils.pickle_load(p) == {"a": 1}
+        with open(p, "w") as f:        # json fallback path
+            json.dump([1, 2], f)
+        assert utils.pickle_load(p) == [1, 2]
