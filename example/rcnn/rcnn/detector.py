@@ -32,9 +32,30 @@ class Detector:
 
     def detect(self, img, img_id=0):
         """Full two-stage detection -> {cls: [(img_id, score, box4)]}."""
-        cfg = self.cfg
         props, mask, _ = self.propose(img)
+        return self.classify_rois(img, props, img_id=img_id, mask=mask)
+
+    def classify_rois(self, img, props, img_id=0, mask=None):
+        """Head-only stage: classify+regress GIVEN rois (the reference's
+        HAS_RPN=False / precomputed-proposal eval path, tools/test_rcnn).
+        ``props`` is (R, 4); shorter sets are zero-padded to the
+        executor's static post_nms_top row count."""
+        cfg = self.cfg
         R = cfg.post_nms_top
+        props = np.asarray(props, np.float32)
+        if mask is None:
+            mask = np.zeros(R, np.float32)
+            mask[:min(len(props), R)] = 1.0
+        else:
+            # pad/trim a caller mask alongside props
+            mask = np.asarray(mask, np.float32).reshape(-1)[:R]
+            if len(mask) < R:
+                mask = np.concatenate(
+                    [mask, np.zeros(R - len(mask), np.float32)])
+        if len(props) < R:
+            props = np.concatenate(
+                [props, np.zeros((R - len(props), 4), np.float32)], axis=0)
+        props = props[:R]
         rois = np.concatenate([np.zeros((R, 1), np.float32), props], axis=1)
         self.rcnn.forward(DataBatch(data=[mx.nd.array(img[None]),
                                           mx.nd.array(rois)], label=[]),

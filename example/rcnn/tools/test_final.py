@@ -1,0 +1,40 @@
+"""Stage tool: evaluate the COMBINED final checkpoint.
+
+Capability parity with reference example/rcnn/tools/test_final.py:1 —
+the alternate-training recipe ends by folding both stages into one
+'final' params blob (utils/combine_model.py); this tool proves that
+single artifact is deployable by driving the full two-stage detector
+from it alone.
+
+  python tools/test_final.py --prefix /tmp/alt-final --epoch 0 \
+      --map-gate 0.5
+"""
+from common import base_parser, setup, test_set
+
+
+def main():
+    ap = base_parser("evaluate the combined final detector (VOC mAP)")
+    ap.add_argument("--prefix", required=True,
+                    help="combined checkpoint prefix (…-final)")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--map-gate", type=float, default=0.0)
+    args = ap.parse_args()
+    mx, cfg, ctx = setup(args)
+
+    from rcnn.tester import load_rcnn_test, load_rpn_test, test_detector
+    from utils.load_model import load_checkpoint
+
+    # ONE blob feeds both stage executors — name-partitioned at load
+    arg_params, aux_params = load_checkpoint(args.prefix, args.epoch)
+    rpn = load_rpn_test(cfg, arg_params, aux_params, ctx=ctx)
+    rcnn = load_rcnn_test(cfg, arg_params, aux_params, ctx=ctx)
+    _, mean_ap = test_detector(rpn, rcnn, test_set(cfg, args), cfg)
+    print("mAP=%.4f" % mean_ap)
+    if args.map_gate:
+        assert mean_ap >= args.map_gate, \
+            "mAP gate failed: %.4f < %.2f" % (mean_ap, args.map_gate)
+        print("PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Stage tool: evaluate the Fast R-CNN head alone on saved proposals.
+
+Capability parity with reference example/rcnn/tools/test_rcnn.py:1
+(there: HAS_RPN=False eval over precomputed/selective-search rois) —
+classification + regression quality isolated from proposal quality:
+the rcnn stage classifies the SAVED proposal set, so a weak RPN cannot
+mask (or be masked by) the head.
+
+  python tools/test_rcnn.py --prefix /tmp/rcnn2 --epoch 8 \
+      --proposals /tmp/props_test.npz --map-gate 0.4
+"""
+from common import base_parser, setup, test_set
+
+
+def main():
+    ap = base_parser("evaluate the Fast R-CNN head on saved proposals")
+    ap.add_argument("--prefix", required=True)
+    ap.add_argument("--epoch", type=int, required=True)
+    ap.add_argument("--proposals", required=True,
+                    help="npz over the TEST set (tools/test_rpn.py "
+                         "--proposals … --on-test-set)")
+    ap.add_argument("--map-gate", type=float, default=0.0)
+    args = ap.parse_args()
+    mx, cfg, ctx = setup(args)
+
+    import logging
+
+    import numpy as np
+
+    from rcnn.detector import Detector
+    from rcnn.tester import (eval_detections, load_proposals,
+                             load_rcnn_test)
+
+    _, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
+                                                         args.epoch)
+    rcnn = load_rcnn_test(cfg, arg_params, aux_params, ctx=ctx)
+    proposals = load_proposals(args.proposals,
+                               expect_images=args.test_images,
+                               expect_seed=args.test_seed)
+    det = Detector(None, rcnn, cfg)
+
+    all_dets, annotations = {}, {}
+    for i, (img, gt_boxes, gt_classes) in enumerate(test_set(cfg, args)):
+        annotations[i] = (gt_boxes, gt_classes)
+        props, mask, _ = proposals[i]
+        for cls, rows in det.classify_rois(
+                img, np.asarray(props, np.float32), img_id=i,
+                mask=np.asarray(mask, np.float32)).items():
+            all_dets.setdefault(cls, []).extend(rows)
+    aps, mean_ap = eval_detections(all_dets, annotations, cfg.num_classes)
+    for cls, ap_v in sorted(aps.items()):
+        logging.info("class %d AP = %.4f", cls, ap_v)
+    print("mAP=%.4f" % mean_ap)
+    if args.map_gate:
+        assert mean_ap >= args.map_gate, \
+            "mAP gate failed: %.4f < %.2f" % (mean_ap, args.map_gate)
+        print("PASSED")
+
+
+if __name__ == "__main__":
+    main()

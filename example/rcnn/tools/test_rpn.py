@@ -5,7 +5,7 @@ at IoU 0.5 and saves the proposal set the next stage trains on.
   python tools/test_rpn.py --prefix /tmp/rpn1 --epoch 8 \
       --proposals /tmp/props1.npz
 """
-from common import base_parser, setup, train_set
+from common import base_parser, setup, test_set, train_set
 
 
 def main():
@@ -15,6 +15,9 @@ def main():
     ap.add_argument("--proposals", required=True,
                     help="npz path to write the proposal set to")
     ap.add_argument("--recall-gate", type=float, default=0.0)
+    ap.add_argument("--on-test-set", action="store_true",
+                    help="generate over the held-out set (for "
+                         "tools/test_rcnn.py) instead of the train set")
     args = ap.parse_args()
     mx, cfg, ctx = setup(args)
 
@@ -24,11 +27,16 @@ def main():
     _, arg_params, aux_params = mx.model.load_checkpoint(args.prefix,
                                                          args.epoch)
     rpn = load_rpn_test(cfg, arg_params, aux_params, ctx=ctx)
-    dataset = train_set(cfg, args)
+    if args.on_test_set:
+        dataset = test_set(cfg, args)
+        n_images, seed = args.test_images, args.test_seed
+    else:
+        dataset = train_set(cfg, args)
+        n_images, seed = args.train_images, args.data_seed
     proposals = generate_proposals(rpn, dataset, cfg)
     recall = proposal_recall(proposals, dataset, cfg)
     save_proposals(args.proposals, proposals,
-                   n_images=args.train_images, data_seed=args.data_seed)
+                   n_images=n_images, data_seed=seed)
     print("recall@0.5=%.4f" % recall)
     if args.recall_gate:
         assert recall >= args.recall_gate, \

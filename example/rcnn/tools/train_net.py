@@ -1,0 +1,81 @@
+"""Stage tool: train Fast R-CNN end-to-end WITHOUT an RPN.
+
+Capability parity with reference example/rcnn/tools/train_net.py:1
+(there: HAS_RPN=False training over selective-search rois appended to
+the roidb).  Proposals come from jittered ground-truth boxes plus
+uniform background boxes — the standing-in proposal source when no
+region proposer exists yet — then the identical ROIIter/Solver path
+used by tools/train_rcnn.py trains the head.
+
+  python tools/train_net.py --prefix /tmp/frcnn --epochs 8
+"""
+import numpy as np
+
+from common import base_parser, setup, train_set
+
+
+def jittered_gt_proposals(dataset, cfg, rng, n_background=24):
+    """Per image: gt boxes perturbed by up to ~15% of their size plus
+    random background boxes, padded to cfg.post_nms_top rows — the same
+    (props, mask, scores) triple tools/test_rpn.py saves."""
+    out = []
+    R = cfg.post_nms_top
+    S = cfg.img_size
+    for img, gt_boxes, _ in dataset:
+        props = []
+        for x1, y1, x2, y2 in gt_boxes:
+            w, h = x2 - x1, y2 - y1
+            for _ in range(4):
+                jx, jy = rng.uniform(-0.15, 0.15, 2) * (w, h)
+                sx, sy = rng.uniform(0.85, 1.15, 2)
+                cx, cy = (x1 + x2) / 2 + jx, (y1 + y2) / 2 + jy
+                props.append([cx - sx * w / 2, cy - sy * h / 2,
+                              cx + sx * w / 2, cy + sy * h / 2])
+        for _ in range(n_background):
+            x1, y1 = rng.uniform(0, S * 0.7, 2)
+            w, h = rng.uniform(S * 0.1, S * 0.3, 2)
+            props.append([x1, y1, min(x1 + w, S - 1), min(y1 + h, S - 1)])
+        props = np.clip(np.asarray(props, np.float32), 0, S - 1)[:R]
+        mask = np.zeros(R, bool)
+        mask[:len(props)] = True
+        if len(props) < R:
+            props = np.concatenate(
+                [props, np.zeros((R - len(props), 4), np.float32)])
+        out.append((props, mask, np.zeros(R, np.float32)))
+    return out
+
+
+def main():
+    ap = base_parser("train Fast R-CNN on jittered-gt proposals (no RPN)")
+    ap.add_argument("--prefix", required=True)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--seed", type=int, default=13)
+    args = ap.parse_args()
+    mx, cfg, ctx = setup(args)
+
+    from rcnn.data_iter import PrefetchingIter
+    from rcnn.loader import ROIIter
+    from rcnn.metric import RCNNAccuracy
+    from rcnn.solver import Solver
+    from rcnn.symbol import get_fast_rcnn_train
+
+    rng = np.random.RandomState(args.seed)
+    dataset = train_set(cfg, args)
+    proposals = jittered_gt_proposals(dataset, cfg, rng)
+    it = PrefetchingIter(ROIIter(dataset, proposals, cfg, seed=args.seed))
+    solver = Solver(
+        get_fast_rcnn_train(cfg), data_names=["data", "rois"],
+        label_names=["label", "bbox_target", "bbox_weight"],
+        ctx=ctx, num_epoch=args.epochs, prefix=args.prefix,
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                          "wd": 5e-4},
+        no_slice_names=("rois",))
+    solver.fit(it, RCNNAccuracy(),
+               batch_end_callback=mx.callback.Speedometer(
+                   it.provide_data[0][1][0], frequent=20))
+    print("TRAIN-NET-DONE %s-%04d.params" % (args.prefix, args.epochs))
+
+
+if __name__ == "__main__":
+    main()

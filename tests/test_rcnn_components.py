@@ -137,6 +137,16 @@ def test_train_alternate_end_to_end(tmp_path):
     # the closing combine_model step folds both stages into one blob
     assert os.path.exists(prefix + "-final-0000.params"), res.stdout
 
+    # the combined blob alone drives the full detector (tools/test_final)
+    res = subprocess.run(
+        [sys.executable, os.path.join("tools", "test_final.py"),
+         "--prefix", prefix + "-final", "--epoch", "0",
+         "--test-images", "8", "--map-gate", "0.4"],
+        cwd=RCNN_DIR, env=env, capture_output=True, text=True,
+        timeout=560)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "PASSED" in res.stdout, res.stdout + res.stderr
+
 
 @pytest.mark.slow
 def test_rcnn_stage_tools(tmp_path):
@@ -176,3 +186,28 @@ def test_rcnn_stage_tools(tmp_path):
               "--rpn-epoch", "5", "--rcnn-prefix", p + "/rcnn2",
               "--rcnn-epoch", "5", "--map-gate", "0.4")
     assert "mAP=" in out and "PASSED" in out
+
+    # head-only eval on held-out-set proposals (reference test_rcnn.py's
+    # HAS_RPN=False path)
+    run("test_rpn.py", "--prefix", p + "/rpn2", "--epoch", "5",
+        "--proposals", p + "/ptest.npz", "--on-test-set")
+    out = run("test_rcnn.py", "--prefix", p + "/rcnn2", "--epoch", "5",
+              "--proposals", p + "/ptest.npz")
+    assert "mAP=" in out
+
+
+@pytest.mark.slow
+def test_rcnn_train_net_without_rpn(tmp_path):
+    """tools/train_net.py: Fast R-CNN trained end-to-end on jittered-gt
+    proposals, no RPN involved (reference train_net's HAS_RPN=False)."""
+    tools = os.path.join(RCNN_DIR, "tools")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "train_net.py", "--prefix",
+         str(tmp_path / "frcnn"), "--epochs", "4",
+         "--train-images", "24"],
+        cwd=tools, env=env, capture_output=True, text=True, timeout=560)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "TRAIN-NET-DONE" in res.stdout
+    assert os.path.exists(str(tmp_path / "frcnn") + "-0004.params")
