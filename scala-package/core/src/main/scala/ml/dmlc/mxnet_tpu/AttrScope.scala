@@ -1,0 +1,33 @@
+package ml.dmlc.mxnet_tpu
+
+/**
+ * Scoped symbol attributes (reference AttrScope.scala): attributes set
+ * on the current scope (ctx_group, lr_mult, ...) merge under any
+ * user-supplied per-symbol attributes.  Nesting composes; the python
+ * binding's mx.AttrScope writes the same keys, so symbols serialized
+ * from either side agree.
+ */
+class AttrScope(attr: Map[String, String] = Map.empty) {
+  private var _attr = attr
+
+  /** Scope attrs with user attrs taking precedence. */
+  def get(userDefinedAttr: Option[Map[String, String]]): Map[String, String] =
+    _attr ++ userDefinedAttr.getOrElse(Map.empty)
+
+  def withScope[T](body: => T): T = {
+    val outer = AttrScope.current
+    this._attr = outer._attr ++ this._attr
+    AttrScope.setCurrentAttr(this)
+    try body finally AttrScope.setCurrentAttr(outer)
+  }
+}
+
+object AttrScope {
+  private var _current = new AttrScope()
+  def current: AttrScope = _current
+  private[mxnet_tpu] def setCurrentAttr(scope: AttrScope): Unit = {
+    _current = scope
+  }
+  def apply(attr: Map[String, String] = Map.empty): AttrScope =
+    new AttrScope(attr)
+}

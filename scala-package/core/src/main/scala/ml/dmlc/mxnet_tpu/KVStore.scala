@@ -5,7 +5,8 @@ import ml.dmlc.mxnet_tpu.Base._
 /** Key-value store over the ABI (reference KVStore.scala): local for
  * single-process aggregation; dist_sync/dist_async ride the same entry
  * points when launched under tools/launch.py. */
-class KVStore private[mxnet_tpu](private val handle: KVStoreHandle) {
+class KVStore private[mxnet_tpu](
+    private[mxnet_tpu] val handle: KVStoreHandle) {
 
   def init(keys: Array[Int], values: Array[NDArray]): Unit =
     checkCall(_LIB.mxKVStoreInit(handle, keys, values.map(_.handle)))

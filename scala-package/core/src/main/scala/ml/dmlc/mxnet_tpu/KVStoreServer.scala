@@ -1,0 +1,32 @@
+package ml.dmlc.mxnet_tpu
+
+/**
+ * Server-role entry point for distributed kvstore (reference
+ * KVStoreServer.scala): a process whose DMLC_ROLE is not "worker"
+ * creates the dist store and blocks in the native server loop (the C
+ * ABI's MXKVStoreRunServer — mxnet_tpu's TCP parameter server, which
+ * un-pickles the worker-shipped optimizer on the command channel the
+ * same way every other binding does).
+ *
+ * Usage (mirrors the python kvstore_server auto-start):
+ *
+ *   if (KVStoreServer.roleOf(sys.env) != "worker") {
+ *     KVStoreServer.start()       // blocks until the job finishes
+ *   }
+ */
+object KVStoreServer {
+
+  def roleOf(env: Map[String, String]): String =
+    env.getOrElse("DMLC_ROLE", "worker")
+
+  /** Create the dist store for this role and run the server loop;
+   * returns when the scheduler tears the job down. */
+  def start(kvType: String = "dist_async"): Unit = {
+    val kv = KVStore.create(kvType)
+    try {
+      Base.checkCall(Base._LIB.mxKVStoreRunServer(kv.handle))
+    } finally {
+      kv.dispose()
+    }
+  }
+}

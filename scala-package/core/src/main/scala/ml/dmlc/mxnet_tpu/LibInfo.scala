@@ -110,4 +110,5 @@ class LibInfo {
   @native def mxKVStoreGetRank(handle: Long, out: Array[Int]): Int
   @native def mxKVStoreGetGroupSize(handle: Long, out: Array[Int]): Int
   @native def mxKVStoreBarrier(handle: Long): Int
+  @native def mxKVStoreRunServer(handle: Long): Int
 }

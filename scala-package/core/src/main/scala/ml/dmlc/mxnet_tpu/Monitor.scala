@@ -1,0 +1,62 @@
+package ml.dmlc.mxnet_tpu
+
+import scala.collection.mutable
+
+/**
+ * Output/weight/gradient statistics for debugging (reference
+ * Monitor.scala): installed on an executor, drains a queue of
+ * (step, name, stat) rows every `interval` batches.  The default stat
+ * is the RMS norm, matching the python Monitor.
+ */
+class Monitor(protected val interval: Int,
+              protected var statFunc: (NDArray) => Float = null) {
+
+  if (statFunc == null) {
+    statFunc = (x: NDArray) => {
+      val vals = x.toArray
+      var ss = 0.0
+      for (v <- vals) ss += v.toDouble * v.toDouble
+      math.sqrt(ss / math.max(vals.length, 1)).toFloat
+    }
+  }
+
+  private var activated: Boolean = false
+  private val queue = new mutable.Queue[(Int, String, Float)]
+  private var step: Int = 0
+  private val executors = new mutable.ListBuffer[Executor]
+
+  /** Install on an executor: its outputs get collected after forward. */
+  def install(executor: Executor): Unit = {
+    executors += executor
+  }
+
+  /** Start collecting for this batch. */
+  def tic(): Unit = {
+    if (step % interval == 0) {
+      activated = true
+      queue.clear()
+    }
+    step += 1
+  }
+
+  /** Collect stats from every installed executor and return the rows. */
+  def toc(): Seq[(Int, String, Float)] = {
+    if (!activated) return Seq.empty
+    activated = false
+    for (exe <- executors) {
+      val outs = exe.outputs
+      for ((out, i) <- outs.zipWithIndex) {
+        queue.enqueue((step, s"output$i", statFunc(out)))
+        out.dispose()   // stat read the values; free the bridge handle
+      }
+    }
+    queue.toList
+  }
+
+  /** toc() and print each row (reference tocPrint). */
+  def tocPrint(): Unit = {
+    for ((s, name, value) <- toc()) {
+      println(s"Batch: $s $name $value")
+    }
+  }
+}

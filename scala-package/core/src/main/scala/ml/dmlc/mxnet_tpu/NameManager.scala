@@ -1,0 +1,34 @@
+package ml.dmlc.mxnet_tpu
+
+import scala.collection.mutable
+
+/**
+ * Automatic symbol naming (reference NameManager.scala): a user name
+ * wins; otherwise `<hint><n>` with a per-hint counter — the same rule
+ * the python NameManager applies, so auto-named graphs round-trip
+ * between bindings.
+ */
+class NameManager {
+  val counter: mutable.Map[String, Int] = mutable.HashMap.empty
+
+  def get(name: Option[String], hint: String): String =
+    name.getOrElse {
+      val n = counter.getOrElse(hint, 0)
+      counter(hint) = n + 1
+      s"$hint$n"
+    }
+
+  def withScope[T](body: => T): T = {
+    val outer = NameManager.current
+    NameManager.setCurrentManager(this)
+    try body finally NameManager.setCurrentManager(outer)
+  }
+}
+
+object NameManager {
+  private var _current = new NameManager()
+  def current: NameManager = _current
+  private[mxnet_tpu] def setCurrentManager(m: NameManager): Unit = {
+    _current = m
+  }
+}

@@ -142,16 +142,31 @@ object Symbol {
   }
 
   /** Create any registered operator by name with keyword inputs +
-   * string-typed params — the whole op inventory, no generated stubs. */
-  def create(op: String, name: String, inputs: Map[String, Symbol],
+   * string-typed params — the whole op inventory, no generated stubs.
+   * An empty `name` is auto-generated by the current NameManager, and
+   * the current AttrScope's attributes merge under `params` (the same
+   * scope rules the python binding applies). */
+  def create(op: String, rawName: String, inputs: Map[String, Symbol],
              params: Map[String, String] = Map.empty): Symbol = {
     val creator = creators.getOrElse(op,
       throw new MXNetError(s"unknown operator $op"))
+    val name =
+      if (rawName == null || rawName.isEmpty)
+        NameManager.current.get(None, op.toLowerCase)
+      else rawName
     val out = new Array[Long](1)
     val (pk, pv) = params.toSeq.unzip
     checkCall(_LIB.mxSymbolCreateAtomicSymbol(creator, pk.toArray,
                                               pv.toArray, out))
     val sym = new Symbol(out(0))
+    // scope attributes (ctx_group, lr_mult, ...) are symbol ATTRS, not
+    // op params — apply them through the attr API so the op's param
+    // parser never sees them; explicit per-call params win on clashes
+    for ((k, v) <- AttrScope.current.get(None)) {
+      if (!params.contains(k)) {
+        checkCall(_LIB.mxSymbolSetAttr(out(0), k, v))
+      }
+    }
     val (ik, iv) = inputs.toSeq.unzip
     checkCall(_LIB.mxSymbolCompose(sym.handle, name, ik.toArray,
                                    iv.map(_.handle).toArray))

@@ -118,6 +118,7 @@ static struct {
   int (*KVStoreGetRank)(KVStoreHandle, int *);
   int (*KVStoreGetGroupSize)(KVStoreHandle, int *);
   int (*KVStoreBarrier)(KVStoreHandle);
+  int (*KVStoreRunServer)(KVStoreHandle);
   int loaded;
 } jx;
 
@@ -289,6 +290,7 @@ JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_nativeLibInit(
   JX_RESOLVE(KVStoreGetRank, "MXKVStoreGetRank");
   JX_RESOLVE(KVStoreGetGroupSize, "MXKVStoreGetGroupSize");
   JX_RESOLVE(KVStoreBarrier, "MXKVStoreBarrier");
+  JX_RESOLVE(KVStoreRunServer, "MXKVStoreRunServer");
   jx.loaded = 1;
   return 0;
 }
@@ -846,6 +848,12 @@ JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreGetGroupSize(
 JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreBarrier(
     JNIEnv *, jobject, jlong h) {
   return jx.KVStoreBarrier(H(h));
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreRunServer(
+    JNIEnv *, jobject, jlong h) {
+  // blocks in the native PS loop until the scheduler finishes the job
+  return jx.KVStoreRunServer(H(h));
 }
 
 }  /* extern "C" */
