@@ -1,14 +1,22 @@
 package ml.dmlc.mxnet_tpu
 
+import ml.dmlc.mxnet_tpu.Base.MXNetError
+
 /**
  * Server-role entry point for distributed kvstore (reference
- * KVStoreServer.scala): a process whose DMLC_ROLE is not "worker"
- * creates the dist store and blocks in the native server loop (the C
- * ABI's MXKVStoreRunServer — mxnet_tpu's TCP parameter server, which
- * un-pickles the worker-shipped optimizer on the command channel the
- * same way every other binding does).
+ * KVStoreServer.scala).
  *
- * Usage (mirrors the python kvstore_server auto-start):
+ * In this build, server and scheduler processes are owned by the
+ * embedded python runtime: importing the package with
+ * DMLC_ROLE=server/scheduler runs the ENTIRE parameter-server loop and
+ * exits (mxnet_tpu/kvstore_server.py — the same import-is-the-program
+ * contract the python binding has).  A JVM process in a server role
+ * therefore serves during its FIRST bridge call; the SystemExit the
+ * bridge raises after the scheduler tears the job down surfaces here
+ * as an MXNetError, which start() treats as normal completion.
+ *
+ * For worker-role processes (no import hijack), start() falls through
+ * to the explicit C-ABI loop, MXKVStoreRunServer.
  *
  *   if (KVStoreServer.roleOf(sys.env) != "worker") {
  *     KVStoreServer.start()       // blocks until the job finishes
@@ -19,14 +27,21 @@ object KVStoreServer {
   def roleOf(env: Map[String, String]): String =
     env.getOrElse("DMLC_ROLE", "worker")
 
-  /** Create the dist store for this role and run the server loop;
-   * returns when the scheduler tears the job down. */
+  /** Serve until the scheduler tears the job down, then return. */
   def start(kvType: String = "dist_async"): Unit = {
-    val kv = KVStore.create(kvType)
+    val serverRole = roleOf(sys.env) != "worker"
     try {
-      Base.checkCall(Base._LIB.mxKVStoreRunServer(kv.handle))
-    } finally {
-      kv.dispose()
+      // for server/scheduler roles this first bridge call runs the
+      // whole serving loop inside the embedded import (see header)
+      val kv = KVStore.create(kvType)
+      try {
+        Base.checkCall(Base._LIB.mxKVStoreRunServer(kv.handle))
+      } finally {
+        kv.dispose()
+      }
+    } catch {
+      // end-of-job SystemExit from the import-owned loop — done
+      case _: MXNetError if serverRole => ()
     }
   }
 }
