@@ -678,3 +678,20 @@ def test_sharded_update_survives_classic_fallback():
     finally:
         os.environ.pop("MXNET_SHARD_WEIGHT_UPDATE", None)
         os.environ.pop("MXNET_FUSED_TRAIN", None)
+
+
+def test_fused_remat_trajectory_matches():
+    """MXNET_BACKWARD_DO_MIRROR=1 on the fused path wraps the WHOLE loss
+    in jax.checkpoint (activations recomputed in backward) — the
+    training trajectory must be bit-compatible with the non-remat run."""
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    try:
+        mod, remat_params = _train(True)
+        assert mod._fused._remat, "remat flag did not reach the fused step"
+    finally:
+        os.environ.pop("MXNET_BACKWARD_DO_MIRROR", None)
+    _, base_params = _train(True)
+    assert set(remat_params) == set(base_params)
+    for k in base_params:
+        np.testing.assert_allclose(remat_params[k], base_params[k],
+                                   rtol=2e-5, atol=2e-6, err_msg=k)
