@@ -42,6 +42,63 @@ typedef const void *OptimizerCreator;
 typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
                                 NDArrayHandle local, void *handle);
 
+/*! \brief per-op monitor callback (reference c_api.h:60-62) */
+typedef void (*ExecutorMonitorCallback)(const char *op_name,
+                                        NDArrayHandle output, void *handle);
+
+/*! \brief ABI custom-op callback tables (reference c_api.h:96-135).
+ * Tag protocol in forward/backward ptr arrays: 0=in_data 1=out_data
+ * 2=in_grad 3=out_grad 4=aux; req codes 0=null 1=write 2=inplace 3=add. */
+#ifdef __cplusplus
+extern "C" {
+#endif
+struct CustomOpInfo {
+  int (*forward)(int /*size*/, void ** /*ptrs*/, int * /*tags*/,
+                 const int * /*reqs*/, const int /*is_train*/,
+                 void * /*state*/);
+  int (*backward)(int /*size*/, void ** /*ptrs*/, int * /*tags*/,
+                  const int * /*reqs*/, const int /*is_train*/,
+                  void * /*state*/);
+  int (*del_)(void * /*state*/);
+  void *p_forward;
+  void *p_backward;
+  void *p_del;
+};
+
+struct CustomOpPropInfo {
+  int (*list_arguments)(char *** /*args*/, void * /*state*/);
+  int (*list_outputs)(char *** /*outputs*/, void * /*state*/);
+  int (*infer_shape)(int /*num_input*/, int * /*ndims*/,
+                     unsigned ** /*shapes*/, void * /*state*/);
+  int (*declare_backward_dependency)(const int * /*out_grad*/,
+                                     const int * /*in_data*/,
+                                     const int * /*out_data*/,
+                                     int * /*num_deps*/, int ** /*rdeps*/,
+                                     void * /*state*/);
+  int (*create_operator)(const char * /*ctx*/, int /*num_inputs*/,
+                         unsigned ** /*shapes*/, int * /*ndims*/,
+                         int * /*dtypes*/, struct CustomOpInfo * /*ret*/,
+                         void * /*state*/);
+  int (*list_auxiliary_states)(char *** /*aux*/, void * /*state*/);
+  int (*del_)(void * /*state*/);
+  void *p_list_arguments;
+  void *p_list_outputs;
+  void *p_infer_shape;
+  void *p_declare_backward_dependency;
+  void *p_create_operator;
+  void *p_list_auxiliary_states;
+  void *p_del;
+};
+
+typedef int (*CustomOpPropCreator)(const char * /*op_type*/,
+                                   const int /*num_kwargs*/,
+                                   const char ** /*keys*/,
+                                   const char ** /*values*/,
+                                   struct CustomOpPropInfo * /*ret*/);
+#ifdef __cplusplus
+}
+#endif
+
 /* -------------------- error handling + global -------------------- */
 MXTPU_DLL const char *MXGetLastError();
 MXTPU_DLL int MXRandomSeed(int seed);
@@ -77,6 +134,14 @@ MXTPU_DLL int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
 MXTPU_DLL int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
 MXTPU_DLL int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
                                   int *out_dev_id);
+/* Raw-byte serialization (reference c_api.h:218-230): self-describing
+ * little-endian frame (magic, dtype, shape, payload) used by kvstore /
+ * cross-process sends.  The returned buffer stays valid until the next
+ * pointer-returning MX* call on this thread. */
+MXTPU_DLL int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                                    const char **out_buf);
+MXTPU_DLL int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                                        NDArrayHandle *out);
 MXTPU_DLL int MXNDArraySave(const char *fname, mx_uint num_args,
                             NDArrayHandle *args, const char **keys);
 MXTPU_DLL int MXNDArrayLoad(const char *fname, mx_uint *out_size,
@@ -95,6 +160,11 @@ MXTPU_DLL int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
                              int *type_mask);
 MXTPU_DLL int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
                            mx_float *scalar_args, NDArrayHandle *mutate_vars);
+/* MXFuncInvoke + string keyword params (reference c_api.h:464-470) */
+MXTPU_DLL int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                             mx_float *scalar_args,
+                             NDArrayHandle *mutate_vars, int num_params,
+                             char **param_keys, char **param_vals);
 
 /* -------------------- Symbol -------------------- */
 MXTPU_DLL int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
@@ -107,6 +177,9 @@ MXTPU_DLL int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
                                           const char ***arg_type_infos,
                                           const char ***arg_descriptions,
                                           const char **key_var_num_args);
+/* The creator handle IS the interned op name (reference c_api.h:488). */
+MXTPU_DLL int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                          const char **name);
 MXTPU_DLL int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
                                          mx_uint num_param, const char **keys,
                                          const char **vals, SymbolHandle *out);
@@ -120,12 +193,21 @@ MXTPU_DLL int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
 MXTPU_DLL int MXSymbolFree(SymbolHandle symbol);
 MXTPU_DLL int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
 MXTPU_DLL int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+/* Name of a single-output symbol; success=0 for unnamed groups
+ * (reference c_api.h:602-604). */
+MXTPU_DLL int MXSymbolGetName(SymbolHandle symbol, const char **out,
+                              int *success);
 MXTPU_DLL int MXSymbolGetAttr(SymbolHandle symbol, const char *key,
                               const char **out, int *success);
 MXTPU_DLL int MXSymbolSetAttr(SymbolHandle symbol, const char *key,
                               const char *value);
-MXTPU_DLL int MXSymbolListAttr(SymbolHandle symbol, int recursive,
-                               mx_uint *out_size, const char ***out);
+/* Recursive attribute listing over the whole graph ("node$key" keys) —
+ * reference c_api.h:638-646; out holds 2*out_size key/value strings. */
+MXTPU_DLL int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                               const char ***out);
+/* Attributes of this node only (reference c_api.h:653-655). */
+MXTPU_DLL int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                                      const char ***out);
 MXTPU_DLL int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
                                     const char ***out_str_array);
 MXTPU_DLL int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
@@ -200,6 +282,11 @@ MXTPU_DLL int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type,
                               NDArrayHandle *arg_grad_store,
                               mx_uint *grad_req_type, mx_uint aux_states_len,
                               NDArrayHandle *aux_states, ExecutorHandle *out);
+/* Per-op output monitor from any frontend (reference c_api.h:991-993);
+ * switches the executor to node-level (eager) execution. */
+MXTPU_DLL int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                           ExecutorMonitorCallback callback,
+                                           void *callback_handle);
 MXTPU_DLL int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type,
                                int dev_id, mx_uint num_map_keys,
                                const char **map_keys, const int *map_dev_types,
@@ -255,6 +342,11 @@ MXTPU_DLL int MXKVStoreSendCommmandToServers(KVStoreHandle handle,
                                              int cmd_id, const char *cmd_body);
 MXTPU_DLL int MXInitPSEnv(mx_uint num_vars, const char **keys,
                           const char **vals);
+/* Process role queries (reference c_api.h:1218-1238): driven by DMLC_ROLE,
+ * matching the launcher contract (tools/launch.py / kvstore_server.py). */
+MXTPU_DLL int MXKVStoreIsWorkerNode(int *ret);
+MXTPU_DLL int MXKVStoreIsServerNode(int *ret);
+MXTPU_DLL int MXKVStoreIsSchedulerNode(int *ret);
 
 /* -------------------- RecordIO -------------------- */
 MXTPU_DLL int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
@@ -288,5 +380,13 @@ MXTPU_DLL int MXOptimizerFree(OptimizerHandle handle);
 MXTPU_DLL int MXOptimizerUpdate(OptimizerHandle handle, int index,
                                 NDArrayHandle weight, NDArrayHandle grad,
                                 mx_float lr, mx_float wd);
+
+/* -------------------- Custom operators -------------------- */
+/* Register a frontend-defined operator usable as sym.Custom(op_type=...)
+ * (reference c_api.h:1375).  The creator is called once per symbol
+ * instantiation; the frontend owns the lifetime of every callback it
+ * installs in the returned tables. */
+MXTPU_DLL int MXCustomOpRegister(const char *op_type,
+                                 CustomOpPropCreator creator);
 
 #endif  /* MXTPU_C_API_H_ */

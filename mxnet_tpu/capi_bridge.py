@@ -271,14 +271,32 @@ def _accepts_out(fn) -> bool:
     return result
 
 
+def _parse_param_str(v: str):
+    """str -> int/float/tuple/str, the dmlc-parameter coercion used across
+    the string-typed ABI channels (data iterators, MXFuncInvokeEx)."""
+    def scalar(x):
+        for conv in (int, float):
+            try:
+                return conv(x)
+            except ValueError:
+                continue
+        return x
+    if v.startswith("("):
+        return tuple(scalar(x) for x in v.strip("()").split(",") if x)
+    return scalar(v)
+
+
 def func_invoke(name: str, use_handles: List[int], scalars: List[float],
-                mutate_handles: List[int]) -> None:
+                mutate_handles: List[int],
+                param_keys: List[str] = (), param_vals: List[str] = ()) -> None:
+    """param_keys/param_vals carry MXFuncInvokeEx's string kwargs
+    (reference c_api.h:464-470); plain MXFuncInvoke passes none."""
     nd = _nd()
     fn = nd._NDARRAY_FUNCS[name]
     ins = [_get(h) for h in use_handles]
     outs = [_get(h) for h in mutate_handles]
     args = ins + list(scalars)
-    kwargs = {}
+    kwargs = {k: _parse_param_str(v) for k, v in zip(param_keys, param_vals)}
     if name not in _FUNC_SIGNATURES and scalars:
         # registry ops take their scalars as named params (SimpleOp
         # scalar family); map the positional ABI scalars onto them
@@ -289,7 +307,7 @@ def func_invoke(name: str, use_handles: List[int], scalars: List[float],
             names = []
         if names:
             args = list(ins)
-            kwargs = dict(zip(names, scalars))
+            kwargs.update(zip(names, scalars))
     if name not in _FUNC_SIGNATURES and mutate_handles:
         # ops with a required `shape` param and no inputs (the sample
         # family) take it from the destination: the ABI's scalar channel
@@ -553,18 +571,7 @@ def list_data_iters() -> List[str]:
 def data_iter_create(name: str, keys: List[str], vals: List[str]) -> int:
     from . import io
     cls = getattr(io, name)
-    kwargs: Dict[str, Any] = {}
-    for k, v in zip(keys, vals):
-        if v.startswith("("):
-            kwargs[k] = tuple(int(x) for x in v.strip("()").split(",") if x)
-        else:
-            try:
-                kwargs[k] = int(v)
-            except ValueError:
-                try:
-                    kwargs[k] = float(v)
-                except ValueError:
-                    kwargs[k] = v
+    kwargs = {k: _parse_param_str(v) for k, v in zip(keys, vals)}
     return _put(cls(**kwargs))
 
 
@@ -834,3 +841,352 @@ def ndlist_get(h: int, index: int):
     arr = arrays[index]
     data = np.ascontiguousarray(arr.asnumpy(), dtype=np.float32).tobytes()
     return names[index], data, list(arr.shape)
+
+
+# ---------------------------------------------------------------------------
+# Raw-byte NDArray serialization (reference MXNDArraySaveRawBytes /
+# MXNDArrayLoadFromRawBytes, c_api.h:218-230 — the kvstore/cross-process
+# send format).  Self-describing little-endian framing:
+#   u32 magic | i32 dtype_code | u32 ndim | u32 dims[ndim] | payload
+
+_RAW_MAGIC = 0x4D585452  # "MXTR"
+
+
+def ndarray_save_raw(h: int) -> bytes:
+    arr = _get(h)
+    a = np.ascontiguousarray(arr.asnumpy())
+    code = _DTYPE_TO_CODE[a.dtype.name]
+    head = np.array([_RAW_MAGIC, code & 0xFFFFFFFF, a.ndim] + list(a.shape),
+                    dtype="<u4").tobytes()
+    return head + a.tobytes()
+
+
+def ndarray_load_raw(buf: bytes) -> int:
+    head = np.frombuffer(buf[:12], dtype="<u4")
+    if len(head) < 3 or head[0] != _RAW_MAGIC:
+        raise ValueError("corrupt NDArray raw-bytes header")
+    code, ndim = int(head[1]), int(head[2])
+    dims = np.frombuffer(buf[12:12 + 4 * ndim], dtype="<u4")
+    shape = tuple(int(d) for d in dims)
+    dtype = np.dtype(_CODE_TO_DTYPE[code])
+    payload = buf[12 + 4 * ndim:]
+    n = int(np.prod(shape)) if shape else 1
+    if len(payload) != n * dtype.itemsize:
+        raise ValueError("raw-bytes payload size mismatch")
+    a = np.frombuffer(payload, dtype=dtype).reshape(shape)
+    return _put(_nd().array(a, dtype=dtype))
+
+
+# ---------------------------------------------------------------------------
+# Symbol name introspection (reference MXSymbolGetName /
+# MXSymbolGetAtomicSymbolName, c_api.h:488-604)
+
+def symbol_get_name(h: int) -> Optional[str]:
+    return _get(h).name
+
+
+# ---------------------------------------------------------------------------
+# Executor monitor from non-python frontends
+# (reference MXExecutorSetMonitorCallback, c_api.h:991-993)
+
+def executor_set_monitor_addr(h: int, fn_addr: int, ctx_addr: int = 0) -> None:
+    """Wrap a C callback ``void (*)(const char*, NDArrayHandle, void*)``
+    (ExecutorMonitorCallback) and install it as the executor's per-op
+    monitor.  The NDArray handle is lent for the callback's duration only,
+    like the kvstore updater's."""
+    import ctypes
+    cb_type = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                               ctypes.c_void_p)
+    cfn = cb_type(fn_addr)
+
+    def monitor(name, arr):
+        hnd = _put(arr)
+        try:
+            cfn(name.encode(), hnd, ctx_addr or None)
+        finally:
+            free_handle(hnd)
+
+    exe = _get(h)
+    exe._capi_monitor_ref = cfn  # keep the callback alive
+    exe.set_monitor_callback(monitor)
+
+
+# ---------------------------------------------------------------------------
+# ABI-registered custom operators (reference MXCustomOpRegister,
+# c_api.h:1375 + the CustomOpPropInfo/CustomOpInfo callback structs at
+# c_api.h:96-135).  A frontend registers a creator; each sym.Custom
+# instantiation calls it and drives the returned callback table.  The
+# Python-side mirror of this dance is reference python/mxnet/operator.py
+# register(); here the roles flip: C is the producer, Python the consumer.
+
+def _custom_ctypes():
+    import ctypes
+
+    class CustomOpInfo(ctypes.Structure):
+        _fields_ = [
+            ("forward", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.c_int, ctypes.c_void_p)),
+            ("backward", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.c_int, ctypes.c_void_p)),
+            ("del_", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)),
+            ("p_forward", ctypes.c_void_p),
+            ("p_backward", ctypes.c_void_p),
+            ("p_del", ctypes.c_void_p),
+        ]
+
+    class CustomOpPropInfo(ctypes.Structure):
+        _fields_ = [
+            ("list_arguments", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+                ctypes.c_void_p)),
+            ("list_outputs", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+                ctypes.c_void_p)),
+            ("infer_shape", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+                ctypes.c_void_p)),
+            ("declare_backward_dependency", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_int)),
+                ctypes.c_void_p)),
+            ("create_operator", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(CustomOpInfo), ctypes.c_void_p)),
+            ("list_auxiliary_states", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+                ctypes.c_void_p)),
+            ("del_", ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)),
+            ("p_list_arguments", ctypes.c_void_p),
+            ("p_list_outputs", ctypes.c_void_p),
+            ("p_infer_shape", ctypes.c_void_p),
+            ("p_declare_backward_dependency", ctypes.c_void_p),
+            ("p_create_operator", ctypes.c_void_p),
+            ("p_list_auxiliary_states", ctypes.c_void_p),
+            ("p_del", ctypes.c_void_p),
+        ]
+
+    return CustomOpInfo, CustomOpPropInfo
+
+
+def _read_null_terminated(pp) -> List[str]:
+    """Read a NULL-terminated char** the callee handed back."""
+    out = []
+    i = 0
+    while pp[i]:
+        out.append(pp[i].decode())
+        i += 1
+    return out
+
+
+def _safe_c_del(del_fn, state) -> None:
+    """Invoke a frontend del_ callback, swallowing failures (destructor
+    context: nothing useful can be raised)."""
+    try:
+        del_fn(state)
+    except Exception:
+        pass
+
+
+def custom_op_register(op_type: str, creator_addr: int) -> None:
+    """MXCustomOpRegister: wrap the frontend's CustomOpPropCreator in a
+    CustomOpProp subclass and place it in the sym.Custom registry.  The
+    callback tag protocol (0=in_data 1=out_data 2=in_grad 3=out_grad
+    4=aux) and req encoding (0=null 1=write 2=inplace 3=add) match the
+    reference custom-inl.h dispatch."""
+    import ctypes
+    from . import operator as _op
+    from .base import MXNetError
+    CustomOpInfo, CustomOpPropInfo = _custom_ctypes()
+    creator_t = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(CustomOpPropInfo))
+    creator = creator_t(creator_addr)
+
+    class _CBackedOp(_op.CustomOp):
+        def __init__(self, info):
+            self._info = info
+            # the frontend's del_ releases per-operator state; fire it when
+            # the Python wrapper dies (the reference frees on operator
+            # destruction, custom-inl.h)
+            if info.del_:
+                import weakref
+                weakref.finalize(self, _safe_c_del, info.del_, info.p_del)
+
+        def _drive(self, fn, state, groups, reqs, is_train):
+            """groups: list of (tag, [NDArray...]) in protocol order."""
+            flat, tags = [], []
+            for tag, arrs in groups:
+                for a in arrs:
+                    flat.append(a)
+                    tags.append(tag)
+            handles = [_put(a) for a in flat]
+            try:
+                n = len(flat)
+                ptrs = (ctypes.c_void_p * n)(*handles)
+                tarr = (ctypes.c_int * n)(*tags)
+                rarr = (ctypes.c_int * max(1, len(reqs)))(*(reqs or [1]))
+                if not fn(n, ptrs, tarr, rarr, bool(is_train), state):
+                    raise MXNetError("custom op %r C callback failed"
+                                     % op_type)
+            finally:
+                for hh in handles:
+                    free_handle(hh)
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            reqs = [_REQ_CODE.get(r, 1) for r in req]
+            self._drive(self._info.forward, self._info.p_forward,
+                        [(0, in_data), (1, out_data), (4, aux)], reqs,
+                        is_train)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            reqs = [_REQ_CODE.get(r, 1) for r in req]
+            # backward only ever runs under gradient computation, i.e.
+            # training: the reference forwards its ctx.is_train here
+            self._drive(self._info.backward, self._info.p_backward,
+                        [(0, in_data), (1, out_data), (2, in_grad),
+                         (3, out_grad), (4, aux)], reqs, True)
+
+    # One creator call per distinct kwargs set, cached for the process:
+    # CustomSymbolOp re-derives the prop on every graph query, and
+    # re-invoking a C creator that allocates state each time would leak.
+    # Cached infos are released through del_ at interpreter exit.
+    _prop_info_cache: Dict[tuple, Any] = {}
+
+    def _prop_info_for(kwargs):
+        key = tuple(sorted(kwargs.items()))
+        info = _prop_info_cache.get(key)
+        if info is not None:
+            return info
+        info = CustomOpPropInfo()
+        keys = [k.encode() for k in kwargs]
+        vals = [str(kwargs[k]).encode() for k in kwargs]
+        karr = (ctypes.c_char_p * max(1, len(keys)))(*(keys or [b""]))
+        varr = (ctypes.c_char_p * max(1, len(vals)))(*(vals or [b""]))
+        if not creator(op_type.encode(), len(keys), karr, varr,
+                       ctypes.byref(info)):
+            raise MXNetError("custom op creator for %r failed" % op_type)
+        _prop_info_cache[key] = info
+        if info.del_:
+            import atexit
+            atexit.register(_safe_c_del, info.del_, info.p_del)
+        return info
+
+    class _CBackedProp(_op.CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+            self._info = _prop_info_for(kwargs)
+            # derive need_top_grad from the frontend's dependency
+            # declaration (reference custom-inl.h consumes it the same
+            # way: out_grad absent from deps => loss-style op)
+            if self._info.declare_backward_dependency:
+                n_out = len(self.list_outputs())
+                n_in = len(self.list_arguments())
+                og = list(range(n_out))
+                ind = list(range(n_out, n_out + n_in))
+                od = list(range(n_out + n_in, 2 * n_out + n_in))
+                deps = set(self.declare_backward_dependency(og, ind, od))
+                self.need_top_grad_ = any(i in deps for i in og)
+
+        def list_arguments(self):
+            pp = ctypes.POINTER(ctypes.c_char_p)()
+            if not self._info.list_arguments(ctypes.byref(pp),
+                                             self._info.p_list_arguments):
+                raise MXNetError("%s.list_arguments failed" % op_type)
+            return _read_null_terminated(pp)
+
+        def list_outputs(self):
+            pp = ctypes.POINTER(ctypes.c_char_p)()
+            if not self._info.list_outputs(ctypes.byref(pp),
+                                           self._info.p_list_outputs):
+                raise MXNetError("%s.list_outputs failed" % op_type)
+            return _read_null_terminated(pp)
+
+        def list_auxiliary_states(self):
+            if not self._info.list_auxiliary_states:
+                return []
+            pp = ctypes.POINTER(ctypes.c_char_p)()
+            if not self._info.list_auxiliary_states(
+                    ctypes.byref(pp), self._info.p_list_auxiliary_states):
+                raise MXNetError("%s.list_auxiliary_states failed" % op_type)
+            return _read_null_terminated(pp)
+
+        def declare_backward_dependency(self, out_grad, in_data, out_data):
+            """Drive the frontend's dependency declaration (ids in, ids
+            out).  Falls back to the base-class superset when the frontend
+            left the slot empty."""
+            if not self._info.declare_backward_dependency:
+                return super().declare_backward_dependency(
+                    out_grad, in_data, out_data)
+            og = (ctypes.c_int * max(1, len(out_grad)))(*(out_grad or [0]))
+            ind = (ctypes.c_int * max(1, len(in_data)))(*(in_data or [0]))
+            od = (ctypes.c_int * max(1, len(out_data)))(*(out_data or [0]))
+            num = ctypes.c_int(0)
+            deps = ctypes.POINTER(ctypes.c_int)()
+            if not self._info.declare_backward_dependency(
+                    og, ind, od, ctypes.byref(num), ctypes.byref(deps),
+                    self._info.p_declare_backward_dependency):
+                raise MXNetError("%s.declare_backward_dependency failed"
+                                 % op_type)
+            return [int(deps[i]) for i in range(num.value)]
+
+        def infer_shape(self, in_shape):
+            n_in = len(self.list_arguments())
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            n = n_in + n_out + n_aux
+            ndims = (ctypes.c_int * n)()
+            shapes = (ctypes.POINTER(ctypes.c_uint) * n)()
+            keep = []  # input dim buffers stay alive across the call
+            for i, s in enumerate(in_shape):
+                buf = (ctypes.c_uint * max(1, len(s)))(*[int(x) for x in s])
+                keep.append(buf)
+                ndims[i] = len(s)
+                shapes[i] = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint))
+            if not self._info.infer_shape(n, ndims, shapes,
+                                          self._info.p_infer_shape):
+                raise MXNetError("%s.infer_shape failed" % op_type)
+            read = lambda i: [int(shapes[i][j]) for j in range(ndims[i])]
+            return ([read(i) for i in range(n_in)],
+                    [read(n_in + i) for i in range(n_out)],
+                    [read(n_in + n_out + i) for i in range(n_aux)])
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            n = len(in_shapes)
+            ndims = (ctypes.c_int * max(1, n))()
+            shapes = (ctypes.POINTER(ctypes.c_uint) * max(1, n))()
+            keep = []
+            for i, s in enumerate(in_shapes):
+                buf = (ctypes.c_uint * max(1, len(s)))(*[int(x) for x in s])
+                keep.append(buf)
+                ndims[i] = len(s)
+                shapes[i] = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint))
+            dtypes = (ctypes.c_int * max(1, n))(
+                *[_DTYPE_TO_CODE[np.dtype(t).name] for t in in_dtypes])
+            info = CustomOpInfo()
+            if not self._info.create_operator(
+                    str(ctx or "cpu").encode(), n, shapes, ndims, dtypes,
+                    ctypes.byref(info), self._info.p_create_operator):
+                raise MXNetError("%s.create_operator failed" % op_type)
+            op = _CBackedOp(info)
+            op._keep = keep
+            return op
+
+    _REQ_CODE = {"null": 0, "write": 1, "inplace": 2, "add": 3}
+    _CBackedProp.__name__ = "_CBackedProp_%s" % op_type
+    _op._CUSTOM_REGISTRY[op_type] = _CBackedProp
+    # the frontend owns the creator's lifetime (reference keeps it in its
+    # own ref_holder); ours pins the ctypes wrapper for the process
+    _CUSTOM_CREATOR_REFS[op_type] = creator
+
+
+_CUSTOM_CREATOR_REFS: Dict[str, Any] = {}

@@ -205,6 +205,19 @@ class CustomOpProp:
     def need_top_grad(self):
         return self.need_top_grad_
 
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Tensors backward depends on (reference operator.py:~540): the
+        dependency-pruning hook.  XLA dead-code-eliminates unused inputs in
+        the compiled vjp, so this surface exists for API parity and for
+        ABI-registered props to expose their declaration; the executor
+        always materializes the full set (documented divergence)."""
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
     def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
         raise NotImplementedError()
 
@@ -245,14 +258,17 @@ class _NativeShimOp(_NDArrayShimOp):
 
 @register_op("Custom", hint="custom")
 class CustomSymbolOp(OpDef):
-    """sym.Custom(..., op_type='name') (reference custom-inl.h:211)."""
+    """sym.Custom(..., op_type='name') (reference custom-inl.h:211).
+    Extra kwargs beyond op_type flow to the prop constructor as strings
+    (reference keeps them as the kwargs_ vector handed to the creator)."""
     params = [Param("op_type", str, required=True)]
+    allow_extra_params = True
 
     def _prop(self, p) -> CustomOpProp:
         if p.op_type not in _CUSTOM_REGISTRY:
             raise MXNetError("custom op %r not registered (have %s)"
                              % (p.op_type, get_all_registered_operators()))
-        prop = _CUSTOM_REGISTRY[p.op_type]()
+        prop = _CUSTOM_REGISTRY[p.op_type](**(p.get("_extras") or {}))
         return prop
 
     def list_arguments(self, p):

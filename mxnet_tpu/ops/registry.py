@@ -119,6 +119,10 @@ class OpDef:
     needs_rng: bool = False
     # key_var_num_args analogue: op takes variable #inputs (Concat, ElementWiseSum)
     variable_args: Optional[str] = None  # name of the num_args param
+    # ops forwarding arbitrary kwargs to a user plugin (Custom: reference
+    # custom-inl.h keeps them as the kwargs_ vector handed to the prop
+    # creator); unknown params are collected under p._extras as strings
+    allow_extra_params: bool = False
 
     def __init__(self, name: str):
         self.name = name
@@ -127,11 +131,17 @@ class OpDef:
     def parse_params(self, kwargs: Dict[str, Any]) -> _AttrDict:
         p = _AttrDict()
         schema = {x.name: x for x in self.params}
+        extras = {}
         for k, v in kwargs.items():
             if k not in schema:
+                if self.allow_extra_params:
+                    extras[k] = str(v)
+                    continue
                 raise MXNetError("%s got unknown parameter %r (accepts: %s)"
                                  % (self.name, k, sorted(schema)))
             p[k] = schema[k].parse(v)
+        if self.allow_extra_params:
+            p["_extras"] = extras
         for x in self.params:
             if x.name not in p:
                 if x.required:
@@ -145,6 +155,8 @@ class OpDef:
             v = p.get(x.name)
             if v is not None:
                 out[x.name] = x.to_string(v)
+        if self.allow_extra_params:
+            out.update(p.get("_extras") or {})
         return out
 
     def list_arguments(self, p) -> List[str]:

@@ -13,6 +13,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -259,6 +260,38 @@ int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
   API_END();
 }
 
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  API_BEGIN();
+  PyObject *ret = BridgeCall("ndarray_save_raw",
+                             Py_BuildValue("(L)", H(handle)));
+  if (ret == nullptr) return -1;
+  char *data = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(ret, &data, &n) != 0) {
+    CaptureError();
+    Py_DECREF(ret);
+    return -1;
+  }
+  arena.clear();
+  arena.strs.emplace_back(data, static_cast<size_t>(n));
+  *out_buf = arena.strs.back().data();
+  *out_size = static_cast<size_t>(n);
+  Py_DECREF(ret);
+  API_END();
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *bytes = PyBytes_FromStringAndSize(
+      static_cast<const char *>(buf), static_cast<Py_ssize_t>(size));
+  if (ReturnHandle(BridgeCall("ndarray_load_raw",
+                              Py_BuildValue("(N)", bytes)), out))
+    return -1;
+  API_END();
+}
+
 int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                   NDArrayHandle **out_arr, mx_uint *out_name_size,
                   const char ***out_names) {
@@ -331,6 +364,21 @@ int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
   PyObject *args = Py_BuildValue(
       "(sNNN)", static_cast<const char *>(fun), HandleList(use_vars, nuse),
       FloatList(scalar_args, nscalar), HandleList(mutate_vars, nmutate));
+  CHECK_CALL(BridgeCall("func_invoke", args));
+  API_END();
+}
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals) {
+  API_BEGIN();
+  mx_uint nuse, nscalar, nmutate; int mask;
+  if (MXFuncDescribe(fun, &nuse, &nscalar, &nmutate, &mask) != 0) return -1;
+  PyObject *args = Py_BuildValue(
+      "(sNNNNN)", static_cast<const char *>(fun), HandleList(use_vars, nuse),
+      FloatList(scalar_args, nscalar), HandleList(mutate_vars, nmutate),
+      StrList(const_cast<const char **>(param_keys), num_params),
+      StrList(const_cast<const char **>(param_vals), num_params));
   CHECK_CALL(BridgeCall("func_invoke", args));
   API_END();
 }
@@ -482,9 +530,8 @@ int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value) {
   API_END();
 }
 
-int MXSymbolListAttr(SymbolHandle symbol, int recursive, mx_uint *out_size,
-                     const char ***out) {
-  API_BEGIN();
+static int ListAttrCall(SymbolHandle symbol, int recursive, mx_uint *out_size,
+                        const char ***out) {
   PyObject *ret = BridgeCall("symbol_list_attr",
                              Py_BuildValue("(Li)", H(symbol), recursive));
   if (ret == nullptr) return -1;
@@ -495,6 +542,47 @@ int MXSymbolListAttr(SymbolHandle symbol, int recursive, mx_uint *out_size,
    * strings (key/value pairs) */
   *out_size = flat_size / 2;
   Py_DECREF(ret);
+  return 0;
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out) {
+  API_BEGIN();
+  if (ListAttrCall(symbol, 1, out_size, out)) return -1;
+  API_END();
+}
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out) {
+  API_BEGIN();
+  if (ListAttrCall(symbol, 0, out_size, out)) return -1;
+  API_END();
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  API_BEGIN();
+  PyObject *ret = BridgeCall("symbol_get_name",
+                             Py_BuildValue("(L)", H(symbol)));
+  if (ret == nullptr) return -1;
+  if (ret == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    arena.clear();
+    arena.strs.emplace_back(PyUnicode_AsUTF8(ret));
+    *out = arena.strs.back().c_str();
+    *success = 1;
+  }
+  Py_DECREF(ret);
+  API_END();
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  API_BEGIN();
+  /* creator handles ARE interned op-name strings (MXGetFunction /
+   * InternedListCall contract) */
+  *name = static_cast<const char *>(creator);
   API_END();
 }
 
@@ -701,6 +789,19 @@ int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
   arena.clear();
   *out = ArenaHandleArray(ret, out_size);
   Py_DECREF(ret);
+  API_END();
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  API_BEGIN();
+  CHECK_CALL(BridgeCall(
+      "executor_set_monitor_addr",
+      Py_BuildValue("(LLL)", H(handle),
+                    static_cast<long long>(
+                        reinterpret_cast<intptr_t>(callback)),
+                    H(callback_handle))));
   API_END();
 }
 
@@ -916,6 +1017,34 @@ int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
   API_END();
 }
 
+/* Role queries (reference c_api.h:1218-1238): pure env reads — same
+ * contract ps-lite derives its roles from (DMLC_ROLE, tools/launch.py);
+ * no bridge call so they work before any kvstore exists. */
+static int RoleIs(const char *want) {
+  const char *role = getenv("DMLC_ROLE");
+  if (role == nullptr) role = "worker";
+  return strcmp(role, want) == 0 ? 1 : 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret) {
+  API_BEGIN();
+  /* reference semantics: worker = not a server, not a scheduler */
+  *ret = (RoleIs("server") || RoleIs("scheduler")) ? 0 : 1;
+  API_END();
+}
+
+int MXKVStoreIsServerNode(int *ret) {
+  API_BEGIN();
+  *ret = RoleIs("server");
+  API_END();
+}
+
+int MXKVStoreIsSchedulerNode(int *ret) {
+  API_BEGIN();
+  *ret = RoleIs("scheduler");
+  API_END();
+}
+
 int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
   API_BEGIN();
   if (ReturnString(BridgeCall("kvstore_get_type", Py_BuildValue("(L)", H(handle))),
@@ -1103,5 +1232,17 @@ int MXOptimizerUpdate(OptimizerHandle handle, int index, NDArrayHandle weight,
   CHECK_CALL(BridgeCall("optimizer_update",
                         Py_BuildValue("(LiLLff)", H(handle), index, H(weight),
                                       H(grad), lr, wd)));
+  API_END();
+}
+
+/* -------------------- Custom operators -------------------- */
+
+int MXCustomOpRegister(const char *op_type, CustomOpPropCreator creator) {
+  API_BEGIN();
+  CHECK_CALL(BridgeCall(
+      "custom_op_register",
+      Py_BuildValue("(sL)", op_type,
+                    static_cast<long long>(
+                        reinterpret_cast<intptr_t>(creator)))));
   API_END();
 }
