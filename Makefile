@@ -6,7 +6,8 @@
 CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -pthread
 LIB = mxnet_tpu/libmxtpu.so
-SRCS = src/recordio.cc src/data_loader.cc src/engine.cc src/storage.cc
+SRCS = src/recordio.cc src/image_decode.cc src/data_loader.cc src/engine.cc \
+       src/storage.cc
 
 # C ABI (reference src/c_api/): embeds CPython, forwards MX* to the JAX core
 PY_INCLUDES := $(shell python3-config --includes)
@@ -17,9 +18,9 @@ PREDICT_LIB = mxnet_tpu/libmxtpu_predict.so
 
 all: $(LIB) bin/im2rec $(CAPI_LIB) $(PREDICT_LIB)
 
-$(LIB): $(SRCS) src/recordio.h
+$(LIB): $(SRCS) src/recordio.h src/image_decode.h
 	@mkdir -p $(dir $@)
-	$(CXX) $(CXXFLAGS) -shared $(SRCS) -o $@
+	$(CXX) $(CXXFLAGS) -shared $(SRCS) -o $@ -ljpeg
 
 $(CAPI_LIB): src/c_api.cc src/c_predict_api.cc src/c_api_common.h \
              include/c_api.h include/c_predict_api.h
@@ -34,9 +35,11 @@ $(PREDICT_LIB): src/c_predict_api.cc src/c_api_common.h include/c_predict_api.h
 	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -DMXTPU_PREDICT_STANDALONE -shared \
 	    src/c_predict_api.cc -o $@ $(PY_LDFLAGS) $(PY_LIB)
 
-bin/im2rec: src/im2rec.cc src/recordio.cc src/recordio.h
+bin/im2rec: src/im2rec.cc src/recordio.cc src/image_decode.cc src/recordio.h \
+            src/image_decode.h
 	@mkdir -p bin
-	$(CXX) $(CXXFLAGS) src/im2rec.cc src/recordio.cc -o $@
+	$(CXX) $(CXXFLAGS) src/im2rec.cc src/recordio.cc src/image_decode.cc \
+	    -o $@ -ljpeg
 
 test: all
 	python -m pytest tests/ -q

@@ -45,6 +45,28 @@ def _node_aux_names(node: _Node) -> List[str]:
             for an in node.op.list_auxiliary_states(node.params)]
 
 
+def _head_grad_unused(node: _Node, memo: dict) -> bool:
+    """True when an omitted head gradient for this output cannot reach any
+    argument: every backward path from the head hits an op whose vjp
+    ignores the incoming gradient (BlockGrad, the injected-loss layers) —
+    the graph-walk analogue of the reference's ref_count==0 omission
+    check (graph_executor.cc:1017-1024).  A bare Reshape/slice wrapper
+    around a BlockGrad'd state therefore still qualifies."""
+    key = id(node)
+    if key in memo:
+        return memo[key]
+    if node.is_variable:
+        result = False       # gradient would land on a parameter
+    elif getattr(node.op, "head_grad_optional", False):
+        result = True        # vjp discards the incoming gradient
+    else:
+        memo[key] = True     # break cycles conservatively-optional
+        result = all(_head_grad_unused(inp, memo)
+                     for (inp, _) in node.inputs)
+    memo[key] = result
+    return result
+
+
 class _GraphProgram:
     """Pure function over (args, aux, rng, is_train) compiled once per mode."""
 
@@ -278,9 +300,20 @@ class Executor:
                     "backward() got %d out_grads for %d outputs"
                     % (len(head_grads), len(self._outputs_nd)))
             if len(head_grads) < len(self._outputs_nd):
-                # reference pads unsupplied head grads with zeros — callers
-                # commonly grad only the loss heads of a Group whose tail
-                # outputs (BlockGrad'd states) take no gradient
+                # the reference permits omission only for outputs whose
+                # gradient is unused (ref_count==0,
+                # graph_executor.cc:1017-1024) — here, heads produced by
+                # ops whose backward ignores the incoming gradient (loss
+                # layers with injected gradients, BlockGrad'd states).
+                # Omitting a REQUIRED head grad is a caller bug that must
+                # not silently train with zero gradients.
+                for k in range(len(head_grads), len(self._outputs_nd)):
+                    node = self._symbol._heads[k][0]
+                    if not _head_grad_unused(node, {}):
+                        raise MXNetError(
+                            "backward() got %d out_grads but output %d "
+                            "(%s) requires a head gradient" %
+                            (len(head_grads), k, node.name))
                 head_grads += [jnp.zeros_like(o._get())
                                for o in self._outputs_nd[len(head_grads):]]
             # caller-made head grads may live on another device (default-

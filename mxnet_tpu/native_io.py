@@ -32,9 +32,11 @@ def _load():
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.POINTER(ctypes.c_float), ctypes.c_float,
-        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
     lib.mxtpu_loader_num_records.restype = ctypes.c_long
     lib.mxtpu_loader_num_records.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_loader_last_error.restype = ctypes.c_char_p
+    lib.mxtpu_loader_last_error.argtypes = [ctypes.c_void_p]
     lib.mxtpu_loader_next.restype = ctypes.c_int
     lib.mxtpu_loader_next.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
@@ -62,7 +64,8 @@ class NativeBatchLoader:
                  label_width: int = 1, threads: int = 4, shuffle: bool = False,
                  rand_crop: bool = False, rand_mirror: bool = False,
                  mean_rgb=None, scale: float = 1.0, part_index: int = 0,
-                 num_parts: int = 1, seed: int = 0, queue_depth: int = 4):
+                 num_parts: int = 1, seed: int = 0, queue_depth: int = 4,
+                 resize: int = 0):
         lib = _load()
         if lib is None:
             raise RuntimeError("libmxtpu.so not built; run make")
@@ -75,7 +78,8 @@ class NativeBatchLoader:
         self._h = lib.mxtpu_loader_create(
             path.encode(), batch_size, c, h, w, label_width, threads,
             int(shuffle), int(rand_crop), int(rand_mirror), mean_ptr,
-            float(scale), part_index, num_parts, seed, queue_depth)
+            float(scale), part_index, num_parts, seed, queue_depth,
+            int(resize))
         if not self._h:
             raise RuntimeError("failed to open %s" % path)
         self.batch_size = batch_size
@@ -89,13 +93,18 @@ class NativeBatchLoader:
         return int(self._lib.mxtpu_loader_num_records(self._h))
 
     def next(self):
-        """Return (data, label, pad) numpy copies or None at epoch end."""
+        """Return (data, label, pad) numpy copies, None at epoch end.
+        A decode failure in any worker (corrupt JPEG, undersized image)
+        raises — garbage batches are never silently delivered."""
         pad = ctypes.c_int(0)
         rc = self._lib.mxtpu_loader_next(
             self._h,
             self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             ctypes.byref(pad))
+        if rc == 2:
+            msg = self._lib.mxtpu_loader_last_error(self._h) or b""
+            raise RuntimeError("native loader: %s" % msg.decode())
         if rc != 0:
             return None
         return (self._data_buf.copy(), self._label_buf.copy(), pad.value)

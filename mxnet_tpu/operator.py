@@ -13,6 +13,7 @@ callback runs on host; XLA overlaps it with device work where possible.
 """
 from __future__ import annotations
 
+import logging
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -95,7 +96,14 @@ class NumpyOp(PythonOp):
                 if partial:
                     try:
                         ins, outs = op_ref.infer_shape(shapes_arg)
-                    except Exception:
+                    except (TypeError, ValueError, IndexError,
+                            AttributeError) as e:
+                        # the expected failure mode: user infer_shape
+                        # indexing a still-None secondary shape.  Other
+                        # exception types are real bugs and propagate.
+                        logging.debug(
+                            "NumpyOp %s.infer_shape deferred on partial "
+                            "shapes (%s); retrying when known", op_name, e)
                         return (in_shapes,
                                 [None] * len(op_ref.list_outputs()), [])
                 else:

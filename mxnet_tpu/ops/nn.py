@@ -481,6 +481,7 @@ def _softmax_output_forward(p, data, label):
 @register_op("SoftmaxOutput", hint="softmaxoutput")
 class SoftmaxOutputOp(OpDef):
     """reference softmax_output-inl.h:342."""
+    head_grad_optional = True
     params = [Param("grad_scale", float, default=1.0),
               Param("ignore_label", float, default=-1.0),
               Param("multi_output", bool, default=False),
@@ -548,6 +549,7 @@ def _regression_forward(p, kind, data, label):
 
 
 class _RegressionBase(OpDef):
+    head_grad_optional = True
     params = [Param("grad_scale", float, default=1.0)]
     kind = "linear"
 
@@ -595,6 +597,7 @@ class MAERegressionOutputOp(_RegressionBase):
 class MakeLossOp(OpDef):
     """reference make_loss-inl.h: forward identity; backward injects
     grad_scale (optionally normalized) regardless of head gradient."""
+    head_grad_optional = True
     params = [Param("grad_scale", float, default=1.0),
               Param("normalization", str, default="null",
                     enum=["null", "batch", "valid"]),
@@ -625,6 +628,7 @@ class MakeLossOp(OpDef):
 @register_op("SVMOutput", hint="svmoutput")
 class SVMOutputOp(OpDef):
     """reference svm_output-inl.h: hinge-loss gradient layer."""
+    head_grad_optional = True
     params = [Param("margin", float, default=1.0),
               Param("regularization_coefficient", float, default=1.0),
               Param("use_linear", bool, default=False)]

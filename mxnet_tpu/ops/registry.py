@@ -123,6 +123,12 @@ class OpDef:
     # custom-inl.h keeps them as the kwargs_ vector handed to the prop
     # creator); unknown params are collected under p._extras as strings
     allow_extra_params: bool = False
+    # True for ops whose backward ignores the incoming head gradient (loss
+    # layers with injected gradients, BlockGrad): executor.backward() may
+    # zero-pad an unsupplied head grad for these outputs only — the
+    # analogue of the reference's ref_count==0 omission check
+    # (graph_executor.cc:1017-1024)
+    head_grad_optional: bool = False
 
     def __init__(self, name: str):
         self.name = name

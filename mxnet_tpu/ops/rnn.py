@@ -92,18 +92,19 @@ class RNNOp(OpDef):
         c0 = inputs[2 + 4 * L] if p.mode == "lstm" else None
         mode = p.mode
 
-        def cell(wi, bi, wh, bh, x, h, c):
-            # one fused matmul pair per step: (B,E)@(E,GH) + (B,H)@(H,GH)
+        def cell(gi, wh, bh, h, c):
+            # gi is this step's PRE-COMPUTED input projection (hoisted out
+            # of the scan, see below); only the recurrent (B,H)@(H,GH)
+            # matmul is inherently sequential
+            gh = h @ wh.T + bh
             if mode == "gru":
-                # keep the two matmuls separate: the candidate slice
-                # needs the reset gate applied to the recurrent term only
-                gi = x @ wi.T + bi
-                gh = h @ wh.T + bh
+                # the candidate slice needs the reset gate applied to the
+                # recurrent term only, so gi/gh stay separate
                 r = jax.nn.sigmoid(gi[:, :H] + gh[:, :H])
                 z = jax.nn.sigmoid(gi[:, H:2 * H] + gh[:, H:2 * H])
                 n = jnp.tanh(gi[:, 2 * H:] + r * gh[:, 2 * H:])
                 return (1 - z) * n + z * h, None
-            g = x @ wi.T + bi + h @ wh.T + bh
+            g = gi + gh
             if mode == "lstm":
                 # gate slice order matches models/lstm.py lstm_cell:
                 # [in, transform, forget, out]
@@ -132,13 +133,20 @@ class RNNOp(OpDef):
             h_init = h0[i]
             c_init = c0[i] if c0 is not None else jnp.zeros_like(h_init)
 
-            def step(carry, x, wi=wi, bi=bi, wh=wh, bh=bh):
+            # hoist the input projection out of the time loop: ONE
+            # (T*B,E)@(E,GH) MXU-sized matmul for the whole sequence
+            # (the cuDNN-LSTM recipe the reference gets from cudnn_rnn;
+            # here it also shrinks the scan body to the recurrent matmul
+            # + elementwise gates, halving the sequential matmul count)
+            gi_all = layer_in @ wi.T + bi
+
+            def step(carry, gi, wh=wh, bh=bh):
                 h, c = carry
-                h_new, c_new = cell(wi, bi, wh, bh, x, h, c)
+                h_new, c_new = cell(gi, wh, bh, h, c)
                 return (h_new, c_new if c_new is not None else c), h_new
 
             (h_fin, c_fin), outs = lax.scan(step, (h_init, c_init),
-                                            layer_in)
+                                            gi_all)
             finals_h.append(h_fin)
             finals_c.append(c_fin)
             layer_in = outs

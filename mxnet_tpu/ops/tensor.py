@@ -514,6 +514,7 @@ class SwapAxisOp(OpDef):
 @register_op("BlockGrad", hint="blockgrad")
 class BlockGradOp(OpDef):
     """reference block_grad-inl.h: identity forward, zero gradient."""
+    head_grad_optional = True
 
     def forward(self, p, inputs, aux, ctx):
         return [lax.stop_gradient(inputs[0])]

@@ -14,6 +14,7 @@ object Base {
   type ExecutorHandle = Long
   type KVStoreHandle = Long
   type OptimizerHandle = Long
+  type DataIterHandle = Long
 
   class MXNetError(val message: String) extends Exception(message)
 

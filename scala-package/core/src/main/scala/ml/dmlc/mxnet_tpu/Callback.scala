@@ -13,6 +13,29 @@ object Callback {
                auxParams: Map[String, NDArray]): Unit
   }
 
+  /** Checkpoint every epoch through Model.saveCheckpoint (reference
+   * FeedForward's doCheckpoint factory). */
+  def doCheckpoint(prefix: String): EpochEndCallback =
+    new EpochEndCallback {
+      override def invoke(epoch: Int, symbol: Symbol,
+                          argParams: Map[String, NDArray],
+                          auxParams: Map[String, NDArray]): Unit =
+        Model.saveCheckpoint(prefix, epoch + 1, symbol, argParams,
+                             auxParams)
+    }
+
+  /** Textual epoch progress bar (reference ProgressBar). */
+  class ProgressBar(total: Int, length: Int = 80)
+      extends BatchEndCallback {
+    override def invoke(epoch: Int, count: Int,
+                        metric: EvalMetric): Unit = {
+      val filled = math.min(length, length * count / math.max(1, total))
+      val bar = "=" * filled + ">" + "." * (length - filled)
+      printf("Epoch[%d] [%s] %d/%d\r", epoch, bar, count, total)
+      if (count >= total) println()
+    }
+  }
+
   class Speedometer(batchSize: Int, frequent: Int = 50)
       extends BatchEndCallback {
     private var init = false

@@ -48,3 +48,129 @@ class MAE extends EvalMetric("mae") {
     }
   }
 }
+
+class MSE extends EvalMetric("mse") {
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray])
+      : Unit = {
+    for ((label, pred) <- labels.zip(preds)) {
+      val y = label.toArray
+      val p = pred.toArray
+      sumMetric += y.zip(p).map { case (a, b) =>
+        (a - b).toDouble * (a - b) }.sum
+      numInst += y.length
+    }
+  }
+}
+
+class RMSE extends EvalMetric("rmse") {
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray])
+      : Unit = {
+    for ((label, pred) <- labels.zip(preds)) {
+      val y = label.toArray
+      val p = pred.toArray
+      val mse = y.zip(p).map { case (a, b) =>
+        (a - b).toDouble * (a - b) }.sum / y.length
+      sumMetric += math.sqrt(mse)
+      numInst += 1   // reference RMSE averages per-batch roots
+    }
+  }
+}
+
+/** Top-k classification accuracy (reference TopKAccuracy). */
+class TopKAccuracy(topK: Int) extends EvalMetric(s"top_k_accuracy_$topK") {
+  require(topK > 1, "use Accuracy for top-1")
+
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray])
+      : Unit = {
+    for ((label, pred) <- labels.zip(preds)) {
+      val probs = pred.toArray
+      val y = label.toArray
+      val classes = pred.shape(1)
+      val k = math.min(topK, classes)
+      for (i <- y.indices) {
+        val row = probs.slice(i * classes, (i + 1) * classes)
+        val top = row.zipWithIndex.sortBy(-_._1).take(k).map(_._2)
+        if (top.contains(y(i).toInt)) sumMetric += 1
+        numInst += 1
+      }
+    }
+  }
+}
+
+/** Binary-classification F1 over argmax predictions (reference F1). */
+class F1 extends EvalMetric("f1") {
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray])
+      : Unit = {
+    for ((label, pred) <- labels.zip(preds)) {
+      val probs = pred.toArray
+      val y = label.toArray
+      val classes = pred.shape(1)
+      require(classes == 2, "F1 is defined for binary classification")
+      var tp = 0.0; var fp = 0.0; var fn = 0.0
+      for (i <- y.indices) {
+        val predicted = if (probs(i * classes + 1) > probs(i * classes)) 1
+                        else 0
+        (predicted, y(i).toInt) match {
+          case (1, 1) => tp += 1
+          case (1, 0) => fp += 1
+          case (0, 1) => fn += 1
+          case _ =>
+        }
+      }
+      val precision = if (tp + fp > 0) tp / (tp + fp) else 0.0
+      val recall = if (tp + fn > 0) tp / (tp + fn) else 0.0
+      val f1 = if (precision + recall > 0)
+        2 * precision * recall / (precision + recall) else 0.0
+      sumMetric += f1
+      numInst += 1
+    }
+  }
+}
+
+/** Mean negative log-likelihood of the labeled class (reference
+ * CrossEntropy). */
+class CrossEntropy extends EvalMetric("cross-entropy") {
+  private val eps = 1e-8f
+
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray])
+      : Unit = {
+    for ((label, pred) <- labels.zip(preds)) {
+      val probs = pred.toArray
+      val y = label.toArray
+      val classes = pred.shape(1)
+      for (i <- y.indices) {
+        val p = probs(i * classes + y(i).toInt)
+        sumMetric -= math.log(math.max(p, eps))
+        numInst += 1
+      }
+    }
+  }
+}
+
+/** Run several metrics over the same batches (reference
+ * CompositeEvalMetric); `get` reports the first, `getAll` every one. */
+class CompositeEvalMetric(metrics: IndexedSeq[EvalMetric])
+    extends EvalMetric("composite") {
+  require(metrics.nonEmpty)
+
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray])
+      : Unit = metrics.foreach(_.update(labels, preds))
+
+  override def reset(): Unit = metrics.foreach(_.reset())
+
+  override def get: (String, Float) = metrics.head.get
+
+  def getAll: IndexedSeq[(String, Float)] = metrics.map(_.get)
+}
+
+/** Wrap a plain function as a metric (reference CustomMetric). */
+class CustomMetric(fEval: (NDArray, NDArray) => Float, name: String)
+    extends EvalMetric(name) {
+  def update(labels: IndexedSeq[NDArray], preds: IndexedSeq[NDArray])
+      : Unit = {
+    for ((label, pred) <- labels.zip(preds)) {
+      sumMetric += fEval(label, pred)
+      numInst += 1
+    }
+  }
+}

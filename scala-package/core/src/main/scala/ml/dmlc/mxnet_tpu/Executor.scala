@@ -32,6 +32,37 @@ class Executor private[mxnet_tpu](
     hs.map(new NDArray(_, writable = false)).toIndexedSeq
   }
 
+  lazy val auxDict: Map[String, NDArray] =
+    symbol.listAuxiliaryStates().zip(auxArrays).toMap
+
+  /** Execution-plan dump (MXExecutorPrint; reference debugStr). */
+  def debugStr: String = {
+    val s = _LIB.mxExecutorPrint(handle)
+    require(s != null, _LIB.mxGetLastError())
+    s
+  }
+
+  /** Copy a parameter checkpoint into the bound arrays (reference
+   * copyParamsFrom); unknown names error unless allowExtra. */
+  def copyParamsFrom(argParams: Map[String, NDArray],
+                     auxParams: Map[String, NDArray] = Map.empty,
+                     allowExtraParams: Boolean = false): Unit = {
+    for ((name, src) <- argParams) {
+      argDict.get(name) match {
+        case Some(dst) => src.copyTo(dst)
+        case None if allowExtraParams =>
+        case None => throw new MXNetError(s"unknown argument $name")
+      }
+    }
+    for ((name, src) <- auxParams) {
+      auxDict.get(name) match {
+        case Some(dst) => src.copyTo(dst)
+        case None if allowExtraParams =>
+        case None => throw new MXNetError(s"unknown aux state $name")
+      }
+    }
+  }
+
   def dispose(): Unit = checkCall(_LIB.mxExecutorFree(handle))
 }
 

@@ -32,6 +32,55 @@ class Normal(sigma: Float = 0.01f) extends Initializer {
   }
 }
 
+/** Every weight the same constant (reference Constant/Zero/One). */
+class Constant(value: Float) extends Initializer {
+  protected def initWeight(name: String, arr: NDArray): Unit =
+    arr.set(value)
+}
+
+class Zero extends Constant(0f)
+class One extends Constant(1f)
+
+/** He/MSRA init with the PReLU slope correction (reference MSRAPrelu):
+ * variance 2/((1+slope^2) * factor). */
+class MSRAPrelu(factorType: String = "avg", slope: Float = 0.25f)
+    extends Initializer {
+  protected def initWeight(name: String, arr: NDArray): Unit = {
+    val shape = arr.shape
+    val fanOut = shape(0).toFloat
+    val fanIn = shape.drop(1).product.toFloat
+    val factor = factorType match {
+      case "avg" => (fanIn + fanOut) / 2f
+      case "in" => fanIn
+      case "out" => fanOut
+      case other => throw new Base.MXNetError(s"bad factor_type $other")
+    }
+    val scale =
+      math.sqrt(2.0f / (factor * (1 + slope * slope))).toFloat
+    val rnd = new scala.util.Random(name.hashCode)
+    arr.set(Array.fill(arr.size)(rnd.nextGaussian().toFloat * scale))
+  }
+}
+
+/** Route parameter names to member initializers by pattern (reference
+ * Mixed): first matching regex wins. */
+class Mixed(patterns: IndexedSeq[String], initializers: IndexedSeq[Initializer])
+    extends Initializer {
+  require(patterns.length == initializers.length)
+  private val compiled = patterns.map(_.r)
+
+  override def apply(name: String, arr: NDArray): Unit = {
+    compiled.zip(initializers).find(_._1.findFirstIn(name).isDefined) match {
+      case Some((_, init)) => init(name, arr)
+      case None => throw new Base.MXNetError(
+        s"no initializer pattern matches $name; add a catch-all '.*'")
+    }
+  }
+
+  protected def initWeight(name: String, arr: NDArray): Unit =
+    throw new IllegalStateException("Mixed routes through apply")
+}
+
 /** Xavier/Glorot: scale by fan-in/fan-out (reference Initializer.scala). */
 class Xavier(rndType: String = "uniform", factorType: String = "avg",
              magnitude: Float = 3f) extends Initializer {

@@ -41,6 +41,11 @@ class KVStore private[mxnet_tpu](
 
   def barrier(): Unit = checkCall(_LIB.mxKVStoreBarrier(handle))
 
+  /** Ship a command to every server (reference sendCommandToServers;
+   * the ABI keeps the reference's typo'd symbol name). */
+  def sendCommandToServers(head: Int, body: String): Unit =
+    checkCall(_LIB.mxKVStoreSendCommmandToServers(handle, head, body))
+
   def dispose(): Unit = checkCall(_LIB.mxKVStoreFree(handle))
 }
 
@@ -50,4 +55,17 @@ object KVStore {
     checkCall(_LIB.mxKVStoreCreate(kvType, out))
     new KVStore(out(0))
   }
+
+  /** Process-role queries driven by DMLC_ROLE (reference
+   * isWorkerNode/isServerNode/isSchedulerNode; usable before any store
+   * exists — tools/launch.py sets the role env). */
+  private def role(fn: Array[Int] => Int): Boolean = {
+    val out = new Array[Int](1)
+    checkCall(fn(out))
+    out(0) == 1
+  }
+
+  def isWorkerNode: Boolean = role(_LIB.mxKVStoreIsWorkerNode)
+  def isServerNode: Boolean = role(_LIB.mxKVStoreIsServerNode)
+  def isSchedulerNode: Boolean = role(_LIB.mxKVStoreIsSchedulerNode)
 }

@@ -40,8 +40,13 @@ object KVStoreServer {
         kv.dispose()
       }
     } catch {
-      // end-of-job SystemExit from the import-owned loop — done
-      case _: MXNetError if serverRole => ()
+      // ONLY the clean end-of-job sentinel (the bridge maps the serving
+      // loop's SystemExit(0) to this exact message) counts as normal
+      // completion; any other bridge failure — bad cluster config,
+      // connect errors — must surface, not vanish as a silent "done"
+      case e: MXNetError
+          if serverRole && e.getMessage != null &&
+             e.getMessage.contains("end of job (SystemExit 0)") => ()
     }
   }
 }

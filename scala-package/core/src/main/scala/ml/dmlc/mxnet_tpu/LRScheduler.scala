@@ -21,3 +21,23 @@ class FactorScheduler(step: Int, factor: Float) extends LRScheduler {
     baseLR * decay
   }
 }
+
+/** Decay at explicit update milestones (reference MultiFactorScheduler;
+ * python lr_scheduler.MultiFactorScheduler). */
+class MultiFactorScheduler(steps: IndexedSeq[Int], factor: Float)
+    extends LRScheduler {
+  require(steps.nonEmpty && steps.head >= 1, "steps must start >= 1")
+  require(steps.sliding(2).forall(p => p.length < 2 || p(0) < p(1)),
+          "steps must be strictly increasing")
+  require(factor < 1f, "factor must decay")
+  private var at = 0
+  private var decay = 1f
+
+  def apply(numUpdate: Int): Float = {
+    while (at < steps.length && numUpdate > steps(at)) {
+      decay *= factor
+      at += 1
+    }
+    baseLR * decay
+  }
+}

@@ -97,7 +97,46 @@ class LibInfo {
                                 grad: Long, lr: Float, wd: Float): Int
   @native def mxOptimizerFree(handle: Long): Int
 
+  // data iterators
+  @native def mxListDataIters(): Array[Long]
+  @native def mxDataIterGetName(creator: Long): String
+  @native def mxDataIterCreateIter(creator: Long, keys: Array[String],
+                                   vals: Array[String],
+                                   out: Array[Long]): Int
+  @native def mxDataIterFree(handle: Long): Int
+  @native def mxDataIterNext(handle: Long, out: Array[Int]): Int
+  @native def mxDataIterBeforeFirst(handle: Long): Int
+  @native def mxDataIterGetData(handle: Long, out: Array[Long]): Int
+  @native def mxDataIterGetLabel(handle: Long, out: Array[Long]): Int
+  @native def mxDataIterGetPadNum(handle: Long, out: Array[Int]): Int
+
+  // raw-byte serialization + dtype
+  @native def mxNDArraySaveRawBytes(handle: Long): Array[Byte]
+  @native def mxNDArrayLoadFromRawBytes(buf: Array[Byte],
+                                        out: Array[Long]): Int
+  @native def mxNDArrayGetDType(handle: Long, out: Array[Int]): Int
+
+  // function registry kwargs channel (MXFuncInvokeEx)
+  @native def mxFuncInvokeEx(fn: Long, useVars: Array[Long],
+                             scalars: Array[Float],
+                             mutateVars: Array[Long],
+                             keys: Array[String],
+                             vals: Array[String]): Int
+
+  // symbol names + attributes
+  @native def mxSymbolGetName(handle: Long): String
+  @native def mxSymbolListAttr(handle: Long): Array[String]
+  @native def mxSymbolListAttrShallow(handle: Long): Array[String]
+
+  // executor debug
+  @native def mxExecutorPrint(handle: Long): String
+
   // kvstore
+  @native def mxKVStoreIsWorkerNode(out: Array[Int]): Int
+  @native def mxKVStoreIsServerNode(out: Array[Int]): Int
+  @native def mxKVStoreIsSchedulerNode(out: Array[Int]): Int
+  @native def mxKVStoreSendCommmandToServers(handle: Long, head: Int,
+                                             body: String): Int
   @native def mxKVStoreCreate(kvType: String, out: Array[Long]): Int
   @native def mxKVStoreFree(handle: Long): Int
   @native def mxKVStoreInit(handle: Long, keys: Array[Int],

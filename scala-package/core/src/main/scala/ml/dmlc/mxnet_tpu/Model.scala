@@ -42,9 +42,22 @@ object Model {
   }
 
   private def readFile(path: String): String = {
+    // a single read() may return short for large files: loop to the end
     val f = new File(path)
-    val buf = new Array[Byte](f.length.toInt)
+    val total = f.length.toInt
+    val buf = new Array[Byte](total)
     val in = new FileInputStream(f)
-    try { in.read(buf); new String(buf, "UTF-8") } finally in.close()
+    try {
+      var off = 0
+      while (off < total) {
+        val n = in.read(buf, off, total - off)
+        if (n < 0) {
+          throw new java.io.IOException(
+            s"unexpected EOF at $off/$total bytes reading $path")
+        }
+        off += n
+      }
+      new String(buf, "UTF-8")
+    } finally in.close()
   }
 }

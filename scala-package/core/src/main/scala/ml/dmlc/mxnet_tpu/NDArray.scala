@@ -89,6 +89,43 @@ class NDArray private[mxnet_tpu](private[mxnet_tpu] val handle: NDArrayHandle,
   def -=(other: NDArray): NDArray = {
     NDArray.invoke("_minus", Array(this, other), Array(this)); this
   }
+  def *=(other: NDArray): NDArray = {
+    NDArray.invoke("_mul", Array(this, other), Array(this)); this
+  }
+  def /=(other: NDArray): NDArray = {
+    NDArray.invoke("_div", Array(this, other), Array(this)); this
+  }
+
+  def unary_- : NDArray = this * -1f
+
+  def dtype: Int = {
+    val out = new Array[Int](1)
+    checkCall(_LIB.mxNDArrayGetDType(handle, out))
+    out(0)
+  }
+
+  // registry names carry the SimpleOp underscore prefix (_sqrt etc.)
+  def sqrt: NDArray = NDArray.unary("_sqrt", this)
+  def square: NDArray = NDArray.unary("_square", this)
+  def exp: NDArray = NDArray.unary("_exp", this)
+  def log: NDArray = NDArray.unary("_log", this)
+  def abs: NDArray = NDArray.unary("_abs", this)
+  def sign: NDArray = NDArray.unary("_sign", this)
+
+  /** Scalar-valued reductions computed on device, read back as Float
+   * (reference NDArray.scala sum/max/min/norm). */
+  def sum: Float = NDArray.reduceToScalar("sum", this)
+  def max: Float = NDArray.reduceToScalar("max", this)
+  def min: Float = NDArray.reduceToScalar("min", this)
+  def norm: Float = NDArray.reduceToScalar("norm", this)
+
+  /** Self-describing raw bytes (MXNDArraySaveRawBytes framing): the
+   * cross-process / RDD-shuffle serialization format. */
+  def serialize(): Array[Byte] = {
+    val bytes = _LIB.mxNDArraySaveRawBytes(handle)
+    require(bytes != null, _LIB.mxGetLastError())
+    bytes
+  }
 
   def dispose(): Unit = checkCall(_LIB.mxNDArrayFree(handle))
 }
@@ -119,6 +156,78 @@ object NDArray {
     val out = empty(lhs.shape, lhs.context)
     invoke(name, Array(lhs), Array(out), Array(s))
     out
+  }
+
+  private[mxnet_tpu] def unary(name: String, src: NDArray): NDArray = {
+    val out = empty(src.shape, src.context)
+    invoke(name, Array(src), Array(out))
+    out
+  }
+
+  private[mxnet_tpu] def reduceToScalar(name: String,
+                                        src: NDArray): Float = {
+    val out = empty(Shape(1), src.context)
+    invoke(name, Array(src), Array(out))
+    out.toScalar
+  }
+
+  /** 2D matrix product through the registry (reference NDArray.dot). */
+  def dot(lhs: NDArray, rhs: NDArray): NDArray = {
+    require(lhs.shape.length == 2 && rhs.shape.length == 2,
+            "dot expects 2D inputs")
+    val out = empty(Shape(lhs.shape(0), rhs.shape(1)), lhs.context)
+    invoke("dot", Array(lhs, rhs), Array(out))
+    out
+  }
+
+  def maximum(lhs: NDArray, rhs: NDArray): NDArray =
+    binary("_maximum", lhs, rhs)
+  def minimum(lhs: NDArray, rhs: NDArray): NDArray =
+    binary("_minimum", lhs, rhs)
+  def power(lhs: NDArray, rhs: NDArray): NDArray =
+    binary("_power", lhs, rhs)
+
+  /** Elementwise clip (reference clip(src, a_min, a_max)). */
+  def clip(src: NDArray, aMin: Float, aMax: Float): NDArray = {
+    val out = empty(src.shape, src.context)
+    invoke("clip", Array(src), Array(out), Array(aMin, aMax))
+    out
+  }
+
+  /** One-hot rows from an index vector (reference onehotEncode). */
+  def onehotEncode(indices: NDArray, out: NDArray): NDArray = {
+    invoke("onehot_encode", Array(indices), Array(out))
+    out
+  }
+
+  /** Row-wise argmax (reference argmaxChannel). */
+  def argmaxChannel(src: NDArray): NDArray = {
+    val out = empty(Shape(src.shape(0)), src.context)
+    invoke("argmax_channel", Array(src), Array(out))
+    out
+  }
+
+  /** Stack along dim 0 via slice-assignment (reference concatenate). */
+  def concatenate(arrays: Seq[NDArray]): NDArray = {
+    require(arrays.nonEmpty, "nothing to concatenate")
+    val tail = arrays.head.shape.drop(1)
+    val rows = arrays.map(_.shape(0)).sum
+    require(arrays.forall(_.shape.drop(1) == tail),
+            "concatenate needs matching trailing dims")
+    val out = empty(Shape(rows +: tail.toVector), arrays.head.context)
+    var at = 0
+    for (a <- arrays) {
+      a.copyTo(out.slice(at, at + a.shape(0)))
+      at += a.shape(0)
+    }
+    out
+  }
+
+  /** Inverse of NDArray.serialize(). */
+  def deserialize(bytes: Array[Byte]): NDArray = {
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxNDArrayLoadFromRawBytes(bytes, out))
+    new NDArray(out(0))
   }
 
   def empty(shape: Shape, ctx: Context = Context.defaultCtx): NDArray = {

@@ -72,11 +72,70 @@ object SGD {
 }
 
 object Adam {
-  def apply(learningRate: Float = 0.001f, beta1: Float = 0.9f,
+  def apply(learningRate: Float = 0.002f, beta1: Float = 0.9f,
             beta2: Float = 0.999f, epsilon: Float = 1e-8f,
-            wd: Float = 0f): Optimizer =
+            wd: Float = 0f,
+            lrScheduler: Option[LRScheduler] = None): Optimizer =
     new Optimizer("adam",
                   Map("beta1" -> beta1.toString, "beta2" -> beta2.toString,
                       "epsilon" -> epsilon.toString),
-                  learningRate, wd)
+                  learningRate, wd, lrScheduler)
+}
+
+/** Nesterov accelerated SGD (python optimizer.py NAG). */
+object NAG {
+  def apply(learningRate: Float = 0.01f, momentum: Float = 0f,
+            wd: Float = 0f,
+            lrScheduler: Option[LRScheduler] = None): Optimizer =
+    new Optimizer("nag", Map("momentum" -> momentum.toString),
+                  learningRate, wd, lrScheduler)
+}
+
+/** Stochastic gradient Langevin dynamics (python optimizer.py SGLD):
+ * injects gradient noise scaled by sqrt(lr); no momentum state. */
+object SGLD {
+  def apply(learningRate: Float = 0.01f, wd: Float = 0f,
+            lrScheduler: Option[LRScheduler] = None): Optimizer =
+    new Optimizer("sgld", Map.empty, learningRate, wd, lrScheduler)
+}
+
+/** Legacy-layout SGD alias (python optimizer.py ccSGD: same math as SGD,
+ * kept for reference-script compatibility). */
+object CcSGD {
+  def apply(learningRate: Float = 0.01f, momentum: Float = 0f,
+            wd: Float = 0f,
+            lrScheduler: Option[LRScheduler] = None): Optimizer =
+    new Optimizer("ccsgd", Map("momentum" -> momentum.toString),
+                  learningRate, wd, lrScheduler)
+}
+
+/** Per-coordinate accumulated-square scaling (python AdaGrad). */
+object AdaGrad {
+  def apply(learningRate: Float = 0.05f, eps: Float = 1e-7f,
+            wd: Float = 0f,
+            lrScheduler: Option[LRScheduler] = None): Optimizer =
+    new Optimizer("adagrad", Map("eps" -> eps.toString),
+                  learningRate, wd, lrScheduler)
+}
+
+/** Tieleman & Hinton RMSProp with the reference's gamma1/gamma2 form
+ * (python optimizer.py RMSProp). */
+object RMSProp {
+  def apply(learningRate: Float = 0.002f, gamma1: Float = 0.95f,
+            gamma2: Float = 0.9f, wd: Float = 0f,
+            lrScheduler: Option[LRScheduler] = None): Optimizer =
+    new Optimizer("rmsprop",
+                  Map("gamma1" -> gamma1.toString,
+                      "gamma2" -> gamma2.toString),
+                  learningRate, wd, lrScheduler)
+}
+
+/** Zeiler's AdaDelta (python optimizer.py AdaDelta); the learning rate
+ * is nominal — the method derives its own per-coordinate step. */
+object AdaDelta {
+  def apply(rho: Float = 0.9f, epsilon: Float = 1e-5f,
+            wd: Float = 0f): Optimizer =
+    new Optimizer("adadelta",
+                  Map("rho" -> rho.toString, "epsilon" -> epsilon.toString),
+                  1.0f, wd)
 }

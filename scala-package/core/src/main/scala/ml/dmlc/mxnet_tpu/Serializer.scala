@@ -1,0 +1,59 @@
+package ml.dmlc.mxnet_tpu
+
+import java.io.{ByteArrayOutputStream, DataInputStream, DataOutputStream}
+import java.io.ByteArrayInputStream
+import java.nio.charset.StandardCharsets
+import java.util.Base64
+
+/**
+ * Wire serialization for model state (reference Serializer.scala — the
+ * surface Spark jobs use to ship params between driver and executors).
+ * NDArrays ride the ABI's self-describing raw-byte frame
+ * (MXNDArraySaveRawBytes), maps are length-prefixed name/payload pairs,
+ * and `encodeBase64`/`decodeBase64` give a text transport for
+ * string-typed channels.
+ */
+object Serializer {
+
+  def serializeNDArray(arr: NDArray): Array[Byte] = arr.serialize()
+
+  def deserializeNDArray(bytes: Array[Byte]): NDArray =
+    NDArray.deserialize(bytes)
+
+  /** name -> array map as one byte blob (params checkpoint in memory). */
+  def serializeMap(params: Map[String, NDArray]): Array[Byte] = {
+    val bos = new ByteArrayOutputStream()
+    val out = new DataOutputStream(bos)
+    out.writeInt(params.size)
+    for ((name, arr) <- params.toSeq.sortBy(_._1)) {
+      val nameBytes = name.getBytes(StandardCharsets.UTF_8)
+      out.writeInt(nameBytes.length)
+      out.write(nameBytes)
+      val payload = arr.serialize()
+      out.writeInt(payload.length)
+      out.write(payload)
+    }
+    out.flush()
+    bos.toByteArray
+  }
+
+  def deserializeMap(bytes: Array[Byte]): Map[String, NDArray] = {
+    val in = new DataInputStream(new ByteArrayInputStream(bytes))
+    val n = in.readInt()
+    (0 until n).map { _ =>
+      val nameLen = in.readInt()
+      val nameBytes = new Array[Byte](nameLen)
+      in.readFully(nameBytes)
+      val payloadLen = in.readInt()
+      val payload = new Array[Byte](payloadLen)
+      in.readFully(payload)
+      new String(nameBytes, StandardCharsets.UTF_8) ->
+        NDArray.deserialize(payload)
+    }.toMap
+  }
+
+  def encodeBase64(bytes: Array[Byte]): String =
+    Base64.getEncoder.encodeToString(bytes)
+
+  def decodeBase64(s: String): Array[Byte] = Base64.getDecoder.decode(s)
+}

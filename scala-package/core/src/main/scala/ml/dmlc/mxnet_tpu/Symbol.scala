@@ -35,6 +35,40 @@ class Symbol private[mxnet_tpu](private[mxnet_tpu] val handle: SymbolHandle)
   def setAttr(key: String, value: String): Unit =
     checkCall(_LIB.mxSymbolSetAttr(handle, key, value))
 
+  /** Name of a single-output symbol; None for unnamed groups
+   * (MXSymbolGetName). */
+  def name: Option[String] = Option(_LIB.mxSymbolGetName(handle))
+
+  /** This node's attributes only (MXSymbolListAttrShallow). */
+  def listAttr(): Map[String, String] = {
+    val flat = _LIB.mxSymbolListAttrShallow(handle)
+    require(flat != null, _LIB.mxGetLastError())
+    flat.grouped(2).map(kv => kv(0) -> kv(1)).toMap
+  }
+
+  /** Whole-graph attributes as "node$key" -> value (MXSymbolListAttr). */
+  def attrMap(): Map[String, String] = {
+    val flat = _LIB.mxSymbolListAttr(handle)
+    require(flat != null, _LIB.mxGetLastError())
+    flat.grouped(2).map(kv => kv(0) -> kv(1)).toMap
+  }
+
+  /** Graph-composition arithmetic (reference Symbol.scala operators):
+   * each builds the corresponding registered elementwise op node. */
+  def +(other: Symbol): Symbol = Symbol.binop("_plus", this, other)
+  def -(other: Symbol): Symbol = Symbol.binop("_minus", this, other)
+  def *(other: Symbol): Symbol = Symbol.binop("_mul", this, other)
+  def /(other: Symbol): Symbol = Symbol.binop("_div", this, other)
+  def +(s: Float): Symbol = Symbol.scalarOp("_plus_scalar", this, s)
+  def -(s: Float): Symbol = Symbol.scalarOp("_minus_scalar", this, s)
+  def *(s: Float): Symbol = Symbol.scalarOp("_mul_scalar", this, s)
+  def /(s: Float): Symbol = Symbol.scalarOp("_div_scalar", this, s)
+
+  def save(fname: String): Unit = {
+    val out = new java.io.PrintWriter(fname)
+    try out.write(toJson) finally out.close()
+  }
+
   def copy(): Symbol = {
     val out = new Array[Long](1)
     checkCall(_LIB.mxSymbolCopy(handle, out))
@@ -140,6 +174,19 @@ object Symbol {
     checkCall(_LIB.mxSymbolCreateFromJSON(json, out))
     new Symbol(out(0))
   }
+
+  def load(fname: String): Symbol = {
+    val src = scala.io.Source.fromFile(fname, "UTF-8")
+    try loadJson(src.mkString) finally src.close()
+  }
+
+  private[mxnet_tpu] def binop(op: String, lhs: Symbol,
+                               rhs: Symbol): Symbol =
+    create(op, "", Map("lhs" -> lhs, "rhs" -> rhs))
+
+  private[mxnet_tpu] def scalarOp(op: String, src: Symbol,
+                                  s: Float): Symbol =
+    create(op, "", Map("data" -> src), Map("scalar" -> s.toString))
 
   /** Create any registered operator by name with keyword inputs +
    * string-typed params — the whole op inventory, no generated stubs.

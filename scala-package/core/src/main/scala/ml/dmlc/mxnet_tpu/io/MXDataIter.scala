@@ -1,0 +1,122 @@
+package ml.dmlc.mxnet_tpu.io
+
+import ml.dmlc.mxnet_tpu.Base._
+import ml.dmlc.mxnet_tpu.{DataBatch, DataIter, NDArray, Shape}
+
+/**
+ * ABI-backed data iterator (reference io/MXDataIter.scala): fronts the
+ * native iterator registry (MXListDataIters / MXDataIterCreateIter), the
+ * same creators the python ImageRecordIter/CSVIter/MNISTIter expose.
+ * Construct through `IO.createIterator(name, params)`.
+ *
+ * Handles returned by GetData/GetLabel are lent until the following
+ * next() — copy out (`toArray`) anything that must survive the step,
+ * matching the reference's borrowed-NDArray convention.
+ */
+class MXDataIter private[mxnet_tpu](
+    private val handle: DataIterHandle,
+    dataName: String = "data",
+    labelName: String = "softmax_label") extends DataIter {
+
+  private var currentData: NDArray = _
+  private var currentLabel: NDArray = _
+  private var hasNextBatch: Boolean = true
+  private var probed = false
+  private var shapesKnown = false
+  private var dataShape: Shape = _
+  private var labelShape: Shape = _
+  private var knownBatchSize = 0
+
+  private def fetch(): Unit = {
+    val out = new Array[Int](1)
+    checkCall(_LIB.mxDataIterNext(handle, out))
+    hasNextBatch = out(0) == 1
+    if (hasNextBatch) {
+      val h = new Array[Long](1)
+      checkCall(_LIB.mxDataIterGetData(handle, h))
+      currentData = new NDArray(h(0), writable = false)
+      checkCall(_LIB.mxDataIterGetLabel(handle, h))
+      currentLabel = new NDArray(h(0), writable = false)
+      if (!shapesKnown) {
+        dataShape = currentData.shape
+        labelShape = currentLabel.shape
+        knownBatchSize = dataShape(0)
+        shapesKnown = true
+      }
+    }
+    probed = true
+  }
+
+  def batchSize: Int = {
+    ensureShapes()
+    knownBatchSize
+  }
+
+  private def ensureShapes(): Unit = {
+    if (!shapesKnown) {
+      // probe the first batch for shapes, then rewind so iteration
+      // still starts at the beginning (reference MXDataIter does the
+      // same first-batch peek)
+      fetch()
+      require(shapesKnown, "iterator is empty: shapes unknowable")
+      reset()
+    }
+  }
+
+  def provideData: Map[String, Shape] = {
+    ensureShapes()
+    Map(dataName -> dataShape)
+  }
+
+  def provideLabel: Map[String, Shape] = {
+    ensureShapes()
+    Map(labelName -> labelShape)
+  }
+
+  def reset(): Unit = {
+    checkCall(_LIB.mxDataIterBeforeFirst(handle))
+    probed = false
+    hasNextBatch = true
+  }
+
+  def hasNext: Boolean = {
+    if (!probed) fetch()
+    hasNextBatch
+  }
+
+  def next(): DataBatch = {
+    if (!probed) fetch()
+    require(hasNextBatch, "iterator exhausted")
+    probed = false   // consume: following hasNext() advances
+    val pad = new Array[Int](1)
+    checkCall(_LIB.mxDataIterGetPadNum(handle, pad))
+    DataBatch(IndexedSeq(currentData), IndexedSeq(currentLabel), pad(0))
+  }
+
+  def dispose(): Unit = checkCall(_LIB.mxDataIterFree(handle))
+}
+
+/** Native iterator registry (reference IO.scala's iterCreateFuncs). */
+object IO {
+  private lazy val creators: Map[String, Long] = {
+    val handles = _LIB.mxListDataIters()
+    require(handles != null, _LIB.mxGetLastError())
+    handles.map(h => _LIB.mxDataIterGetName(h) -> h).toMap
+  }
+
+  def iterNames: Seq[String] = creators.keys.toSeq.sorted
+
+  /** Create a native iterator by registry name, e.g.
+   * `IO.createIterator("CSVIter", Map("data_csv" -> path, ...))`. */
+  def createIterator(name: String, params: Map[String, String],
+                     dataName: String = "data",
+                     labelName: String = "softmax_label"): MXDataIter = {
+    val creator = creators.getOrElse(name,
+      throw new MXNetError(
+        s"unknown data iter $name (have ${iterNames.mkString(", ")})"))
+    val (k, v) = params.toSeq.unzip
+    val out = new Array[Long](1)
+    checkCall(_LIB.mxDataIterCreateIter(creator, k.toArray, v.toArray, out))
+    new MXDataIter(out(0), dataName, labelName)
+  }
+}

@@ -1,0 +1,96 @@
+package ml.dmlc.mxnet_tpu.io
+
+import java.util.concurrent.{ArrayBlockingQueue, TimeUnit}
+
+import ml.dmlc.mxnet_tpu.{DataBatch, DataIter, NDArray, Shape}
+
+/**
+ * Background-thread prefetcher (reference io/PrefetchingIter.scala;
+ * python PrefetchingIter).  One producer thread per wrapped iterator
+ * drains batches into a bounded queue so decode/host work overlaps the
+ * training step.  Batches are deep-copied into owned NDArrays before
+ * queueing: the wrapped iterator is free to recycle its buffers.
+ */
+class PrefetchingIter(iters: IndexedSeq[DataIter],
+                      capacity: Int = 2) extends DataIter {
+  require(iters.nonEmpty, "at least one iterator required")
+  private val primary = iters.head
+
+  def batchSize: Int = primary.batchSize
+  def provideData: Map[String, Shape] =
+    iters.map(_.provideData).reduce(_ ++ _)
+  def provideLabel: Map[String, Shape] =
+    iters.map(_.provideLabel).reduce(_ ++ _)
+
+  // queue element: Some(combined batch) or None = end of epoch
+  private var queue = new ArrayBlockingQueue[Option[DataBatch]](capacity)
+  private var producer: Thread = _
+  private var pending: Option[DataBatch] = _
+  private var started = false
+  @volatile private var stopping = false
+
+  private def copyOf(b: DataBatch): DataBatch =
+    DataBatch(b.data.map(_.copy()), b.label.map(_.copy()), b.pad)
+
+  private def combine(batches: IndexedSeq[DataBatch]): DataBatch =
+    DataBatch(batches.flatMap(_.data), batches.flatMap(_.label),
+              batches.head.pad)
+
+  private def startProducer(): Unit = {
+    val myQueue = queue   // a mid-epoch reset() swaps the field; a stale
+                          // producer must never feed the replacement
+    producer = new Thread(new Runnable {
+      def run(): Unit = {
+        try {
+          while (!stopping && iters.forall(_.hasNext)) {
+            val combined = combine(iters.map(it => copyOf(it.next())))
+            // bounded offer loop instead of put(): a blocked put would
+            // keep this thread alive across reset()'s drain forever
+            var placed = false
+            while (!placed && !stopping) {
+              placed = myQueue.offer(Some(combined), 50,
+                                     TimeUnit.MILLISECONDS)
+            }
+          }
+        } finally {
+          myQueue.offer(None, 50, TimeUnit.MILLISECONDS)
+        }
+      }
+    })
+    producer.setDaemon(true)
+    producer.start()
+    started = true
+  }
+
+  private def peek(): Option[DataBatch] = {
+    if (!started) startProducer()
+    if (pending == null) pending = queue.take()
+    pending
+  }
+
+  def hasNext: Boolean = peek().isDefined
+
+  def next(): DataBatch = {
+    val b = peek().getOrElse(throw new NoSuchElementException("exhausted"))
+    pending = null
+    b
+  }
+
+  /** Safe mid-epoch: stops the producer FULLY (it may be blocked on a
+   * full queue) before the wrapped iterators are reset, so no stale
+   * thread ever races them or feeds the next epoch's queue. */
+  def reset(): Unit = {
+    if (started) {
+      stopping = true
+      while (producer.isAlive) {
+        queue.poll(10, TimeUnit.MILLISECONDS)  // unblock pending offers
+        producer.join(10)
+      }
+      stopping = false
+    }
+    iters.foreach(_.reset())
+    pending = null
+    queue = new ArrayBlockingQueue[Option[DataBatch]](capacity)
+    started = false
+  }
+}

@@ -1,0 +1,34 @@
+package ml.dmlc.mxnet_tpu.io
+
+import ml.dmlc.mxnet_tpu.{DataBatch, DataIter, Shape}
+
+/**
+ * Fixed-length epoch adapter (reference io/ResizeIter.scala; python
+ * ResizeIter): presents exactly `size` batches per epoch regardless of
+ * the wrapped iterator's length — short epochs wrap around (optionally
+ * resetting the underlying iterator), long ones truncate.
+ */
+class ResizeIter(iter: DataIter, size: Int,
+                 resetInternal: Boolean = true) extends DataIter {
+  private var cur = 0
+
+  def batchSize: Int = iter.batchSize
+  def provideData: Map[String, Shape] = iter.provideData
+  def provideLabel: Map[String, Shape] = iter.provideLabel
+
+  def reset(): Unit = {
+    cur = 0
+    if (resetInternal) iter.reset()
+  }
+
+  def hasNext: Boolean = cur < size
+
+  def next(): DataBatch = {
+    if (!hasNext) throw new NoSuchElementException("epoch complete")
+    if (!iter.hasNext) {
+      iter.reset()   // wrap: the resized epoch is longer than the data
+    }
+    cur += 1
+    iter.next()
+  }
+}

@@ -119,6 +119,31 @@ static struct {
   int (*KVStoreGetGroupSize)(KVStoreHandle, int *);
   int (*KVStoreBarrier)(KVStoreHandle);
   int (*KVStoreRunServer)(KVStoreHandle);
+  int (*KVStoreIsWorkerNode)(int *);
+  int (*KVStoreIsServerNode)(int *);
+  int (*KVStoreIsSchedulerNode)(int *);
+  int (*KVStoreSendCommmandToServers)(KVStoreHandle, int, const char *);
+  int (*NDArraySaveRawBytes)(NDArrayHandle, size_t *, const char **);
+  int (*NDArrayLoadFromRawBytes)(const void *, size_t, NDArrayHandle *);
+  int (*NDArrayGetDType)(NDArrayHandle, int *);
+  int (*FuncInvokeEx)(FunctionHandle, NDArrayHandle *, mx_float *,
+                      NDArrayHandle *, int, char **, char **);
+  int (*SymbolGetName)(SymbolHandle, const char **, int *);
+  int (*SymbolListAttr)(SymbolHandle, mx_uint *, const char ***);
+  int (*SymbolListAttrShallow)(SymbolHandle, mx_uint *, const char ***);
+  int (*ExecutorPrint)(ExecutorHandle, const char **);
+  int (*ListDataIters)(mx_uint *, const void ***);
+  int (*DataIterGetIterInfo)(const void *, const char **, const char **,
+                             mx_uint *, const char ***, const char ***,
+                             const char ***);
+  int (*DataIterCreateIter)(const void *, mx_uint, const char **,
+                            const char **, void **);
+  int (*DataIterFree)(void *);
+  int (*DataIterNext)(void *, int *);
+  int (*DataIterBeforeFirst)(void *);
+  int (*DataIterGetData)(void *, NDArrayHandle *);
+  int (*DataIterGetLabel)(void *, NDArrayHandle *);
+  int (*DataIterGetPadNum)(void *, int *);
   int loaded;
 } jx;
 
@@ -291,6 +316,27 @@ JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_nativeLibInit(
   JX_RESOLVE(KVStoreGetGroupSize, "MXKVStoreGetGroupSize");
   JX_RESOLVE(KVStoreBarrier, "MXKVStoreBarrier");
   JX_RESOLVE(KVStoreRunServer, "MXKVStoreRunServer");
+  JX_RESOLVE(KVStoreIsWorkerNode, "MXKVStoreIsWorkerNode");
+  JX_RESOLVE(KVStoreIsServerNode, "MXKVStoreIsServerNode");
+  JX_RESOLVE(KVStoreIsSchedulerNode, "MXKVStoreIsSchedulerNode");
+  JX_RESOLVE(KVStoreSendCommmandToServers, "MXKVStoreSendCommmandToServers");
+  JX_RESOLVE(NDArraySaveRawBytes, "MXNDArraySaveRawBytes");
+  JX_RESOLVE(NDArrayLoadFromRawBytes, "MXNDArrayLoadFromRawBytes");
+  JX_RESOLVE(NDArrayGetDType, "MXNDArrayGetDType");
+  JX_RESOLVE(FuncInvokeEx, "MXFuncInvokeEx");
+  JX_RESOLVE(SymbolGetName, "MXSymbolGetName");
+  JX_RESOLVE(SymbolListAttr, "MXSymbolListAttr");
+  JX_RESOLVE(SymbolListAttrShallow, "MXSymbolListAttrShallow");
+  JX_RESOLVE(ExecutorPrint, "MXExecutorPrint");
+  JX_RESOLVE(ListDataIters, "MXListDataIters");
+  JX_RESOLVE(DataIterGetIterInfo, "MXDataIterGetIterInfo");
+  JX_RESOLVE(DataIterCreateIter, "MXDataIterCreateIter");
+  JX_RESOLVE(DataIterFree, "MXDataIterFree");
+  JX_RESOLVE(DataIterNext, "MXDataIterNext");
+  JX_RESOLVE(DataIterBeforeFirst, "MXDataIterBeforeFirst");
+  JX_RESOLVE(DataIterGetData, "MXDataIterGetData");
+  JX_RESOLVE(DataIterGetLabel, "MXDataIterGetLabel");
+  JX_RESOLVE(DataIterGetPadNum, "MXDataIterGetPadNum");
   jx.loaded = 1;
   return 0;
 }
@@ -854,6 +900,209 @@ JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreRunServer(
     JNIEnv *, jobject, jlong h) {
   // blocks in the native PS loop until the scheduler finishes the job
   return jx.KVStoreRunServer(H(h));
+}
+
+static jint role_query(JNIEnv *env, int (*fn)(int *), jintArray out) {
+  int r = 0;
+  int rc = fn(&r);
+  if (rc == 0) {
+    jint v = r;
+    env->SetIntArrayRegion(out, 0, 1, &v);
+  }
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreIsWorkerNode(
+    JNIEnv *env, jobject, jintArray out) {
+  return role_query(env, jx.KVStoreIsWorkerNode, out);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreIsServerNode(
+    JNIEnv *env, jobject, jintArray out) {
+  return role_query(env, jx.KVStoreIsServerNode, out);
+}
+
+JNIEXPORT jint JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreIsSchedulerNode(
+    JNIEnv *env, jobject, jintArray out) {
+  return role_query(env, jx.KVStoreIsSchedulerNode, out);
+}
+
+JNIEXPORT jint JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreSendCommmandToServers(
+    JNIEnv *env, jobject, jlong h, jint head, jstring jbody) {
+  JString body(env, jbody);
+  return jx.KVStoreSendCommmandToServers(H(h), head, body.c);
+}
+
+/* ---- raw-byte NDArray serialization ---------------------------------- */
+JNIEXPORT jbyteArray JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArraySaveRawBytes(
+    JNIEnv *env, jobject, jlong h) {
+  size_t n = 0;
+  const char *buf = NULL;
+  if (jx.NDArraySaveRawBytes(H(h), &n, &buf) != 0) return NULL;
+  jbyteArray out = env->NewByteArray((jsize)n);
+  env->SetByteArrayRegion(out, 0, (jsize)n, (const jbyte *)buf);
+  return out;
+}
+
+JNIEXPORT jint JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayLoadFromRawBytes(
+    JNIEnv *env, jobject, jbyteArray jbuf, jlongArray out) {
+  int n = env->GetArrayLength(jbuf);
+  std::vector<jbyte> buf(n);
+  env->GetByteArrayRegion(jbuf, 0, n, buf.data());
+  NDArrayHandle h = NULL;
+  int rc = jx.NDArrayLoadFromRawBytes(buf.data(), (size_t)n, &h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayGetDType(
+    JNIEnv *env, jobject, jlong h, jintArray out) {
+  int dt = 0;
+  int rc = jx.NDArrayGetDType(H(h), &dt);
+  if (rc == 0) {
+    jint v = dt;
+    env->SetIntArrayRegion(out, 0, 1, &v);
+  }
+  return rc;
+}
+
+/* ---- function registry: kwargs channel ------------------------------- */
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxFuncInvokeEx(
+    JNIEnv *env, jobject, jlong fn, jlongArray juse, jfloatArray jscalars,
+    jlongArray jmutate, jobjectArray jkeys, jobjectArray jvals) {
+  std::vector<void *> use = handles_in(env, juse);
+  std::vector<void *> mutate = handles_in(env, jmutate);
+  int ns = jscalars ? env->GetArrayLength(jscalars) : 0;
+  std::vector<jfloat> scalars(ns);
+  if (ns) env->GetFloatArrayRegion(jscalars, 0, ns, scalars.data());
+  JStringArray keys(env, jkeys), vals(env, jvals);
+  return jx.FuncInvokeEx(
+      (FunctionHandle)(intptr_t)fn, use.data(), scalars.data(),
+      mutate.data(), (int)keys.size(),
+      const_cast<char **>(keys.data()), const_cast<char **>(vals.data()));
+}
+
+/* ---- symbol names + attributes --------------------------------------- */
+JNIEXPORT jstring JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolGetName(
+    JNIEnv *env, jobject, jlong h) {
+  const char *name = NULL;
+  int ok = 0;
+  if (jx.SymbolGetName(H(h), &name, &ok) != 0) return NULL;
+  return ok ? env->NewStringUTF(name) : NULL;
+}
+
+static jobjectArray list_attr(JNIEnv *env,
+                              int (*fn)(SymbolHandle, mx_uint *,
+                                        const char ***),
+                              jlong h) {
+  mx_uint n = 0;
+  const char **kv = NULL;
+  if (fn(H(h), &n, &kv) != 0) return NULL;
+  return strings_new(env, 2 * n, kv);  /* flat [k0,v0,k1,v1,...] */
+}
+
+JNIEXPORT jobjectArray JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListAttr(
+    JNIEnv *env, jobject, jlong h) {
+  return list_attr(env, jx.SymbolListAttr, h);
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListAttrShallow(
+    JNIEnv *env, jobject, jlong h) {
+  return list_attr(env, jx.SymbolListAttrShallow, h);
+}
+
+/* ---- executor debug -------------------------------------------------- */
+JNIEXPORT jstring JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorPrint(
+    JNIEnv *env, jobject, jlong h) {
+  const char *s = NULL;
+  if (jx.ExecutorPrint(H(h), &s) != 0) return NULL;
+  return env->NewStringUTF(s);
+}
+
+/* ---- data iterators -------------------------------------------------- */
+JNIEXPORT jlongArray JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxListDataIters(
+    JNIEnv *env, jobject) {
+  mx_uint n = 0;
+  const void **creators = NULL;
+  if (jx.ListDataIters(&n, &creators) != 0) return NULL;
+  return handles_new(env, n, const_cast<void *const *>(creators));
+}
+
+JNIEXPORT jstring JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterGetName(
+    JNIEnv *env, jobject, jlong creator) {
+  const char *name = NULL, *desc = NULL;
+  mx_uint nargs = 0;
+  const char **anames = NULL, **atypes = NULL, **adescs = NULL;
+  if (jx.DataIterGetIterInfo((const void *)(intptr_t)creator, &name, &desc,
+                             &nargs, &anames, &atypes, &adescs) != 0)
+    return NULL;
+  return env->NewStringUTF(name);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterCreateIter(
+    JNIEnv *env, jobject, jlong creator, jobjectArray jkeys,
+    jobjectArray jvals, jlongArray out) {
+  JStringArray keys(env, jkeys), vals(env, jvals);
+  void *h = NULL;
+  int rc = jx.DataIterCreateIter((const void *)(intptr_t)creator,
+                                 keys.size(), keys.data(), vals.data(), &h);
+  if (rc == 0) handle_out(env, out, h);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterFree(
+    JNIEnv *, jobject, jlong h) {
+  return jx.DataIterFree(H(h));
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterNext(
+    JNIEnv *env, jobject, jlong h, jintArray out) {
+  int has = 0;
+  int rc = jx.DataIterNext(H(h), &has);
+  if (rc == 0) {
+    jint v = has;
+    env->SetIntArrayRegion(out, 0, 1, &v);
+  }
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterBeforeFirst(
+    JNIEnv *, jobject, jlong h) {
+  return jx.DataIterBeforeFirst(H(h));
+}
+
+static jint iter_get_array(JNIEnv *env, int (*fn)(void *, NDArrayHandle *),
+                           jlong h, jlongArray out) {
+  NDArrayHandle a = NULL;
+  int rc = fn(H(h), &a);
+  if (rc == 0) handle_out(env, out, a);
+  return rc;
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterGetData(
+    JNIEnv *env, jobject, jlong h, jlongArray out) {
+  return iter_get_array(env, jx.DataIterGetData, h, out);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterGetLabel(
+    JNIEnv *env, jobject, jlong h, jlongArray out) {
+  return iter_get_array(env, jx.DataIterGetLabel, h, out);
+}
+
+JNIEXPORT jint JNICALL Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterGetPadNum(
+    JNIEnv *env, jobject, jlong h, jintArray out) {
+  int pad = 0;
+  int rc = jx.DataIterGetPadNum(H(h), &pad);
+  if (rc == 0) {
+    jint v = pad;
+    env->SetIntArrayRegion(out, 0, 1, &v);
+  }
+  return rc;
 }
 
 }  /* extern "C" */

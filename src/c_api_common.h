@@ -73,17 +73,38 @@ class Gil {
   PyGILState_STATE state_;
 };
 
+/* Message CaptureError assigns for a clean SystemExit(0) crossing the
+ * ABI — the kvstore server/scheduler end-of-job path
+ * (kvstore_server.py sys.exit(0)).  Frontends match THIS sentinel to
+ * distinguish normal job completion from real bridge failures. */
+constexpr const char *kEndOfJobError = "mxnet-tpu: end of job (SystemExit 0)";
+
 inline void CaptureError() {
   PyObject *ptype, *pvalue, *ptrace;
   PyErr_Fetch(&ptype, &pvalue, &ptrace);
   PyErr_NormalizeException(&ptype, &pvalue, &ptrace);
   last_error = "unknown python error";
   if (pvalue != nullptr) {
-    PyObject *s = PyObject_Str(pvalue);
-    if (s != nullptr) {
-      const char *msg = PyUnicode_AsUTF8(s);
-      if (msg != nullptr) last_error = msg;
-      Py_DECREF(s);
+    bool clean_exit = false;
+    if (ptype != nullptr &&
+        PyErr_GivenExceptionMatches(ptype, PyExc_SystemExit)) {
+      PyObject *code = PyObject_GetAttrString(pvalue, "code");
+      if (code != nullptr) {
+        clean_exit = (code == Py_None) ||
+                     (PyLong_Check(code) && PyLong_AsLong(code) == 0);
+        Py_DECREF(code);
+      }
+      PyErr_Clear();  // GetAttrString may set its own error
+    }
+    if (clean_exit) {
+      last_error = kEndOfJobError;
+    } else {
+      PyObject *s = PyObject_Str(pvalue);
+      if (s != nullptr) {
+        const char *msg = PyUnicode_AsUTF8(s);
+        if (msg != nullptr) last_error = msg;
+        Py_DECREF(s);
+      }
     }
   }
   Py_XDECREF(ptype); Py_XDECREF(pvalue); Py_XDECREF(ptrace);

@@ -2,22 +2,24 @@
 // C++ IO stack (src/io/iter_image_recordio.cc ImageRecordIOParser with N OMP
 // decode threads + iter_normalize.h + iter_batchloader.h + iter_prefetcher.h).
 //
-// Pipeline: RecordFile index -> worker threads decode raw CHW payloads and
-// apply crop/mirror/mean/scale -> completed float32 batches land in a bounded
-// double-buffer queue -> python (ctypes) copies a batch out and hands it to
-// jax.device_put (PJRT's async H2D replaces the reference's copy workers).
+// Pipeline: mmapped RecordFile index -> worker threads decode JPEG (libjpeg,
+// matching the reference's per-thread cv::imdecode) or raw CHW payloads,
+// apply resize/crop/mirror/mean/scale -> completed float32 batches land in a
+// bounded double-buffer queue -> python (ctypes) copies a batch out and hands
+// it to jax.device_put (PJRT's async H2D replaces the engine copy workers).
 //
 // Exposed as a C ABI (ctypes; no pybind11 in this image).
 #include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstring>
-#include <deque>
+#include <map>
 #include <mutex>
 #include <random>
 #include <thread>
 #include <vector>
 
+#include "image_decode.h"
 #include "recordio.h"
 
 namespace mxtpu {
@@ -33,10 +35,12 @@ class BatchLoader {
   BatchLoader(const char* path, int batch, int c, int h, int w,
               int label_width, int threads, int shuffle, int rand_crop,
               int rand_mirror, const float* mean_rgb, float scale,
-              int part_index, int num_parts, int seed, int queue_depth)
+              int part_index, int num_parts, int seed, int queue_depth,
+              int resize)
       : batch_(batch), c_(c), h_(h), w_(w), label_width_(label_width),
         shuffle_(shuffle), rand_crop_(rand_crop), rand_mirror_(rand_mirror),
-        scale_(scale), queue_depth_(queue_depth), rng_(seed) {
+        scale_(scale), queue_depth_(queue_depth), resize_(resize),
+        rng_(seed) {
     ok_ = rec_.Open(path);
     if (!ok_) return;
     if (mean_rgb) {
@@ -69,21 +73,34 @@ class BatchLoader {
       workers_.emplace_back([this] { WorkerLoop(); });
   }
 
-  // Returns 0 and fills data/label on success; 1 at end of epoch.
+  // Returns 0 and fills data/label on success; 1 at end of epoch; 2 on a
+  // decode error (message via last_error()).  Batches are delivered IN
+  // ORDER (sequence = record position / batch): workers complete out of
+  // order, but eval parity and reproducible training require the
+  // reference's sequential batch stream.
   int Next(float* data, float* label, int* pad) {
     std::unique_lock<std::mutex> lk(mu_);
     not_empty_.wait(lk, [this] {
-      return !queue_.empty() || (eof_produced_.load() && in_flight_ == 0);
+      return !error_.empty() || pending_.count(next_seq_) != 0 ||
+             (eof_produced_.load() && in_flight_ == 0);
     });
-    if (queue_.empty()) return 1;
-    Batch b = std::move(queue_.front());
-    queue_.pop_front();
+    if (!error_.empty()) return 2;
+    auto it = pending_.find(next_seq_);
+    if (it == pending_.end()) return 1;
+    Batch b = std::move(it->second);
+    pending_.erase(it);
+    ++next_seq_;
     lk.unlock();
     not_full_.notify_all();
     memcpy(data, b.data.data(), b.data.size() * sizeof(float));
     memcpy(label, b.label.data(), b.label.size() * sizeof(float));
     *pad = b.pad;
     return 0;
+  }
+
+  const char* last_error() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return error_.c_str();
   }
 
  private:
@@ -93,14 +110,106 @@ class BatchLoader {
     not_empty_.notify_all();
     for (auto& t : workers_) t.join();
     workers_.clear();
-    queue_.clear();
+    pending_.clear();
     in_flight_ = 0;
+    next_seq_ = 0;
+    error_.clear();
+  }
+
+  // A bad record is a hard, loud error (the reference CHECKs and aborts
+  // on decode failure): silently emitting zero images with real labels
+  // would train on garbage invisibly.
+  void Fail(const std::string& msg) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (error_.empty()) error_ = msg;
+    }
+    stop_.store(true);
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  // Per-worker decode scratch: reused across records so the hot loop does
+  // no allocation once warm (the reference keeps per-OMP-thread cv::Mats).
+  struct Scratch {
+    std::vector<uint8_t> rgb, resized;
+  };
+
+  // Crop/mirror/normalize an HWC-RGB buffer into CHW float out.
+  void EmitHWC(const uint8_t* px, int src_h, int src_w, float* out,
+               std::mt19937* rng) {
+    int dy = 0, dx = 0;
+    if (src_h > h_ || src_w > w_) {
+      if (rand_crop_) {
+        dy = (*rng)() % (src_h - h_ + 1);
+        dx = (*rng)() % (src_w - w_ + 1);
+      } else {
+        dy = (src_h - h_) / 2;
+        dx = (src_w - w_) / 2;
+      }
+    }
+    bool mirror = rand_mirror_ && ((*rng)() & 1);
+    for (int ch = 0; ch < c_; ++ch) {
+      float mean = has_mean_ ? mean_[ch % 3] : 0.f;
+      for (int y = 0; y < h_; ++y) {
+        const uint8_t* row =
+            px + (static_cast<size_t>(y + dy) * src_w + dx) * c_ + ch;
+        float* dst = out + (static_cast<size_t>(ch) * h_ + y) * w_;
+        if (!mirror) {
+          for (int x = 0; x < w_; ++x)
+            dst[x] = (static_cast<float>(row[static_cast<size_t>(x) * c_]) -
+                      mean) * scale_;
+        } else {
+          for (int x = 0; x < w_; ++x)
+            dst[x] = (static_cast<float>(
+                          row[static_cast<size_t>(w_ - 1 - x) * c_]) -
+                      mean) * scale_;
+        }
+      }
+    }
   }
 
   void DecodeInto(size_t rec_idx, float* out, float* label_out,
-                  std::mt19937* rng) {
+                  std::mt19937* rng, Scratch* scratch) {
     ImageRecord r;
     if (!rec_.Get(order_[rec_idx % order_.size()], &r)) return;
+    for (int l = 0; l < label_width_; ++l)
+      label_out[l] = l < static_cast<int>(r.labels.size()) ? r.labels[l] : 0.f;
+
+    if (IsJPEG(r.payload, r.payload_size)) {
+      // reference path: per-thread JPEG decode
+      // (iter_image_recordio.cc:139-291 + image_aug_default.cc resize)
+      int ih = 0, iw = 0;
+      if (!DecodeJPEG(r.payload, r.payload_size, &scratch->rgb, &ih, &iw)) {
+        char msg[128];
+        snprintf(msg, sizeof(msg), "corrupt JPEG at record %zu",
+                 order_[rec_idx % order_.size()]);
+        Fail(msg);
+        return;
+      }
+      const uint8_t* px = scratch->rgb.data();
+      if (resize_ > 0) {
+        int oh = 0, ow = 0;
+        if (ResizeShorterEdge(scratch->rgb, ih, iw, resize_,
+                              &scratch->resized, &oh, &ow)) {
+          px = scratch->resized.data();
+          ih = oh;
+          iw = ow;
+        }
+      }
+      if (ih < h_ || iw < w_) {
+        char msg[160];
+        snprintf(msg, sizeof(msg),
+                 "record %zu decodes to %dx%d, smaller than the %dx%d "
+                 "crop (resize=%d)",
+                 order_[rec_idx % order_.size()], ih, iw, h_, w_, resize_);
+        Fail(msg);
+        return;
+      }
+      EmitHWC(px, ih, iw, out, rng);
+      return;
+    }
+
     // raw-packed payload: uint8 CHW at source resolution (>= target)
     size_t want = static_cast<size_t>(c_) * h_ * w_;
     int src_h = h_, src_w = w_;
@@ -138,12 +247,11 @@ class BatchLoader {
         }
       }
     }
-    for (int l = 0; l < label_width_; ++l)
-      label_out[l] = l < static_cast<int>(r.labels.size()) ? r.labels[l] : 0.f;
   }
 
   void WorkerLoop() {
     std::mt19937 rng(rng_());
+    Scratch scratch;
     const size_t n = order_.size();
     const size_t img_sz = static_cast<size_t>(c_) * h_ * w_;
     while (!stop_.load()) {
@@ -153,10 +261,17 @@ class BatchLoader {
         not_empty_.notify_all();
         return;
       }
+      size_t seq = start / static_cast<size_t>(batch_);
       {
         std::unique_lock<std::mutex> lk(mu_);
-        not_full_.wait(lk, [this] {
-          return static_cast<int>(queue_.size()) + in_flight_ < queue_depth_
+        // admission by SEQUENCE WINDOW, not queue occupancy: a size-based
+        // gate can starve the worker holding the lowest unproduced seq
+        // while later seqs fill the buffer — the consumer then waits on a
+        // batch that can never be admitted (deadlock).  Any seq within
+        // queue_depth_ of the drain point may proceed; because seqs are
+        // handed out contiguously, the needed batch is always admissible.
+        not_full_.wait(lk, [this, seq] {
+          return seq < next_seq_ + static_cast<size_t>(queue_depth_)
                  || stop_.load();
         });
         if (stop_.load()) return;
@@ -168,14 +283,14 @@ class BatchLoader {
       b.pad = start + batch_ > n ? static_cast<int>(start + batch_ - n) : 0;
       for (int i = 0; i < batch_; ++i) {
         DecodeInto(start + i, b.data.data() + i * img_sz,
-                   b.label.data() + i * label_width_, &rng);
+                   b.label.data() + i * label_width_, &rng, &scratch);
       }
       {
         std::lock_guard<std::mutex> lk(mu_);
-        queue_.push_back(std::move(b));
+        pending_.emplace(seq, std::move(b));
         --in_flight_;
       }
-      not_empty_.notify_one();
+      not_empty_.notify_all();
     }
   }
 
@@ -189,10 +304,13 @@ class BatchLoader {
   bool ok_ = false;
   int n_threads_ = 4;
   int queue_depth_;
+  int resize_ = 0;  // shorter-edge resize target; 0 = off
   std::mt19937 rng_;
 
   std::vector<std::thread> workers_;
-  std::deque<Batch> queue_;
+  std::map<size_t, Batch> pending_;  // seq -> batch, drained in order
+  size_t next_seq_ = 0;
+  std::string error_;                // first decode failure, sticky
   int in_flight_ = 0;
   std::mutex mu_;
   std::condition_variable not_empty_, not_full_;
@@ -209,11 +327,12 @@ void* mxtpu_loader_create(const char* path, int batch, int c, int h, int w,
                           int label_width, int threads, int shuffle,
                           int rand_crop, int rand_mirror,
                           const float* mean_rgb, float scale, int part_index,
-                          int num_parts, int seed, int queue_depth) {
+                          int num_parts, int seed, int queue_depth,
+                          int resize) {
   auto* l = new mxtpu::BatchLoader(path, batch, c, h, w, label_width, threads,
                                    shuffle, rand_crop, rand_mirror, mean_rgb,
                                    scale, part_index, num_parts, seed,
-                                   queue_depth > 0 ? queue_depth : 4);
+                                   queue_depth > 0 ? queue_depth : 4, resize);
   if (!l->ok()) {
     delete l;
     return nullptr;
@@ -227,6 +346,10 @@ long mxtpu_loader_num_records(void* handle) {
 
 int mxtpu_loader_next(void* handle, float* data, float* label, int* pad) {
   return static_cast<mxtpu::BatchLoader*>(handle)->Next(data, label, pad);
+}
+
+const char* mxtpu_loader_last_error(void* handle) {
+  return static_cast<mxtpu::BatchLoader*>(handle)->last_error();
 }
 
 void mxtpu_loader_reset(void* handle) {

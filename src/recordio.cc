@@ -1,30 +1,65 @@
 #include "recordio.h"
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <cstring>
 
 namespace mxtpu {
 
+RecordFile::~RecordFile() {
+  if (map_ != nullptr) munmap(map_, bytes_);
+}
+
 bool RecordFile::Open(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY);
+  if (fd >= 0) {
+    struct stat st;
+    if (fstat(fd, &st) == 0 && st.st_size > 0) {
+      void* m = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                     MAP_PRIVATE, fd, 0);
+      if (m != MAP_FAILED) {
+        // the access pattern is one sequential index pass, then batched
+        // reads that sweep forward per epoch (or jump when shuffled)
+        madvise(m, static_cast<size_t>(st.st_size), MADV_WILLNEED);
+        map_ = m;
+        base_ = static_cast<const uint8_t*>(m);
+        bytes_ = static_cast<size_t>(st.st_size);
+        ::close(fd);
+        return BuildIndex();
+      }
+    }
+    ::close(fd);
+  }
+  // fallback: whole-file heap read (small test files, exotic filesystems)
   FILE* f = fopen(path.c_str(), "rb");
   if (!f) return false;
   fseek(f, 0, SEEK_END);
   long n = ftell(f);
   fseek(f, 0, SEEK_SET);
-  data_.resize(n);
-  if (n > 0 && fread(data_.data(), 1, n, f) != static_cast<size_t>(n)) {
+  heap_.resize(n);
+  if (n > 0 && fread(heap_.data(), 1, n, f) != static_cast<size_t>(n)) {
     fclose(f);
     return false;
   }
   fclose(f);
+  base_ = heap_.data();
+  bytes_ = heap_.size();
+  return BuildIndex();
+}
+
+bool RecordFile::BuildIndex() {
   size_t pos = 0;
-  while (pos + 8 <= data_.size()) {
+  while (pos + 8 <= bytes_) {
     uint32_t magic, lrec;
-    memcpy(&magic, data_.data() + pos, 4);
-    memcpy(&lrec, data_.data() + pos + 4, 4);
+    memcpy(&magic, base_ + pos, 4);
+    memcpy(&lrec, base_ + pos + 4, 4);
     if (magic != kRecordMagic) return false;
     size_t len = lrec & ((1u << 29) - 1);
     pos += 8;
-    if (pos + len > data_.size()) return false;
+    if (pos + len > bytes_) return false;
     offsets_.emplace_back(pos, len);
     pos += len + ((4 - len % 4) % 4);
   }
@@ -33,7 +68,7 @@ bool RecordFile::Open(const std::string& path) {
 
 bool RecordFile::Get(size_t i, ImageRecord* out) const {
   if (i >= offsets_.size()) return false;
-  const uint8_t* p = data_.data() + offsets_[i].first;
+  const uint8_t* p = base_ + offsets_[i].first;
   size_t len = offsets_[i].second;
   // IRHeader: uint32 flag, float label, uint64 id, uint64 id2  (24 bytes)
   if (len < 24) return false;

@@ -25,18 +25,26 @@ struct ImageRecord {
   size_t payload_size = 0;
 };
 
-// Memory-loaded sequential reader. Splits the file into records once at
-// open (the reference's chunked OMP parse, iter_image_recordio.cc:139-291,
-// becomes an upfront index + thread-pooled decode).
+// Memory-MAPPED sequential reader: one index-building pass at open, then
+// O(resident) memory — the kernel pages records in and out on demand, so an
+// ImageNet-scale .rec (~150 GB) iterates in bounded RAM.  The reference
+// streams bounded chunks instead (iter_image_recordio.cc:311-395); mmap
+// gives the same bound with random (shuffled) access for free.  Falls back
+// to a heap read when mmap is unavailable (pipes, tiny test files).
 class RecordFile {
  public:
+  ~RecordFile();
   bool Open(const std::string& path);
   size_t size() const { return offsets_.size(); }
-  // Parse record i (IRHeader + payload view into the file buffer).
+  // Parse record i (IRHeader + payload view into the mapped file).
   bool Get(size_t i, ImageRecord* out) const;
 
  private:
-  std::vector<uint8_t> data_;
+  bool BuildIndex();
+  const uint8_t* base_ = nullptr;  // mmap base or heap fallback
+  size_t bytes_ = 0;
+  void* map_ = nullptr;            // non-null when mmapped
+  std::vector<uint8_t> heap_;      // fallback storage
   std::vector<std::pair<size_t, size_t>> offsets_;  // (begin, length)
 };
 

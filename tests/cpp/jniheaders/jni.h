@@ -27,15 +27,18 @@ typedef int32_t jint;
 typedef int64_t jlong;
 typedef float jfloat;
 typedef uint8_t jboolean;
+typedef int8_t jbyte;
 typedef jint jsize;
 
 struct MockJObject {
-  int kind;  /* 0 plain, 1 string, 2 int[], 3 long[], 4 float[], 5 obj[] */
+  int kind;  /* 0 plain, 1 string, 2 int[], 3 long[], 4 float[], 5 obj[],
+                6 byte[] */
   std::string str;
   std::vector<jint> ints;
   std::vector<jlong> longs;
   std::vector<jfloat> floats;
   std::vector<MockJObject *> objs;
+  std::vector<jbyte> bytes;
 };
 
 typedef MockJObject *jobject;
@@ -46,6 +49,7 @@ typedef MockJObject *jintArray;
 typedef MockJObject *jlongArray;
 typedef MockJObject *jfloatArray;
 typedef MockJObject *jobjectArray;
+typedef MockJObject *jbyteArray;
 
 class JNIEnv {
  public:
@@ -69,8 +73,24 @@ class JNIEnv {
       case 3: return (jsize)a->longs.size();
       case 4: return (jsize)a->floats.size();
       case 5: return (jsize)a->objs.size();
+      case 6: return (jsize)a->bytes.size();
       default: return 0;
     }
+  }
+
+  /* byte arrays */
+  jbyteArray NewByteArray(jsize n) {
+    MockJObject *o = new MockJObject();
+    o->kind = 6;
+    o->bytes.resize(n);
+    return o;
+  }
+  void GetByteArrayRegion(jbyteArray a, jsize start, jsize len, jbyte *buf) {
+    memcpy(buf, a->bytes.data() + start, len * sizeof(jbyte));
+  }
+  void SetByteArrayRegion(jbyteArray a, jsize start, jsize len,
+                          const jbyte *buf) {
+    memcpy(a->bytes.data() + start, buf, len * sizeof(jbyte));
   }
 
   /* int arrays */

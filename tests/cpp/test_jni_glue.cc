@@ -426,6 +426,138 @@ int main(int argc, char **argv) {
                                                          rank1) == 0);
   CHECK(rank1->ints[0] == 0);
 
+  /* ---- round-5 surface: raw bytes, names/attrs, InvokeEx, roles,
+   *      executor print, ABI data iterators (Scala io.IO path) ---- */
+
+  /* raw-byte serialization round trip (Scala Serializer path) */
+  jlong raw_src = nd_create({2, 2});
+  nd_set(raw_src, {9, 8, 7, 6});
+  jbyteArray raw = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArraySaveRawBytes(
+      &env, nullptr, raw_src);
+  CHECK(raw != nullptr && raw->bytes.size() > 16);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayLoadFromRawBytes(
+            &env, nullptr, raw, ref) == 0);
+  jlong raw_back = out_handle(ref);
+  got = nd_get(raw_back, 4);
+  CHECK(got[0] == 9.0f && got[3] == 6.0f);
+  jintArray dt1 = env.NewIntArray(1);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayGetDType(
+            &env, nullptr, raw_back, dt1) == 0);
+  CHECK(dt1->ints[0] == 0);  /* float32 */
+
+  /* symbol name + shallow/recursive attrs (Scala Symbol.name/listAttr) */
+  jstring symname = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolGetName(
+      &env, nullptr, fc1);
+  CHECK(symname != nullptr && symname->str == "fc1");
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolSetAttr(
+            &env, nullptr, fc1, env.NewStringUTF("lr_mult"),
+            env.NewStringUTF("2.0")) == 0);
+  jobjectArray attrs = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListAttrShallow(
+      &env, nullptr, fc1);
+  CHECK(attrs != nullptr);
+  bool saw_lr = false;
+  for (size_t i = 0; i + 1 < attrs->objs.size(); i += 2)
+    if (attrs->objs[i]->str == "lr_mult" && attrs->objs[i + 1]->str == "2.0")
+      saw_lr = true;
+  CHECK(saw_lr);
+  jobjectArray rattrs = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxSymbolListAttr(
+      &env, nullptr, fc1);
+  CHECK(rattrs != nullptr);
+  bool saw_deep = false;
+  for (size_t i = 0; i + 1 < rattrs->objs.size(); i += 2)
+    if (rattrs->objs[i]->str.find("$lr_mult") != std::string::npos)
+      saw_deep = true;
+  CHECK(saw_deep);
+
+  /* MXFuncInvokeEx: transpose with a string kwarg (Scala kwargs channel) */
+  jlong t_in = nd_create({2, 3});
+  nd_set(t_in, {1, 2, 3, 4, 5, 6});
+  jlong t_out = nd_create({3, 2});
+  jlong transpose_fn = 0;
+  {
+    jlongArray fns = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxListFunctions(
+        &env, nullptr);
+    CHECK(fns != nullptr);
+    for (jlong h : fns->longs) {
+      jstring nm = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxFuncGetName(&env,
+                                                                 nullptr, h);
+      if (nm && nm->str == "transpose") transpose_fn = h;
+    }
+  }
+  CHECK(transpose_fn != 0);
+  std::vector<jlong> tu = {t_in}, tm = {t_out};
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxFuncInvokeEx(
+            &env, nullptr, transpose_fn, mklongs(tu), mkfloats({}),
+            mklongs(tm), mkstrs({"axes"}), mkstrs({"(1,0)"})) == 0);
+  got = nd_get(t_out, 6);
+  CHECK(got[0] == 1.0f && got[1] == 4.0f && got[2] == 2.0f);
+
+  /* role queries (Scala KVStore.isWorkerNode etc.) */
+  jintArray role1 = env.NewIntArray(1);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreIsWorkerNode(
+            &env, nullptr, role1) == 0);
+  CHECK(role1->ints[0] == 1);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreIsServerNode(
+            &env, nullptr, role1) == 0);
+  CHECK(role1->ints[0] == 0);
+  CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxKVStoreIsSchedulerNode(
+            &env, nullptr, role1) == 0);
+  CHECK(role1->ints[0] == 0);
+
+  /* executor debug dump (Scala Executor.debugStr) */
+  jstring dbg = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxExecutorPrint(&env, nullptr,
+                                                                ex);
+  CHECK(dbg != nullptr && dbg->str.size() > 0);
+
+  /* ABI data iterators: CSVIter end-to-end (Scala io.IO.createIterator) */
+  {
+    std::string csv = std::string(argv[2]) + "/jni_data.csv";
+    FILE *f = fopen(csv.c_str(), "w");
+    CHECK(f != nullptr);
+    for (int i = 0; i < 8; ++i)
+      fprintf(f, "%d,%d,%d\n", i, i + 1, i + 2);
+    fclose(f);
+    jlong csv_creator = 0;
+    jlongArray iters = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxListDataIters(
+        &env, nullptr);
+    CHECK(iters != nullptr && iters->longs.size() >= 3);
+    for (jlong h : iters->longs) {
+      jstring nm = Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterGetName(
+          &env, nullptr, h);
+      if (nm && nm->str == "CSVIter") csv_creator = h;
+    }
+    CHECK(csv_creator != 0);
+    CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterCreateIter(
+              &env, nullptr, csv_creator,
+              mkstrs({"data_csv", "data_shape", "batch_size"}),
+              mkstrs({csv, "(3)", "4"}), ref) == 0);
+    jlong it = out_handle(ref);
+    jintArray has = env.NewIntArray(1);
+    int batches = 0;
+    float first_val = -1;
+    while (true) {
+      CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterNext(&env, nullptr, it,
+                                                           has) == 0);
+      if (!has->ints[0]) break;
+      CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterGetData(
+                &env, nullptr, it, ref) == 0);
+      jlong data_h = out_handle(ref);
+      std::vector<jfloat> rows = nd_get(data_h, 12);
+      if (batches == 0) first_val = rows[0];
+      ++batches;
+    }
+    CHECK(batches == 2);
+    CHECK(first_val == 0.0f);
+    /* rewind works */
+    CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterBeforeFirst(
+              &env, nullptr, it) == 0);
+    CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterNext(&env, nullptr, it,
+                                                         has) == 0);
+    CHECK(has->ints[0] == 1);
+    CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxDataIterFree(&env, nullptr, it)
+          == 0);
+  }
+
   CHECK(Java_ml_dmlc_mxnet_1tpu_LibInfo_mxNDArrayWaitAll(&env, nullptr) == 0);
   printf("JNI GLUE TESTS PASSED\n");
   return 0;

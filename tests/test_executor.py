@@ -183,3 +183,35 @@ def test_backward_mirror_grad_equivalence(monkeypatch):
     assert set(g_plain) == set(g_mirror)
     for k in g_plain:
         assert np.allclose(g_plain[k], g_mirror[k], atol=1e-6), k
+
+
+def test_backward_head_grad_omission_rules():
+    """Omitting a head grad is allowed only when it cannot reach any
+    argument (reference ref_count==0 rule): loss heads and (wrapped)
+    BlockGrad tails qualify; plain outputs do not."""
+    import numpy as np
+    import pytest
+    x = mx.sym.Variable("x")
+    loss = mx.sym.LinearRegressionOutput(
+        data=x * 2.0, label=mx.sym.Variable("y"), name="loss")
+    # Reshape AROUND BlockGrad: the wrapper itself is not grad-optional,
+    # but every backward path dies in BlockGrad — omission must pass
+    tail = mx.sym.Reshape(mx.sym.BlockGrad(x * 3.0), shape=(4, 1))
+    grouped = mx.sym.Group([loss, tail])
+    xv = mx.nd.array(np.arange(4, dtype=np.float32))
+    yv = mx.nd.array(np.zeros(4, dtype=np.float32))
+    gx = mx.nd.zeros((4,))
+    exe = grouped.bind(mx.cpu(), {"x": xv, "y": yv}, args_grad={"x": gx},
+                       grad_req={"x": "write", "y": "null"})
+    exe.forward(is_train=True)
+    exe.backward([mx.nd.ones((4,))])   # only the loss head's grad
+    # d(loss)/dx = (2x - y) * 2 regardless of supplied head grad
+    assert np.allclose(gx.asnumpy(), 4.0 * xv.asnumpy())
+
+    # a REQUIRED head grad omitted -> loud error, not silent zeros
+    plain = mx.sym.Group([loss, x * 5.0])
+    exe2 = plain.bind(mx.cpu(), {"x": xv, "y": yv}, args_grad={"x": gx},
+                      grad_req={"x": "write", "y": "null"})
+    exe2.forward(is_train=True)
+    with pytest.raises(mx.base.MXNetError, match="requires a head gradient"):
+        exe2.backward([mx.nd.ones((4,))])
