@@ -61,3 +61,40 @@ mx.exec.update.arg <- function(executor, name, r.array) {
   mx.nd.copyto(executor$arg.arrays[[name]], as.double(r.array))
   invisible(executor)
 }
+
+# Rebind with new input shapes, carrying trained parameters over
+# (reference mx.executor.reshape / executor.cc Reshape): parameters keep
+# their arrays' VALUES; input-shaped arrays are reallocated.  The
+# standard train-at-batch-N / predict-at-batch-M flow.
+mx.exec.reshape <- function(executor, ctx = NULL, grad.req = "write",
+                            ...) {
+  new.shapes <- list(...)
+  if (is.null(ctx)) ctx <- executor$ctx
+  reshaped <- do.call(mx.simple.bind,
+                      c(list(executor$symbol, ctx = ctx,
+                             grad.req = grad.req), new.shapes))
+  for (n in names(executor$arg.arrays)) {
+    if (n %in% names(new.shapes)) next   # explicit inputs: fresh shape
+    src <- executor$arg.arrays[[n]]
+    dst <- reshaped$arg.arrays[[n]]
+    if (is.null(dst)) next
+    # only same-sized arrays carry over: anything whose inferred shape
+    # changed (e.g. a label resized alongside the data batch) is an
+    # input, not a parameter — it gets the fresh allocation
+    if (prod(mx.nd.shape(src)) == prod(mx.nd.shape(dst))) {
+      mx.nd.copyto(dst, as.array(src))
+    }
+  }
+  if (length(executor$aux.arrays) > 0) {
+    for (i in seq_along(executor$aux.arrays)) {
+      mx.nd.copyto(reshaped$aux.arrays[[i]],
+                   as.array(executor$aux.arrays[[i]]))
+    }
+  }
+  reshaped
+}
+
+# dump the executed plan (MXExecutorPrint; reference debug.str)
+mx.exec.debug.str <- function(executor) {
+  .Call("mxg_exec_print", executor$handle)
+}

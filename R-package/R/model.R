@@ -26,14 +26,23 @@ mx.model.init.params <- function(symbol, input.shapes, initializer.scale) {
 
 mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
                                         num.round = 10,
+                                        optimizer = NULL,
                                         learning.rate = 0.1,
                                         momentum = 0.9,
                                         array.batch.size = 32,
+                                        eval.data = NULL,
                                         eval.metric = mx.metric.accuracy,
                                         initializer = NULL,
+                                        arg.params = NULL,
+                                        begin.round = 1,
                                         batch.end.callback = NULL,
                                         epoch.end.callback = NULL,
                                         verbose = TRUE) {
+  # Reference mx.model.FeedForward.create surface: optimizer may be an
+  # MXOptimizer (native registry update path) or NULL (the in-R
+  # SGD+momentum loop); eval.data = list(data=, label=) scores a
+  # validation split each round; arg.params + begin.round resume a
+  # loaded checkpoint.
   batch <- array.batch.size
   feat <- ncol(X)
   # R dim order is the REVERSE of the framework's (column-major vs
@@ -44,17 +53,39 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
   exec <- do.call(mx.simple.bind,
                   c(list(symbol, ctx = ctx, grad.req = "write"),
                     input.shapes))
-  params <- if (is.null(initializer)) {
+  params <- if (!is.null(arg.params)) {
+    mx.util.filter.params(arg.params, symbol)
+  } else if (is.null(initializer)) {
     mx.model.init.params(symbol, input.shapes, 0.07)
   } else {
     mx.init.create(initializer, symbol, input.shapes)
   }
   for (n in names(params)) mx.exec.update.arg(exec, n, params[[n]])
-  momenta <- lapply(params, function(p) array(0, dim = dim(p)))
+  updater <- NULL
+  momenta <- NULL
+  if (is.character(optimizer)) {
+    # reference semantics: a NAME creates the optimizer here, with the
+    # loss-head batch-sum normalized (rescale_grad = 1/batch) — the
+    # dynamics then match the in-R default loop exactly
+    optimizer <- mx.opt.create(optimizer, learning.rate = learning.rate,
+                               momentum = momentum,
+                               rescale.grad = 1 / batch)
+  }
+  if (!is.null(optimizer)) {
+    # an MXOptimizer object is used as-is: its creator owns rescale.grad
+    updater <- mx.opt.get.updater(optimizer)
+  } else {
+    momenta <- lapply(params, function(p) array(0, dim = dim(p)))
+  }
 
   iter <- mx.io.arrayiter(X, y, batch.size = batch, shuffle = TRUE)
   keep.going <- TRUE
-  for (round in seq_len(num.round)) {
+  if (begin.round > num.round) {
+    stop("begin.round exceeds num.round: nothing to train")
+  }
+  # num.round is the FINAL round number (reference resume semantics):
+  # begin.round=6, num.round=10 trains rounds 6..10
+  for (round in begin.round:num.round) {
     if (!keep.going) break
     state <- eval.metric$init()
     mx.io.reset(iter)
@@ -71,13 +102,24 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
       mx.exec.backward(exec)
       probs <- t(as.array(mx.exec.outputs(exec)[[1]]))
       state <- eval.metric$update(state, b$label, probs)
-      for (n in names(params)) {
-        g <- as.array(exec$grad.arrays[[n]])
-        dim(g) <- dim(params[[n]])
-        momenta[[n]] <- momentum * momenta[[n]] -
-          learning.rate * (g / batch)
-        params[[n]] <- params[[n]] + momenta[[n]]
-        mx.exec.update.arg(exec, n, params[[n]])
+      if (!is.null(updater)) {
+        idx <- 0L
+        for (n in names(params)) {
+          idx <- idx + 1L
+          updater(idx, exec$arg.arrays[[n]], exec$grad.arrays[[n]])
+          p <- as.array(exec$arg.arrays[[n]])
+          dim(p) <- dim(params[[n]])
+          params[[n]] <- p
+        }
+      } else {
+        for (n in names(params)) {
+          g <- as.array(exec$grad.arrays[[n]])
+          dim(g) <- dim(params[[n]])
+          momenta[[n]] <- momentum * momenta[[n]] -
+            learning.rate * (g / batch)
+          params[[n]] <- params[[n]] + momenta[[n]]
+          mx.exec.update.arg(exec, n, params[[n]])
+        }
       }
       if (!is.null(batch.end.callback)) {
         ok <- batch.end.callback(round, nbatch, eval.metric$get(state))
@@ -88,10 +130,20 @@ mx.model.FeedForward.create <- function(symbol, X, y, ctx = mx.cpu(),
       cat(sprintf("Round [%d] Train-accuracy=%.4f\n", round,
                   eval.metric$get(state)))
     }
+    model.now <- structure(list(symbol = symbol, params = params,
+                                exec = exec, batch = batch),
+                           class = "MXFeedForwardModel")
+    if (!is.null(eval.data)) {
+      val.probs <- predict(model.now, eval.data$data)
+      val.state <- eval.metric$init()
+      val.state <- eval.metric$update(val.state, eval.data$label,
+                                      val.probs)
+      if (verbose) {
+        cat(sprintf("Round [%d] Validation-accuracy=%.4f\n", round,
+                    eval.metric$get(val.state)))
+      }
+    }
     if (!is.null(epoch.end.callback)) {
-      model.now <- structure(list(symbol = symbol, params = params,
-                                  exec = exec, batch = batch),
-                             class = "MXFeedForwardModel")
       ok <- epoch.end.callback(model.now, round)
       if (identical(ok, FALSE)) keep.going <- FALSE
     }

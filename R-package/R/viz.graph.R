@@ -2,27 +2,82 @@
 # graph.viz): emits Graphviz DOT from the symbol's json — viewable with
 # any dot renderer; no graph package dependency.
 
-graph.viz <- function(symbol, file = NULL) {
+graph.viz <- function(symbol, file = NULL, shape = NULL,
+                      direction = "BT", graph.title = NULL,
+                      graph.width.px = NULL, graph.height.px = NULL) {
+  # `shape`: named list of input shapes (e.g. list(data = c(1, 28, 28, 1)))
+  # — when given, output shapes annotate each edge like the reference's
+  # DiagrammeR renderer; direction flips rankdir (reference graph.viz
+  # direction= knob); title/size knobs emit graph-level DOT attributes.
   json <- mx.symbol.tojson(symbol)
   parsed <- .mx.json.parse(json)
   nodes <- parsed$nodes
-  lines <- c("digraph mxnet_tpu {", "  rankdir=BT;")
-  shapes <- c(null = "ellipse")
+  out.shapes <- NULL
+  if (!is.null(shape)) {
+    inferred <- tryCatch(
+      do.call(mx.symbol.infer.shape,
+              c(list(mx.symbol.internal.group.internals(symbol)), shape)),
+      error = function(e) NULL)
+    if (!is.null(inferred) && isTRUE(inferred$complete) &&
+        length(inferred$out.shapes) == length(parsed$nodes)) {
+      # the internals view emits one output PER NODE only when no node
+      # is multi-output (SliceChannel etc. expand and shift indices);
+      # annotate only in that unambiguous case, never mislabel
+      out.shapes <- inferred$out.shapes
+    }
+  }
+  lines <- c("digraph mxnet_tpu {",
+             sprintf("  rankdir=%s;", direction))
+  if (!is.null(graph.title)) {
+    lines <- c(lines, sprintf("  label=\"%s\"; labelloc=t;", graph.title))
+  }
+  if (!is.null(graph.width.px) && !is.null(graph.height.px)) {
+    lines <- c(lines, sprintf("  size=\"%g,%g\";",
+                              graph.width.px / 96, graph.height.px / 96))
+  }
+  # reference palette: layer-family fills (viz.graph.R node styling)
+  fill.for <- function(op) {
+    if (op == "null") return("#8dd3c7")
+    if (grepl("Convolution|Deconvolution", op)) return("#fb8072")
+    if (grepl("FullyConnected", op)) return("#fdb462")
+    if (grepl("Activation|LeakyReLU", op)) return("#ffffb3")
+    if (grepl("BatchNorm", op)) return("#bebada")
+    if (grepl("Pooling", op)) return("#80b1d3")
+    if (grepl("Softmax|Output|Loss", op)) return("#b3de69")
+    "#fccde5"
+  }
   for (i in seq_along(nodes)) {
     node <- nodes[[i]]
-    shape <- if (node$op == "null") "ellipse" else "box"
-    color <- if (node$op == "null") "lightblue" else "lightgoldenrod"
+    nshape <- if (node$op == "null") "ellipse" else "box"
+    label <- if (node$op == "null") node$name
+             else paste0(node$name, "\\n", node$op)
     lines <- c(lines, sprintf(
-      "  n%d [label=\"%s\\n%s\", shape=%s, style=filled, fillcolor=%s];",
-      i - 1, node$name, node$op, shape, color))
+      "  n%d [label=\"%s\", shape=%s, style=filled, fillcolor=\"%s\"];",
+      i - 1, label, nshape, fill.for(node$op)))
     for (input in node$inputs) {
-      lines <- c(lines, sprintf("  n%d -> n%d;", input[[1]], i - 1))
+      edge.label <- ""
+      if (!is.null(out.shapes)) {
+        src <- input[[1]] + 1
+        if (src <= length(out.shapes)) {
+          edge.label <- sprintf(" [label=\"%s\"]",
+                                paste(out.shapes[[src]], collapse = "x"))
+        }
+      }
+      lines <- c(lines, sprintf("  n%d -> n%d%s;", input[[1]], i - 1,
+                                edge.label))
     }
   }
   lines <- c(lines, "}")
   dot <- paste(lines, collapse = "\n")
   if (!is.null(file)) writeLines(dot, file)
   invisible(dot)
+}
+
+# internals view used for per-node shape annotation: every node output
+# becomes a head so infer.shape reports shapes in node order
+mx.symbol.internal.group.internals <- function(symbol) {
+  structure(list(handle = .Call("mxg_sym_get_internals", symbol$handle)),
+            class = "MXSymbol")
 }
 
 # minimal json reader for the symbol format (nodes/op/name/inputs) —

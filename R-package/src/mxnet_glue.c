@@ -96,6 +96,8 @@ static struct {
                          NDArrayHandle, float, float);
   int (*ExecutorBackward)(ExecutorHandle, mx_uint, NDArrayHandle *);
   int (*ExecutorOutputs)(ExecutorHandle, mx_uint *, NDArrayHandle **);
+  int (*ExecutorPrint)(ExecutorHandle, const char **);
+  int (*SymbolGetInternals)(SymbolHandle, SymbolHandle *);
   int (*ExecutorFree)(ExecutorHandle);
   /* registries cached at load */
   mx_uint n_funcs;
@@ -166,6 +168,8 @@ SEXP mxg_load(SEXP path) {
   RESOLVE(ExecutorForward, "MXExecutorForward");
   RESOLVE(ExecutorBackward, "MXExecutorBackward");
   RESOLVE(ExecutorOutputs, "MXExecutorOutputs");
+  RESOLVE(ExecutorPrint, "MXExecutorPrint");
+  RESOLVE(SymbolGetInternals, "MXSymbolGetInternals");
   RESOLVE(ExecutorFree, "MXExecutorFree");
   /* the registry ARRAYS are arena-backed in the ABI (invalidated by
    * the next call); the interned handle VALUES persist — copy each
@@ -547,7 +551,19 @@ SEXP mxg_exec_outputs(SEXP ex) {
   return out;
 }
 
+SEXP mxg_exec_print(SEXP ex) {
+  const char *str = NULL;
+  chk(mxg.ExecutorPrint(unwrap(ex), &str));
+  return Rf_mkString(str != NULL ? str : "");
+}
+
 /* ---- registration ------------------------------------------------------ */
+SEXP mxg_sym_get_internals(SEXP sym) {
+  SymbolHandle out;
+  chk(mxg.SymbolGetInternals(unwrap(sym), &out));
+  return wrap_handle(out, sym_finalizer);
+}
+
 SEXP mxg_sym_get_output(SEXP sym, SEXP index) {
   SymbolHandle out;
   chk(mxg.SymbolGetOutput(unwrap(sym), (mx_uint)Rf_asInteger(index),
@@ -692,7 +708,9 @@ static const R_CallMethodDef call_methods[] = {
     {"mxg_exec_forward", (DL_FUNC)&mxg_exec_forward, 2},
     {"mxg_exec_backward", (DL_FUNC)&mxg_exec_backward, 2},
     {"mxg_exec_outputs", (DL_FUNC)&mxg_exec_outputs, 1},
+    {"mxg_exec_print", (DL_FUNC)&mxg_exec_print, 1},
     {"mxg_sym_get_output", (DL_FUNC)&mxg_sym_get_output, 2},
+    {"mxg_sym_get_internals", (DL_FUNC)&mxg_sym_get_internals, 1},
     {"mxg_kv_create", (DL_FUNC)&mxg_kv_create, 1},
     {"mxg_kv_init", (DL_FUNC)&mxg_kv_init, 3},
     {"mxg_kv_push", (DL_FUNC)&mxg_kv_push, 4},

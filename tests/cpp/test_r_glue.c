@@ -221,6 +221,14 @@ int main(int argc, char **argv) {
   got = mxg_nd_copy_to(kw);
   CHECK(REAL(got)[0] < 1.0); /* sgd stepped downhill on +1 grads */
 
+  /* round-5 surfaces: executor plan dump + internals view (the shape
+   * annotation path graph.viz/mx.exec.debug.str drive) */
+  SEXP dbg = mxg_exec_print(ex);
+  CHECK(strlen(CHAR(STRING_ELT(dbg, 0))) > 0);
+  SEXP internals = mxg_sym_get_internals(net);
+  SEXP int_outs = mxg_sym_list_outputs(internals);
+  CHECK(LENGTH(int_outs) > LENGTH(mxg_sym_list_outputs(net)));
+
   mxg_nd_waitall();
   printf("R GLUE TESTS PASSED\n");
   return 0;
