@@ -1,0 +1,168 @@
+package ml.dmlc.mxnet_tpu
+
+/**
+ * Typed builders for the common layer ops (reference Symbol.scala's
+ * generated operator functions).  Everything routes through
+ * Symbol.create, so the full registry remains reachable generically;
+ * these give the frequently-used layers real JVM signatures (named
+ * defaults, IDE completion) instead of stringly-typed maps.
+ */
+object SymbolOps {
+
+  private def shapeStr(s: (Int, Int)): String = s"(${s._1}, ${s._2})"
+
+  def FullyConnected(data: Symbol, numHidden: Int, noBias: Boolean = false,
+                     name: String = "", weight: Option[Symbol] = None,
+                     bias: Option[Symbol] = None): Symbol = {
+    var inputs = Map("data" -> data)
+    weight.foreach(w => inputs += ("weight" -> w))
+    bias.foreach(b => inputs += ("bias" -> b))
+    Symbol.create("FullyConnected", name, inputs,
+                  Map("num_hidden" -> numHidden.toString,
+                      "no_bias" -> noBias.toString.capitalize))
+  }
+
+  def Activation(data: Symbol, actType: String,
+                 name: String = ""): Symbol =
+    Symbol.create("Activation", name, Map("data" -> data),
+                  Map("act_type" -> actType))
+
+  def Convolution(data: Symbol, kernel: (Int, Int), numFilter: Int,
+                  stride: (Int, Int) = (1, 1), pad: (Int, Int) = (0, 0),
+                  dilate: (Int, Int) = (1, 1), numGroup: Int = 1,
+                  noBias: Boolean = false, name: String = ""): Symbol =
+    Symbol.create("Convolution", name, Map("data" -> data),
+                  Map("kernel" -> shapeStr(kernel),
+                      "num_filter" -> numFilter.toString,
+                      "stride" -> shapeStr(stride),
+                      "pad" -> shapeStr(pad),
+                      "dilate" -> shapeStr(dilate),
+                      "num_group" -> numGroup.toString,
+                      "no_bias" -> noBias.toString.capitalize))
+
+  def Deconvolution(data: Symbol, kernel: (Int, Int), numFilter: Int,
+                    stride: (Int, Int) = (1, 1), pad: (Int, Int) = (0, 0),
+                    name: String = ""): Symbol =
+    Symbol.create("Deconvolution", name, Map("data" -> data),
+                  Map("kernel" -> shapeStr(kernel),
+                      "num_filter" -> numFilter.toString,
+                      "stride" -> shapeStr(stride),
+                      "pad" -> shapeStr(pad)))
+
+  def Pooling(data: Symbol, kernel: (Int, Int), poolType: String = "max",
+              stride: (Int, Int) = (1, 1), pad: (Int, Int) = (0, 0),
+              globalPool: Boolean = false, name: String = ""): Symbol =
+    Symbol.create("Pooling", name, Map("data" -> data),
+                  Map("kernel" -> shapeStr(kernel),
+                      "pool_type" -> poolType,
+                      "stride" -> shapeStr(stride),
+                      "pad" -> shapeStr(pad),
+                      "global_pool" -> globalPool.toString.capitalize))
+
+  def BatchNorm(data: Symbol, eps: Float = 1e-3f,
+                momentum: Float = 0.9f, fixGamma: Boolean = true,
+                name: String = ""): Symbol =
+    Symbol.create("BatchNorm", name, Map("data" -> data),
+                  Map("eps" -> eps.toString,
+                      "momentum" -> momentum.toString,
+                      "fix_gamma" -> fixGamma.toString.capitalize))
+
+  def Dropout(data: Symbol, p: Float = 0.5f, name: String = ""): Symbol =
+    Symbol.create("Dropout", name, Map("data" -> data),
+                  Map("p" -> p.toString))
+
+  def Flatten(data: Symbol, name: String = ""): Symbol =
+    Symbol.create("Flatten", name, Map("data" -> data))
+
+  def Reshape(data: Symbol, shape: Seq[Int], name: String = ""): Symbol =
+    Symbol.create("Reshape", name, Map("data" -> data),
+                  Map("shape" -> shape.mkString("(", ", ", ")")))
+
+  def Concat(args: Seq[Symbol], dim: Int = 1,
+             name: String = ""): Symbol = {
+    val inputs = args.zipWithIndex.map { case (s, i) =>
+      s"arg$i" -> s }.toMap
+    Symbol.create("Concat", name, inputs,
+                  Map("num_args" -> args.length.toString,
+                      "dim" -> dim.toString))
+  }
+
+  def Embedding(data: Symbol, inputDim: Int, outputDim: Int,
+                name: String = ""): Symbol =
+    Symbol.create("Embedding", name, Map("data" -> data),
+                  Map("input_dim" -> inputDim.toString,
+                      "output_dim" -> outputDim.toString))
+
+  def LeakyReLU(data: Symbol, actType: String = "leaky",
+                slope: Float = 0.25f, name: String = ""): Symbol =
+    Symbol.create("LeakyReLU", name, Map("data" -> data),
+                  Map("act_type" -> actType, "slope" -> slope.toString))
+
+  def LRN(data: Symbol, nsize: Int, alpha: Float = 1e-4f,
+          beta: Float = 0.75f, name: String = ""): Symbol =
+    Symbol.create("LRN", name, Map("data" -> data),
+                  Map("nsize" -> nsize.toString,
+                      "alpha" -> alpha.toString, "beta" -> beta.toString))
+
+  def SoftmaxOutput(data: Symbol, label: Option[Symbol] = None,
+                    gradScale: Float = 1f, name: String = ""): Symbol = {
+    var inputs = Map("data" -> data)
+    label.foreach(l => inputs += ("label" -> l))
+    Symbol.create("SoftmaxOutput", name, inputs,
+                  Map("grad_scale" -> gradScale.toString))
+  }
+
+  def LinearRegressionOutput(data: Symbol, label: Symbol,
+                             name: String = ""): Symbol =
+    Symbol.create("LinearRegressionOutput", name,
+                  Map("data" -> data, "label" -> label))
+
+  def LogisticRegressionOutput(data: Symbol, label: Symbol,
+                               name: String = ""): Symbol =
+    Symbol.create("LogisticRegressionOutput", name,
+                  Map("data" -> data, "label" -> label))
+
+  def MakeLoss(data: Symbol, gradScale: Float = 1f,
+               name: String = ""): Symbol =
+    Symbol.create("MakeLoss", name, Map("data" -> data),
+                  Map("grad_scale" -> gradScale.toString))
+
+  def BlockGrad(data: Symbol, name: String = ""): Symbol =
+    Symbol.create("BlockGrad", name, Map("data" -> data))
+
+  def SliceChannel(data: Symbol, numOutputs: Int, axis: Int = 1,
+                   name: String = ""): Symbol =
+    Symbol.create("SliceChannel", name, Map("data" -> data),
+                  Map("num_outputs" -> numOutputs.toString,
+                      "axis" -> axis.toString))
+
+  def SwapAxis(data: Symbol, dim1: Int, dim2: Int,
+               name: String = ""): Symbol =
+    Symbol.create("SwapAxis", name, Map("data" -> data),
+                  Map("dim1" -> dim1.toString, "dim2" -> dim2.toString))
+
+  def UpSampling(data: Symbol, scale: Int, sampleType: String = "nearest",
+                 name: String = ""): Symbol =
+    Symbol.create("UpSampling", name, Map("data" -> data),
+                  Map("scale" -> scale.toString,
+                      "sample_type" -> sampleType,
+                      "num_args" -> "1"))
+
+  def Cast(data: Symbol, dtype: String, name: String = ""): Symbol =
+    Symbol.create("Cast", name, Map("data" -> data),
+                  Map("dtype" -> dtype))
+
+  def Transpose(data: Symbol, axes: Seq[Int] = Seq.empty,
+                name: String = ""): Symbol = {
+    val params = if (axes.isEmpty) Map.empty[String, String]
+                 else Map("axes" -> axes.mkString("(", ", ", ")"))
+    Symbol.create("transpose", name, Map("data" -> data), params)
+  }
+
+  def RNN(data: Symbol, stateSize: Int, numLayers: Int, mode: String,
+          name: String = ""): Symbol =
+    Symbol.create("RNN", name, Map("data" -> data),
+                  Map("state_size" -> stateSize.toString,
+                      "num_layers" -> numLayers.toString,
+                      "mode" -> mode))
+}

@@ -1,0 +1,73 @@
+package ml.dmlc.mxnet_tpu.io
+
+import ml.dmlc.mxnet_tpu.{Context, DataBatch, DataIter, NDArray, Shape}
+
+/**
+ * Full in-memory iterator over host tensors of ANY rank (reference
+ * io/NDArrayIter.scala; the flat 2D fast path lives in IO.scala's
+ * NDArrayIter).  Supports shuffle-per-epoch and the reference's
+ * last-batch policies: "pad" wraps the final batch recording pad,
+ * "discard" drops it.
+ */
+class FullNDArrayIter(data: Array[Float], dataShape: Shape,
+                      label: Array[Float], labelWidth: Int,
+                      val batchSize: Int,
+                      shuffle: Boolean = false,
+                      lastBatchHandle: String = "pad",
+                      dataName: String = "data",
+                      labelName: String = "softmax_label",
+                      ctx: Context = Context.cpu()) extends DataIter {
+  private val rowSize = dataShape.product
+  private val numData = data.length / rowSize
+  require(numData * rowSize == data.length,
+          s"data length ${data.length} not divisible by row size $rowSize")
+  require(label.length == numData * labelWidth,
+          "label count does not match data rows")
+  require(numData >= batchSize, "batchSize larger than data")
+
+  private val order = Array.range(0, numData)
+  private val rnd = new scala.util.Random(0)
+  private var cursor = 0
+  private val batchShape = Shape(batchSize +: dataShape.toVector)
+  private val labelShape =
+    if (labelWidth == 1) Shape(batchSize) else Shape(batchSize, labelWidth)
+  private val dataArr = NDArray.empty(batchShape, ctx)
+  private val labelArr = NDArray.empty(labelShape, ctx)
+
+  def provideData: Map[String, Shape] = Map(dataName -> batchShape)
+  def provideLabel: Map[String, Shape] = Map(labelName -> labelShape)
+
+  def reset(): Unit = {
+    cursor = 0
+    if (shuffle) {
+      // Fisher-Yates over the index order; data stays in place
+      var i = order.length - 1
+      while (i > 0) {
+        val j = rnd.nextInt(i + 1)
+        val t = order(i); order(i) = order(j); order(j) = t
+        i -= 1
+      }
+    }
+  }
+
+  def hasNext: Boolean =
+    if (lastBatchHandle == "discard") cursor + batchSize <= numData
+    else cursor < numData
+
+  def next(): DataBatch = {
+    if (!hasNext) throw new NoSuchElementException("epoch complete")
+    val xb = new Array[Float](batchSize * rowSize)
+    val yb = new Array[Float](batchSize * labelWidth)
+    for (i <- 0 until batchSize) {
+      val src = order((cursor + i) % numData)  // wrap the final batch
+      System.arraycopy(data, src * rowSize, xb, i * rowSize, rowSize)
+      System.arraycopy(label, src * labelWidth, yb, i * labelWidth,
+                       labelWidth)
+    }
+    val pad = if (lastBatchHandle == "pad")
+      math.max(0, cursor + batchSize - numData) else 0
+    cursor += batchSize
+    DataBatch(IndexedSeq(dataArr.set(xb)), IndexedSeq(labelArr.set(yb)),
+              pad)
+  }
+}
