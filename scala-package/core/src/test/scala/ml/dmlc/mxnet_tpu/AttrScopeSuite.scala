@@ -1,0 +1,43 @@
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.FunSuite
+
+/** Reference AttrScopeSuite.scala analogue: scoped symbol attributes
+ * flow into created nodes and nest/restore correctly. */
+class AttrScopeSuite extends FunSuite {
+
+  test("scope attributes attach to symbols created inside") {
+    val inside = AttrScope(Map("ctx_group" -> "stage1")).withScope {
+      val a = Symbol.Variable("a")
+      val fc = SymbolOps.FullyConnected(a, numHidden = 2, name = "fc_attr")
+      fc.attr("ctx_group")
+    }
+    assert(inside.contains("stage1"))
+    // outside the scope, new symbols carry no ctx_group
+    val b = SymbolOps.FullyConnected(Symbol.Variable("b"), numHidden = 2,
+                                     name = "fc_plain")
+    assert(b.attr("ctx_group").isEmpty)
+  }
+
+  test("nested scopes merge with inner precedence") {
+    AttrScope(Map("lr_mult" -> "2")).withScope {
+      AttrScope(Map("lr_mult" -> "5")).withScope {
+        val s = SymbolOps.FullyConnected(Symbol.Variable("x"),
+                                         numHidden = 2, name = "fc_n")
+        assert(s.attr("lr_mult").contains("5"))
+        ()
+      }
+      ()
+    }
+  }
+
+  test("explicit attr wins over the scope") {
+    AttrScope(Map("lr_mult" -> "2")).withScope {
+      val s = SymbolOps.FullyConnected(Symbol.Variable("y"), numHidden = 2,
+                                       name = "fc_e")
+      s.setAttr("lr_mult", "9")
+      assert(s.attr("lr_mult").contains("9"))
+      ()
+    }
+  }
+}

@@ -1,0 +1,55 @@
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.FunSuite
+
+/** Reference ExecutorSuite.scala analogue over simpleBind. */
+class ExecutorSuite extends FunSuite {
+
+  private def mlp(): Symbol = {
+    val data = Symbol.Variable("data")
+    val fc = SymbolOps.FullyConnected(data, numHidden = 4, name = "fc_t")
+    SymbolOps.SoftmaxOutput(SymbolOps.Activation(fc, "relu", name = "r_t"),
+                            name = "softmax")
+  }
+
+  test("simpleBind forward/backward with gradient flow") {
+    val net = mlp()
+    val exe = net.simpleBind(Context.cpu(),
+                             shapes = Map("data" -> Shape(2, 3),
+                                          "softmax_label" -> Shape(2)))
+    exe.argDict("data").set(Array(1f, -2f, 3f, -4f, 5f, -6f))
+    exe.argDict("softmax_label").set(Array(0f, 1f))
+    // simpleBind zero-fills params; zero weights park ReLU exactly at 0
+    // where its gradient vanishes — give the graph a live operating point
+    exe.argDict("fc_t_weight").set(
+      Array.tabulate(12)(i => 0.1f * (i % 5 - 2)))
+    exe.forward(isTrain = true)
+    val probs = exe.outputs.head.toArray
+    assert(probs.grouped(4).forall(row => math.abs(row.sum - 1f) < 1e-4))
+    exe.backward()
+    val gw = exe.gradDict("fc_t_weight").toArray
+    assert(gw.exists(_ != 0f))
+  }
+
+  test("debugStr dumps the plan") {
+    val net = mlp()
+    val exe = net.simpleBind(Context.cpu(),
+                             shapes = Map("data" -> Shape(2, 3),
+                                          "softmax_label" -> Shape(2)))
+    assert(exe.debugStr.nonEmpty)
+  }
+
+  test("copyParamsFrom installs a checkpoint") {
+    val net = mlp()
+    val exe = net.simpleBind(Context.cpu(),
+                             shapes = Map("data" -> Shape(2, 3),
+                                          "softmax_label" -> Shape(2)))
+    val w = NDArray.ones(Shape(4, 3))
+    exe.copyParamsFrom(Map("fc_t_weight" -> w))
+    assert(exe.argDict("fc_t_weight").toArray.forall(_ == 1f))
+    intercept[Base.MXNetError] {
+      exe.copyParamsFrom(Map("nope" -> w))
+    }
+    exe.copyParamsFrom(Map("nope" -> w), allowExtraParams = true)
+  }
+}

@@ -1,0 +1,47 @@
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.FunSuite
+
+/** Reference KVStoreSuite.scala analogue. */
+class KVStoreSuite extends FunSuite {
+
+  test("init, push, pull through the local store") {
+    val kv = KVStore.create("local")
+    assert(kv.`type` == "local")
+    val w = NDArray.zeros(Shape(4))
+    kv.init(Array(3), Array(w))
+    val g = NDArray.ones(Shape(4))
+    kv.push(Array(3), Array(g))
+    val out = NDArray.zeros(Shape(4))
+    kv.pull(Array(3), Array(out))
+    assert(out.toArray.forall(_ == 1f))
+    kv.dispose()
+  }
+
+  test("aggregate: two pushes before a pull sum") {
+    val kv = KVStore.create("local")
+    val w = NDArray.zeros(Shape(2))
+    kv.init(Array(9), Array(w))
+    kv.push(Array(9), Array(NDArray.ones(Shape(2))))
+    kv.push(Array(9), Array(NDArray.ones(Shape(2)) * 2f))
+    val out = NDArray.zeros(Shape(2))
+    kv.pull(Array(9), Array(out))
+    // single-worker local store applies pushes in order; the pulled
+    // value reflects the merged updates
+    assert(out.toArray.forall(_ >= 2f))
+    kv.dispose()
+  }
+
+  test("rank and world size on a local store") {
+    val kv = KVStore.create("local")
+    assert(kv.rank == 0)
+    assert(kv.numWorkers == 1)
+    kv.dispose()
+  }
+
+  test("role queries default to worker") {
+    assert(KVStore.isWorkerNode)
+    assert(!KVStore.isServerNode)
+    assert(!KVStore.isSchedulerNode)
+  }
+}

@@ -1,0 +1,27 @@
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.FunSuite
+
+/** Reference RandomSuite.scala analogue: device-side sampling through
+ * the registry with ABI-seeded determinism. */
+class RandomSuite extends FunSuite {
+
+  test("uniform respects bounds and seed determinism") {
+    Random.seed(7)
+    val a = Random.uniform(-2f, 3f, Shape(40))
+    val va = a.toArray
+    assert(va.forall(v => v >= -2f && v <= 3f))
+    Random.seed(7)
+    val b = Random.uniform(-2f, 3f, Shape(40))
+    assert(va.toSeq == b.toArray.toSeq)
+  }
+
+  test("normal moments are plausible") {
+    Random.seed(11)
+    val a = Random.normal(1f, 2f, Shape(4000)).toArray
+    val mean = a.sum / a.length
+    val sd = math.sqrt(a.map(v => (v - mean) * (v - mean)).sum / a.length)
+    assert(math.abs(mean - 1f) < 0.2)
+    assert(math.abs(sd - 2f) < 0.3)
+  }
+}

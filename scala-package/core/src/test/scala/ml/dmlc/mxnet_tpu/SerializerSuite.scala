@@ -1,0 +1,35 @@
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.FunSuite
+
+/** Reference SerializerSuite.scala analogue: raw-byte NDArray frames +
+ * the name->array map blob + base64 text transport. */
+class SerializerSuite extends FunSuite {
+
+  test("NDArray raw-byte round trip") {
+    val a = NDArray.array(Array(5f, 4f, 3f, 2f, 1f, 0f), Shape(2, 3))
+    val bytes = Serializer.serializeNDArray(a)
+    assert(bytes.length > 6 * 4)
+    val back = Serializer.deserializeNDArray(bytes)
+    assert(back.shape == Shape(2, 3))
+    assert(back.toArray.toSeq == a.toArray.toSeq)
+  }
+
+  test("param-map blob round trip") {
+    val params = Map(
+      "fc_weight" -> NDArray.array(Array(1f, 2f, 3f, 4f), Shape(2, 2)),
+      "fc_bias" -> NDArray.array(Array(0.5f, -0.5f), Shape(2)))
+    val blob = Serializer.serializeMap(params)
+    val back = Serializer.deserializeMap(blob)
+    assert(back.keySet == params.keySet)
+    for ((k, v) <- params) {
+      assert(back(k).toArray.toSeq == v.toArray.toSeq)
+    }
+  }
+
+  test("base64 transport is lossless") {
+    val bytes = Array.tabulate[Byte](64)(i => (i * 7 - 100).toByte)
+    val text = Serializer.encodeBase64(bytes)
+    assert(Serializer.decodeBase64(text).toSeq == bytes.toSeq)
+  }
+}

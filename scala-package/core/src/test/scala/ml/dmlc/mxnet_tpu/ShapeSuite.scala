@@ -1,0 +1,27 @@
+package ml.dmlc.mxnet_tpu
+
+import org.scalatest.FunSuite
+
+/** Reference ShapeSuite.scala analogue. */
+class ShapeSuite extends FunSuite {
+
+  test("construction, equality, product") {
+    val s = Shape(2, 3, 4)
+    assert(s == Shape(Seq(2, 3, 4)))
+    assert(s(0) == 2 && s(2) == 4)
+    assert(s.length == 3)
+    assert(s.product == 24)
+    assert(s != Shape(2, 3))
+  }
+
+  test("drop and slice") {
+    val s = Shape(2, 3, 4, 5)
+    assert(s.drop(1) == Shape(3, 4, 5))
+    assert(s.slice(1, 3) == Shape(3, 4))
+    assert(s.head == 2)
+  }
+
+  test("toString is the tuple form") {
+    assert(Shape(1, 28, 28).toString == "(1,28,28)")
+  }
+}
