@@ -1,0 +1,27 @@
+# Build configuration knobs (reference make/config.mk shape).
+# Copy to the repo root as config.mk or pass on the command line:
+#   make CXX=clang++ ADD_CFLAGS=-march=native
+#
+# The native surface here is deliberately small: XLA/PJRT (via jaxlib)
+# does the accelerator work the reference built CUDA/cuDNN/BLAS flags
+# for, so most reference knobs have no TPU-build counterpart and are
+# listed at the bottom for porters.
+
+# toolchain
+export CXX ?= g++
+export ADD_CFLAGS ?=
+export ADD_LDFLAGS ?=
+
+# optimization level for the native core (engine/storage/IO/ABI)
+export OPT_FLAGS ?= -O3
+
+# whether `make test` runs the whole suite or the fast unit tier
+export TEST_TIER ?= all
+
+# ---------------------------------------------------------------------------
+# Reference knobs with no equivalent here (documented, not honored):
+#   USE_CUDA / USE_CUDNN / USE_CUDA_PATH  -> XLA:TPU via jaxlib
+#   USE_BLAS / USE_MKL / ATLAS            -> MXU matmuls via XLA
+#   USE_OPENCV                            -> libjpeg decode in src/, PIL tail
+#   USE_DIST_KVSTORE / USE_HDFS / USE_S3  -> always on (collectives + fsspec)
+#   USE_NVRTC                             -> Pallas kernels (mxnet_tpu/rtc.py)
