@@ -409,3 +409,22 @@ def test_native_loader_fails_loud_on_undersized(tmp_path):
         for _ in range(10):
             if ld.next() is None:
                 break
+
+
+@pytest.mark.skipif(not os.path.exists(
+    os.path.join(os.path.dirname(mx.__file__), "libmxtpu.so")),
+    reason="native lib not built")
+def test_bench_io_leg_runs():
+    """The bench input-pipeline leg (bench_io.run) must stay runnable off
+    the chip: it backs a driver-recorded metric and silent rot would drop
+    the io_* keys from BENCH artifacts."""
+    pytest.importorskip("PIL")
+    import sys as _sys
+    root = os.path.dirname(os.path.dirname(mx.__file__))
+    if root not in _sys.path:
+        _sys.path.insert(0, root)
+    import bench_io
+    out = bench_io.run(batch=16, threads=1, seconds=0.4)
+    assert out["io_jpeg_img_s"] > 0
+    assert out["io_raw_img_s"] > 0
+    assert out["io_host_cores"] >= 1
