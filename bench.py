@@ -102,9 +102,15 @@ def device_preflight(timeout_s=None, retries=1):
     return diag
 
 
-def probe_peak_tflops(iters=16, n=8192, windows=3):
+def probe_peak_tflops(iters=16, n=8192, windows=4):
     """Measured bf16 matmul peak of this chip — the MFU denominator.
-    Median of several windows: the tunnel clock is noisy."""
+
+    Statistic: max over the windows CONSISTENT with the median (within
+    1.3x).  Both documented tunnel-clock failure modes are covered: a
+    slow window (background work) must not cap the peak — a median alone
+    once underestimated it enough to print mfu 1.02 — and a fast-dilated
+    window (the round-2 '66,500 TF/s' artifact) must not be selected by
+    a bare max; the consistency filter discards it."""
     import jax
     import jax.numpy as jnp
     a = jnp.ones((n, n), jnp.bfloat16)
@@ -118,7 +124,9 @@ def probe_peak_tflops(iters=16, n=8192, windows=3):
             out = f(out, a)
         out.block_until_ready()
         rates.append(2.0 * n ** 3 * iters / (time.perf_counter() - t0) / 1e12)
-    return sorted(rates)[len(rates) // 2]
+    med = sorted(rates)[len(rates) // 2]
+    consistent = [r for r in rates if r <= 1.3 * med]
+    return max(consistent)
 
 
 def build_module(batch):
@@ -280,6 +288,24 @@ def main():
     # the probe lands outside the physically possible band, say so and
     # refuse to publish a baseline comparison built on that clock.
     clock_suspect = clock_is_suspect(peak)
+    if clock_suspect:
+        # the dilation is a PER-PROCESS property (docs/perf.md: the same
+        # chip has probed 90 TF/s in one process and 76,000 in another):
+        # recovery is re-spawn, exactly like the wedged-device preflight.
+        # A measured 45,054 TF/s probe once rode through here publishing
+        # "70,196 img/s" as the primary metric — retry in a fresh
+        # interpreter before resorting to a flagged artifact.
+        retries = int(os.environ.get("MXNET_BENCH_CLOCK_RETRIES", "2"))
+        if retries > 0:
+            sys.stderr.write(
+                "bench: probe %.1f TF/s is outside the physical band; "
+                "re-spawning for a fresh clock (%d retr%s left)\n"
+                % (peak, retries, "y" if retries == 1 else "ies"))
+            _wd.stop()
+            env = dict(os.environ)
+            env["MXNET_BENCH_CLOCK_RETRIES"] = str(retries - 1)
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)], env)
     line = {
         "metric": "resnet50_train_throughput_per_chip",
         "value": round(value, 2),
@@ -305,22 +331,61 @@ def main():
     # process, same peak probe — the only comparison this tunnel allows.
     try:
         from bench_lstm import run as lstm_run, train_mflop_per_token
-        _feed_watchdog("lstm")
+
+        def measured_leg(phase, mflop_per_token, **kwargs):
+            """Run an LSTM leg with two independent sanity gates:
+            (a) ABSOLUTE: tok implies <= PEAK_SANE_TFLOPS[1] of compute —
+                catches clock dilation (a glitch once yielded 220M
+                'tok/s' = 3.5 PF/s) even when the peak probe failed;
+                one retry, then nothing is published;
+            (b) vs the measured peak: mfu > 1.05 withholds ONLY the mfu
+                (tok does not depend on peak; a bad peak must not
+                discard a clean throughput measurement).
+            Returns (tok, mfu-or-None, suspect)."""
+            hard_cap = PEAK_SANE_TFLOPS[1] * 1e12 / (mflop_per_token * 1e6)
+            for attempt in range(2):
+                _feed_watchdog(phase)
+                tok = lstm_run(**kwargs)
+                if tok <= hard_cap:
+                    break
+                sys.stderr.write(
+                    "bench: %s measured %.3g tok/s, beyond any physical "
+                    "chip (clock glitch); attempt %d\n"
+                    % (phase, tok, attempt))
+            else:
+                return None, None, True
+            mfu = (tok * mflop_per_token * 1e6 / (peak * 1e12)
+                   if peak else None)
+            if mfu is not None and mfu > 1.05:
+                sys.stderr.write(
+                    "bench: %s mfu %.2f vs probe peak is impossible; "
+                    "publishing tok/s only\n" % (phase, mfu))
+                return tok, None, True
+            return tok, mfu, False
+
         # b2048: the measured MFU plateau for the PTB shape (bench_lstm.py
         # sweep note; b256 leaves ~1.7x on the table)
-        tok = lstm_run(batch=2048, iters=10, windows=3)
-        line["lstm_tokens_per_sec"] = round(tok, 1)
-        if peak:
-            line["lstm_mfu"] = round(
-                tok * train_mflop_per_token() * 1e6 / (peak * 1e12), 4)
-        _feed_watchdog("lstm-h1024")
-        tok_big = lstm_run(batch=256, num_hidden=1024, num_embed=1024,
-                           iters=10, windows=3)
-        line["lstm_h1024_tokens_per_sec"] = round(tok_big, 1)
-        if peak:
-            line["lstm_h1024_mfu"] = round(
-                tok_big * train_mflop_per_token(hidden=1024, embed=1024)
-                * 1e6 / (peak * 1e12), 4)
+        tok, mfu, suspect = measured_leg(
+            "lstm", train_mflop_per_token(), batch=2048, iters=10,
+            windows=3)
+        if tok is not None:
+            line["lstm_tokens_per_sec"] = round(tok, 1)
+            if mfu is not None:
+                line["lstm_mfu"] = round(mfu, 4)
+        if suspect:
+            line["lstm_clock_suspect"] = True
+        # b512: measured same-process mfu 0.73 (b256) -> 0.98 (b512) —
+        # at 1024-wide gates the MXU is K-satisfied and batch is the
+        # remaining M lever
+        tok_big, mfu_big, suspect_big = measured_leg(
+            "lstm-h1024", train_mflop_per_token(hidden=1024, embed=1024),
+            batch=512, num_hidden=1024, num_embed=1024, iters=8, windows=3)
+        if tok_big is not None:
+            line["lstm_h1024_tokens_per_sec"] = round(tok_big, 1)
+            if mfu_big is not None:
+                line["lstm_h1024_mfu"] = round(mfu_big, 4)
+        if suspect_big:
+            line["lstm_h1024_clock_suspect"] = True
     except Exception as e:
         sys.stderr.write("bench: lstm leg failed (%s)\n" % e)
     _PARTIAL_LINE = dict(line)

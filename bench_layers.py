@@ -71,21 +71,9 @@ def conv_flops(w_shape, out_shape, groups):
     return 2.0 * n * oh * ow * o * i * kh * kw
 
 
-def probe_peak_tflops(iters=16, n=8192, windows=3):
-    import jax
-    import jax.numpy as jnp
-    a = jnp.ones((n, n), jnp.bfloat16)
-    f = jax.jit(lambda x, y: x @ y)
-    f(a, a).block_until_ready()
-    rates = []
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        out = a
-        for _ in range(iters):
-            out = f(out, a)
-        out.block_until_ready()
-        rates.append(2.0 * n ** 3 * iters / (time.perf_counter() - t0) / 1e12)
-    return sorted(rates)[len(rates) // 2]
+# one probe, one statistic: per-layer mfu must share the headline
+# bench's denominator or the two sets of numbers stop being comparable
+from bench import probe_peak_tflops  # noqa: E402
 
 
 def time_conv(x_shape, w_shape, stride, pad, groups, iters, windows=3):
