@@ -102,15 +102,21 @@ def device_preflight(timeout_s=None, retries=1):
     return diag
 
 
-def probe_peak_tflops(iters=16, n=8192, windows=4):
-    """Measured bf16 matmul peak of this chip — the MFU denominator.
+def consistent_peak(rates, tolerance=1.3):
+    """Peak statistic over timing windows: max of the windows CONSISTENT
+    with the median (within `tolerance`x).  Both documented tunnel-clock
+    failure modes are covered: a slow window (background work) must not
+    cap the peak — a median alone once underestimated it enough to print
+    mfu 1.02 — and a fast-dilated window (the round-2 '66,500 TF/s'
+    artifact) must not be selected by a bare max; the consistency filter
+    discards it."""
+    med = sorted(rates)[len(rates) // 2]
+    return max(r for r in rates if r <= tolerance * med)
 
-    Statistic: max over the windows CONSISTENT with the median (within
-    1.3x).  Both documented tunnel-clock failure modes are covered: a
-    slow window (background work) must not cap the peak — a median alone
-    once underestimated it enough to print mfu 1.02 — and a fast-dilated
-    window (the round-2 '66,500 TF/s' artifact) must not be selected by
-    a bare max; the consistency filter discards it."""
+
+def probe_peak_tflops(iters=16, n=8192, windows=4):
+    """Measured bf16 matmul peak of this chip — the MFU denominator
+    (see consistent_peak for the statistic)."""
     import jax
     import jax.numpy as jnp
     a = jnp.ones((n, n), jnp.bfloat16)
@@ -124,9 +130,7 @@ def probe_peak_tflops(iters=16, n=8192, windows=4):
             out = f(out, a)
         out.block_until_ready()
         rates.append(2.0 * n ** 3 * iters / (time.perf_counter() - t0) / 1e12)
-    med = sorted(rates)[len(rates) // 2]
-    consistent = [r for r in rates if r <= 1.3 * med]
-    return max(consistent)
+    return consistent_peak(rates)
 
 
 def build_module(batch):

@@ -135,3 +135,23 @@ def test_dryrun_multichip_hermetic_no_env_help():
         cwd=REPO, env=env, timeout=600, capture_output=True, text=True)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "dryrun_multichip OK" in r.stdout
+
+
+def test_consistent_peak_statistic():
+    """The probe's peak statistic must survive BOTH documented tunnel
+    clock failures: slow windows must not cap the peak (max over the
+    consistent set), and a fast-dilated window must be discarded (bare
+    max would crown it)."""
+    from bench import consistent_peak, clock_is_suspect
+
+    # healthy windows: best consistent window wins
+    assert consistent_peak([85.0, 88.0, 90.0, 87.0]) == 90.0
+    # one slow window (background work): must not drag the peak down
+    assert consistent_peak([40.0, 88.0, 90.0, 87.0]) == 90.0
+    # one fast-dilated glitch: must NOT be selected
+    assert consistent_peak([85.0, 88.0, 600.0, 87.0]) == 88.0
+    # glitch plus slow window together
+    assert consistent_peak([40.0, 88.0, 600.0, 87.0]) == 88.0
+    # a fully dilated process still lands outside the sane band and is
+    # caught downstream by the clock_suspect re-spawn
+    assert clock_is_suspect(consistent_peak([45000.0] * 4))
