@@ -67,7 +67,6 @@ class BatchLoader {
       std::shuffle(order_.begin(), order_.end(), rng_);
     }
     cursor_.store(0);
-    eof_produced_.store(false);
     stop_.store(false);
     for (int i = 0; i < n_threads_; ++i)
       workers_.emplace_back([this] { WorkerLoop(); });
@@ -80,13 +79,27 @@ class BatchLoader {
   // reference's sequential batch stream.
   int Next(float* data, float* label, int* pad) {
     std::unique_lock<std::mutex> lk(mu_);
-    not_empty_.wait(lk, [this] {
+    // End-of-epoch is EXACT: every one of the ceil(n/batch) sequences
+    // must be delivered.  "Some worker ran off the end" is NOT the
+    // condition — with more workers than the admission window, the
+    // first worker past the cursor end races ahead of workers still
+    // waiting at the gate with undelivered earlier sequences, and an
+    // eof flag alone truncated an 8-batch epoch to 2.
+    const size_t total = total_batches();
+    not_empty_.wait(lk, [this, total] {
       return !error_.empty() || pending_.count(next_seq_) != 0 ||
-             (eof_produced_.load() && in_flight_ == 0);
+             next_seq_ >= total;
     });
     if (!error_.empty()) return 2;
+    if (next_seq_ >= total) return 1;
     auto it = pending_.find(next_seq_);
-    if (it == pending_.end()) return 1;
+    if (it == pending_.end()) {
+      // unreachable by the wait predicate; a lost batch must be LOUD,
+      // never a silent end-of-epoch (the truncation bug this replaced)
+      error_ = "internal: sequence " + std::to_string(next_seq_) +
+               " missing from the reorder buffer";
+      return 2;
+    }
     Batch b = std::move(it->second);
     pending_.erase(it);
     ++next_seq_;
@@ -103,6 +116,12 @@ class BatchLoader {
     return error_.c_str();
   }
 
+  size_t total_batches() const {
+    return order_.empty() ? 0
+        : (order_.size() + static_cast<size_t>(batch_) - 1) /
+              static_cast<size_t>(batch_);
+  }
+
  private:
   void Stop() {
     stop_.store(true);
@@ -111,7 +130,6 @@ class BatchLoader {
     for (auto& t : workers_) t.join();
     workers_.clear();
     pending_.clear();
-    in_flight_ = 0;
     next_seq_ = 0;
     error_.clear();
   }
@@ -256,11 +274,7 @@ class BatchLoader {
     const size_t img_sz = static_cast<size_t>(c_) * h_ * w_;
     while (!stop_.load()) {
       size_t start = cursor_.fetch_add(batch_);
-      if (start >= n) {
-        eof_produced_.store(true);
-        not_empty_.notify_all();
-        return;
-      }
+      if (start >= n) return;   // the exact end condition lives in Next()
       size_t seq = start / static_cast<size_t>(batch_);
       {
         std::unique_lock<std::mutex> lk(mu_);
@@ -275,7 +289,6 @@ class BatchLoader {
                  || stop_.load();
         });
         if (stop_.load()) return;
-        ++in_flight_;
       }
       Batch b;
       b.data.resize(static_cast<size_t>(batch_) * img_sz);
@@ -288,7 +301,6 @@ class BatchLoader {
       {
         std::lock_guard<std::mutex> lk(mu_);
         pending_.emplace(seq, std::move(b));
-        --in_flight_;
       }
       not_empty_.notify_all();
     }
@@ -311,11 +323,9 @@ class BatchLoader {
   std::map<size_t, Batch> pending_;  // seq -> batch, drained in order
   size_t next_seq_ = 0;
   std::string error_;                // first decode failure, sticky
-  int in_flight_ = 0;
   std::mutex mu_;
   std::condition_variable not_empty_, not_full_;
   std::atomic<size_t> cursor_{0};
-  std::atomic<bool> eof_produced_{false};
   std::atomic<bool> stop_{false};
 };
 

@@ -428,3 +428,32 @@ def test_bench_io_leg_runs():
     assert out["io_jpeg_img_s"] > 0
     assert out["io_raw_img_s"] > 0
     assert out["io_host_cores"] >= 1
+
+
+@pytest.mark.skipif(not os.path.exists(
+    os.path.join(os.path.dirname(mx.__file__), "libmxtpu.so")),
+    reason="native lib not built")
+def test_native_loader_complete_epochs_under_contention(tmp_path):
+    """More workers than the admission window must not truncate an epoch:
+    the first worker past the cursor end races ahead of workers still
+    gated on earlier sequences, and an eof-flag end condition once cut an
+    8-batch epoch to 2.  End-of-epoch is exact (every sequence
+    delivered), in order, across epochs and mid-epoch resets."""
+    frec = _write_jpeg_rec(tmp_path, n=37, size_lo=40, size_hi=44)
+    from mxnet_tpu.native_io import NativeBatchLoader
+    ld = NativeBatchLoader(frec, 5, (3, 32, 32), threads=6, queue_depth=2)
+    for _ in range(5):
+        labels = []
+        while True:
+            out = ld.next()
+            if out is None:
+                break
+            labels.extend(out[1].ravel().tolist())
+        assert len(labels) == 40                      # 8 full batches
+        # _write_jpeg_rec labels records i%5, in record order
+        assert labels[:37] == [float(i % 5) for i in range(37)]
+        ld.reset()
+    for k in range(12):                               # mid-epoch resets
+        assert ld.next() is not None
+        if k % 3 == 0:
+            ld.reset()
