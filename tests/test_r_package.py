@@ -101,3 +101,27 @@ def test_r_surface_depth_and_call_targets():
                 "mx.callback.save.checkpoint", "mx.runif",
                 "mx.metric.rmse", "graph.viz"]:
         assert "export(%s)" % api in ns, api
+
+
+def test_generated_r_ops_in_sync():
+    """R/mxnet_generated.R is generator output (tools/gen_r_ops.py); a
+    newly registered operator must not silently drift out of the shipped
+    file.  The generator is deterministic and writes in place: capture
+    the committed text, regenerate, compare (a drift leaves the fresh
+    output in the working tree for the developer to commit)."""
+    import subprocess
+
+    committed = os.path.join(ROOT, "R-package", "R", "mxnet_generated.R")
+    with open(committed) as f:
+        want = f.read()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "gen_r_ops.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=ROOT)
+    assert res.returncode == 0, res.stderr
+    with open(committed) as f:
+        got = f.read()
+    assert got == want, ("tools/gen_r_ops.py output changed: commit the "
+                         "regenerated R-package/R/mxnet_generated.R")
