@@ -60,6 +60,30 @@ def clock_is_suspect(peak_tflops):
         PEAK_SANE_TFLOPS[0] <= peak_tflops <= PEAK_SANE_TFLOPS[1])
 
 
+def maybe_respawn_for_clock(peak, watchdog):
+    """Clock dilation is a PER-PROCESS property (docs/perf.md: the same
+    chip has probed 90 TF/s in one process and 76,000 in another), so
+    recovery is re-spawn, exactly like the wedged-device preflight.  A
+    measured 45,054 TF/s probe once rode through publishing "70,196
+    img/s" as the primary metric — retry in a fresh interpreter (bounded
+    by MXNET_BENCH_CLOCK_RETRIES) before resorting to a flagged
+    artifact.  Returns only when out of retries; otherwise execve never
+    returns."""
+    import os
+    retries = int(os.environ.get("MXNET_BENCH_CLOCK_RETRIES", "2"))
+    if retries <= 0:
+        return
+    sys.stderr.write(
+        "bench: probe %.1f TF/s is outside the physical band; "
+        "re-spawning for a fresh clock (%d retr%s left)\n"
+        % (peak, retries, "y" if retries == 1 else "ies"))
+    watchdog.stop()
+    env = dict(os.environ)
+    env["MXNET_BENCH_CLOCK_RETRIES"] = str(retries - 1)
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)], env)
+
+
 def device_preflight(timeout_s=None, retries=1):
     """Bounded-time device health check in a SUBPROCESS (a wedged backend
     hangs inside native code and cannot be interrupted in-process; a child
@@ -293,23 +317,7 @@ def main():
     # refuse to publish a baseline comparison built on that clock.
     clock_suspect = clock_is_suspect(peak)
     if clock_suspect:
-        # the dilation is a PER-PROCESS property (docs/perf.md: the same
-        # chip has probed 90 TF/s in one process and 76,000 in another):
-        # recovery is re-spawn, exactly like the wedged-device preflight.
-        # A measured 45,054 TF/s probe once rode through here publishing
-        # "70,196 img/s" as the primary metric — retry in a fresh
-        # interpreter before resorting to a flagged artifact.
-        retries = int(os.environ.get("MXNET_BENCH_CLOCK_RETRIES", "2"))
-        if retries > 0:
-            sys.stderr.write(
-                "bench: probe %.1f TF/s is outside the physical band; "
-                "re-spawning for a fresh clock (%d retr%s left)\n"
-                % (peak, retries, "y" if retries == 1 else "ies"))
-            _wd.stop()
-            env = dict(os.environ)
-            env["MXNET_BENCH_CLOCK_RETRIES"] = str(retries - 1)
-            os.execve(sys.executable,
-                      [sys.executable, os.path.abspath(__file__)], env)
+        maybe_respawn_for_clock(peak, _wd)
     line = {
         "metric": "resnet50_train_throughput_per_chip",
         "value": round(value, 2),

@@ -155,3 +155,38 @@ def test_consistent_peak_statistic():
     # a fully dilated process still lands outside the sane band and is
     # caught downstream by the clock_suspect re-spawn
     assert clock_is_suspect(consistent_peak([45000.0] * 4))
+
+
+def test_clock_respawn_decision(monkeypatch):
+    """The bad-clock recovery must build a valid execve: real interpreter,
+    existing script path, string-only env with the retry budget
+    decremented; and it must not re-spawn once the budget is spent."""
+    import os
+    import sys as _sys
+    import bench
+
+    calls = []
+    stopped = []
+
+    class WD:
+        def stop(self):
+            stopped.append(True)
+
+    def fake_execve(path, argv, env):
+        calls.append((path, argv, env))
+
+    monkeypatch.setattr(os, "execve", fake_execve)
+    monkeypatch.setenv("MXNET_BENCH_CLOCK_RETRIES", "2")
+    bench.maybe_respawn_for_clock(45053.9, WD())
+    assert stopped == [True]          # watchdog released before exec
+    (path, argv, env), = calls
+    assert path == _sys.executable
+    assert os.path.exists(argv[1]) and argv[1].endswith("bench.py")
+    assert env["MXNET_BENCH_CLOCK_RETRIES"] == "1"   # budget decremented
+    assert all(isinstance(k, str) and isinstance(v, str)
+               for k, v in env.items())
+
+    calls.clear()
+    monkeypatch.setenv("MXNET_BENCH_CLOCK_RETRIES", "0")
+    bench.maybe_respawn_for_clock(45053.9, WD())
+    assert calls == []                # out of retries: fall through
