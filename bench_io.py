@@ -1,20 +1,30 @@
-"""Input-pipeline benchmark leg: RecordIO -> native decode -> device.
+"""Input-pipeline benchmark legs: RecordIO -> decode -> device -> train.
 
 Measures what bench.py's device-only number deliberately excludes: the
-host-side cost of feeding the chip.  Two legs over synthetic .rec files
-built at bench time (self-contained, no dataset on disk):
+host-side cost of feeding the chip.  Legs over synthetic .rec files built
+at bench time (self-contained, no dataset on disk):
 
-  jpeg: training-resolution JPEG records (what im2rec --resize 256
-        produces for ImageNet) through the native loader's libjpeg worker
-        threads + crop/mirror/normalize, ending in jax.device_put — the
-        reference's ImageRecordIter+prefetcher path
-        (src/io/iter_image_recordio.cc:139-291).
-  raw:  raw-CHW-packed records (decode-free), isolating the framing +
-        normalize + H2D cost.
+  jpeg:     training-resolution PHOTO-ENTROPY JPEGs (high-frequency
+            content at realistic ~100KB/file — an upscaled-noise-free
+            workload; VERDICT r5 #2 showed 8x8-upscaled images decode
+            several times cheaper than real photos) through the native
+            loader's libjpeg worker threads + crop/mirror/normalize.
+  scaling:  the same jpeg leg at 1 thread and at >=2 threads, so every
+            BENCH artifact carries a thread-scaling datum even from a
+            1-core tunnel host (io_thread_speedup).
+  raw:      raw-CHW-packed records (decode-free), isolating framing +
+            normalize cost.
+  pipeline: the COMBINED loader -> Module.fit leg: NativeImageRecordIter
+            feeding a small conv net through the feed subsystem's
+            prefetch-to-device staging (mxnet_tpu.feed), recording
+            io_pipeline_img_s (end-to-end trained img/s),
+            io_train_img_s (same step on a pre-staged batch: the chip's
+            demand), and io_feed_headroom = feed capacity / train demand
+            — >1 means the input side keeps pace with the compute side.
 
-Throughput scales with host cores (each worker owns a full decode chain);
-`io_host_cores` is reported so a 1-core tunnel host reading 500 img/s and
-a 32-core production host reading 12k img/s are both interpretable.
+Throughput scales with host cores (each worker owns a full decode
+chain); `io_host_cores` is reported so a 1-core tunnel host and a
+32-core production host are both interpretable.
 """
 import os
 import tempfile
@@ -23,28 +33,48 @@ import time
 import numpy as np
 
 
-def _build_jpeg_rec(path, n=192, edge=256, quality=90, seed=0):
-    """Pack n pseudo-photo JPEGs (shorter edge = `edge`) into a .rec."""
+def _build_jpeg_rec(path, n=160, edge=256, quality=95, seed=0):
+    """Pack n photo-entropy JPEGs (shorter edge = `edge`) into a .rec.
+
+    Content = smooth low-frequency base + mid-frequency gratings +
+    per-pixel texture noise: energy across the whole spectrum, like a
+    detailed photograph, costing libjpeg real Huffman + IDCT work
+    (~90-100KB/file at q95 and 256-edge — what im2rec --resize 256
+    produces from ImageNet).  The old upscaled-8x8 images had nearly
+    flat DCT blocks and decoded several times cheaper (VERDICT r5 #2).
+    Returns mean encoded KB per file."""
     import io as _io
     from PIL import Image
     from mxnet_tpu import recordio
     rng = np.random.RandomState(seed)
     w = recordio.MXRecordIO(path, "w")
+    total = 0
     for i in range(n):
         h, wd = edge, edge + int(rng.randint(0, 96))
         if rng.rand() < 0.5:
             h, wd = wd, h
-        # low-frequency content compresses like a photo, unlike pure noise
-        base = rng.randint(0, 255, (8, 8, 3)).astype(np.uint8)
-        img = Image.fromarray(base).resize((wd, h), Image.BILINEAR)
+        base = rng.randint(0, 255, (32, 32, 3)).astype(np.uint8)
+        smooth = np.asarray(Image.fromarray(base).resize((wd, h),
+                                                         Image.BILINEAR),
+                            np.float32)
+        yy, xx = np.mgrid[0:h, 0:wd].astype(np.float32)
+        grating = sum(40.0 * np.sin(2 * np.pi * (xx * fx + yy * fy))
+                      for fx, fy in ((0.11, 0.07), (0.23, 0.31),
+                                     (0.43, 0.17)))
+        texture = rng.normal(0.0, 45.0, (h, wd, 3)).astype(np.float32)
+        img = np.clip(smooth + grating[..., None] + texture,
+                      0, 255).astype(np.uint8)
         buf = _io.BytesIO()
-        img.save(buf, format="JPEG", quality=quality)
+        Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+        payload = buf.getvalue()
+        total += len(payload)
         w.write(recordio.pack(recordio.IRHeader(0, float(i % 1000), i, 0),
-                              buf.getvalue()))
+                              payload))
     w.close()
+    return total / n / 1024.0
 
 
-def _build_raw_rec(path, n=192, shape=(3, 224, 224), seed=0):
+def _build_raw_rec(path, n=160, shape=(3, 224, 224), seed=0):
     from mxnet_tpu import recordio
     rng = np.random.RandomState(seed)
     w = recordio.MXRecordIO(path, "w")
@@ -69,6 +99,16 @@ def _pump(loader, seconds=4.0):
     return n / (time.perf_counter() - t0)
 
 
+def _jpeg_rate(jpeg_rec, batch, threads, seconds):
+    from mxnet_tpu.native_io import NativeBatchLoader
+    ld = NativeBatchLoader(jpeg_rec, batch, (3, 224, 224), threads=threads,
+                           shuffle=True, rand_crop=True, rand_mirror=True,
+                           scale=1.0 / 255)
+    rate = _pump(ld, seconds=seconds)
+    del ld
+    return rate
+
+
 def _h2d_probe(batch=128, iters=8):
     """Host->device bandwidth for one training batch (MB/s).  Reported
     separately from the pipeline rate: on a production TPU host this is a
@@ -76,7 +116,6 @@ def _h2d_probe(batch=128, iters=8):
     bench tunnel it is a network hop and would dominate any combined
     number, which is why the device-side bench pre-stages batches."""
     import jax
-    import jax.numpy as jnp
     x = np.random.rand(batch, 3, 224, 224).astype(np.float32)
     jax.block_until_ready(jax.device_put(x))  # warm path
     t0 = time.perf_counter()
@@ -86,9 +125,102 @@ def _h2d_probe(batch=128, iters=8):
     return x.nbytes * iters / dt / 1e6
 
 
-def run(batch=128, threads=None, seconds=4.0, feed=lambda *_: None):
+def _bench_net():
+    """Small conv net for the combined leg: enough MXU/ALU work to be a
+    believable consumer, small enough that the leg measures the FEED."""
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=16, kernel=(7, 7),
+                             stride=(4, 4), name="conv0")
+    net = mx.sym.Pooling(net, kernel=(7, 7), stride=(7, 7), pool_type="avg",
+                         name="pool0")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=100, name="fc0")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _sync_module(mod):
+    import jax
+    if getattr(mod, "_fused_state", None) is not None:
+        jax.block_until_ready(next(iter(mod._fused_state["params"].values())))
+    else:
+        mod.get_outputs()[0].asnumpy()
+
+
+def _pipeline_leg(jpeg_rec, batch, threads, seconds, feed):
+    """Combined loader -> Module.fit leg through feed.prefetch-to-device.
+
+    Epoch 0 warms up (compiles the fused step); epoch 1 is measured
+    batch-end to batch-end.  Returns io_pipeline_img_s (end-to-end),
+    io_train_img_s (pre-staged step rate), io_feed_headroom (host feed
+    capacity / chip demand), and io_h2d_stall_s (time the device feed
+    spent starved by the host pipeline during the measured epoch)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import NativeImageRecordIter, ResizeIter
+
+    ctx = mx.tpu(0) if jax.devices()[0].platform != "cpu" else mx.cpu(0)
+    steps = max(4, int(2 * seconds))
+    src = NativeImageRecordIter(jpeg_rec, (3, 224, 224), batch,
+                                preprocess_threads=threads, shuffle=True,
+                                rand_crop=True, rand_mirror=True,
+                                scale=1.0 / 255)
+    it = ResizeIter(src, steps)
+    mod = mx.mod.Module(_bench_net(), context=ctx)
+    marks = {"n": 0}
+
+    def cb(param):
+        feed("io-pipeline")
+        if param.epoch == 1:
+            if param.nbatch == 0:
+                marks["t0"] = time.perf_counter()
+                marks["stall0"] = \
+                    wrapped.stats.report()["h2d"]["stall_in_s"]
+            marks["n"] = param.nbatch + 1
+            marks["t1"] = time.perf_counter()
+
+    # wrap OURSELVES (not via fit(prefetch_to_device=True)) and keep the
+    # wrapper alive: its stats registration is weak, and a wrapper local
+    # to fit()'s frame would be gone — stall counters with it — before
+    # this leg could read them.  Sharding still resolves lazily from the
+    # module's fused step, which exists by the first staged batch.
+    wrapped = mx.feed.device_feed(it, module=mod, depth=2)
+    mod.fit(wrapped, num_epoch=2, batch_end_callback=cb,
+            optimizer_params=(("learning_rate", 0.01),))
+    out = {}
+    if marks["n"] > 1:
+        wall = marks["t1"] - marks["t0"]
+        out["io_pipeline_img_s"] = round((marks["n"] - 1) * batch / wall, 1)
+    # the h2d stall counter: how long the chip-side consumer waited on
+    # the host pipeline during the MEASURED epoch (epoch 0 is warm-up/
+    # compile, so the cumulative counter is snapshotted at epoch-1 start)
+    out["io_h2d_stall_s"] = round(
+        wrapped.stats.report()["h2d"]["stall_in_s"]
+        - marks.get("stall0", 0.0), 4)
+
+    # chip demand: the same step on one pre-staged resident batch
+    feed("io-train-only")
+    staged = mod.prefetch_to_device(ResizeIter(src, 1), depth=1).next()
+    for _ in range(2):
+        mod.forward(staged, is_train=True)
+        mod.backward()
+        mod.update()
+    _sync_module(mod)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        mod.forward(staged, is_train=True)
+        mod.backward()
+        mod.update()
+    _sync_module(mod)
+    out["io_train_img_s"] = round(
+        steps * batch / (time.perf_counter() - t0), 1)
+    return out
+
+
+def run(batch=128, threads=None, seconds=4.0, feed=lambda *_: None,
+        pipeline=True):
     """Returns dict of io_* metrics.  `feed` is the watchdog heartbeat."""
-    from mxnet_tpu.native_io import NativeBatchLoader, lib_available
+    from mxnet_tpu.native_io import lib_available, NativeBatchLoader
     if not lib_available():
         raise RuntimeError("libmxtpu.so not built")
     cores = os.cpu_count() or 1
@@ -98,19 +230,41 @@ def run(batch=128, threads=None, seconds=4.0, feed=lambda *_: None):
         feed("io-build")
         jpeg_rec = os.path.join(tmp, "bench_jpeg.rec")
         raw_rec = os.path.join(tmp, "bench_raw.rec")
-        _build_jpeg_rec(jpeg_rec)
+        out["io_jpeg_kb_mean"] = round(_build_jpeg_rec(jpeg_rec), 1)
         _build_raw_rec(raw_rec)
         feed("io-jpeg")
-        ld = NativeBatchLoader(jpeg_rec, batch, (3, 224, 224),
-                               threads=threads, shuffle=True, rand_crop=True,
-                               rand_mirror=True, scale=1.0 / 255)
-        out["io_jpeg_img_s"] = round(_pump(ld, seconds=seconds), 1)
-        del ld
+        out["io_jpeg_img_s"] = round(
+            _jpeg_rate(jpeg_rec, batch, threads, seconds), 1)
+        # thread-scaling datum (VERDICT r5 weak #2): 1 thread vs >=2, so
+        # the decode pipeline's parallel speedup is measured every round
+        # even when the main leg runs single-threaded
+        mt = max(2, threads)
+        feed("io-jpeg-scaling")
+        t1_rate = (out["io_jpeg_img_s"] if threads == 1 else
+                   round(_jpeg_rate(jpeg_rec, batch, 1, seconds / 2), 1))
+        mt_rate = (out["io_jpeg_img_s"] if threads == mt else
+                   round(_jpeg_rate(jpeg_rec, batch, mt, seconds / 2), 1))
+        out["io_jpeg_img_s_1t"] = t1_rate
+        out["io_jpeg_img_s_mt"] = mt_rate
+        out["io_threads_mt"] = mt
+        if t1_rate:
+            out["io_thread_speedup"] = round(mt_rate / t1_rate, 2)
         feed("io-raw")
         ld = NativeBatchLoader(raw_rec, batch, (3, 224, 224),
                                threads=threads, shuffle=True)
         out["io_raw_img_s"] = round(_pump(ld, seconds=seconds), 1)
         del ld
+        if pipeline:
+            feed("io-pipeline")
+            try:
+                out.update(_pipeline_leg(jpeg_rec, batch, threads, seconds,
+                                         feed))
+                if out.get("io_train_img_s"):
+                    out["io_feed_headroom"] = round(
+                        out["io_jpeg_img_s"] / out["io_train_img_s"], 3)
+            except Exception as e:   # combined leg is additive, never fatal
+                import sys
+                sys.stderr.write("bench_io: pipeline leg failed (%s)\n" % e)
     feed("io-h2d")
     try:
         out["io_h2d_mb_s"] = round(_h2d_probe(batch), 1)

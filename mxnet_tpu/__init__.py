@@ -54,6 +54,7 @@ from . import parallel
 from . import plugins
 from .plugins import torch_bridge as th
 from . import native_io
+from . import feed
 from . import profiler
 from . import libinfo
 from . import misc

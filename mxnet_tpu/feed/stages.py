@@ -1,0 +1,284 @@
+"""Concrete feed-pipeline stages.
+
+    SourceStage        records/batches out of an iterable or DataIter
+    MapStage           N parallel workers, ORDER-PRESERVING (decode/augment)
+    BatchStage         item accumulation into padded fixed-size batches
+    StagingStage       copy into a reusable contiguous host ring (staging
+                       buffers whose addresses are stable for H2D DMA —
+                       the pinned-memory analogue; see staging.py)
+    DevicePutStage     async jax.device_put into an optional sharding
+
+All of them ride the Stage/BoundedQueue machinery in pipeline.py: bounded
+output queues give backpressure, epoch-end sentinels flow in-band, worker
+exceptions are forwarded to the consumer.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from .pipeline import (BoundedQueue, EndOfEpoch, EndOfStream, QueueClosed,
+                       Stage, StageError)
+
+__all__ = ["SourceStage", "MapStage", "BatchStage", "StagingStage",
+           "DevicePutStage"]
+
+
+class SourceStage(Stage):
+    """Head of the pipeline: drains an iterable (or DataIter-protocol
+    object with reset()/next()) and emits its items, then an
+    :class:`EndOfEpoch` sentinel, then starts the next epoch — the next
+    epoch's decode work overlaps the consumer's epoch boundary (eval,
+    checkpointing).  ``max_epochs=None`` loops until the pipeline closes;
+    backpressure keeps it from running more than a queue ahead."""
+
+    def __init__(self, source, max_epochs: Optional[int] = None,
+                 name: str = "source"):
+        super().__init__(name)
+        self._source = source
+        self._max_epochs = max_epochs
+
+    def _epoch_items(self, epoch: int) -> Iterable[Any]:
+        src = self._source
+        if callable(src) and not hasattr(src, "next"):
+            return src()                       # factory: fresh per epoch
+        if hasattr(src, "reset") and hasattr(src, "next"):
+            if epoch > 0:
+                src.reset()
+            return iter(src)                   # DataIter protocol
+        if epoch > 0:
+            raise RuntimeError(
+                "source %r is a one-shot iterable; pass a factory or a "
+                "resettable DataIter for multi-epoch feeding" % (src,))
+        return iter(src)
+
+    def run(self):
+        epoch = 0
+        while self._max_epochs is None or epoch < self._max_epochs:
+            it = iter(self._epoch_items(epoch))
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                self.stats.add_items(1, time.perf_counter() - t0)
+                self.out_q.put(item)
+            self.out_q.put(EndOfEpoch(epoch))
+            epoch += 1
+        self.out_q.put(EndOfStream())
+
+
+class MapStage(Stage):
+    """Order-preserving parallel map (the decode/augment workers).
+
+    A dispatcher thread pulls items and submits them to a worker pool;
+    futures enter a bounded ticket queue IN SUBMISSION ORDER and an
+    emitter thread resolves them in that order into the output queue — so
+    N workers overlap the work, batches still arrive in sequence (the
+    same reorder discipline as the native loader's sequence window), and
+    the ticket queue bounds how far workers run ahead (backpressure).
+    """
+
+    def __init__(self, fn: Callable[[Any], Any], workers: int = 4,
+                 name: str = "map"):
+        super().__init__(name)
+        assert workers >= 1
+        self._fn = fn
+        self._workers = workers
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._tickets: Optional[BoundedQueue] = None
+        self._stopped = False
+
+    def start(self):
+        self._pool = ThreadPoolExecutor(
+            self._workers, thread_name_prefix="feed-%s-w" % self.name)
+        self._tickets = BoundedQueue(self._workers * 2)
+        t = threading.Thread(target=self._emit_loop,
+                             name="feed-%s-emit" % self.name, daemon=True)
+        self._threads.append(t)
+        t.start()
+        super().start()        # dispatcher runs the base run() loop
+
+    def _timed_fn(self, item):
+        t0 = time.perf_counter()
+        out = self._fn(item)
+        return out, time.perf_counter() - t0
+
+    def run(self):             # dispatcher
+        while True:
+            item = self.in_q.get()
+            if isinstance(item, (EndOfEpoch, EndOfStream, StageError)):
+                self._tickets.put(item)
+                continue
+            self._tickets.put(self._pool.submit(self._timed_fn, item))
+
+    def _emit_loop(self):
+        try:
+            while True:
+                ticket = self._tickets.get()
+                if isinstance(ticket, (EndOfEpoch, EndOfStream, StageError)):
+                    self.out_q.put(ticket)
+                    continue
+                try:
+                    out, busy = ticket.result()
+                except BaseException as exc:    # noqa: BLE001 — in-band
+                    self._emit_error(exc)
+                    return
+                self.stats.add_items(1, busy)
+                self.out_q.put(out)
+        except QueueClosed:
+            pass
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._tickets is not None:
+            self._tickets.close()
+        if self._pool is not None:
+            try:
+                self._pool.shutdown(wait=False, cancel_futures=True)
+            except TypeError:                   # pre-3.9 signature
+                self._pool.shutdown(wait=False)
+
+
+class BatchStage(Stage):
+    """Assemble items into fixed-size batches.
+
+    Items are tuples of numpy-stackable fields, e.g. ``(img_chw, label)``.
+    Output is ``(stacked_field_0, ..., stacked_field_n, pad)`` where the
+    final partial batch of an epoch wraps around to the epoch's first
+    items and reports the wrapped row count as ``pad`` (the reference
+    round_batch/pad contract).  ``partial="drop"`` discards it instead.
+    """
+
+    def __init__(self, batch_size: int, partial: str = "pad",
+                 name: str = "batch"):
+        super().__init__(name)
+        assert partial in ("pad", "drop")
+        self.batch_size = batch_size
+        self.partial = partial
+        self._acc: list = []
+        self._epoch_head: list = []   # first batch_size items, for padding
+
+    def process(self, item):
+        self._acc.append(item)
+        if len(self._epoch_head) < self.batch_size:
+            self._epoch_head.append(item)
+        if len(self._acc) == self.batch_size:
+            out = self._collate(self._acc, pad=0)
+            self._acc = []
+            return out
+        return None
+
+    def flush(self):
+        acc, self._acc = self._acc, []
+        head, self._epoch_head = self._epoch_head, []
+        if not acc:
+            return
+        pad = self.batch_size - len(acc)
+        if self.partial == "drop":
+            return
+        fill = (head or acc)
+        i = 0
+        while len(acc) < self.batch_size:
+            acc.append(fill[i % len(fill)])
+            i += 1
+        self.out_q.put(self._collate(acc, pad=pad))
+        self.stats.add_items(self.batch_size)
+
+    def _collate(self, items, pad: int):
+        if isinstance(items[0], (tuple, list)):
+            fields = tuple(np.stack([np.asarray(it[f]) for it in items])
+                           for f in range(len(items[0])))
+            return fields + (pad,)
+        return (np.stack([np.asarray(it) for it in items]), pad)
+
+    def count(self, out):
+        return self.batch_size
+
+
+def _map_arrays(obj, fn):
+    """Apply fn to every ndarray-like leaf of a batch tuple/list, passing
+    scalars (e.g. the trailing pad int) through untouched."""
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(_map_arrays(o, fn) for o in obj)
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        return fn(obj)
+    return obj
+
+
+class StagingStage(Stage):
+    """Copy each batch into a reusable ring of contiguous host buffers.
+
+    The ring gives every in-flight batch a stable, aligned, contiguous
+    address for the H2D DMA to read from — the commodity-host analogue of
+    CUDA pinned staging (on a TPU host, PJRT's transfer manager does the
+    page-lock dance; what it needs from us is a buffer that is not
+    recycled or moved until the async transfer completes).  ``ring_size``
+    must exceed the downstream queue depth plus in-flight consumers, or a
+    slot would be overwritten while still referenced.
+    """
+
+    def __init__(self, ring_size: int = 8, name: str = "staging"):
+        super().__init__(name)
+        self._ring_size = ring_size
+        self._ring: list = []
+        self._slot = 0
+
+    def process(self, batch):
+        if not self._ring:
+            self._ring = [
+                _map_arrays(batch, lambda a: np.empty(a.shape, a.dtype))
+                for _ in range(self._ring_size)]
+        slot = self._ring[self._slot]
+        self._slot = (self._slot + 1) % self._ring_size
+
+        def pair_copy(dst, src):
+            if isinstance(src, (tuple, list)):
+                return type(src)(pair_copy(d, s) for d, s in zip(dst, src))
+            if hasattr(src, "shape") and hasattr(src, "dtype"):
+                if dst.shape != src.shape or dst.dtype != src.dtype:
+                    return np.ascontiguousarray(src)   # shape drift: copy
+                np.copyto(dst, src)
+                return dst
+            return src
+        return pair_copy(slot, batch)
+
+    def count(self, out):
+        lead = out[0] if isinstance(out, (tuple, list)) else out
+        return int(lead.shape[0]) if hasattr(lead, "shape") and \
+            getattr(lead, "ndim", 0) >= 1 else 1
+
+
+class DevicePutStage(Stage):
+    """Issue the async H2D transfer (jax.device_put) for every array in
+    the batch.  device_put returns immediately; by the time the consumer
+    touches the arrays the DMA has had a full pipeline stage of time to
+    complete — double-buffering the transfer under the previous step.  An
+    optional ``sharding`` lands the batch directly in the layout the
+    fused train step consumes (its make_batch then passes the arrays
+    through untouched)."""
+
+    def __init__(self, sharding=None, name: str = "h2d"):
+        super().__init__(name)
+        self._sharding = sharding
+
+    def process(self, batch):
+        import jax
+        sh = self._sharding() if callable(self._sharding) else self._sharding
+
+        def put(a):
+            return jax.device_put(a, sh) if sh is not None \
+                else jax.device_put(a)
+        return _map_arrays(batch, put)
+
+    def count(self, out):
+        lead = out[0] if isinstance(out, (tuple, list)) else out
+        return int(lead.shape[0]) if hasattr(lead, "shape") and \
+            getattr(lead, "ndim", 0) >= 1 else 1

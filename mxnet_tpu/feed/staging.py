@@ -1,0 +1,136 @@
+"""Host->device staging: double-buffer the next batch's H2D transfer
+under the current train step.
+
+:class:`DevicePrefetchIter` wraps any DataIter and keeps ``depth``
+batches in flight: each ``next()`` first tops the window up by pulling
+host batches and issuing ``jax.device_put`` for them (async — the call
+returns before the DMA completes), then hands out the OLDEST in-flight
+batch, whose transfer has had a full step's worth of time to finish.
+When the wrapped module runs the fused train step, batches are staged
+directly into its batch sharding, so ``FusedTrainStep.make_batch``
+recognizes the resident arrays and passes them through without a second
+transfer (donation-friendly: the program reads the input buffers in the
+layout it compiled for).  On CPU backends ``device_put`` is a cheap copy
+and the wrapper degrades to plain lookahead overlap.
+
+``Module.fit(..., prefetch_to_device=True)`` wires this in automatically
+(base_module.py); :func:`device_feed` is the manual entry point.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+from .stats import PipelineStats
+
+__all__ = ["DevicePrefetchIter", "device_feed"]
+
+
+class DevicePrefetchIter:
+    """DataIter wrapper: async-stage ``depth`` batches ahead on device.
+
+    Instrumented like a pipeline stage: the ``h2d`` stats row counts
+    staged images and the time spent issuing transfers; ``stall_in``
+    accumulates time blocked waiting on the wrapped (host) iterator —
+    i.e. how long the chip-side consumer was starved by the host
+    pipeline.
+    """
+
+    def __init__(self, data_iter, sharding=None, module=None, depth: int = 2,
+                 name: str = "device_feed"):
+        assert depth >= 1
+        self._iter = data_iter
+        self._module = module
+        self._sharding = sharding
+        self._depth = depth
+        self._pending = deque()
+        self._exhausted = False
+        self.stats = PipelineStats(name).register()
+        self._h2d = self.stats.stage("h2d")
+        self.batch_size = getattr(data_iter, "batch_size", 0)
+
+    # -- DataIter surface -------------------------------------------------
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def reset(self):
+        self._pending.clear()
+        self._exhausted = False
+        self._iter.reset()
+
+    def next(self):
+        self._fill()
+        if not self._pending:
+            raise StopIteration
+        return self._pending.popleft()
+
+    def iter_next(self):
+        self._fill()
+        return bool(self._pending)
+
+    # -- staging ----------------------------------------------------------
+    def _resolve_sharding(self):
+        if self._sharding is not None:
+            return self._sharding
+        if self._module is not None:
+            fused = getattr(self._module, "_fused", None)
+            if fused is not None:
+                return fused.batched_sharding()
+        return None
+
+    def _fill(self):
+        while not self._exhausted and len(self._pending) < self._depth:
+            t0 = time.perf_counter()
+            try:
+                batch = self._iter.next()
+            except StopIteration:
+                self._exhausted = True
+                return
+            self._h2d.add_stall_in(time.perf_counter() - t0)
+            self._pending.append(self._stage(batch))
+
+    def _stage(self, batch):
+        import jax
+        from ..io import DataBatch
+        from ..ndarray import NDArray
+        sh = self._resolve_sharding()
+        t0 = time.perf_counter()
+
+        def put(arr):
+            a = arr._get() if isinstance(arr, NDArray) else arr
+            if sh is not None:
+                if getattr(a, "sharding", None) == sh:
+                    return arr if isinstance(arr, NDArray) else NDArray(a)
+                return NDArray(jax.device_put(a, sh))
+            return NDArray(jax.device_put(a))
+        data = [put(a) for a in (batch.data or [])]
+        label = [put(a) for a in (batch.label or [])]
+        n = data[0].shape[0] if data else 0
+        self._h2d.add_items(int(n), time.perf_counter() - t0)
+        return DataBatch(data=data, label=label, pad=batch.pad,
+                         index=batch.index,
+                         provide_data=getattr(batch, "provide_data", None),
+                         provide_label=getattr(batch, "provide_label", None))
+
+
+def device_feed(data_iter, module=None, sharding=None, depth: int = 2):
+    """Wrap ``data_iter`` so batches arrive pre-staged on device.
+
+    ``module``: resolve the sharding lazily from the module's fused train
+    step (call AFTER init_optimizer); ``sharding``: explicit NamedSharding
+    override; neither: stage to the default device (still overlaps the
+    transfer — the CPU/plain path)."""
+    return DevicePrefetchIter(data_iter, sharding=sharding, module=module,
+                              depth=depth)

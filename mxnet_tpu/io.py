@@ -82,6 +82,53 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError()
 
+    def feed(self, depth=2, module=None, sharding=None):
+        """Wrap this iterator with the staged device prefetcher
+        (mxnet_tpu.feed.device_feed): every DataIter — including the
+        RecordIO image iterators — becomes a feed-pipeline source whose
+        next batch's H2D transfer is issued under the current step."""
+        from . import feed as _feed
+        return _feed.device_feed(self, module=module, sharding=sharding,
+                                 depth=depth)
+
+
+def resize_shorter_edge(pil_img, resize):
+    """Scale a PIL image so its shorter edge equals ``resize`` (aspect
+    preserved) — shared by ImageRecordIter's augmenter and the
+    mxnet_tpu.feed decode workers."""
+    from PIL import Image
+    w0, h0 = pil_img.size
+    if w0 < h0:
+        return pil_img.resize((resize, max(1, int(h0 * resize / w0))),
+                              Image.BILINEAR)
+    return pil_img.resize((max(1, int(w0 * resize / h0)), resize),
+                          Image.BILINEAR)
+
+
+def crop_mirror_normalize(img, data_shape, rand_crop=False,
+                          rand_mirror=False, mean=None, scale=1.0):
+    """Shared augment tail over a CHW float image — min-size check,
+    random/center crop to ``data_shape``, horizontal mirror, mean
+    subtract, scale.  Both decode paths (python ImageRecordIter and the
+    mxnet_tpu.feed decode workers) end here so a crop/mirror fix lands
+    in one place."""
+    _, h, w = data_shape
+    _, ih, iw = img.shape
+    if ih < h or iw < w:
+        raise MXNetError("image %s smaller than data_shape %s"
+                         % (img.shape, tuple(data_shape)))
+    if rand_crop:
+        dy = np.random.randint(0, ih - h + 1)
+        dx = np.random.randint(0, iw - w + 1)
+    else:
+        dy, dx = (ih - h) // 2, (iw - w) // 2
+    img = img[:, dy:dy + h, dx:dx + w]
+    if rand_mirror and np.random.rand() < 0.5:
+        img = img[:, :, ::-1]
+    if mean is not None:
+        img = img - mean
+    return img * scale
+
 
 def _init_data(data, allow_empty, default_name):
     """Normalize input to list of (name, numpy) (reference io.py:219)."""
@@ -494,6 +541,12 @@ def _native_io_delegable(kwargs) -> bool:
                    "random_l", "pad")
     if any(kwargs.get(k) for k in unsupported):
         return False
+    # round_batch=False asks for discard-last-partial semantics; the
+    # native loader always pads the final batch — stay on the PIL path
+    # rather than silently delivering a padded batch the caller said not
+    # to want
+    if not kwargs.get("round_batch", True):
+        return False
     path = kwargs.get("path_imgrec")
     shape = kwargs.get("data_shape")
     if not path or not shape:
@@ -509,7 +562,12 @@ def _native_io_delegable(kwargs) -> bool:
             return False
         _, payload = _recordio.unpack(s)
         if payload[:3] == b"\xff\xd8\xff":     # JPEG
-            return True
+            # the native JPEG path decodes to 3-channel RGB and strides
+            # by shape[0]; a grayscale (or other) channel count would
+            # corrupt pixels, so only 3-channel shapes delegate
+            # (data_loader.cc fails loud as defense in depth).  Raw-CHW
+            # payloads below handle any channel count natively.
+            return shape[0] == 3
         want = int(np.prod(shape))
         # raw-CHW: exact size, or the 2x-uint16 (src_h, src_w) prefix form
         return len(payload) == want or (
@@ -550,8 +608,16 @@ class ImageRecordIter(DataIter):
             if _native_io_delegable(merged):
                 try:
                     return NativeImageRecordIter(**merged)
-                except Exception:
-                    pass  # unreadable via native core: PIL path decides
+                except Exception as e:
+                    # unreadable via native core: PIL path decides — but
+                    # never silently; a swallowed construction failure
+                    # once hid a broken native build behind a 10x-slower
+                    # fallback
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "native ImageRecordIter construction failed "
+                        "(%s: %s); falling back to the PIL path",
+                        type(e).__name__, e)
         return super().__new__(cls)
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
@@ -571,6 +637,10 @@ class ImageRecordIter(DataIter):
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.scale = scale
+        # round_batch=False: discard-last-partial (NDArrayIter's
+        # last_batch_handle="discard"); True: wrap into the epoch head
+        # and report the wrapped rows via pad
+        self.round_batch = bool(round_batch)
         # reference default augmenter knobs (src/io/image_aug_default.cc):
         # resize shorter edge, random rotation, contrast/illumination
         # jitter, HSL channel shifts
@@ -660,15 +730,7 @@ class ImageRecordIter(DataIter):
         channel jitter."""
         from PIL import Image
         if self.resize:
-            w0, h0 = pil_img.size
-            if w0 < h0:
-                pil_img = pil_img.resize(
-                    (self.resize, int(h0 * self.resize / w0)),
-                    Image.BILINEAR)
-            else:
-                pil_img = pil_img.resize(
-                    (int(w0 * self.resize / h0), self.resize),
-                    Image.BILINEAR)
+            pil_img = resize_shorter_edge(pil_img, self.resize)
         if self.max_rotate_angle:
             angle = np.random.uniform(-self.max_rotate_angle,
                                       self.max_rotate_angle)
@@ -712,25 +774,15 @@ class ImageRecordIter(DataIter):
         if self.pad_pixels:
             p = self.pad_pixels
             img = np.pad(img, ((0, 0), (p, p), (p, p)))
-        c, h, w = self.data_shape
-        _, ih, iw = img.shape
-        if ih < h or iw < w:
-            raise MXNetError("image %s smaller than data_shape %s"
-                             % (img.shape, self.data_shape))
-        if self.rand_crop:
-            dy = np.random.randint(0, ih - h + 1)
-            dx = np.random.randint(0, iw - w + 1)
-        else:
-            dy, dx = (ih - h) // 2, (iw - w) // 2
-        img = img[:, dy:dy + h, dx:dx + w]
-        if self.rand_mirror and np.random.rand() < 0.5:
-            img = img[:, :, ::-1]
-        if self.mean is not None:
-            img = img - self.mean
-        return img * self.scale
+        return crop_mirror_normalize(img, self.data_shape,
+                                     rand_crop=self.rand_crop,
+                                     rand_mirror=self.rand_mirror,
+                                     mean=self.mean, scale=self.scale)
 
     def iter_next(self):
         self.cursor += self.batch_size
+        if not self.round_batch:
+            return self.cursor + self.batch_size <= len(self._index)
         return self.cursor < len(self._index)
 
     def _fetch_decode(self, i: int):

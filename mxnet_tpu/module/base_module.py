@@ -112,8 +112,13 @@ class BaseModule:
             arg_params=None, aux_params=None, allow_missing=False,
             force_rebind=False, force_init=False, begin_epoch=0,
             num_epoch=None, validation_metric=None, monitor=None,
-            work_load_list=None):
-        """Train (reference base_module.py:273-393)."""
+            work_load_list=None, prefetch_to_device=False):
+        """Train (reference base_module.py:273-393).
+
+        ``prefetch_to_device``: wrap ``train_data`` with the feed
+        subsystem's device prefetcher (mxnet_tpu.feed) so batch N+1's
+        H2D transfer is issued while batch N trains; pass an int to set
+        the lookahead depth (True = 2)."""
         assert num_epoch is not None, "please specify number of epochs"
         if optimizer_params is None:
             optimizer_params = (("learning_rate", 0.01),)
@@ -128,6 +133,13 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+
+        if prefetch_to_device and hasattr(self, "prefetch_to_device"):
+            # wrap AFTER init_optimizer so the fused step's batch sharding
+            # exists and staged batches land directly in its input layout
+            depth = 2 if prefetch_to_device is True \
+                else max(1, int(prefetch_to_device))
+            train_data = self.prefetch_to_device(train_data, depth=depth)
 
         if validation_metric is None:
             validation_metric = eval_metric

@@ -152,6 +152,14 @@ class FusedTrainStep:
     def _batched(self):
         return NamedSharding(self.mesh, P("dp"))
 
+    def batched_sharding(self):
+        """Public handle for input pipelines (feed.device_feed /
+        feed.DevicePutStage): batches staged with this sharding are
+        recognized by make_batch and passed through without a second
+        transfer — the H2D lands once, async, in the exact layout the
+        donated step program compiled for."""
+        return self._batched()
+
     def _multiprocess(self):
         return self.global_dp and jax.process_count() > 1
 

@@ -569,6 +569,17 @@ class Module(BaseModule):
             outs, self._fused_pending)
         self._fused_next = (new_state, self._fused_outputs)
 
+    def prefetch_to_device(self, data_iter, depth=2):
+        """Wrap ``data_iter`` so each batch's H2D transfer is issued
+        ``depth`` steps ahead of consumption (mxnet_tpu.feed staging).
+        With the fused train step engaged, batches land directly in its
+        batch sharding and make_batch passes them through untouched; on
+        the classic (or CPU) path this degrades to plain lookahead
+        overlap.  Call after init_optimizer; fit(prefetch_to_device=True)
+        does this automatically."""
+        from .. import feed as _feed
+        return _feed.device_feed(data_iter, module=self, depth=depth)
+
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
         self._disable_fused("optimizer borrowed")

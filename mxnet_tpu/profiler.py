@@ -20,9 +20,11 @@ from __future__ import annotations
 
 import contextlib
 import os
+import weakref
 
 __all__ = ["profiler_set_config", "profiler_set_state", "scope",
-           "dump_profile", "state"]
+           "dump_profile", "state", "register_feed_stats", "feed_report",
+           "feed_report_str"]
 
 _config = {"filename": "profile_output", "mode": "symbolic"}
 _state = "stop"
@@ -61,6 +63,35 @@ def dump_profile() -> str:
     """Return the trace directory (reference MXDumpProfile wrote the json;
     XLA traces stream to disk while running)."""
     return _config["filename"]
+
+
+# -- feed-pipeline instrumentation (mxnet_tpu.feed) -------------------------
+# Live pipelines register their PipelineStats here (weakly: a dropped
+# pipeline disappears from reports without an unregister call), so one
+# feed_report() shows every stage of every running input pipeline —
+# items/sec, busy time, producer/consumer stall time, queue depth — and
+# therefore exactly which stage starves the chip.
+_feed_stats = weakref.WeakValueDictionary()
+_feed_seq = 0
+
+
+def register_feed_stats(pipeline_stats) -> None:
+    """Called by feed.Pipeline / feed.DevicePrefetchIter on construction."""
+    global _feed_seq
+    _feed_seq += 1
+    # zero-padded seq so lexicographic report order == creation order
+    _feed_stats["%s#%06d" % (pipeline_stats.name, _feed_seq)] = pipeline_stats
+
+
+def feed_report() -> dict:
+    """{pipeline key: {stage name: counters}} for every live pipeline."""
+    return {key: ps.report() for key, ps in sorted(_feed_stats.items())}
+
+
+def feed_report_str() -> str:
+    """Human-readable per-stage table for every live feed pipeline."""
+    parts = [ps.report_str() for _, ps in sorted(_feed_stats.items())]
+    return "\n\n".join(parts) if parts else "(no live feed pipelines)"
 
 
 @contextlib.contextmanager

@@ -20,6 +20,11 @@ class MXDataIter private[mxnet_tpu](
 
   private var currentData: NDArray = _
   private var currentLabel: NDArray = _
+  // the batch before current: still borrowable (a hasNext() probe
+  // fetches the NEXT batch while the caller may not have read the
+  // previous one yet), freed on the fetch after that
+  private var retiredData: NDArray = _
+  private var retiredLabel: NDArray = _
   private var hasNextBatch: Boolean = true
   private var probed = false
   private var shapesKnown = false
@@ -32,6 +37,17 @@ class MXDataIter private[mxnet_tpu](
     checkCall(_LIB.mxDataIterNext(handle, out))
     hasNextBatch = out(0) == 1
     if (hasNextBatch) {
+      // lent handles die ONE FETCH LATE: the previous batch is retired
+      // (still valid — a hasNext() probe runs this before the caller
+      // reads it) and the pair retired before it is freed.  Without the
+      // dispose every fetch leaked two bridge NDArray handles for the
+      // life of the iterator; disposing immediately would free handles
+      // the borrow window still covers.
+      disposeRetired()
+      retiredData = currentData
+      retiredLabel = currentLabel
+      currentData = null
+      currentLabel = null
       val h = new Array[Long](1)
       checkCall(_LIB.mxDataIterGetData(handle, h))
       currentData = new NDArray(h(0), writable = false)
@@ -93,7 +109,30 @@ class MXDataIter private[mxnet_tpu](
     DataBatch(IndexedSeq(currentData), IndexedSeq(currentLabel), pad(0))
   }
 
-  def dispose(): Unit = checkCall(_LIB.mxDataIterFree(handle))
+  private def disposeRetired(): Unit = {
+    if (retiredData != null) {
+      retiredData.dispose()
+      retiredData = null
+    }
+    if (retiredLabel != null) {
+      retiredLabel.dispose()
+      retiredLabel = null
+    }
+  }
+
+  def dispose(): Unit = {
+    // free both outstanding lent pairs, not just the iterator
+    disposeRetired()
+    if (currentData != null) {
+      currentData.dispose()
+      currentData = null
+    }
+    if (currentLabel != null) {
+      currentLabel.dispose()
+      currentLabel = null
+    }
+    checkCall(_LIB.mxDataIterFree(handle))
+  }
 }
 
 /** Native iterator registry (reference IO.scala's iterCreateFuncs). */

@@ -53,7 +53,16 @@ class PrefetchingIter(iters: IndexedSeq[DataIter],
             }
           }
         } finally {
-          myQueue.offer(None, 50, TimeUnit.MILLISECONDS)
+          // the epoch-end sentinel must NEVER be dropped: a single timed
+          // offer against a full queue silently lost it and the consumer
+          // then blocked in take() forever.  Loop like the batch path.
+          // `stopping` is the only exit without a placed sentinel — it is
+          // set solely by reset(), which discards this queue, so the
+          // thread can't spin forever on an abandoned iterator either.
+          var placed = false
+          while (!placed && !stopping) {
+            placed = myQueue.offer(None, 50, TimeUnit.MILLISECONDS)
+          }
         }
       }
     })
@@ -76,21 +85,30 @@ class PrefetchingIter(iters: IndexedSeq[DataIter],
     b
   }
 
-  /** Safe mid-epoch: stops the producer FULLY (it may be blocked on a
-   * full queue) before the wrapped iterators are reset, so no stale
-   * thread ever races them or feeds the next epoch's queue. */
-  def reset(): Unit = {
+  /** Stop the producer FULLY (it may be blocked on a full queue) and
+   * drop queued batches.  Call when abandoning the iterator mid-epoch
+   * (e.g. fixed-step training that exits early) so the producer thread
+   * and the deep-copied batches it pinned are released; reset() calls
+   * this too before starting the next epoch. */
+  def dispose(): Unit = {
     if (started) {
       stopping = true
       while (producer.isAlive) {
         queue.poll(10, TimeUnit.MILLISECONDS)  // unblock pending offers
         producer.join(10)
       }
-      stopping = false
+      started = false
     }
-    iters.foreach(_.reset())
     pending = null
+  }
+
+  /** Safe mid-epoch: the producer is stopped before the wrapped
+   * iterators are reset, so no stale thread ever races them or feeds
+   * the next epoch's queue. */
+  def reset(): Unit = {
+    dispose()
+    stopping = false
+    iters.foreach(_.reset())
     queue = new ArrayBlockingQueue[Option[DataBatch]](capacity)
-    started = false
   }
 }

@@ -195,6 +195,19 @@ class BatchLoader {
       label_out[l] = l < static_cast<int>(r.labels.size()) ? r.labels[l] : 0.f;
 
     if (IsJPEG(r.payload, r.payload_size)) {
+      // DecodeJPEG emits 3-channel RGB; EmitHWC strides by c_.  With
+      // c_ != 3 (grayscale data_shape) the stride silently walked RGB
+      // bytes across x positions — corrupt images with real labels.
+      // Fail loud; the python side gates delegation on shape[0] == 3.
+      if (c_ != 3) {
+        char msg[160];
+        snprintf(msg, sizeof(msg),
+                 "JPEG records decode to 3 channels but data_shape has "
+                 "%d; use a 3-channel data_shape (record %zu)",
+                 c_, order_[rec_idx % order_.size()]);
+        Fail(msg);
+        return;
+      }
       // reference path: per-thread JPEG decode
       // (iter_image_recordio.cc:139-291 + image_aug_default.cc resize)
       int ih = 0, iw = 0;

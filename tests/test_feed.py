@@ -1,0 +1,366 @@
+"""mxnet_tpu.feed: staged prefetch-to-device input pipeline.
+
+Covers the subsystem's contracts: stage composition and ordering,
+bounded-queue backpressure, the in-band epoch-end sentinel under a
+consumer slower than the producer, worker-exception propagation,
+shutdown without dangling threads, stats-counter correctness, and the
+Module.fit prefetch-to-device integration.  All CPU-only.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import feed
+from mxnet_tpu.feed.pipeline import BoundedQueue, QueueClosed
+
+
+def _ints(n):
+    return lambda: iter(range(n))
+
+
+def _close(p):
+    p.close()
+    assert p.alive_threads() == []
+
+
+# -- composition -------------------------------------------------------------
+
+def test_stage_composition_ordered():
+    """source -> parallel map -> batch keeps sequence order (the decode
+    workers overlap but the reorder discipline preserves the stream)."""
+    p = feed.Pipeline([
+        feed.SourceStage(_ints(23), max_epochs=1),
+        feed.MapStage(lambda x: (np.full((2,), x, np.float32),
+                                 np.float32(x)), workers=4, name="decode"),
+        feed.BatchStage(5)], buffer_size=2, name="compose")
+    batches = list(p)
+    assert len(batches) == 5
+    vals = np.concatenate([b[0][:, 0] for b in batches])
+    # 23 items -> 4 full batches + final batch padded by wrapping to the
+    # epoch head, pad=2
+    assert vals[:23].tolist() == [float(i) for i in range(23)]
+    assert [b[2] for b in batches] == [0, 0, 0, 0, 2]
+    assert batches[-1][0][:, 0].tolist() == [20.0, 21.0, 22.0, 0.0, 1.0]
+    # labels rode along with their images through the parallel stage
+    for b in batches:
+        assert np.array_equal(b[0][:, 0], b[1])
+    _close(p)
+
+
+def test_batch_stage_drop_partial():
+    p = feed.Pipeline([
+        feed.SourceStage(_ints(13), max_epochs=1),
+        feed.BatchStage(5, partial="drop")], name="drop")
+    batches = list(p)
+    assert len(batches) == 2 and all(b[-1] == 0 for b in batches)
+    _close(p)
+
+
+def test_multi_epoch_items_exact():
+    """Every epoch delivers exactly its items: the sentinel is in-band
+    and can never be dropped or duplicated."""
+    p = feed.Pipeline([
+        feed.SourceStage(_ints(7), max_epochs=3),
+        feed.MapStage(lambda x: x * 10, workers=2)], buffer_size=2,
+        name="epochs")
+    for _ in range(3):
+        got = list(p)
+        assert got == [i * 10 for i in range(7)]
+    assert list(p) == []          # EndOfStream: exhausted forever
+    assert list(p) == []
+    _close(p)
+
+
+# -- backpressure and the sentinel under a slow consumer ---------------------
+
+def test_bounded_queue_backpressure():
+    """A fast producer must BLOCK on the bounded queue (never buffer
+    unboundedly) and the blocked time must land in its stall_out
+    counter."""
+    depths = []
+    p = feed.Pipeline([feed.SourceStage(_ints(50), max_epochs=1)],
+                      buffer_size=3, name="bp")
+    q = p._queues[-1]
+    time.sleep(0.15)                    # producer runs ahead... to cap
+    for _ in range(50):
+        depths.append(q.depth())
+        p.get()
+        time.sleep(0.002)
+    assert max(depths) <= 3
+    snap = p.stats.report()["source"]
+    assert snap["stall_out_s"] > 0.05   # spent the sleep blocked, not buffering
+    assert snap["items"] == 50
+    _close(p)
+
+
+def test_epoch_sentinel_survives_slow_consumer():
+    """Consumer slower than the producer, capacity-1 queues: the epoch
+    boundary arrives exactly after every item, three epochs in a row (the
+    PrefetchingIter.scala single-offer bug class: a full queue must delay
+    the sentinel, never drop it)."""
+    p = feed.Pipeline([
+        feed.SourceStage(_ints(6), max_epochs=3),
+        feed.MapStage(lambda x: x, workers=2, name="m")],
+        buffer_size=1, name="slow")
+    for epoch in range(3):
+        seen = []
+        for item in p:
+            time.sleep(0.02)            # slower than production
+            seen.append(item)
+        assert seen == list(range(6)), "epoch %d" % epoch
+    _close(p)
+
+
+def test_bounded_queue_close_drains_then_raises():
+    q = BoundedQueue(4)
+    q.put(1)
+    q.put(2)
+    q.close()
+    assert q.get() == 1 and q.get() == 2
+    with pytest.raises(QueueClosed):
+        q.get()
+    with pytest.raises(QueueClosed):
+        q.put(3)
+
+
+# -- error propagation -------------------------------------------------------
+
+def test_worker_exception_propagates():
+    """A decode-worker exception must surface at the consumer as the
+    original exception — never a hang, never silent truncation."""
+    def decode(x):
+        if x == 5:
+            raise ValueError("bad record 5")
+        return x
+
+    p = feed.Pipeline([
+        feed.SourceStage(_ints(20), max_epochs=1),
+        feed.MapStage(decode, workers=3, name="decode")],
+        buffer_size=2, name="err")
+    got = []
+    with pytest.raises(ValueError, match="bad record 5"):
+        for item in p:
+            got.append(item)
+    assert got == [0, 1, 2, 3, 4]       # ordered delivery up to the fault
+    # the failure tore the pipeline down: no threads left behind
+    deadline = time.time() + 5
+    while p.alive_threads() and time.time() < deadline:
+        time.sleep(0.02)
+    assert p.alive_threads() == []
+
+
+def test_source_exception_propagates():
+    def boom():
+        yield 1
+        raise RuntimeError("source died")
+
+    p = feed.Pipeline([feed.SourceStage(boom, max_epochs=1)], name="srcerr")
+    assert p.get() == 1
+    with pytest.raises(RuntimeError, match="source died"):
+        while True:
+            p.get()
+    _close(p)
+
+
+# -- shutdown ----------------------------------------------------------------
+
+def test_shutdown_no_dangling_threads():
+    """close() mid-epoch with full queues and blocked producers must join
+    every stage thread (and retire the map stage's pool workers)."""
+    before = {t.name for t in threading.enumerate()}
+    p = feed.Pipeline([
+        feed.SourceStage(_ints(10_000)),        # unbounded epochs
+        feed.MapStage(lambda x: x, workers=3, name="m"),
+        feed.BatchStage(4)], buffer_size=2, name="shut")
+    for _ in range(3):
+        p.get()                                  # mid-epoch
+    p.close()
+    assert p.alive_threads() == []
+    # pool workers observe the shutdown too (they hold no queue locks)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        leaked = {t.name for t in threading.enumerate()} - before
+        if not any(n.startswith("feed-") for n in leaked):
+            break
+        time.sleep(0.05)
+    assert not any(n.startswith("feed-") for n in leaked), leaked
+
+
+def test_context_manager_closes():
+    with feed.Pipeline([feed.SourceStage(_ints(100))], name="cm") as p:
+        assert p.get() == 0
+    assert p.alive_threads() == []
+    with pytest.raises(StopIteration):
+        p.get()
+
+
+# -- stats -------------------------------------------------------------------
+
+def test_stats_counters_exact():
+    p = feed.Pipeline([
+        feed.SourceStage(_ints(12), max_epochs=1),
+        feed.MapStage(lambda x: (np.zeros(1, np.float32), np.float32(x)),
+                      workers=2, name="decode"),
+        feed.BatchStage(4)], name="stats")
+    batches = list(p)
+    assert len(batches) == 3
+    rep = p.stats.report()
+    assert rep["source"]["items"] == 12
+    assert rep["decode"]["items"] == 12
+    assert rep["batch"]["items"] == 12          # 3 batches x 4
+    assert rep["consume"]["items"] == 3         # batches, consumer-side
+    for row in rep.values():
+        assert row["items_per_s"] >= 0 and row["wall_s"] > 0
+    # queue wiring: every producing stage reports its queue depth/capacity
+    assert rep["source"]["queue_capacity"] >= 1
+    # fully drained of data (the end-of-stream marker may still sit there)
+    assert rep["batch"]["queue_depth"] <= 1
+    _close(p)
+
+
+def test_profiler_feed_report_surfaces_pipelines():
+    from mxnet_tpu import profiler
+    p = feed.Pipeline([feed.SourceStage(_ints(5), max_epochs=1)],
+                      name="reportme")
+    list(p)
+    rep = profiler.feed_report()
+    keys = [k for k in rep if k.startswith("reportme#")]
+    assert keys, rep.keys()
+    assert "source" in rep[keys[0]]
+    assert "reportme" in profiler.feed_report_str()
+    assert p.stats.bottleneck() in ("source", "consume")
+    _close(p)
+    # dropped pipelines vanish from the report (weak registry)
+    del p
+    import gc
+    gc.collect()
+    assert not any(k.startswith("reportme#") for k in profiler.feed_report())
+
+
+# -- device staging / Module integration -------------------------------------
+
+def test_device_prefetch_iter_parity():
+    """The device prefetcher yields the same batches (values, pad, count)
+    as the wrapped iterator, across resets."""
+    X = np.arange(40, dtype=np.float32).reshape(40, 1)
+    y = np.arange(40, dtype=np.float32)
+    raw = list(mx.io.NDArrayIter(X, y, batch_size=12))
+    it = mx.io.NDArrayIter(X, y, batch_size=12).feed(depth=2)
+    staged = list(it)
+    assert len(staged) == len(raw)
+    for a, b in zip(staged, raw):
+        assert np.array_equal(a.data[0].asnumpy(), b.data[0].asnumpy())
+        assert np.array_equal(a.label[0].asnumpy(), b.label[0].asnumpy())
+        assert a.pad == b.pad
+    assert staged[-1].pad == raw[-1].pad == 8     # 40 rows / batch 12
+    it.reset()
+    assert len(list(it)) == len(raw)
+    # starvation accounting exists for the h2d stage
+    assert it.stats.report()["h2d"]["items"] == 2 * 4 * 12
+
+
+def test_fit_prefetch_to_device_trains():
+    """Module.fit(prefetch_to_device=True): batches are staged into the
+    fused step's batch sharding ahead of time, make_batch passes them
+    through, and training still learns."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(120, 6).astype(np.float32)
+    w = rng.rand(6, 3).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=24, shuffle=True)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=3), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=12, prefetch_to_device=True,
+            optimizer_params=(("learning_rate", 0.5),))
+    assert mod._fused is not None
+    # staged batches land in the fused batch sharding: no second transfer
+    it.reset()
+    staged = mod.prefetch_to_device(it, depth=1).next()
+    arr = staged.data[0]._get()
+    assert arr.sharding == mod._fused.batched_sharding()
+    preds = mod.predict(mx.io.NDArrayIter(X, y, batch_size=24)).asnumpy()
+    acc = (np.argmax(preds, 1) == y).mean()
+    assert acc > 0.8, acc
+
+
+def test_record_pipeline_end_to_end(tmp_path):
+    """The full staged pipeline (.rec source -> parallel decode -> batch
+    -> staging ring -> h2d) as a DataIter: exact epochs, ordered labels,
+    device-resident batches, clean close."""
+    pytest.importorskip("PIL")
+    import io as _io
+    from PIL import Image
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(22):
+        img = Image.fromarray(rng.randint(0, 255, (14, 14, 3),
+                                          dtype=np.uint8))
+        buf = _io.BytesIO()
+        img.save(buf, format="JPEG", quality=92)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 7), i, 0),
+                              buf.getvalue()))
+    w.close()
+    it = feed.record_pipeline(rec, batch_size=5, data_shape=(3, 12, 12),
+                              workers=3, rand_crop=True, scale=1 / 255.0,
+                              max_epochs=3)
+    for _ in range(2):
+        batches = list(it)
+        assert len(batches) == 5
+        assert batches[0].data[0].shape == (5, 3, 12, 12)
+        labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+        assert labels[:22].tolist() == [float(i % 7) for i in range(22)]
+        assert batches[-1].pad == 3
+        it.reset()
+    it.close()
+    assert it.pipeline.alive_threads() == []
+
+
+def test_feed_data_iter_reset_mid_epoch():
+    """FeedDataIter.reset() from the middle of an epoch drains to the
+    next epoch boundary instead of replaying or interleaving items."""
+    p2 = feed.Pipeline([
+        feed.SourceStage(_ints(9), max_epochs=4),
+        feed.MapStage(lambda x: (np.full((1,), x, np.float32),
+                                 np.float32(x)), workers=2),
+        feed.BatchStage(3)], name="midreset")
+    it = feed.FeedDataIter(p2, data_shape=(1,), batch_size=3)
+    it.next()                          # mid-epoch
+    it.reset()                         # drains the rest of epoch 0
+    vals = np.concatenate([b.data[0].asnumpy()[:, 0] for b in it])
+    assert vals.tolist() == [float(i) for i in range(9)]
+    # reset at a boundary is a no-op roll to the next epoch
+    it.reset()
+    vals = np.concatenate([b.data[0].asnumpy()[:, 0] for b in it])
+    assert vals.tolist() == [float(i) for i in range(9)]
+    it.close()
+
+
+@pytest.mark.skipif(not os.path.exists(
+    os.path.join(os.path.dirname(mx.__file__), "libmxtpu.so")),
+    reason="native lib not built")
+def test_bench_io_pipeline_leg(tmp_path):
+    """The combined loader -> Module.fit bench leg must produce the
+    io_pipeline_img_s / io_train_img_s / io_feed_headroom keys the
+    driver's BENCH json records (acceptance: an honest end-to-end feed
+    number)."""
+    pytest.importorskip("PIL")
+    import sys as _sys
+    root = os.path.dirname(os.path.dirname(mx.__file__))
+    if root not in _sys.path:
+        _sys.path.insert(0, root)
+    import bench_io
+    out = bench_io.run(batch=8, threads=1, seconds=0.3, pipeline=True)
+    assert out["io_pipeline_img_s"] > 0
+    assert out["io_train_img_s"] > 0
+    assert out["io_feed_headroom"] > 0
+    assert out["io_jpeg_img_s_1t"] > 0 and out["io_jpeg_img_s_mt"] > 0
+    assert out["io_threads_mt"] >= 2
+    assert out["io_jpeg_kb_mean"] > 40   # photo-entropy, not flat blocks

@@ -329,6 +329,26 @@ def test_image_record_iter_streams_lazily(tmp_path):
     assert labels2.tolist() == labels.tolist()
 
 
+def test_image_record_iter_round_batch_false_discards(tmp_path):
+    """round_batch=False is discard-last-partial (NDArrayIter's
+    "discard"): the native loader always pads, so construction stays on
+    the python path, which must actually stop before the partial batch
+    rather than wrap-pad it."""
+    frec = _write_jpeg_rec(tmp_path, n=10)      # batch 4: 2 full + 2 left
+    it = mx.io.ImageRecordIter(path_imgrec=frec, data_shape=(3, 32, 32),
+                               batch_size=4, round_batch=False)
+    assert type(it).__name__ == "ImageRecordIter"   # not delegated
+    batches = list(it)
+    assert len(batches) == 2 and all(b.pad == 0 for b in batches)
+    it.reset()
+    assert len(list(it)) == 2
+    # contrast: round_batch=True wraps and reports the wrapped rows
+    it = mx.io.ImageRecordIter(path_imgrec=frec, data_shape=(3, 32, 32),
+                               batch_size=4, round_batch=True)
+    batches = list(it)
+    assert len(batches) == 3 and batches[-1].pad == 2
+
+
 @pytest.mark.skipif(not os.path.exists(
     os.path.join(os.path.dirname(mx.__file__), "libmxtpu.so")),
     reason="native lib not built")
@@ -424,7 +444,9 @@ def test_bench_io_leg_runs():
     if root not in _sys.path:
         _sys.path.insert(0, root)
     import bench_io
-    out = bench_io.run(batch=16, threads=1, seconds=0.4)
+    # pipeline=False: the combined Module.fit leg is covered (and its new
+    # keys asserted) by tests/test_feed.py::test_bench_io_pipeline_leg
+    out = bench_io.run(batch=16, threads=1, seconds=0.4, pipeline=False)
     assert out["io_jpeg_img_s"] > 0
     assert out["io_raw_img_s"] > 0
     assert out["io_host_cores"] >= 1
