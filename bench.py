@@ -410,6 +410,16 @@ def main():
         line.update(io_run(feed=_feed_watchdog))
     except Exception as e:
         sys.stderr.write("bench: io leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
+    # checkpoint leg (mxnet_tpu.checkpoint): the cost of fault tolerance —
+    # async save wall time, bytes/s, restore time, and the steady-state
+    # steps/s tax of a save every K steps (acceptance: < 10% at K=100)
+    try:
+        from bench_ckpt import run as ckpt_run
+        _feed_watchdog("ckpt")
+        line.update(ckpt_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: checkpoint leg failed (%s)\n" % e)
     _wd.stop()
     print(json.dumps(line), flush=True)
 

@@ -55,6 +55,7 @@ from . import plugins
 from .plugins import torch_bridge as th
 from . import native_io
 from . import feed
+from . import checkpoint
 from . import profiler
 from . import libinfo
 from . import misc

@@ -9,13 +9,15 @@ is reproduced natively in Python so the introspection capabilities
 """
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
 __all__ = ["MXNetError", "string_types", "numeric_types", "mx_uint", "mx_float",
-           "get_env", "c_array", "MXNetTPUError"]
+           "get_env", "c_array", "MXNetTPUError", "atomic_local_write",
+           "fsync_dir", "is_local_path", "local_path"]
 
 
 class MXNetError(Exception):
@@ -67,6 +69,70 @@ def open_stream(fname: str, mode: str = "r"):
     if fname.startswith("file://"):
         fname = fname[len("file://"):]
     return open(fname, mode)
+
+
+def is_local_path(fname: str) -> bool:
+    """Whether ``fname`` names the local filesystem (bare path or
+    ``file://``) rather than a protocol URI routed through fsspec.  The
+    ONE definition of the test: save paths use it to decide between
+    atomic local publish and streaming, load paths to decide between
+    existence checks and driver errors — they must agree."""
+    return "://" not in fname or fname.startswith("file://")
+
+
+def local_path(fname: str) -> str:
+    """Strip an optional ``file://`` scheme off a local path."""
+    return fname[len("file://"):] if fname.startswith("file://") else fname
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a rename/create inside it is durable before we
+    report success (crash-safety: the commit protocol in
+    mxnet_tpu/checkpoint/layout.py depends on this ordering).  Platforms
+    whose filesystems cannot fsync a directory fd degrade to a no-op."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_local_write(fname: str, mode: str = "wb"):
+    """Crash-safe publish of a local file: write to a temp name in the
+    SAME directory, flush + fsync, then ``os.replace`` onto the published
+    name and fsync the directory.  A crash mid-write leaves only the temp
+    file; the published name is either absent or complete, never
+    truncated (the legacy save_checkpoint/ndarray.save failure mode).
+    """
+    if not is_local_path(fname):
+        raise MXNetError("atomic_local_write needs a local path, got %r"
+                         % fname)
+    fname = local_path(fname)
+    tmp = "%s.tmp-%d" % (fname, os.getpid())
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, fname)
+        fsync_dir(os.path.dirname(os.path.abspath(fname)))
+    except BaseException:
+        try:
+            f.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def c_array(ctype, values):  # pragma: no cover - compat shim

@@ -8,11 +8,32 @@ import time
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "ProgressBar"]
 
 
-def do_checkpoint(prefix):
-    """Epoch-end checkpoint callback (reference callback.py:10)."""
+def do_checkpoint(prefix, module=None):
+    """Epoch-end checkpoint callback (reference callback.py:10).
+
+    Always writes the legacy ``prefix-symbol.json`` + ``prefix-NNNN
+    .params`` pair (atomically — see model.save_checkpoint).  Pass the
+    training ``module`` to ALSO route through ``mxnet_tpu.checkpoint``:
+    the full train state — optimizer slots (momentum/Adam m+v no longer
+    reset on resume), lr-scheduler position, RNG — is committed under
+    ``prefix-ckpt/`` each epoch, restorable with
+    ``mx.checkpoint.restore_module`` or ``fit(checkpoint=...,
+    resume=True)``.  The legacy files remain a readable fallback."""
+    manager = [None]
+
     def _callback(iter_no, sym, arg, aux):
         from .model import save_checkpoint
         save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+        if module is not None and module.optimizer_initialized:
+            if manager[0] is None:
+                from .checkpoint import CheckpointManager
+                manager[0] = CheckpointManager(prefix + "-ckpt",
+                                               keep_last_n=None,
+                                               async_save=False)
+            from .checkpoint import save_module
+            save_module(manager[0], module, iter_no + 1,
+                        meta={"epoch": iter_no + 1, "nbatch": 0},
+                        blocking=True)
     return _callback
 
 

@@ -1,0 +1,51 @@
+"""mxnet_tpu.checkpoint: async, sharded, crash-safe checkpointing.
+
+The fault-tolerance layer of the production story (ROADMAP north star):
+a training job on preemptible TPUs must survive ``kill -9`` at any
+instant and resume bitwise-identically — params, optimizer slots, LR
+schedule, RNG, and the exact next batch.
+
+Capabilities (see the submodule docstrings for the mechanics):
+
+* **async snapshot** (snapshot.py) — a save costs ~one step of stall:
+  on-device copies + async D2H on the train thread, serialization and
+  commit on a background writer;
+* **sharded saves/restores** (sharded.py) — each process writes only the
+  shards it owns, one file per shard plus a merged index; restore
+  device_puts each shard straight to its target devices, no gather;
+* **atomic commit** (layout.py) — ``step-N.tmp`` -> fsync -> rename ->
+  ``COMMIT`` marker; :func:`latest_step` (the discovery API) can never
+  observe a torn save;
+* **full train-state capture** (module_state.py) — params, optimizer
+  slots, lr_scheduler, RNG, epoch + batch cursor (the feed pipeline's
+  ``state()``/``restore()``);
+* **policy + preemption** (manager.py) — keep-last-N / keep-every-K
+  retention, ``Module.fit(checkpoint=...)`` wiring, SIGTERM
+  snapshot-then-exit;
+* **observability** — ``mx.profiler.checkpoint_report()`` alongside
+  ``feed_report()``.
+
+Quick start::
+
+    mgr = mx.checkpoint.CheckpointManager("/ckpt/run7", keep_last_n=3,
+                                          save_every_steps=100)
+    mod.fit(train_iter, num_epoch=50, checkpoint=mgr, resume=True)
+
+or standalone over any pytree of arrays::
+
+    mgr.save(step, {"params": params, "opt": slots}, {"epoch": 3})
+    tree, meta = mgr.restore()           # newest committed step
+"""
+from __future__ import annotations
+
+from .layout import (all_steps, latest_step, set_fault_hook, step_dir_name,
+                     COMMIT_MARKER, INDEX_FILE, META_FILE)
+from .manager import CheckpointManager, CheckpointStats
+from .module_state import (capture_train_state, restore_train_state,
+                           save_module, restore_module)
+from .snapshot import snapshot_tree
+
+__all__ = ["CheckpointManager", "CheckpointStats", "latest_step",
+           "all_steps", "step_dir_name", "set_fault_hook", "snapshot_tree",
+           "capture_train_state", "restore_train_state", "save_module",
+           "restore_module", "COMMIT_MARKER", "INDEX_FILE", "META_FILE"]

@@ -1,0 +1,305 @@
+"""Full train-state capture/restore for Module (tentpole capability 4).
+
+The legacy ``save_checkpoint`` kept params only; resuming silently reset
+optimizer slots, the LR schedule, RNG, and the data cursor.  These
+helpers capture EVERYTHING the next step depends on:
+
+* params / aux / fixed params — from the live fused device state when
+  the fused train step is engaged (no host sync on the critical path),
+  else from the host param dicts;
+* optimizer slots (momentum, Adam m/v, ...) — the fused state's ``opt``
+  subtree, or the classic updater's per-index states re-keyed by param
+  name (so fused-saved checkpoints restore into classic modules and
+  vice versa);
+* schedule position — ``optimizer.num_update``, per-param update counts
+  (Adam bias correction), and ``lr_scheduler.state_dict()``;
+* RNG — the fused step's resident key, or the global chain key.
+
+The tree schema is ``{"params", "fixed", "aux", "opt", "rng"}`` with all
+scalars in ``meta`` (JSON).  ``restore_train_state`` places leaves with
+the target layout's shardings (each shard device_put straight to its
+devices via CheckpointManager.restore(like=...)).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["capture_train_state", "restore_train_state", "save_module",
+           "restore_module"]
+
+STATE_FORMAT = 1
+
+
+def _updater_of(module):
+    upd = getattr(module, "_updater", None)
+    if upd is None and getattr(module, "_update_on_kvstore", False):
+        kv = getattr(module, "_kvstore", None)
+        upd = getattr(kv, "_updater", None)
+    return upd
+
+
+def _name_index(module, i: int) -> int:
+    """Classic updater index of param i's device-0 replica (the
+    ``idx * num_device + dev`` convention from model._update_params)."""
+    if getattr(module, "_update_on_kvstore", False):
+        return i
+    return i * len(getattr(module, "_context", [None]))
+
+
+def _to_host(x):
+    from ..ndarray import NDArray
+    if x is None:
+        return None
+    if isinstance(x, (tuple, list)):
+        return tuple(_to_host(e) for e in x)
+    if isinstance(x, NDArray):
+        return x._get()
+    return x
+
+
+def capture_train_state(module, extra_meta: Optional[Dict] = None
+                        ) -> Tuple[Dict, Dict]:
+    """-> (tree, meta) snapshotting the module's complete train state."""
+    from .. import random as _random
+    assert module.binded and module.params_initialized, \
+        "capture_train_state needs a bound, initialized module"
+    opt = getattr(module, "_optimizer", None)
+    meta: Dict[str, Any] = {"state_format": STATE_FORMAT}
+    if opt is not None:
+        meta["optimizer"] = type(opt).__name__
+        meta["num_update"] = int(opt.num_update)
+        sched = getattr(opt, "lr_scheduler", None)
+        if sched is not None:
+            meta["lr_scheduler"] = sched.state_dict()
+    fused_state = getattr(module, "_fused_state", None)
+    if getattr(module, "_fused", None) is not None and fused_state is not None:
+        key = _random.key_data_of(module._fused_key)
+        tree = {"params": dict(fused_state["params"]),
+                "fixed": dict(fused_state["fixed"]),
+                "aux": dict(fused_state["aux"]),
+                "opt": dict(fused_state["opt"]),
+                "rng": key}
+        meta["state_path"] = "fused"
+        meta["t"] = int(module._fused_t)
+    else:
+        arg_params, aux_params = module.get_params()
+        tree = {"params": dict(arg_params), "fixed": {},
+                "aux": dict(aux_params), "opt": {},
+                "rng": _random.get_key_data()}
+        meta["state_path"] = "classic"
+        updater = _updater_of(module)
+        if updater is not None and getattr(updater, "states", None):
+            counts = {}
+            for i, n in enumerate(module._param_names):
+                idx = _name_index(module, i)
+                st = updater.states.get(idx)
+                if st is not None:
+                    tree["opt"][n] = _to_host(st)
+                if opt is not None and idx in opt._index_update_count:
+                    counts[n] = int(opt._index_update_count[idx])
+            meta["index_update_count"] = counts
+    meta.update(extra_meta or {})
+    return tree, meta
+
+
+# -- restore ----------------------------------------------------------------
+
+def _lookup(tree: Dict, group: str, name: str):
+    val = (tree.get(group) or {}).get(name)
+    if val is None and group == "params":
+        val = (tree.get("fixed") or {}).get(name)
+    if val is None and group == "fixed":
+        val = (tree.get("params") or {}).get(name)
+    return val
+
+
+def _put_like(template, value):
+    """Place ``value`` in ``template``'s exact layout (sharding + dtype).
+
+    The result joins the DONATED fused state, so it must own fresh
+    device storage: on CPU backends ``device_put`` (including the
+    per-shard puts inside make_array_from_callback) can alias the host
+    numpy buffer it was given, and donating an aliased buffer lets XLA
+    scribble over memory numpy still owns — nondeterministic corruption
+    (the same hazard fused.py's init_state documents).  ``jnp.copy``
+    severs the alias while preserving the sharding."""
+    import jax
+    import jax.numpy as jnp
+    if template is None or value is None:
+        return None
+    if isinstance(template, (tuple, list)):
+        if not isinstance(value, (tuple, list)) or \
+                len(value) != len(template):
+            raise MXNetError(
+                "optimizer state structure mismatch: saved %r vs live %r "
+                "(was the optimizer changed between save and resume?)"
+                % (type(value).__name__, type(template).__name__))
+        return tuple(_put_like(t, v) for t, v in zip(template, value))
+    if isinstance(value, jax.Array) and \
+            getattr(value, "sharding", None) == template.sharding:
+        if value.dtype != template.dtype:
+            value = value.astype(template.dtype)
+        return jnp.copy(value)
+    host = np.asarray(value)
+    if str(host.dtype) != str(template.dtype):
+        host = host.astype(template.dtype)
+    return jnp.copy(jax.device_put(host, template.sharding))
+
+
+def _restore_fused(module, tree: Dict, meta: Dict) -> None:
+    import jax
+    import jax.numpy as jnp
+    module._fused_ensure_state()
+    fs = module._fused_state
+    new_state = {"params": {}, "fixed": {}, "aux": {}, "opt": {}}
+    for group in ("params", "fixed", "aux"):
+        for n, tpl in fs[group].items():
+            val = _lookup(tree, group, n)
+            if val is None:
+                raise MXNetError(
+                    "checkpoint is missing %s %r; cannot resume "
+                    "bitwise-consistently" % (group, n))
+            new_state[group][n] = _put_like(tpl, val)
+    saved_opt = tree.get("opt") or {}
+    for n, tpl in fs["opt"].items():
+        if tpl is None:          # live optimizer keeps no state for n
+            new_state["opt"][n] = None
+        elif saved_opt.get(n) is None:
+            # absent OR saved-as-None (e.g. momentum=0 SGD) while the
+            # live optimizer expects arrays: a switched optimizer —
+            # installing None would crash opaquely inside the jit trace
+            raise MXNetError(
+                "checkpoint has no optimizer state for %r; resuming would "
+                "silently reset its slots (save with the same optimizer, "
+                "or restore params only via load_params)" % n)
+        else:
+            new_state["opt"][n] = _put_like(tpl, saved_opt[n])
+    t = int(meta.get("t", meta.get("num_update", 0)))
+    # jnp.copy: the scalar const could otherwise alias jax's constant
+    # cache, which the donated state would then scribble over
+    new_state["t"] = jnp.copy(jax.device_put(jnp.asarray(t, jnp.int32),
+                                             fs["t"].sharding))
+    module._fused_state = new_state
+    module._fused_t = t
+    kd = np.asarray(np.asarray(_to_host(tree["rng"])), dtype=np.uint32) \
+        if tree.get("rng") is not None else None
+    if kd is not None:
+        if module._fused._multiprocess():
+            import jax
+            key = jax.random.wrap_key_data(
+                jax.device_put(kd, module._fused._replicated()))
+        else:
+            key = jnp.asarray(kd)
+        module._fused_key = key
+    module._fused_pending = None
+    module._fused_outputs = None
+    module._discard_speculation()
+    module._params_dirty = True     # device state is now the truth
+
+
+def _restore_classic(module, tree: Dict, meta: Dict) -> None:
+    from ..ndarray import NDArray
+    from .. import random as _random
+    import jax.numpy as jnp
+
+    def nd(v):
+        return v if isinstance(v, NDArray) else NDArray(jnp.asarray(v))
+
+    arg_params = {}
+    for group in ("params", "fixed"):
+        for n, v in (tree.get(group) or {}).items():
+            arg_params[n] = nd(v)
+    aux_params = {n: nd(v) for n, v in (tree.get("aux") or {}).items()}
+    module.set_params(arg_params, aux_params)
+    opt = getattr(module, "_optimizer", None)
+    updater = _updater_of(module)
+    saved_opt = tree.get("opt") or {}
+    counts = meta.get("index_update_count") or {}
+    if not counts and meta.get("t"):
+        # fused-saved checkpoint: one in-program step counter for every
+        # param; seed the classic per-index counts from it or Adam's
+        # bias correction restarts at t=1
+        counts = {n: int(meta["t"]) for n in saved_opt}
+    if updater is not None:
+        num_dev = len(getattr(module, "_context", [None]))
+        for i, n in enumerate(module._param_names):
+            if n not in saved_opt:
+                continue
+
+            def to_nd(x):
+                if x is None:
+                    return None
+                if isinstance(x, (tuple, list)):
+                    return tuple(to_nd(e) for e in x)
+                return NDArray(jnp.array(np.asarray(_to_host(x))))
+            if getattr(module, "_update_on_kvstore", False):
+                updater.states[i] = to_nd(saved_opt[n])
+                if opt is not None and n in counts:
+                    opt._index_update_count[i] = int(counts[n])
+            else:
+                for dev in range(num_dev):
+                    updater.states[i * num_dev + dev] = to_nd(saved_opt[n])
+                    if opt is not None and n in counts:
+                        opt._index_update_count[i * num_dev + dev] = \
+                            int(counts[n])
+    if tree.get("rng") is not None:
+        _random.set_key_data(np.asarray(_to_host(tree["rng"])))
+
+
+def restore_train_state(module, tree: Dict, meta: Dict) -> None:
+    """Install a captured train state into a bound module (same or the
+    other execution path: fused<->classic both work — the opt-state
+    structures match by construction)."""
+    assert module.binded and module.params_initialized, \
+        "restore_train_state needs a bound, initialized module"
+    meta = meta or {}
+    opt = getattr(module, "_optimizer", None)
+    if getattr(module, "_fused", None) is not None and \
+            module.optimizer_initialized:
+        _restore_fused(module, tree, meta)
+    else:
+        _restore_classic(module, tree, meta)
+    if opt is not None:
+        if "num_update" in meta:
+            opt.num_update = int(meta["num_update"])
+        sched = getattr(opt, "lr_scheduler", None)
+        if sched is not None and meta.get("lr_scheduler"):
+            sched.load_state_dict(meta["lr_scheduler"])
+
+
+# -- manager convenience ----------------------------------------------------
+
+def save_module(manager, module, step: int, meta: Optional[Dict] = None,
+                blocking: Optional[bool] = None) -> None:
+    """Capture ``module``'s train state and checkpoint it as ``step``."""
+    tree, state_meta = capture_train_state(module, extra_meta=meta)
+    manager.save(step, tree, state_meta, blocking=blocking)
+
+
+def restore_module(manager, module, step: Optional[int] = None
+                   ) -> Optional[Dict]:
+    """Restore ``module`` from the newest committed step (or ``step``).
+    Returns the checkpoint's meta, or None when the store is empty.  With
+    the fused path engaged, shards land directly in its state layout."""
+    if step is None:
+        step = manager.latest_step()
+        if step is None:
+            return None
+    like = None
+    if getattr(module, "_fused", None) is not None and \
+            module.optimizer_initialized:
+        module._fused_ensure_state()
+        fs = module._fused_state
+        like = {"params": fs["params"], "fixed": fs["fixed"],
+                "aux": fs["aux"], "opt": fs["opt"]}
+    tree, meta = manager.restore(step=step, like=like)
+    restore_train_state(module, tree, meta)
+    logging.getLogger("mxnet_tpu.checkpoint").info(
+        "restored train state from step %d under %r", step,
+        manager.directory)
+    return meta

@@ -1,0 +1,260 @@
+"""Sharded tensor serialization for the checkpoint subsystem.
+
+A train state is an arbitrary pytree of arrays (dicts / tuples / lists /
+None leaves — the shapes Module and the optimizers actually produce).
+``flatten_state`` walks it into ``(leaves, spec)`` where ``spec`` is a
+JSON-able structure description whose leaf nodes carry stable,
+path-derived ids (``params/fc1_weight``, ``opt/fc1_weight/1``) — the ids
+double as shard file basenames, so a checkpoint directory is
+self-describing.
+
+Sharded saves (tentpole capability 2): a ``jax.Array`` under a
+``NamedSharding`` is written as **one file per distinct shard this
+process owns** — ``addressable_shards`` filtered to ``replica_id == 0``
+and deduped by index, so a replicated array costs one file and a
+dp-sharded optimizer slot (MXNET_SHARD_WEIGHT_UPDATE) costs one file per
+slice.  Under multi-process training each process writes only its own
+shards (file names carry the process index) and rank 0 merges the
+per-process indexes into one ``index.json``.
+
+Restore never gathers: ``read_leaf`` hands each target device its shard
+via ``jax.make_array_from_callback`` (per-device ``device_put`` under
+the hood).  When the saved shard boundaries match the target sharding,
+each file is loaded exactly once and goes straight to its device; when
+they differ (e.g. restoring a replicated save into a sharded layout or
+onto a different device count) the leaf is assembled on host once and
+sliced per device — still no cross-device collective.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["flatten_state", "unflatten_state", "write_leaf", "read_leaf",
+           "merge_indexes"]
+
+_SAFE = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_.-"
+
+
+def _sanitize(part: str) -> str:
+    return "".join(c if c in _SAFE else "_" for c in str(part))
+
+
+def _is_leaf(x) -> bool:
+    return not isinstance(x, (dict, tuple, list)) and x is not None
+
+
+def flatten_state(tree) -> Tuple[Dict[str, Any], Dict]:
+    """-> (leaves: {leaf_id: array-like}, spec: JSON-able structure).
+
+    Leaf ids are derived from the tree path and uniquified with a
+    sequence prefix only on collision (sanitized names can collide)."""
+    leaves: Dict[str, Any] = {}
+
+    def walk(node, path):
+        if node is None:
+            return {"kind": "none"}
+        if isinstance(node, dict):
+            return {"kind": "dict",
+                    "items": {str(k): walk(v, path + [str(k)])
+                              for k, v in node.items()}}
+        if isinstance(node, (tuple, list)):
+            return {"kind": "tuple" if isinstance(node, tuple) else "list",
+                    "items": [walk(v, path + [str(i)])
+                              for i, v in enumerate(node)]}
+        leaf_id = "/".join(_sanitize(p) for p in path) or "leaf"
+        if leaf_id in leaves:
+            k = 1
+            while "%s~%d" % (leaf_id, k) in leaves:
+                k += 1
+            leaf_id = "%s~%d" % (leaf_id, k)
+        leaves[leaf_id] = node
+        return {"kind": "leaf", "id": leaf_id}
+
+    return leaves, walk(tree, [])
+
+
+def unflatten_state(spec: Dict, leaves: Dict[str, Any]):
+    kind = spec["kind"]
+    if kind == "none":
+        return None
+    if kind == "dict":
+        return {k: unflatten_state(v, leaves)
+                for k, v in spec["items"].items()}
+    if kind in ("tuple", "list"):
+        vals = [unflatten_state(v, leaves) for v in spec["items"]]
+        return tuple(vals) if kind == "tuple" else vals
+    if kind == "leaf":
+        return leaves[spec["id"]]
+    raise MXNetError("unknown checkpoint spec node %r" % (kind,))
+
+
+# ---------------------------------------------------------------------------
+# npy shard files (bfloat16 rides as uint16 bits + a dtype tag, the same
+# convention as ndarray.save)
+
+def _np_write(path: str, arr: np.ndarray) -> int:
+    """Write one fsynced .npy file; returns bytes written."""
+    if str(arr.dtype) == "bfloat16":
+        arr = arr.view(np.uint16)
+    with open(path, "wb") as f:
+        np.save(f, np.ascontiguousarray(arr))
+        f.flush()
+        os.fsync(f.fileno())
+    return os.path.getsize(path)
+
+
+def _np_read(path: str, dtype: str) -> np.ndarray:
+    arr = np.load(path)
+    if dtype == "bfloat16":
+        import ml_dtypes
+        arr = arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+def _index_json(index, shape) -> List[List[int]]:
+    """Normalize a tuple-of-slices shard index to [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _index_key(idx_json) -> Tuple:
+    return tuple(tuple(p) for p in idx_json)
+
+
+def _owned_shards(arr) -> List:
+    """This process's distinct shards: replica 0 only, deduped by index,
+    so replicated data is written exactly once per checkpoint."""
+    shards = [s for s in arr.addressable_shards if s.replica_id == 0]
+    seen, out = set(), []
+    for s in shards:
+        key = _index_key(_index_json(s.index, arr.shape))
+        if key not in seen:
+            seen.add(key)
+            out.append(s)
+    return out
+
+
+def write_leaf(dirpath: str, leaf_id: str, arr, process_index: int = 0) -> Dict:
+    """Write one leaf's owned shards into ``dirpath``; returns its index
+    entry ``{"shape", "dtype", "shards": [{"file", "index"}]}`` covering
+    ONLY the shards this process wrote (merge_indexes joins processes)."""
+    import jax
+    base = leaf_id.replace("/", ".")
+    entry: Dict[str, Any] = {"id": leaf_id, "shards": []}
+    if isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
+        entry["shape"] = [int(d) for d in arr.shape]
+        entry["dtype"] = str(arr.dtype)
+        for k, shard in enumerate(_owned_shards(arr)):
+            fname = "%s.p%d.s%d.npy" % (base, process_index, k)
+            data = np.asarray(shard.data)
+            nbytes = _np_write(os.path.join(dirpath, fname), data)
+            entry["shards"].append({
+                "file": fname,
+                "index": _index_json(shard.index, arr.shape),
+                "bytes": nbytes,
+            })
+        return entry
+    data = np.asarray(arr)
+    entry["shape"] = [int(d) for d in data.shape]
+    entry["dtype"] = str(data.dtype)
+    fname = "%s.p%d.s0.npy" % (base, process_index)
+    nbytes = _np_write(os.path.join(dirpath, fname), data)
+    entry["shards"].append({
+        "file": fname,
+        "index": _index_json(tuple(slice(0, d) for d in data.shape),
+                             data.shape),
+        "bytes": nbytes,
+    })
+    return entry
+
+
+def merge_indexes(entries_per_process: List[Dict[str, Dict]]) -> Dict[str, Dict]:
+    """Join per-process ``{leaf_id: entry}`` maps into one: same
+    shape/dtype, concatenated (deduped) shard lists."""
+    merged: Dict[str, Dict] = {}
+    for entries in entries_per_process:
+        for leaf_id, entry in entries.items():
+            if leaf_id not in merged:
+                merged[leaf_id] = {"id": leaf_id, "shape": entry["shape"],
+                                   "dtype": entry["dtype"], "shards": []}
+            have = {_index_key(s["index"]) for s in merged[leaf_id]["shards"]}
+            for s in entry["shards"]:
+                if _index_key(s["index"]) not in have:
+                    merged[leaf_id]["shards"].append(s)
+    return merged
+
+
+def _assemble_host(dirpath: str, entry: Dict) -> np.ndarray:
+    """Rebuild the full array on host from its shard files."""
+    shape = tuple(entry["shape"])
+    dtype = entry["dtype"]
+    shards = entry["shards"]
+    if len(shards) == 1 and _covers_all(shards[0]["index"], shape):
+        return _np_read(os.path.join(dirpath, shards[0]["file"]),
+                        dtype).reshape(shape)
+    first = _np_read(os.path.join(dirpath, shards[0]["file"]), dtype)
+    out = np.empty(shape, dtype=first.dtype)
+    covered = 0
+    for i, s in enumerate(shards):
+        sl = tuple(slice(a, b) for a, b in s["index"])
+        part = first if i == 0 else \
+            _np_read(os.path.join(dirpath, s["file"]), dtype)
+        out[sl] = part.reshape(out[sl].shape)
+        covered += part.size
+    if covered < int(np.prod(shape)):
+        raise MXNetError(
+            "checkpoint leaf %r is missing shards: %d of %d elements "
+            "present (a partial sharded save?)"
+            % (entry.get("id"), covered, int(np.prod(shape))))
+    return out
+
+
+def _covers_all(idx_json, shape) -> bool:
+    return all(a == 0 and b == d for (a, b), d in zip(idx_json, shape))
+
+
+def read_leaf(dirpath: str, entry: Dict, sharding=None, target_dtype=None):
+    """Load one leaf.  ``sharding`` None -> host np.ndarray; otherwise a
+    jax.Array built shard-by-shard: each target device's slice is loaded
+    (straight from its file when the saved boundaries match) and
+    device_put to that device — no global gather."""
+    shape = tuple(entry["shape"])
+    if sharding is None:
+        out = _assemble_host(dirpath, entry)
+        if target_dtype is not None and str(out.dtype) != str(target_dtype):
+            out = out.astype(target_dtype)
+        return out
+    import jax
+    by_index = {_index_key(s["index"]): s for s in entry["shards"]}
+    cache: Dict[Tuple, np.ndarray] = {}
+    full = [None]   # lazily assembled only when boundaries mismatch
+
+    def load(index) -> np.ndarray:
+        key = _index_key(_index_json(index, shape))
+        if key in cache:
+            return cache[key]
+        shard = by_index.get(key)
+        if shard is not None:
+            sl_shape = tuple(b - a for a, b in key)
+            part = _np_read(os.path.join(dirpath, shard["file"]),
+                            entry["dtype"]).reshape(sl_shape)
+        else:
+            if full[0] is None:
+                full[0] = _assemble_host(dirpath, entry)
+            part = full[0][index]
+        if target_dtype is not None and str(part.dtype) != str(target_dtype):
+            part = part.astype(target_dtype)
+        cache[key] = part
+        return part
+
+    return jax.make_array_from_callback(shape, sharding, load)

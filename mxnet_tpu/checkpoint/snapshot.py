@@ -1,0 +1,143 @@
+"""Async snapshot machinery: take the save off the training critical path.
+
+A save has three phases with very different costs:
+
+1. **snapshot** (train thread, ~one step of stall): every device leaf is
+   copied on-device (``jnp.copy`` — the live train state is DONATED to
+   the next step's program, so the snapshot must own its buffers) and
+   its D2H transfer is started (``copy_to_host_async``).  The train loop
+   then continues; the DMA overlaps the next steps.
+2. **serialize** (writer thread): ``np.asarray`` each leaf (blocks only
+   the writer until its transfer lands) and write the shard files.
+3. **commit** (writer thread): the layout.py rename + marker protocol.
+
+:class:`AsyncWriter` is one daemon thread draining a bounded queue of
+save jobs — a second save issued while ``max_pending`` are in flight
+blocks the caller (backpressure, charged to the overhead counter) rather
+than queueing unbounded device copies.  A writer exception is stashed
+and re-raised on the next ``save``/``wait`` so failures cannot pass
+silently.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+__all__ = ["snapshot_tree", "AsyncWriter"]
+
+
+def _map_structure(fn, node):
+    """Structure-preserving map over the dict/tuple/list/None trees the
+    train state uses (jax.tree_map would skip None and rebuild customs)."""
+    if node is None:
+        return None
+    if isinstance(node, dict):
+        return {k: _map_structure(fn, v) for k, v in node.items()}
+    if isinstance(node, (tuple, list)):
+        vals = [_map_structure(fn, v) for v in node]
+        return tuple(vals) if isinstance(node, tuple) else vals
+    return fn(node)
+
+
+def snapshot_tree(tree):
+    """Device-copy every jax leaf and start its D2H transfer; host leaves
+    are copied so later caller mutation cannot race the writer."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..ndarray import NDArray
+    from ..random import key_data_of
+
+    def snap(x):
+        if isinstance(x, NDArray):
+            x = x._get()
+        if isinstance(x, jax.Array):
+            if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+                return key_data_of(x)   # 8 bytes: host copy is free
+            y = jnp.copy(x)
+            try:
+                y.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+            return y
+        return np.array(x, copy=True)
+
+    return _map_structure(snap, tree)
+
+
+class AsyncWriter:
+    """One background writer thread with bounded in-flight saves."""
+
+    def __init__(self, name: str = "ckpt-writer", max_pending: int = 2):
+        assert max_pending >= 1
+        self._max_pending = max_pending
+        self._jobs: List[Callable[[], None]] = []
+        # RLock: a SIGTERM handler runs on the main thread between
+        # bytecodes and may interrupt submit() WHILE it holds this lock;
+        # the handler's blocking save then re-enters wait()/submit() on
+        # the same thread — a plain Lock would self-deadlock and eat the
+        # preemption grace period (Condition handles RLock re-entrancy
+        # via _release_save/_acquire_restore)
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._busy = False     # a popped job still running
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._jobs and not self._closed:
+                    self._cv.wait(0.1)
+                if not self._jobs:
+                    return
+                job = self._jobs.pop(0)
+                self._busy = True
+            try:
+                job()
+            except BaseException as exc:   # noqa: BLE001 — re-raised at caller
+                with self._cv:
+                    self._error = exc
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _raise_pending(self):
+        if self._error is not None:
+            exc, self._error = self._error, None
+            raise exc
+
+    def submit(self, job: Callable[[], None]) -> None:
+        """Enqueue a save job; blocks while ``max_pending`` are in flight
+        (the caller times the whole call to charge its overhead counter).
+        Re-raises any previous job's failure."""
+        with self._cv:
+            self._raise_pending()
+            if self._closed:
+                raise RuntimeError("AsyncWriter is closed")
+            while len(self._jobs) + (1 if self._busy else 0) \
+                    >= self._max_pending:
+                self._cv.wait(0.1)
+                self._raise_pending()
+            self._jobs.append(job)
+            self._cv.notify_all()
+
+    def wait(self) -> None:
+        """Drain every queued job; re-raise a writer failure."""
+        with self._cv:
+            while self._jobs or self._busy:
+                self._cv.wait(0.1)
+            self._raise_pending()
+
+    def close(self, join: bool = True) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if join and self._thread.is_alive():
+            self._thread.join(30.0)
+        with self._cv:
+            self._raise_pending()

@@ -65,6 +65,7 @@ class FeedDataIter:
         self._data_name = data_name
         self._label_name = label_name
         self._at_boundary = True
+        self._delivered = 0   # batches handed out in the current epoch
 
     @property
     def provide_data(self):
@@ -89,8 +90,10 @@ class FeedDataIter:
             data, label, pad = self.pipeline.get()
         except StopIteration:
             self._at_boundary = True
+            self._delivered = 0
             raise
         self._at_boundary = False
+        self._delivered += 1
 
         def wrap(a):
             if isinstance(a, NDArray):
@@ -112,6 +115,53 @@ class FeedDataIter:
         except StopIteration:
             pass
         self._at_boundary = True
+        self._delivered = 0
+
+    # -- checkpoint cursor (mxnet_tpu.checkpoint mid-epoch resume) --------
+    def state(self) -> dict:
+        """Position cursor: completed epochs + batches delivered in the
+        current one.  ``restore`` on a FRESH iterator fast-forwards to
+        the exact next batch."""
+        return {"epoch": self.pipeline.epochs_consumed,
+                "batch": self._delivered}
+
+    def restore(self, state: dict) -> None:
+        """Fast-forward a freshly built iterator to ``state``: whole
+        epochs are drained through the pipeline (the source replays the
+        same passes), then the already-consumed batches of the target
+        epoch are pulled and discarded, so the next ``next()`` returns
+        the exact batch the checkpoint's training step would have seen."""
+        from ..base import MXNetError
+        state = state or {}
+        if "inner" in state:
+            # a cursor saved THROUGH a DevicePrefetchIter wrapper
+            # (prefetch_to_device was toggled off between save and
+            # resume): the nested inner state is this iterator's own
+            state = state["inner"] or {}
+        target_epoch = int(state.get("epoch", 0))
+        target_batch = int(state.get("batch", 0))
+        while self.pipeline.epochs_consumed < target_epoch:
+            before = self.pipeline.epochs_consumed
+            try:
+                while True:
+                    self.pipeline.get()
+            except StopIteration:
+                pass
+            if self.pipeline.epochs_consumed == before:   # EndOfStream
+                raise MXNetError(
+                    "feed restore: source exhausted before epoch %d "
+                    "(max_epochs too small for this resume?)" % target_epoch)
+        for i in range(target_batch):
+            try:
+                self.pipeline.get()
+            except StopIteration:
+                raise MXNetError(
+                    "feed restore: epoch %d ended after %d batches but the "
+                    "checkpoint cursor wants %d (did the dataset or batch "
+                    "size change between save and resume?)"
+                    % (target_epoch, i, target_batch))
+        self._delivered = target_batch
+        self._at_boundary = target_batch == 0
 
     def close(self):
         self.pipeline.close()

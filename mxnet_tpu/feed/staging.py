@@ -45,7 +45,11 @@ class DevicePrefetchIter:
         self._sharding = sharding
         self._depth = depth
         self._pending = deque()
+        # inner-iterator cursor snapshots aligned 1:1 with _pending, each
+        # taken BEFORE its batch was pulled (see state())
+        self._pending_states = deque()
         self._exhausted = False
+        self._consumed = 0    # batches handed out this epoch (checkpoint)
         self.stats = PipelineStats(name).register()
         self._h2d = self.stats.stage("h2d")
         self.batch_size = getattr(data_iter, "batch_size", 0)
@@ -67,14 +71,69 @@ class DevicePrefetchIter:
 
     def reset(self):
         self._pending.clear()
+        self._pending_states.clear()
         self._exhausted = False
+        self._consumed = 0
         self._iter.reset()
 
     def next(self):
         self._fill()
         if not self._pending:
             raise StopIteration
+        self._consumed += 1
+        if self._pending_states:
+            self._pending_states.popleft()
         return self._pending.popleft()
+
+    # -- checkpoint cursor (mxnet_tpu.checkpoint mid-epoch resume) --------
+    def state(self) -> dict:
+        """Position cursor counting batches HANDED OUT — in-flight staged
+        batches are NOT consumed; a resume re-stages them.  For an inner
+        iterator with its own cursor, the snapshot taken BEFORE the
+        oldest still-pending batch was pulled is reported (the inner's
+        live cursor already sits ``depth`` batches ahead; using it would
+        skip the staged-but-untrained batches on resume)."""
+        st = {"batch": self._consumed}
+        inner = getattr(self._iter, "state", None)
+        if callable(inner):
+            st["inner"] = (self._pending_states[0] if self._pending_states
+                           else inner())
+        return st
+
+    def restore(self, state: dict) -> None:
+        """Fast-forward past the consumed batches.  A wrapped iterator
+        with its own cursor (feed.FeedDataIter) restores natively;
+        otherwise the host batches are pulled and discarded WITHOUT
+        staging them to the device.  A cursor saved WITHOUT the wrapper
+        (an epoch-carrying inner-style state — prefetch_to_device was
+        toggled on between save and resume) is delegated to the inner
+        iterator rather than silently dropping its epoch."""
+        state = state or {}
+        self._pending.clear()
+        self._pending_states.clear()
+        self._exhausted = False
+        inner = getattr(self._iter, "restore", None)
+        if callable(inner) and "inner" in state:
+            inner(state["inner"])
+        elif "epoch" in state:
+            # an unwrapped iterator's own cursor: only that iterator
+            # knows how to honor the epoch component
+            if not callable(inner):
+                from ..base import MXNetError
+                raise MXNetError(
+                    "cannot restore an epoch-carrying feed cursor %r: the "
+                    "wrapped iterator has no restore(); resume without "
+                    "prefetch_to_device or re-save with it enabled" % state)
+            inner(state)
+        else:
+            self._iter.reset()
+            for _ in range(int(state.get("batch", 0))):
+                try:
+                    self._iter.next()
+                except StopIteration:
+                    self._exhausted = True
+                    break
+        self._consumed = int(state.get("batch", 0))
 
     def iter_next(self):
         self._fill()
@@ -91,7 +150,9 @@ class DevicePrefetchIter:
         return None
 
     def _fill(self):
+        inner_state = getattr(self._iter, "state", None)
         while not self._exhausted and len(self._pending) < self._depth:
+            pre = inner_state() if callable(inner_state) else None
             t0 = time.perf_counter()
             try:
                 batch = self._iter.next()
@@ -100,6 +161,8 @@ class DevicePrefetchIter:
                 return
             self._h2d.add_stall_in(time.perf_counter() - t0)
             self._pending.append(self._stage(batch))
+            if pre is not None:
+                self._pending_states.append(pre)
 
     def _stage(self, batch):
         import jax

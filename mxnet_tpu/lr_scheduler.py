@@ -15,6 +15,20 @@ class LRScheduler:
     def __call__(self, num_update: int) -> float:
         raise NotImplementedError()
 
+    def state_dict(self) -> dict:
+        """JSON-able snapshot of the schedule position (base_lr plus any
+        counters a subclass keeps), for checkpointing: a resumed run must
+        not replay completed lr decays."""
+        return {k: v for k, v in vars(self).items()
+                if isinstance(v, (int, float, bool, str))
+                or (isinstance(v, list)
+                    and all(isinstance(x, (int, float)) for x in v))}
+
+    def load_state_dict(self, state: dict) -> None:
+        for k, v in (state or {}).items():
+            if k in vars(self):
+                setattr(self, k, v)
+
 
 class FactorScheduler(LRScheduler):
     """lr *= factor every `step` updates (reference lr_scheduler.py:36)."""

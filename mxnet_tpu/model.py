@@ -220,7 +220,15 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Save prefix-symbol.json + prefix-%04d.params (reference model.py:312)."""
+    """Save prefix-symbol.json + prefix-%04d.params (reference model.py:312).
+
+    Both files publish atomically on local paths (symbol.save /
+    ndarray.save write temp + fsync + rename), so a crash mid-save never
+    leaves a truncated file at the published name.  NOTE this legacy
+    format keeps params only; for full train state (optimizer slots, lr
+    schedule, RNG, batch cursor) use ``mxnet_tpu.checkpoint`` —
+    ``Module.save_checkpoint`` and ``Module.fit(checkpoint=...)`` write
+    both."""
     symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
@@ -230,11 +238,55 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
 
 def load_checkpoint(prefix, epoch):
-    """Load checkpoint pair (reference model.py:340-375)."""
-    from .base import open_stream
-    with open_stream("%s-symbol.json" % prefix) as f:
-        symbol = sym_load_json(f.read())
-    save_dict = nd_load("%s-%04d.params" % (prefix, epoch))
+    """Load checkpoint pair (reference model.py:340-375).
+
+    Failures name the exact file and distinguish *missing* from
+    *corrupt* (a torn write from a pre-atomic-save crash).  Discovery:
+    epoch numbers here are caller-chosen; for directory-based full-state
+    checkpoints the documented discovery API is
+    ``mxnet_tpu.checkpoint.latest_step(dir)``, which only ever reports
+    fully committed saves."""
+    import os
+    from .base import is_local_path, local_path, open_stream
+    sym_file = "%s-symbol.json" % prefix
+    param_file = "%s-%04d.params" % (prefix, epoch)
+    for fname, kind in ((sym_file, "symbol"), (param_file, "params")):
+        if is_local_path(fname) and not os.path.exists(local_path(fname)):
+            import glob
+            have = sorted(glob.glob("%s-*.params" % prefix))
+            raise MXNetError(
+                "checkpoint %s file missing: %r (existing param files for "
+                "this prefix: %s)" % (kind, fname, have or "none"))
+    try:
+        with open_stream(sym_file) as f:
+            symbol = sym_load_json(f.read())
+    except MXNetError:
+        raise
+    except FileNotFoundError as e:
+        # remote URIs skip the local existence pre-check above; a missing
+        # object must not be reported as corruption
+        raise MXNetError(
+            "checkpoint symbol file missing: %r (%s)" % (sym_file, e)) from e
+    except Exception as e:
+        raise MXNetError(
+            "checkpoint symbol file corrupt: %r (%s: %s) — likely a torn "
+            "write from a crashed save predating atomic publishes"
+            % (sym_file, type(e).__name__, e)) from e
+    try:
+        save_dict = nd_load(param_file)
+    except FileNotFoundError as e:
+        raise MXNetError(
+            "checkpoint params file missing: %r (%s)" % (param_file, e)) from e
+    except MXNetError as e:
+        raise MXNetError(
+            "checkpoint params file corrupt: %r (%s) — likely a torn "
+            "write from a crashed save predating atomic publishes"
+            % (param_file, e)) from e
+    except Exception as e:
+        raise MXNetError(
+            "checkpoint params file corrupt: %r (%s: %s) — likely a torn "
+            "write from a crashed save predating atomic publishes"
+            % (param_file, type(e).__name__, e)) from e
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():

@@ -112,13 +112,27 @@ class BaseModule:
             arg_params=None, aux_params=None, allow_missing=False,
             force_rebind=False, force_init=False, begin_epoch=0,
             num_epoch=None, validation_metric=None, monitor=None,
-            work_load_list=None, prefetch_to_device=False):
+            work_load_list=None, prefetch_to_device=False,
+            checkpoint=None, checkpoint_every=None, resume=False):
         """Train (reference base_module.py:273-393).
 
         ``prefetch_to_device``: wrap ``train_data`` with the feed
         subsystem's device prefetcher (mxnet_tpu.feed) so batch N+1's
         H2D transfer is issued while batch N trains; pass an int to set
-        the lookahead depth (True = 2)."""
+        the lookahead depth (True = 2).
+
+        ``checkpoint``: a ``mx.checkpoint.CheckpointManager`` (or a
+        directory path, wrapped in one with defaults) for crash-safe
+        fault tolerance: async saves every ``checkpoint_every`` batches
+        (overrides the manager's ``save_every_steps``) and at every
+        epoch end, full train state (params, optimizer slots, lr
+        schedule, RNG, batch cursor).  ``resume=True`` restores the
+        newest committed step and continues from the exact next batch —
+        natively when ``train_data`` implements the feed subsystem's
+        ``state()``/``restore()`` cursor, otherwise by skipping the
+        already-trained batches.  If SIGTERM arrives (the manager's
+        ``install_preemption_handler``), the loop snapshots at the next
+        batch boundary and returns."""
         assert num_epoch is not None, "please specify number of epochs"
         if optimizer_params is None:
             optimizer_params = (("learning_rate", 0.01),)
@@ -141,15 +155,69 @@ class BaseModule:
                 else max(1, int(prefetch_to_device))
             train_data = self.prefetch_to_device(train_data, depth=depth)
 
+        ckpt_mgr = None
+        if checkpoint is None and resume:
+            raise MXNetError(
+                "fit(resume=True) needs checkpoint=<manager or directory>; "
+                "without a store to restore from, training would silently "
+                "restart from scratch")
+        if checkpoint is not None:
+            from ..checkpoint import CheckpointManager, save_module, \
+                restore_module
+            ckpt_mgr = checkpoint if isinstance(checkpoint, CheckpointManager) \
+                else CheckpointManager(str(checkpoint))
+            if checkpoint_every is not None:
+                ckpt_mgr.save_every_steps = int(checkpoint_every)
+            # a handled preemption from a PREVIOUS fit must not make this
+            # run save-and-return after one batch; re-entering fit is the
+            # caller's decision to train again
+            ckpt_mgr.preempted = False
+
+        global_step = 0
+        start_epoch, start_batch = begin_epoch, 0
+        if ckpt_mgr is not None and resume:
+            meta = restore_module(ckpt_mgr, self)
+            if meta is not None:
+                global_step = int(meta.get("global_step", 0))
+                start_epoch = int(meta.get("epoch", begin_epoch))
+                start_batch = int(meta.get("nbatch", 0))
+                feed_state = meta.get("feed")
+                if feed_state is not None and \
+                        callable(getattr(train_data, "restore", None)):
+                    train_data.restore(feed_state)
+                elif start_batch:
+                    # generic DataIter: fast-forward by discarding the
+                    # already-trained batches of the resumed epoch
+                    for _ in range(start_batch):
+                        try:
+                            train_data.next()
+                        except StopIteration:
+                            break
+                self.logger.info(
+                    "resumed from checkpoint step %d: epoch %d, batch %d",
+                    global_step, start_epoch, start_batch)
+
+        last_saved_step = [-1]
+
+        def ckpt_save(epoch_, nbatch_, blocking=False):
+            meta = {"global_step": global_step, "epoch": epoch_,
+                    "nbatch": nbatch_}
+            if callable(getattr(train_data, "state", None)):
+                meta["feed"] = train_data.state()
+            save_module(ckpt_mgr, self, global_step, meta=meta,
+                        blocking=blocking)
+            last_saved_step[0] = global_step
+
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
-        for epoch in range(begin_epoch, num_epoch):
+        for epoch in range(start_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
+            nbatch = start_batch if epoch == start_epoch else 0
+            for data_batch in train_data:
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
@@ -166,6 +234,21 @@ class BaseModule:
                             callback(batch_end_params)
                     else:
                         batch_end_callback(batch_end_params)
+                nbatch += 1
+                global_step += 1
+                if ckpt_mgr is not None:
+                    if ckpt_mgr.preempted:
+                        # SIGTERM: snapshot at this safe batch boundary,
+                        # flush, and leave the loop (snapshot-then-exit)
+                        ckpt_save(epoch, nbatch, blocking=True)
+                        ckpt_mgr.wait()
+                        self.logger.info(
+                            "preempted: checkpoint committed at step %d "
+                            "(epoch %d, batch %d); exiting fit",
+                            global_step, epoch, nbatch)
+                        return
+                    if ckpt_mgr.should_save(global_step):
+                        ckpt_save(epoch, nbatch)
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -187,6 +270,16 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
 
             train_data.reset()
+            if ckpt_mgr is not None and last_saved_step[0] != global_step:
+                # epoch boundary: cursor points at the NEXT epoch's start.
+                # Skipped when the epoch's last batch already saved this
+                # global_step (an end-of-epoch cursor and a full-epoch
+                # cursor resume identically): re-committing the same step
+                # would rewrite the whole state AND briefly uncommit the
+                # newest checkpoint — a crash there loses it.
+                ckpt_save(epoch + 1, 0)
+        if ckpt_mgr is not None:
+            ckpt_mgr.wait()
 
     # -- symbol -------------------------------------------------------------
     @property
@@ -234,6 +327,28 @@ class BaseModule:
         save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
         from ..ndarray import save as nd_save
         nd_save(fname, save_dict)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=True):
+        """Checkpoint through the mxnet_tpu.checkpoint subsystem while
+        keeping the legacy files as a readable fallback: writes the
+        classic ``prefix-symbol.json`` + ``prefix-%04d.params`` pair
+        (atomically — a crash can no longer tear them) AND, with
+        ``save_optimizer_states``, the FULL train state (optimizer
+        slots, lr schedule position, RNG) as a committed step under
+        ``prefix-ckpt/``.  ``model.load_checkpoint`` reads the legacy
+        pair; ``mx.checkpoint.restore_module`` (or
+        ``fit(checkpoint=prefix + "-ckpt", resume=True)``) resumes with
+        nothing reset."""
+        from ..model import save_checkpoint as legacy_save
+        arg_params, aux_params = self.get_params()
+        legacy_save(prefix, epoch, self.symbol, arg_params, aux_params)
+        if save_optimizer_states and self.optimizer_initialized:
+            from ..checkpoint import CheckpointManager, save_module
+            with CheckpointManager(prefix + "-ckpt", keep_last_n=None,
+                                   async_save=False) as mgr:
+                save_module(mgr, self, epoch,
+                            meta={"epoch": epoch, "nbatch": 0},
+                            blocking=True)
 
     def load_params(self, fname):
         from ..ndarray import load as nd_load

@@ -412,7 +412,13 @@ _SAVE_MAGIC = b"MXTPU001"
 
 
 def save(fname: str, data) -> None:
-    """Save list or dict of NDArray (reference python/mxnet/ndarray.py save)."""
+    """Save list or dict of NDArray (reference python/mxnet/ndarray.py save).
+
+    Local paths publish atomically (temp file + fsync + ``os.replace``,
+    base.atomic_local_write): a crash mid-save can never leave a
+    truncated file at the published name — the torn-``.params`` failure
+    mode that used to break ``load_checkpoint``.  URI targets stream
+    through their protocol driver unchanged."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
@@ -431,8 +437,8 @@ def save(fname: str, data) -> None:
     dtypes = [str(a.dtype) for a in raw]
     raw = [a.view(np.uint16) if d == "bfloat16" else a
            for a, d in zip(raw, dtypes)]
-    from .base import open_stream
-    with open_stream(fname, "wb") as f:
+
+    def _write(f):
         f.write(_SAVE_MAGIC)
         np_bytes = _io.BytesIO()
         np.savez(np_bytes, *raw)
@@ -440,6 +446,14 @@ def save(fname: str, data) -> None:
         f.write(struct.pack("<Q", len(meta)))
         f.write(meta)
         f.write(np_bytes.getvalue())
+
+    from .base import atomic_local_write, is_local_path, open_stream
+    if is_local_path(fname):
+        with atomic_local_write(fname, "wb") as f:
+            _write(f)
+    else:
+        with open_stream(fname, "wb") as f:
+            _write(f)
 
 
 def load(fname: str):

@@ -24,7 +24,8 @@ import weakref
 
 __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "dump_profile", "state", "register_feed_stats", "feed_report",
-           "feed_report_str"]
+           "feed_report_str", "register_checkpoint_stats",
+           "checkpoint_report", "checkpoint_report_str"]
 
 _config = {"filename": "profile_output", "mode": "symbolic"}
 _state = "stop"
@@ -92,6 +93,33 @@ def feed_report_str() -> str:
     """Human-readable per-stage table for every live feed pipeline."""
     parts = [ps.report_str() for _, ps in sorted(_feed_stats.items())]
     return "\n\n".join(parts) if parts else "(no live feed pipelines)"
+
+
+# -- checkpoint instrumentation (mxnet_tpu.checkpoint) ----------------------
+# Live CheckpointManagers register their CheckpointStats here, weakly like
+# the feed pipelines above, so one checkpoint_report() shows every
+# manager's save/restore wall time, bytes/s, and the train-thread overhead
+# each save cost — the numbers BENCH's ckpt leg tracks over rounds.
+_ckpt_stats = weakref.WeakValueDictionary()
+_ckpt_seq = 0
+
+
+def register_checkpoint_stats(ckpt_stats) -> None:
+    """Called by checkpoint.CheckpointManager on construction."""
+    global _ckpt_seq
+    _ckpt_seq += 1
+    _ckpt_stats["%s#%06d" % (ckpt_stats.name, _ckpt_seq)] = ckpt_stats
+
+
+def checkpoint_report() -> dict:
+    """{manager key: counters} for every live CheckpointManager."""
+    return {key: cs.report() for key, cs in sorted(_ckpt_stats.items())}
+
+
+def checkpoint_report_str() -> str:
+    """Human-readable save/restore counters for every live manager."""
+    parts = [cs.report_str() for _, cs in sorted(_ckpt_stats.items())]
+    return "\n\n".join(parts) if parts else "(no live checkpoint managers)"
 
 
 @contextlib.contextmanager

@@ -18,7 +18,8 @@ import jax
 from .ndarray import NDArray, _dev_put, _resolve_ctx
 from . import engine as _engine
 
-__all__ = ["seed", "uniform", "normal", "new_key", "randint"]
+__all__ = ["seed", "uniform", "normal", "new_key", "randint",
+           "get_key_data", "set_key_data", "key_data_of"]
 
 _state = threading.local()
 
@@ -34,6 +35,26 @@ def new_key():
     k1, k2 = jax.random.split(_key())
     _state.key = k1
     return k2
+
+
+def key_data_of(key) -> np.ndarray:
+    """Raw uint32 data of ANY PRNG key array, typed or legacy — the one
+    unwrap used by every checkpoint capture path (a JAX key-API change
+    lands here once)."""
+    if jax.numpy.issubdtype(key.dtype, jax.dtypes.prng_key):
+        key = jax.random.key_data(key)
+    return np.asarray(key)
+
+
+def get_key_data() -> np.ndarray:
+    """Raw data of the global PRNG chain key, for checkpointing."""
+    return key_data_of(_key())
+
+
+def set_key_data(data) -> None:
+    """Restore the global PRNG chain from :func:`get_key_data` output, so
+    a resumed run continues the exact random sequence."""
+    _state.key = jax.numpy.asarray(np.asarray(data, dtype=np.uint32))
 
 
 def seed(seed_state: int) -> None:

@@ -383,8 +383,14 @@ class Symbol:
                           indent=2)
 
     def save(self, fname: str) -> None:
-        from .base import open_stream
-        with open_stream(fname, "w") as f:
+        from .base import atomic_local_write, is_local_path, open_stream
+        if not is_local_path(fname):
+            with open_stream(fname, "w") as f:
+                f.write(self.tojson())
+            return
+        # local paths publish atomically: checkpoint pairs must never
+        # expose a truncated -symbol.json (see base.atomic_local_write)
+        with atomic_local_write(fname, "w") as f:
             f.write(self.tojson())
 
     def debug_str(self) -> str:
