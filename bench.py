@@ -398,6 +398,18 @@ def main():
                 line["lstm_h1024_mfu"] = round(mfu_big, 4)
         if suspect_big:
             line["lstm_h1024_clock_suspect"] = True
+        # dispatch-bound leg (ISSUE 3): LSTM-200h at b32, where per-step
+        # dispatch + host sync — not compute — sets the ceiling (r05:
+        # 0.46 MFU vs 0.95 on the compute-bound h1024 leg).  K=1
+        # sequential fused steps vs ONE lax.scan superstep per 8
+        # batches; the delta per step is the host overhead the
+        # superstep amortizes away.
+        try:
+            from bench_lstm import superstep_leg_json
+            _feed_watchdog("lstm-superstep")
+            line.update(superstep_leg_json(k=8))
+        except Exception as e:
+            sys.stderr.write("bench: superstep leg failed (%s)\n" % e)
     except Exception as e:
         sys.stderr.write("bench: lstm leg failed (%s)\n" % e)
     _PARTIAL_LINE = dict(line)

@@ -115,6 +115,82 @@ def run(batch=32, seq_len=32, num_hidden=200, num_embed=200,
     return sorted(rates)[len(rates) // 2]
 
 
+def run_superstep_leg(batch=32, seq_len=32, num_hidden=200, num_embed=200,
+                      k=8, warmup=2, iters=48, windows=3):
+    """The dispatch-bound leg (BENCH_r05: LSTM-200h at 0.46 MFU while
+    h1024 hits 0.95 — per-step dispatch + host sync, not compute, is the
+    ceiling): K=1 sequential fused steps vs ONE lax.scan superstep
+    program per K batches, same module, same pre-staged data.  Returns
+    (tokens_per_sec_k1, tokens_per_sec_k8, host_overhead_s_per_step) or
+    None when the fused path did not engage."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.feed import MegaBatch, stack_batch_arrays
+
+    mod, staged = build_module(batch=batch, seq_len=seq_len,
+                               num_hidden=num_hidden, num_embed=num_embed)
+    if mod._fused is None:
+        return None
+
+    def window_rates(step_fn, steps_per_iter, n_iters):
+        rates = []
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(n_iters):
+                step_fn()
+            _sync(mod)
+            rates.append(batch * seq_len * steps_per_iter * n_iters
+                         / (time.perf_counter() - t0))
+        return sorted(rates)[len(rates) // 2]
+
+    def one_step():
+        mod.forward(staged, is_train=True)
+        mod.backward()
+        mod.update()
+
+    for _ in range(warmup):
+        one_step()
+    _sync(mod)
+    r1 = window_rates(one_step, 1, iters)
+
+    # megabatch pre-staged ONCE in the superstep input layout (K copies
+    # of the same staged batch — identical FLOPs to the K=1 leg),
+    # through the SAME staging primitive production uses
+    sh = mod._fused.megabatched_sharding()
+
+    def stack(arr):
+        return mx.nd.NDArray(stack_batch_arrays([arr] * k, sh))
+    mega = MegaBatch(data=[stack(a) for a in staged.data],
+                     label=[stack(a) for a in staged.label], k=k)
+
+    def one_superstep():
+        if not mod.superstep_train(mega):
+            raise RuntimeError("superstep refused to dispatch")
+    one_superstep()   # compile
+    _sync(mod)
+    rk = window_rates(one_superstep, k, max(1, iters // k))
+
+    # the host-side cost superstep amortizes away: per-step wall at K=1
+    # minus per-step wall at K (same program body, K-fold fewer
+    # dispatch+sync round trips)
+    tokens = batch * seq_len
+    overhead = max(0.0, tokens / r1 - tokens / rk)
+    return r1, rk, overhead
+
+
+def superstep_leg_json(k=8):
+    """The superstep leg as bench-JSON keys (shared by this bench's main
+    and bench.py so both entry points emit identical fields); {} when
+    the fused path did not engage."""
+    leg = run_superstep_leg(k=k)
+    if leg is None:
+        return {}
+    r1, rk, overhead = leg
+    return {"lstm_superstep_k1_tokens_per_sec": round(r1, 1),
+            "lstm_superstep_tokens_per_sec": round(rk, 1),
+            "lstm_superstep_k": k,
+            "lstm_step_host_overhead_s": round(overhead, 7)}
+
+
 def main():
     os.environ.setdefault("MXNET_COMPUTE_DTYPE", "bfloat16")
     value = None
@@ -142,7 +218,7 @@ def main():
     except Exception as e:
         sys.stderr.write("bench_lstm: peak probe failed (%s)\n" % e)
         peak, mfu = 0.0, 0.0
-    print(json.dumps({
+    out = {
         "metric": "ptb_lstm_train_tokens_per_chip",
         "value": round(value, 2),
         "unit": "tokens/sec",
@@ -150,7 +226,12 @@ def main():
         "path": "module_api_fused",
         "mfu": round(mfu, 4),
         "peak_tflops": round(peak, 1),
-    }))
+    }
+    try:
+        out.update(superstep_leg_json(k=8))
+    except Exception as e:
+        sys.stderr.write("bench_lstm: superstep leg failed (%s)\n" % e)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -54,12 +54,21 @@ class Speedometer:
     """Samples/sec logger (reference callback.py:49) — the throughput
     instrument behind every BASELINE.md number. Rates are measured over
     windows of `frequent` batches; the clock restarts whenever the batch
-    counter jumps backwards (a new epoch)."""
+    counter jumps backwards (a new epoch).
+
+    Windows are timed with ``time.perf_counter()`` — a monotonic clock;
+    ``time.time()`` is wall-clock and an NTP step (or DST jump) inside a
+    window used to corrupt the samples/sec sample.  The rate divides by
+    the batches ACTUALLY covered since the window opened, so superstep
+    training (``fit(superstep=K)`` fires the callback once per K
+    batches, at batch indices that need not hit ``frequent`` exactly)
+    reports true throughput instead of skipping windows."""
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
         self._window_start = None
+        self._window_batch = 0
         self._prev_batch = 0
 
     def __call__(self, param):
@@ -68,12 +77,14 @@ class Speedometer:
             self._window_start = None
         self._prev_batch = n
         if self._window_start is None:
-            self._window_start = time.time()
+            self._window_start = time.perf_counter()
+            self._window_batch = n
             return
-        if n % self.frequent:
+        covered = n - self._window_batch
+        if (n % self.frequent) and covered < self.frequent:
             return
-        elapsed = max(time.time() - self._window_start, 1e-12)
-        rate = self.frequent * self.batch_size / elapsed
+        elapsed = max(time.perf_counter() - self._window_start, 1e-12)
+        rate = max(covered, 1) * self.batch_size / elapsed
         metric = param.eval_metric
         if metric is None:
             logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
@@ -83,7 +94,8 @@ class Speedometer:
                 logging.info(
                     "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
                     "\tTrain-%s=%f", param.epoch, n, rate, name, value)
-        self._window_start = time.time()
+        self._window_start = time.perf_counter()
+        self._window_batch = n
 
 
 class ProgressBar:

@@ -36,14 +36,16 @@ from .pipeline import (BoundedQueue, EndOfEpoch, EndOfStream, Pipeline,
                        QueueClosed, Stage, StageError)
 from .stages import (BatchStage, DevicePutStage, MapStage, SourceStage,
                      StagingStage)
-from .staging import DevicePrefetchIter, device_feed
+from .staging import (DevicePrefetchIter, MegaBatch, device_feed,
+                      stack_batch_arrays)
 from .stats import PipelineStats, StageStats
 
 __all__ = ["Pipeline", "Stage", "BoundedQueue", "EndOfEpoch", "EndOfStream",
            "StageError", "QueueClosed", "SourceStage", "MapStage",
            "BatchStage", "StagingStage", "DevicePutStage", "StageStats",
-           "PipelineStats", "DevicePrefetchIter", "device_feed",
-           "FeedDataIter", "record_pipeline", "make_jpeg_decode"]
+           "PipelineStats", "DevicePrefetchIter", "MegaBatch", "device_feed",
+           "stack_batch_arrays", "FeedDataIter", "record_pipeline",
+           "make_jpeg_decode"]
 
 
 class FeedDataIter:

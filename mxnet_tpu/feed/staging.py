@@ -24,7 +24,55 @@ from typing import Optional
 
 from .stats import PipelineStats
 
-__all__ = ["DevicePrefetchIter", "device_feed"]
+__all__ = ["MegaBatch", "DevicePrefetchIter", "device_feed",
+           "stack_batch_arrays"]
+
+
+def stack_batch_arrays(arrs, sharding=None):
+    """Stack K per-step arrays (NDArray or array-like) on a new leading
+    axis and ship them in ONE ``device_put`` — the megabatch staging
+    primitive shared by the prefetcher (:class:`DevicePrefetchIter`) and
+    the cold path (``FusedTrainStep.make_megabatch``), so both produce
+    the same layout for the same compiled superstep program."""
+    import numpy as np
+    import jax
+    from ..ndarray import NDArray
+    hosts = [np.asarray(a._get() if isinstance(a, NDArray) else a)
+             for a in arrs]
+    stacked = np.stack(hosts)
+    if sharding is not None:
+        return jax.device_put(stacked, sharding)
+    return jax.device_put(stacked)
+
+
+class MegaBatch:
+    """K training batches stacked on a leading axis, pre-staged on
+    device in the fused superstep's input layout
+    (``FusedTrainStep.megabatched_sharding()``: K axis unsharded, batch
+    axis over dp).  ``data``/``label`` are lists of NDArray shaped
+    ``(K, B, ...)``, aligned with the module's data/label names like a
+    DataBatch.  Consumers duck-type on the ``megabatch`` attribute
+    (``Module.fit``'s superstep loop); ``unstack()`` recovers the K
+    per-step DataBatches for the per-batch fallback path."""
+
+    def __init__(self, data, label, k, pad=0, index=None):
+        self.data = data
+        self.label = label
+        self.megabatch = int(k)
+        self.pad = pad
+        self.index = index
+
+    def unstack(self):
+        from ..io import DataBatch
+        from ..ndarray import NDArray
+
+        def row(arr, i):
+            a = arr._get() if isinstance(arr, NDArray) else arr
+            return NDArray(a[i])
+        return [DataBatch(data=[row(a, i) for a in self.data],
+                          label=[row(a, i) for a in (self.label or [])],
+                          pad=self.pad, index=None)
+                for i in range(self.megabatch)]
 
 
 class DevicePrefetchIter:
@@ -38,12 +86,17 @@ class DevicePrefetchIter:
     """
 
     def __init__(self, data_iter, sharding=None, module=None, depth: int = 2,
-                 name: str = "device_feed"):
+                 megabatch: int = 1, name: str = "device_feed"):
         assert depth >= 1
         self._iter = data_iter
         self._module = module
         self._sharding = sharding
         self._depth = depth
+        # megabatch=K: assemble K host batches into ONE stacked (K, B,
+        # ...) staged transfer (the superstep's input layout) per
+        # next(); a sub-K tail at epoch end is staged as plain per-step
+        # batches for the K=1 fallback path
+        self._megabatch = max(1, int(megabatch))
         self._pending = deque()
         # inner-iterator cursor snapshots aligned 1:1 with _pending, each
         # taken BEFORE its batch was pulled (see state())
@@ -80,10 +133,14 @@ class DevicePrefetchIter:
         self._fill()
         if not self._pending:
             raise StopIteration
-        self._consumed += 1
         if self._pending_states:
             self._pending_states.popleft()
-        return self._pending.popleft()
+        batch = self._pending.popleft()
+        # the checkpoint cursor counts underlying batches: a megabatch
+        # consumes K at once (cursor granularity stays exact because
+        # fit only checkpoints at superstep boundaries)
+        self._consumed += getattr(batch, "megabatch", 1)
+        return batch
 
     # -- checkpoint cursor (mxnet_tpu.checkpoint mid-epoch resume) --------
     def state(self) -> dict:
@@ -149,20 +206,53 @@ class DevicePrefetchIter:
                 return fused.batched_sharding()
         return None
 
+    def _resolve_mega_sharding(self):
+        if self._sharding is not None:
+            # derive the megabatch layout from the explicit PER-BATCH
+            # sharding (leading K axis unsharded, batch spec shifted
+            # right) — reusing it as-is would shard the K axis, and
+            # ignoring it would stage a layout the consumer re-transfers
+            # every superstep
+            from jax.sharding import NamedSharding, PartitionSpec
+            sh = self._sharding
+            if isinstance(sh, NamedSharding):
+                return NamedSharding(sh.mesh, PartitionSpec(None, *sh.spec))
+            return None
+        if self._module is not None:
+            fused = getattr(self._module, "_fused", None)
+            if fused is not None:
+                return fused.megabatched_sharding()
+        return None
+
     def _fill(self):
+        k = self._megabatch
         inner_state = getattr(self._iter, "state", None)
         while not self._exhausted and len(self._pending) < self._depth:
-            pre = inner_state() if callable(inner_state) else None
-            t0 = time.perf_counter()
-            try:
-                batch = self._iter.next()
-            except StopIteration:
-                self._exhausted = True
+            group, pres = [], []
+            while len(group) < k and not self._exhausted:
+                pre = inner_state() if callable(inner_state) else None
+                t0 = time.perf_counter()
+                try:
+                    batch = self._iter.next()
+                except StopIteration:
+                    self._exhausted = True
+                    break
+                self._h2d.add_stall_in(time.perf_counter() - t0)
+                group.append(batch)
+                pres.append(pre)
+            if not group:
                 return
-            self._h2d.add_stall_in(time.perf_counter() - t0)
-            self._pending.append(self._stage(batch))
-            if pre is not None:
-                self._pending_states.append(pre)
+            if k > 1 and len(group) == k:
+                # one pending entry per megabatch; the cursor snapshot is
+                # the position BEFORE its first batch was pulled
+                self._pending.append(self._stage_mega(group))
+                if pres[0] is not None:
+                    self._pending_states.append(pres[0])
+            else:
+                for batch, pre in zip(group, pres):
+                    self._pending.append(self._stage(batch))
+                    if pre is not None:
+                        self._pending_states.append(pre)
 
     def _stage(self, batch):
         import jax
@@ -187,13 +277,35 @@ class DevicePrefetchIter:
                          provide_data=getattr(batch, "provide_data", None),
                          provide_label=getattr(batch, "provide_label", None))
 
+    def _stage_mega(self, group):
+        """Stack K host batches into one (K, B, ...) staged transfer per
+        input — issued async while the CURRENT superstep runs, so the
+        next megabatch's H2D is double-buffered under device compute."""
+        from ..ndarray import NDArray
+        sh = self._resolve_mega_sharding()
+        k = len(group)
+        t0 = time.perf_counter()
 
-def device_feed(data_iter, module=None, sharding=None, depth: int = 2):
+        def put_stack(arrs):
+            return NDArray(stack_batch_arrays(arrs, sh))
+        data = [put_stack([b.data[i] for b in group])
+                for i in range(len(group[0].data or []))]
+        label = [put_stack([b.label[i] for b in group])
+                 for i in range(len(group[0].label or []))]
+        n = data[0].shape[0] * data[0].shape[1] if data else 0
+        self._h2d.add_items(int(n), time.perf_counter() - t0)
+        return MegaBatch(data=data, label=label, k=k)
+
+
+def device_feed(data_iter, module=None, sharding=None, depth: int = 2,
+                megabatch: int = 1):
     """Wrap ``data_iter`` so batches arrive pre-staged on device.
 
     ``module``: resolve the sharding lazily from the module's fused train
     step (call AFTER init_optimizer); ``sharding``: explicit NamedSharding
     override; neither: stage to the default device (still overlaps the
-    transfer — the CPU/plain path)."""
+    transfer — the CPU/plain path).  ``megabatch=K``: assemble stacked
+    K-batch megabatches for the fused superstep (fit(superstep=K) wires
+    this through automatically)."""
     return DevicePrefetchIter(data_iter, sharding=sharding, module=module,
-                              depth=depth)
+                              depth=depth, megabatch=megabatch)

@@ -171,7 +171,7 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
     train_data.reset()
     endless = _rolling_batches(train_data, logger) if epoch_size else None
     for epoch in range(begin_epoch, end_epoch):
-        tic = time.time()
+        tic = time.perf_counter()
         eval_metric.reset()
         source = (itertools.islice(endless, epoch_size) if epoch_size
                   else train_data)
@@ -192,7 +192,8 @@ def _train_multi_device(symbol, ctx, arg_names, param_names, aux_names,
                 cb(bep)
         if not epoch_size:
             train_data.reset()
-        logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+        logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                    time.perf_counter() - tic)
 
         if epoch_end_callback or epoch + 1 == end_epoch:
             pull_params()

@@ -19,6 +19,15 @@ from ..initializer import Uniform
 __all__ = ["BaseModule"]
 
 
+def _fire_callbacks(callbacks, param):
+    """Invoke a single callback or a list of them (the reference's
+    list-or-single dispatch, shared by fit and score)."""
+    if callbacks is None:
+        return
+    for cb in (callbacks if isinstance(callbacks, list) else [callbacks]):
+        cb(param)
+
+
 class BaseModule:
     """Abstract module (reference base_module.py:41)."""
 
@@ -37,29 +46,97 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _eval_outputs_async(self):
+        """Hook for score()'s dispatch/metric overlap: return the last
+        eval forward's outputs with their D2H transfers started async,
+        or None to keep the synchronous per-batch order (the default —
+        Module overrides on the fused path)."""
+        return None
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, reset=True, epoch=0):
-        """Evaluate (reference base_module.py score)."""
+        """Evaluate (reference base_module.py score).
+
+        When the module can start its device->host output copies
+        asynchronously (Module's fused path), the metric update for
+        batch N is deferred until after batch N+1's forward has been
+        dispatched, so eval compute overlaps the transfer + host metric
+        instead of blocking on every batch.  Metric totals and the
+        per-batch callback order are unchanged."""
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
+
+        def fire_callback(nb, loc):
+            # merge the loop's locals in so reference-style callbacks
+            # reading param.locals['eval_batch'] keep working
+            loc = dict(loc or {})
+            loc.setdefault("self", self)
+            loc.setdefault("eval_metric", eval_metric)
+            _fire_callbacks(batch_end_callback,
+                            BatchEndParam(epoch=epoch, nbatch=nb,
+                                          eval_metric=eval_metric,
+                                          locals=loc))
+
+        def snap_labels(labels):
+            # the deferred drain outlives the iterator's next(); an
+            # iterator that refills its label buffers in place (allowed
+            # by the DataIter contract) must not shift the deferred
+            # batch's labels — snapshot them now (labels are tiny; the
+            # big output arrays stay in flight)
+            def snap(x):
+                if x is None:
+                    return None
+                return np.array(x.asnumpy() if hasattr(x, "asnumpy")
+                                else x, copy=True)
+            return [snap(x) for x in (labels or [])]
+
+        pending = None   # (label snapshot, outputs-in-flight, nbatch, locals)
+
+        def drain(p):
+            labels, outs, nb, loc = p
+            eval_metric.update(labels, outs)
+            fire_callback(nb, loc)
+
+        # a callback that reads module outputs (inspects_outputs=True,
+        # the same contract fit() honors) must run while ITS batch's
+        # outputs are still current — deferral would hand it the next
+        # batch's forward
+        cbs = batch_end_callback if isinstance(batch_end_callback, list) \
+            else ([batch_end_callback] if batch_end_callback else [])
+        defer_ok = not any(getattr(cb, "inspects_outputs", False)
+                           for cb in cbs)
+
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
             self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                if isinstance(batch_end_callback, list):
-                    for callback in batch_end_callback:
-                        callback(batch_end_params)
-                else:
-                    batch_end_callback(batch_end_params)
+            outs = self._eval_outputs_async() if defer_ok else None
+            if outs is None:
+                # synchronous path (classic exec group, worker-local
+                # multi-process eval): drain any deferred batch first so
+                # callback order stays monotone
+                if pending is not None:
+                    drain(pending)
+                    pending = None
+                self.update_metric(eval_metric, eval_batch.label)
+                fire_callback(nbatch, locals())
+            else:
+                if pending is not None:
+                    drain(pending)
+                # drop the 'pending' binding from the captured locals:
+                # it still references the PREVIOUS deferred tuple, and
+                # keeping it would chain every batch's outputs/inputs
+                # alive until score() returns (O(batches) device memory)
+                loc = dict(locals())
+                loc.pop("pending", None)
+                pending = (snap_labels(eval_batch.label), outs, nbatch,
+                           loc)
+        if pending is not None:
+            drain(pending)
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
@@ -113,13 +190,30 @@ class BaseModule:
             force_rebind=False, force_init=False, begin_epoch=0,
             num_epoch=None, validation_metric=None, monitor=None,
             work_load_list=None, prefetch_to_device=False,
-            checkpoint=None, checkpoint_every=None, resume=False):
+            checkpoint=None, checkpoint_every=None, resume=False,
+            superstep=None):
         """Train (reference base_module.py:273-393).
 
         ``prefetch_to_device``: wrap ``train_data`` with the feed
         subsystem's device prefetcher (mxnet_tpu.feed) so batch N+1's
         H2D transfer is issued while batch N trains; pass an int to set
         the lookahead depth (True = 2).
+
+        ``superstep``: run K training batches per XLA dispatch (the
+        fused step body under ``lax.scan``), with metric accumulation on
+        device and ONE scalar drain per K steps — the dispatch-bound
+        regime's biggest lever.  Defaults to the ``MXNET_SUPERSTEP`` env
+        var (1 = off).  Semantics are preserved exactly (superstep K is
+        bitwise-identical to K sequential fused steps); anything needing
+        per-step host visibility — a monitor, a metric without a device
+        form, ``checkpoint_every`` not a multiple of K, a batch-end
+        callback marked ``inspects_outputs=True`` — falls back to K=1
+        automatically (logged), as does a partial final megabatch.
+        Batch-end callbacks fire once per superstep, with ``nbatch``
+        pointing at the last batch of the K and ``param.locals``
+        carrying the megabatch ``group`` rather than a per-batch
+        ``data_batch``; a callback that needs per-batch locals or
+        outputs should declare ``inspects_outputs = True``.
 
         ``checkpoint``: a ``mx.checkpoint.CheckpointManager`` (or a
         directory path, wrapped in one with defaults) for crash-safe
@@ -133,6 +227,7 @@ class BaseModule:
         already-trained batches.  If SIGTERM arrives (the manager's
         ``install_preemption_handler``), the loop snapshots at the next
         batch boundary and returns."""
+        import os
         assert num_epoch is not None, "please specify number of epochs"
         if optimizer_params is None:
             optimizer_params = (("learning_rate", 0.01),)
@@ -147,13 +242,6 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
-
-        if prefetch_to_device and hasattr(self, "prefetch_to_device"):
-            # wrap AFTER init_optimizer so the fused step's batch sharding
-            # exists and staged batches land directly in its input layout
-            depth = 2 if prefetch_to_device is True \
-                else max(1, int(prefetch_to_device))
-            train_data = self.prefetch_to_device(train_data, depth=depth)
 
         ckpt_mgr = None
         if checkpoint is None and resume:
@@ -173,6 +261,43 @@ class BaseModule:
             # caller's decision to train again
             ckpt_mgr.preempted = False
 
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+
+        # superstep resolution: K from the argument or the env knob,
+        # then every semantic blocker gets a logged fallback to K=1
+        k_super = int(superstep) if superstep is not None \
+            else int(os.environ.get("MXNET_SUPERSTEP", "1") or "1")
+        k_super = max(1, k_super)
+        use_super = k_super > 1 and callable(
+            getattr(self, "superstep_train", None))
+        if k_super > 1 and not use_super:
+            self.logger.info("superstep disabled (K=%d -> 1): module has "
+                             "no fused superstep support", k_super)
+        if use_super:
+            blocker = self._superstep_blockers(
+                eval_metric, k_super, monitor=monitor,
+                batch_end_callback=batch_end_callback,
+                checkpoint_every=(ckpt_mgr.save_every_steps
+                                  if ckpt_mgr is not None else None))
+            if blocker is not None:
+                self.logger.info("superstep disabled (K=%d -> 1): %s",
+                                 k_super, blocker)
+                use_super = False
+
+        if prefetch_to_device and hasattr(self, "prefetch_to_device"):
+            # wrap AFTER init_optimizer so the fused step's batch sharding
+            # exists and staged batches land directly in its input layout;
+            # in superstep mode the prefetcher assembles whole megabatches
+            # (stacked K axis) under the running superstep
+            depth = 2 if prefetch_to_device is True \
+                else max(1, int(prefetch_to_device))
+            train_data = self.prefetch_to_device(
+                train_data, depth=depth,
+                megabatch=k_super if use_super else 1)
+
         global_step = 0
         start_epoch, start_batch = begin_epoch, 0
         if ckpt_mgr is not None and resume:
@@ -186,13 +311,23 @@ class BaseModule:
                         callable(getattr(train_data, "restore", None)):
                     train_data.restore(feed_state)
                 elif start_batch:
-                    # generic DataIter: fast-forward by discarding the
-                    # already-trained batches of the resumed epoch
-                    for _ in range(start_batch):
-                        try:
-                            train_data.next()
-                        except StopIteration:
-                            break
+                    if callable(getattr(train_data, "restore", None)):
+                        # a cursor-less checkpoint resumed into a feed
+                        # wrapper (e.g. prefetch added after the save):
+                        # its restore() skips UNDERLYING batches exactly,
+                        # where next() would pop whole megabatches
+                        train_data.restore({"batch": start_batch})
+                    else:
+                        # generic DataIter: fast-forward by discarding
+                        # the already-trained batches (counting the
+                        # batches a megabatch carries)
+                        skipped = 0
+                        while skipped < start_batch:
+                            try:
+                                b = train_data.next()
+                            except StopIteration:
+                                break
+                            skipped += getattr(b, "megabatch", 1)
                 self.logger.info(
                     "resumed from checkpoint step %d: epoch %d, batch %d",
                     global_step, start_epoch, start_batch)
@@ -208,34 +343,43 @@ class BaseModule:
                         blocking=blocking)
             last_saved_step[0] = global_step
 
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
-
         for epoch in range(start_epoch, num_epoch):
-            tic = time.time()
+            tic = time.perf_counter()
             eval_metric.reset()
             nbatch = start_batch if epoch == start_epoch else 0
-            for data_batch in train_data:
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    if isinstance(batch_end_callback, list):
-                        for callback in batch_end_callback:
-                            callback(batch_end_params)
-                    else:
-                        batch_end_callback(batch_end_params)
-                nbatch += 1
-                global_step += 1
+            preempted = False
+
+            def fire_batch_end(nb, loc=None):
+                # merge the call site's locals: per-batch sites expose
+                # 'data_batch' like the reference loop did; the
+                # superstep site fires once per K and exposes the whole
+                # 'group' instead (a callback needing per-batch locals
+                # should declare inspects_outputs=True, which forces
+                # K=1)
+                loc = dict(loc or {})
+                loc.setdefault("self", self)
+                loc.setdefault("epoch", epoch)
+                loc.setdefault("nbatch", nb)
+                loc.setdefault("eval_metric", eval_metric)
+                _fire_callbacks(batch_end_callback,
+                                BatchEndParam(epoch=epoch, nbatch=nb,
+                                              eval_metric=eval_metric,
+                                              locals=loc))
+
+            def advance(count, allow_ckpt=True, ckpt_from=None):
+                """Bookkeeping after ``count`` trained batches: counters
+                + checkpoint cadence.  True => leave fit (preemption).
+                ``allow_ckpt=False`` suppresses saves at an unsafe point
+                (mid-way through an unstacked megabatch, where the feed
+                cursor already counted the whole group); ``ckpt_from``
+                re-bases the save-crossing check to the group's first
+                step so a suppressed crossing still saves at its end."""
+                nonlocal nbatch, global_step, preempted
+                prev_step = global_step if ckpt_from is None else ckpt_from
+                nbatch += count
+                global_step += count
+                if not allow_ckpt:
+                    return False
                 if ckpt_mgr is not None:
                     if ckpt_mgr.preempted:
                         # SIGTERM: snapshot at this safe batch boundary,
@@ -246,13 +390,106 @@ class BaseModule:
                             "preempted: checkpoint committed at step %d "
                             "(epoch %d, batch %d); exiting fit",
                             global_step, epoch, nbatch)
-                        return
-                    if ckpt_mgr.should_save(global_step):
+                        preempted = True
+                        return True
+                    # save when (prev_step, global_step] crosses a
+                    # save_every multiple — for count=1 that is exactly
+                    # should_save(); for a K-step jump it keeps the
+                    # cadence alive even after a partial tail or a
+                    # resume leaves global_step off the K-aligned
+                    # residue class (a bare `step % every == 0` would
+                    # then never fire again)
+                    every = ckpt_mgr.save_every_steps
+                    if every and global_step // every > prev_step // every:
                         ckpt_save(epoch, nbatch)
+                return False
+
+            def train_one(data_batch, allow_ckpt=True, ckpt_from=None):
+                """The reference per-batch body (the K=1 path)."""
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
+                if monitor is not None:
+                    monitor.toc_print()
+                fire_batch_end(nbatch, locals())
+                return advance(1, allow_ckpt=allow_ckpt,
+                               ckpt_from=ckpt_from)
+
+            if use_super:
+                # pull K batches (or one prefetch-assembled megabatch)
+                # per iteration and run them as ONE dispatch; a partial
+                # tail or a mid-training fallback (hparams mutated,
+                # fusion disabled) trains per-batch instead
+                data_iter = iter(train_data)
+                while not preempted:
+                    mega, pulled = None, []
+                    while len(pulled) < k_super:
+                        try:
+                            b = next(data_iter)
+                        except StopIteration:
+                            break
+                        if getattr(b, "megabatch", 0) > 1:
+                            mega = b
+                            break
+                        pulled.append(b)
+                    if mega is None and not pulled:
+                        break
+                    if pulled and (mega is not None
+                                   or len(pulled) < k_super):
+                        # plain batches that cannot form a full K — the
+                        # epoch tail, or stragglers ahead of an arriving
+                        # megabatch: train them per-batch, never drop.
+                        # They were all pulled from the iterator up
+                        # front, so a feed cursor already counts them —
+                        # defer saves to the group's end like the
+                        # unstacked-fallback below.
+                        start_step = global_step
+                        for i, b in enumerate(pulled):
+                            last = i == len(pulled) - 1
+                            if train_one(b, allow_ckpt=last,
+                                         ckpt_from=(start_step if last
+                                                    else None)):
+                                return
+                        pulled = []
+                    group = mega if mega is not None else pulled
+                    if not group:
+                        continue
+                    count = mega.megabatch if mega is not None \
+                        else len(pulled)
+                    if self.superstep_train(group, eval_metric):
+                        fire_batch_end(nbatch + count - 1, locals())
+                        if advance(count):
+                            return
+                    else:
+                        # superstep refused (fused path gone / hparams
+                        # changed): K=1 fallback.  For an unstacked
+                        # megabatch the feed cursor already counted ALL
+                        # K batches, so a save fired mid-group would
+                        # resume past never-trained data — defer
+                        # preemption/save checks to the group's end (an
+                        # exact boundary again), re-basing the crossing
+                        # test so no save_every multiple is skipped.
+                        singles = mega.unstack() if mega is not None \
+                            else pulled
+                        start_step = global_step
+                        for i, b in enumerate(singles):
+                            last = i == len(singles) - 1
+                            if train_one(b, allow_ckpt=last,
+                                         ckpt_from=(start_step if last
+                                                    else None)):
+                                return
+            else:
+                for data_batch in train_data:
+                    if train_one(data_batch):
+                        return
+            if preempted:
+                return
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
+            toc = time.perf_counter()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
 
             if epoch_end_callback is not None:

@@ -160,6 +160,14 @@ class FusedTrainStep:
         donated step program compiled for."""
         return self._batched()
 
+    def megabatched_sharding(self):
+        """Sharding for a K-step megabatch: leading K axis unsharded
+        (the scan iterates it), batch axis sharded over dp — the layout
+        the superstep program compiles for.  feed.DevicePrefetchIter's
+        megabatch mode stages with this so make_megabatch passes the
+        resident arrays through without a second transfer."""
+        return NamedSharding(self.mesh, P(None, "dp"))
+
     def _multiprocess(self):
         return self.global_dp and jax.process_count() > 1
 
@@ -285,6 +293,58 @@ class FusedTrainStep:
                         jnp.zeros(shape, jnp.float32), sh)
         return out
 
+    def make_megabatch(self, batches):
+        """Assemble a K-step megabatch: ``{name: (K, B, ...) array}`` in
+        the megabatched sharding.  ``batches`` is either a pre-staged
+        object with a ``megabatch`` attribute and stacked ``data``/
+        ``label`` lists (feed.MegaBatch — resident arrays already in the
+        right sharding pass through untouched) or a list of K DataBatch,
+        stacked on host and shipped in ONE device_put per input.
+        Returns ``(k, megabatch_dict)``."""
+        if self._multiprocess():
+            raise MXNetError("superstep megabatches are single-process "
+                             "only (dist training keeps per-step dispatch)")
+        sh = self.megabatched_sharding()
+
+        def put(arr):
+            a = arr._get() if isinstance(arr, NDArray) else arr
+            if getattr(a, "sharding", None) == sh:
+                return a
+            return jax.device_put(np.asarray(a), sh)
+
+        if hasattr(batches, "megabatch"):
+            k = int(batches.megabatch)
+            out = {}
+            for name, arr in zip(self.data_names, batches.data):
+                out[name] = put(arr)
+            labels = batches.label or []
+            for i, name in enumerate(self.label_names):
+                if i >= len(labels) or labels[i] is None:
+                    raise MXNetError("superstep training needs label %r"
+                                     % name)
+                out[name] = put(labels[i])
+            return k, out
+
+        k = len(batches)
+        from ..feed.staging import stack_batch_arrays
+
+        def stack(arrs):
+            return stack_batch_arrays(arrs, sh)
+
+        out = {}
+        for i, name in enumerate(self.data_names):
+            out[name] = stack([b.data[i] for b in batches])
+        for i, name in enumerate(self.label_names):
+            col = []
+            for b in batches:
+                lab = b.label[i] if b.label and i < len(b.label) else None
+                if lab is None:
+                    raise MXNetError("superstep training needs label %r"
+                                     % name)
+                col.append(lab)
+            out[name] = stack(col)
+        return k, out
+
     def host_outputs(self, outs, batch) -> List[NDArray]:
         """Wrap program outputs for host-side consumers (update_metric,
         get_outputs).  Single-process arrays wrap as-is; multi-process
@@ -320,7 +380,12 @@ class FusedTrainStep:
         return P("dp") if (o.ndim >= 1 and o.shape[0] == rows) else P()
 
     # -- compiled programs ---------------------------------------------------
-    def _build_step(self):
+    def _make_step_fn(self):
+        """The ONE batch-body trace: fwd+bwd+reduce+update as a pure
+        function of (state, batch, lr, base_key).  _build_step jits it
+        directly; build_superstep runs it K times under jax.lax.scan —
+        sharing the trace is what makes superstep K bitwise-identical to
+        K sequential fused steps."""
         prog = self._prog
         rescale = self.optimizer.rescale_grad
         clip = self.optimizer.clip_gradient
@@ -379,7 +444,10 @@ class FusedTrainStep:
             return ({"params": new_params, "opt": new_opt,
                      "aux": merged_aux, "fixed": fixed, "t": t}, outs)
 
-        self._step = jax.jit(step, donate_argnums=(0,))
+        return step
+
+    def _build_step(self):
+        self._step = jax.jit(self._make_step_fn(), donate_argnums=(0,))
         return self._step
 
     def _build_fwd(self):
@@ -395,6 +463,41 @@ class FusedTrainStep:
 
         self._fwd = jax.jit(fwd, static_argnums=(3,))
         return self._fwd
+
+    def build_superstep(self, k, metric_update=None):
+        """ONE donated XLA program executing K fused steps: the step body
+        from _make_step_fn traced under ``jax.lax.scan`` over the
+        megabatch's leading K axis, with zero host involvement between
+        steps.  ``metric_update(acc, labels, preds)`` (a traced reducer
+        from EvalMetric.device_reducer) rides in the scan carry, so the
+        caller drains one tiny scalar pytree every K steps instead of
+        full output arrays every step.  Per-step learning rates arrive
+        as a K-vector (the host resolves the scheduler at each step
+        position, exactly as K sequential update() calls would).
+
+        Returns ``superstep(state, megabatch, lrs, base_key, acc) ->
+        (new_state, acc)``, jitted with the state donated.  Because the
+        scan body IS the sequential step's trace (same in-program step
+        counter, same per-step RNG fold), superstep K is bitwise-
+        identical to K sequential fused steps."""
+        step_fn = self._make_step_fn()
+        label_names = self.label_names
+
+        def superstep(state, megabatch, lrs, base_key, acc):
+            def body(carry, xs):
+                st, a = carry
+                batch, lr = xs
+                st, outs = step_fn(st, batch, lr, base_key)
+                if metric_update is not None:
+                    labels = [batch[n] for n in label_names]
+                    a = metric_update(a, labels, list(outs))
+                return (st, a), None
+
+            (state, acc), _ = jax.lax.scan(body, (state, acc),
+                                           (megabatch, lrs), length=k)
+            return state, acc
+
+        return jax.jit(superstep, donate_argnums=(0,))
 
     def step(self, state, batch, base_key):
         """Advance one batch; returns (new_state, outputs)."""

@@ -67,6 +67,10 @@ class Module(BaseModule):
         # fused fast path (see fused.py): engaged by init_optimizer when
         # the configuration allows one donated XLA program per batch
         self._fused = None
+        # superstep (K fused steps per dispatch): compiled programs keyed
+        # by (K, metric signature), plus the profiler counters
+        self._superstep_progs = {}
+        self._superstep_stats = None
         self._fused_state = None
         self._fused_pending = None
         self._fused_outputs = None
@@ -387,6 +391,7 @@ class Module(BaseModule):
         self._fused_state = None
         self._fused_pending = None
         self._fused_outputs = None
+        self._superstep_progs = {}
         self._discard_speculation()
         if not self._fusable():
             return
@@ -475,6 +480,7 @@ class Module(BaseModule):
         self._fused_pending = None
         self._fused_outputs = None
         self._fused_next = None
+        self._superstep_progs = {}
         if pend is not None:
             # an uncommitted batch (forward recorded, update not yet run):
             # replay it through the exec group so the caller's next
@@ -569,16 +575,145 @@ class Module(BaseModule):
             outs, self._fused_pending)
         self._fused_next = (new_state, self._fused_outputs)
 
-    def prefetch_to_device(self, data_iter, depth=2):
+    def prefetch_to_device(self, data_iter, depth=2, megabatch=1):
         """Wrap ``data_iter`` so each batch's H2D transfer is issued
         ``depth`` steps ahead of consumption (mxnet_tpu.feed staging).
         With the fused train step engaged, batches land directly in its
         batch sharding and make_batch passes them through untouched; on
         the classic (or CPU) path this degrades to plain lookahead
-        overlap.  Call after init_optimizer; fit(prefetch_to_device=True)
-        does this automatically."""
+        overlap.  ``megabatch=K`` assembles K-batch megabatches (stacked
+        leading axis, superstep input layout) instead, double-buffering
+        the next megabatch's H2D under the current superstep.  Call
+        after init_optimizer; fit(prefetch_to_device=True) does this
+        automatically."""
         from .. import feed as _feed
-        return _feed.device_feed(data_iter, module=self, depth=depth)
+        return _feed.device_feed(data_iter, module=self, depth=depth,
+                                 megabatch=megabatch)
+
+    # -- superstep: K fused steps per dispatch -------------------------------
+    def _superstep_blockers(self, eval_metric, k, monitor=None,
+                            batch_end_callback=None, checkpoint_every=None):
+        """Why superstep K must fall back to per-step dispatch, or None
+        when K steps per program is semantically safe.  Anything that
+        needs per-step host visibility blocks it."""
+        if self._fused is None or not self.optimizer_initialized:
+            return "fused train step not engaged"
+        if monitor is not None or self._monitor_installed:
+            return "monitor attached (needs per-step host visibility)"
+        if self._fused._multiprocess():
+            return "multi-process training keeps per-step dispatch"
+        if eval_metric is not None and \
+                getattr(eval_metric, "device_reducer", lambda: None)() is None:
+            return "metric %r has no device form" % getattr(
+                eval_metric, "name", eval_metric)
+        if checkpoint_every and checkpoint_every % k != 0:
+            return ("checkpoint_every=%d is not a multiple of K=%d"
+                    % (checkpoint_every, k))
+        cbs = batch_end_callback if isinstance(batch_end_callback, list) \
+            else ([batch_end_callback] if batch_end_callback else [])
+        for cb in cbs:
+            if getattr(cb, "inspects_outputs", False):
+                return "batch-end callback %r inspects per-step outputs" % cb
+        return None
+
+    def superstep_train(self, batches, eval_metric=None):
+        """Advance K training batches in ONE donated XLA dispatch
+        (fused.build_superstep): forward+backward+reduce+update K times
+        under lax.scan, metric sums accumulated on device and drained as
+        one scalar pytree at the end.  ``batches`` is a list of K
+        DataBatch or a pre-staged feed.MegaBatch (K is taken from it).
+
+        Returns True when the superstep dispatched; False when the
+        caller must fall back to per-batch processing of these batches
+        (fused path gone, or optimizer hyperparameters mutated since the
+        program was compiled — the per-batch path resolves both)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        if self._fused is None:
+            return False
+        if self._fused_pending is not None:
+            # a recorded-but-uncommitted training forward is a real batch,
+            # not a stale artifact: silently dropping it would lose its
+            # update (every other path commits or replays it)
+            raise MXNetError(
+                "superstep_train with an uncommitted forward pending; "
+                "call update() to commit it first")
+        if self._fused.hparam_signature() != self._fused_hsig:
+            return False
+        import time as _time
+        import jax
+        import numpy as _np
+        self._fused_ensure_state()
+        reducer = eval_metric.device_reducer() if eval_metric is not None \
+            else None
+        if eval_metric is not None and reducer is None:
+            return False
+
+        if self._superstep_stats is None:
+            from .. import profiler as _prof
+            self._superstep_stats = _prof.SuperstepStats()
+            _prof.register_superstep_stats(self._superstep_stats)
+        stats = self._superstep_stats
+
+        t0 = _time.perf_counter()
+        k, mega = self._fused.make_megabatch(batches)
+        h2d_s = _time.perf_counter() - t0
+
+        sig = (k, reducer.signature if reducer is not None else None)
+        prog = self._superstep_progs.get(sig)
+        if prog is None:
+            prog = self._fused.build_superstep(
+                k, reducer.update if reducer is not None else None)
+            self._superstep_progs[sig] = prog
+
+        # per-step lr exactly as K sequential update() calls resolve it:
+        # bump the step counter, let the scheduler see each position.
+        # The counters (and scheduler state) advance BEFORE the program
+        # runs — roll them back if the dispatch (first-call trace /
+        # compile included) fails, or a caller that catches and falls
+        # back per-batch would train K steps ahead of the device state.
+        prev_t = self._fused_t
+        prev_num_update = self._optimizer.num_update
+        sched = getattr(self._optimizer, "lr_scheduler", None)
+        sched_state = sched.state_dict() if sched is not None else None
+        try:
+            lrs = []
+            for _ in range(k):
+                self._fused_t += 1
+                self._optimizer.num_update = max(
+                    self._optimizer.num_update, self._fused_t)
+                lrs.append(float(self._optimizer.base_lr()))
+            rep = self._fused._replicated()
+            lrs = jax.device_put(_np.asarray(lrs, _np.float32), rep)
+            acc0 = () if reducer is None else jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, rep), reducer.init())
+
+            # stale per-batch artifacts cannot survive a K-step jump (no
+            # pending forward exists here — guarded at entry)
+            self._fused_outputs = None
+            self._fused_eval_local = False
+            self._discard_speculation()
+
+            t1 = _time.perf_counter()
+            self._fused_state, acc = prog(self._fused_state, mega, lrs,
+                                          self._fused_key, acc0)
+            dispatch_s = _time.perf_counter() - t1
+        except Exception:
+            self._fused_t = prev_t
+            self._optimizer.num_update = prev_num_update
+            if sched is not None:
+                sched.load_state_dict(sched_state)
+            raise
+        self._params_dirty = True
+
+        wait_s = 0.0
+        if reducer is not None:
+            t2 = _time.perf_counter()
+            host_acc = jax.tree_util.tree_map(lambda a: _np.asarray(a), acc)
+            wait_s = _time.perf_counter() - t2
+            reducer.absorb(host_acc)
+        stats.add(k, h2d_s, dispatch_s, wait_s)
+        return True
 
     def borrow_optimizer(self, shared_module):
         assert shared_module.optimizer_initialized
@@ -761,6 +896,26 @@ class Module(BaseModule):
             eval_metric.update(labels, self.get_outputs())
             return
         self._exec_group.update_metric(eval_metric, labels)
+
+    def _eval_outputs_async(self):
+        """score()'s overlap hook: the last eval forward's outputs with
+        their device->host copies STARTED but not awaited, so the next
+        batch's dispatch runs under the transfer and the metric update
+        (which blocks) happens a batch later.  None on the classic /
+        worker-local paths — those keep the synchronous order."""
+        if self._fused is None or self._fused_eval_local or \
+                self._fused_outputs is None:
+            return None
+        outs = list(self._fused_outputs)
+        for o in outs:
+            a = o._get()
+            start = getattr(a, "copy_to_host_async", None)
+            if callable(start):
+                try:
+                    start()
+                except Exception:
+                    pass
+        return outs
 
     def install_monitor(self, mon):
         assert self.binded

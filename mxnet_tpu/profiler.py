@@ -25,7 +25,9 @@ import weakref
 __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "dump_profile", "state", "register_feed_stats", "feed_report",
            "feed_report_str", "register_checkpoint_stats",
-           "checkpoint_report", "checkpoint_report_str"]
+           "checkpoint_report", "checkpoint_report_str", "SuperstepStats",
+           "register_superstep_stats", "superstep_report",
+           "superstep_report_str"]
 
 _config = {"filename": "profile_output", "mode": "symbolic"}
 _state = "stop"
@@ -92,7 +94,104 @@ def feed_report() -> dict:
 def feed_report_str() -> str:
     """Human-readable per-stage table for every live feed pipeline."""
     parts = [ps.report_str() for _, ps in sorted(_feed_stats.items())]
-    return "\n\n".join(parts) if parts else "(no live feed pipelines)"
+    out = "\n\n".join(parts) if parts else "(no live feed pipelines)"
+    if _superstep_stats:
+        # the chip-side half of the same story: whether the loop is
+        # dispatch-bound or compute-bound lives in superstep_report()
+        out += ("\n\n(superstep dispatch/wait/stage split: see "
+                "mx.profiler.superstep_report_str())")
+    return out
+
+
+# -- superstep instrumentation (module/fused.py build_superstep) -------------
+# One SuperstepStats per training Module running fit(superstep=K),
+# registered weakly like the feed pipelines.  The counters split the host
+# side of every superstep into the three places time can go, so
+# "dispatch-bound vs compute-bound" is measured rather than inferred:
+#
+#   h2d_stage_s     megabatch assembly + the device_put issue time
+#   step_dispatch_s enqueueing the K-step program (host->XLA dispatch;
+#                   on an async backend this returns before compute ends)
+#   device_wait_s   blocking on the drained metric accumulators — i.e.
+#                   actual device compute the host had to wait out
+_superstep_stats = weakref.WeakValueDictionary()
+_superstep_seq = 0
+
+
+class SuperstepStats:
+    """Counters for the K-steps-per-dispatch training loop.  Cumulative
+    totals plus ``window()`` deltas (per-window counters for bench
+    loops: call once per measurement window and diff)."""
+
+    def __init__(self, name: str = "superstep"):
+        self.name = name
+        self.supersteps = 0
+        self.steps = 0
+        self.h2d_stage_s = 0.0
+        self.step_dispatch_s = 0.0
+        self.device_wait_s = 0.0
+        self._window_base = self._totals()
+
+    def _totals(self) -> dict:
+        return {"supersteps": self.supersteps, "steps": self.steps,
+                "h2d_stage_s": self.h2d_stage_s,
+                "step_dispatch_s": self.step_dispatch_s,
+                "device_wait_s": self.device_wait_s}
+
+    def add(self, steps: int, h2d_s: float, dispatch_s: float,
+            wait_s: float) -> None:
+        self.supersteps += 1
+        self.steps += int(steps)
+        self.h2d_stage_s += h2d_s
+        self.step_dispatch_s += dispatch_s
+        self.device_wait_s += wait_s
+
+    def window(self) -> dict:
+        """Counters accumulated since the previous window() call."""
+        now = self._totals()
+        delta = {k: now[k] - self._window_base[k] for k in now}
+        self._window_base = now
+        return delta
+
+    def report(self) -> dict:
+        out = self._totals()
+        if self.steps:
+            out["host_s_per_step"] = (
+                self.h2d_stage_s + self.step_dispatch_s
+                + self.device_wait_s) / self.steps
+        return out
+
+    def report_str(self) -> str:
+        r = self.report()
+        lines = ["%s: %d supersteps / %d steps" % (self.name,
+                                                   r["supersteps"],
+                                                   r["steps"])]
+        for key in ("h2d_stage_s", "step_dispatch_s", "device_wait_s"):
+            lines.append("  %-16s %10.4f" % (key, r[key]))
+        if "host_s_per_step" in r:
+            lines.append("  %-16s %10.6f" % ("host_s/step",
+                                             r["host_s_per_step"]))
+        return "\n".join(lines)
+
+
+def register_superstep_stats(superstep_stats) -> None:
+    """Called by Module.superstep_train on first dispatch."""
+    global _superstep_seq
+    _superstep_seq += 1
+    _superstep_stats["%s#%06d" % (superstep_stats.name, _superstep_seq)] = \
+        superstep_stats
+
+
+def superstep_report() -> dict:
+    """{key: counters} for every live superstep-training module; the
+    feed-side view of the same loop is feed_report()."""
+    return {key: ss.report() for key, ss in sorted(_superstep_stats.items())}
+
+
+def superstep_report_str() -> str:
+    """Human-readable dispatch/wait/stage split per training loop."""
+    parts = [ss.report_str() for _, ss in sorted(_superstep_stats.items())]
+    return "\n\n".join(parts) if parts else "(no live superstep loops)"
 
 
 # -- checkpoint instrumentation (mxnet_tpu.checkpoint) ----------------------

@@ -1,0 +1,493 @@
+"""Superstep training (fused.build_superstep + Module.superstep_train +
+fit(superstep=K)): K fused steps per XLA dispatch must be BITWISE-
+identical to K sequential fused steps — params, optimizer slots, RNG,
+and metric values — with on-device metric accumulation, megabatch
+staging through the feed prefetcher, exact checkpoint resume through a
+superstep boundary, and automatic K=1 fallback whenever semantics need
+per-step host visibility."""
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ck
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=8,
+                                                name="fc1"),
+                          act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=3,
+                                                      name="fc2"),
+                                name="softmax")
+
+
+def _data(n=64, batch=16):
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 6).astype(np.float32)
+    y = rng.randint(0, 3, n).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch)
+
+
+def _fit(superstep, n=64, num_epoch=2, metric="acc", sched=None,
+         prefetch=False, optimizer="sgd", monitor=None, **opt_params):
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    met = mx.metric.create(metric)
+    opt_params.setdefault("learning_rate", 0.5)
+    if sched is not None:
+        opt_params["lr_scheduler"] = sched()
+    mod.fit(_data(n), num_epoch=num_epoch, eval_metric=met,
+            optimizer=optimizer, optimizer_params=opt_params,
+            superstep=superstep, prefetch_to_device=prefetch,
+            monitor=monitor)
+    return mod, met
+
+
+def _leaves(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_leaves(v, prefix + "/" + str(k)))
+    elif isinstance(tree, (tuple, list)):
+        for i, v in enumerate(tree):
+            out.update(_leaves(v, prefix + "/%d" % i))
+    elif tree is not None:
+        out[prefix] = np.asarray(tree)
+    return out
+
+
+def _assert_bitwise(mod_a, mod_b):
+    pa = {k: v.asnumpy() for k, v in mod_a.get_params()[0].items()}
+    pb = {k: v.asnumpy() for k, v in mod_b.get_params()[0].items()}
+    for k in pa:
+        assert np.array_equal(pa[k], pb[k]), "param %s diverged" % k
+    oa = _leaves(mod_a._fused_state["opt"])
+    ob = _leaves(mod_b._fused_state["opt"])
+    assert set(oa) == set(ob)
+    for k in oa:
+        assert np.array_equal(oa[k], ob[k]), "opt slot %s diverged" % k
+    assert mod_a._fused_t == mod_b._fused_t
+    assert np.array_equal(mx.random.key_data_of(mod_a._fused_key),
+                          mx.random.key_data_of(mod_b._fused_key))
+    assert int(np.asarray(mod_a._fused_state["t"])) == \
+        int(np.asarray(mod_b._fused_state["t"]))
+
+
+# -- the acceptance criterion: bitwise parity --------------------------------
+
+def test_superstep4_bitwise_matches_sequential():
+    """superstep=4 vs 4 sequential fused steps: params, optimizer
+    slots, RNG, and metric values all bitwise-identical."""
+    m1, met1 = _fit(1, optimizer="sgd", momentum=0.9)
+    m4, met4 = _fit(4, optimizer="sgd", momentum=0.9)
+    assert m4._fused is not None and m4._superstep_progs
+    _assert_bitwise(m1, m4)
+    assert met1.sum_metric == met4.sum_metric
+    assert met1.num_inst == met4.num_inst
+    assert met1.get() == met4.get()
+
+
+def test_superstep_adam_bitwise():
+    m1, _ = _fit(1, optimizer="adam", learning_rate=0.01)
+    m4, _ = _fit(4, optimizer="adam", learning_rate=0.01)
+    _assert_bitwise(m1, m4)
+
+
+def test_superstep_lr_scheduler_parity():
+    """Per-step lr positions inside the megabatch must match what K
+    sequential update() calls would resolve (scheduler fires mid-scan)."""
+    def sched():
+        return mx.lr_scheduler.FactorScheduler(step=3, factor=0.5)
+    m1, _ = _fit(1, sched=sched, momentum=0.9)
+    m4, _ = _fit(4, sched=sched, momentum=0.9)
+    _assert_bitwise(m1, m4)
+
+
+def test_superstep_partial_tail_trains_every_batch():
+    """5 batches/epoch with K=4: one superstep + one per-batch tail, and
+    the trajectory still bitwise-matches the sequential run."""
+    m1, met1 = _fit(1, n=80, momentum=0.9)
+    m4, met4 = _fit(4, n=80, momentum=0.9)
+    _assert_bitwise(m1, m4)
+    assert met4.num_inst == 80            # nothing skipped in epoch 2
+    assert met1.get() == met4.get()
+
+
+def test_superstep_env_knob(monkeypatch):
+    monkeypatch.setenv("MXNET_SUPERSTEP", "4")
+    m_env, _ = _fit(None, momentum=0.9)
+    monkeypatch.delenv("MXNET_SUPERSTEP")
+    m1, _ = _fit(1, momentum=0.9)
+    assert m_env._superstep_progs          # the env knob engaged
+    _assert_bitwise(m1, m_env)
+
+
+# -- feed megabatch staging --------------------------------------------------
+
+def test_prefetch_megabatch_parity():
+    m1, met1 = _fit(1, n=80, prefetch=True, momentum=0.9)
+    m4, met4 = _fit(4, n=80, prefetch=True, momentum=0.9)
+    _assert_bitwise(m1, m4)
+    assert met1.get() == met4.get()
+
+
+def test_device_prefetch_iter_megabatch_assembly():
+    from mxnet_tpu.feed import DevicePrefetchIter, MegaBatch
+    it = DevicePrefetchIter(_data(n=80, batch=16), megabatch=4)
+    first = it.next()
+    assert isinstance(first, MegaBatch) and first.megabatch == 4
+    assert first.data[0].shape == (4, 16, 6)
+    assert first.label[0].shape == (4, 16)
+    # unstack recovers per-step batches (the K=1 fallback path)
+    singles = first.unstack()
+    assert len(singles) == 4 and singles[0].data[0].shape == (16, 6)
+    # 5 batches/epoch: one full megabatch, then a 1-batch tail staged
+    # as a plain DataBatch
+    tail = it.next()
+    assert getattr(tail, "megabatch", 1) == 1
+    assert tail.data[0].shape == (16, 6)
+    with pytest.raises(StopIteration):
+        it.next()
+
+
+def test_device_prefetch_iter_megabatch_cursor():
+    """state()/restore() count UNDERLYING batches, so a cursor saved at
+    a superstep boundary restores to the exact next megabatch."""
+    from mxnet_tpu.feed import DevicePrefetchIter
+    it = DevicePrefetchIter(_data(n=160, batch=16), megabatch=4)
+    first = it.next()
+    st = it.state()
+    assert st["batch"] == 4
+    second = it.next()
+    it2 = DevicePrefetchIter(_data(n=160, batch=16), megabatch=4)
+    it2.restore(st)
+    second_again = it2.next()
+    for a, b in zip(second.data + second.label,
+                    second_again.data + second_again.label):
+        assert np.array_equal(a.asnumpy(), b.asnumpy())
+
+
+# -- fallback-to-K=1 triggers -------------------------------------------------
+
+def test_monitor_forces_per_batch(caplog):
+    mon = mx.monitor.Monitor(1)
+    mod, _ = _fit(4, num_epoch=1, monitor=mon)
+    # monitor disables fusion entirely; no superstep program compiled
+    assert mod._fused is None
+    assert not mod._superstep_progs
+
+
+def test_host_only_metric_falls_back():
+    met = mx.metric.np_metric(
+        lambda label, pred: float((np.argmax(pred, 1) == label).mean()))
+    assert met.device_reducer() is None
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    mod.fit(_data(), num_epoch=1, eval_metric=met,
+            optimizer_params={"learning_rate": 0.5}, superstep=4)
+    assert not mod._superstep_progs        # fell back to per-batch
+    assert met.num_inst == 4               # ...and still trained + scored
+
+
+def test_misaligned_checkpoint_every_falls_back(tmp_path):
+    """checkpoint_every=3 cannot land on K=4 superstep boundaries: fit
+    must keep per-batch cadence (a save at step 3 proves it)."""
+    store = str(tmp_path / "store")
+    mod, _ = _fit(4, num_epoch=1)          # aligned baseline: supersteps ok
+    assert mod._superstep_progs
+    mx.random.seed(7)
+    mod2 = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    mod2.fit(_data(), num_epoch=1, optimizer_params={"learning_rate": 0.5},
+             superstep=4, checkpoint=store, checkpoint_every=3)
+    assert not mod2._superstep_progs
+    assert 3 in ck.all_steps(store)
+
+
+def test_callback_inspects_outputs_falls_back():
+    def cb(param):
+        pass
+    cb.inspects_outputs = True
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    mod.fit(_data(), num_epoch=1, optimizer_params={"learning_rate": 0.5},
+            superstep=4, batch_end_callback=cb)
+    assert not mod._superstep_progs
+
+
+def test_batch_end_callback_fires_per_superstep():
+    seen = []
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    mod.fit(_data(), num_epoch=1, optimizer_params={"learning_rate": 0.5},
+            superstep=2, batch_end_callback=lambda p: seen.append(p.nbatch))
+    # 4 batches, K=2: one callback per superstep, nbatch at the K'th
+    assert seen == [1, 3]
+
+
+# -- checkpoint through a superstep boundary ---------------------------------
+
+def test_superstep_checkpoint_resume_bitwise(tmp_path):
+    store = str(tmp_path / "store")
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    mod.fit(_data(n=80), num_epoch=1,
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            superstep=2, checkpoint=store, checkpoint_every=2)
+    steps = ck.all_steps(store)
+    assert 2 in steps and 4 in steps       # superstep-boundary saves
+    # resume from step 2 into a fresh module, finish both epochs
+    mx.random.seed(999)
+    m2 = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    m2.fit(_data(n=80), num_epoch=2,
+           optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+           superstep=2,
+           checkpoint=ck.CheckpointManager(store, keep_last_n=None),
+           resume=True)
+    m_ref, _ = _fit(2, n=80, momentum=0.9)
+    _assert_bitwise(m_ref, m2)
+
+
+def test_resume_cursorless_checkpoint_into_prefetch_superstep(tmp_path):
+    """A checkpoint saved WITHOUT a feed cursor (plain NDArrayIter, no
+    prefetch) resumed into fit(prefetch_to_device=True, superstep=K):
+    the fast-forward must skip UNDERLYING batches, not megabatches."""
+    import shutil
+    store = str(tmp_path / "store")
+    mx.random.seed(7)
+    m = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    m.fit(_data(n=80), num_epoch=1,
+          optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+          superstep=2, checkpoint=store, checkpoint_every=4)
+    # drop the epoch-end save so the newest survivor is the MID-EPOCH
+    # step-4 checkpoint (epoch 0, batch cursor 4) — as after a crash
+    shutil.rmtree(os.path.join(store, ck.step_dir_name(5)))
+    assert ck.latest_step(store) == 4
+    mx.random.seed(999)
+    m2 = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    m2.fit(_data(n=80), num_epoch=2, superstep=2, prefetch_to_device=True,
+           optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+           checkpoint=ck.CheckpointManager(store, keep_last_n=None),
+           resume=True)
+    m_ref, _ = _fit(2, n=80, momentum=0.9)
+    _assert_bitwise(m_ref, m2)
+
+
+def test_score_callback_inspecting_outputs_not_deferred():
+    """An eval callback marked inspects_outputs=True must see ITS
+    batch's outputs — score()'s deferred drain would otherwise hand it
+    the NEXT batch's forward."""
+    m4, _ = _fit(4, momentum=0.9)
+    expected = [outs[0].asnumpy() for outs, _, _ in m4.iter_predict(_data())]
+    seen = []
+
+    def cb(param):
+        seen.append(param.locals["self"].get_outputs()[0].asnumpy())
+    cb.inspects_outputs = True
+    m4.score(_data(), "acc", batch_end_callback=cb)
+    assert len(seen) == len(expected)
+    for got, exp in zip(seen, expected):
+        assert np.array_equal(got, exp)
+
+
+def test_checkpoint_cadence_survives_tail_misalignment(tmp_path):
+    """5 batches/epoch with K=2: the per-epoch tail pushes global_step
+    off the K-aligned residue class (5, 10, ...).  The save cadence must
+    keep firing at the first boundary PAST each checkpoint_every
+    multiple instead of going silent for the rest of training."""
+    store = str(tmp_path / "store")
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    mod.fit(_data(n=80), num_epoch=2,
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            superstep=2, checkpoint=store, checkpoint_every=2)
+    steps = ck.all_steps(store)
+    # epoch 2 boundaries land on 7 and 9 (crossing 6 and 8): both save
+    assert 7 in steps and 9 in steps, steps
+
+
+_CRASH_CHILD = """
+import os, signal, sys
+sys.path.insert(0, %(root)r)
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ck
+
+store = sys.argv[1]
+
+def fault(point, step, path):
+    # SIGKILL mid-save at a superstep boundary past step 4
+    if point == "shards_written" and step >= 4:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+ck.set_fault_hook(fault)
+rng = np.random.RandomState(0)
+X = rng.randn(80, 6).astype(np.float32)
+y = rng.randint(0, 3, 80).astype(np.float32)
+it = mx.io.NDArrayIter(X, y, batch_size=16)
+mx.random.seed(7)
+data = mx.sym.Variable("data")
+h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=8, name="fc1"),
+                      act_type="relu")
+net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=3, name="fc2"),
+                           name="softmax")
+mod = mx.mod.Module(net, context=mx.cpu(0))
+mgr = ck.CheckpointManager(store, save_every_steps=2, keep_last_n=None)
+mod.fit(it, num_epoch=2, optimizer="sgd", superstep=2,
+        optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+        checkpoint=mgr)
+sys.exit(3)   # unreachable: the save at step 4 kills us
+"""
+
+
+def test_kill9_through_superstep_boundary_then_resume(tmp_path):
+    """kill -9 during the async save at a superstep boundary: discovery
+    skips the torn save, resume restores the last committed boundary,
+    and continuing WITH superstep=2 bitwise-matches an uninterrupted
+    superstep run."""
+    store = os.path.join(str(tmp_path), "store")
+    script = os.path.join(str(tmp_path), "crash_child.py")
+    with open(script, "w") as f:
+        f.write(_CRASH_CHILD % {"root": ROOT})
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, script, store],
+                         capture_output=True, text=True, timeout=240,
+                         env=env, cwd=ROOT)
+    assert res.returncode == -signal.SIGKILL, (res.returncode, res.stderr)
+    assert ck.latest_step(store) == 2      # step-4 save torn, step 2 stands
+
+    mx.random.seed(999)
+    m2 = mx.mod.Module(_mlp(), context=mx.cpu(0))
+    m2.fit(_data(n=80), num_epoch=2, superstep=2,
+           optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+           checkpoint=ck.CheckpointManager(store, keep_last_n=None),
+           resume=True)
+    m_ref, _ = _fit(2, n=80, momentum=0.9)
+    _assert_bitwise(m_ref, m2)
+
+
+# -- device metric reducers ---------------------------------------------------
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("acc", {}), ("top_k_accuracy", {"top_k": 2}), ("ce", {}),
+    ("mse", {}), ("mae", {}), ("rmse", {})])
+def test_device_reducer_matches_host_update(name, kwargs):
+    import jax
+    rng = np.random.RandomState(3)
+    pred = rng.rand(32, 5).astype(np.float32)
+    pred /= pred.sum(axis=1, keepdims=True)
+    label = rng.randint(0, 5, 32).astype(np.float32)
+    if name in ("mse", "mae", "rmse"):
+        pred = rng.randn(32, 1).astype(np.float32)
+        label = rng.randn(32).astype(np.float32)
+
+    host = mx.metric.create(name, **kwargs)
+    host.update([mx.nd.array(label)], [mx.nd.array(pred)])
+
+    dev = mx.metric.create(name, **kwargs)
+    red = dev.device_reducer()
+    assert red is not None
+    acc = jax.jit(red.update)(red.init(),
+                              [np.asarray(label)], [np.asarray(pred)])
+    red.absorb(jax.tree_util.tree_map(np.asarray, acc))
+    hn, hv = host.get()
+    dn, dv = dev.get()
+    assert hn == dn
+    assert abs(hv - dv) < 1e-5, (name, hv, dv)
+    assert host.num_inst == dev.num_inst
+
+
+def test_pending_forward_blocks_superstep():
+    """A recorded-but-uncommitted training forward must not be silently
+    dropped by a superstep dispatch."""
+    mod, _ = _fit(1, num_epoch=1, momentum=0.9)
+    batch = next(iter(_data()))
+    mod.forward(batch, is_train=True)          # pending fused commit
+    with pytest.raises(mx.base.MXNetError):
+        mod.superstep_train([batch, batch])
+    mod.update()                                # commit resolves it
+    assert mod.superstep_train([batch, batch])
+
+
+def test_subclassed_host_metric_falls_back():
+    """Overriding only the HOST math of a metric with a device form must
+    disable the (now-divergent) inherited device reducer."""
+    class EveryOtherAcc(mx.metric.Accuracy):
+        def _score(self, label, pred):
+            return 0, label.size                # custom host math
+    assert EveryOtherAcc().device_reducer() is None
+
+    class WeightedAcc(mx.metric.Accuracy):
+        def update(self, labels, preds):        # custom update loop
+            pass
+    assert WeightedAcc().device_reducer() is None
+    assert mx.metric.Accuracy().device_reducer() is not None
+
+
+def test_composite_device_reducer():
+    comp = mx.metric.create(["acc", "ce"])
+    # composite has a device form iff every child does
+    red = comp.device_reducer()
+    assert red is not None
+    comp2 = mx.metric.CompositeEvalMetric(
+        [mx.metric.Accuracy(), mx.metric.np_metric(lambda l, p: 0.0)])
+    assert comp2.device_reducer() is None
+
+
+def test_composite_metric_supersteps():
+    m1, met1 = _fit(1, metric=["acc", "ce"], momentum=0.9)
+    m4, met4 = _fit(4, metric=["acc", "ce"], momentum=0.9)
+    assert m4._superstep_progs
+    _assert_bitwise(m1, m4)
+    (n1, v1), (n4, v4) = met1.get(), met4.get()
+    assert n1 == n4
+    assert v1[0] == v4[0]                  # accuracy: exact int counts
+    assert abs(v1[1] - v4[1]) < 1e-5       # CE: float reduce order
+
+
+# -- async eval (score) -------------------------------------------------------
+
+def test_score_async_matches_classic():
+    m4, _ = _fit(4, momentum=0.9)
+    assert m4._fused is not None
+    fused_val = dict(m4.score(_data(), ["acc", "ce"]))
+    # classic module with the same trained params
+    arg, aux = m4.get_params()
+    mc = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    it = _data()
+    mc.bind(it.provide_data, it.provide_label, for_training=False)
+    mc.set_params(arg, aux)
+    classic_val = dict(mc.score(_data(), ["acc", "ce"]))
+    assert fused_val["accuracy"] == classic_val["accuracy"]
+    assert abs(fused_val["cross-entropy"]
+               - classic_val["cross-entropy"]) < 1e-5
+
+
+def test_score_async_callback_order_and_count():
+    m4, _ = _fit(4, momentum=0.9)
+    seen = []
+    m4.score(_data(n=80), "acc",
+             batch_end_callback=lambda p: seen.append(p.nbatch))
+    assert seen == [0, 1, 2, 3, 4]
+
+
+# -- speedometer across superstep jumps --------------------------------------
+
+def test_speedometer_handles_superstep_jumps(caplog):
+    import logging
+    from collections import namedtuple
+    P = namedtuple("P", ["nbatch", "epoch", "eval_metric"])
+    spd = mx.callback.Speedometer(batch_size=16, frequent=4)
+    with caplog.at_level(logging.INFO):
+        for n in (1, 3, 5, 7, 9):          # K=2: odd last-batch indices
+            spd(P(nbatch=n, epoch=0, eval_metric=None))
+    msgs = [r.message for r in caplog.records if "samples/sec" in r.message]
+    assert msgs, "speedometer never logged across superstep jumps"
