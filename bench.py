@@ -432,6 +432,17 @@ def main():
         line.update(ckpt_run(feed=_feed_watchdog))
     except Exception as e:
         sys.stderr.write("bench: checkpoint leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
+    # serving leg (mxnet_tpu.serve): closed-loop multithreaded load on the
+    # dynamic micro-batcher vs serial batch-1 Predictor.predict — the
+    # inference-side throughput the north star asks for (acceptance:
+    # serve_speedup >= 3x at >= 8 client threads, outputs parity-checked)
+    try:
+        from bench_serve import run as serve_run
+        _feed_watchdog("serve")
+        line.update(serve_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: serve leg failed (%s)\n" % e)
     _wd.stop()
     print(json.dumps(line), flush=True)
 

@@ -56,6 +56,8 @@ from .plugins import torch_bridge as th
 from . import native_io
 from . import feed
 from . import checkpoint
+from . import predictor
+from . import serve
 from . import profiler
 from . import libinfo
 from . import misc

@@ -9,6 +9,13 @@ one inference XLA program per input shape, and exposes the same minimal
 surface (set_input/forward/get_output + reshape).  The "amalgamation"
 capability — deploy with minimal deps — holds because this module only
 needs jax + numpy + the symbol/executor layers.
+
+Executables are cached per input-shape set the way BucketingModule
+caches per-bucket modules: ``reshape()`` back to a previously seen shape
+reuses the compiled program (and all cached executors share one set of
+parameter buffers through ``shared_exec``), so a serving loop cycling
+through shape buckets never recompiles and ``set_params`` hot-swaps
+weights into every bucket at once.
 """
 from __future__ import annotations
 
@@ -22,7 +29,7 @@ from .ndarray import NDArray, load as nd_load, array as nd_array
 from .symbol import Symbol, load_json as sym_load_json
 
 __all__ = ["Predictor", "load_ndarray_file", "create_predictor",
-           "strip_param_prefixes"]
+           "load_checkpoint_pair", "strip_param_prefixes"]
 
 
 def strip_param_prefixes(params: Dict[str, NDArray]) -> Dict[str, NDArray]:
@@ -37,36 +44,158 @@ def load_ndarray_file(path: str) -> Dict[str, NDArray]:
     return strip_param_prefixes(nd_load(path))
 
 
+def load_checkpoint_pair(prefix: str, epoch: int) -> Tuple[str, Dict]:
+    """-> (symbol_json, params dict) for a ``save_checkpoint`` pair.
+
+    Deployment-time analogue of model.load_checkpoint's error story:
+    failures name the exact file and distinguish *missing* (with the
+    candidate files that DO exist for this prefix listed) from *corrupt*
+    (a torn write from a pre-atomic-save crash)."""
+    import glob
+    import os
+    sym_file = "%s-symbol.json" % prefix
+    param_file = "%s-%04d.params" % (prefix, epoch)
+    if not os.path.exists(sym_file):
+        pat = os.path.join(os.path.dirname(sym_file) or ".", "*-symbol.json")
+        have = sorted(glob.glob(pat))
+        raise MXNetError(
+            "predictor symbol file missing: %r (symbol files present in "
+            "that directory: %s)" % (sym_file, have or "none"))
+    try:
+        with open(sym_file) as f:
+            sym_json = f.read()
+        sym_load_json(sym_json)      # parse now: corrupt fails loud HERE
+    except MXNetError as e:
+        raise MXNetError(
+            "predictor symbol file corrupt: %r (%s) — likely a torn write "
+            "from a crashed save predating atomic publishes"
+            % (sym_file, e)) from e
+    except Exception as e:
+        raise MXNetError(
+            "predictor symbol file corrupt: %r (%s: %s) — likely a torn "
+            "write from a crashed save predating atomic publishes"
+            % (sym_file, type(e).__name__, e)) from e
+    if not os.path.exists(param_file):
+        have = sorted(glob.glob("%s-*.params" % prefix))
+        raise MXNetError(
+            "predictor params file missing: %r (existing param files for "
+            "this prefix: %s)" % (param_file, have or "none"))
+    try:
+        params = load_ndarray_file(param_file)
+    except MXNetError as e:
+        raise MXNetError(
+            "predictor params file corrupt: %r (%s) — likely a torn write "
+            "from a crashed save predating atomic publishes"
+            % (param_file, e)) from e
+    except Exception as e:
+        raise MXNetError(
+            "predictor params file corrupt: %r (%s: %s) — likely a torn "
+            "write from a crashed save predating atomic publishes"
+            % (param_file, type(e).__name__, e)) from e
+    return sym_json, params
+
+
 class Predictor:
     """MXPredCreate analogue (c_predict_api.h:1-207)."""
 
     def __init__(self, symbol_json: str, param_bytes_or_path,
                  input_shapes: Dict[str, Tuple[int, ...]],
-                 dev_type: str = "cpu", dev_id: int = 0):
+                 dev_type: str = "cpu", dev_id: int = 0,
+                 type_dict: Optional[Dict] = None):
         self.symbol = sym_load_json(symbol_json) \
             if isinstance(symbol_json, str) and symbol_json.lstrip().startswith("{") \
             else sym_load_json(open(symbol_json).read())
         self.ctx = Context(dev_type, dev_id)
         if isinstance(param_bytes_or_path, (dict,)):
-            params = param_bytes_or_path
+            params = strip_param_prefixes(param_bytes_or_path)
         else:
             params = load_ndarray_file(param_bytes_or_path)
+        # each list_arguments() call walks the whole graph — compute the
+        # name sets ONCE (set_params runs them under the serving lock)
+        self._arg_names = frozenset(self.symbol.list_arguments())
+        self._aux_names = frozenset(self.symbol.list_auxiliary_states())
         self._arg_params = {k: v for k, v in params.items()
-                            if k in self.symbol.list_arguments()}
+                            if k in self._arg_names}
         self._aux_params = {k: v for k, v in params.items()
-                            if k in self.symbol.list_auxiliary_states()}
+                            if k in self._aux_names}
+        # Bind every argument at its STORED dtype (an fp16 checkpoint
+        # binds an fp16 program, not an f32 one that silently upcasts),
+        # and default the non-param inputs to the params' common float
+        # dtype so "load an fp16 model, predict" works without a
+        # type_dict.  Explicit type_dict entries win.
+        self._type_dict: Dict[str, np.dtype] = {
+            k: np.dtype(getattr(v, "dtype", np.float32))
+            for k, v in self._arg_params.items()}
+        float_dts = {dt for dt in self._type_dict.values() if dt.kind == "f"}
+        if len(float_dts) == 1:
+            common = float_dts.pop()
+            param_names = set(self._type_dict)
+            for name in self._arg_names:
+                if name not in param_names:
+                    self._type_dict[name] = common
+        for k, v in (type_dict or {}).items():
+            self._type_dict[k] = np.dtype(v)
+        # per-shape executor cache (BucketingModule's bucket-cache idea):
+        # key -> bound executor; all executors share parameter buffers
+        self._exec_cache: Dict[Tuple, object] = {}
         self._bind(dict(input_shapes))
+
+    @staticmethod
+    def _shape_key(input_shapes: Dict[str, Tuple[int, ...]]) -> Tuple:
+        return tuple(sorted((k, tuple(v)) for k, v in input_shapes.items()))
 
     def _bind(self, input_shapes: Dict[str, Tuple[int, ...]]):
         self._input_shapes = input_shapes
-        self._exec = self.symbol.simple_bind(self.ctx, grad_req="null",
-                                             **input_shapes)
+        key = self._shape_key(input_shapes)
+        cached = self._exec_cache.get(key)
+        if cached is not None:
+            self._exec = cached
+            return
+        # new shape set: bind sharing the parameter NDArrays of the first
+        # executor (simple_bind shared_exec reuses identically-shaped
+        # arrays, which params always are — only input shapes vary)
+        shared = next(iter(self._exec_cache.values())) \
+            if self._exec_cache else None
+        self._exec = self.symbol.simple_bind(
+            self.ctx, grad_req="null", type_dict=dict(self._type_dict),
+            shared_exec=shared, **input_shapes)
         self._exec.copy_params_from(self._arg_params, self._aux_params,
                                     allow_extra_params=True)
+        self._exec_cache[key] = self._exec
 
     def set_input(self, name: str, data) -> None:
-        """MXPredSetInput."""
-        self._exec.arg_dict[name][:] = np.asarray(data, dtype=np.float32)
+        """MXPredSetInput: cast to the BOUND input's dtype — the executor
+        decides (fp16/int32/uint8 models), not a hardcoded float32."""
+        arr = self._exec.arg_dict[name]
+        arr[:] = np.asarray(data, dtype=arr.dtype)
+
+    def set_params(self, arg_params: Optional[Dict] = None,
+                   aux_params: Optional[Dict] = None) -> None:
+        """Hot-swap weights into EVERY cached executor (they share param
+        buffers, but iterating keeps the swap correct even for executors
+        bound before sharing was possible).  Later ``_bind`` calls copy
+        from the updated host dicts, so new shapes see the new weights."""
+        if arg_params:
+            arg_params = strip_param_prefixes(dict(arg_params))
+            for k, v in arg_params.items():
+                if k in self._arg_names:
+                    self._arg_params[k] = v if isinstance(v, NDArray) \
+                        else nd_array(np.asarray(v))
+                elif k in self._aux_names:
+                    self._aux_params[k] = v if isinstance(v, NDArray) \
+                        else nd_array(np.asarray(v))
+        if aux_params:
+            for k, v in strip_param_prefixes(dict(aux_params)).items():
+                if k in self._aux_names:
+                    self._aux_params[k] = v if isinstance(v, NDArray) \
+                        else nd_array(np.asarray(v))
+        seen = set()
+        for ex in self._exec_cache.values():
+            if id(ex) in seen:
+                continue
+            seen.add(id(ex))
+            ex.copy_params_from(self._arg_params, self._aux_params,
+                                allow_extra_params=True)
 
     def forward(self) -> None:
         """MXPredForward."""
@@ -82,7 +211,8 @@ class Predictor:
             else tuple(self.symbol.infer_shape(**self._input_shapes)[1][index])
 
     def reshape(self, input_shapes: Dict[str, Tuple[int, ...]]) -> "Predictor":
-        """MXPredReshape: new input shapes, shared weights."""
+        """MXPredReshape: new input shapes, shared weights.  A previously
+        seen shape set reuses its compiled executor from the cache."""
         self._bind(dict(input_shapes))
         return self
 
@@ -95,9 +225,10 @@ class Predictor:
 
 
 def create_predictor(prefix: str, epoch: int, input_shapes,
-                     dev_type="cpu", dev_id=0) -> Predictor:
-    """Build a Predictor from a save_checkpoint pair."""
-    with open("%s-symbol.json" % prefix) as f:
-        sym_json = f.read()
-    return Predictor(sym_json, "%s-%04d.params" % (prefix, epoch),
-                     input_shapes, dev_type, dev_id)
+                     dev_type="cpu", dev_id=0, type_dict=None) -> Predictor:
+    """Build a Predictor from a save_checkpoint pair.  Missing or corrupt
+    artifacts raise a clear MXNetError naming candidates (see
+    load_checkpoint_pair)."""
+    sym_json, params = load_checkpoint_pair(prefix, epoch)
+    return Predictor(sym_json, params, input_shapes, dev_type, dev_id,
+                     type_dict=type_dict)

@@ -27,7 +27,8 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "feed_report_str", "register_checkpoint_stats",
            "checkpoint_report", "checkpoint_report_str", "SuperstepStats",
            "register_superstep_stats", "superstep_report",
-           "superstep_report_str"]
+           "superstep_report_str", "register_serve_stats", "serve_report",
+           "serve_report_str"]
 
 _config = {"filename": "profile_output", "mode": "symbolic"}
 _state = "stop"
@@ -219,6 +220,33 @@ def checkpoint_report_str() -> str:
     """Human-readable save/restore counters for every live manager."""
     parts = [cs.report_str() for _, cs in sorted(_ckpt_stats.items())]
     return "\n\n".join(parts) if parts else "(no live checkpoint managers)"
+
+
+# -- serving instrumentation (mxnet_tpu.serve) ------------------------------
+# Live ServeEngines register their ServeStats here, weakly like the feed
+# pipelines, so one serve_report() shows every engine's request latency
+# percentiles, queue depth, batch occupancy, pad waste, and per-bucket
+# hit counts — the capacity-planning numbers for the inference side.
+_serve_stats = weakref.WeakValueDictionary()
+_serve_seq = 0
+
+
+def register_serve_stats(serve_stats) -> None:
+    """Called by serve.ServeEngine on construction."""
+    global _serve_seq
+    _serve_seq += 1
+    _serve_stats["%s#%06d" % (serve_stats.name, _serve_seq)] = serve_stats
+
+
+def serve_report() -> dict:
+    """{engine key: counters} for every live serve engine."""
+    return {key: ss.report() for key, ss in sorted(_serve_stats.items())}
+
+
+def serve_report_str() -> str:
+    """Human-readable latency/occupancy/queue table per serve engine."""
+    parts = [ss.report_str() for _, ss in sorted(_serve_stats.items())]
+    return "\n\n".join(parts) if parts else "(no live serve engines)"
 
 
 @contextlib.contextmanager

@@ -1,0 +1,51 @@
+"""mxnet_tpu.serve: dynamic-batching inference serving.
+
+The inference half of the production story (ROADMAP north star: "serves
+heavy traffic from millions of users").  The training stack got fused
+steps, prefetch feeds, and crash-safe checkpoints; this subsystem gives
+the resulting models a serving path with the same discipline:
+
+* **pre-compiled shape buckets** (engine.py) — one inference executable
+  per configured batch size, compiled + warmed at startup (the
+  BucketingModule per-shape-program idea applied to the request axis);
+  requests are padded to the smallest bucket that fits;
+* **dynamic micro-batching** (batcher.py) — concurrent ``submit()``
+  futures coalesce under ``max_batch_size`` / ``max_delay_ms`` flush
+  rules, with per-request deadlines and admission-time validation;
+* **overload fast-fail** (errors.py) — the request queue is bounded; a
+  full queue raises :class:`ServeOverloadError` from ``submit``
+  immediately, never an unbounded hang;
+* **async result completion** — the next batch's dispatch overlaps the
+  previous batch's device-to-host copy;
+* **hot weight reload** — ``reload*()`` atomically swaps params between
+  batches from a newer checkpoint (legacy pair or
+  ``mxnet_tpu.checkpoint`` step) with zero dropped or mixed-weights
+  requests;
+* **observability** — ``mx.profiler.serve_report()`` /
+  ``serve_report_str()``: latency p50/p95/p99, queue depth, batch
+  occupancy, pad waste, per-bucket hit counts.
+
+Quick start::
+
+    eng = mx.serve.ServeEngine.from_checkpoint(
+        "model", epoch=3,
+        input_shapes={"data": (1, 6), "softmax_label": (1,)})
+    futures = [eng.submit(x) for x in items]      # from many threads
+    rows = [f.result(timeout=1.0) for f in futures]
+    eng.close()
+
+Knobs (constructor args override): ``MXNET_SERVE_MAX_BATCH``,
+``MXNET_SERVE_MAX_DELAY_MS``, ``MXNET_SERVE_QUEUE_DEPTH``,
+``MXNET_SERVE_DEADLINE_MS`` — see docs/env_var.md.
+"""
+from __future__ import annotations
+
+from .batcher import MicroBatcher
+from .engine import ServeEngine, default_buckets
+from .errors import (ServeClosedError, ServeDeadlineError, ServeError,
+                     ServeOverloadError, ServeRequestError)
+from .stats import ServeStats
+
+__all__ = ["ServeEngine", "MicroBatcher", "ServeStats", "default_buckets",
+           "ServeError", "ServeOverloadError", "ServeDeadlineError",
+           "ServeRequestError", "ServeClosedError"]

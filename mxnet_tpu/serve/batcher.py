@@ -1,0 +1,254 @@
+"""Dynamic micro-batcher: coalesce concurrent requests into padded batches.
+
+The serving analogue of the feed pipeline's bounded stages: a bounded
+request deque, ONE dispatcher thread that assembles batches, and ONE
+completion thread that finalizes results, connected by a depth-2 handoff
+queue so the next batch's XLA dispatch overlaps the previous batch's
+D2H copy (the ``score()`` deferred-sync pattern from the superstep PR).
+
+Flush rules (TF-Serving style batching): a batch is dispatched when it
+reaches ``max_batch_size`` OR when the oldest queued request has waited
+``max_delay_ms`` — whichever comes first.  The delay window is further
+capped by the oldest request's deadline, so a doomed request fails at
+its deadline instead of after a pointless full window.
+
+Admission control happens in the CALLER's thread inside ``submit``:
+
+* validation (shape/dtype) raises :class:`ServeRequestError` before the
+  request can enter the queue — a malformed request cannot poison a
+  batch;
+* a full queue raises :class:`ServeOverloadError` IMMEDIATELY — bounded
+  queue, never an unbounded hang.  The queue bound is the overload
+  contract: depth x per-batch latency is the worst queueing delay an
+  admitted request can see.
+
+Shutdown: ``close(drain=True)`` stops admissions, lets the dispatcher
+drain the queue (flushing partial batches immediately rather than
+waiting out their delay windows), and joins both threads.
+``drain=False`` fails queued requests with :class:`ServeClosedError`.
+"""
+from __future__ import annotations
+
+import collections
+import queue as _queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+from .errors import (ServeClosedError, ServeDeadlineError, ServeError,
+                     ServeOverloadError)
+
+__all__ = ["MicroBatcher"]
+
+# dispatcher wakeup period while idle: bounds shutdown latency, not
+# request latency (a submit notifies the condition variable directly)
+_IDLE_POLL_S = 0.05
+
+
+class _Request:
+    __slots__ = ("data", "future", "enqueue_t", "deadline_t")
+
+    def __init__(self, data, future, enqueue_t, deadline_t):
+        self.data = data
+        self.future = future
+        self.enqueue_t = enqueue_t
+        self.deadline_t = deadline_t
+
+
+class MicroBatcher:
+    """Request queue + dispatcher/completion threads around two engine
+    callbacks:
+
+    ``run_batch(requests) -> handoff``
+        Runs inference on the dispatcher thread; should START the
+        device-to-host copy and return without blocking on it.
+    ``finish(handoff) -> [result, ...]``
+        Runs on the completion thread; blocks on the copy and returns
+        one result per request, in order.
+    """
+
+    def __init__(self, run_batch: Callable, finish: Callable, *,
+                 max_batch_size: int, max_delay_ms: float,
+                 queue_depth: int, default_deadline_ms: Optional[float] = None,
+                 validate: Optional[Callable] = None, stats=None,
+                 name: str = "serve"):
+        if max_batch_size < 1:
+            raise ServeError("max_batch_size must be >= 1, got %d"
+                             % max_batch_size)
+        if queue_depth < 1:
+            raise ServeError("queue_depth must be >= 1, got %d" % queue_depth)
+        self._run_batch = run_batch
+        self._finish = finish
+        self._max_batch_size = int(max_batch_size)
+        self._max_delay_s = float(max_delay_ms) / 1000.0
+        self._queue_depth = int(queue_depth)
+        self._default_deadline_ms = default_deadline_ms
+        self._validate = validate
+        self._stats = stats
+        self.name = name
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        # depth-2 handoff: the dispatcher may run one batch ahead of the
+        # completion thread (overlap), then backpressures
+        self._done_q: _queue.Queue = _queue.Queue(maxsize=2)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="%s-dispatch" % name,
+            daemon=True)
+        self._completer = threading.Thread(
+            target=self._complete_loop, name="%s-complete" % name,
+            daemon=True)
+        self._dispatcher.start()
+        self._completer.start()
+
+    # -- client side -------------------------------------------------------
+    def submit(self, data, deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a Future resolving to its result.
+
+        Raises ServeRequestError (malformed), ServeOverloadError (queue
+        full) or ServeClosedError — all immediately, in this thread."""
+        if self._validate is not None:
+            data = self._validate(data)     # ServeRequestError on bad input
+        dl = self._default_deadline_ms if deadline_ms is None else deadline_ms
+        now = time.perf_counter()
+        req = _Request(data, Future(), now,
+                       now + dl / 1000.0 if dl else None)
+        with self._cv:
+            if self._closed:
+                raise ServeClosedError(
+                    "serve engine %r is closed" % self.name)
+            if len(self._q) >= self._queue_depth:
+                if self._stats is not None:
+                    self._stats.on_overload()
+                raise ServeOverloadError(
+                    "serve queue full (%d queued, depth %d): shed load or "
+                    "retry with backoff" % (len(self._q), self._queue_depth))
+            self._q.append(req)
+            depth = len(self._q)
+            self._cv.notify()
+        if self._stats is not None:
+            self._stats.on_submit(depth)
+        return req.future
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    # -- dispatcher thread -------------------------------------------------
+    def _gather(self) -> Optional[List[_Request]]:
+        """Assemble one batch honoring the flush rules; None on
+        closed-and-drained.
+
+        Already-queued requests are drained GREEDILY: the delay window
+        only governs waiting for requests that have not arrived yet.
+        (Otherwise a backlog older than ``max_delay_ms`` — built up while
+        earlier batches ran — would flush one request at a time, exactly
+        when batching matters most.)"""
+        with self._cv:
+            while not self._q and not self._closed:
+                self._cv.wait(_IDLE_POLL_S)
+            if not self._q:
+                return None
+            batch = [self._q.popleft()]
+            while self._q and len(batch) < self._max_batch_size:
+                batch.append(self._q.popleft())
+        if len(batch) >= self._max_batch_size:
+            return batch
+        flush_at = batch[0].enqueue_t + self._max_delay_s
+        if batch[0].deadline_t is not None:
+            # no point holding the window open past the point the oldest
+            # request is dead anyway
+            flush_at = min(flush_at, batch[0].deadline_t)
+        while len(batch) < self._max_batch_size:
+            timeout = flush_at - time.perf_counter()
+            if timeout <= 0:
+                break
+            with self._cv:
+                if not self._q:
+                    if self._closed:
+                        break       # draining: flush partial batches now
+                    self._cv.wait(timeout)
+                while self._q and len(batch) < self._max_batch_size:
+                    batch.append(self._q.popleft())
+        return batch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._gather()
+            if batch is None:
+                break
+            if self._stats is not None:
+                self._stats.set_queue_depth(self.queue_depth())
+            now = time.perf_counter()
+            live = []
+            for r in batch:
+                if r.deadline_t is not None and now > r.deadline_t:
+                    if self._stats is not None:
+                        self._stats.on_expired(1)
+                    r.future.set_exception(ServeDeadlineError(
+                        "deadline exceeded: %.1f ms in queue against a "
+                        "%.1f ms deadline"
+                        % ((now - r.enqueue_t) * 1e3,
+                           (r.deadline_t - r.enqueue_t) * 1e3)))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            try:
+                handoff = self._run_batch(live)
+            except BaseException as e:     # engine bug: fail the batch,
+                self._fail(live, e)        # never wedge the loop
+                continue
+            self._done_q.put((live, handoff))
+        self._done_q.put(None)
+
+    # -- completion thread -------------------------------------------------
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._done_q.get()
+            if item is None:
+                break
+            live, handoff = item
+            try:
+                results = self._finish(handoff)
+            except BaseException as e:
+                self._fail(live, e)
+                continue
+            now = time.perf_counter()
+            lat = []
+            for r, res in zip(live, results):
+                r.future.set_result(res)
+                lat.append((now - r.enqueue_t) * 1e3)
+            if self._stats is not None:
+                self._stats.on_complete(lat)
+
+    def _fail(self, reqs: List[_Request], exc: BaseException) -> None:
+        if self._stats is not None:
+            self._stats.on_failed(len(reqs))
+        if not isinstance(exc, Exception):
+            exc = ServeError("serve worker died: %r" % (exc,))
+        for r in reqs:
+            r.future.set_exception(exc)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        """Stop admissions; drain (default) or fail queued requests; join
+        both worker threads.  Idempotent."""
+        with self._cv:
+            already = self._closed
+            self._closed = True
+            dropped = [] if drain else list(self._q)
+            if not drain:
+                self._q.clear()
+            self._cv.notify_all()
+        for r in dropped:
+            r.future.set_exception(ServeClosedError(
+                "serve engine %r closed before this request was "
+                "dispatched" % self.name))
+        if self._stats is not None and dropped:
+            self._stats.on_failed(len(dropped))
+        if already:
+            return
+        self._dispatcher.join()
+        self._completer.join()

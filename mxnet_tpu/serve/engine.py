@@ -1,0 +1,358 @@
+"""ServeEngine: pre-compiled shape buckets + dynamic batching + hot reload.
+
+The inference-side counterpart of the training stack: where ``fit`` owns
+one donated XLA program per megabatch, the engine owns one pre-compiled
+inference executable per BATCH BUCKET (the BucketingModule idea applied
+to the request axis) and a micro-batcher that coalesces concurrent
+``submit()`` calls into the smallest bucket that fits, padding the tail
+rows.  All buckets are compiled and warmed at construction — the serving
+loop never sees a compile stall.
+
+Weights live in ONE set of parameter buffers shared by every bucket's
+executor (Predictor's executor cache + ``shared_exec``), so
+``reload(...)`` — from a newer legacy pair or a ``mxnet_tpu.checkpoint``
+step — swaps every bucket at once.  The swap holds the same lock the
+dispatcher holds while running a batch, so each batch executes entirely
+under one weights version: in-flight requests are neither dropped nor
+served a mix of old and new layers.
+
+::
+
+    eng = mx.serve.ServeEngine.from_checkpoint(
+        "model", epoch=3, input_shapes={"data": (1, 6),
+                                        "softmax_label": (1,)})
+    fut = eng.submit(x)                  # x: one item, shape (6,)
+    probs = fut.result(timeout=1.0)
+    eng.reload_from_checkpoint("model", epoch=7)   # hot swap
+    print(mx.profiler.serve_report_str())
+    eng.close()                          # graceful: drains the queue
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, get_env
+from ..predictor import Predictor, load_checkpoint_pair
+from .batcher import MicroBatcher
+from .errors import ServeError, ServeRequestError
+from .stats import ServeStats
+
+__all__ = ["ServeEngine", "default_buckets"]
+
+
+def default_buckets(max_batch_size: int) -> Tuple[int, ...]:
+    """Power-of-two batch buckets up to (and including) max_batch_size:
+    few compiled programs, worst-case pad waste < 50%."""
+    if max_batch_size < 1:
+        raise ServeError("max_batch_size must be >= 1, got %d"
+                         % max_batch_size)
+    buckets = []
+    b = 1
+    while b < max_batch_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch_size)
+    return tuple(buckets)
+
+
+class ServeEngine:
+    """Dynamic-batching inference server over a Predictor (see module
+    docstring).
+
+    Parameters
+    ----------
+    symbol : Symbol | str
+        Network: a Symbol, a symbol-JSON string, or a path to one.
+    params : dict
+        Parameter blob (``arg:``/``aux:`` prefixes accepted).
+    input_shapes : dict name -> shape
+        Per-input shapes INCLUDING a leading batch dim (its value is a
+        template — the engine rebinds dim 0 to each bucket size).  The
+        request payload is one item of ``input_shapes[data_name][1:]``;
+        non-data inputs (labels) are zero-filled.
+    batch_buckets : sequence of int, optional
+        Compiled batch sizes; default power-of-two grid up to
+        ``MXNET_SERVE_MAX_BATCH`` (8).
+    max_delay_ms / queue_depth / deadline_ms :
+        Batching knobs; default from ``MXNET_SERVE_MAX_DELAY_MS`` (2),
+        ``MXNET_SERVE_QUEUE_DEPTH`` (4x max batch),
+        ``MXNET_SERVE_DEADLINE_MS`` (1000; 0 disables).
+    """
+
+    def __init__(self, symbol, params: Dict,
+                 input_shapes: Dict[str, Tuple[int, ...]], *,
+                 data_name: Optional[str] = None,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 max_delay_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 output_index: int = 0,
+                 dev_type: str = "cpu", dev_id: int = 0,
+                 type_dict: Optional[Dict] = None,
+                 name: str = "serve", warmup: bool = True):
+        if not input_shapes:
+            raise ServeError("input_shapes must name at least one input")
+        sym_json = symbol.tojson() if hasattr(symbol, "tojson") else symbol
+        if batch_buckets is None:
+            batch_buckets = default_buckets(
+                get_env("MXNET_SERVE_MAX_BATCH", 8, int))
+        self._buckets = tuple(sorted(set(int(b) for b in batch_buckets)))
+        if not self._buckets or self._buckets[0] < 1:
+            raise ServeError("batch_buckets must be positive ints, got %r"
+                             % (batch_buckets,))
+        self.max_batch_size = self._buckets[-1]
+        if max_delay_ms is None:
+            max_delay_ms = get_env("MXNET_SERVE_MAX_DELAY_MS", 2.0, float)
+        if queue_depth is None:
+            queue_depth = get_env("MXNET_SERVE_QUEUE_DEPTH",
+                                  4 * self.max_batch_size, int)
+        if deadline_ms is None:
+            deadline_ms = get_env("MXNET_SERVE_DEADLINE_MS", 1000.0, float)
+        self.max_delay_ms = float(max_delay_ms)
+        self.queue_depth = int(queue_depth)
+        self.deadline_ms = float(deadline_ms) or None
+        self._shapes_tpl = {k: tuple(v) for k, v in input_shapes.items()}
+        if data_name is None:
+            data_name = "data" if "data" in self._shapes_tpl \
+                else next(iter(self._shapes_tpl))
+        if data_name not in self._shapes_tpl:
+            raise ServeError("data_name %r not in input_shapes %s"
+                             % (data_name, sorted(self._shapes_tpl)))
+        self.data_name = data_name
+        self.item_shape = self._shapes_tpl[data_name][1:]
+        self._output_index = int(output_index)
+        self.name = name
+        self.weights_version = 0
+        # serializes batch execution against weight swaps: a batch runs
+        # entirely under one version, a reload waits out the in-flight
+        # batch instead of tearing it.  RLock so reload()/pause() nest
+        # on one thread; _pause_owner guards the close-inside-pause
+        # deadlock (close joins the dispatcher, which needs this lock).
+        self._swap_lock = threading.RLock()
+        self._pause_owner: Optional[int] = None
+        # per-bucket shape dicts, built once: _run_batch is the hot loop
+        self._shapes_by_bucket = {b: self._bucket_shapes(b)
+                                  for b in self._buckets}
+        self._predictor = Predictor(
+            sym_json, params, self._shapes_by_bucket[self.max_batch_size],
+            dev_type, dev_id, type_dict=type_dict)
+        self._data_dtype = np.dtype(
+            self._predictor._exec.arg_dict[data_name].dtype)
+        self.stats = ServeStats(name, self.max_batch_size)
+        from .. import profiler
+        profiler.register_serve_stats(self.stats)
+        if warmup:
+            self._warmup()
+        self._batcher = MicroBatcher(
+            self._run_batch, self._finish,
+            max_batch_size=self.max_batch_size,
+            max_delay_ms=self.max_delay_ms, queue_depth=self.queue_depth,
+            default_deadline_ms=self.deadline_ms, validate=self._validate,
+            stats=self.stats, name=name)
+        self._closed = False
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, prefix: str, epoch: int,
+                        input_shapes: Dict[str, Tuple[int, ...]],
+                        **kwargs) -> "ServeEngine":
+        """Serve a legacy ``save_checkpoint`` pair (missing vs corrupt
+        artifacts fail with candidates listed, like load_checkpoint)."""
+        sym_json, params = load_checkpoint_pair(prefix, epoch)
+        return cls(sym_json, params, input_shapes, **kwargs)
+
+    @classmethod
+    def from_checkpoint_dir(cls, directory: str, symbol,
+                            input_shapes: Dict[str, Tuple[int, ...]],
+                            step: Optional[int] = None,
+                            **kwargs) -> "ServeEngine":
+        """Serve a ``mxnet_tpu.checkpoint`` store (full train state saved
+        by CheckpointManager / ``Module.fit(checkpoint=...)``): loads the
+        newest committed step (or ``step``), keeping params + aux and
+        dropping the optimizer state.  ``symbol`` is required — the store
+        holds arrays, not the graph."""
+        params, _meta = _load_checkpoint_dir_params(directory, step)
+        return cls(symbol, params, input_shapes, **kwargs)
+
+    # -- shape / dtype plumbing -------------------------------------------
+    def _bucket_shapes(self, b: int) -> Dict[str, Tuple[int, ...]]:
+        return {k: (b,) + v[1:] for k, v in self._shapes_tpl.items()}
+
+    def _warmup(self) -> None:
+        """Compile + run every bucket once so serving never compiles."""
+        p = self._predictor
+        for b in self._buckets:
+            p.reshape(self._shapes_by_bucket[b])
+            p.set_input(self.data_name,
+                        np.zeros((b,) + self.item_shape, self._data_dtype))
+            p.forward()
+            p.get_output(self._output_index)    # sync: executable is hot
+
+    def _validate(self, data) -> np.ndarray:
+        """Admission-time request validation (caller's thread): shape and
+        dtype are checked BEFORE the queue, so one malformed request can
+        never take a batch of good ones down with it."""
+        arr = np.asarray(data)
+        if arr.dtype.kind not in "biuf":
+            raise ServeRequestError(
+                "request dtype %s is not numeric (expected castable to %s)"
+                % (arr.dtype, self._data_dtype))
+        if tuple(arr.shape) != tuple(self.item_shape):
+            raise ServeRequestError(
+                "request shape %s != item shape %s (submit ONE item; the "
+                "server owns the batch dim)"
+                % (tuple(arr.shape), tuple(self.item_shape)))
+        return np.ascontiguousarray(arr, dtype=self._data_dtype)
+
+    def _pick_bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self.max_batch_size       # n <= max_batch_size by contract
+
+    # -- batch execution (dispatcher thread) ------------------------------
+    def _run_batch(self, reqs) -> Tuple:
+        n = len(reqs)
+        bucket = self._pick_bucket(n)
+        data = np.stack([r.data for r in reqs])
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + self.item_shape, self._data_dtype)
+            data = np.concatenate([data, pad], axis=0)
+        with self._swap_lock:
+            p = self._predictor
+            p.reshape(self._shapes_by_bucket[bucket])  # cache hit: no compile
+            p.set_input(self.data_name, data)
+            p.forward()
+            out = p._exec.outputs[self._output_index]._get()
+        # start the D2H copy and return: the completion thread blocks on
+        # it while THIS thread dispatches the next batch (score() pattern)
+        start = getattr(out, "copy_to_host_async", None)
+        if callable(start):
+            try:
+                start()
+            except Exception:
+                pass
+        self.stats.on_batch(n, bucket)
+        return out, n
+
+    def _finish(self, handoff) -> List[np.ndarray]:
+        """Completion thread: block on the D2H copy, slice per request."""
+        out, n = handoff
+        host = np.asarray(out)
+        return [np.array(host[i]) for i in range(n)]
+
+    # -- client API --------------------------------------------------------
+    def submit(self, data, deadline_ms: Optional[float] = None):
+        """Enqueue one item (shape ``item_shape``); returns a
+        concurrent.futures.Future of the output row.  Raises
+        ServeRequestError / ServeOverloadError / ServeClosedError
+        immediately (see serve.errors)."""
+        return self._batcher.submit(data, deadline_ms=deadline_ms)
+
+    def submit_many(self, items, deadline_ms: Optional[float] = None):
+        """Convenience fan-out: one future per item."""
+        return [self.submit(x, deadline_ms=deadline_ms) for x in items]
+
+    def predict(self, data, timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking one-shot: submit + result."""
+        return self.submit(data).result(timeout=timeout)
+
+    # -- hot weight reload -------------------------------------------------
+    def reload(self, arg_params: Dict,
+               aux_params: Optional[Dict] = None) -> int:
+        """Atomically swap weights between batches.  In-flight requests
+        finish under the old version; everything dispatched after this
+        returns sees the new one.  Returns the new weights version."""
+        with self._swap_lock:
+            self._predictor.set_params(arg_params, aux_params)
+            self.weights_version += 1
+            version = self.weights_version
+        self.stats.on_reload()
+        return version
+
+    def reload_from_checkpoint(self, prefix: str, epoch: int) -> int:
+        """Hot-swap to a legacy pair's params (symbol must match the
+        serving graph — only weights move)."""
+        _sym_json, params = load_checkpoint_pair(prefix, epoch)
+        return self.reload(params)
+
+    def reload_from_checkpoint_dir(self, directory: str,
+                                   step: Optional[int] = None) -> int:
+        """Hot-swap to a ``mxnet_tpu.checkpoint`` step (default newest
+        committed)."""
+        params, _meta = _load_checkpoint_dir_params(directory, step)
+        return self.reload(params)
+
+    @contextlib.contextmanager
+    def pause(self):
+        """Hold batch execution between batches (the weights-swap lock):
+        queued requests wait, admissions keep their overload semantics.
+        For maintenance windows and deterministic tests.  reload() and
+        nested pause() are fine inside; close() is not (it would join a
+        dispatcher blocked on this lock) and raises instead of hanging."""
+        with self._swap_lock:
+            prev = self._pause_owner
+            self._pause_owner = threading.get_ident()
+            try:
+                yield
+            finally:
+                self._pause_owner = prev
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self._buckets
+
+    def pending_requests(self) -> int:
+        """Requests currently waiting in the bounded queue (the
+        ``queue_depth`` attribute is the configured bound)."""
+        return self._batcher.queue_depth()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        """Graceful shutdown: stop admissions, drain queued requests
+        (partial batches flush immediately), join the worker threads.
+        ``drain=False`` fails queued requests with ServeClosedError."""
+        if self._pause_owner == threading.get_ident():
+            raise ServeError(
+                "close() inside pause() would deadlock: the dispatcher "
+                "needs the paused lock to finish its in-flight batch — "
+                "exit pause() first (or close from another thread)")
+        if self._closed:
+            return
+        self._closed = True
+        self._batcher.close(drain=drain)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _load_checkpoint_dir_params(directory: str,
+                                step: Optional[int] = None) -> Tuple[Dict, Dict]:
+    """Read serving weights out of a mxnet_tpu.checkpoint store: params +
+    fixed (both are executor arguments) and aux; optimizer slots and RNG
+    stay behind.  -> (params dict, meta)."""
+    from ..checkpoint import CheckpointManager
+    mgr = CheckpointManager(directory, async_save=False,
+                            name="serve-restore")
+    try:
+        tree, meta = mgr.restore(step=step)
+    finally:
+        mgr.close()
+    if not isinstance(tree, dict) or "params" not in tree:
+        raise MXNetError(
+            "checkpoint under %r is not a module train state (expected a "
+            "{'params', ...} tree, got %s); serve needs a state saved by "
+            "save_module / Module.fit(checkpoint=...)"
+            % (directory, type(tree).__name__))
+    params: Dict = {}
+    for group in ("params", "fixed", "aux"):
+        params.update(tree.get(group) or {})
+    return params, meta

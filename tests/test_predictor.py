@@ -3,6 +3,7 @@ import numpy as np
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
 from mxnet_tpu.predictor import Predictor, create_predictor
 
 
@@ -42,6 +43,93 @@ def test_predictor_reshape(tmp_path):
     pred.reshape({"data": (4, 6), "softmax_label": (4,)})
     out4 = pred.predict(X[:4])
     assert np.allclose(out16[:4], out4, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [np.float16, np.float32, np.int32,
+                                   np.uint8])
+def test_set_input_respects_bound_dtype(dtype):
+    """set_input casts to the EXECUTOR's input dtype, not a hardcoded
+    float32 (regression: predictor.py once forced np.float32)."""
+    sym = mx.sym.Flatten(mx.sym.Variable("data"))
+    pred = Predictor(sym.tojson(), {}, {"data": (2, 3)},
+                     type_dict={"data": dtype})
+    assert pred._exec.arg_dict["data"].dtype == np.dtype(dtype)
+    src = np.arange(6, dtype=np.float64).reshape(2, 3)
+    pred.set_input("data", src)     # float64 in: must cast, not crash
+    pred.forward()
+    out = pred.get_output(0)
+    assert out.dtype == np.dtype(dtype)
+    assert np.array_equal(out, src.astype(dtype))
+
+
+def test_fp16_params_bind_fp16_program(tmp_path):
+    """An fp16 checkpoint serves an fp16 executor end-to-end: params keep
+    their stored dtype and the data input defaults to the params' common
+    float dtype."""
+    prefix, X, _, _ = _train_tiny(tmp_path)
+    pred32 = create_predictor(prefix, 3, {"data": (4, 6),
+                                          "softmax_label": (4,)})
+    ref = pred32.predict(X[:4])
+    params16 = {k: v.astype(np.float16)
+                for k, v in pred32._arg_params.items()}
+    pred16 = Predictor(open("%s-symbol.json" % prefix).read(), params16,
+                       {"data": (4, 6), "softmax_label": (4,)})
+    assert pred16._exec.arg_dict["data"].dtype == np.float16
+    out = pred16.predict(X[:4])
+    assert out.dtype == np.float16
+    assert np.allclose(out.astype(np.float32), ref, atol=2e-2)
+
+
+def test_predictor_reshape_reuses_cached_executor(tmp_path):
+    """reshape() back to a seen shape set reuses the compiled executor
+    (BucketingModule-style per-shape cache) and all cached executors see
+    a set_params weight swap."""
+    prefix, X, _, _ = _train_tiny(tmp_path)
+    pred = create_predictor(prefix, 3, {"data": (16, 6),
+                                        "softmax_label": (16,)})
+    first = pred._exec
+    out16 = pred.predict(X[:16])
+    pred.reshape({"data": (4, 6), "softmax_label": (4,)})
+    second = pred._exec
+    assert second is not first
+    pred.reshape({"data": (16, 6), "softmax_label": (16,)})
+    assert pred._exec is first, "seen shape must hit the executor cache"
+    assert len(pred._exec_cache) == 2
+    assert np.allclose(pred.predict(X[:16]), out16, atol=1e-6)
+    # weight hot-swap reaches every cached executor
+    zeros = {k: mx.nd.zeros(v.shape, dtype=v.dtype)
+             for k, v in pred._arg_params.items()}
+    pred.set_params(zeros)
+    flat16 = pred.predict(X[:16])
+    pred.reshape({"data": (4, 6), "softmax_label": (4,)})
+    flat4 = pred.predict(X[:4])
+    # all-zero weights => uniform softmax from BOTH executors
+    assert np.allclose(flat16, flat16[0], atol=1e-6)
+    assert np.allclose(flat4, flat16[:4], atol=1e-6)
+
+
+def test_create_predictor_missing_files(tmp_path):
+    prefix, _, _, _ = _train_tiny(tmp_path)
+    with pytest.raises(MXNetError, match="symbol file missing"):
+        create_predictor(str(tmp_path / "nope"), 3, {"data": (4, 6)})
+    # wrong epoch: params missing, existing candidates listed
+    with pytest.raises(MXNetError, match="params file missing.*0003"):
+        create_predictor(prefix, 99, {"data": (4, 6)})
+
+
+def test_create_predictor_corrupt_files(tmp_path):
+    prefix, _, _, _ = _train_tiny(tmp_path)
+    bad = str(tmp_path / "bad")
+    with open(bad + "-symbol.json", "w") as f:
+        f.write('{"nodes": [truncated')
+    with open(bad + "-0003.params", "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(MXNetError, match="symbol file corrupt"):
+        create_predictor(bad, 3, {"data": (4, 6)})
+    import shutil
+    shutil.copy("%s-symbol.json" % prefix, bad + "-symbol.json")
+    with pytest.raises(MXNetError, match="params file corrupt"):
+        create_predictor(bad, 3, {"data": (4, 6)})
 
 
 def test_engine_naive_mode():

@@ -1,0 +1,424 @@
+"""mxnet_tpu.serve: dynamic-batching inference serving (tier-1, CPU).
+
+Covers the subsystem's contracts: concurrent submitters see serial-
+identical outputs; flush on max_batch vs max_delay; deadline expiry;
+overload fast-fail from a bounded queue; admission-time malformed-
+request isolation; hot weight reload with zero dropped or mixed-weights
+requests; drain-on-shutdown; and the profiler.serve_report counters.
+"""
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.predictor import Predictor, create_predictor
+from mxnet_tpu.serve import (ServeClosedError, ServeDeadlineError,
+                             ServeEngine, ServeError, ServeOverloadError,
+                             ServeRequestError, default_buckets)
+
+IN_DIM = 6
+CLASSES = 3
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _save_model(tmp_path, epoch=0, seed=0, name="model"):
+    """Init (no training needed) + save a legacy pair; returns prefix."""
+    net = _net()
+    mx.random.seed(seed)
+    it = mx.io.NDArrayIter(np.zeros((8, IN_DIM), np.float32),
+                           np.zeros(8, np.float32), batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0),
+                    force_init=True)
+    arg, aux = mod.get_params()
+    prefix = str(tmp_path / name)
+    mx.model.save_checkpoint(prefix, epoch, net, arg, aux)
+    return prefix
+
+
+def _serial(prefix, epoch, X):
+    """Reference outputs: batch-1 Predictor.predict per row."""
+    pred = create_predictor(prefix, epoch, {"data": (1, IN_DIM),
+                                            "softmax_label": (1,)})
+    return np.stack([pred.predict(X[i:i + 1])[0] for i in range(len(X))])
+
+
+def _engine(prefix, epoch=0, **kw):
+    kw.setdefault("batch_buckets", (1, 2, 4, 8))
+    kw.setdefault("max_delay_ms", 5.0)
+    kw.setdefault("name", "test")
+    return ServeEngine.from_checkpoint(
+        prefix, epoch, {"data": (1, IN_DIM), "softmax_label": (1,)}, **kw)
+
+
+@pytest.fixture(scope="module")
+def model(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serve_model")
+    prefix = _save_model(tmp, epoch=0, seed=0)
+    X = np.random.RandomState(7).randn(96, IN_DIM).astype(np.float32)
+    return prefix, X, _serial(prefix, 0, X)
+
+
+def test_concurrent_submitters_match_serial(model):
+    prefix, X, serial = model
+    eng = _engine(prefix)
+    try:
+        results = [None] * len(X)
+
+        def client(lo, hi):
+            for i in range(lo, hi):
+                results[i] = eng.predict(X[i], timeout=30)
+
+        n_threads = 8
+        per = len(X) // n_threads
+        with ThreadPoolExecutor(n_threads) as pool:
+            list(pool.map(lambda t: client(t * per, (t + 1) * per),
+                          range(n_threads)))
+        for i in range(n_threads * per):
+            assert np.allclose(results[i], serial[i], atol=1e-5), i
+        rep = eng.stats.report()
+        assert rep["completed"] == n_threads * per
+        assert rep["failed"] == 0 and rep["expired"] == 0
+        assert rep["batches"] >= 1
+    finally:
+        eng.close()
+
+
+def test_flush_on_max_batch_beats_delay(model):
+    """A full bucket dispatches immediately — the 1s delay window never
+    runs out."""
+    prefix, X, serial = model
+    eng = _engine(prefix, max_delay_ms=1000.0)
+    try:
+        t0 = time.perf_counter()
+        futs = [eng.submit(X[i]) for i in range(8)]
+        rows = [f.result(timeout=30) for f in futs]
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.5, "full batch waited out the delay window"
+        for i in range(8):
+            assert np.allclose(rows[i], serial[i], atol=1e-5)
+        assert eng.stats.report()["bucket_hits"].get(8, 0) >= 1
+    finally:
+        eng.close()
+
+
+def test_flush_on_max_delay_with_padding(model):
+    """3 requests < max_batch flush at the delay deadline, padded into
+    the 4-bucket."""
+    prefix, X, serial = model
+    eng = _engine(prefix, max_delay_ms=30.0)
+    try:
+        futs = eng.submit_many([X[0], X[1], X[2]])
+        rows = [f.result(timeout=30) for f in futs]
+        for i in range(3):
+            assert np.allclose(rows[i], serial[i], atol=1e-5)
+        rep = eng.stats.report()
+        assert rep["bucket_hits"].get(4, 0) >= 1
+        assert rep["pad_waste_frac"] > 0.0
+        assert rep["batch_occupancy"] < 1.0
+    finally:
+        eng.close()
+
+
+def test_deadline_expiry(model):
+    """A request whose deadline lapses in the queue fails with
+    ServeDeadlineError — promptly, not after the full delay window."""
+    prefix, X, _ = model
+    eng = _engine(prefix, max_delay_ms=500.0, deadline_ms=10.0)
+    try:
+        t0 = time.perf_counter()
+        fut = eng.submit(X[0])      # alone: can only flush at deadline
+        with pytest.raises(ServeDeadlineError):
+            fut.result(timeout=30)
+        assert time.perf_counter() - t0 < 0.4, \
+            "expiry waited out the 500ms delay window"
+        assert eng.stats.report()["expired"] == 1
+    finally:
+        eng.close()
+
+
+def test_per_request_deadline_override(model):
+    prefix, X, serial = model
+    eng = _engine(prefix, max_delay_ms=5.0, deadline_ms=5000.0)
+    try:
+        ok = eng.submit(X[0])
+        doomed = eng.submit(X[1], deadline_ms=0.001)
+        assert np.allclose(ok.result(timeout=30), serial[0], atol=1e-5)
+        with pytest.raises(ServeDeadlineError):
+            doomed.result(timeout=30)
+    finally:
+        eng.close()
+
+
+def test_overload_fast_fail(model):
+    """Bounded queue: once the in-flight batch and the queue are full,
+    submit raises ServeOverloadError immediately instead of hanging —
+    and every ADMITTED request still completes."""
+    prefix, X, serial = model
+    eng = _engine(prefix, batch_buckets=(1, 2), max_delay_ms=2.0,
+                  queue_depth=2, deadline_ms=0)
+    try:
+        admitted = []
+        with eng.pause():       # dispatcher blocks between batches
+            t0 = time.perf_counter()
+            with pytest.raises(ServeOverloadError):
+                for i in range(32):
+                    admitted.append(eng.submit(X[i % len(X)]))
+            reject_elapsed = time.perf_counter() - t0
+        assert reject_elapsed < 1.0, "overload rejection was not fast"
+        # max_batch(2) in flight + queue_depth(2) is the admission cap
+        assert len(admitted) <= 4
+        assert eng.stats.report()["overloaded"] >= 1
+        for i, f in enumerate(admitted):
+            assert np.allclose(f.result(timeout=30),
+                               serial[i % len(X)], atol=1e-5)
+    finally:
+        eng.close()
+
+
+def test_malformed_request_isolation(model):
+    """Bad shape/dtype is rejected at admission, in the caller's thread;
+    concurrent good requests are untouched (failed counter stays 0)."""
+    prefix, X, serial = model
+    eng = _engine(prefix)
+    try:
+        good = eng.submit_many([X[i] for i in range(8)])
+        with pytest.raises(ServeRequestError):
+            eng.submit(np.zeros((IN_DIM + 1,), np.float32))   # wrong shape
+        with pytest.raises(ServeRequestError):
+            eng.submit(np.zeros((2, IN_DIM), np.float32))     # batch dim
+        with pytest.raises(ServeRequestError):
+            eng.submit(np.array(["a"] * IN_DIM))              # non-numeric
+        for i, f in enumerate(good):
+            assert np.allclose(f.result(timeout=30), serial[i], atol=1e-5)
+        rep = eng.stats.report()
+        assert rep["failed"] == 0
+        assert rep["completed"] >= 8
+    finally:
+        eng.close()
+
+
+def test_hot_reload_parity_and_no_mixed_weights(model, tmp_path):
+    """reload() swaps weights between batches: before the swap every
+    output matches the old weights, after it the new ones — and under a
+    concurrent flood, EVERY row matches exactly one version (a mixed-
+    weights forward would match neither)."""
+    prefix, X, serial_v1 = model
+    prefix2 = _save_model(tmp_path, epoch=1, seed=99, name="model2")
+    serial_v2 = _serial(prefix2, 1, X)
+    # the two versions genuinely disagree, else the test proves nothing
+    assert not np.allclose(serial_v1, serial_v2, atol=1e-3)
+    eng = _engine(prefix)
+    try:
+        assert np.allclose(eng.predict(X[0], timeout=30), serial_v1[0],
+                           atol=1e-5)
+        results = [None] * len(X)
+        errors = []
+
+        def client(lo, hi):
+            try:
+                for i in range(lo, hi):
+                    results[i] = eng.predict(X[i], timeout=30)
+            except Exception as e:      # pragma: no cover - fail loud below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client,
+                                    args=(t * 12, (t + 1) * 12))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        version = eng.reload_from_checkpoint(prefix2, 1)   # mid-flood
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert version == 1 and eng.weights_version == 1
+        for i in range(96):
+            old = np.allclose(results[i], serial_v1[i], atol=1e-5)
+            new = np.allclose(results[i], serial_v2[i], atol=1e-5)
+            assert old or new, \
+                "request %d matches NEITHER weights version (mixed?)" % i
+        # steady state after the swap: new weights only
+        assert np.allclose(eng.predict(X[1], timeout=30), serial_v2[1],
+                           atol=1e-5)
+        assert eng.stats.report()["reloads"] == 1
+    finally:
+        eng.close()
+
+
+def test_reload_from_checkpoint_dir(model, tmp_path):
+    """Hot reload straight from a mxnet_tpu.checkpoint store (and
+    from_checkpoint_dir construction) matches the module that saved it."""
+    prefix, X, _ = model
+    from mxnet_tpu.checkpoint import CheckpointManager, save_module
+    net = _net()
+    mx.random.seed(5)
+    it = mx.io.NDArrayIter(np.zeros((8, IN_DIM), np.float32),
+                           np.zeros(8, np.float32), batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", magnitude=2.0),
+                    force_init=True)
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    store = str(tmp_path / "ckpt_store")
+    with CheckpointManager(store, async_save=False, name="serve-test") as m:
+        save_module(m, mod, step=7)
+    arg, aux = mod.get_params()
+    ref_prefix = str(tmp_path / "ref")
+    mx.model.save_checkpoint(ref_prefix, 0, net, arg, aux)
+    ref = _serial(ref_prefix, 0, X[:8])
+
+    eng = _engine(prefix)
+    try:
+        eng.reload_from_checkpoint_dir(store)
+        for i in range(8):
+            assert np.allclose(eng.predict(X[i], timeout=30), ref[i],
+                               atol=1e-5), i
+    finally:
+        eng.close()
+    eng2 = ServeEngine.from_checkpoint_dir(
+        store, _net(), {"data": (1, IN_DIM), "softmax_label": (1,)},
+        batch_buckets=(1, 2), max_delay_ms=5.0, name="from-dir")
+    try:
+        assert np.allclose(eng2.predict(X[0], timeout=30), ref[0],
+                           atol=1e-5)
+    finally:
+        eng2.close()
+
+
+def test_drain_on_shutdown(model):
+    """close(drain=True) completes every queued request; later submits
+    fail with ServeClosedError."""
+    prefix, X, serial = model
+    eng = _engine(prefix, max_delay_ms=200.0)
+    try:
+        futs = eng.submit_many([X[i] for i in range(6)])
+        eng.close()     # drains: partial batch flushes now, not at 200ms
+        for i, f in enumerate(futs):
+            assert np.allclose(f.result(timeout=30), serial[i], atol=1e-5)
+        with pytest.raises(ServeClosedError):
+            eng.submit(X[0])
+    finally:
+        eng.close()
+
+
+def test_close_without_drain_fails_pending(model):
+    prefix, X, _ = model
+    eng = _engine(prefix, batch_buckets=(1, 2), max_delay_ms=500.0,
+                  queue_depth=64)
+    # close() joins the worker threads, and the dispatcher needs the
+    # pause (swap) lock to finish its in-flight batch — so close from a
+    # helper thread and release the pause while it drains
+    closer = threading.Thread(target=lambda: eng.close(drain=False))
+    with eng.pause():
+        futs = eng.submit_many([X[i] for i in range(6)])
+        time.sleep(0.1)         # dispatcher absorbs <= max_batch in flight
+        closer.start()
+        time.sleep(0.1)         # close clears the queue under the lock
+    closer.join(timeout=30)
+    assert not closer.is_alive()
+    failed = 0
+    for f in futs:
+        try:
+            f.result(timeout=30)
+        except ServeClosedError:
+            failed += 1
+    # requests still in the bounded queue (not yet absorbed into the
+    # in-flight batch) must be failed, not leaked
+    assert failed >= 1
+
+
+def test_serve_report_counters(model):
+    prefix, X, _ = model
+    eng = _engine(prefix, name="report-engine")
+    try:
+        for f in eng.submit_many([X[i] for i in range(8)]):
+            f.result(timeout=30)
+        rep = mx.profiler.serve_report()
+        keys = [k for k in rep if k.startswith("report-engine#")]
+        assert keys, "engine not registered with mx.profiler"
+        r = rep[keys[-1]]
+        assert r["submitted"] == 8 and r["completed"] == 8
+        assert r["latency_p99_ms"] >= r["latency_p50_ms"] > 0
+        assert 0.0 < r["batch_occupancy"] <= 1.0
+        assert sum(b * n for b, n in r["bucket_hits"].items()) >= 8
+        s = mx.profiler.serve_report_str()
+        assert "report-engine" in s and "p99" in s
+    finally:
+        eng.close()
+    del eng     # the engine (and its batcher cycle) owns the stats ref
+    import gc
+    gc.collect()
+    assert not any(k.startswith("report-engine#")
+                   for k in mx.profiler.serve_report()), \
+        "dead engine should drop out of the weak registry"
+
+
+def test_default_buckets_and_env_knobs(model, monkeypatch):
+    assert default_buckets(8) == (1, 2, 4, 8)
+    assert default_buckets(6) == (1, 2, 4, 6)
+    assert default_buckets(1) == (1,)
+    prefix, X, serial = model
+    monkeypatch.setenv("MXNET_SERVE_MAX_BATCH", "4")
+    monkeypatch.setenv("MXNET_SERVE_MAX_DELAY_MS", "7.5")
+    monkeypatch.setenv("MXNET_SERVE_QUEUE_DEPTH", "9")
+    monkeypatch.setenv("MXNET_SERVE_DEADLINE_MS", "1234")
+    eng = ServeEngine.from_checkpoint(
+        prefix, 0, {"data": (1, IN_DIM), "softmax_label": (1,)},
+        name="env-knobs")
+    try:
+        assert eng.buckets == (1, 2, 4)
+        assert eng.max_batch_size == 4
+        assert eng.max_delay_ms == 7.5
+        assert eng.queue_depth == 9
+        assert eng.deadline_ms == 1234.0
+        assert np.allclose(eng.predict(X[0], timeout=30), serial[0],
+                           atol=1e-5)
+    finally:
+        eng.close()
+
+
+def test_close_inside_pause_raises_not_deadlocks(model):
+    """close() joins the dispatcher, which needs the paused lock for its
+    in-flight batch — calling it inside pause() must raise, not hang;
+    reload() inside pause() nests fine (RLock)."""
+    prefix, X, serial = model
+    eng = _engine(prefix)
+    try:
+        with eng.pause():
+            eng.reload_from_checkpoint(prefix, 0)   # nested acquire: ok
+            with pytest.raises(ServeError, match="deadlock"):
+                eng.close()
+        assert eng.pending_requests() == 0
+        # the refused close must not have half-closed anything
+        assert np.allclose(eng.predict(X[0], timeout=30), serial[0],
+                           atol=1e-5)
+    finally:
+        eng.close()
+
+
+def test_no_compiles_in_serving_loop(model):
+    """Every bucket executable is compiled at construction: the predictor
+    executor cache is fully populated before the first submit."""
+    prefix, X, _ = model
+    eng = _engine(prefix, batch_buckets=(1, 2, 4))
+    try:
+        assert len(eng._predictor._exec_cache) == 3
+        execs_before = set(id(e) for e in eng._predictor._exec_cache.values())
+        for f in eng.submit_many([X[i] for i in range(9)]):
+            f.result(timeout=30)
+        execs_after = set(id(e) for e in eng._predictor._exec_cache.values())
+        assert execs_before == execs_after, "serving rebound an executor"
+    finally:
+        eng.close()
