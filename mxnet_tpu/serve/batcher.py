@@ -9,8 +9,15 @@ D2H copy (the ``score()`` deferred-sync pattern from the superstep PR).
 Flush rules (TF-Serving style batching): a batch is dispatched when it
 reaches ``max_batch_size`` OR when the oldest queued request has waited
 ``max_delay_ms`` — whichever comes first.  The delay window is further
-capped by the oldest request's deadline, so a doomed request fails at
-its deadline instead of after a pointless full window.
+capped by the TIGHTEST deadline in the partial batch (recomputed as
+requests join it), so a doomed request fails at its deadline instead of
+after a pointless full window — even when it is queued behind a
+deadline-less head request.
+
+Client cancellation: a ``fut.cancel()`` on a still-queued request wins —
+the dispatcher claims each future with ``set_running_or_notify_cancel``
+and silently drops the ones a client already cancelled, so a routine
+cancel can never raise ``InvalidStateError`` inside a worker thread.
 
 Admission control happens in the CALLER's thread inside ``submit``:
 
@@ -33,7 +40,7 @@ import collections
 import queue as _queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Callable, List, Optional
 
 from .errors import (ServeClosedError, ServeDeadlineError, ServeError,
@@ -44,6 +51,24 @@ __all__ = ["MicroBatcher"]
 # dispatcher wakeup period while idle: bounds shutdown latency, not
 # request latency (a submit notifies the condition variable directly)
 _IDLE_POLL_S = 0.05
+
+
+def _set_result(fut: Future, result) -> bool:
+    """Resolve a future, tolerating a racing client ``cancel()``: the
+    worker threads must survive any future state a client can produce."""
+    try:
+        fut.set_result(result)
+        return True
+    except InvalidStateError:
+        return False
+
+
+def _set_exception(fut: Future, exc: BaseException) -> bool:
+    try:
+        fut.set_exception(exc)
+        return True
+    except InvalidStateError:
+        return False
 
 
 class _Request:
@@ -151,16 +176,32 @@ class MicroBatcher:
             if not self._q:
                 return None
             batch = [self._q.popleft()]
-            while self._q and len(batch) < self._max_batch_size:
-                batch.append(self._q.popleft())
-        if len(batch) >= self._max_batch_size:
-            return batch
-        flush_at = batch[0].enqueue_t + self._max_delay_s
-        if batch[0].deadline_t is not None:
-            # no point holding the window open past the point the oldest
-            # request is dead anyway
-            flush_at = min(flush_at, batch[0].deadline_t)
-        while len(batch) < self._max_batch_size:
+        while True:
+            # client-cancelled requests are dead weight awaiting their
+            # drop at dispatch: they neither fill the batch nor cap the
+            # flush window with their deadlines.  Backfill their slots
+            # from the queue BEFORE any window arithmetic — a backlog
+            # never waits out the window
+            with self._cv:
+                live = [r for r in batch if not r.future.cancelled()]
+                while self._q and len(live) < self._max_batch_size:
+                    r = self._q.popleft()
+                    batch.append(r)
+                    if not r.future.cancelled():
+                        live.append(r)
+            if len(live) >= self._max_batch_size:
+                break
+            # no point holding the window open past the point ANY live
+            # request is dead anyway — recomputed as requests join, so
+            # a tight-deadline request queued behind a deadline-less
+            # head still fails promptly
+            # anchored at the oldest LIVE arrival: a cancelled head must
+            # not burn the coalescing window of the requests behind it
+            flush_at = (live[0] if live else batch[0]).enqueue_t \
+                + self._max_delay_s
+            for r in live:
+                if r.deadline_t is not None and r.deadline_t < flush_at:
+                    flush_at = r.deadline_t
             timeout = flush_at - time.perf_counter()
             if timeout <= 0:
                 break
@@ -169,8 +210,6 @@ class MicroBatcher:
                     if self._closed:
                         break       # draining: flush partial batches now
                     self._cv.wait(timeout)
-                while self._q and len(batch) < self._max_batch_size:
-                    batch.append(self._q.popleft())
         return batch
 
     def _dispatch_loop(self) -> None:
@@ -182,17 +221,24 @@ class MicroBatcher:
                 self._stats.set_queue_depth(self.queue_depth())
             now = time.perf_counter()
             live = []
+            cancelled = 0
             for r in batch:
-                if r.deadline_t is not None and now > r.deadline_t:
+                # claim the future: a client fut.cancel() on a queued
+                # request wins here and the request is dropped
+                if not r.future.set_running_or_notify_cancel():
+                    cancelled += 1
+                elif r.deadline_t is not None and now > r.deadline_t:
                     if self._stats is not None:
                         self._stats.on_expired(1)
-                    r.future.set_exception(ServeDeadlineError(
+                    _set_exception(r.future, ServeDeadlineError(
                         "deadline exceeded: %.1f ms in queue against a "
                         "%.1f ms deadline"
                         % ((now - r.enqueue_t) * 1e3,
                            (r.deadline_t - r.enqueue_t) * 1e3)))
                 else:
                     live.append(r)
+            if cancelled and self._stats is not None:
+                self._stats.on_cancelled(cancelled)
             if not live:
                 continue
             try:
@@ -211,15 +257,25 @@ class MicroBatcher:
                 break
             live, handoff = item
             try:
-                results = self._finish(handoff)
+                # list() also guards against a None / generator / unsized
+                # return — any contract breach must land in _fail, never
+                # escape and kill this thread
+                results = list(self._finish(handoff))
             except BaseException as e:
                 self._fail(live, e)
+                continue
+            if len(results) != len(live):
+                # engine contract bug: fail everyone rather than leave
+                # the surplus futures unresolved (clients hang forever)
+                self._fail(live, ServeError(
+                    "engine returned %d results for a %d-request batch"
+                    % (len(results), len(live))))
                 continue
             now = time.perf_counter()
             lat = []
             for r, res in zip(live, results):
-                r.future.set_result(res)
-                lat.append((now - r.enqueue_t) * 1e3)
+                if _set_result(r.future, res):
+                    lat.append((now - r.enqueue_t) * 1e3)
             if self._stats is not None:
                 self._stats.on_complete(lat)
 
@@ -229,26 +285,51 @@ class MicroBatcher:
         if not isinstance(exc, Exception):
             exc = ServeError("serve worker died: %r" % (exc,))
         for r in reqs:
-            r.future.set_exception(exc)
+            _set_exception(r.future, exc)
 
     # -- lifecycle ---------------------------------------------------------
-    def close(self, drain: bool = True) -> None:
-        """Stop admissions; drain (default) or fail queued requests; join
-        both worker threads.  Idempotent."""
+    def is_worker_thread(self) -> bool:
+        """True when called from the dispatcher or completion thread —
+        e.g. from a future done-callback, which the completion thread
+        runs inline from set_result/set_exception."""
+        return threading.current_thread() in (self._dispatcher,
+                                              self._completer)
+
+    def request_close(self, drain: bool = True) -> None:
+        """Stop admissions and ask the workers to shut down, WITHOUT
+        joining them — safe to call from the worker threads themselves
+        (a future done-callback closing the server).  Idempotent."""
         with self._cv:
-            already = self._closed
             self._closed = True
             dropped = [] if drain else list(self._q)
             if not drain:
                 self._q.clear()
             self._cv.notify_all()
+        failed = cancelled = 0
         for r in dropped:
-            r.future.set_exception(ServeClosedError(
-                "serve engine %r closed before this request was "
-                "dispatched" % self.name))
-        if self._stats is not None and dropped:
-            self._stats.on_failed(len(dropped))
-        if already:
-            return
+            if _set_exception(r.future, ServeClosedError(
+                    "serve engine %r closed before this request was "
+                    "dispatched" % self.name)):
+                failed += 1
+            else:               # client cancelled it while it was queued
+                cancelled += 1
+        if self._stats is not None:
+            if failed:
+                self._stats.on_failed(failed)
+            if cancelled:
+                self._stats.on_cancelled(cancelled)
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admissions; drain (default) or fail queued requests; join
+        both worker threads.  Idempotent.  From a worker thread (a future
+        done-callback) this degrades to :meth:`request_close` — a worker
+        cannot wait for itself, nor for its peer, who may be
+        backpressured on work this thread still has to consume."""
+        self.request_close(drain=drain)
+        if self.is_worker_thread():
+            return      # shutdown requested; the threads exit on their own
+        # always join (a no-op once the threads are dead): a concurrent
+        # second closer returns only after shutdown really finished,
+        # instead of racing the first one
         self._dispatcher.join()
         self._completer.join()

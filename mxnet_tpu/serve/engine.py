@@ -134,6 +134,11 @@ class ServeEngine:
         # deadlock (close joins the dispatcher, which needs this lock).
         self._swap_lock = threading.RLock()
         self._pause_owner: Optional[int] = None
+        # serializes close(): every closer returns only after shutdown
+        # actually finished, not merely after some other thread STARTED
+        # it.  RLock: a drop-on-close done-callback runs inline on the
+        # closer's own thread and may close() again (see close()).
+        self._close_lock = threading.RLock()
         # per-bucket shape dicts, built once: _run_batch is the hot loop
         self._shapes_by_bucket = {b: self._bucket_shapes(b)
                                   for b in self._buckets}
@@ -293,7 +298,8 @@ class ServeEngine:
         queued requests wait, admissions keep their overload semantics.
         For maintenance windows and deterministic tests.  reload() and
         nested pause() are fine inside; close() is not (it would join a
-        dispatcher blocked on this lock) and raises instead of hanging."""
+        dispatcher blocked on this lock) and raises instead of hanging.
+        A close() from another thread blocks until the pause exits."""
         with self._swap_lock:
             prev = self._pause_owner
             self._pause_owner = threading.get_ident()
@@ -316,16 +322,39 @@ class ServeEngine:
     def close(self, drain: bool = True) -> None:
         """Graceful shutdown: stop admissions, drain queued requests
         (partial batches flush immediately), join the worker threads.
-        ``drain=False`` fails queued requests with ServeClosedError."""
+        ``drain=False`` fails queued requests with ServeClosedError.
+
+        Thread-safe and idempotent: concurrent closers serialize, and
+        every one of them returns only after shutdown completed.  A
+        close() from the thread that holds ``pause()`` raises (guaranteed
+        deadlock); a close() from ANOTHER thread while a pause is held
+        simply blocks until the pause exits — the dispatcher needs the
+        paused lock to finish its in-flight batch before it can be
+        joined (see ``test_close_without_drain_fails_pending``)."""
         if self._pause_owner == threading.get_ident():
             raise ServeError(
                 "close() inside pause() would deadlock: the dispatcher "
                 "needs the paused lock to finish its in-flight batch — "
                 "exit pause() first (or close from another thread)")
-        if self._closed:
+        if self._batcher.is_worker_thread():
+            # reentrant close from a future done-callback (run inline on
+            # the completion thread): request shutdown without joining or
+            # taking the close lock — an outer closer may hold it while
+            # joining this very thread
+            self._batcher.request_close(drain=drain)
             return
-        self._closed = True
-        self._batcher.close(drain=drain)
+        with self._close_lock:
+            # _closed is flipped BEFORE the batcher shutdown: close(
+            # drain=False) fails dropped futures whose done-callbacks run
+            # inline on THIS thread and may close() again — the RLock
+            # re-enters and this guard returns.  For a concurrent closer
+            # the guard is race-free: it acquires the lock only after the
+            # first closer finished the joins, so returning early here
+            # still means shutdown completed.
+            if self._closed:
+                return
+            self._closed = True
+            self._batcher.close(drain=drain)
 
     def __enter__(self):
         return self

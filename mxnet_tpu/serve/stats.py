@@ -13,8 +13,8 @@ checkpoint / superstep report family):
   waste means the bucket grid is too coarse for the arrival pattern);
 * **per-bucket hit counts** — which compiled programs serve the
   traffic;
-* **queue depth** (live + high-water) and the reject/expiry/failure
-  counters that tell overload apart from client impatience.
+* **queue depth** (live + high-water) and the reject/expiry/cancel/
+  failure counters that tell overload apart from client impatience.
 """
 from __future__ import annotations
 
@@ -52,6 +52,7 @@ class ServeStats:
         self._completed = 0
         self._overloaded = 0
         self._expired = 0
+        self._cancelled = 0
         self._failed = 0
         self._reloads = 0
         self._batches = 0
@@ -77,6 +78,10 @@ class ServeStats:
     def on_expired(self, n: int) -> None:
         with self._lock:
             self._expired += n
+
+    def on_cancelled(self, n: int) -> None:
+        with self._lock:
+            self._cancelled += n
 
     def on_failed(self, n: int) -> None:
         with self._lock:
@@ -112,6 +117,7 @@ class ServeStats:
                 "completed": self._completed,
                 "overloaded": self._overloaded,
                 "expired": self._expired,
+                "cancelled": self._cancelled,
                 "failed": self._failed,
                 "reloads": self._reloads,
                 "batches": self._batches,
@@ -136,14 +142,16 @@ class ServeStats:
                             for b, n in r["bucket_hits"].items()) or "-"
         return ("serve engine %r\n"
                 "  requests: %d submitted / %d completed "
-                "(%d overloaded, %d expired, %d failed), %d reloads\n"
+                "(%d overloaded, %d expired, %d cancelled, %d failed), "
+                "%d reloads\n"
                 "  latency ms: p50 %.2f  p95 %.2f  p99 %.2f\n"
                 "  batches: %d, occupancy %.2f of max %d, "
                 "pad waste %.1f%%\n"
                 "  bucket hits: %s\n"
                 "  queue depth: %d now / %d high-water" % (
                     self.name, r["submitted"], r["completed"],
-                    r["overloaded"], r["expired"], r["failed"], r["reloads"],
+                    r["overloaded"], r["expired"], r["cancelled"],
+                    r["failed"], r["reloads"],
                     r["latency_p50_ms"], r["latency_p95_ms"],
                     r["latency_p99_ms"], r["batches"], r["batch_occupancy"],
                     self.max_batch_size, 100.0 * r["pad_waste_frac"],

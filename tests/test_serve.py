@@ -188,6 +188,144 @@ def test_overload_fast_fail(model):
         eng.close()
 
 
+def test_client_cancel_does_not_wedge_engine(model):
+    """fut.cancel() on a queued request wins and is dropped at dispatch;
+    it must never kill a worker thread (InvalidStateError) — the engine
+    keeps serving and close() still returns."""
+    prefix, X, serial = model
+    eng = _engine(prefix, batch_buckets=(1, 2), max_delay_ms=2.0,
+                  queue_depth=8)
+    try:
+        with eng.pause():       # hold dispatch so requests stay queued
+            futs = eng.submit_many([X[i] for i in range(6)])
+            time.sleep(0.1)     # dispatcher absorbs <= max_batch in flight
+            cancelled = [f for f in futs if f.cancel()]
+            assert cancelled, "no queued future was cancellable"
+        for f in futs:
+            if not f.cancelled():
+                f.result(timeout=30)    # survivors still complete
+        # the engine is not wedged: later requests serve normally
+        assert np.allclose(eng.predict(X[0], timeout=30), serial[0],
+                           atol=1e-5)
+        rep = eng.stats.report()
+        assert rep["cancelled"] == len(cancelled)
+        assert rep["failed"] == 0
+    finally:
+        eng.close()     # must not hang on dead/wedged worker threads
+
+
+def test_result_count_mismatch_fails_batch(model):
+    """If the engine returns fewer results than requests (contract bug),
+    the whole batch fails with ServeError instead of leaving the surplus
+    futures unresolved forever."""
+    prefix, X, _ = model
+    eng = _engine(prefix)
+    orig = eng._batcher._finish
+    try:
+        eng._batcher._finish = lambda handoff: orig(handoff)[:-1]
+        futs = eng.submit_many([X[i] for i in range(4)])
+        for f in futs:
+            with pytest.raises(ServeError):
+                f.result(timeout=30)
+        assert eng.stats.report()["failed"] >= 4
+    finally:
+        eng._batcher._finish = orig
+        eng.close()
+
+
+def test_tight_deadline_behind_deadline_less_head(model):
+    """The flush window is capped by the TIGHTEST deadline in the
+    partial batch: a doomed request queued behind a deadline-less head
+    fails at its own deadline, not after the full 500ms delay window."""
+    prefix, X, serial = model
+    eng = _engine(prefix, max_delay_ms=500.0, deadline_ms=0)
+    try:
+        t0 = time.perf_counter()
+        head = eng.submit(X[0])                       # no deadline
+        doomed = eng.submit(X[1], deadline_ms=10.0)   # queued behind it
+        with pytest.raises(ServeDeadlineError):
+            doomed.result(timeout=30)
+        assert np.allclose(head.result(timeout=30), serial[0], atol=1e-5)
+        assert time.perf_counter() - t0 < 0.4, \
+            "doomed request waited out the 500ms delay window"
+    finally:
+        eng.close()
+
+
+def test_concurrent_close(model):
+    """close() from several threads at once: all return, none before
+    shutdown completed, and the engine ends up closed exactly once."""
+    prefix, X, serial = model
+    eng = _engine(prefix)
+    futs = eng.submit_many([X[i] for i in range(4)])
+    closers = [threading.Thread(target=eng.close) for _ in range(4)]
+    for t in closers:
+        t.start()
+    for t in closers:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in closers)
+    for i, f in enumerate(futs):    # drained, not dropped
+        assert np.allclose(f.result(timeout=30), serial[i], atol=1e-5)
+    with pytest.raises(ServeClosedError):
+        eng.submit(X[0])
+
+
+def test_close_from_done_callback_does_not_deadlock(model):
+    """A future done-callback (run inline on the completion thread) may
+    close the engine — 'shut down after the last response' — while an
+    outer closer holds the close lock joining that very thread: the
+    reentrant close must degrade to a non-joining shutdown request, not
+    deadlock."""
+    prefix, X, serial = model
+    eng = _engine(prefix, batch_buckets=(1, 2), max_delay_ms=2.0,
+                  queue_depth=16)
+    cb_ran = []
+    with eng.pause():
+        futs = eng.submit_many([X[i] for i in range(6)])
+        for f in futs:
+            f.add_done_callback(lambda f: (eng.close(), cb_ran.append(1)))
+        closer = threading.Thread(target=eng.close)
+        closer.start()      # joins the workers once the pause exits
+        time.sleep(0.05)
+    closer.join(timeout=30)
+    assert not closer.is_alive(), "close deadlocked on a callback close"
+    assert len(cb_ran) == len(futs), "a done-callback close hung"
+    for i, f in enumerate(futs):    # drained, every request served
+        assert np.allclose(f.result(timeout=30), serial[i], atol=1e-5)
+    with pytest.raises(ServeClosedError):
+        eng.submit(X[0])
+
+
+def test_close_drain_false_callback_reentrancy(model):
+    """close(drain=False) fails dropped futures whose done-callbacks run
+    inline on the CLOSER's own thread; a callback that closes again must
+    re-enter and return, not self-deadlock on the close lock."""
+    prefix, X, _ = model
+    eng = _engine(prefix, batch_buckets=(1, 2), max_delay_ms=500.0,
+                  queue_depth=16)
+    reentered = []
+    with eng.pause():
+        futs = eng.submit_many([X[i] for i in range(6)])
+        time.sleep(0.1)     # dispatcher absorbs <= max_batch in flight
+        for f in futs:
+            f.add_done_callback(lambda f: (eng.close(drain=False),
+                                           reentered.append(1)))
+        closer = threading.Thread(target=lambda: eng.close(drain=False))
+        closer.start()
+        time.sleep(0.2)     # drop path runs callbacks on the closer thread
+    closer.join(timeout=30)
+    assert not closer.is_alive(), "close self-deadlocked on a callback"
+    assert len(reentered) == len(futs), "a reentrant close hung"
+    outcomes = {"served": 0, "dropped": 0}
+    for f in futs:
+        try:
+            f.result(timeout=30)
+            outcomes["served"] += 1
+        except ServeClosedError:
+            outcomes["dropped"] += 1
+    assert outcomes["dropped"] >= 1 and sum(outcomes.values()) == len(futs)
+
+
 def test_malformed_request_isolation(model):
     """Bad shape/dtype is rejected at admission, in the caller's thread;
     concurrent good requests are untouched (failed counter stays 0)."""
