@@ -443,6 +443,17 @@ def main():
         line.update(serve_run(feed=_feed_watchdog))
     except Exception as e:
         sys.stderr.write("bench: serve leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
+    # compile / cold-start leg (mxnet_tpu.compile_cache): cold-process vs
+    # warm-cache construction of the serve bucket grid and a 4-bucket
+    # LSTM BucketingModule (acceptance: compile_cache_speedup >= 2 with
+    # hit rate 1.0 on the warm leg)
+    try:
+        from bench_compile import run as compile_run
+        _feed_watchdog("compile")
+        line.update(compile_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: compile leg failed (%s)\n" % e)
     _wd.stop()
     print(json.dumps(line), flush=True)
 

@@ -56,6 +56,7 @@ from .plugins import torch_bridge as th
 from . import native_io
 from . import feed
 from . import checkpoint
+from . import compile_cache
 from . import predictor
 from . import serve
 from . import profiler

@@ -1,0 +1,137 @@
+"""Cache keying: what makes two compilations interchangeable.
+
+A serialized executable may be reused only when everything that went
+into producing it is identical.  The key is a sha256 over:
+
+* the lowered program text (StableHLO from ``jit(...).lower(...)``) —
+  shapes, dtypes, donation/aliasing, compute_dtype casts, remat, the
+  whole traced graph are all in here;
+* jax + jaxlib versions (executable wire format is not stable across
+  releases);
+* backend platform + device kind + device topology (an executable
+  compiled for one chip layout must never load on another);
+* compile-relevant flags: ``XLA_FLAGS`` plus the ``MXNET_*`` knobs that
+  steer program construction (belt and braces — they already change the
+  lowered text, but a missed one must widen the key, not alias it).
+
+Anything that does not match hashes to a different key, which reads as
+a clean miss — the failure mode is always "compile again", never "run
+the wrong program".
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Iterable, Optional
+
+# MXNET knobs that steer how programs are built/compiled.  Most alter the
+# traced graph (and therefore the lowered text) anyway; keying on them
+# directly costs nothing and protects against representation coincidences.
+COMPILE_RELEVANT_ENV = (
+    "MXNET_BACKWARD_DO_MIRROR",
+    "MXNET_COMPUTE_DTYPE",
+    "MXNET_EXEC_PREFER_BULK_EXEC",
+    "MXNET_FUSED_TRAIN",
+    "MXNET_LSTM_SCAN",
+    "MXNET_SHARD_WEIGHT_UPDATE",
+    "MXNET_SUPERSTEP",
+    "XLA_FLAGS",
+)
+
+_env_fp_cache: Optional[str] = None
+
+
+def environment_fingerprint(refresh: bool = False) -> str:
+    """One string describing everything key-relevant OUTSIDE the program
+    text: versions, backend, topology, flags.  Computed once per process
+    (the backend cannot change under us; env mutations mid-process are a
+    test-only affair and use ``refresh=True``)."""
+    global _env_fp_cache
+    if _env_fp_cache is not None and not refresh:
+        return _env_fp_cache
+    import jax
+    import jaxlib
+    devs = jax.devices()
+    parts = [
+        "jax=%s" % jax.__version__,
+        "jaxlib=%s" % jaxlib.__version__,
+        "platform=%s" % devs[0].platform,
+        "device_kind=%s" % getattr(devs[0], "device_kind", "?"),
+        "topology=%s" % ",".join(str(d.id) for d in devs),
+        "processes=%d" % jax.process_count(),
+    ]
+    for name in COMPILE_RELEVANT_ENV:
+        parts.append("%s=%s" % (name, os.environ.get(name, "")))
+    _env_fp_cache = ";".join(parts)
+    return _env_fp_cache
+
+
+_code_fp_cache: Optional[str] = None
+
+
+def code_fingerprint(refresh: bool = False) -> str:
+    """Hash over every mxnet_tpu python file's (path, size, mtime): the
+    staleness guard for the trace-free fast-key index.  A fast key
+    describes a program by what BUILT it (symbol graph, dtypes, flags)
+    rather than by its lowered text — sound only while the building code
+    itself is unchanged, so any edited/updated source file conservatively
+    misses the whole index (the HLO-keyed entries still hit after one
+    lowering)."""
+    global _code_fp_cache
+    if _code_fp_cache is not None and not refresh:
+        return _code_fp_cache
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            h.update(("%s:%d:%d;" % (os.path.relpath(p, root), st.st_size,
+                                     st.st_mtime_ns)).encode())
+    _code_fp_cache = h.hexdigest()
+    return _code_fp_cache
+
+
+def fast_key(description: str, signature: str,
+             env_fp: Optional[str] = None,
+             code_fp: Optional[str] = None) -> str:
+    """Key for the trace-free index: caller's program description (e.g.
+    symbol json hash + dtypes + optimizer hparams) + the input-aval
+    signature + environment + code fingerprints."""
+    h = hashlib.sha256()
+    h.update((env_fp if env_fp is not None
+              else environment_fingerprint()).encode("utf-8"))
+    h.update(b"\x00")
+    h.update((code_fp if code_fp is not None
+              else code_fingerprint()).encode("utf-8"))
+    h.update(b"\x00")
+    h.update(description.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(signature.encode("utf-8"))
+    return h.hexdigest()
+
+
+def program_key(lowered_text: str, extras: Iterable[str] = (),
+                env_fp: Optional[str] = None) -> str:
+    """Key for one lowered program under the current environment."""
+    h = hashlib.sha256()
+    h.update((env_fp if env_fp is not None
+              else environment_fingerprint()).encode("utf-8"))
+    h.update(b"\x00")
+    for e in extras:
+        h.update(str(e).encode("utf-8"))
+        h.update(b"\x00")
+    h.update(lowered_text.encode("utf-8"))
+    return h.hexdigest()
+
+
+def blob_digest(blob: bytes) -> str:
+    """Content checksum stored in the sidecar: a truncated or bit-flipped
+    executable blob is detected BEFORE it reaches PJRT deserialization."""
+    return hashlib.sha256(blob).hexdigest()

@@ -1,0 +1,212 @@
+"""On-disk entry store: atomic publish, corruption tolerance, LRU bound.
+
+Layout (flat, two files per entry)::
+
+    <dir>/<key>.exe    serialized PJRT executable blob
+    <dir>/<key>.meta   pickled sidecar: blob checksum, canonical input
+                       avals, input sharding recipes, versions
+
+Publish order is exe first, meta second, both through
+``base.atomic_local_write`` (tmp + fsync + rename): a reader requires
+BOTH files and verifies the meta's checksum against the blob, so a crash
+between the two writes — or a concurrent writer racing on the same key —
+leaves either a complete entry or no entry, never a torn one.  Any
+malformed entry is treated as a miss, warned about once, and deleted so
+the slot recompiles and republishes.
+
+Recency is file mtime: hits touch the pair, eviction drops
+oldest-mtime pairs until the directory fits ``size_mb``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..base import atomic_local_write
+from .fingerprint import blob_digest
+
+logger = logging.getLogger(__name__)
+
+META_VERSION = 2
+
+_warned: set = set()
+_warned_lock = threading.Lock()
+
+
+def warn_once(category: str, msg: str) -> None:
+    """Log one warning per category per process: a cache must degrade
+    quietly — a cold-start stall is news once, not once per program."""
+    with _warned_lock:
+        if category in _warned:
+            return
+        _warned.add(category)
+    logger.warning(msg)
+
+
+def _reset_warnings() -> None:   # test hook
+    with _warned_lock:
+        _warned.clear()
+
+
+class CacheStore:
+    """Filesystem half of the compile cache (no jax/PJRT knowledge)."""
+
+    def __init__(self, directory: str, size_mb: float):
+        self.directory = os.path.abspath(directory)
+        self.size_bytes = int(float(size_mb) * 1024 * 1024)
+        self._lock = threading.Lock()
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+    def _exe_path(self, key: str) -> str:
+        return os.path.join(self.directory, key + ".exe")
+
+    def _meta_path(self, key: str) -> str:
+        return os.path.join(self.directory, key + ".meta")
+
+    def _idx_path(self, fast_key: str) -> str:
+        return os.path.join(self.directory, fast_key + ".idx")
+
+    # -- fast-key index ----------------------------------------------------
+    def save_index(self, fast_key: str, key: str) -> None:
+        """Publish fast_key -> entry-key (the trace-free lookup path)."""
+        try:
+            with atomic_local_write(self._idx_path(fast_key), "w") as f:
+                f.write(key)
+        except Exception:
+            pass     # index is pure optimization; the HLO path still works
+
+    def load_index(self, fast_key: str) -> Optional[str]:
+        """-> entry key, or None.  A stale index (pointing at an evicted
+        or unreadable entry) is deleted by the caller via
+        ``drop_index``."""
+        try:
+            with open(self._idx_path(fast_key)) as f:
+                key = f.read().strip()
+        except OSError:
+            return None
+        if len(key) != 64 or not all(c in "0123456789abcdef" for c in key):
+            self.drop_index(fast_key)
+            return None
+        return key
+
+    def drop_index(self, fast_key: str) -> None:
+        try:
+            os.unlink(self._idx_path(fast_key))
+        except OSError:
+            pass
+
+    # -- read --------------------------------------------------------------
+    def load(self, key: str) -> Optional[Tuple[bytes, Dict[str, Any]]]:
+        """-> (blob, meta) or None.  Every way an entry can be malformed
+        (absent half, unpicklable meta, wrong meta version, checksum
+        mismatch from truncation or bit flips) degrades to a miss with
+        one warning, and the bad entry is removed."""
+        exe, mp = self._exe_path(key), self._meta_path(key)
+        try:
+            with open(mp, "rb") as f:
+                meta = pickle.load(f)
+            if not isinstance(meta, dict) or \
+                    meta.get("version") != META_VERSION:
+                raise ValueError("meta version mismatch")
+            with open(exe, "rb") as f:
+                blob = f.read()
+            if blob_digest(blob) != meta.get("sha256"):
+                raise ValueError("blob checksum mismatch "
+                                 "(truncated or corrupted entry)")
+        except FileNotFoundError:
+            return None
+        except Exception as e:
+            warn_once(
+                "corrupt-entry",
+                "compile cache entry %s unreadable (%s: %s); recompiling "
+                "and replacing it" % (key[:12], type(e).__name__, e))
+            self.invalidate(key)
+            return None
+        self._touch(key)
+        return blob, meta
+
+    def _touch(self, key: str) -> None:
+        for p in (self._exe_path(key), self._meta_path(key)):
+            try:
+                os.utime(p, None)
+            except OSError:
+                pass
+
+    # -- write -------------------------------------------------------------
+    def save(self, key: str, blob: bytes, meta: Dict[str, Any]) -> int:
+        """Atomic publish; returns bytes written.  Failures (read-only
+        dir, disk full) warn once and report 0 — caching is an
+        optimization, never a reason to fail the compile."""
+        meta = dict(meta)
+        meta["version"] = META_VERSION
+        meta["sha256"] = blob_digest(blob)
+        try:
+            with atomic_local_write(self._exe_path(key)) as f:
+                f.write(blob)
+            with atomic_local_write(self._meta_path(key)) as f:
+                pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:
+            warn_once(
+                "store-failed",
+                "compile cache cannot publish to %s (%s: %s); running "
+                "uncached" % (self.directory, type(e).__name__, e))
+            self.invalidate(key)
+            return 0
+        nbytes = len(blob)
+        self._enforce_budget()
+        return nbytes
+
+    def invalidate(self, key: str) -> None:
+        # .idx too: eviction treats an index file as its own entry (its
+        # basename is the fast key), so invalidating must actually free
+        # it or the budget math drifts and stale indexes pile up forever
+        for p in (self._exe_path(key), self._meta_path(key),
+                  self._idx_path(key)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    # -- size bound --------------------------------------------------------
+    def _entries(self) -> List[Tuple[float, str, int]]:
+        """[(mtime, key, pair bytes)] for complete and half entries."""
+        agg: Dict[str, List[float]] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            base, ext = os.path.splitext(name)
+            if ext not in (".exe", ".meta", ".idx"):
+                continue
+            try:
+                st = os.stat(os.path.join(self.directory, name))
+            except OSError:
+                continue
+            ent = agg.setdefault(base, [0.0, 0.0])
+            ent[0] = max(ent[0], st.st_mtime)
+            ent[1] += st.st_size
+        return sorted((mt, key, int(sz)) for key, (mt, sz) in agg.items())
+
+    def disk_bytes(self) -> int:
+        return sum(sz for _, _, sz in self._entries())
+
+    def entry_count(self) -> int:
+        return len(self._entries())
+
+    def _enforce_budget(self) -> None:
+        """Drop oldest entries until under the bound.  Best-effort under
+        concurrency: two processes evicting at once both converge on the
+        same survivors (deletes of already-deleted files are no-ops)."""
+        with self._lock:
+            entries = self._entries()
+            total = sum(sz for _, _, sz in entries)
+            for _mt, key, sz in entries:
+                if total <= self.size_bytes:
+                    break
+                self.invalidate(key)
+                total -= sz

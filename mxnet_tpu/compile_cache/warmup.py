@@ -1,0 +1,74 @@
+"""Bounded-parallel AOT warmup: compile program grids off the hot loop.
+
+XLA compilation releases the GIL, so N programs compile genuinely
+concurrently through a thread pool — a serve bucket grid or a bucketing
+module's sequence buckets warm in max(compile) instead of sum(compile).
+Tasks are (label, thunk); the first failure is re-raised as a
+``WarmupError`` carrying the label so callers can name the offending
+bucket/shape instead of surfacing a bare jax traceback.
+"""
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["WarmupError", "parallel_warm", "default_warmup_threads"]
+
+
+class WarmupError(MXNetError):
+    """One warmup task failed; ``label`` names it, ``__cause__`` is the
+    original exception."""
+
+    def __init__(self, label: str, cause: BaseException):
+        super().__init__("warmup of %s failed: %s: %s"
+                         % (label, type(cause).__name__, cause))
+        self.label = label
+
+
+def default_warmup_threads(ntasks: int) -> int:
+    return max(1, min(ntasks, os.cpu_count() or 1))
+
+
+def parallel_warm(tasks: Sequence[Tuple[str, Callable[[], object]]],
+                  threads: Optional[int] = None) -> List[str]:
+    """Run every thunk through a bounded pool; returns the labels in
+    completion order.  All tasks are attempted even after a failure
+    (compiles are idempotent and the survivors stay warm); the FIRST
+    failure is then raised as WarmupError."""
+    tasks = list(tasks)
+    if not tasks:
+        return []
+    if threads is None:
+        threads = default_warmup_threads(len(tasks))
+    threads = max(1, min(int(threads), len(tasks)))
+    done: List[str] = []
+    if threads == 1:
+        first_err = None
+        for label, thunk in tasks:
+            try:
+                thunk()
+                done.append(label)
+            except Exception as e:
+                if first_err is None:
+                    first_err = (label, e)
+        if first_err is not None:
+            raise WarmupError(first_err[0], first_err[1]) from first_err[1]
+        return done
+    with ThreadPoolExecutor(max_workers=threads,
+                            thread_name_prefix="mx-compile-warm") as pool:
+        futs = {pool.submit(thunk): label for label, thunk in tasks}
+        first_err = None
+        for fut in as_completed(futs):
+            label = futs[fut]
+            try:
+                fut.result()
+                done.append(label)
+            except Exception as e:
+                if first_err is None:
+                    first_err = (label, e)
+    if first_err is not None:
+        raise WarmupError(first_err[0], first_err[1]) from first_err[1]
+    return done

@@ -175,6 +175,15 @@ class Executor:
                                    None if multi_ctx else ctx, do_mirror)
         self._eager = multi_ctx
         self._jit_cache: Dict[Any, Any] = {}
+        # stats/report tag: symbol head + a shape hint so per-bucket
+        # executors of one symbol stay distinguishable in compile_report
+        import zlib
+        outs = symbol.list_outputs()
+        shapes = ",".join("%s:%s" % (n, tuple(a.shape))
+                          for n, a in sorted(arg_dict.items()))
+        self._prog_tag = "%s@%08x" % (outs[0] if outs else "exec",
+                                      zlib.crc32(shapes.encode()))
+        self._prog_desc = None      # lazy: see _program_desc()
 
         # names of args that receive gradients
         self._grad_names = [n for n in symbol.list_arguments()
@@ -212,9 +221,15 @@ class Executor:
         return key
 
     def _get_jit(self, kind: str):
-        """kind: 'fwd_train' | 'fwd_eval' | 'fwdbwd'."""
+        """kind: 'fwd_train' | 'fwd_eval' | 'fwdbwd'.  Every whole-graph
+        program goes through compile_cache.cached_jit: with
+        MXNET_COMPILE_CACHE set, a process restart deserializes the
+        executable instead of re-running XLA."""
         if kind in self._jit_cache:
             return self._jit_cache[kind]
+        from .compile_cache import cached_jit
+        name = "exec:%s:%s" % (kind, self._prog_tag)
+        fast_key = "exec|%s|%s" % (kind, self._program_desc())
         prog = self._prog
         if kind in ("fwdbwd", "fwdbwd_ones"):
             with_head = (kind == "fwdbwd")
@@ -231,18 +246,94 @@ class Executor:
                 grads = vjp_fn(list(head_grads))[0]
                 return outs, grads, new_aux
             if with_head:
-                jfn = jax.jit(fn)
+                jfn = cached_jit(fn, name=name, fast_key=fast_key)
             else:
-                jfn = jax.jit(lambda gargs, sargs, aux, rng:
-                              fn(gargs, sargs, aux, rng, None))
+                jfn = cached_jit(lambda gargs, sargs, aux, rng:
+                                 fn(gargs, sargs, aux, rng, None),
+                                 name=name, fast_key=fast_key)
         else:
             is_train = (kind == "fwd_train")
 
             def fn(args, aux, rng, _t=is_train):
                 return prog.eval(args, aux, rng, _t)
-            jfn = jax.jit(fn)
+            jfn = cached_jit(fn, name=name, fast_key=fast_key)
         self._jit_cache[kind] = jfn
         return jfn
+
+    def _program_desc(self) -> str:
+        """Everything this executor's traced programs depend on beyond
+        the input avals: the symbol graph (ops, topology, attrs — all in
+        the json), the bound dtypes, grad request layout, the device,
+        and the bulk-exec/mirror modes.  Feeds the compile cache's
+        trace-free fast key; sound alongside code_fingerprint (op
+        IMPLEMENTATIONS live in source files, not the json)."""
+        if self._prog_desc is None:
+            import hashlib
+            h = hashlib.sha256()
+            h.update(self._symbol.tojson().encode())
+            h.update(repr(sorted(
+                (n, str(a.dtype)) for n, a in self.arg_dict.items())).encode())
+            h.update(repr(sorted(
+                (n, str(a.dtype)) for n, a in self.aux_dict.items())).encode())
+            h.update(repr(sorted(self._grad_req.items())).encode())
+            h.update(repr(sorted(self._grad_names)).encode())
+            h.update(str(self._ctx).encode())
+            h.update(str(self._prog.do_mirror).encode())
+            h.update(str(self._fused_train).encode())
+            self._prog_desc = h.hexdigest()
+        return self._prog_desc
+
+    def default_program_kinds(self) -> Tuple[str, ...]:
+        """The jit program(s) this executor's hot loop will request:
+        the fused train+backward program when bound for training (see
+        forward()), the eval forward otherwise."""
+        if self._grad_names and self._fused_train:
+            return ("fwdbwd_ones",)
+        return ("fwd_eval",)
+
+    def precompile(self, kinds: Optional[Sequence[str]] = None) -> Tuple[str, ...]:
+        """AOT-compile whole-graph programs WITHOUT executing them (no
+        output buffers, no aux updates, no donation) — through the
+        persistent compile cache when one is active.  Safe to run from a
+        warmup thread pool: tracing/compilation touch no executor state
+        beyond the jit-program cache entry being built.  Eager-mode
+        executors (ctx_group placement, monitors) have no whole-graph
+        program and return ().  Returns the kinds made ready."""
+        if self._eager or self._monitor_callback is not None:
+            return ()
+        if kinds is None:
+            kinds = self.default_program_kinds()
+        args, aux = self._args_jax(), self._aux_jax()
+        # a DUMMY key with the real key's aval/placement: only the aval
+        # matters for compilation, and drawing from the global RNG chain
+        # here would make the seeded run's stream depend on the warmup
+        # thread count (parallel warmers advance thread-local chains,
+        # serial warmup advances the main one)
+        rng = jnp.zeros((2,), jnp.uint32)
+        if self._ctx is not None:
+            rng = jax.device_put(rng, self._ctx.jax_device())
+        done = []
+        for kind in kinds:
+            if kind == "fwdbwd":
+                raise MXNetError(
+                    "precompile cannot build the explicit-head-gradient "
+                    "program (head grads arrive at backward() time); "
+                    "precompile 'fwdbwd_ones' instead")
+            jfn = self._get_jit(kind)
+            if kind == "fwdbwd_ones":
+                gargs = {k: args[k] for k in self._grad_names}
+                sargs = {k: v for k, v in args.items() if k not in gargs}
+                jfn.warm(gargs, sargs, aux, rng)
+            else:
+                jfn.warm(args, aux, rng)
+            done.append(kind)
+        return tuple(done)
+
+    def has_compiled(self) -> bool:
+        """Whether any whole-graph program has been built (compiled,
+        cache-loaded, or executed) for this executor."""
+        return any(getattr(f, "has_compiled", True)
+                   for f in self._jit_cache.values())
 
     # -- forward / backward -------------------------------------------------
     def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:

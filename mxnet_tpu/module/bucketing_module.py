@@ -216,8 +216,81 @@ class BucketingModule(BaseModule):
     def _is_cold(mod):
         """True when no program has been compiled for this bucket yet."""
         if mod._fused is not None:
-            return mod._fused._step is None
-        return all(not ex._jit_cache for ex in mod._exec_group.execs)
+            step = mod._fused._step
+            if step is None:
+                return True
+            # cached_jit wrapper: exists as soon as _build_step ran, but
+            # is only warm once something compiled/loaded through it
+            return not getattr(step, "has_compiled", True)
+        return all(not ex.has_compiled() for ex in mod._exec_group.execs)
+
+    def precompile(self, bucket_shapes, threads=None):
+        """Bind every listed bucket and AOT-compile its programs through
+        a bounded thread pool — the parallel, compile-only successor to
+        ``prepare()``: nothing executes, so no aux state moves, no
+        shared gradient arrays are clobbered, and N buckets compile in
+        max(compile) wall time instead of sum (XLA releases the GIL).
+        With ``MXNET_COMPILE_CACHE`` set, a restarted process loads each
+        bucket's executable from disk here instead of compiling at all.
+
+        Parameters
+        ----------
+        bucket_shapes : dict bucket_key -> (data_shapes, label_shapes)
+            or iterable of (bucket_key, data_shapes, label_shapes)
+            (the ``prepare()`` forms).
+        threads : int, optional
+            Pool bound; default min(n_buckets, cpu count).
+        """
+        assert self.binded and self.params_initialized, \
+            "call bind and init_params before precompile"
+        if self.for_training and not self.optimizer_initialized:
+            # same contract as Module.prepare: the hot loop's program
+            # form (fused vs classic) is decided by init_optimizer
+            raise MXNetError(
+                "precompile() on a training-bound bucketing module "
+                "needs init_optimizer first")
+        from ..compile_cache import parallel_warm
+
+        if isinstance(bucket_shapes, dict):
+            items = [(k, v[0], v[1]) for k, v in bucket_shapes.items()]
+        else:
+            items = [tuple(it) for it in bucket_shapes]
+        listed = {it[0] for it in items}
+        for key, mod in self._buckets.items():
+            if key not in listed and self._is_cold(mod):
+                items.append((key, mod._data_shapes, mod._label_shapes))
+
+        # bind sequentially (cheap; switch_bucket mutates shared module
+        # state), collect one compile thunk per cold bucket
+        keep = self._curr_module
+        tasks = []
+        try:
+            for key, data_shapes, label_shapes in items:
+                self.switch_bucket(key, data_shapes, label_shapes)
+                mod = self._curr_module
+                if not self._is_cold(mod):
+                    continue
+                label = "bucket %r (data %s)" % (key, list(data_shapes))
+                if mod._fused is not None and self.for_training:
+                    from ..io import DataBatch
+                    from ..ndarray import zeros as nd_zeros
+                    mod._fused_ensure_state()
+                    batch = mod._fused.make_batch(DataBatch(
+                        data=[nd_zeros(s) for _, s in data_shapes],
+                        label=[nd_zeros(s)
+                               for _, s in (label_shapes or [])]))
+                    tasks.append((label,
+                                  lambda m=mod, b=batch: m._fused.warm_step(
+                                      m._fused_state, b, m._fused_key)))
+                else:
+                    kinds = None if self.for_training else ("fwd_eval",)
+                    for ex in mod._exec_group.execs:
+                        tasks.append((label,
+                                      lambda e=ex, k=kinds: e.precompile(k)))
+        finally:
+            self._curr_module = keep
+        parallel_warm(tasks, threads=threads)
+        return [label for label, _ in tasks]
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=None, force_init=False):

@@ -446,22 +446,55 @@ class FusedTrainStep:
 
         return step
 
+    def _program_desc(self, tag: str) -> str:
+        """Trace-free fast-key description for this step's programs:
+        the symbol graph plus every closed-over ingredient of the trace
+        — optimizer class + baked hparams + per-name schedule factors,
+        remat, compute dtype, sharded-update mode, mesh layout, and the
+        train/fixed/label name split.  Op and optimizer IMPLEMENTATIONS
+        are covered by the cache's code_fingerprint."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(self._prog.symbol.tojson().encode())
+        for part in (tag, type(self.optimizer).__name__,
+                     repr(self.hparam_signature()),
+                     repr(sorted(self._lr_mult.items())),
+                     repr(sorted(self._wd.items())),
+                     str(self.compute_dtype), str(self._remat),
+                     str(self.shard_update), str(self.global_dp),
+                     repr([int(d.id) for d in self.mesh.devices.ravel()]),
+                     repr(self.train_names), repr(self.fixed_names),
+                     repr(sorted(self.label_shapes.items()))):
+            h.update(str(part).encode())
+            h.update(b"\x00")
+        return "fused|%s" % h.hexdigest()
+
     def _build_step(self):
-        self._step = jax.jit(self._make_step_fn(), donate_argnums=(0,))
+        from ..compile_cache import cached_jit
+        self._step = cached_jit(self._make_step_fn(), name="fused:step",
+                                donate_argnums=(0,),
+                                fast_key=self._program_desc("step"))
         return self._step
 
     def _build_fwd(self):
+        # one cached program per mode (is_train closed over rather than
+        # a static argnum: the compile cache keys concrete programs)
+        from ..compile_cache import cached_jit
         prog = self._prog
 
-        def fwd(state, batch, rng, is_train):
-            args = dict(state["params"])
-            args.update(state["fixed"])
-            args.update(batch)
-            args = self._cast_compute(args)
-            outs, _ = prog.eval(args, state["aux"], rng, is_train)
-            return outs
+        def make(is_train):
+            def fwd(state, batch, rng):
+                args = dict(state["params"])
+                args.update(state["fixed"])
+                args.update(batch)
+                args = self._cast_compute(args)
+                outs, _ = prog.eval(args, state["aux"], rng, is_train)
+                return outs
+            mode = "train" if is_train else "eval"
+            return cached_jit(fwd, name="fused:fwd_%s" % mode,
+                              fast_key=self._program_desc("fwd_%s" % mode))
 
-        self._fwd = jax.jit(fwd, static_argnums=(3,))
+        self._fwd = {True: make(True), False: make(False)}
         return self._fwd
 
     def build_superstep(self, k, metric_update=None):
@@ -497,7 +530,22 @@ class FusedTrainStep:
                                            (megabatch, lrs), length=k)
             return state, acc
 
-        return jax.jit(superstep, donate_argnums=(0,))
+        from ..compile_cache import cached_jit
+        # the traced metric reducer is part of the program; identify it
+        # by owner class + qualname — process-stable, unlike a repr with
+        # an object address (implementation changes ride code_fingerprint)
+        if metric_update is None:
+            mtag = "none"
+        else:
+            owner = getattr(metric_update, "__self__", None)
+            mtag = "%s:%s" % (
+                type(owner).__name__ if owner is not None else "",
+                getattr(metric_update, "__qualname__",
+                        type(metric_update).__name__))
+        return cached_jit(superstep, name="fused:superstep:k%d" % k,
+                          donate_argnums=(0,),
+                          fast_key=self._program_desc(
+                              "superstep:k%d:%s" % (k, mtag)))
 
     def step(self, state, batch, base_key):
         """Advance one batch; returns (new_state, outputs)."""
@@ -529,17 +577,41 @@ class FusedTrainStep:
         # every eager op it meets with a device mismatch
         return jnp.asarray(np.asarray(gathered.addressable_data(0)))
 
+    def warm_step(self, state, batch, base_key) -> str:
+        """Compile (or cache-load) the step program for these avals
+        WITHOUT executing it: nothing is donated, no optimizer update
+        runs, no state copy is needed.  The warmup entry point for
+        Module.prepare / BucketingModule.precompile; safe from a warmup
+        thread pool."""
+        if self._step is None:
+            self._build_step()
+        # the lr operand must match step()'s form exactly: a host scalar
+        # in multi-process mode (an uncommitted device scalar cannot
+        # join a multi-process computation), a device scalar otherwise
+        if self._multiprocess():
+            lr = np.float32(self.optimizer.base_lr())
+        else:
+            lr = jnp.asarray(self.optimizer.base_lr(), jnp.float32)
+        if hasattr(self._step, "warm"):
+            return self._step.warm(state, batch, lr, base_key)
+        return "present"     # already an installed AOT executable
+
     def aot_compile(self, state, batch, base_key):
         """Ahead-of-time compile the step for exactly these avals,
         install the executable as the step program, and return its
         executed-FLOP count from XLA cost analysis (0.0 when the backend
         cannot report one).  Keeps the (state, batch, lr, key) calling
         contract in one place; bench.py uses this so its utilization
-        numerator is the very program its loop runs."""
+        numerator is the very program its loop runs.  Routed through the
+        compile cache: a warm process start installs the deserialized
+        executable without compiling."""
         if self._step is None:
             self._build_step()
         lr = jnp.asarray(self.optimizer.base_lr(), jnp.float32)
-        compiled = self._step.lower(state, batch, lr, base_key).compile()
+        if hasattr(self._step, "compile_for"):
+            compiled = self._step.compile_for(state, batch, lr, base_key)
+        else:
+            compiled = self._step.lower(state, batch, lr, base_key).compile()
         flops = 0.0
         try:
             ca = compiled.cost_analysis()
@@ -554,7 +626,7 @@ class FusedTrainStep:
     def forward_only(self, state, batch, rng, is_train=False):
         if self._fwd is None:
             self._build_fwd()
-        return self._fwd(state, batch, rng, is_train)
+        return self._fwd[bool(is_train)](state, batch, rng)
 
     # -- host sync -----------------------------------------------------------
     def read_params(self, state, arg_params: Dict[str, NDArray],

@@ -530,18 +530,52 @@ class Module(BaseModule):
             self._fused_key = key
 
     def _fused_warmup(self, data_batch):
-        """Compile the fused step program off the hot loop without
-        touching training state: the step runs on a throwaway deep copy
-        (the program donates its inputs, so the live state must not be
-        passed), and the compiled executable is cached by shape/dtype so
-        the first real batch replays it."""
+        """Compile (or cache-load) the fused step program off the hot
+        loop without touching training state: compile-only via
+        ``FusedTrainStep.warm_step`` — nothing executes, so the donated
+        live state needs no throwaway copy and no optimizer update runs.
+        The program is cached by shape/dtype, so the first real batch
+        dispatches it without compiling."""
         assert self._fused is not None
-        import jax
-        import jax.numpy as jnp
         self._fused_ensure_state()
         pend = self._fused.make_batch(data_batch)
-        state_copy = jax.tree_util.tree_map(jnp.copy, self._fused_state)
-        self._fused.step(state_copy, pend, self._fused_key)
+        self._fused.warm_step(self._fused_state, pend, self._fused_key)
+
+    def prepare(self, data_batch=None, threads=None):
+        """AOT-compile this module's hot-loop program(s) before the loop
+        runs them — through the persistent compile cache when
+        ``MXNET_COMPILE_CACHE`` is set, so a restarted process loads
+        executables instead of paying XLA again.  Compile-only: nothing
+        executes, no aux state moves, no gradients land.
+
+        With the fused train step engaged this warms the one donated
+        step program (``data_batch`` supplies the batch avals; default a
+        zero batch of the bound shapes).  On the classic path every
+        bound executor precompiles its default program, in parallel when
+        there are several (``threads`` bounds the pool)."""
+        assert self.binded and self.params_initialized
+        from ..compile_cache import parallel_warm
+        if self.for_training and not self.optimizer_initialized:
+            # the training hot-loop program is CHOSEN by init_optimizer
+            # (fused step vs classic exec-group); warming before that
+            # would compile classic programs a fused fit never runs
+            raise MXNetError(
+                "prepare() on a training-bound module needs "
+                "init_optimizer first")
+        if self._fused is not None and self.optimizer_initialized:
+            if data_batch is None:
+                from ..io import DataBatch
+                from ..ndarray import zeros as nd_zeros
+                data_batch = DataBatch(
+                    data=[nd_zeros(s) for _, s in self._data_shapes],
+                    label=[nd_zeros(s)
+                           for _, s in (self._label_shapes or [])])
+            self._fused_warmup(data_batch)
+            return
+        parallel_warm(
+            [("executor %d" % i, ex.precompile)
+             for i, ex in enumerate(self._exec_group.execs)],
+            threads=threads)
 
     def _discard_speculation(self):
         """Drop a stashed early-committed step WITHOUT applying it, rolling

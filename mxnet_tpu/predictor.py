@@ -216,6 +216,36 @@ class Predictor:
         self._bind(dict(input_shapes))
         return self
 
+    def ensure_bound(self, input_shapes: Dict[str, Tuple[int, ...]]):
+        """Bind (or fetch) the executor for this shape set WITHOUT
+        switching the predictor's current executor — the warmup path:
+        ServeEngine binds its whole bucket grid up front (sequentially;
+        binding shares the parameter buffers) and then compiles the
+        executors' programs in parallel via ``Executor.precompile``.
+        Returns the (cached) executor."""
+        key = self._shape_key(input_shapes)
+        cached = self._exec_cache.get(key)
+        if cached is not None:
+            return cached
+        keep_exec, keep_shapes = self._exec, self._input_shapes
+        try:
+            self._bind(dict(input_shapes))
+            return self._exec
+        finally:
+            self._exec, self._input_shapes = keep_exec, keep_shapes
+
+    def precompile(self, shape_sets, threads=None):
+        """Bind every shape set and AOT-compile its inference program
+        through a bounded thread pool (see compile_cache.parallel_warm);
+        with a persistent cache active, a warm process start deserializes
+        instead of compiling."""
+        from .compile_cache import parallel_warm
+        execs = [(dict(s), self.ensure_bound(s)) for s in shape_sets]
+        return parallel_warm(
+            [("shapes %s" % (sorted(s.items()),),
+              lambda e=ex: e.precompile(("fwd_eval",)))
+             for s, ex in execs], threads=threads)
+
     def predict(self, data) -> np.ndarray:
         """Convenience one-shot: set first input, forward, output 0."""
         first = next(iter(self._input_shapes))

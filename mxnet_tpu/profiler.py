@@ -28,7 +28,7 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "checkpoint_report", "checkpoint_report_str", "SuperstepStats",
            "register_superstep_stats", "superstep_report",
            "superstep_report_str", "register_serve_stats", "serve_report",
-           "serve_report_str"]
+           "serve_report_str", "compile_report", "compile_report_str"]
 
 _config = {"filename": "profile_output", "mode": "symbolic"}
 _state = "stop"
@@ -247,6 +247,25 @@ def serve_report_str() -> str:
     """Human-readable latency/occupancy/queue table per serve engine."""
     parts = [ss.report_str() for _, ss in sorted(_serve_stats.items())]
     return "\n\n".join(parts) if parts else "(no live serve engines)"
+
+
+# -- compilation instrumentation (mxnet_tpu.compile_cache) -------------------
+# Compilation is process-global (one XLA compiler, one jit cache, one disk
+# cache), so unlike the per-instance registries above there is exactly one
+# CompileStats, owned by the compile_cache subsystem; these are thin views.
+
+def compile_report() -> dict:
+    """Per-program trace/lower/compile seconds, cache hits / misses /
+    bypasses, steady-state retrace count, plus the disk cache's mode,
+    entry count and bytes (totals + per_program + cache keys)."""
+    from .compile_cache import get_cache, get_stats
+    return get_stats().report(cache=get_cache())
+
+
+def compile_report_str() -> str:
+    """Human-readable compile/cold-start table (see compile_report)."""
+    from .compile_cache import get_cache, get_stats
+    return get_stats().report_str(cache=get_cache())
 
 
 @contextlib.contextmanager

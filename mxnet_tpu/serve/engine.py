@@ -188,14 +188,61 @@ class ServeEngine:
         return {k: (b,) + v[1:] for k, v in self._shapes_tpl.items()}
 
     def _warmup(self) -> None:
-        """Compile + run every bucket once so serving never compiles."""
+        """Compile + run every bucket once so serving never compiles.
+
+        Three phases: (1) bind every bucket executor sequentially
+        (cheap; they share one set of parameter buffers), (2) compile
+        the bucket programs through a bounded thread pool — XLA
+        compilation releases the GIL, so the grid warms in max(compile)
+        instead of sum; ``MXNET_SERVE_WARMUP_THREADS`` bounds the pool
+        (default: one thread per bucket up to the host's cores) — and
+        (3) run each bucket once, serially (cheap after compilation:
+        buffers allocate, the executable loads).  With
+        ``MXNET_COMPILE_CACHE`` set, phase 2 deserializes executables
+        from disk on a restart instead of compiling at all.
+
+        Any failure is re-raised as a ServeError naming the offending
+        bucket and its shapes — a mid-grid compile error must not
+        surface as a bare jax traceback with no bucket context."""
+        from ..compile_cache import WarmupError, default_warmup_threads, \
+            parallel_warm
         p = self._predictor
+        self._warmup_threads = max(1, get_env(
+            "MXNET_SERVE_WARMUP_THREADS",
+            default_warmup_threads(len(self._buckets)), int))
+
+        def fail(bucket, phase, exc):
+            raise ServeError(
+                "serve warmup failed at bucket %d (input shapes %s, "
+                "%s): %s: %s"
+                % (bucket, sorted(self._shapes_by_bucket[bucket].items()),
+                   phase, type(exc).__name__, exc)) from exc
+
+        execs = {}
         for b in self._buckets:
-            p.reshape(self._shapes_by_bucket[b])
-            p.set_input(self.data_name,
-                        np.zeros((b,) + self.item_shape, self._data_dtype))
-            p.forward()
-            p.get_output(self._output_index)    # sync: executable is hot
+            try:
+                execs[b] = p.ensure_bound(self._shapes_by_bucket[b])
+            except Exception as e:
+                fail(b, "bind", e)
+        try:
+            parallel_warm(
+                [("bucket %d" % b,
+                  lambda e=execs[b]: e.precompile(("fwd_eval",)))
+                 for b in self._buckets],
+                threads=self._warmup_threads)
+        except WarmupError as e:
+            bucket = int(str(e.label).split()[1])
+            fail(bucket, "compile", e.__cause__ or e)
+        for b in self._buckets:
+            try:
+                p.reshape(self._shapes_by_bucket[b])
+                p.set_input(self.data_name,
+                            np.zeros((b,) + self.item_shape,
+                                     self._data_dtype))
+                p.forward()
+                p.get_output(self._output_index)   # sync: executable is hot
+            except Exception as e:
+                fail(b, "first run", e)
 
     def _validate(self, data) -> np.ndarray:
         """Admission-time request validation (caller's thread): shape and

@@ -7,12 +7,15 @@ request isolation; hot weight reload with zero dropped or mixed-weights
 requests; drain-on-shutdown; and the profiler.serve_report counters.
 """
 import os
+import sys
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
 
 import mxnet_tpu as mx
 from mxnet_tpu.predictor import Predictor, create_predictor
@@ -548,14 +551,18 @@ def test_close_inside_pause_raises_not_deadlocks(model):
 
 def test_no_compiles_in_serving_loop(model):
     """Every bucket executable is compiled at construction: the predictor
-    executor cache is fully populated before the first submit."""
+    executor cache is fully populated before the first submit, and the
+    serving loop itself never enters the XLA compiler (shared
+    steady-state guard, tests/common/compile_guard.py)."""
+    from compile_guard import assert_no_compiles
     prefix, X, _ = model
     eng = _engine(prefix, batch_buckets=(1, 2, 4))
     try:
         assert len(eng._predictor._exec_cache) == 3
         execs_before = set(id(e) for e in eng._predictor._exec_cache.values())
-        for f in eng.submit_many([X[i] for i in range(9)]):
-            f.result(timeout=30)
+        with assert_no_compiles("serving loop"):
+            for f in eng.submit_many([X[i] for i in range(9)]):
+                f.result(timeout=30)
         execs_after = set(id(e) for e in eng._predictor._exec_cache.values())
         assert execs_before == execs_after, "serving rebound an executor"
     finally:
