@@ -32,6 +32,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from .augment import AugmentSpec, augment_batch, augment_batch_host
+from .parallel import ParallelReader
 from .pipeline import (BoundedQueue, EndOfEpoch, EndOfStream, Pipeline,
                        QueueClosed, Stage, StageError)
 from .stages import (BatchStage, DevicePutStage, MapStage, SourceStage,
@@ -45,7 +47,8 @@ __all__ = ["Pipeline", "Stage", "BoundedQueue", "EndOfEpoch", "EndOfStream",
            "BatchStage", "StagingStage", "DevicePutStage", "StageStats",
            "PipelineStats", "DevicePrefetchIter", "MegaBatch", "device_feed",
            "stack_batch_arrays", "FeedDataIter", "record_pipeline",
-           "make_jpeg_decode"]
+           "make_jpeg_decode", "make_u8_decode", "ParallelReader",
+           "AugmentSpec", "augment_batch", "augment_batch_host"]
 
 
 class FeedDataIter:
@@ -68,6 +71,12 @@ class FeedDataIter:
         self._label_name = label_name
         self._at_boundary = True
         self._delivered = 0   # batches handed out in the current epoch
+        self._samples = 0     # source samples consumed (pad rows excluded)
+        # set by record_pipeline(device_augment=True): batches are
+        # compact uint8 HWC and Module.fit hands this spec to the fused
+        # step, which prepends the traced cast/crop/flip/normalize
+        # prologue (feed.augment)
+        self.augment_spec = None
 
     @property
     def provide_data(self):
@@ -88,20 +97,27 @@ class FeedDataIter:
     def next(self):
         from ..io import DataBatch
         from ..ndarray import NDArray, array as nd_array
+        self._ensure_released()
         try:
             data, label, pad = self.pipeline.get()
         except StopIteration:
             self._at_boundary = True
             self._delivered = 0
+            self._samples = 0
             raise
         self._at_boundary = False
         self._delivered += 1
+        self._samples += self.batch_size - pad
 
         def wrap(a):
             if isinstance(a, NDArray):
                 return a
             if isinstance(a, np.ndarray):
-                return nd_array(a)
+                # keep the wire dtype: the compact-feed path ships uint8
+                # batches and the fused step's augment prologue dispatches
+                # on it (a silent f32 default cast would quadruple the
+                # H2D bytes AND skip the on-device augmentation)
+                return nd_array(a, dtype=a.dtype)
             return NDArray(a)          # resident jax array (DevicePutStage)
         if self.label_width == 1 and getattr(label, "ndim", 1) > 1:
             label = label.reshape(label.shape[0])
@@ -111,6 +127,7 @@ class FeedDataIter:
     def reset(self):
         if self._at_boundary:
             return            # already positioned at an epoch start
+        self._ensure_released()
         try:
             while True:
                 self.pipeline.get()
@@ -118,21 +135,48 @@ class FeedDataIter:
             pass
         self._at_boundary = True
         self._delivered = 0
+        self._samples = 0
+
+    def _ensure_released(self):
+        """Open a held ParallelReader head (constructed paused so a
+        fresh iterator can still take a fast mid-epoch restore); no-op
+        for every other pipeline shape."""
+        head = self.pipeline.stages[0]
+        release = getattr(head, "release", None)
+        if callable(release):
+            release()
 
     # -- checkpoint cursor (mxnet_tpu.checkpoint mid-epoch resume) --------
     def state(self) -> dict:
         """Position cursor: completed epochs + batches delivered in the
-        current one.  ``restore`` on a FRESH iterator fast-forwards to
-        the exact next batch."""
-        return {"epoch": self.pipeline.epochs_consumed,
-                "batch": self._delivered}
+        current one (plus the exact source-sample count, which differs
+        from batch*batch_size only across a padded final batch).  With a
+        ParallelReader head the derived per-worker ``(epoch, offset)``
+        shard positions ride along under ``"reader"``.  ``restore`` on a
+        FRESH iterator fast-forwards to the exact next batch."""
+        st = {"epoch": self.pipeline.epochs_consumed,
+              "batch": self._delivered,
+              "samples": self._samples}
+        head = self.pipeline.stages[0]
+        cursor = getattr(head, "cursor", None)
+        if callable(cursor):
+            st["reader"] = cursor(st["epoch"], st["samples"])
+        return st
 
     def restore(self, state: dict) -> None:
-        """Fast-forward a freshly built iterator to ``state``: whole
-        epochs are drained through the pipeline (the source replays the
-        same passes), then the already-consumed batches of the target
-        epoch are pulled and discarded, so the next ``next()`` returns
-        the exact batch the checkpoint's training step would have seen."""
+        """Fast-forward a freshly built iterator to ``state``.  A held
+        ParallelReader head takes the fast path: the reader simulates
+        its deterministic schedule and restarts each worker process at
+        the exact shard offset still needed — no re-decode of the
+        already-consumed samples.  Otherwise whole epochs are drained
+        through the pipeline (the source replays the same passes) and
+        the consumed batches of the target epoch are pulled and
+        discarded.  Either way the next ``next()`` returns the exact
+        batch the checkpoint's training step would have seen (fast-path
+        caveat: a final PADDED batch after a mid-epoch resume pads with
+        post-resume rows — pad count and real rows are identical, pad
+        content may differ; size your dataset to the batch or use
+        ``partial="drop"`` when bitwise pad rows matter)."""
         from ..base import MXNetError
         state = state or {}
         if "inner" in state:
@@ -142,6 +186,49 @@ class FeedDataIter:
             state = state["inner"] or {}
         target_epoch = int(state.get("epoch", 0))
         target_batch = int(state.get("batch", 0))
+        head = self.pipeline.stages[0]
+        saved = state.get("reader")
+        reader_head = hasattr(head, "fast_restore")
+        if saved and reader_head:
+            # the delivered stream is a pure function of (seed, epoch,
+            # nworkers, window): a config drift between save and resume
+            # would silently deliver a DIFFERENT stream — re-delivering
+            # consumed samples and skipping unconsumed ones — so refuse
+            live = {"nworkers": head._nworkers, "seed": head._seed,
+                    "shuffle_window": head._window}
+            drift = {k: (saved[k], live[k]) for k in live
+                     if k in saved and saved[k] != live[k]}
+            if drift:
+                raise MXNetError(
+                    "feed restore: reader config changed between save "
+                    "and resume (%s as saved vs live); the sharded "
+                    "stream is a function of these — rebuild the "
+                    "pipeline with the saved settings" % (drift,))
+        elif bool(saved) != reader_head and target_batch:
+            # a MID-epoch cursor across a topology change (thread-pool
+            # save -> multi-process resume, or the reverse) cannot land
+            # on the same stream — the two topologies order samples
+            # differently.  Epoch-boundary cursors (batch 0) are safe:
+            # every topology starts its epoch deterministically.
+            raise MXNetError(
+                "feed restore: pipeline topology changed between save "
+                "(%s) and resume (%s); a mid-epoch cursor cannot map "
+                "across — rebuild the pipeline as saved, or resume "
+                "from an epoch-boundary checkpoint"
+                % ("multi-process reader" if saved else "thread pool",
+                   "multi-process reader" if reader_head
+                   else "thread pool"))
+        if callable(getattr(head, "fast_restore", None)) and \
+                getattr(head, "can_fast_restore", lambda: False)():
+            samples = int(state.get("samples",
+                                    target_batch * self.batch_size))
+            head.fast_restore(target_epoch, samples, saved=saved)
+            self.pipeline.resume_at(target_epoch)
+            self._delivered = target_batch
+            self._samples = samples
+            self._at_boundary = target_batch == 0
+            return
+        self._ensure_released()
         while self.pipeline.epochs_consumed < target_epoch:
             before = self.pipeline.epochs_consumed
             try:
@@ -163,6 +250,8 @@ class FeedDataIter:
                     "size change between save and resume?)"
                     % (target_epoch, i, target_batch))
         self._delivered = target_batch
+        self._samples = int(state.get("samples",
+                                      target_batch * self.batch_size))
         self._at_boundary = target_batch == 0
 
     def close(self):
@@ -202,6 +291,21 @@ def make_jpeg_decode(data_shape: Tuple[int, ...], resize: int = 0,
     return decode
 
 
+def make_u8_decode(pre_shape: Tuple[int, ...], resize: int = 0):
+    """Build the compact-wire decode fn for device-augment pipelines:
+    (label, payload) -> (HWC uint8 of exactly ``pre_shape``, f32 label).
+    No float math on the host — cast/crop/flip/normalize run inside the
+    compiled train program (feed.augment), and the batch crosses H2D at
+    1 byte/pixel instead of 4."""
+    def decode(item):
+        from ..io import decode_to_hwc_u8
+        label, payload = item
+        return decode_to_hwc_u8(payload, pre_shape, resize=resize), \
+            np.float32(label)
+
+    return decode
+
+
 def _record_source(path_imgrec: str):
     """Factory: one sequential pass over a .rec file per call, yielding
     (scalar label, payload bytes) items."""
@@ -229,26 +333,104 @@ def record_pipeline(path_imgrec: str, batch_size: int,
                     rand_mirror: bool = False, mean_rgb=None,
                     scale: float = 1.0, buffer_size: int = 4,
                     max_epochs: Optional[int] = None, to_device: bool = True,
-                    sharding=None, name: str = "record_feed"):
-    """The full staged image pipeline over a RecordIO file, as a DataIter:
+                    sharding=None, name: str = "record_feed",
+                    reader_procs: Optional[int] = None,
+                    shuffle_window: Optional[int] = None,
+                    device_augment: Optional[bool] = None,
+                    seed: int = 0, hold: Optional[bool] = None,
+                    partial: str = "pad"):
+    """The full staged image pipeline over a RecordIO file, as a DataIter.
 
-        source(.rec) -> decode x workers -> batch -> staging ring -> h2d
+    Two source topologies:
+
+    * ``reader_procs == 0`` (default) — in-process thread pool::
+
+          source(.rec) -> decode x workers -> batch -> staging -> h2d
+
+    * ``reader_procs = N`` (or ``MXNET_FEED_WORKERS=N``) — N forked
+      reader PROCESSES, each streaming a deterministic shard of the
+      .rec with chunked pread, decoding in parallel past the GIL, and
+      funneling fixed-shape samples through shared-memory rings into a
+      seeded global-shuffle window (``shuffle_window`` /
+      ``MXNET_FEED_SHUFFLE_WINDOW``)::
+
+          ParallelReader(N procs, shuffle window) -> batch -> staging -> h2d
+
+      Crash-detected worker restart, clean shutdown and exact mid-epoch
+      checkpoint cursors come along (feed.ParallelReader).
+
+    ``device_augment`` (or ``MXNET_FEED_DEVICE_AUGMENT=1``) switches the
+    wire format to compact uint8 HWC (~4x fewer H2D bytes): workers only
+    decode + center-fit each image into a fixed ``(resize, resize, C)``
+    envelope, and the returned iterator carries an ``augment_spec`` that
+    ``Module.fit`` hands to the fused train step, which prepends the
+    traced cast/crop/flip/normalize prologue (feed.augment) — per-step
+    RNG-folded, so mid-epoch resume replays identical crops.
 
     Returns a :class:`FeedDataIter` ready for ``Module.fit``.  Pass
     ``sharding`` (or a zero-arg callable resolving to one, e.g.
     ``lambda: mod._fused.batched_sharding()``) to land batches directly
     in the fused step's input layout."""
-    stages = [
-        SourceStage(_record_source(path_imgrec), max_epochs=max_epochs),
-        MapStage(make_jpeg_decode(data_shape, resize=resize,
+    from ..base import get_env
+    if reader_procs is None:
+        reader_procs = get_env("MXNET_FEED_WORKERS", 0, int)
+    if shuffle_window is None:
+        shuffle_window = get_env("MXNET_FEED_SHUFFLE_WINDOW", 256, int)
+    if device_augment is None:
+        device_augment = get_env("MXNET_FEED_DEVICE_AUGMENT", False, bool)
+
+    spec = None
+    if device_augment:
+        c, h, w = data_shape
+        pre = (resize, resize, c) if resize else (h, w, c)
+        if rand_crop and pre[0] <= h and pre[1] <= w:
+            # no crop margin in the fixed envelope: the device "random"
+            # crop would be a constant center crop — quality silently
+            # degrades vs the host path, which crops from the full
+            # decoded image.  Say so; pass resize > crop size for room.
+            import logging
+            logging.getLogger("mxnet_tpu.feed").warning(
+                "record_pipeline(device_augment=True, rand_crop=True) "
+                "with envelope %s == crop %s: no crop margin, the "
+                "on-device crop is deterministic; set resize > %d to "
+                "give the random crop room", pre[:2], (h, w), max(h, w))
+        spec = AugmentSpec(data_shape, pre_shape=pre, rand_crop=rand_crop,
+                           rand_mirror=rand_mirror, mean_rgb=mean_rgb,
+                           scale=scale)
+        decode = make_u8_decode(pre, resize=resize)
+        sample_shape, sample_dtype = pre, np.uint8
+    else:
+        decode = make_jpeg_decode(data_shape, resize=resize,
                                   rand_crop=rand_crop,
                                   rand_mirror=rand_mirror,
-                                  mean_rgb=mean_rgb, scale=scale),
-                 workers=workers, name="decode"),
-        BatchStage(batch_size),
-        StagingStage(ring_size=max(8, 2 * buffer_size + 2)),
-    ]
+                                  mean_rgb=mean_rgb, scale=scale)
+        sample_shape, sample_dtype = tuple(data_shape), np.float32
+
+    if reader_procs > 0:
+        # hold by default: the FeedDataIter releases the reader on first
+        # use, leaving the pre-consumption window open for a fast
+        # mid-epoch checkpoint restore
+        stages = [
+            ParallelReader(("rec", path_imgrec), decode,
+                           workers=reader_procs,
+                           sample_shape=sample_shape,
+                           sample_dtype=sample_dtype,
+                           shuffle_window=shuffle_window, seed=seed,
+                           max_epochs=max_epochs,
+                           hold=True if hold is None else hold),
+            BatchStage(batch_size, partial=partial),
+            StagingStage(ring_size=max(8, 2 * buffer_size + 2)),
+        ]
+    else:
+        stages = [
+            SourceStage(_record_source(path_imgrec), max_epochs=max_epochs),
+            MapStage(decode, workers=workers, name="decode"),
+            BatchStage(batch_size, partial=partial),
+            StagingStage(ring_size=max(8, 2 * buffer_size + 2)),
+        ]
     if to_device:
         stages.append(DevicePutStage(sharding))
     pipe = Pipeline(stages, buffer_size=buffer_size, name=name)
-    return FeedDataIter(pipe, data_shape, batch_size)
+    it = FeedDataIter(pipe, data_shape, batch_size)
+    it.augment_spec = spec
+    return it

@@ -1,0 +1,158 @@
+"""On-device image augmentation: the crop/mirror/normalize tail of the
+input pipeline, traced into the compiled train program.
+
+The host pipeline's decode workers historically produced normalized
+float32 CHW batches — 4 bytes/pixel over H2D plus a per-image python
+crop/flip/normalize.  The device-augment path ships compact ``uint8``
+HWC batches instead (4x fewer H2D bytes at equal resolution) and the
+fused train step prepends this module's traced prologue: cast, per-
+sample random crop, random horizontal flip, HWC->CHW transpose, mean
+subtract, scale — all inside the ONE donated XLA dispatch, where the
+whole batch's augmentation is a handful of fused vector ops instead of
+B python loop bodies (the weight-update-sharding move — hoist per-step
+host work into the compiled program — applied to the input side).
+
+Randomness is folded from the step's in-program RNG (``fold_in(step_key,
+_AUG_FOLD)``), so augmentation draws are a pure function of the train
+state's step counter: a mid-epoch checkpoint resume replays the exact
+same crops and flips.
+
+Two twin implementations share one draw discipline:
+
+* :func:`augment_batch` — jax, traced into the step program;
+* :func:`augment_batch_host` — numpy, identical math on host.
+
+Given the same key they produce bitwise-identical pixels (the parity
+contract tests/test_parallel_feed.py enforces).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["AugmentSpec", "augment_batch", "augment_batch_host",
+           "AUG_FOLD"]
+
+# fold_in tag separating augmentation draws from the model's own
+# in-program randomness (dropout etc.) — both derive from the same
+# per-step key, neither sees the other's stream
+AUG_FOLD = 0x41554731
+
+
+class AugmentSpec:
+    """What the traced prologue does to a ``(B, Hp, Wp, C)`` uint8 batch.
+
+    ``data_shape`` is the CHW shape the network consumes; ``pre_shape``
+    is the HWC shape the feed ships (decode resizes/center-crops each
+    image to this fixed envelope so ring slots and XLA shapes stay
+    static; the margin over ``data_shape`` is the random-crop room).
+    ``mean_rgb``/``scale`` match the host path's normalize step.
+    """
+
+    def __init__(self, data_shape: Sequence[int],
+                 pre_shape: Optional[Sequence[int]] = None,
+                 rand_crop: bool = False, rand_mirror: bool = False,
+                 mean_rgb=None, scale: float = 1.0):
+        self.data_shape: Tuple[int, ...] = tuple(int(d) for d in data_shape)
+        if len(self.data_shape) != 3:
+            raise ValueError("data_shape must be CHW, got %r"
+                             % (self.data_shape,))
+        c, h, w = self.data_shape
+        if pre_shape is None:
+            pre_shape = (h, w, c)
+        self.pre_shape: Tuple[int, ...] = tuple(int(d) for d in pre_shape)
+        hp, wp, cp = self.pre_shape
+        if cp != c or hp < h or wp < w:
+            raise ValueError(
+                "pre_shape %r must cover data_shape %r (same channels, "
+                "height/width >= crop size)" % (self.pre_shape,
+                                                self.data_shape))
+        self.rand_crop = bool(rand_crop)
+        self.rand_mirror = bool(rand_mirror)
+        self.mean = (None if mean_rgb is None
+                     else np.asarray(mean_rgb, np.float32).reshape(-1))
+        if self.mean is not None and self.mean.size != c:
+            raise ValueError("mean_rgb needs %d entries, got %d"
+                             % (c, self.mean.size))
+        self.scale = float(scale)
+
+    def signature(self) -> tuple:
+        """Hashable identity for compile-cache keys: everything the
+        traced prologue closes over."""
+        return (self.data_shape, self.pre_shape, self.rand_crop,
+                self.rand_mirror,
+                None if self.mean is None else tuple(self.mean.tolist()),
+                self.scale)
+
+    def __repr__(self):
+        return "AugmentSpec%r" % (self.signature(),)
+
+
+def _draw(key, batch: int, spec: AugmentSpec, train: bool, xp):
+    """The ONE draw discipline both twins share: split the key three
+    ways and draw (dy, dx, flip) per sample.  Draws happen through jax
+    in BOTH implementations so device and host see identical bits; the
+    pixel math downstream is what differs (traced vs numpy)."""
+    import jax
+    c, h, w = spec.data_shape
+    hp, wp, _ = spec.pre_shape
+    ky, kx, kf = jax.random.split(key, 3)
+    if train and spec.rand_crop and (hp > h or wp > w):
+        dy = jax.random.randint(ky, (batch,), 0, hp - h + 1)
+        dx = jax.random.randint(kx, (batch,), 0, wp - w + 1)
+    else:
+        dy = xp.full((batch,), (hp - h) // 2, np.int32)
+        dx = xp.full((batch,), (wp - w) // 2, np.int32)
+    if train and spec.rand_mirror:
+        flip = jax.random.bernoulli(kf, 0.5, (batch,))
+    else:
+        flip = xp.zeros((batch,), bool)
+    return dy, dx, flip
+
+
+def augment_batch(x, key, spec: AugmentSpec, train: bool):
+    """Traced prologue: ``(B, Hp, Wp, C) uint8 -> (B, C, H, W) float32``.
+
+    Per-sample random crop + random horizontal flip (train mode with the
+    spec's flags; eval mode center-crops deterministically), then
+    HWC->CHW, mean subtract, scale — the exact op order of the host
+    path's ``crop_mirror_normalize``, so pixels match bitwise."""
+    import jax
+    import jax.numpy as jnp
+    c, h, w = spec.data_shape
+    b = x.shape[0]
+    dy, dx, flip = _draw(key, b, spec, train, jnp)
+
+    def crop_one(img, y0, x0):
+        return jax.lax.dynamic_slice(img, (y0, x0, 0), (h, w, c))
+
+    out = jax.vmap(crop_one)(x, dy, dx)
+    out = jnp.where(flip[:, None, None, None], out[:, :, ::-1, :], out)
+    out = jnp.transpose(out, (0, 3, 1, 2)).astype(jnp.float32)
+    if spec.mean is not None:
+        out = out - jnp.asarray(spec.mean).reshape(1, c, 1, 1)
+    if spec.scale != 1.0:
+        out = out * jnp.float32(spec.scale)
+    return out
+
+
+def augment_batch_host(x, key, spec: AugmentSpec, train: bool):
+    """Numpy twin of :func:`augment_batch`: same draws (through jax, so
+    the bits match), same op order, host execution.  The parity oracle
+    for tests and the reference semantics for documentation."""
+    x = np.asarray(x)
+    c, h, w = spec.data_shape
+    b = x.shape[0]
+    dy, dx, flip = (np.asarray(a) for a in _draw(key, b, spec, train, np))
+    out = np.empty((b, h, w, c), x.dtype)
+    for i in range(b):
+        out[i] = x[i, dy[i]:dy[i] + h, dx[i]:dx[i] + w, :]
+        if flip[i]:
+            out[i] = out[i][:, ::-1, :]
+    out = out.transpose(0, 3, 1, 2).astype(np.float32)
+    if spec.mean is not None:
+        out = out - spec.mean.reshape(1, c, 1, 1).astype(np.float32)
+    if spec.scale != 1.0:
+        out = out * np.float32(spec.scale)
+    return out

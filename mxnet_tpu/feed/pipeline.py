@@ -280,6 +280,13 @@ class Pipeline:
     def epochs_consumed(self) -> int:
         return self._epoch
 
+    def resume_at(self, epoch: int) -> None:
+        """Align the consumed-epoch counter with a cursor installed
+        directly in the head stage (ParallelReader.fast_restore jumps
+        the whole pipeline to mid-epoch N without draining epochs
+        0..N-1 through it)."""
+        self._epoch = int(epoch)
+
     def report(self):
         return self.stats.report()
 

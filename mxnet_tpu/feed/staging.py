@@ -116,6 +116,14 @@ class DevicePrefetchIter:
     def provide_label(self):
         return self._iter.provide_label
 
+    @property
+    def augment_spec(self):
+        """Forward the wrapped iterator's on-device augmentation spec
+        (compact uint8 pipelines): fit's augment wiring must see it
+        through this wrapper too, or the uint8 batches would hit the
+        fused trace without their prologue."""
+        return getattr(self._iter, "augment_spec", None)
+
     def __iter__(self):
         return self
 

@@ -38,6 +38,10 @@ class StageStats:
         # live depth of the queue this stage FEEDS (None until wired)
         self._depth_fn: Optional[Callable[[], int]] = None
         self._capacity = 0
+        # external per-process counters (ParallelReader worker shm):
+        # merged into every snapshot so feed_report() aggregates the
+        # whole process tree, not just the parent
+        self._external_fn: Optional[Callable[[], Dict]] = None
 
     # -- recording (called from stage threads) ---------------------------
     def add_items(self, n: int, busy_s: float = 0.0) -> None:
@@ -56,6 +60,14 @@ class StageStats:
     def wire_queue(self, depth_fn: Callable[[], int], capacity: int) -> None:
         self._depth_fn = depth_fn
         self._capacity = capacity
+
+    def wire_external(self, fn: Callable[[], Dict]) -> None:
+        """Attach per-worker-PROCESS counters (``{worker: {items, busy_s,
+        restarts, ...}}``, read out of shared memory): a multi-process
+        stage's decode work happens outside this process, and a report
+        showing only the parent's counters would silently claim the
+        workers did nothing."""
+        self._external_fn = fn
 
     # -- reading ---------------------------------------------------------
     @property
@@ -81,6 +93,20 @@ class StageStats:
         if self._depth_fn is not None:
             out["queue_depth"] = self._depth_fn()
             out["queue_capacity"] = self._capacity
+        if self._external_fn is not None:
+            try:
+                workers = self._external_fn()
+            except Exception:
+                workers = None
+            if workers:
+                out["workers"] = workers
+                out["worker_items"] = sum(
+                    int(w.get("items", 0)) for w in workers.values())
+                out["worker_busy_s"] = round(sum(
+                    float(w.get("busy_s", 0.0)) for w in workers.values()),
+                    4)
+                out["restarts"] = sum(
+                    int(w.get("restarts", 0)) for w in workers.values())
         return out
 
 
@@ -125,4 +151,11 @@ class PipelineStats:
             lines.append("  %-16s %10d %10.1f %8.2f %10.2f %10.2f %7s" % (
                 s.name, snap["items"], snap["items_per_s"], snap["busy_s"],
                 snap["stall_in_s"], snap["stall_out_s"], depth))
+            for wname, wc in sorted((snap.get("workers") or {}).items()):
+                lines.append(
+                    "  %-16s %10d %10.1f %8.2f %10s %10s %7s" % (
+                        "  %s[%s]" % (s.name, wname), wc.get("items", 0),
+                        wc.get("items_per_s", 0.0), wc.get("busy_s", 0.0),
+                        "-", "restarts=%d" % wc.get("restarts", 0),
+                        "up" if wc.get("alive") else "down"))
         return "\n".join(lines)

@@ -130,6 +130,39 @@ def crop_mirror_normalize(img, data_shape, rand_crop=False,
     return img * scale
 
 
+def decode_to_hwc_u8(payload, pre_shape, resize=0):
+    """Decode an image payload to a FIXED ``(Hp, Wp, C)`` uint8 HWC
+    buffer — the compact wire format of the device-augment feed path
+    (cast/crop/flip/normalize then run inside the compiled train
+    program; see mxnet_tpu.feed.augment).  JPEG/PNG payloads decode via
+    PIL, resize (shorter edge to ``resize`` when given, scaled up
+    further if still smaller than the envelope) and CENTER-crop to
+    ``pre_shape`` — the random crop happens on device, out of the
+    envelope's margin.  Raw payloads whose size matches are accepted as
+    packed CHW uint8 (the .rec raw fallback) and transposed."""
+    import io as _io
+    hp, wp, c = pre_shape
+    if len(payload) == hp * wp * c:
+        # raw CHW-packed record
+        return np.frombuffer(payload, np.uint8).reshape(
+            (c, hp, wp)).transpose(1, 2, 0).copy()
+    from PIL import Image
+    pil = Image.open(_io.BytesIO(payload)).convert("RGB")
+    if resize:
+        pil = resize_shorter_edge(pil, resize)
+    w0, h0 = pil.size
+    if h0 < hp or w0 < wp:
+        # envelope not covered (tiny image or no resize given): scale up
+        # so BOTH dims reach it, preserving aspect
+        s = max(hp / h0, wp / w0)
+        pil = pil.resize((max(wp, int(round(w0 * s))),
+                          max(hp, int(round(h0 * s)))), Image.BILINEAR)
+        w0, h0 = pil.size
+    dy, dx = (h0 - hp) // 2, (w0 - wp) // 2
+    img = np.asarray(pil, np.uint8)[dy:dy + hp, dx:dx + wp, :]
+    return np.ascontiguousarray(img)
+
+
 def _init_data(data, allow_empty, default_name):
     """Normalize input to list of (name, numpy) (reference io.py:219)."""
     assert data is not None or allow_empty

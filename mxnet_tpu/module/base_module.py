@@ -53,6 +53,23 @@ class BaseModule:
         Module overrides on the fused path)."""
         return None
 
+    def _wire_eval_augment(self, eval_data):
+        """A device-augment pipeline (uint8 wire, feed.AugmentSpec on
+        the iterator) used for standalone score/predict must install
+        its prologue on the fused step — or fail with the actionable
+        message — BEFORE its batches reach the trace; fit() does the
+        same for train_data."""
+        spec = getattr(eval_data, "augment_spec", None)
+        if spec is None:
+            return
+        applier = getattr(self, "apply_augment_spec", None)
+        if applier is None or not applier(spec):
+            raise MXNetError(
+                "eval_data ships uint8 device-augment batches but this "
+                "module has no fused step to run the on-device "
+                "prologue; rebuild the pipeline with "
+                "device_augment=False (or MXNET_FEED_DEVICE_AUGMENT=0)")
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, reset=True, epoch=0):
         """Evaluate (reference base_module.py score).
@@ -64,6 +81,7 @@ class BaseModule:
         instead of blocking on every batch.  Metric totals and the
         per-batch callback order are unchanged."""
         assert self.binded and self.params_initialized
+        self._wire_eval_augment(eval_data)
         if reset:
             eval_data.reset()
         if not isinstance(eval_metric, metric_mod.EvalMetric):
@@ -141,6 +159,7 @@ class BaseModule:
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
+        self._wire_eval_augment(eval_data)
         if reset:
             eval_data.reset()
         for nbatch, eval_batch in enumerate(eval_data):
@@ -155,6 +174,7 @@ class BaseModule:
                 reset=True, always_output_list=False):
         """Predict (reference base_module.py predict)."""
         assert self.binded and self.params_initialized
+        self._wire_eval_augment(eval_data)
         if reset:
             eval_data.reset()
         output_list = []
@@ -242,6 +262,36 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+
+        # compact-feed pipelines (record_pipeline(device_augment=True))
+        # ship uint8 HWC batches and carry the augmentation spec the
+        # fused step must trace in (cast/crop/flip/normalize on device)
+        aug_spec = getattr(train_data, "augment_spec", None)
+        eval_spec = getattr(eval_data, "augment_spec", None) \
+            if eval_data is not None else None
+        if aug_spec is not None and eval_spec is not None and \
+                aug_spec.signature() != eval_spec.signature():
+            # one fused program family carries ONE prologue; two specs
+            # would silently augment eval with the train parameters
+            raise MXNetError(
+                "train_data and eval_data carry different device-augment "
+                "specs (%r vs %r); build both pipelines with the same "
+                "augmentation parameters" % (aug_spec, eval_spec))
+        aug_spec = aug_spec or eval_spec
+        applier = getattr(self, "apply_augment_spec", None)
+        if aug_spec is not None:
+            if applier is None or not applier(aug_spec):
+                raise MXNetError(
+                    "the training/eval feed ships uint8 device-augment "
+                    "batches but this module has no fused train step to "
+                    "run the on-device prologue; rebuild the pipeline "
+                    "with device_augment=False (or MXNET_FEED_DEVICE_"
+                    "AUGMENT=0) for the host-augmented f32 path")
+        elif callable(applier):
+            # clear a spec left by a PREVIOUS fit on this module: a
+            # stale prologue would block the classic-path fallback and
+            # key the compiled step differently for this f32 feed
+            applier(None)
 
         ckpt_mgr = None
         if checkpoint is None and resume:

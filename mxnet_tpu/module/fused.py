@@ -137,6 +137,12 @@ class FusedTrainStep:
         self.shard_update = (
             _os.environ.get("MXNET_SHARD_WEIGHT_UPDATE", "0") == "1"
             and len(self.mesh.devices.ravel()) > 1)
+        # on-device augmentation prologue (feed.AugmentSpec): when set,
+        # uint8 HWC data batches are cast/cropped/flipped/normalized
+        # INSIDE the compiled step (feed.augment), so the feed ships
+        # ~4x fewer H2D bytes and the per-image python augment loop
+        # disappears from the hot path
+        self.device_augment = None
         self._step = None
         self._fwd = None
         self._lr_cache = None
@@ -144,6 +150,44 @@ class FusedTrainStep:
     def _cast_compute(self, args):
         from ..symbol import cast_compute
         return cast_compute(args, self.compute_dtype, self._no_cast)
+
+    # -- on-device augmentation ---------------------------------------------
+    def set_device_augment(self, spec) -> None:
+        """Install (or clear) the traced augmentation prologue.  Already-
+        built programs are dropped on a real change — the prologue is
+        part of the trace and of the compile-cache key; a no-op set
+        (same spec, or None over None) keeps the warm programs."""
+        if spec is None and self.device_augment is None:
+            return
+        if getattr(self.device_augment, "signature", None) is not None \
+                and spec is not None \
+                and self.device_augment.signature() == spec.signature():
+            return
+        self.device_augment = spec
+        self._step = None
+        self._fwd = None
+
+    def _maybe_augment(self, batch, rng, train: bool):
+        """Trace-time dispatch of the prologue: applies ONLY when the
+        first data input arrives as a 4-D uint8 array (the compact HWC
+        wire format) — an f32 batch from a host-augmented eval iterator
+        or a warmup zero-batch passes through untouched, so one compiled
+        family serves both wire formats without runtime branching."""
+        spec = self.device_augment
+        if spec is None or not self.data_names:
+            return batch
+        name = self.data_names[0]
+        x = batch.get(name)
+        if x is None or x.dtype != jnp.uint8 or x.ndim != 4:
+            return batch
+        from ..feed.augment import AUG_FOLD, augment_batch
+        out = dict(batch)
+        # a dedicated fold keeps augmentation draws out of the model's
+        # own RNG stream; both derive from the per-step key, so resume
+        # replays identical crops/flips
+        out[name] = augment_batch(x, jax.random.fold_in(rng, AUG_FOLD),
+                                  spec, train)
+        return out
 
     # -- placement ----------------------------------------------------------
     def _replicated(self):
@@ -397,6 +441,7 @@ class FusedTrainStep:
             # per-step randomness derived in-program from one resident key:
             # creating a fresh host key every batch would cost a transfer
             rng = jax.random.fold_in(base_key, t)
+            batch = self._maybe_augment(batch, rng, train=True)
 
             def loss_fn(train_params):
                 args = dict(train_params)
@@ -461,6 +506,8 @@ class FusedTrainStep:
                      repr(sorted(self._lr_mult.items())),
                      repr(sorted(self._wd.items())),
                      str(self.compute_dtype), str(self._remat),
+                     repr(self.device_augment.signature()
+                          if self.device_augment is not None else None),
                      str(self.shard_update), str(self.global_dp),
                      repr([int(d.id) for d in self.mesh.devices.ravel()]),
                      repr(self.train_names), repr(self.fixed_names),
@@ -484,6 +531,7 @@ class FusedTrainStep:
 
         def make(is_train):
             def fwd(state, batch, rng):
+                batch = self._maybe_augment(batch, rng, train=is_train)
                 args = dict(state["params"])
                 args.update(state["fixed"])
                 args.update(batch)

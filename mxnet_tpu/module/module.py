@@ -417,6 +417,28 @@ class Module(BaseModule):
             self.logger.warning("fused train step disabled: %s", e)
             self._fused = None
 
+    def apply_augment_spec(self, spec):
+        """Wire a feed pipeline's on-device augmentation spec
+        (feed.AugmentSpec, carried by ``record_pipeline(device_augment=
+        True)`` iterators) into the fused train step, which prepends the
+        traced cast/crop/flip/normalize prologue.  Returns False when
+        the fused path is not engaged — the caller must then rebuild the
+        pipeline host-side, because the classic exec-group path binds
+        f32 CHW inputs and cannot consume the uint8 HWC wire format."""
+        if self._fused is None or not self.optimizer_initialized:
+            return False
+
+        def sig(s):
+            return s.signature() if s is not None else None
+        before = sig(self._fused.device_augment)
+        self._fused.set_device_augment(spec)
+        if sig(self._fused.device_augment) != before:
+            # the prologue is part of the superstep trace too, and the
+            # module-level cache keys only (K, metric) — a stale entry
+            # would train through the OLD spec's crop/normalize
+            self._superstep_progs = {}
+        return True
+
     def _disable_fused(self, reason, replay_backward=True):
         """Leave the fused path mid-training with consistent state: pull
         the live params back into arg_params/exec group and re-seed an
@@ -424,6 +446,15 @@ class Module(BaseModule):
         init time — a pull would otherwise revert training)."""
         if self._fused is None:
             return
+        if getattr(self._fused, "device_augment", None) is not None:
+            # the classic path binds f32 CHW inputs; a uint8 HWC feed
+            # has no host fallback — fail with the cause instead of a
+            # shape-mismatch crash three frames later
+            raise MXNetError(
+                "cannot leave the fused train step (%s): on-device "
+                "augmentation is active and the classic path cannot "
+                "consume the uint8 feed; rebuild the pipeline with "
+                "device_augment=False to use the fallback" % reason)
         fused = self._fused
         pend = self._fused_pending
         if self._fused_state is not None:
@@ -565,9 +596,20 @@ class Module(BaseModule):
         if self._fused is not None and self.optimizer_initialized:
             if data_batch is None:
                 from ..io import DataBatch
-                from ..ndarray import zeros as nd_zeros
+                from ..ndarray import NDArray, zeros as nd_zeros
+                import jax.numpy as _jnp
+                spec = getattr(self._fused, "device_augment", None)
+                if spec is not None:
+                    # the hot loop feeds compact uint8 HWC batches; warm
+                    # THAT program, not the f32 variant fit never runs
+                    batch = self._data_shapes[0][1][0]
+                    data = [NDArray(_jnp.zeros((batch,) + spec.pre_shape,
+                                               _jnp.uint8))]
+                    data += [nd_zeros(s) for _, s in self._data_shapes[1:]]
+                else:
+                    data = [nd_zeros(s) for _, s in self._data_shapes]
                 data_batch = DataBatch(
-                    data=[nd_zeros(s) for _, s in self._data_shapes],
+                    data=data,
                     label=[nd_zeros(s)
                            for _, s in (self._label_shapes or [])])
             self._fused_warmup(data_batch)

@@ -74,7 +74,12 @@ def dump_profile() -> str:
 # pipeline disappears from reports without an unregister call), so one
 # feed_report() shows every stage of every running input pipeline —
 # items/sec, busy time, producer/consumer stall time, queue depth — and
-# therefore exactly which stage starves the chip.
+# therefore exactly which stage starves the chip.  Multi-process stages
+# (feed.ParallelReader) publish per-worker counters through shared
+# memory; their StageStats merges them into every snapshot (a "workers"
+# sub-dict with per-process items/s, busy time, restart count and
+# liveness, plus aggregated worker_items/worker_busy_s/restarts), so the
+# report covers the whole reader process tree, not just the parent.
 _feed_stats = weakref.WeakValueDictionary()
 _feed_seq = 0
 
@@ -88,7 +93,9 @@ def register_feed_stats(pipeline_stats) -> None:
 
 
 def feed_report() -> dict:
-    """{pipeline key: {stage name: counters}} for every live pipeline."""
+    """{pipeline key: {stage name: counters}} for every live pipeline,
+    including per-worker-process counters for multi-process reader
+    stages (see the registry note above)."""
     return {key: ps.report() for key, ps in sorted(_feed_stats.items())}
 
 

@@ -16,9 +16,75 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+           "pack_img", "unpack_img", "stream_records", "count_records"]
 
 _MAGIC = 0xced7230a
+
+
+def _iter_frames(uri: str, want, chunk_bytes: int):
+    """Walk a .rec file's framing via chunked ``os.pread``, yielding
+    ``(index, payload_or_None)`` for every record — payload bytes are
+    assembled only when ``want(index)`` is true, so skipping a record
+    costs header arithmetic, not a copy, and the whole file is never
+    resident (at most ~``chunk_bytes`` of it is)."""
+    fd = os.open(uri, os.O_RDONLY)
+    try:
+        size = os.fstat(fd).st_size
+        buf = b""
+        base = 0          # file offset of buf[0]
+        pos = 0           # absolute parse position
+        idx = 0
+        while pos + 8 <= size:
+            if pos + 8 > base + len(buf):
+                buf = os.pread(fd, chunk_bytes, pos)
+                base = pos
+            magic, length = struct.unpack_from("<II", buf, pos - base)
+            if magic != _MAGIC:
+                raise MXNetError("invalid record magic at offset %d in %s"
+                                 % (pos, uri))
+            length &= (1 << 29) - 1
+            pad = (4 - length % 4) % 4
+            if pos + 8 + length > size:
+                raise MXNetError("truncated record %d at offset %d in %s"
+                                 % (idx, pos, uri))
+            if want is None or want(idx):
+                end = pos + 8 + length
+                if end > base + len(buf):
+                    # record spans past the buffered chunk: one pread
+                    # sized to the record (large records never force a
+                    # whole-file read)
+                    buf = os.pread(fd, max(chunk_bytes, 8 + length), pos)
+                    base = pos
+                off = pos - base
+                yield idx, bytes(buf[off + 8:off + 8 + length])
+            else:
+                yield idx, None
+            pos += 8 + length + pad
+            idx += 1
+    finally:
+        os.close(fd)
+
+
+def stream_records(uri: str, want=None, chunk_bytes: int = 1 << 20):
+    """Stream ``(index, payload)`` out of a RecordIO file without ever
+    materializing it: records are parsed out of a sliding pread window
+    (``chunk_bytes`` at a time).  ``want(index) -> bool`` selects which
+    records get their payload copied out — the sharded-reader workers
+    pass ``lambda i: i % nshards == shard`` so each process pays copy
+    cost only for its own shard while the page cache amortizes the
+    sequential walk across processes."""
+    for idx, payload in _iter_frames(uri, want, chunk_bytes):
+        if payload is not None:
+            yield idx, payload
+
+
+def count_records(uri: str, chunk_bytes: int = 1 << 20) -> int:
+    """Number of records in a .rec file via a payload-free framing walk
+    (headers only are decoded; nothing is copied)."""
+    n = 0
+    for idx, _ in _iter_frames(uri, lambda _i: False, chunk_bytes):
+        n = idx + 1
+    return n
 
 
 class MXRecordIO:

@@ -414,7 +414,9 @@ def test_feed_iter_cursor_state_restore():
         it.reset()
     b_next = it.next()       # epoch 2? no: epoch 2's first batch
     st_mid = it.state()
-    assert st_mid == {"epoch": 2, "batch": 1}
+    # the cursor may carry extra keys (exact sample count, reader shard
+    # positions); epoch/batch are the contract
+    assert st_mid["epoch"] == 2 and st_mid["batch"] == 1
     expected = it.next().data[0].asnumpy()
     it.close()
 

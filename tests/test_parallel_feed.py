@@ -1,0 +1,591 @@
+"""mxnet_tpu.feed multi-process sharded readers + on-device augmentation.
+
+Covers the ISSUE-6 contracts: deterministic sharded delivery through the
+global-shuffle window, worker-crash detection and restart with zero lost
+or duplicated samples, exact mid-epoch checkpoint restore with 4 worker
+processes (pure-simulation fast path), device-vs-host augmentation
+parity (same RNG fold => identical pixels), uint8-wire training that
+matches the host-augmented f32 path numerically, zero steady-loop
+recompiles with the traced augment prologue, per-worker-process counters
+in profiler.feed_report(), the compact-H2D byte ratio, env knobs, and
+clean shutdown.  All CPU-only.
+"""
+import multiprocessing as mp
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import feed, recordio
+
+from common.compile_guard import assert_no_compiles
+
+pytestmark = pytest.mark.skipif(
+    "fork" not in mp.get_all_start_methods(),
+    reason="ParallelReader needs the fork start method")
+
+
+def _raw_rec(path, n, shape=(3, 6, 6), label_mod=None, seed=0):
+    """n raw-CHW-packed uint8 records, labels 0..n-1 (or i % label_mod)."""
+    rng = np.random.RandomState(seed)
+    w = recordio.MXRecordIO(str(path), "w")
+    for i in range(n):
+        arr = rng.randint(0, 255, shape).astype(np.uint8)
+        label = float(i if label_mod is None else i % label_mod)
+        w.write(recordio.pack(recordio.IRHeader(0, label, i, 0),
+                              arr.tobytes()))
+    w.close()
+    return str(path)
+
+
+def _f32_decode(shape):
+    def decode(item):
+        label, payload = item
+        img = np.frombuffer(payload, np.uint8).astype(
+            np.float32).reshape(shape)
+        return img, np.float32(label)
+    return decode
+
+
+def _reader_iter(rec, batch_size, workers, window, seed=0, max_epochs=2,
+                 hold=False, slots=8, shape=(3, 6, 6), decode=None):
+    p = feed.Pipeline([
+        feed.ParallelReader(rec, decode or _f32_decode(shape),
+                            workers=workers, sample_shape=shape,
+                            sample_dtype=np.float32,
+                            shuffle_window=window, seed=seed,
+                            max_epochs=max_epochs, hold=hold,
+                            slots_per_worker=slots),
+        feed.BatchStage(batch_size)], name="ptest")
+    return feed.FeedDataIter(p, shape, batch_size)
+
+
+def _labels(it, epochs):
+    out = []
+    for _ in range(epochs):
+        for b in it:
+            out.extend(b.label[0].asnumpy().tolist())
+        it.reset()
+    return out
+
+
+# -- deterministic sharded delivery ------------------------------------------
+
+def test_parallel_reader_multiset_and_determinism(tmp_path):
+    """Every epoch delivers the exact dataset (shuffled, no loss, no
+    dup); the stream is a pure function of (seed, epoch): identical
+    across rebuilds, different across epochs and seeds."""
+    rec = _raw_rec(tmp_path / "a.rec", 53)
+    it = _reader_iter(rec, 53, workers=3, window=7, seed=1)
+    e0, e1 = _labels(it, 1), _labels(it, 1)
+    it.close()
+    assert sorted(e0) == [float(i) for i in range(53)]
+    assert sorted(e1) == sorted(e0)
+    assert e0 != e1                          # per-epoch reseed
+    assert e0 != [float(i) for i in range(53)]   # actually shuffled
+    it2 = _reader_iter(rec, 53, workers=3, window=7, seed=1)
+    assert _labels(it2, 1) == e0             # deterministic rebuild
+    it2.close()
+    it3 = _reader_iter(rec, 53, workers=3, window=7, seed=2)
+    assert _labels(it3, 1) != e0             # seed matters
+    it3.close()
+
+
+def test_window_zero_is_shard_interleave(tmp_path):
+    """shuffle_window=0: pure deterministic round-robin over the shards
+    — with record-mod sharding that reconstructs source order exactly."""
+    rec = _raw_rec(tmp_path / "b.rec", 12)
+    it = _reader_iter(rec, 4, workers=3, window=0, max_epochs=1)
+    assert _labels(it, 1) == [float(i) for i in range(12)]
+    it.close()
+
+
+def test_more_workers_than_records(tmp_path):
+    """Empty shards (workers > records) finish cleanly every epoch."""
+    rec = _raw_rec(tmp_path / "c.rec", 3)
+    it = _reader_iter(rec, 3, workers=4, window=2, max_epochs=2)
+    assert sorted(_labels(it, 1)) == [0.0, 1.0, 2.0]
+    assert sorted(_labels(it, 1)) == [0.0, 1.0, 2.0]
+    it.close()
+
+
+# -- crash recovery ----------------------------------------------------------
+
+def test_worker_crash_restart_no_lost_or_duplicated(tmp_path):
+    """SIGKILL a reader worker mid-epoch: the parent drains the ring's
+    published survivors, reforks the worker at the exact next shard
+    offset, and the delivered stream is IDENTICAL to a crash-free run."""
+    rec = _raw_rec(tmp_path / "d.rec", 60)
+
+    def slow_decode(item):
+        label, payload = item
+        time.sleep(0.002)     # keep the ring shallow so the kill bites
+        img = np.frombuffer(payload, np.uint8).astype(
+            np.float32).reshape(3, 6, 6)
+        return img, np.float32(label)
+
+    def make():
+        return _reader_iter(rec, 5, workers=2, window=5, seed=1,
+                            max_epochs=2, slots=4, decode=slow_decode)
+
+    ref = make()
+    want = _labels(ref, 2)
+    ref.close()
+
+    it = make()
+    got = []
+    for _ in range(2):
+        got.extend(it.next().label[0].asnumpy().tolist())
+    reader = it.pipeline.stages[0]
+    os.kill(reader.worker_pids()[0], signal.SIGKILL)
+    for _ in range(2):
+        try:
+            while True:
+                got.extend(it.next().label[0].asnumpy().tolist())
+        except StopIteration:
+            pass
+    assert got == want
+    assert sum(reader.restarts) >= 1
+    it.close()
+
+
+def test_decode_error_fails_loud(tmp_path):
+    """A decode exception is a data bug, not a crash to retry: it is
+    forwarded in-band and re-raised at the consumer with the worker's
+    traceback."""
+    rec = _raw_rec(tmp_path / "e.rec", 8)
+
+    def bad_decode(item):
+        label, payload = item
+        if label >= 4:
+            raise ValueError("rotten record %d" % int(label))
+        img = np.frombuffer(payload, np.uint8).astype(
+            np.float32).reshape(3, 6, 6)
+        return img, np.float32(label)
+
+    it = _reader_iter(rec, 4, workers=2, window=0, max_epochs=1,
+                      decode=bad_decode)
+    with pytest.raises(mx.MXNetError, match="rotten record"):
+        _labels(it, 1)
+    it.close()
+
+
+# -- cursors / checkpoint composition ----------------------------------------
+
+def test_mid_epoch_fast_restore_exact_4_workers(tmp_path):
+    """state() mid-epoch, fresh 4-process reader, restore: the remaining
+    stream continues EXACTLY where the saved run stopped — via the
+    pure-integer schedule simulation, not a replayed decode of the
+    consumed samples — and the cursor carries per-worker (epoch, offset)
+    shard positions."""
+    rec = _raw_rec(tmp_path / "f.rec", 48)
+
+    def make(hold):
+        return _reader_iter(rec, 6, workers=4, window=9, seed=3,
+                            max_epochs=3, hold=hold)
+
+    ref = make(False)
+    stream = _labels(ref, 2)
+    ref.close()
+
+    a = make(False)
+    _labels(a, 1)                       # epoch 0
+    got = [a.next().label[0].asnumpy().tolist() for _ in range(3)]
+    st = a.state()
+    a.close()
+    assert st["epoch"] == 1 and st["batch"] == 3 and st["samples"] == 18
+    workers = st["reader"]["workers"]
+    assert set(workers) == {"0", "1", "2", "3"}
+    assert all({"epoch", "offset"} <= set(w) for w in workers.values())
+    # the consumed-or-in-window shard positions cover delivered+window
+    assert sum(w["offset"] for w in workers.values()) == 18 + 9
+    assert sum(x for b in got for x in b) == sum(stream[48:66])
+
+    # a config drift between save and resume would silently deliver a
+    # DIFFERENT stream — it must refuse instead
+    wrong = _reader_iter(rec, 6, workers=2, window=9, seed=3,
+                         max_epochs=3, hold=True)
+    with pytest.raises(mx.MXNetError, match="reader config changed"):
+        wrong.restore(st)
+    wrong.close()
+
+    b = make(True)
+    assert b.pipeline.stages[0].can_fast_restore()
+    b.restore(st)
+    rest = []
+    try:
+        while True:
+            rest.extend(b.next().label[0].asnumpy().tolist())
+    except StopIteration:
+        pass
+    assert rest == stream[66:96]
+    b.close()
+
+
+def test_restore_at_epoch_boundary(tmp_path):
+    """An (epoch=E, batch=0) cursor starts epoch E exactly: workers jump
+    straight to epoch E's shard pass, shuffle reseeded for E."""
+    rec = _raw_rec(tmp_path / "g.rec", 24)
+    ref = _reader_iter(rec, 6, workers=3, window=5, seed=2, max_epochs=3)
+    stream = _labels(ref, 2)
+    ref.close()
+    it = _reader_iter(rec, 6, workers=3, window=5, seed=2, max_epochs=3,
+                      hold=True)
+    it.restore({"epoch": 1, "batch": 0, "samples": 0})
+    assert _labels(it, 1) == stream[24:]
+    it.close()
+
+
+def test_fit_checkpoint_resume_mid_epoch(tmp_path):
+    """The full composition: fit + CheckpointManager over a 4-process
+    reader, interrupted mid-epoch; a FRESH module + FRESH pipeline with
+    resume=True continues from the committed step and lands on the same
+    params as an uninterrupted run (reader stream is deterministic, the
+    feed cursor fast-restores the shard positions)."""
+    rec = _raw_rec(tmp_path / "h.rec", 32, shape=(3, 8, 8), label_mod=4)
+
+    def net():
+        d = mx.sym.Variable("data")
+        n = mx.sym.Flatten(d)
+        n = mx.sym.FullyConnected(n, num_hidden=4, name="fc")
+        return mx.sym.SoftmaxOutput(n, name="softmax")
+
+    def make_it():
+        return feed.record_pipeline(
+            rec, 8, (3, 8, 8), reader_procs=4, shuffle_window=6, seed=5,
+            scale=1.0 / 255, max_epochs=8, to_device=False,
+            device_augment=False)
+
+    init = {"fc_weight": mx.nd.array(
+        np.random.RandomState(7).uniform(-0.05, 0.05, (4, 192))
+        .astype(np.float32)), "fc_bias": mx.nd.zeros((4,))}
+
+    def fit(it, resume, ckpt_dir, epochs, cb=None):
+        m = mx.mod.Module(net(), context=mx.cpu(0))
+        m.fit(it, num_epoch=epochs, arg_params=dict(init),
+              optimizer_params=(("learning_rate", 0.05),),
+              checkpoint=str(ckpt_dir), checkpoint_every=3,
+              resume=resume, batch_end_callback=cb)
+        a, _ = m.get_params()
+        return {k: v.asnumpy() for k, v in a.items()}
+
+    ref_it = make_it()
+    want = fit(ref_it, False, tmp_path / "ck_ref", 2)
+    ref_it.close()
+
+    class Interrupt(Exception):
+        pass
+
+    def bomb(param):
+        # epoch 1, batch index 1 => global step 6: the last committed
+        # checkpoint is step 6 (mid-epoch-1)
+        if param.epoch == 1 and param.nbatch == 1:
+            raise Interrupt()
+
+    it1 = make_it()
+    with pytest.raises(Interrupt):
+        fit(it1, False, tmp_path / "ck", 2, cb=bomb)
+    it1.close()
+
+    it2 = make_it()
+    got = fit(it2, True, tmp_path / "ck", 2)
+    it2.close()
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=0, atol=1e-6)
+
+
+# -- on-device augmentation ---------------------------------------------------
+
+def test_device_host_augment_parity():
+    """Same RNG fold => identical pixels: the traced jax prologue and
+    the numpy host twin agree bitwise, train and eval mode."""
+    import jax
+    spec = feed.AugmentSpec((3, 8, 8), pre_shape=(12, 14, 3),
+                            rand_crop=True, rand_mirror=True,
+                            mean_rgb=(120.0, 100.0, 90.0),
+                            scale=1.0 / 255)
+    x = np.random.RandomState(0).randint(
+        0, 256, (6, 12, 14, 3)).astype(np.uint8)
+    key = jax.random.key(42)
+    for train in (True, False):
+        dev = jax.jit(lambda x, k, t=train:
+                      feed.augment_batch(x, k, spec, t))(x, key)
+        host = feed.augment_batch_host(x, key, spec, train)
+        assert np.array_equal(np.asarray(dev), host)
+        assert np.asarray(dev).shape == (6, 3, 8, 8)
+    # eval mode is deterministic center crop: key-independent
+    e1 = feed.augment_batch_host(x, jax.random.key(0), spec, False)
+    e2 = feed.augment_batch_host(x, jax.random.key(9), spec, False)
+    assert np.array_equal(e1, e2)
+
+
+def _parity_net():
+    d = mx.sym.Variable("data")
+    n = mx.sym.Convolution(d, num_filter=4, kernel=(3, 3), name="c0")
+    n = mx.sym.Flatten(n)
+    n = mx.sym.FullyConnected(n, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(n, name="softmax")
+
+
+def test_uint8_training_matches_host_path(tmp_path):
+    """The acceptance parity: training through the compact uint8 wire +
+    traced device augment equals training through the host-augmented
+    f32 wire, to the last bit of every parameter — and the uint8 batch
+    crosses H2D with >= 3.5x fewer bytes."""
+    rec = _raw_rec(tmp_path / "u8.rec", 32, shape=(3, 8, 8), label_mod=4,
+                   seed=1)
+    common = dict(batch_size=8, data_shape=(3, 8, 8), rand_crop=False,
+                  rand_mirror=False, mean_rgb=(100.0, 110.0, 120.0),
+                  scale=1.0 / 255, max_epochs=6, seed=0, shuffle_window=0,
+                  reader_procs=2, to_device=False)
+    it_host = feed.record_pipeline(rec, device_augment=False, **common)
+    it_dev = feed.record_pipeline(rec, device_augment=True, **common)
+    assert it_host.augment_spec is None
+    assert it_dev.augment_spec is not None
+
+    init = None
+    res = {}
+    for tag, it in (("host", it_host), ("dev", it_dev)):
+        m = mx.mod.Module(_parity_net(), context=mx.cpu(0))
+        if init is None:
+            m.bind(data_shapes=it.provide_data,
+                   label_shapes=it.provide_label, for_training=True)
+            m.init_params(initializer=mx.init.Uniform(0.05))
+            a, _ = m.get_params()
+            init = {k: v.asnumpy() for k, v in a.items()}
+        m.fit(it, num_epoch=2,
+              arg_params={k: mx.nd.array(v) for k, v in init.items()},
+              optimizer_params=(("learning_rate", 0.05),))
+        a, _ = m.get_params()
+        res[tag] = {k: v.asnumpy() for k, v in a.items()}
+
+    for k in res["host"]:
+        np.testing.assert_allclose(res["dev"][k], res["host"][k],
+                                   rtol=0, atol=1e-6)
+    # compact wire: per-image bytes u8 HWC vs f32 CHW at equal resolution
+    b_dev = it_dev.next().data[0].asnumpy()
+    b_host = it_host.next().data[0].asnumpy()
+    assert b_dev.dtype == np.uint8
+    assert b_host.nbytes >= 3.5 * b_dev.nbytes
+    it_host.close()
+    it_dev.close()
+
+
+def test_uint8_steady_loop_no_compiles(tmp_path):
+    """After the first batch compiles the augment-prologue step, the
+    steady uint8 loop must never retrace (fixed pre_shape => fixed
+    avals)."""
+    rec = _raw_rec(tmp_path / "u8c.rec", 32, shape=(3, 8, 8), label_mod=4)
+    it = feed.record_pipeline(rec, 8, (3, 8, 8), reader_procs=2,
+                              shuffle_window=4, seed=0, scale=1.0 / 255,
+                              rand_crop=True, rand_mirror=True,
+                              max_epochs=6, to_device=False,
+                              device_augment=True)
+    m = mx.mod.Module(_parity_net(), context=mx.cpu(0))
+    m.fit(it, num_epoch=1, optimizer_params=(("learning_rate", 0.05),))
+    with assert_no_compiles("uint8-prologue steady loop"):
+        n = 0
+        try:
+            while True:
+                b = it.next()
+                m.forward(b, is_train=True)
+                m.update()
+                n += 1
+        except StopIteration:
+            pass
+    assert n == 4
+    it.close()
+
+
+def test_uint8_superstep_bitwise_matches_k1(tmp_path):
+    """The augment prologue lives in the shared step trace, its RNG
+    folds from the in-program step counter: superstep K=2 over the
+    uint8 wire with RANDOM crop+flip is bitwise-identical to K=1."""
+    rec = _raw_rec(tmp_path / "ss.rec", 32, shape=(3, 8, 8), label_mod=4,
+                   seed=1)
+
+    def make_it():
+        return feed.record_pipeline(
+            rec, 8, (3, 8, 8), reader_procs=2, seed=0, shuffle_window=4,
+            rand_crop=True, rand_mirror=True, scale=1.0 / 255,
+            max_epochs=8, to_device=False, device_augment=True)
+
+    init = {"fc_weight": mx.nd.array(
+        np.random.RandomState(3).uniform(-0.05, 0.05, (4, 192))
+        .astype(np.float32)), "fc_bias": mx.nd.zeros((4,))}
+
+    def net():
+        d = mx.sym.Variable("data")
+        n = mx.sym.Flatten(d)
+        n = mx.sym.FullyConnected(n, num_hidden=4, name="fc")
+        return mx.sym.SoftmaxOutput(n, name="softmax")
+
+    res = {}
+    for tag, k in (("k1", None), ("k2", 2)):
+        mx.random.seed(123)      # same fused base key => same crop draws
+        it = make_it()
+        m = mx.mod.Module(net(), context=mx.cpu(0))
+        m.fit(it, num_epoch=2, arg_params=dict(init), superstep=k,
+              optimizer_params=(("learning_rate", 0.05),))
+        a, _ = m.get_params()
+        res[tag] = {kk: v.asnumpy() for kk, v in a.items()}
+        it.close()
+    for kk in res["k1"]:
+        assert np.array_equal(res["k1"][kk], res["k2"][kk])
+
+
+def test_device_augment_without_fused_raises(tmp_path):
+    """A uint8 pipeline into a module that cannot run the fused step
+    (no classic fallback can consume the wire format) fails with the
+    actionable message, not a shape crash."""
+    rec = _raw_rec(tmp_path / "u8f.rec", 16, shape=(3, 8, 8), label_mod=4)
+    it = feed.record_pipeline(rec, 8, (3, 8, 8), reader_procs=1,
+                              shuffle_window=0, max_epochs=2,
+                              to_device=False, device_augment=True)
+    m = mx.mod.Module(_parity_net(), context=mx.cpu(0))
+    os.environ["MXNET_FUSED_TRAIN"] = "0"
+    try:
+        with pytest.raises(mx.MXNetError, match="device_augment=False"):
+            m.fit(it, num_epoch=1)
+    finally:
+        del os.environ["MXNET_FUSED_TRAIN"]
+    it.close()
+
+
+def test_host_augment_draws_are_positional(tmp_path):
+    """f32-path host augmentation (np.random inside the forked decode)
+    must be a pure function of (seed, shard, epoch, seq): forked workers
+    inherit ONE parent RNG state, so without positional reseeding every
+    shard would draw identical flips and a restarted/restored worker
+    would re-decode in-flight samples differently than the saved run.
+    Checked at PIXEL level: rebuild-deterministic, per-sample varied,
+    and mid-epoch fast-restore reproduces the exact pixels."""
+    rec = _raw_rec(tmp_path / "rng.rec", 40, shape=(3, 8, 8))
+
+    def make():
+        return feed.record_pipeline(str(tmp_path / "rng.rec"), 5, (3, 8, 8),
+                                    reader_procs=2, shuffle_window=5,
+                                    seed=4, rand_mirror=True,
+                                    scale=1.0 / 255, max_epochs=2,
+                                    to_device=False, device_augment=False)
+
+    def collect(it, n=None):
+        out = []
+        try:
+            while True:
+                out.append(it.next().data[0].asnumpy().copy())
+                if n and len(out) >= n:
+                    return out
+        except StopIteration:
+            pass
+        return out
+
+    ita, itb = make(), make()
+    a, b = collect(ita), collect(itb)
+    ita.close()
+    itb.close()
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    # flips vary per sample (decorrelated draws, not one inherited state)
+    rows = np.concatenate([x.reshape(5, -1) for x in a[:4]])
+    assert len({tuple(r[:6]) for r in rows}) > 10
+
+    # cursor + fast_restore must never walk the .rec: shard ends come
+    # from consumed epoch-end markers (or ride inside the cursor)
+    from mxnet_tpu import recordio as _rio
+    real_count = _rio.count_records
+
+    def no_walk(*a, **k):
+        raise AssertionError("cursor/restore walked the record file")
+
+    _rio.count_records = no_walk
+    try:
+        it2 = make()
+        collect(it2, 3)
+        st = it2.state()
+        # sizes are either still unobserved (None) or learned from the
+        # readahead's markers — never from a file walk
+        assert st["reader"]["shard_sizes"] in ([None, None], [20, 20])
+        it2.close()
+        it3 = make()
+        it3.restore(st)
+        rest = collect(it3)
+        assert all(np.array_equal(x, y) for x, y in zip(rest, a[3:8]))
+        it3.close()
+    finally:
+        _rio.count_records = real_count
+
+
+# -- observability / knobs / shutdown ----------------------------------------
+
+def test_feed_report_aggregates_worker_processes(tmp_path):
+    """profiler.feed_report() must show the decode work done in the
+    reader subprocesses (items, busy seconds, restarts, liveness), not
+    just the parent's counters."""
+    rec = _raw_rec(tmp_path / "s.rec", 24)
+    it = _reader_iter(rec, 6, workers=2, window=3, max_epochs=1)
+    _labels(it, 1)
+    rep = it.pipeline.stats.report()["reader"]
+    assert rep["worker_items"] == 24
+    assert set(rep["workers"]) == {"w0", "w1"}
+    assert rep["workers"]["w0"]["items"] + \
+        rep["workers"]["w1"]["items"] == 24
+    assert rep["restarts"] == 0
+    txt = mx.profiler.feed_report_str()
+    assert "reader[w0]" in txt and "reader[w1]" in txt
+    assert it.pipeline.stats.report()["reader"]["items"] == 24
+    it.close()
+
+
+def test_env_knobs(tmp_path, monkeypatch):
+    """MXNET_FEED_WORKERS / MXNET_FEED_SHUFFLE_WINDOW /
+    MXNET_FEED_DEVICE_AUGMENT drive record_pipeline's defaults."""
+    rec = _raw_rec(tmp_path / "k.rec", 12, shape=(3, 6, 6))
+    monkeypatch.setenv("MXNET_FEED_WORKERS", "2")
+    monkeypatch.setenv("MXNET_FEED_SHUFFLE_WINDOW", "4")
+    monkeypatch.setenv("MXNET_FEED_DEVICE_AUGMENT", "1")
+    it = feed.record_pipeline(rec, 4, (3, 6, 6), max_epochs=1,
+                              to_device=False)
+    head = it.pipeline.stages[0]
+    assert isinstance(head, feed.ParallelReader)
+    assert head._nworkers == 2 and head._window == 4
+    assert it.augment_spec is not None
+    assert it.augment_spec.pre_shape == (6, 6, 3)
+    # uint8 wire all the way through the batch stage
+    b = it.next()
+    assert b.data[0].dtype == np.uint8
+    assert b.data[0].shape == (4, 6, 6, 3)
+    it.close()
+
+
+def test_shutdown_no_leaked_processes(tmp_path):
+    """close() mid-epoch ends every worker process and pipeline thread."""
+    rec = _raw_rec(tmp_path / "z.rec", 40)
+    it = _reader_iter(rec, 5, workers=3, window=5, max_epochs=None)
+    it.next()
+    reader = it.pipeline.stages[0]
+    pids = [p for p in reader.worker_pids() if p]
+    assert len(pids) == 3
+    it.close()
+    assert it.pipeline.alive_threads() == []
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if all(not _alive(p) for p in pids):
+            break
+        time.sleep(0.05)
+    assert all(not _alive(p) for p in pids)
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    # reaped-but-present zombies count as dead
+    try:
+        with open("/proc/%d/stat" % pid) as f:
+            return f.read().split()[2] != "Z"
+    except OSError:
+        return False
