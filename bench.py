@@ -454,6 +454,18 @@ def main():
         line.update(compile_run(feed=_feed_watchdog))
     except Exception as e:
         sys.stderr.write("bench: compile leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
+    # multichip leg (ISSUE 7): Module.fit(mesh=...) scaling efficiency
+    # vs 1 device (dp=8 and dp=4 x tp=2, weak scaling) and the
+    # tp=2-sharded ServeEngine's closed-loop QPS; runs on the real
+    # topology when >= 8 devices exist, else on 8 forced host-CPU
+    # devices (flagged multichip_backend=host_cpu)
+    try:
+        from bench_multichip import run as multichip_run
+        _feed_watchdog("multichip")
+        line.update(multichip_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: multichip leg failed (%s)\n" % e)
     _wd.stop()
     print(json.dumps(line), flush=True)
 

@@ -190,6 +190,57 @@ class Executor:
                             if grad_req.get(n, "null") != "null"
                             and grad_dict.get(n) is not None]
 
+        # multichip inference placement (set_mesh): mesh + replicated
+        # sharding for the RNG operand; None = classic single-device
+        self._mesh = None
+        self._mesh_rep = None
+        self._mesh_desc = ""
+
+    # -- multichip placement -------------------------------------------------
+    def set_mesh(self, mesh, param_specs=None, input_specs=None) -> None:
+        """Place EVERY bound array on ``mesh`` for GSPMD execution:
+        params/aux at their declared PartitionSpecs (``param_specs``,
+        name -> spec; replicated when absent), inputs at
+        ``input_specs`` (e.g. the batch input at ``P("dp", ...)``).
+        One jit program cannot mix mesh-committed and single-device-
+        committed operands, which is why everything moves.
+
+        Inference-only (the tp-sharded ServeEngine path): a training
+        executor's gradients live outside this placement story — the
+        fused train step owns multichip training."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from .parallel.mesh import normalize_spec, validate_spec
+        if self._grad_names:
+            raise MXNetError(
+                "Executor.set_mesh is inference-only (grad_req='null'); "
+                "multichip training goes through Module.fit(mesh=...)")
+        specs = {}
+        for src in (param_specs, input_specs):
+            for n, sp in (src or {}).items():
+                specs[n] = normalize_spec(sp)
+        known = set(self.arg_dict) | set(self.aux_dict)
+        unknown = sorted(set(specs) - known)
+        if unknown:
+            raise MXNetError(
+                "set_mesh specs name no bound array: %s (have: %s)"
+                % (unknown, sorted(known)))
+        for name, nd in list(self.arg_dict.items()) + \
+                list(self.aux_dict.items()):
+            sp = specs.get(name, PartitionSpec())
+            validate_spec(name, sp, mesh, shape=nd.shape)
+            nd._place(NamedSharding(mesh, sp))
+        self._mesh = mesh
+        self._mesh_rep = NamedSharding(mesh, PartitionSpec())
+        # mesh axes + specs join the program identity: the same graph
+        # placed on dp=8 vs dp=4 x tp=2 partitions differently while the
+        # device-id list stays identical
+        from .parallel.mesh import mesh_axes
+        self._mesh_desc = "mesh:%r;specs:%r" % (
+            mesh_axes(mesh),
+            sorted((n, tuple(s)) for n, s in specs.items()))
+        self._prog_desc = None      # recompute with the mesh in it
+        self._jit_cache.clear()     # programs re-key under the mesh
+
     # -- helpers ------------------------------------------------------------
     @property
     def outputs(self) -> List[NDArray]:
@@ -214,7 +265,11 @@ class Executor:
         # on the DEFAULT device, and a cpu-ctx executor in a process that
         # also has a TPU would feed mixed-device args to one jit (the
         # reference analogue: the RNG resource lives on the op's stream,
-        # resource.cc:20-121)
+        # resource.cc:20-121).  A mesh-placed executor pins it replicated
+        # on the mesh instead — all operands must share one device set.
+        if self._mesh_rep is not None:
+            import jax
+            return jax.device_put(key, self._mesh_rep)
         if self._ctx is not None:
             import jax
             key = jax.device_put(key, self._ctx.jax_device())
@@ -280,6 +335,7 @@ class Executor:
             h.update(str(self._ctx).encode())
             h.update(str(self._prog.do_mirror).encode())
             h.update(str(self._fused_train).encode())
+            h.update(self._mesh_desc.encode())
             self._prog_desc = h.hexdigest()
         return self._prog_desc
 
@@ -310,7 +366,9 @@ class Executor:
         # thread count (parallel warmers advance thread-local chains,
         # serial warmup advances the main one)
         rng = jnp.zeros((2,), jnp.uint32)
-        if self._ctx is not None:
+        if self._mesh_rep is not None:
+            rng = jax.device_put(rng, self._mesh_rep)
+        elif self._ctx is not None:
             rng = jax.device_put(rng, self._ctx.jax_device())
         done = []
         for kind in kinds:

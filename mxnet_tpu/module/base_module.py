@@ -211,8 +211,21 @@ class BaseModule:
             num_epoch=None, validation_metric=None, monitor=None,
             work_load_list=None, prefetch_to_device=False,
             checkpoint=None, checkpoint_every=None, resume=False,
-            superstep=None):
+            superstep=None, mesh=None, sharding=None):
         """Train (reference base_module.py:273-393).
+
+        ``mesh``/``sharding``: first-class multichip training.  ``mesh``
+        is a named device mesh (``parallel.make_mesh([("dp", 4),
+        ("tp", 2)])``, the axes list itself, or ``"dp=4,tp=2"``); the
+        batch shards over the ``dp`` axis, ``sharding`` maps param
+        names to PartitionSpecs (``{"fc1_weight": P(None, "tp")}``, or
+        ``"None,tp"`` strings / ``__sharding__`` symbol attributes)
+        applied as GSPMD constraints inside the fused step — XLA
+        inserts the collectives.  Defaults to the ``MXNET_MESH`` env
+        knob.  Composes unchanged with ``superstep``,
+        ``prefetch_to_device``, on-device augmentation and
+        ``checkpoint`` (shards land on the live mesh at restore).  See
+        docs/multichip.md.
 
         ``prefetch_to_device``: wrap ``train_data`` with the feed
         subsystem's device prefetcher (mxnet_tpu.feed) so batch N+1's
@@ -255,6 +268,13 @@ class BaseModule:
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
+        if mesh is not None or sharding is not None:
+            setter = getattr(self, "set_mesh", None)
+            if setter is None:
+                raise MXNetError(
+                    "fit(mesh=...) needs a module with multichip support "
+                    "(Module); %s has no set_mesh" % type(self).__name__)
+            setter(mesh, sharding)
         if monitor is not None:
             self.install_monitor(monitor)
         self.init_params(initializer=initializer, arg_params=arg_params,

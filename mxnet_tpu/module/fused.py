@@ -70,25 +70,50 @@ class FusedTrainStep:
                  label_names: Sequence[str], param_names: Sequence[str],
                  fixed_param_names: Sequence[str], optimizer,
                  label_shapes=None, remat: bool = False,
-                 compute_dtype=None, global_dp: bool = False):
-        devices = [c.jax_device() for c in contexts]
-        if len(set(devices)) != len(devices):
-            raise MXNetError("fused step needs distinct devices")
+                 compute_dtype=None, global_dp: bool = False,
+                 mesh=None, sharding=None):
         self.global_dp = global_dp
-        if global_dp:
-            # multi-host dist_sync: ONE mesh over every process's devices;
-            # GSPMD turns the dp gradient mean into cross-process
-            # collectives (ICI within a slice, DCN across) — no kvstore
-            # round trips in the hot loop (reference kvstore_dist.h:65-98
-            # semantics at "python pushes one pointer" cost)
-            if set(devices) != set(jax.local_devices()):
+        self.named_mesh = mesh is not None
+        if mesh is not None:
+            # first-class multichip: a user-provided named mesh (e.g.
+            # parallel.make_mesh([("dp", 4), ("tp", 2)])).  The batch
+            # axis shards over "dp"; per-param GSPMD constraints over
+            # the remaining axes come from ``sharding`` below.
+            if global_dp:
                 raise MXNetError(
-                    "dist_sync fused step needs the module bound on every "
-                    "local device (%d bound, %d local)"
-                    % (len(devices), jax.local_device_count()))
-            self.mesh = Mesh(np.array(jax.devices()), ("dp",))
+                    "mesh= and dist_sync kvstores are mutually exclusive "
+                    "(a named mesh already owns all cross-device "
+                    "placement; run single-process with the mesh spanning "
+                    "every device instead)")
+            mdevs = list(mesh.devices.ravel())
+            if len(set(mdevs)) != len(mdevs):
+                raise MXNetError("fused step needs distinct devices")
+            if "dp" not in mesh.axis_names:
+                raise MXNetError(
+                    "mesh %s has no 'dp' axis; the batch shards over "
+                    "'dp' — use dp=1 for pure tensor parallelism"
+                    % (dict(mesh.shape),))
+            self.mesh = mesh
         else:
-            self.mesh = Mesh(np.array(devices), ("dp",))
+            devices = [c.jax_device() for c in contexts]
+            if len(set(devices)) != len(devices):
+                raise MXNetError("fused step needs distinct devices")
+            if global_dp:
+                # multi-host dist_sync: ONE mesh over every process's
+                # devices; GSPMD turns the dp gradient mean into
+                # cross-process collectives (ICI within a slice, DCN
+                # across) — no kvstore round trips in the hot loop
+                # (reference kvstore_dist.h:65-98 semantics at "python
+                # pushes one pointer" cost)
+                if set(devices) != set(jax.local_devices()):
+                    raise MXNetError(
+                        "dist_sync fused step needs the module bound on "
+                        "every local device (%d bound, %d local)"
+                        % (len(devices), jax.local_device_count()))
+                self.mesh = Mesh(np.array(jax.devices()), ("dp",))
+            else:
+                self.mesh = Mesh(np.array(devices), ("dp",))
+        self.dp_size = int(self.mesh.shape["dp"])
         self.data_names = tuple(data_names)
         self.label_names = tuple(label_names)
         self.label_shapes = dict(label_shapes or [])
@@ -96,6 +121,26 @@ class FusedTrainStep:
         self.train_names = [n for n in param_names if n not in fixed]
         self.fixed_names = [n for n in param_names if n in fixed]
         self.aux_names = symbol.list_auxiliary_states()
+        # per-param GSPMD sharding constraints: the ``sharding=`` map
+        # merged over ``__sharding__`` symbol attributes (explicit map
+        # wins).  Resolved to NamedShardings and applied with
+        # lax.with_sharding_constraint inside the step trace, so the
+        # partitioner inserts the tensor-parallel collectives.
+        from ..parallel.mesh import (normalize_spec, sharding_attrs,
+                                     validate_spec)
+        specs = sharding_attrs(symbol)
+        specs.update(sharding or {})
+        known = set(param_names) | set(self.aux_names)
+        unknown = sorted(set(specs) - known)
+        if unknown:
+            raise MXNetError(
+                "sharding specs name no bound parameter: %s (params: %s)"
+                % (unknown, sorted(known)))
+        self.param_specs = {}
+        for n, sp in specs.items():
+            sp = normalize_spec(sp)
+            validate_spec(n, sp, self.mesh)
+            self.param_specs[n] = sp
         self.optimizer = optimizer
         fused = optimizer.fused_update_fn()
         if fused is None:
@@ -132,11 +177,15 @@ class FusedTrainStep:
         # shard of the optimizer state, updated params all-gather back.
         # Same math, optimizer memory and update flops divided by the
         # dp degree; expressed purely through sharding constraints, the
-        # partitioner forms the collectives.
+        # partitioner forms the collectives.  Generalized to arbitrary
+        # named meshes: the update shards over the mesh's "dp" AXIS
+        # (not the whole device set), composing with per-param tensor-
+        # parallel specs — a dp=4 x tp=2 mesh shards each tp shard's
+        # update 4 ways.
         import os as _os
         self.shard_update = (
             _os.environ.get("MXNET_SHARD_WEIGHT_UPDATE", "0") == "1"
-            and len(self.mesh.devices.ravel()) > 1)
+            and self.dp_size > 1)
         # on-device augmentation prologue (feed.AugmentSpec): when set,
         # uint8 HWC data batches are cast/cropped/flipped/normalized
         # INSIDE the compiled step (feed.augment), so the feed ships
@@ -146,6 +195,19 @@ class FusedTrainStep:
         self._step = None
         self._fwd = None
         self._lr_cache = None
+        # multichip observability: per-step dispatch vs (sampled) device
+        # time, plus XLA cost analysis + collective counts once an AOT
+        # compile ran — surfaced via mx.profiler.multichip_report()
+        self.multichip_stats = None
+        if len(self.mesh.devices.ravel()) > 1:
+            from .. import profiler as _prof
+            from ..parallel.mesh import mesh_axes as _mesh_axes
+            from ..parallel.mesh import spec_axes as _spec_axes
+            self.multichip_stats = _prof.MultichipStats(
+                "fused", axes=_mesh_axes(self.mesh),
+                spec_axes=sorted({a for sp in self.param_specs.values()
+                                  for a in _spec_axes(sp)}))
+            _prof.register_multichip_stats(self.multichip_stats)
 
     def _cast_compute(self, args):
         from ..symbol import cast_compute
@@ -215,18 +277,49 @@ class FusedTrainStep:
     def _multiprocess(self):
         return self.global_dp and jax.process_count() > 1
 
-    def _update_spec(self, x):
-        """Sharding for one update-path leaf: leading dim over dp when
-        it divides evenly, replicated otherwise (tiny params)."""
-        ndev = len(self.mesh.devices.ravel())
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % ndev == 0:
-            return NamedSharding(self.mesh,
-                                 P(*(["dp"] + [None] * (x.ndim - 1))))
-        return self._replicated()
+    def _param_sharding(self, name):
+        """At-rest sharding for one named param/aux: its declared GSPMD
+        spec, replicated when none."""
+        return NamedSharding(self.mesh, self.param_specs.get(name, P()))
+
+    def _update_spec(self, x, name=None):
+        """Sharding for one update-path leaf (gradient / optimizer
+        slot): the param's declared spec, with the leading dim
+        additionally sharded over the dp axis when MXNET_SHARD_WEIGHT_
+        UPDATE is on and it divides evenly (replicated otherwise — tiny
+        params).  Composes: a tp-sharded weight's momentum stays
+        tp-sharded AND dp-sharded at rest."""
+        from ..parallel.mesh import spec_axes
+        nd = getattr(x, "ndim", 0)
+        base = tuple(self.param_specs.get(name, P())) if name else ()
+        spec = list(base[:nd]) + [None] * (nd - len(base[:nd]))
+        if self.shard_update and nd >= 1 and spec and spec[0] is None \
+                and "dp" not in spec_axes(spec) \
+                and x.shape[0] % self.dp_size == 0:
+            # a declared spec may already spend "dp" on another dim
+            # (P(None, "dp")) — a second use would be an invalid
+            # duplicate-axis PartitionSpec, so the update rides the
+            # declared layout alone
+            spec[0] = "dp"
+        if not any(e is not None for e in spec):
+            return self._replicated()
+        return NamedSharding(self.mesh, P(*spec))
+
+    def _check_divisible(self, name, shape):
+        """A declared spec whose axis does not divide its dim would shard
+        unevenly — checkpoint shard indexes and the donated layout both
+        want the even case; refuse with the numbers."""
+        spec = self.param_specs.get(name)
+        if spec is None:
+            return
+        from ..parallel.mesh import validate_spec
+        validate_spec(name, spec, self.mesh, shape=shape)
 
     def init_state(self, arg_params: Dict[str, NDArray],
                    aux_params: Dict[str, NDArray]):
-        """Build the device-resident train state from host param dicts."""
+        """Build the device-resident train state from host param dicts.
+        Each leaf lands directly in its declared sharding (tensor-
+        parallel params never materialize replicated on the mesh)."""
         rep = self._replicated()
 
         def host(v):
@@ -237,6 +330,9 @@ class FusedTrainStep:
             "fixed": {n: host(arg_params[n]) for n in self.fixed_names},
             "aux": {n: host(aux_params[n]) for n in self.aux_names},
         }
+        for group in tree.values():
+            for n, a in group.items():
+                self._check_divisible(n, a.shape)
         if self._multiprocess():
             # dist init semantics: rank 0's value wins everywhere
             # (reference kvstore_dist init); a global device_put needs
@@ -245,15 +341,18 @@ class FusedTrainStep:
             from jax.experimental import multihost_utils as mhu
             tree = mhu.broadcast_one_to_all(tree)
 
-        def put(a):
+        def put(a, sh=rep):
             # device_put may alias the caller's buffer when it already
             # lives here; the state is donated every step, so it must own
             # fresh storage or the source NDArrays get deleted under it
-            return jnp.copy(jax.device_put(a, rep))
-        params = {n: put(a) for n, a in tree["params"].items()}
-        fixed = {n: put(a) for n, a in tree["fixed"].items()}
-        aux = {n: put(a) for n, a in tree["aux"].items()}
-        if self.shard_update:
+            return jnp.copy(jax.device_put(a, sh))
+        params = {n: put(a, self._param_sharding(n))
+                  for n, a in tree["params"].items()}
+        fixed = {n: put(a, self._param_sharding(n))
+                 for n, a in tree["fixed"].items()}
+        aux = {n: put(a, self._param_sharding(n))
+               for n, a in tree["aux"].items()}
+        if self.shard_update or self.param_specs:
             # optimizer state lives SHARDED at rest: each replica holds
             # only its slice (the paper's memory saving) and the donated
             # state keeps one stable layout across steps.  Allocate each
@@ -261,13 +360,14 @@ class FusedTrainStep:
             # replicate-then-reshard would spike peak HBM by exactly the
             # amount this mode exists to save.
             opt = {}
-            init_cache = {}   # one compile per (shape, dtype), not per param
+            init_cache = {}   # one compile per (shape, dtype, spec)
             for n, w in params.items():
-                key = (tuple(w.shape), str(w.dtype))
+                key = (tuple(w.shape), str(w.dtype),
+                       repr(self.param_specs.get(n)))
                 if key not in init_cache:
                     struct = jax.eval_shape(self._opt_init, w)
-                    shardings = jax.tree_util.tree_map(self._update_spec,
-                                                       struct)
+                    shardings = jax.tree_util.tree_map(
+                        lambda x, _n=n: self._update_spec(x, _n), struct)
                     init_cache[key] = jax.jit(self._opt_init,
                                               out_shardings=shardings)
                 opt[n] = init_cache[key](w)
@@ -434,9 +534,26 @@ class FusedTrainStep:
         rescale = self.optimizer.rescale_grad
         clip = self.optimizer.clip_gradient
         lr_mult, wd, opt_update = self._lr_mult, self._wd, self._opt_update
+        # which params ride GSPMD constraints through the update: every
+        # specced (tensor-parallel) param always; every param when the
+        # cross-replica sharded weight update is on
+        constrained = self.shard_update or bool(self.param_specs)
+
+        def wsc_param(n, w):
+            if n in self.param_specs:
+                return jax.lax.with_sharding_constraint(
+                    w, self._param_sharding(n))
+            return w
 
         def step(state, batch, lr, base_key):
             params, fixed, aux = state["params"], state["fixed"], state["aux"]
+            if self.param_specs:
+                # pin the declared layouts at the trace root so GSPMD
+                # propagates them through the matmuls (inserting the
+                # tensor-parallel collectives) instead of re-deriving a
+                # layout from scratch
+                params = {n: wsc_param(n, w) for n, w in params.items()}
+                fixed = {n: wsc_param(n, w) for n, w in fixed.items()}
             t = state["t"] + 1
             # per-step randomness derived in-program from one resident key:
             # creating a fresh host key every batch would cost a transfer
@@ -470,20 +587,22 @@ class FusedTrainStep:
                 g = grads[n].astype(w.dtype) * rescale
                 if clip is not None:
                     g = jnp.clip(g, -clip, clip)
-                if self.shard_update:
-                    # grads arrive sharded (reduce-scatter), the update
-                    # runs on the shard, params leave replicated
-                    # (all-gather) and optimizer state stays sharded
+                if constrained:
+                    # grads arrive sharded (reduce-scatter over dp,
+                    # tensor-parallel shards stay put), the update runs
+                    # on the shard, params leave in their at-rest spec
+                    # (all-gather over dp when replicated there) and
+                    # optimizer state stays sharded
                     g = jax.lax.with_sharding_constraint(
-                        g, self._update_spec(g))
+                        g, self._update_spec(g, n))
                 new_params[n], new_opt[n] = opt_update(
                     w, g, state["opt"][n], lr * lr_mult[n], wd[n], t)
-                if self.shard_update:
+                if constrained:
                     new_params[n] = jax.lax.with_sharding_constraint(
-                        new_params[n], self._replicated())
+                        new_params[n], self._param_sharding(n))
                     new_opt[n] = jax.tree_util.tree_map(
-                        lambda x: jax.lax.with_sharding_constraint(
-                            x, self._update_spec(x)), new_opt[n])
+                        lambda x, _n=n: jax.lax.with_sharding_constraint(
+                            x, self._update_spec(x, _n)), new_opt[n])
             merged_aux = dict(aux)
             merged_aux.update(new_aux)
             return ({"params": new_params, "opt": new_opt,
@@ -499,6 +618,7 @@ class FusedTrainStep:
         train/fixed/label name split.  Op and optimizer IMPLEMENTATIONS
         are covered by the cache's code_fingerprint."""
         import hashlib
+        from ..parallel.mesh import mesh_axes as _mesh_axes
         h = hashlib.sha256()
         h.update(self._prog.symbol.tojson().encode())
         for part in (tag, type(self.optimizer).__name__,
@@ -509,6 +629,13 @@ class FusedTrainStep:
                      repr(self.device_augment.signature()
                           if self.device_augment is not None else None),
                      str(self.shard_update), str(self.global_dp),
+                     # mesh AXES, not just devices: dp=8 and dp=4 x tp=2
+                     # over the same chips partition differently but list
+                     # identical device ids — without the axis shape the
+                     # fast key would alias the two programs
+                     repr(_mesh_axes(self.mesh)),
+                     repr(sorted((n, tuple(s))
+                                 for n, s in self.param_specs.items())),
                      repr([int(d.id) for d in self.mesh.devices.ravel()]),
                      repr(self.train_names), repr(self.fixed_names),
                      repr(sorted(self.label_shapes.items()))):
@@ -603,12 +730,45 @@ class FusedTrainStep:
         if self._multiprocess():
             # a host scalar is replicated implicitly; an uncommitted
             # device scalar cannot join a multi-process computation
-            return self._step(state, batch, np.float32(lr), base_key)
+            return self._dispatch(state, batch, np.float32(lr), base_key)
         if self._lr_cache is None or self._lr_cache[0] != lr:
             # lr changes only when the scheduler fires; keep the device
             # scalar resident between changes
             self._lr_cache = (lr, jnp.asarray(lr, jnp.float32))
-        return self._step(state, batch, self._lr_cache[1], base_key)
+        return self._dispatch(state, batch, self._lr_cache[1], base_key)
+
+    def _dispatch(self, state, batch, lr, base_key):
+        """Run the step program, feeding the multichip counters: host
+        dispatch time every step, full device step wall on a sampled
+        subset (one sync every sample_every steps — the async pipeline
+        stays intact between samples)."""
+        stats = self.multichip_stats
+        if stats is None:
+            return self._step(state, batch, lr, base_key)
+        import time as _time
+        first = stats.steps == 0
+        sample = not first and stats.should_sample()
+        if sample:
+            # drain the async backlog BEFORE timing, or the sampled
+            # wait charges up to sample_every queued steps' device time
+            # to this one step (the input state is the previous step's
+            # output — ready means the queue is empty)
+            jax.block_until_ready(
+                next(iter(state["params"].values()), state["t"]))
+        t0 = _time.perf_counter()
+        out = self._step(state, batch, lr, base_key)
+        if first:
+            # blocks through trace+compile on a cold cache: its own
+            # counter, not the steady dispatch average
+            stats.note_first(_time.perf_counter() - t0)
+        else:
+            stats.add_step(_time.perf_counter() - t0)
+        if sample:
+            t1 = _time.perf_counter()
+            leaf = next(iter(out[0]["params"].values()), out[0]["t"])
+            jax.block_until_ready(leaf)
+            stats.add_wait(_time.perf_counter() - t1)
+        return out
 
     def gather_update_leaf(self, x):
         """One sharded-at-rest optimizer-state leaf -> replicated (and,
@@ -661,12 +821,32 @@ class FusedTrainStep:
         else:
             compiled = self._step.lower(state, batch, lr, base_key).compile()
         flops = 0.0
+        bytes_accessed = 0.0
         try:
             ca = compiled.cost_analysis()
             ca = ca[0] if isinstance(ca, list) else ca
-            flops = float(ca.get("flops", 0.0)) if ca else 0.0
+            if ca:
+                flops = float(ca.get("flops", 0.0))
+                bytes_accessed = float(ca.get("bytes accessed", 0.0))
         except Exception:
             pass
+        if self.multichip_stats is not None:
+            # the optimized (post-SPMD-partitioner) HLO names the REAL
+            # collectives; parse counts + payload bytes for the
+            # collective-vs-compute split in multichip_report()
+            txt = None
+            try:
+                if hasattr(compiled, "as_text"):
+                    txt = compiled.as_text()
+                elif hasattr(compiled, "_loaded"):
+                    txt = compiled._loaded.hlo_modules()[0].to_string()
+            except Exception:
+                pass
+            from .. import profiler as _prof
+            self.multichip_stats.set_cost(
+                flops=flops, bytes_accessed=bytes_accessed,
+                collectives=_prof.parse_hlo_collectives(txt)
+                if txt else None)
         self._step = compiled
         self._lr_cache = None
         return flops
@@ -686,8 +866,16 @@ class FusedTrainStep:
         # contract): a jnp.copy would stay committed to the fused mesh,
         # and a mesh-committed weight leaking into the classic per-device
         # path (kvstore re-seed on fallback, exec-group updates) poisons
-        # every eager op it meets with a device mismatch.
+        # every eager op it meets with a device mismatch.  A tensor-
+        # parallel (specced) param is SHARDED at rest — addressable_data(0)
+        # would hand back one shard as if it were the whole weight, so
+        # non-replicated leaves gather first.
         def host(x):
+            sh = getattr(x, "sharding", None)
+            if sh is not None and not x.is_fully_replicated:
+                if x.is_fully_addressable:
+                    return NDArray(jnp.asarray(np.asarray(x)))
+                return NDArray(self.gather_update_leaf(x))
             return NDArray(jnp.asarray(np.asarray(x.addressable_data(0))))
         for n in self.train_names:
             arg_params[n] = host(state["params"][n])

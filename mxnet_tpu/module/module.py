@@ -64,6 +64,11 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
 
+        # named device mesh + per-param GSPMD sharding specs (first-class
+        # multichip: set_mesh / bind(mesh=...) / fit(mesh=...)); consumed
+        # by _setup_fused, which hands them to FusedTrainStep
+        self._mesh = None
+        self._sharding_specs = None
         # fused fast path (see fused.py): engaged by init_optimizer when
         # the configuration allows one donated XLA program per batch
         self._fused = None
@@ -187,14 +192,62 @@ class Module(BaseModule):
             self._exec_group.get_params(self._arg_params, self._aux_params)
         self._params_dirty = False
 
+    # -- mesh ----------------------------------------------------------------
+    def set_mesh(self, mesh, sharding=None):
+        """Install a named device mesh + per-param GSPMD sharding specs
+        for multichip training (the public multichip surface, also
+        reachable as ``bind(mesh=...)`` / ``fit(mesh=...)``).
+
+        ``mesh``: a ``jax.sharding.Mesh`` (``parallel.make_mesh``), an
+        axes list like ``[("dp", 4), ("tp", 2)]``, the ``"dp=4,tp=2"``
+        string form, or None to clear.  The batch axis shards over
+        ``"dp"``; ``sharding`` maps param names to PartitionSpecs (or
+        ``"None,tp"``-style strings) applied as constraints on the
+        symbol graph — ``__sharding__`` variable attributes compose,
+        with this map winning.
+
+        Call before ``init_optimizer`` (fit does); afterwards the fused
+        step is rebuilt on the new mesh with the FULL train state
+        carried across — params, optimizer slots (momentum, Adam
+        moments), step counter and RNG all land re-sharded on the new
+        mesh (the same capture/restore machinery a cross-mesh
+        checkpoint resume uses)."""
+        from jax.sharding import Mesh
+        from ..parallel import make_mesh
+        if mesh is not None and not isinstance(mesh, Mesh):
+            mesh = make_mesh(mesh)
+        specs = dict(sharding) if sharding else None
+        if mesh == self._mesh and specs == self._sharding_specs:
+            return       # no-op set keeps the warm compiled programs
+        carried = None
+        if self.optimizer_initialized and self._fused is not None and \
+                self._fused_state is not None:
+            # mid-training re-mesh: dropping the fused state would
+            # silently zero every optimizer slot; capture the whole
+            # train state and restore it into the new mesh's layout
+            from ..checkpoint.module_state import (capture_train_state,
+                                                   restore_train_state)
+            carried = capture_train_state(self)
+        self._mesh = mesh
+        self._sharding_specs = specs
+        if self.optimizer_initialized:
+            self._setup_fused()
+            if carried is not None and self._fused is not None:
+                restore_train_state(self, *carried)
+
     # -- bind ----------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write", no_slice_names=None):
+             grad_req="write", no_slice_names=None, mesh=None,
+             sharding=None):
         """``no_slice_names``: input/label names that must NOT be batch-
         sliced across devices even when their leading dim equals the batch
         size (e.g. rcnn rois with num_rois == batch_size); they are
-        replicated whole instead of silently split."""
+        replicated whole instead of silently split.
+
+        ``mesh``/``sharding``: multichip placement — see ``set_mesh``."""
+        if mesh is not None or sharding is not None:
+            self.set_mesh(mesh, sharding)
         if force_rebind:
             self._reset_bind()
         if self.binded:
@@ -393,9 +446,35 @@ class Module(BaseModule):
         self._fused_outputs = None
         self._superstep_progs = {}
         self._discard_speculation()
-        if not self._fusable():
-            return
         import os
+        mesh = self._mesh
+        if mesh is None and os.environ.get("MXNET_MESH", "").strip():
+            # MXNET_MESH="dp=4,tp=2": the env-knob spelling of set_mesh
+            from ..parallel import mesh_from_env
+            mesh = mesh_from_env()
+        specs = self._sharding_specs
+        if not self._fusable():
+            if mesh is not None or specs:
+                # a mesh the user asked for must never silently degrade
+                # to a single-device classic loop
+                raise MXNetError(
+                    "Module mesh training needs the fused train step, "
+                    "which this configuration disables (monitor / "
+                    "grad_req != 'write' / borrowed optimizer / shared "
+                    "executors / optimizer without a fused form / "
+                    "MXNET_FUSED_TRAIN=0); remove the blocker or drop "
+                    "mesh=/sharding=")
+            return
+        if mesh is not None and "dp" in mesh.axis_names:
+            # (a mesh WITHOUT a dp axis is refused by FusedTrainStep
+            # below, re-raised loudly because mesh is set)
+            bs = self._exec_group.batch_size
+            dp = int(mesh.shape["dp"])
+            if bs % dp:
+                raise MXNetError(
+                    "bound batch size %d is not divisible by the mesh's "
+                    "dp axis (%d); pick a batch the devices can slice "
+                    "evenly" % (bs, dp))
         remat = bool(int(os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0")))
         # MXNET_COMPUTE_DTYPE=bfloat16: bf16 fwd/bwd on the MXU with f32
         # master weights (the fp16-era capability mapped the TPU way)
@@ -408,9 +487,14 @@ class Module(BaseModule):
                 self._label_names, self._param_names,
                 self._fixed_param_names, self._optimizer,
                 label_shapes=self._label_shapes, remat=remat,
-                compute_dtype=cdt, global_dp=gdp)
+                compute_dtype=cdt, global_dp=gdp, mesh=mesh,
+                sharding=specs)
             self._fused_hsig = self._fused.hparam_signature()
         except MXNetError as e:
+            if mesh is not None or specs:
+                # same contract as above: a refused mesh must fail loud,
+                # not train on one device
+                raise
             # _fusable() already vetted the config, so a refusal here is
             # abnormal (e.g. fused_update_fn without a fused_hparams
             # declaration) — surface why the slow path engaged
@@ -492,7 +576,7 @@ class Module(BaseModule):
                     st = opt_states.get(n)
                     if st is None:
                         continue
-                    if fused.shard_update:
+                    if fused.shard_update or fused.param_specs:
                         # sharded-at-rest state must be gathered
                         # before the per-param host updater owns it
                         def _gather(s):
@@ -789,6 +873,9 @@ class Module(BaseModule):
             wait_s = _time.perf_counter() - t2
             reducer.absorb(host_acc)
         stats.add(k, h2d_s, dispatch_s, wait_s)
+        mcs = getattr(self._fused, "multichip_stats", None)
+        if mcs is not None:
+            mcs.add_superstep(k, dispatch_s, wait_s)
         return True
 
     def borrow_optimizer(self, shared_module):

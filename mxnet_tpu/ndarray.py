@@ -132,11 +132,22 @@ class NDArray:
                 new = new.astype(self._data.dtype)
             if self._data is not None:
                 # a write mutates the chunk in place in the reference —
-                # keep the buffer on its original device
+                # keep the buffer at its original PLACEMENT: the single
+                # device it lived on, or (mesh-placed arrays, see
+                # Executor.set_mesh) its multi-device sharding — a write
+                # must not silently collapse a tp-sharded weight onto
+                # one chip
                 try:
-                    old_dev = next(iter(self._data.devices()))
-                    if hasattr(new, "devices") and new.devices() != {old_dev}:
-                        new = jax.device_put(new, old_dev)
+                    old_devs = self._data.devices()
+                    if len(old_devs) > 1:
+                        old_sh = self._data.sharding
+                        if getattr(new, "sharding", None) != old_sh:
+                            new = jax.device_put(new, old_sh)
+                    else:
+                        old_dev = next(iter(old_devs))
+                        if hasattr(new, "devices") and \
+                                new.devices() != {old_dev}:
+                            new = jax.device_put(new, old_dev)
                 except Exception:
                     pass
             self._data = _engine.track(new)
@@ -153,6 +164,16 @@ class NDArray:
         else:
             raise MXNetError("unknown view spec %r" % (self._spec,))
         self._base._set(upd)
+
+    def _place(self, sharding):
+        """Move the owning chunk to an explicit jax sharding (or device),
+        keeping its value.  Later ``_set`` writes preserve the placement
+        (see the multi-device branch there) — this is how
+        ``Executor.set_mesh`` pins bound arrays to a mesh once and every
+        subsequent ``set_input``/``set_params`` write stays sharded."""
+        root = self._root()
+        root._data = _engine.track(jax.device_put(root._get(), sharding))
+        return self
 
     # -- basic properties ---------------------------------------------------
     @property

@@ -5,10 +5,15 @@ TPU-native replacement for the reference's kvstore/ps-lite distribution stack
 over ICI; model parallel = param PartitionSpecs (ctx_group analogue);
 multi-host = the same mesh spanning processes over ICI+DCN.
 """
-from .mesh import make_mesh, dp_sharding, replicated, Mesh, NamedSharding, PartitionSpec
+from .mesh import (make_mesh, parse_mesh_spec, mesh_from_env,
+                   normalize_spec, spec_axes, validate_spec,
+                   sharding_attrs, dp_sharding, replicated,
+                   Mesh, NamedSharding, PartitionSpec)
 from .data_parallel import DPTrainStep
 from .pipeline import GPipeTrainStep, pipeline_apply
 
-__all__ = ["make_mesh", "dp_sharding", "replicated", "Mesh", "NamedSharding",
-           "PartitionSpec", "DPTrainStep", "GPipeTrainStep",
-           "pipeline_apply"]
+__all__ = ["make_mesh", "parse_mesh_spec", "mesh_from_env",
+           "normalize_spec", "spec_axes", "validate_spec",
+           "sharding_attrs", "dp_sharding", "replicated",
+           "Mesh", "NamedSharding", "PartitionSpec", "DPTrainStep",
+           "GPipeTrainStep", "pipeline_apply"]

@@ -91,11 +91,19 @@ class DPTrainStep:
 
     def init(self, arg_params: Dict[str, np.ndarray],
              aux_params: Dict[str, np.ndarray]):
-        """Place params/aux/momentum on the mesh; returns device state."""
-        params = {k: jax.device_put(jnp.asarray(v), self._param_sharding(k))
-                  for k, v in arg_params.items() if k in self.param_names}
-        aux = {k: jax.device_put(jnp.asarray(v), self._param_sharding(k))
-               for k, v in aux_params.items()}
+        """Place params/aux/momentum on the mesh; returns device state.
+
+        jnp.copy: device_put may zero-copy ALIAS the caller's host
+        buffer (CPU backends), and this state is DONATED every step —
+        XLA would scribble over memory numpy still owns, corrupting
+        training nondeterministically (the same hazard
+        module/fused.init_state documents)."""
+        def put(v, k):
+            return jnp.copy(jax.device_put(jnp.asarray(v),
+                                           self._param_sharding(k)))
+        params = {k: put(v, k) for k, v in arg_params.items()
+                  if k in self.param_names}
+        aux = {k: put(v, k) for k, v in aux_params.items()}
         mom = {k: jax.device_put(jnp.zeros_like(v), self._param_sharding(k))
                for k, v in params.items()} if self.momentum else None
         return {"params": params, "aux": aux, "mom": mom}

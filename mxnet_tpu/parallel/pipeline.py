@@ -113,12 +113,17 @@ class GPipeTrainStep:
         self._step = None
 
     def init(self, stacked_params, tail_params):
+        # jnp.copy: the state is donated every step and device_put may
+        # zero-copy alias the caller's host buffers (see
+        # DPTrainStep.init)
         spec = NamedSharding(self.mesh, P(self.axis))
         rep = NamedSharding(self.mesh, P())
         stacked = jax.tree_util.tree_map(
-            lambda a: jax.device_put(jnp.asarray(a), spec), stacked_params)
+            lambda a: jnp.copy(jax.device_put(jnp.asarray(a), spec)),
+            stacked_params)
         tail = jax.tree_util.tree_map(
-            lambda a: jax.device_put(jnp.asarray(a), rep), tail_params)
+            lambda a: jnp.copy(jax.device_put(jnp.asarray(a), rep)),
+            tail_params)
         return {"stages": stacked, "tail": tail}
 
     def _build(self):

@@ -28,7 +28,10 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "checkpoint_report", "checkpoint_report_str", "SuperstepStats",
            "register_superstep_stats", "superstep_report",
            "superstep_report_str", "register_serve_stats", "serve_report",
-           "serve_report_str", "compile_report", "compile_report_str"]
+           "serve_report_str", "compile_report", "compile_report_str",
+           "MultichipStats", "register_multichip_stats",
+           "parse_hlo_collectives", "multichip_report",
+           "multichip_report_str"]
 
 _config = {"filename": "profile_output", "mode": "symbolic"}
 _state = "stop"
@@ -199,7 +202,263 @@ def superstep_report() -> dict:
 def superstep_report_str() -> str:
     """Human-readable dispatch/wait/stage split per training loop."""
     parts = [ss.report_str() for _, ss in sorted(_superstep_stats.items())]
-    return "\n\n".join(parts) if parts else "(no live superstep loops)"
+    out = "\n\n".join(parts) if parts else "(no live superstep loops)"
+    if _multichip_stats:
+        # the mesh-side view of the same loop: collective vs compute
+        # split and per-axis usage live in multichip_report()
+        out += ("\n\n(per-axis collective/compute split: see "
+                "mx.profiler.multichip_report_str())")
+    return out
+
+
+# -- multichip instrumentation (module/fused.py over a device mesh) ----------
+# One MultichipStats per FusedTrainStep spanning >1 device, registered
+# weakly like the feed pipelines.  The counters answer "where does a mesh
+# step's time go, and how much of it is collectives":
+#
+#   dispatch_s          host time enqueueing the step program (async
+#                       backends return before compute ends)
+#   sampled_device_s    full step wall measured by block_until_ready on
+#                       a sampled subset (1 in sample_every steps — the
+#                       async pipeline stays intact between samples)
+#   flops/bytes         XLA cost analysis of the AOT-compiled step —
+#                       PER DEVICE (SPMD cost analysis reports one
+#                       partition's work)
+#   collectives         op counts + per-device payload bytes parsed
+#                       from the optimized (post-SPMD-partitioner) HLO
+#                       — the REAL all-reduce/all-gather/reduce-scatter
+#                       the partitioner inserted for the mesh
+#
+# ``report(peak_tflops=, ici_gbps=)`` turns the static numbers into a
+# collective-vs-compute time split estimate; without them the raw
+# counts/bytes and the measured wall splits are reported as-is.
+_multichip_stats = weakref.WeakValueDictionary()
+_multichip_seq = 0
+
+_HLO_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+_HLO_ITEMSIZE = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8": 1,
+                 "s64": 8, "s32": 4, "s16": 2, "s8": 1,
+                 "u64": 8, "u32": 4, "u16": 2, "u8": 1, "pred": 1}
+
+
+def parse_hlo_collectives(hlo_text: str) -> dict:
+    """Collective-op census of one post-partitioner HLO module text:
+    per-op instruction counts plus the payload bytes of every typed
+    collective result.  The partitioned HLO is per-device, so counts
+    and bytes are PER DEVICE per program execution.  Async ``-start``
+    ops (TPU backends) return a tuple mixing the aliased operand, the
+    result and possibly context scalars — the largest element counts
+    as the payload, and the ``-done`` halves of the pairs are skipped
+    entirely (the -start carries the shape)."""
+    import re
+    out = {op: {"count": 0, "bytes": 0} for op in _HLO_COLLECTIVES}
+    line_pat = re.compile(
+        r"=\s*(\([^)]*\)|\S+)\s+(%s)(-start)?\("
+        % "|".join(_HLO_COLLECTIVES))
+    shape_pat = re.compile(r"([a-z]+\d*)\[([0-9,]*)\]")
+    for m in line_pat.finditer(hlo_text or ""):
+        shapes, op, started = m.group(1), m.group(2), m.group(3)
+        out[op]["count"] += 1
+        found = shape_pat.findall(shapes)
+
+        def nbytes(dt, dims):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            return n * _HLO_ITEMSIZE.get(dt, 4)
+        sizes = [nbytes(dt, dims) for dt, dims in found]
+        if started and len(sizes) > 1:
+            # -start tuples mix the aliased operand, the result, and
+            # (collective-permute) u32 context scalars — the largest
+            # element is the payload; summing would double it and
+            # halving would keep the context scalars
+            out[op]["bytes"] += max(sizes)
+        else:
+            out[op]["bytes"] += sum(sizes)
+    out["total_count"] = sum(v["count"] for k, v in out.items()
+                             if isinstance(v, dict))
+    out["total_bytes"] = sum(v["bytes"] for k, v in out.items()
+                             if isinstance(v, dict))
+    return out
+
+
+class MultichipStats:
+    """Counters for one mesh-spanning fused train step (see the section
+    note above).  ``axes`` is the mesh's ((name, size), ...) tuple;
+    ``spec_axes`` the axes any per-param sharding spec references."""
+
+    def __init__(self, name: str, axes, spec_axes=(), sample_every: int = 16):
+        self.name = name
+        self.axes = tuple((str(a), int(s)) for a, s in axes)
+        self.spec_axes = tuple(spec_axes)
+        self.devices = 1
+        for _, s in self.axes:
+            self.devices *= s
+        self.sample_every = max(1, int(sample_every))
+        self.steps = 0
+        self.dispatch_s = 0.0
+        self.first_step_s = 0.0
+        self.sampled_steps = 0
+        self.sampled_device_s = 0.0
+        self.flops_per_step = 0.0
+        self.bytes_per_step = 0.0
+        self.collectives = None
+
+    def add_step(self, dispatch_s: float) -> None:
+        self.steps += 1
+        self.dispatch_s += dispatch_s
+
+    def note_first(self, dispatch_s: float) -> None:
+        """The first dispatch blocks through trace+XLA compile (seconds
+        on a cold cache) — recording it into dispatch_s would dominate
+        dispatch_s_per_step forever, so it gets its own counter."""
+        self.steps += 1
+        self.first_step_s = dispatch_s
+
+    def should_sample(self) -> bool:
+        """Checked BEFORE add_step: true on the 2nd, (N+2)th, ... call
+        — never the first, whose wall is compile time (the caller
+        skips it; sample_every=1 samples every step after it)."""
+        return self.sample_every == 1 \
+            or self.steps % self.sample_every == 1
+
+    def add_wait(self, device_s: float) -> None:
+        self.sampled_steps += 1
+        self.sampled_device_s += device_s
+
+    def add_superstep(self, k: int, dispatch_s: float,
+                      wait_s: float = 0.0) -> None:
+        """K steps dispatched as ONE scan program (Module.superstep_
+        train): the metric drain's wait already measures the device
+        wall, so it feeds the sampled column without extra syncs."""
+        self.steps += int(k)
+        self.dispatch_s += dispatch_s
+        if wait_s:
+            self.sampled_steps += int(k)
+            self.sampled_device_s += wait_s
+
+    def set_cost(self, flops: float = 0.0, bytes_accessed: float = 0.0,
+                 collectives=None) -> None:
+        self.flops_per_step = float(flops)
+        self.bytes_per_step = float(bytes_accessed)
+        if collectives is not None:
+            self.collectives = dict(collectives)
+
+    def report(self, peak_tflops=None, ici_gbps=None) -> dict:
+        out = {
+            "mesh": dict(self.axes),
+            "devices": self.devices,
+            "steps": self.steps,
+            "dispatch_s": round(self.dispatch_s, 4),
+            "sampled_steps": self.sampled_steps,
+            "sampled_device_s": round(self.sampled_device_s, 4),
+        }
+        # per-axis view: degree + what uses the axis (the batch rides
+        # "dp"; tensor-parallel specs ride the axes they reference)
+        out["per_axis"] = {
+            a: {"size": s,
+                "batch_sharded": a == "dp",
+                "param_sharded": a in self.spec_axes}
+            for a, s in self.axes}
+        if self.first_step_s:
+            out["first_step_s"] = round(self.first_step_s, 4)
+        if self.sampled_steps:
+            out["device_s_per_step"] = round(
+                self.sampled_device_s / self.sampled_steps, 6)
+        steady = self.steps - (1 if self.first_step_s else 0)
+        if steady > 0:
+            out["dispatch_s_per_step"] = round(
+                self.dispatch_s / steady, 6)
+        if self.flops_per_step:
+            out["flops_per_step"] = self.flops_per_step
+            out["bytes_per_step"] = self.bytes_per_step
+        if self.collectives is not None:
+            out["collectives"] = self.collectives
+        # estimated collective-vs-compute split, only when the caller
+        # supplies the hardware numbers the estimate needs.  cost
+        # analysis of an SPMD executable and the partitioned HLO are
+        # both PER DEVICE already (verified: a dp=8 matmul reports 1/8
+        # the single-device flops), so neither divides by devices —
+        # per-device work over per-device peak / link bandwidth IS the
+        # per-device time estimate.
+        if peak_tflops and self.flops_per_step:
+            out["compute_s_est"] = self.flops_per_step \
+                / (peak_tflops * 1e12)
+        if ici_gbps and self.collectives and \
+                self.collectives.get("total_bytes"):
+            out["collective_s_est"] = (self.collectives["total_bytes"]
+                                       / (ici_gbps * 1e9))
+            if out.get("compute_s_est"):
+                tot = out["compute_s_est"] + out["collective_s_est"]
+                out["collective_frac_est"] = out["collective_s_est"] / tot
+        # measured fallback for the same split: device wall minus the
+        # compute estimate when both exist
+        if out.get("device_s_per_step") and out.get("compute_s_est"):
+            out["collective_s_measured_est"] = max(
+                0.0, out["device_s_per_step"] - out["compute_s_est"])
+        return out
+
+    def report_str(self, peak_tflops=None, ici_gbps=None) -> str:
+        r = self.report(peak_tflops=peak_tflops, ici_gbps=ici_gbps)
+        mesh = " x ".join("%s=%d" % (a, s) for a, s in self.axes)
+        lines = ["%s: mesh %s (%d devices), %d steps"
+                 % (self.name, mesh or "1", r["devices"], r["steps"])]
+        if "dispatch_s_per_step" in r:
+            lines.append("  dispatch_s/step   %10.6f"
+                         % r["dispatch_s_per_step"])
+        if "first_step_s" in r:
+            lines.append("  first step        %10.4f (trace+compile)"
+                         % r["first_step_s"])
+        if "device_s_per_step" in r:
+            lines.append("  device_s/step     %10.6f (sampled %d)"
+                         % (r["device_s_per_step"], r["sampled_steps"]))
+        if "flops_per_step" in r:
+            lines.append("  flops/step        %10.3e" % r["flops_per_step"])
+        c = r.get("collectives")
+        if c:
+            lines.append("  collectives/step  %d ops, %.3f MB"
+                         % (c["total_count"], c["total_bytes"] / 1e6))
+            for op in _HLO_COLLECTIVES:
+                if c.get(op, {}).get("count"):
+                    lines.append("    %-19s %3d ops %10.3f MB"
+                                 % (op, c[op]["count"],
+                                    c[op]["bytes"] / 1e6))
+        if "collective_frac_est" in r:
+            lines.append("  collective frac   %10.3f (est @ %s TF/s, %s "
+                         "GB/s ICI)" % (r["collective_frac_est"],
+                                        peak_tflops, ici_gbps))
+        for a, info in r["per_axis"].items():
+            use = [u for u, on in (("batch", info["batch_sharded"]),
+                                   ("params", info["param_sharded"])) if on]
+            lines.append("  axis %-6s size %2d  shards: %s"
+                         % (a, info["size"], ", ".join(use) or "(unused)"))
+        return "\n".join(lines)
+
+
+def register_multichip_stats(multichip_stats) -> None:
+    """Called by FusedTrainStep when its mesh spans >1 device."""
+    global _multichip_seq
+    _multichip_seq += 1
+    _multichip_stats["%s#%06d" % (multichip_stats.name, _multichip_seq)] = \
+        multichip_stats
+
+
+def multichip_report(peak_tflops=None, ici_gbps=None) -> dict:
+    """{key: counters} for every live mesh-spanning train step; pass
+    PER-DEVICE ``peak_tflops`` (e.g. bench.py's probe result) and
+    ``ici_gbps`` link bandwidth for the collective-vs-compute time
+    estimate."""
+    return {key: ms.report(peak_tflops=peak_tflops, ici_gbps=ici_gbps)
+            for key, ms in sorted(_multichip_stats.items())}
+
+
+def multichip_report_str(peak_tflops=None, ici_gbps=None) -> str:
+    """Human-readable per-mesh dispatch/device/collective table."""
+    parts = [ms.report_str(peak_tflops=peak_tflops, ici_gbps=ici_gbps)
+             for _, ms in sorted(_multichip_stats.items())]
+    return "\n\n".join(parts) if parts else "(no live multichip steps)"
 
 
 # -- checkpoint instrumentation (mxnet_tpu.checkpoint) ----------------------

@@ -81,6 +81,17 @@ class ServeEngine:
         Batching knobs; default from ``MXNET_SERVE_MAX_DELAY_MS`` (2),
         ``MXNET_SERVE_QUEUE_DEPTH`` (4x max batch),
         ``MXNET_SERVE_DEADLINE_MS`` (1000; 0 disables).
+    mesh / param_specs :
+        Multichip serving: a named mesh (``parallel.make_mesh``, an
+        axes list, or ``"tp=2"``) plus per-param PartitionSpecs.  Every
+        bucket executor is placed on the mesh — weights sharded per
+        spec (a model too big for one chip serves from N), padded
+        batches ``device_put`` with a ``P("dp", ...)`` input sharding
+        when the mesh has a dp axis that divides the bucket (replicated
+        otherwise), GSPMD inserts the collectives, outputs reassemble
+        on gather.  Composes with hot reload (a swapped weight lands
+        back in its shard sharding) and the compile cache (mesh axes
+        join the program keys).
     """
 
     def __init__(self, symbol, params: Dict,
@@ -93,7 +104,8 @@ class ServeEngine:
                  output_index: int = 0,
                  dev_type: str = "cpu", dev_id: int = 0,
                  type_dict: Optional[Dict] = None,
-                 name: str = "serve", warmup: bool = True):
+                 name: str = "serve", warmup: bool = True,
+                 mesh=None, param_specs: Optional[Dict] = None):
         if not input_shapes:
             raise ServeError("input_shapes must name at least one input")
         sym_json = symbol.tojson() if hasattr(symbol, "tojson") else symbol
@@ -142,6 +154,16 @@ class ServeEngine:
         # per-bucket shape dicts, built once: _run_batch is the hot loop
         self._shapes_by_bucket = {b: self._bucket_shapes(b)
                                   for b in self._buckets}
+        if mesh is not None:
+            from jax.sharding import Mesh
+            from ..parallel import make_mesh
+            if not isinstance(mesh, Mesh):
+                mesh = make_mesh(mesh)
+        self._mesh = mesh
+        self._param_specs = dict(param_specs or {})
+        if self._param_specs and mesh is None:
+            raise ServeError("param_specs without mesh=: specs are "
+                             "PartitionSpecs over a named mesh")
         self._predictor = Predictor(
             sym_json, params, self._shapes_by_bucket[self.max_batch_size],
             dev_type, dev_id, type_dict=type_dict)
@@ -152,6 +174,11 @@ class ServeEngine:
         profiler.register_serve_stats(self.stats)
         if warmup:
             self._warmup()
+        elif self._mesh is not None:
+            # the dispatcher's reshape() must never bind a bucket the
+            # mesh placement missed (mixed single-device/mesh operands
+            # crash the jit): place the whole grid even without warmup
+            self._bind_grid()
         self._batcher = MicroBatcher(
             self._run_batch, self._finish,
             max_batch_size=self.max_batch_size,
@@ -187,6 +214,53 @@ class ServeEngine:
     def _bucket_shapes(self, b: int) -> Dict[str, Tuple[int, ...]]:
         return {k: (b,) + v[1:] for k, v in self._shapes_tpl.items()}
 
+    def _input_specs(self, bucket: int) -> Dict:
+        """Mesh input shardings for one bucket's non-param inputs: the
+        batch dim over ``dp`` when the mesh has one that divides the
+        bucket, replicated otherwise (small buckets on a dp mesh pad
+        up through replication — correctness first)."""
+        from jax.sharding import PartitionSpec as P
+        dp = dict(self._mesh.shape).get("dp", 1)
+        specs = {}
+        for name, shape in self._shapes_by_bucket[bucket].items():
+            if dp > 1 and shape and shape[0] % dp == 0:
+                specs[name] = P(*(["dp"] + [None] * (len(shape) - 1)))
+            else:
+                specs[name] = P()
+        return specs
+
+    def _grid_fail(self, bucket, phase, exc):
+        """One error-message shape for every grid construction phase
+        (bind / mesh placement / compile / first run) — the bind and
+        placement phases also run with warmup=False, so the message
+        names the grid, not a warmup that may not have run."""
+        raise ServeError(
+            "serve bucket-grid construction failed at bucket %d (input "
+            "shapes %s, %s phase): %s: %s"
+            % (bucket, sorted(self._shapes_by_bucket[bucket].items()),
+               phase, type(exc).__name__, exc)) from exc
+
+    def _bind_grid(self) -> Dict:
+        """Bind every bucket executor (they share one set of parameter
+        buffers) and, with a mesh, place each on it — params at their
+        specs, inputs per ``_input_specs``.  Shared param NDArrays are
+        placed once; re-placing to the same sharding is a no-op."""
+        p = self._predictor
+        execs = {}
+        for b in self._buckets:
+            try:
+                execs[b] = p.ensure_bound(self._shapes_by_bucket[b])
+            except Exception as e:
+                self._grid_fail(b, "bind", e)
+            if self._mesh is not None:
+                try:
+                    execs[b].set_mesh(self._mesh,
+                                      param_specs=self._param_specs,
+                                      input_specs=self._input_specs(b))
+                except Exception as e:
+                    self._grid_fail(b, "mesh placement", e)
+        return execs
+
     def _warmup(self) -> None:
         """Compile + run every bucket once so serving never compiles.
 
@@ -211,19 +285,8 @@ class ServeEngine:
             "MXNET_SERVE_WARMUP_THREADS",
             default_warmup_threads(len(self._buckets)), int))
 
-        def fail(bucket, phase, exc):
-            raise ServeError(
-                "serve warmup failed at bucket %d (input shapes %s, "
-                "%s): %s: %s"
-                % (bucket, sorted(self._shapes_by_bucket[bucket].items()),
-                   phase, type(exc).__name__, exc)) from exc
-
-        execs = {}
-        for b in self._buckets:
-            try:
-                execs[b] = p.ensure_bound(self._shapes_by_bucket[b])
-            except Exception as e:
-                fail(b, "bind", e)
+        fail = self._grid_fail
+        execs = self._bind_grid()
         try:
             parallel_warm(
                 [("bucket %d" % b,

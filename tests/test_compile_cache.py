@@ -788,3 +788,93 @@ def test_steady_retrace_counter(no_cache):
     assert _totals()["steady_retraces"] == 0
     f.warm(jnp.ones((3,)))      # new avals on a compiled program
     assert _totals()["steady_retraces"] == 1
+
+
+# -- mesh-shape keying (ISSUE 7) ---------------------------------------------
+
+def _mesh_sharded_arg(axes):
+    """One (8, 4) array sharded P(<first axis>) over a mesh of `axes`
+    covering all 8 devices."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    sizes = [s for _, s in axes]
+    devs = np.array(jax.devices()).reshape(sizes)
+    mesh = Mesh(devs, tuple(a for a, _ in axes))
+    sh = NamedSharding(mesh, P(axes[0][0]))
+    return jax.device_put(jnp.arange(32.0).reshape(8, 4), sh)
+
+
+def test_mesh_shape_changes_cache_key(cache_dir):
+    """The same program placed on dp=8 vs dp=4 x tp=2 partitions
+    differently while listing identical device ids: the two placements
+    must key DISTINCT cache entries, and a warm restart on the same
+    mesh must hit."""
+    def make():
+        return cc.cached_jit(lambda a: (a * 2).sum(0), name="t:meshkey")
+    x_dp8 = _mesh_sharded_arg([("dp", 8)])
+    x_dp4tp2 = _mesh_sharded_arg([("dp", 4), ("tp", 2)])
+    want = np.asarray(make()(x_dp8))
+    assert _totals()["misses"] == 1
+    got = np.asarray(make()(x_dp4tp2))
+    t = _totals()
+    # dp=4 x tp=2 must MISS (fresh compile), never load the dp=8 entry
+    assert t["misses"] == 2 and t["hits"] == 0
+    assert np.allclose(got, want)
+    # warm restart on the same mesh shape: both placements hit
+    np.asarray(make()(x_dp8))
+    np.asarray(make()(x_dp4tp2))
+    assert _totals()["hits"] == 2
+
+
+def test_fused_fast_key_includes_mesh_axes():
+    """The trace-free fast key is built from _program_desc, which must
+    distinguish mesh AXES (dp=8 vs dp=4 x tp=2 list the same device
+    ids) and the per-param sharding specs."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.module.fused import FusedTrainStep
+    from mxnet_tpu.parallel import make_mesh
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=8, name="fc1"),
+        act_type="relu", name="act1")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=2, name="fc2"),
+        name="softmax")
+
+    def desc(mesh_axes, sharding=None):
+        opt = mx.optimizer.create("sgd", learning_rate=0.1)
+        f = FusedTrainStep(net, [mx.cpu(0)], ("data",),
+                           ("softmax_label",),
+                           ["fc1_weight", "fc1_bias", "fc2_weight",
+                            "fc2_bias"], [], opt,
+                           label_shapes=[("softmax_label", (16,))],
+                           mesh=make_mesh(mesh_axes), sharding=sharding)
+        return f._program_desc("step")
+
+    d_dp8 = desc([("dp", 8)])
+    d_dp4tp2 = desc([("dp", 4), ("tp", 2)])
+    d_spec = desc([("dp", 4), ("tp", 2)],
+                  sharding={"fc1_weight": P(None, "tp")})
+    assert d_dp8 != d_dp4tp2, "mesh axes not in the fast-key description"
+    assert d_dp4tp2 != d_spec, "sharding specs not in the fast-key " \
+        "description"
+    assert desc([("dp", 8)]) == d_dp8, "description is not deterministic"
+
+
+def test_executor_mesh_placement_keys_program_desc():
+    """Executor.set_mesh (the tp-sharded serve path) must re-key the
+    executor's fast-key description by mesh axes + specs."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import make_mesh
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                              name="fc1"), name="softmax")
+
+    def bound():
+        return net.simple_bind(mx.cpu(0), grad_req="null",
+                               data=(4, 6), softmax_label=(4,))
+    base = bound()._program_desc()
+    ex = bound()
+    ex.set_mesh(make_mesh([("tp", 2)]),
+                param_specs={"fc1_weight": P("tp", None)})
+    assert ex._program_desc() != base
