@@ -59,6 +59,7 @@ from . import checkpoint
 from . import compile_cache
 from . import predictor
 from . import serve
+from . import trace
 from . import profiler
 from . import libinfo
 from . import misc

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from .. import trace as _trace
 from ..base import MXNetError
 from . import layout
 from .sharded import flatten_state, merge_indexes, read_leaf, write_leaf
@@ -167,12 +168,17 @@ class CheckpointManager:
             if self._writer is not None:
                 self._writer.wait()     # keep commits ordered by step
             self._write_state(step, snap, meta)
-            self.stats.add(last_overhead_s=time.perf_counter() - t0,
-                           overhead_s=time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.stats.add(last_overhead_s=dt, overhead_s=dt)
+            _trace.complete("ckpt:save(blocking)", t0, dt, cat="ckpt",
+                            step=step)
             return
         self._writer.submit(lambda: self._write_state(step, snap, meta))
         dt = time.perf_counter() - t0
         self.stats.add(last_overhead_s=dt, overhead_s=dt)
+        # the train-thread stall a save cost: snapshot + async submit
+        _trace.complete("ckpt:snapshot_overhead", t0, dt, cat="ckpt",
+                        step=step)
 
     def _write_state(self, step: int, snap, meta: Dict) -> None:
         t0 = time.perf_counter()
@@ -193,6 +199,9 @@ class CheckpointManager:
             self.stats.add(save_failures=1)
             raise
         dt = max(time.perf_counter() - t0, 1e-9)
+        # runs on the writer thread: its own lane in the dumped trace,
+        # visibly overlapping the train-thread dispatch spans
+        _trace.complete("ckpt:write_commit", t0, dt, cat="ckpt", step=step)
         nbytes = self._dir_bytes(step)
         self.stats.add(saves_committed=1, last_step=step,
                        save_s=dt, last_save_s=dt, bytes=nbytes,
@@ -299,6 +308,7 @@ class CheckpointManager:
         tree = self._read_tree(d, index["spec"], index["leaves"], like)
         dt = time.perf_counter() - t0
         self.stats.add(restores=1, restore_s=dt, last_restore_s=dt)
+        _trace.complete("ckpt:restore", t0, dt, cat="ckpt", step=step)
         return tree, meta
 
     def _read_tree(self, d: str, spec, entries, like):

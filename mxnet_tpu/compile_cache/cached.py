@@ -36,6 +36,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import trace as _trace
 from ..base import get_env
 from .fingerprint import (environment_fingerprint,
                           fast_key as _fast_key_of, program_key)
@@ -373,7 +374,10 @@ class CompileCache:
                 % (key[:12], type(e).__name__, e))
             self.store.invalidate(key)
             return None
-        get_stats().note_hit(name, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        get_stats().note_hit(name, dt)
+        _trace.complete("compile:deserialize", t0, dt, cat="compile",
+                        program=name)
         return entry
 
     def load_fast(self, fkey: str, name: str):
@@ -722,7 +726,10 @@ class CachedFunction:
                     return entry
             t0 = time.perf_counter()
             lowered = self._jit.lower(*args)
-            stats.note_trace_lower(self.name, time.perf_counter() - t0)
+            dt0 = time.perf_counter() - t0
+            stats.note_trace_lower(self.name, dt0)
+            _trace.complete("compile:trace_lower", t0, dt0, cat="compile",
+                            program=self.name)
             entry = None
             key = None
             if cache is not None:
@@ -744,8 +751,11 @@ class CachedFunction:
                         compiled = lowered.compile()
                 else:
                     compiled = lowered.compile()
-                stats.note_compile(self.name, time.perf_counter() - t1,
-                                   retrace=retrace)
+                dt1 = time.perf_counter() - t1
+                stats.note_compile(self.name, dt1, retrace=retrace)
+                _trace.complete("compile:backend_compile", t1, dt1,
+                                cat="compile", program=self.name,
+                                retrace=retrace)
                 if key is not None:
                     cache.store_entry(key, compiled, lowered, args,
                                       self.name, fkey=fkey)
@@ -765,14 +775,20 @@ class CachedFunction:
                       and cache.bypass_reason() is None)
         t0 = time.perf_counter()
         lowered = self._jit.lower(*args)
-        stats.note_trace_lower(self.name, time.perf_counter() - t0)
+        dt0 = time.perf_counter() - t0
+        stats.note_trace_lower(self.name, dt0)
+        _trace.complete("compile:trace_lower", t0, dt0, cat="compile",
+                        program=self.name)
         t1 = time.perf_counter()
         if will_store:
             with _fresh_compile_ctx():
                 compiled = lowered.compile()
         else:
             compiled = lowered.compile()
-        stats.note_compile(self.name, time.perf_counter() - t1)
+        dt1 = time.perf_counter() - t1
+        stats.note_compile(self.name, dt1)
+        _trace.complete("compile:backend_compile", t1, dt1, cat="compile",
+                        program=self.name)
         if will_store:
             fkey = None
             if self._fast_desc is not None:

@@ -39,6 +39,8 @@ from __future__ import annotations
 
 import ctypes
 import multiprocessing as mp
+import os
+import tempfile
 import threading
 import time
 import traceback
@@ -48,6 +50,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import trace as _trace
 from ..base import MXNetError
 from .pipeline import EndOfEpoch, EndOfStream, QueueClosed, Stage
 
@@ -268,11 +271,22 @@ def _shard_stream(source, shard: int, nshards: int, offset: int):
 def _reader_worker(ring: _Ring, counters, stop, source, decode,
                    shard: int, nshards: int, start_epoch: int,
                    start_offset: int, max_epochs, label_width: int,
-                   seed: int):
+                   seed: int, spill_dir: Optional[str] = None):
     """Worker-process main: stream the shard, decode, publish.  Lives
     across epochs (epoch-end markers flow in-band through the ring);
     exceptions are forwarded as in-band error slots (fail loud — a
-    decode error is a data bug, not a crash to retry)."""
+    decode error is a data bug, not a crash to retry).  With a
+    ``spill_dir``, decode spans stream to a per-(worker, pid) JSONL
+    file the parent merges into its Chrome trace — flushed every few
+    events, so even a SIGKILL'd worker leaves its timeline behind."""
+    span_name = None
+    if spill_dir is not None and _trace.enabled():
+        # pid in the filename: a crash-restarted worker is a NEW process
+        # whose spans must not clobber (and must merge alongside) the
+        # dead one's
+        _trace.configure_spill(os.path.join(
+            spill_dir, "spans-w%d-pid%d.jsonl" % (shard, os.getpid())))
+        span_name = "feed:decode[w%d]" % shard
     try:
         epoch, offset = start_epoch, start_offset
         while max_epochs is None or epoch < max_epochs:
@@ -295,11 +309,16 @@ def _reader_worker(ring: _Ring, counters, stop, source, decode,
                     .generate_state(1)[0])
                 t0 = time.perf_counter()
                 data, lab = decode((label, payload))
-                counters[1] += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                counters[1] += dt
+                if span_name is not None:
+                    _trace.complete(span_name, t0, dt, cat="feed")
                 ring.put(_DATA, epoch, seq, lab, data, stop)
                 counters[0] += 1
                 seq += 1
             ring.put(_EPOCH_END, epoch, seq, stop=stop)
+            if span_name is not None:
+                _trace.flush_spill()
             epoch += 1
             offset = 0
             counters[2] = epoch
@@ -312,6 +331,12 @@ def _reader_worker(ring: _Ring, counters, stop, source, decode,
                            % (traceback.format_exc(), shard))
         except Exception:
             pass
+    finally:
+        if span_name is not None:
+            try:
+                _trace.flush_spill()
+            except Exception:
+                pass
 
 
 class _ShuffleScheduler:
@@ -460,6 +485,23 @@ class ParallelReader(Stage):
         # save with a monotonically growing `delivered`; advancing one
         # persistent sim keeps each call O(delta) not O(delivered))
         self._cursim: Optional[tuple] = None
+        # per-worker span spill: each forked reader appends its decode
+        # spans to a file in this dir; registering it routes them into
+        # every dump_trace() merge — including spans of workers that
+        # died (even SIGKILL) before the dump.  Created unconditionally
+        # (an empty dir is ~free): whether to SPILL is decided by each
+        # worker from the trace flag it inherits at fork, so a
+        # set_enabled(True) before iteration starts still gets worker
+        # lanes (enabling after the fork cannot reach live workers).
+        self._spill_dir: Optional[str] = tempfile.mkdtemp(
+            prefix="mxtpu-trace-%s-" % self.name)
+        _trace.add_spill_dir(self._spill_dir)
+        # spans must outlive the reader (a dump after close() still
+        # merges them) but not the process: clean at exit, or every
+        # run leaves a tempdir behind
+        import atexit
+        import shutil
+        atexit.register(shutil.rmtree, self._spill_dir, True)
 
     # -- public surface ----------------------------------------------------
     def release(self) -> None:
@@ -603,7 +645,7 @@ class ParallelReader(Stage):
             args=(self._rings[w], self._counters[w], self._stop_evt,
                   self._source, self._decode, w, self._nworkers, epoch,
                   offset, self._max_epochs, self._label_width,
-                  self._seed),
+                  self._seed, self._spill_dir),
             name="feed-%s-p%d" % (self.name, w), daemon=True)
         with warnings.catch_warnings():
             # jax registers an at-fork RuntimeWarning; the children
@@ -611,6 +653,9 @@ class ParallelReader(Stage):
             warnings.simplefilter("ignore", RuntimeWarning)
             proc.start()
         self._procs[w] = proc
+        if proc.pid:
+            _trace.label_process(proc.pid,
+                                 "feed-reader %s w%d" % (self.name, w))
 
     def _restart(self, w: int, epoch: int, offset: int) -> None:
         self.restarts[w] += 1

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any, List, Optional, Sequence
 
+from .. import trace as _trace
 from .stats import PipelineStats, StageStats
 
 __all__ = ["EndOfEpoch", "EndOfStream", "StageError", "QueueClosed",
@@ -186,6 +187,9 @@ class Stage:
             t0 = time.perf_counter()
             out = self.process(item)
             dt = time.perf_counter() - t0
+            # the stage's busy interval, on the shared trace timeline
+            # (stall time shows up as the gaps between these spans)
+            _trace.complete("feed:%s" % self.name, t0, dt, cat="feed")
             if out is not None:
                 self.stats.add_items(self.count(out), dt)
                 self.out_q.put(out)

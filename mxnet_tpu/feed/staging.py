@@ -22,6 +22,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from .. import trace as _trace
 from .stats import PipelineStats
 
 __all__ = ["MegaBatch", "DevicePrefetchIter", "device_feed",
@@ -279,7 +280,9 @@ class DevicePrefetchIter:
         data = [put(a) for a in (batch.data or [])]
         label = [put(a) for a in (batch.label or [])]
         n = data[0].shape[0] if data else 0
-        self._h2d.add_items(int(n), time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._h2d.add_items(int(n), dt)
+        _trace.complete("feed:h2d_stage", t0, dt, cat="feed", items=int(n))
         return DataBatch(data=data, label=label, pad=batch.pad,
                          index=batch.index,
                          provide_data=getattr(batch, "provide_data", None),
@@ -301,7 +304,10 @@ class DevicePrefetchIter:
         label = [put_stack([b.label[i] for b in group])
                  for i in range(len(group[0].label or []))]
         n = data[0].shape[0] * data[0].shape[1] if data else 0
-        self._h2d.add_items(int(n), time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._h2d.add_items(int(n), dt)
+        _trace.complete("feed:h2d_stage_mega", t0, dt, cat="feed", k=k,
+                        items=int(n))
         return MegaBatch(data=data, label=label, k=k)
 
 

@@ -13,6 +13,7 @@ import numpy as np
 from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import io as mx_io
+from .. import trace as _trace
 from ..model import BatchEndParam
 from ..initializer import Uniform
 
@@ -368,6 +369,10 @@ class BaseModule:
                 train_data, depth=depth,
                 megabatch=k_super if use_super else 1)
 
+        # each fit journals independently: a later fit restarting from
+        # step 1 in the same process must not be muted by the previous
+        # run's high-water step
+        _trace.reset_journal()
         global_step = 0
         start_epoch, start_batch = begin_epoch, 0
         if ckpt_mgr is not None and resume:
@@ -448,6 +453,11 @@ class BaseModule:
                 prev_step = global_step if ckpt_from is None else ckpt_from
                 nbatch += count
                 global_step += count
+                # run-metrics journal (MXNET_TRACE_JOURNAL): one unified-
+                # report JSONL line every N global steps; a no-op (one
+                # env lookup) when the knob is unset
+                _trace.maybe_journal_step(global_step, epoch=epoch,
+                                          nbatch=nbatch)
                 if not allow_ckpt:
                     return False
                 if ckpt_mgr is not None:
@@ -561,6 +571,8 @@ class BaseModule:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.perf_counter()
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            _trace.complete("fit:epoch", tic, toc - tic, cat="train",
+                            epoch=epoch, batches=nbatch)
 
             if epoch_end_callback is not None:
                 arg_params_, aux_params_ = self.get_params()

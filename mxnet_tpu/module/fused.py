@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 from ..executor import _GraphProgram
 from ..ndarray import NDArray
+from .. import trace as _trace
 
 __all__ = ["FusedTrainStep"]
 
@@ -738,14 +739,20 @@ class FusedTrainStep:
         return self._dispatch(state, batch, self._lr_cache[1], base_key)
 
     def _dispatch(self, state, batch, lr, base_key):
-        """Run the step program, feeding the multichip counters: host
-        dispatch time every step, full device step wall on a sampled
-        subset (one sync every sample_every steps — the async pipeline
-        stays intact between samples)."""
+        """Run the step program, feeding the multichip counters and the
+        span recorder: host dispatch time every step, full device step
+        wall on a sampled subset (one sync every sample_every steps —
+        the async pipeline stays intact between samples)."""
         stats = self.multichip_stats
-        if stats is None:
-            return self._step(state, batch, lr, base_key)
         import time as _time
+        if stats is None:
+            if not _trace.enabled():
+                return self._step(state, batch, lr, base_key)
+            t0 = _time.perf_counter()
+            out = self._step(state, batch, lr, base_key)
+            _trace.complete("fused:dispatch", t0,
+                            _time.perf_counter() - t0, cat="train")
+            return out
         first = stats.steps == 0
         sample = not first and stats.should_sample()
         if sample:
@@ -757,17 +764,27 @@ class FusedTrainStep:
                 next(iter(state["params"].values()), state["t"]))
         t0 = _time.perf_counter()
         out = self._step(state, batch, lr, base_key)
+        dt = _time.perf_counter() - t0
         if first:
             # blocks through trace+compile on a cold cache: its own
             # counter, not the steady dispatch average
-            stats.note_first(_time.perf_counter() - t0)
+            stats.note_first(dt)
+            _trace.complete("fused:first_step(compile)", t0, dt,
+                            cat="train")
         else:
-            stats.add_step(_time.perf_counter() - t0)
+            stats.add_step(dt)
+            _trace.complete("fused:dispatch", t0, dt, cat="train")
         if sample:
             t1 = _time.perf_counter()
             leaf = next(iter(out[0]["params"].values()), out[0]["t"])
             jax.block_until_ready(leaf)
-            stats.add_wait(_time.perf_counter() - t1)
+            wait = _time.perf_counter() - t1
+            stats.add_wait(wait)
+            # the sampled device-wall: the one span that shows real
+            # device compute in a timeline otherwise full of async
+            # dispatches
+            _trace.complete("fused:device_wait(sampled)", t1, wait,
+                            cat="train")
         return out
 
     def gather_update_leaf(self, x):

@@ -8,6 +8,7 @@ from __future__ import annotations
 import logging
 from typing import Dict, List, Optional, Sequence
 
+from .. import trace as _trace
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..initializer import Uniform
@@ -818,6 +819,7 @@ class Module(BaseModule):
         t0 = _time.perf_counter()
         k, mega = self._fused.make_megabatch(batches)
         h2d_s = _time.perf_counter() - t0
+        _trace.complete("superstep:h2d_stage", t0, h2d_s, cat="train")
 
         sig = (k, reducer.signature if reducer is not None else None)
         prog = self._superstep_progs.get(sig)
@@ -858,6 +860,8 @@ class Module(BaseModule):
             self._fused_state, acc = prog(self._fused_state, mega, lrs,
                                           self._fused_key, acc0)
             dispatch_s = _time.perf_counter() - t1
+            _trace.complete("superstep:dispatch", t1, dispatch_s,
+                            cat="train", k=k)
         except Exception:
             self._fused_t = prev_t
             self._optimizer.num_update = prev_num_update
@@ -871,6 +875,8 @@ class Module(BaseModule):
             t2 = _time.perf_counter()
             host_acc = jax.tree_util.tree_map(lambda a: _np.asarray(a), acc)
             wait_s = _time.perf_counter() - t2
+            _trace.complete("superstep:metric_drain", t2, wait_s,
+                            cat="train", k=k)
             reducer.absorb(host_acc)
         stats.add(k, h2d_s, dispatch_s, wait_s)
         mcs = getattr(self._fused, "multichip_stats", None)

@@ -20,18 +20,19 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import weakref
 
 __all__ = ["profiler_set_config", "profiler_set_state", "scope",
-           "dump_profile", "state", "register_feed_stats", "feed_report",
-           "feed_report_str", "register_checkpoint_stats",
+           "dump_profile", "dump_trace", "state", "register_feed_stats",
+           "feed_report", "feed_report_str", "register_checkpoint_stats",
            "checkpoint_report", "checkpoint_report_str", "SuperstepStats",
            "register_superstep_stats", "superstep_report",
            "superstep_report_str", "register_serve_stats", "serve_report",
            "serve_report_str", "compile_report", "compile_report_str",
            "MultichipStats", "register_multichip_stats",
            "parse_hlo_collectives", "multichip_report",
-           "multichip_report_str"]
+           "multichip_report_str", "unified_report", "unified_report_str"]
 
 _config = {"filename": "profile_output", "mode": "symbolic"}
 _state = "stop"
@@ -67,9 +68,71 @@ def state() -> str:
 
 
 def dump_profile() -> str:
-    """Return the trace directory (reference MXDumpProfile wrote the json;
-    XLA traces stream to disk while running)."""
-    return _config["filename"]
+    """Write the Chrome-format span trace for the configured filename and
+    return its path (reference MXDumpProfile wrote the json to the
+    configured file; the span runtime now honors that contract — the
+    returned file loads in chrome://tracing / Perfetto).  XLA's own
+    xprof trace, when profiler_set_state("run") was used, streams into
+    the configured directory separately."""
+    from . import trace as _trace
+    out = _config["filename"]
+    path = out if out.endswith(".json") else out + ".trace.json"
+    return _trace.dump_trace(path)
+
+
+def dump_trace(path: str) -> str:
+    """Write the merged span timeline (this process + registered worker
+    spill dirs) as Chrome/Perfetto trace-event JSON; returns ``path``.
+    See mxnet_tpu.trace and docs/observability.md."""
+    from . import trace as _trace
+    return _trace.dump_trace(path)
+
+
+# -- the shared stats registry ----------------------------------------------
+# Every subsystem's live stats objects register here (weakly: a dropped
+# pipeline/engine/manager disappears from reports without an unregister
+# call).  ONE lock guards every registry's mutation and iteration:
+# register_* is called from writer threads (serve engines from request
+# threads, checkpoint managers from fit, feed pipelines from pipeline
+# construction) while report readers iterate — a WeakValueDictionary
+# mutating under iteration is a RuntimeError, so every reader
+# snapshot-copies under the lock first.  The per-object counter locks
+# (StageStats, ServeStats, ...) stay where they are; this lock only
+# covers registry membership.
+_registry_lock = threading.Lock()
+
+
+class _Registry:
+    """name -> live stats objects, weakly held, creation-ordered."""
+
+    def __init__(self, label: str, empty_str: str):
+        self.label = label
+        self.empty_str = empty_str
+        self._items = weakref.WeakValueDictionary()
+        self._seq = 0
+
+    def register(self, obj) -> None:
+        with _registry_lock:
+            self._seq += 1
+            # zero-padded seq so lexicographic order == creation order
+            self._items["%s#%06d" % (obj.name, self._seq)] = obj
+
+    def snapshot(self):
+        """Strong-referenced (key, obj) list — safe to iterate while
+        other threads register/drop."""
+        with _registry_lock:
+            return sorted(self._items.items())
+
+    def __len__(self) -> int:
+        with _registry_lock:
+            return len(self._items)
+
+    def report(self, **kw) -> dict:
+        return {key: obj.report(**kw) for key, obj in self.snapshot()}
+
+    def report_str(self, **kw) -> str:
+        parts = [obj.report_str(**kw) for _, obj in self.snapshot()]
+        return "\n\n".join(parts) if parts else self.empty_str
 
 
 # -- feed-pipeline instrumentation (mxnet_tpu.feed) -------------------------
@@ -83,30 +146,25 @@ def dump_profile() -> str:
 # sub-dict with per-process items/s, busy time, restart count and
 # liveness, plus aggregated worker_items/worker_busy_s/restarts), so the
 # report covers the whole reader process tree, not just the parent.
-_feed_stats = weakref.WeakValueDictionary()
-_feed_seq = 0
+_feed_registry = _Registry("feed", "(no live feed pipelines)")
 
 
 def register_feed_stats(pipeline_stats) -> None:
     """Called by feed.Pipeline / feed.DevicePrefetchIter on construction."""
-    global _feed_seq
-    _feed_seq += 1
-    # zero-padded seq so lexicographic report order == creation order
-    _feed_stats["%s#%06d" % (pipeline_stats.name, _feed_seq)] = pipeline_stats
+    _feed_registry.register(pipeline_stats)
 
 
 def feed_report() -> dict:
     """{pipeline key: {stage name: counters}} for every live pipeline,
     including per-worker-process counters for multi-process reader
     stages (see the registry note above)."""
-    return {key: ps.report() for key, ps in sorted(_feed_stats.items())}
+    return _feed_registry.report()
 
 
 def feed_report_str() -> str:
     """Human-readable per-stage table for every live feed pipeline."""
-    parts = [ps.report_str() for _, ps in sorted(_feed_stats.items())]
-    out = "\n\n".join(parts) if parts else "(no live feed pipelines)"
-    if _superstep_stats:
+    out = _feed_registry.report_str()
+    if len(_superstep_registry):
         # the chip-side half of the same story: whether the loop is
         # dispatch-bound or compute-bound lives in superstep_report()
         out += ("\n\n(superstep dispatch/wait/stage split: see "
@@ -125,8 +183,7 @@ def feed_report_str() -> str:
 #                   on an async backend this returns before compute ends)
 #   device_wait_s   blocking on the drained metric accumulators — i.e.
 #                   actual device compute the host had to wait out
-_superstep_stats = weakref.WeakValueDictionary()
-_superstep_seq = 0
+_superstep_registry = _Registry("superstep", "(no live superstep loops)")
 
 
 class SuperstepStats:
@@ -187,23 +244,19 @@ class SuperstepStats:
 
 def register_superstep_stats(superstep_stats) -> None:
     """Called by Module.superstep_train on first dispatch."""
-    global _superstep_seq
-    _superstep_seq += 1
-    _superstep_stats["%s#%06d" % (superstep_stats.name, _superstep_seq)] = \
-        superstep_stats
+    _superstep_registry.register(superstep_stats)
 
 
 def superstep_report() -> dict:
     """{key: counters} for every live superstep-training module; the
     feed-side view of the same loop is feed_report()."""
-    return {key: ss.report() for key, ss in sorted(_superstep_stats.items())}
+    return _superstep_registry.report()
 
 
 def superstep_report_str() -> str:
     """Human-readable dispatch/wait/stage split per training loop."""
-    parts = [ss.report_str() for _, ss in sorted(_superstep_stats.items())]
-    out = "\n\n".join(parts) if parts else "(no live superstep loops)"
-    if _multichip_stats:
+    out = _superstep_registry.report_str()
+    if len(_multichip_registry):
         # the mesh-side view of the same loop: collective vs compute
         # split and per-axis usage live in multichip_report()
         out += ("\n\n(per-axis collective/compute split: see "
@@ -232,8 +285,7 @@ def superstep_report_str() -> str:
 # ``report(peak_tflops=, ici_gbps=)`` turns the static numbers into a
 # collective-vs-compute time split estimate; without them the raw
 # counts/bytes and the measured wall splits are reported as-is.
-_multichip_stats = weakref.WeakValueDictionary()
-_multichip_seq = 0
+_multichip_registry = _Registry("multichip", "(no live multichip steps)")
 
 _HLO_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
                     "collective-permute", "all-to-all")
@@ -439,10 +491,7 @@ class MultichipStats:
 
 def register_multichip_stats(multichip_stats) -> None:
     """Called by FusedTrainStep when its mesh spans >1 device."""
-    global _multichip_seq
-    _multichip_seq += 1
-    _multichip_stats["%s#%06d" % (multichip_stats.name, _multichip_seq)] = \
-        multichip_stats
+    _multichip_registry.register(multichip_stats)
 
 
 def multichip_report(peak_tflops=None, ici_gbps=None) -> dict:
@@ -450,15 +499,14 @@ def multichip_report(peak_tflops=None, ici_gbps=None) -> dict:
     PER-DEVICE ``peak_tflops`` (e.g. bench.py's probe result) and
     ``ici_gbps`` link bandwidth for the collective-vs-compute time
     estimate."""
-    return {key: ms.report(peak_tflops=peak_tflops, ici_gbps=ici_gbps)
-            for key, ms in sorted(_multichip_stats.items())}
+    return _multichip_registry.report(peak_tflops=peak_tflops,
+                                      ici_gbps=ici_gbps)
 
 
 def multichip_report_str(peak_tflops=None, ici_gbps=None) -> str:
     """Human-readable per-mesh dispatch/device/collective table."""
-    parts = [ms.report_str(peak_tflops=peak_tflops, ici_gbps=ici_gbps)
-             for _, ms in sorted(_multichip_stats.items())]
-    return "\n\n".join(parts) if parts else "(no live multichip steps)"
+    return _multichip_registry.report_str(peak_tflops=peak_tflops,
+                                          ici_gbps=ici_gbps)
 
 
 # -- checkpoint instrumentation (mxnet_tpu.checkpoint) ----------------------
@@ -466,26 +514,22 @@ def multichip_report_str(peak_tflops=None, ici_gbps=None) -> str:
 # the feed pipelines above, so one checkpoint_report() shows every
 # manager's save/restore wall time, bytes/s, and the train-thread overhead
 # each save cost — the numbers BENCH's ckpt leg tracks over rounds.
-_ckpt_stats = weakref.WeakValueDictionary()
-_ckpt_seq = 0
+_ckpt_registry = _Registry("checkpoint", "(no live checkpoint managers)")
 
 
 def register_checkpoint_stats(ckpt_stats) -> None:
     """Called by checkpoint.CheckpointManager on construction."""
-    global _ckpt_seq
-    _ckpt_seq += 1
-    _ckpt_stats["%s#%06d" % (ckpt_stats.name, _ckpt_seq)] = ckpt_stats
+    _ckpt_registry.register(ckpt_stats)
 
 
 def checkpoint_report() -> dict:
     """{manager key: counters} for every live CheckpointManager."""
-    return {key: cs.report() for key, cs in sorted(_ckpt_stats.items())}
+    return _ckpt_registry.report()
 
 
 def checkpoint_report_str() -> str:
     """Human-readable save/restore counters for every live manager."""
-    parts = [cs.report_str() for _, cs in sorted(_ckpt_stats.items())]
-    return "\n\n".join(parts) if parts else "(no live checkpoint managers)"
+    return _ckpt_registry.report_str()
 
 
 # -- serving instrumentation (mxnet_tpu.serve) ------------------------------
@@ -493,26 +537,22 @@ def checkpoint_report_str() -> str:
 # pipelines, so one serve_report() shows every engine's request latency
 # percentiles, queue depth, batch occupancy, pad waste, and per-bucket
 # hit counts — the capacity-planning numbers for the inference side.
-_serve_stats = weakref.WeakValueDictionary()
-_serve_seq = 0
+_serve_registry = _Registry("serve", "(no live serve engines)")
 
 
 def register_serve_stats(serve_stats) -> None:
     """Called by serve.ServeEngine on construction."""
-    global _serve_seq
-    _serve_seq += 1
-    _serve_stats["%s#%06d" % (serve_stats.name, _serve_seq)] = serve_stats
+    _serve_registry.register(serve_stats)
 
 
 def serve_report() -> dict:
     """{engine key: counters} for every live serve engine."""
-    return {key: ss.report() for key, ss in sorted(_serve_stats.items())}
+    return _serve_registry.report()
 
 
 def serve_report_str() -> str:
     """Human-readable latency/occupancy/queue table per serve engine."""
-    parts = [ss.report_str() for _, ss in sorted(_serve_stats.items())]
-    return "\n\n".join(parts) if parts else "(no live serve engines)"
+    return _serve_registry.report_str()
 
 
 # -- compilation instrumentation (mxnet_tpu.compile_cache) -------------------
@@ -534,10 +574,64 @@ def compile_report_str() -> str:
     return get_stats().report_str(cache=get_cache())
 
 
+# -- the unified view --------------------------------------------------------
+def unified_report() -> dict:
+    """Every subsystem's report under one roof: ``{"feed": ...,
+    "superstep": ..., "multichip": ..., "checkpoint": ..., "serve": ...,
+    "compile": ..., "trace": ...}`` — the snapshot the run-metrics
+    journal (``MXNET_TRACE_JOURNAL``) writes every N steps."""
+    out = {
+        "feed": feed_report(),
+        "superstep": superstep_report(),
+        "multichip": multichip_report(),
+        "checkpoint": checkpoint_report(),
+        "serve": serve_report(),
+    }
+    try:
+        out["compile"] = compile_report()
+    except Exception:   # no backend yet / cache import failure
+        out["compile"] = {}
+    from . import trace as _trace
+    out["trace"] = _trace.trace_report()
+    return out
+
+
+def unified_report_str() -> str:
+    """Every subsystem's human-readable table, sectioned."""
+    sections = [
+        ("feed", feed_report_str),
+        ("superstep", superstep_report_str),
+        ("multichip", multichip_report_str),
+        ("checkpoint", checkpoint_report_str),
+        ("serve", serve_report_str),
+        ("compile", compile_report_str),
+    ]
+    parts = []
+    for label, fn in sections:
+        try:
+            body = fn()
+        except Exception as e:
+            body = "(unavailable: %s)" % e
+        parts.append("== %s %s\n%s" % (label, "=" * max(1, 68 - len(label)),
+                                       body))
+    from . import trace as _trace
+    tr = _trace.trace_report()
+    parts.append("== trace %s\nenabled=%s events=%d dropped=%d "
+                 "spill_dirs=%d journal=%s"
+                 % ("=" * 62, tr["enabled"], tr["events"], tr["dropped"],
+                    len(tr["spill_dirs"]), tr["journal"] or "-"))
+    return "\n\n".join(parts)
+
+
 @contextlib.contextmanager
 def scope(name: str):
-    """Named region visible in the trace timeline (jax TraceAnnotation);
-    also usable around host-side work like data loading."""
+    """Named region visible in BOTH trace timelines: the span runtime's
+    Chrome/Perfetto dump (mxnet_tpu.trace) and, while
+    profiler_set_state("run") holds an xprof trace open, jax's
+    TraceAnnotation.  Also usable around host-side work like data
+    loading.  API unchanged from the seed."""
     import jax
+    from . import trace as _trace
     with jax.profiler.TraceAnnotation(name):
-        yield
+        with _trace.span(name, cat="scope"):
+            yield

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import trace as _trace
 from ..base import MXNetError, get_env
 from ..predictor import Predictor, load_checkpoint_pair
 from .batcher import MicroBatcher
@@ -333,32 +334,38 @@ class ServeEngine:
     def _run_batch(self, reqs) -> Tuple:
         n = len(reqs)
         bucket = self._pick_bucket(n)
-        data = np.stack([r.data for r in reqs])
-        if bucket > n:
-            pad = np.zeros((bucket - n,) + self.item_shape, self._data_dtype)
-            data = np.concatenate([data, pad], axis=0)
-        with self._swap_lock:
-            p = self._predictor
-            p.reshape(self._shapes_by_bucket[bucket])  # cache hit: no compile
-            p.set_input(self.data_name, data)
-            p.forward()
-            out = p._exec.outputs[self._output_index]._get()
-        # start the D2H copy and return: the completion thread blocks on
-        # it while THIS thread dispatches the next batch (score() pattern)
-        start = getattr(out, "copy_to_host_async", None)
-        if callable(start):
-            try:
-                start()
-            except Exception:
-                pass
+        with _trace.span("serve:run_batch", cat="serve", n=n,
+                         bucket=bucket):
+            data = np.stack([r.data for r in reqs])
+            if bucket > n:
+                pad = np.zeros((bucket - n,) + self.item_shape,
+                               self._data_dtype)
+                data = np.concatenate([data, pad], axis=0)
+            with self._swap_lock:
+                p = self._predictor
+                # cache hit: no compile
+                p.reshape(self._shapes_by_bucket[bucket])
+                p.set_input(self.data_name, data)
+                p.forward()
+                out = p._exec.outputs[self._output_index]._get()
+            # start the D2H copy and return: the completion thread blocks
+            # on it while THIS thread dispatches the next batch (score()
+            # pattern)
+            start = getattr(out, "copy_to_host_async", None)
+            if callable(start):
+                try:
+                    start()
+                except Exception:
+                    pass
         self.stats.on_batch(n, bucket)
         return out, n
 
     def _finish(self, handoff) -> List[np.ndarray]:
         """Completion thread: block on the D2H copy, slice per request."""
         out, n = handoff
-        host = np.asarray(out)
-        return [np.array(host[i]) for i in range(n)]
+        with _trace.span("serve:d2h_finish", cat="serve", n=n):
+            host = np.asarray(out)
+            return [np.array(host[i]) for i in range(n)]
 
     # -- client API --------------------------------------------------------
     def submit(self, data, deadline_ms: Optional[float] = None):

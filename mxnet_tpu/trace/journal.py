@@ -1,0 +1,78 @@
+"""Run-metrics journal: one JSONL line every N steps for long-run
+dashboards.
+
+``MXNET_TRACE_JOURNAL=path`` turns it on; every time the training
+loop's global step crosses a multiple of ``MXNET_TRACE_JOURNAL_EVERY``
+(default 50), one line is appended::
+
+    {"ts": <unix seconds>, "step": S,
+     "reports": mx.profiler.unified_report(), ...extra}
+
+The write path opens/appends/closes per line (a crash loses nothing
+already written) and the whole feature costs one ``os.environ.get`` per
+step when disabled.  ``Module.fit`` calls :func:`maybe_journal_step`
+from its per-batch bookkeeping; any other loop can do the same.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+__all__ = ["journal_path", "journal_every", "maybe_journal_step",
+           "write_journal_line", "reset_journal"]
+
+_last_step: Optional[int] = None
+
+
+def journal_path() -> Optional[str]:
+    return os.environ.get("MXNET_TRACE_JOURNAL") or None
+
+
+def journal_every() -> int:
+    try:
+        return max(1, int(os.environ.get("MXNET_TRACE_JOURNAL_EVERY",
+                                         "50") or "50"))
+    except ValueError:
+        return 50
+
+
+def reset_journal() -> None:
+    """Forget the last journaled step (test hook / new run)."""
+    global _last_step
+    _last_step = None
+
+
+def maybe_journal_step(step: int, **extra) -> bool:
+    """Journal when ``(last, step]`` crosses a multiple of the cadence —
+    crossing, not ``%``, so K-step superstep jumps can't skip a line
+    forever.  Returns True when a line was written."""
+    global _last_step
+    path = journal_path()
+    if path is None:
+        return False
+    every = journal_every()
+    prev = _last_step if _last_step is not None else step - 1
+    if step // every <= prev // every:
+        return False
+    _last_step = step
+    write_journal_line(path, step, **extra)
+    return True
+
+
+def write_journal_line(path: str, step: int, **extra) -> None:
+    """Append one snapshot line; a journal failure must never take the
+    training loop down, so I/O errors are swallowed."""
+    from .. import profiler
+    line = {"ts": time.time(), "step": int(step),
+            "reports": profiler.unified_report()}
+    line.update(extra)
+    try:
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(line, default=str) + "\n")
+    except (OSError, TypeError, ValueError):
+        pass

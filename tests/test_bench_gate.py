@@ -1,0 +1,130 @@
+"""tools/bench_gate.py: the bench-trajectory regression gate.
+
+Tier-1 contracts from ISSUE 8: the gate exits 0 on the repo's real
+checked-in BENCH trajectory (r02's clock artifact, r03's wedged round
+and r01's pre-fused configuration are skipped as incomparable, not
+counted as regressions), exits nonzero when a synthetic newest round
+regresses a gated metric past the threshold, and treats a silently
+dropped bench leg as a failure too.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "tools", "bench_gate.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import bench_gate  # noqa: E402
+
+
+def _run(args, cwd):
+    return subprocess.run([sys.executable, GATE] + args, cwd=str(cwd),
+                          capture_output=True, text=True, timeout=60)
+
+
+def _real_bench_files():
+    return sorted(f for f in os.listdir(REPO)
+                  if f.startswith("BENCH_r") and f.endswith(".json"))
+
+
+def test_gate_passes_on_real_trajectory():
+    res = _run([], REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "bench_gate: OK" in res.stdout
+    # the known artifacts are skipped with a reason, not gated
+    assert "BENCH_r02.json (clock-suspect" in res.stdout
+    assert "BENCH_r03.json (rc=2)" in res.stdout
+
+
+@pytest.fixture()
+def trajectory(tmp_path):
+    """The real BENCH files copied somewhere writable."""
+    for f in _real_bench_files():
+        shutil.copy(os.path.join(REPO, f), tmp_path / f)
+    return tmp_path
+
+
+def _synthetic_round(tmp_path, n=9, scale=None, drop=None):
+    files = _real_bench_files()
+    with open(os.path.join(REPO, files[-1])) as f:
+        doc = json.load(f)
+    parsed = doc["parsed"]
+    if scale:
+        for k, s in scale.items():
+            parsed[k] = parsed[k] * s
+    for k in drop or ():
+        parsed.pop(k, None)
+    with open(str(tmp_path / ("BENCH_r%02d.json" % n)), "w") as f:
+        json.dump({"n": n, "rc": 0, "parsed": parsed}, f)
+
+
+def test_gate_fails_on_synthetic_regression(trajectory):
+    _synthetic_round(trajectory, scale={"value": 0.5})
+    res = _run([], trajectory)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "REGRESS" in res.stdout and "value" in res.stdout
+
+
+def test_gate_fails_on_dropped_metric(trajectory):
+    _synthetic_round(trajectory, drop=["lstm_tokens_per_sec"])
+    res = _run([], trajectory)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "MISSING" in res.stdout
+
+
+def test_gate_threshold_and_allowlist(trajectory):
+    # a 5% dip passes the default 10% threshold ...
+    _synthetic_round(trajectory, scale={"value": 0.95})
+    assert _run([], trajectory).returncode == 0
+    # ... fails a 2% threshold ...
+    assert _run(["--threshold", "2"], trajectory).returncode == 1
+    # ... and passes even that when the allowlist excludes `value`
+    assert _run(["--threshold", "2", "--metrics", "mfu"],
+                trajectory).returncode == 0
+
+
+def test_gate_improvements_pass(trajectory):
+    _synthetic_round(trajectory, scale={"value": 1.5, "mfu": 1.2})
+    res = _run([], trajectory)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_lower_is_better_direction(tmp_path):
+    for n, lat in ((1, 10.0), (2, 30.0)):
+        with open(str(tmp_path / ("BENCH_r%02d.json" % n)), "w") as f:
+            json.dump({"rc": 0, "parsed": {"metric": "m", "unit": "ms",
+                                           "path": "p",
+                                           "latency_ms": lat}}, f)
+    # higher-is-better default: 10 -> 30 reads as +200%
+    assert _run([], tmp_path).returncode == 0
+    # flipped: 30ms against a best-prior 10ms is a 200% regression
+    assert _run(["--lower-is-better", "latency_ms"],
+                tmp_path).returncode == 1
+
+
+def test_invalid_newest_run_is_an_error(tmp_path):
+    with open(str(tmp_path / "BENCH_r01.json"), "w") as f:
+        json.dump({"rc": 2, "parsed": {}}, f)
+    res = _run([], tmp_path)
+    assert res.returncode not in (0, 1)
+    assert "not gateable" in res.stderr + res.stdout
+
+
+def test_metrics_typo_fails_with_clear_message():
+    res = _run(["--metrics", "no_such_metric"], REPO)
+    assert res.returncode == 1
+    assert "present in no run" in res.stdout
+
+
+def test_gate_api_rows_shape():
+    runs = bench_gate.load_runs(REPO, "BENCH_r*.json")
+    rows, regressions, newest, priors = bench_gate.gate(runs, threshold=10.0)
+    assert newest.name == _real_bench_files()[-1]
+    assert not regressions
+    keys = {r[0] for r in rows}
+    assert "value" in keys and "peak_tflops" not in keys

@@ -1,0 +1,516 @@
+"""mxnet_tpu.trace: unified cross-process span tracing (tier-1, CPU).
+
+ISSUE-8 contracts: trace-event JSON schema validity (pid/tid/ph/ts,
+non-negative monotonic durations), ring-buffer overflow drops counted
+not crashed, ParallelReader worker spans surviving a SIGKILL-restart and
+merging under correct pids, a fit(prefetch_to_device=True,
+reader_procs=2) dump showing reader-process lanes + feed stages + fused
+dispatch, the serve-request async flow, the run-metrics journal, the
+unified report, scope() emitting real spans, dump_profile() producing a
+loadable Chrome file, and the steady fused loop staying zero-recompile
+and inside the overhead budget with tracing on.
+"""
+import json
+import multiprocessing as mp
+import os
+import signal
+import statistics
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import feed, recordio, trace
+
+from common.compile_guard import assert_no_compiles
+
+IN_DIM = 6
+VALID_PH = {"X", "B", "E", "i", "I", "b", "n", "e", "s", "t", "f", "M",
+            "C", "M"}
+
+
+@pytest.fixture(autouse=True)
+def fresh_trace():
+    trace.reset()
+    yield
+    trace.reset()
+
+
+def _events(path, meta=False):
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    return evs if meta else [e for e in evs if e["ph"] != "M"]
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=8,
+                                                name="fc1"),
+                          act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=3,
+                                                      name="fc2"),
+                                name="softmax")
+
+
+def _data_iter(n=64, batch=16):
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, IN_DIM).astype(np.float32)
+    y = rng.randint(0, 3, n).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch)
+
+
+def _fit_module(**fit_kw):
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    mod.fit(_data_iter(), num_epoch=1,
+            optimizer_params=(("learning_rate", 0.5),), **fit_kw)
+    return mod
+
+
+def _raw_rec(path, n, shape=(3, 8, 8)):
+    rng = np.random.RandomState(0)
+    w = recordio.MXRecordIO(str(path), "w")
+    for i in range(n):
+        arr = rng.randint(0, 255, shape).astype(np.uint8)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              arr.tobytes()))
+    w.close()
+    return str(path)
+
+
+# -- span API + export schema ------------------------------------------------
+
+def test_trace_event_json_schema(tmp_path):
+    with trace.span("outer", cat="t", k=1):
+        with trace.span("inner"):
+            time.sleep(0.001)
+    trace.instant("mark", cat="t")
+    aid = trace.next_async_id()
+    trace.async_begin("req", aid, cat="serve")
+    trace.async_instant("req", aid, cat="serve")
+    trace.async_end("req", aid, cat="serve")
+    path = trace.dump_trace(str(tmp_path / "t.json"))
+    evs = _events(path, meta=True)
+    assert any(e["ph"] == "M" and e["name"] == "process_name" for e in evs)
+    pid = os.getpid()
+    for e in evs:
+        assert e["ph"] in VALID_PH
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] != "M":
+            assert e["pid"] == pid
+            assert e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    # nesting: inner lies within outer
+    outer = next(e for e in evs if e["name"] == "outer")
+    inner = next(e for e in evs if e["name"] == "inner")
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"k": 1}
+    # async triplet shares one id
+    reqs = [e for e in evs if e["name"] == "req"]
+    assert [e["ph"] for e in reqs] == ["b", "n", "e"]
+    assert len({e["id"] for e in reqs}) == 1
+    # dumps are idempotent and re-loadable
+    assert json.load(open(trace.dump_trace(str(tmp_path / "t2.json"))))
+
+
+def test_span_decorator_and_disable():
+    @trace.span("worker_fn", cat="t")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert trace.event_count() == 1
+    trace.set_enabled(False)
+    with trace.span("not_recorded"):
+        pass
+    assert f(2) == 3
+    assert trace.event_count() == 1
+
+    # the enabled check is at record time, not decoration time: a
+    # function decorated while disabled traces once re-enabled
+    @trace.span("late_bound")
+    def g():
+        return 7
+
+    assert g() == 7
+    assert trace.event_count() == 1
+    trace.set_enabled(True)
+    assert g() == 7
+    assert trace.event_count() == 2
+
+
+def test_nonserializable_attrs_survive_dump(tmp_path):
+    with trace.span("np-attrs", val=np.float32(0.5), arr=np.arange(2)):
+        pass
+    evs = _events(trace.dump_trace(str(tmp_path / "np.json")))
+    ev = next(e for e in evs if e["name"] == "np-attrs")
+    assert "0.5" in str(ev["args"]["val"])
+
+
+def test_dead_thread_rings_are_pruned():
+    from mxnet_tpu.trace import recorder as rec_mod
+
+    def one_span(i):
+        trace.instant("thread-%d" % i)
+
+    for i in range(rec_mod.MAX_DEAD_BUFS + 40):
+        t = threading.Thread(target=one_span, args=(i,))
+        t.start()
+        t.join()
+    # touch the registry from a fresh thread to trigger the prune
+    t = threading.Thread(target=one_span, args=(-1,))
+    t.start()
+    t.join()
+    r = trace._recorder
+    with r._lock:
+        nbufs = len(r._bufs)
+    assert nbufs <= rec_mod.MAX_DEAD_BUFS + 8
+    # pruned events are accounted as drops, not silently lost
+    assert trace.drop_count() > 0
+    assert trace.event_count() >= 1
+
+
+def test_ring_overflow_drops_counted_not_crashed(tmp_path):
+    trace.reset(buf_events=64)
+    for i in range(300):
+        trace.instant("e%d" % i)
+    assert trace.event_count() == 300
+    assert trace.drop_count() == 300 - 64
+    evs = _events(trace.dump_trace(str(tmp_path / "o.json")))
+    names = [e["name"] for e in evs if e["name"].startswith("e")]
+    # the ring keeps the NEWEST events; the drop marker rides the dump
+    assert len(names) == 64 and names[-1] == "e299"
+    assert any(e["name"] == "trace:dropped_events" and
+               e["args"]["dropped"] == 236 for e in evs)
+
+
+def test_spill_file_is_bounded(tmp_path, monkeypatch):
+    """The per-process spill file honors the bounded-resources contract:
+    past MXNET_TRACE_SPILL_MAX_EVENTS it stops growing and says so
+    in-band instead of filling the disk."""
+    monkeypatch.setenv("MXNET_TRACE_SPILL_EVERY", "10")
+    monkeypatch.setenv("MXNET_TRACE_SPILL_MAX_EVENTS", "25")
+    spill = str(tmp_path / "spill.jsonl")
+    trace.configure_spill(spill)
+    for i in range(200):
+        trace.instant("s%d" % i)
+    trace.flush_spill()
+    lines = [json.loads(ln) for ln in open(spill)]
+    names = [ln["name"] for ln in lines]
+    assert len([n for n in names if n.startswith("s")]) <= 25
+    assert "trace:spill_truncated" in names
+    size = os.path.getsize(spill)
+    for i in range(200):     # the cap holds: no further growth
+        trace.instant("t%d" % i)
+    trace.flush_spill()
+    assert os.path.getsize(spill) == size
+
+
+def test_registry_thread_safety():
+    """register_* racing *_report() must neither crash nor deadlock
+    (the one-lock + snapshot-copy contract)."""
+    stop = threading.Event()
+    errs = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                mx.profiler.unified_report()
+                mx.profiler.feed_report_str()
+        except Exception as e:      # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(200):
+            stats = feed.PipelineStats("racer%d" % i).register()
+            stats.stage("s")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+    assert not errs
+
+
+# -- profiler surface --------------------------------------------------------
+
+def test_scope_emits_real_span(tmp_path):
+    with mx.profiler.scope("my-region"):
+        pass
+    evs = _events(trace.dump_trace(str(tmp_path / "s.json")))
+    assert any(e["name"] == "my-region" and e["cat"] == "scope"
+               for e in evs)
+
+
+def test_dump_profile_writes_loadable_chrome_json(tmp_path):
+    mx.profiler.profiler_set_config(filename=str(tmp_path / "prof"))
+    with mx.profiler.scope("seeded-workflow"):
+        pass
+    out = mx.profiler.dump_profile()
+    assert out.endswith(".json") and os.path.exists(out)
+    evs = [e for e in json.load(open(out))["traceEvents"]
+           if e["ph"] != "M"]
+    assert any(e["name"] == "seeded-workflow" for e in evs)
+
+
+def test_unified_report_sections():
+    r = mx.profiler.unified_report()
+    for key in ("feed", "superstep", "multichip", "checkpoint", "serve",
+                "compile", "trace"):
+        assert key in r, key
+    assert r["trace"]["enabled"] is True
+    s = mx.profiler.unified_report_str()
+    for key in ("feed", "superstep", "multichip", "checkpoint", "serve",
+                "compile", "trace"):
+        assert "== %s " % key in s
+
+
+# -- training-path spans -----------------------------------------------------
+
+def test_fit_records_fused_dispatch_and_epoch(tmp_path):
+    _fit_module()
+    evs = _events(trace.dump_trace(str(tmp_path / "f.json")))
+    names = {e["name"] for e in evs}
+    assert "fused:dispatch" in names
+    assert "fit:epoch" in names
+    durs = [e["dur"] for e in evs if e["name"] == "fused:dispatch"]
+    assert len(durs) >= 3 and all(d >= 0 for d in durs)
+
+
+def test_superstep_spans(tmp_path):
+    _fit_module(superstep=4)
+    evs = _events(trace.dump_trace(str(tmp_path / "ss.json")))
+    names = {e["name"] for e in evs}
+    assert "superstep:dispatch" in names
+    disp = next(e for e in evs if e["name"] == "superstep:dispatch")
+    assert disp["args"]["k"] == 4
+
+
+def test_journal_lines(tmp_path, monkeypatch):
+    jpath = str(tmp_path / "journal.jsonl")
+    monkeypatch.setenv("MXNET_TRACE_JOURNAL", jpath)
+    monkeypatch.setenv("MXNET_TRACE_JOURNAL_EVERY", "2")
+    trace.reset_journal()
+    _fit_module()          # 4 batches -> steps 2 and 4 journal
+    lines = [json.loads(ln) for ln in open(jpath)]
+    assert len(lines) == 2
+    assert [ln["step"] for ln in lines] == [2, 4]
+    for ln in lines:
+        assert set(("feed", "superstep", "multichip", "checkpoint",
+                    "serve", "compile", "trace")) <= set(ln["reports"])
+        assert ln["ts"] > 0
+
+
+def test_checkpoint_spans(tmp_path):
+    from mxnet_tpu import checkpoint
+    mgr = checkpoint.CheckpointManager(str(tmp_path / "ck"),
+                                       async_save=False)
+    mgr.save(1, {"w": np.arange(4.0)})
+    mgr.restore()
+    mgr.close()
+    evs = _events(trace.dump_trace(str(tmp_path / "c.json")))
+    names = {e["name"] for e in evs}
+    assert "ckpt:write_commit" in names and "ckpt:restore" in names
+
+
+# -- serve request flow ------------------------------------------------------
+
+def test_serve_request_async_flow(tmp_path):
+    it = _data_iter(8, 8)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Uniform(0.05))
+    arg, aux = mod.get_params()
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 0, _mlp(), arg, aux)
+    eng = mx.serve.ServeEngine.from_checkpoint(
+        prefix, 0, {"data": (1, IN_DIM), "softmax_label": (1,)},
+        batch_buckets=(1, 2, 4), max_delay_ms=2.0, name="trace-test")
+    try:
+        X = np.random.RandomState(3).randn(12, IN_DIM).astype(np.float32)
+        futs = [eng.submit(x) for x in X]
+        for f in futs:
+            f.result(timeout=30)
+    finally:
+        eng.close()
+    evs = _events(trace.dump_trace(str(tmp_path / "srv.json")))
+    by_ph = {}
+    for e in evs:
+        if e["name"] == "serve:request":
+            by_ph.setdefault(e["ph"], []).append(e)
+    # every request begins, passes dispatch, and resolves — one shared
+    # id per request, which is what draws the flow arrows
+    assert len(by_ph.get("b", [])) == 12
+    assert len(by_ph.get("e", [])) == 12
+    assert {e["id"] for e in by_ph["b"]} == {e["id"] for e in by_ph["e"]}
+    assert all(e["args"]["outcome"] == "resolved" for e in by_ph["e"])
+    names = {e["name"] for e in evs}
+    assert "serve:run_batch" in names and "serve:d2h_finish" in names
+    # submit / dispatch / resolve cross three threads: distinct lanes
+    tids = {e["tid"] for e in evs if e["name"] in
+            ("serve:request", "serve:run_batch", "serve:d2h_finish")}
+    assert len(tids) >= 3
+
+
+# -- cross-process reader spans ----------------------------------------------
+
+def _reader_iter(rec, batch, workers, decode=None, **kw):
+    shape = (3, 6, 6)
+
+    def f32_decode(item):
+        label, payload = item
+        img = np.frombuffer(payload, np.uint8).astype(
+            np.float32).reshape(shape)
+        return img, np.float32(label)
+
+    p = feed.Pipeline([
+        feed.ParallelReader(rec, decode or f32_decode, workers=workers,
+                            sample_shape=shape, sample_dtype=np.float32,
+                            shuffle_window=kw.pop("window", 4),
+                            seed=kw.pop("seed", 1),
+                            max_epochs=kw.pop("max_epochs", 2),
+                            slots_per_worker=kw.pop("slots", 4)),
+        feed.BatchStage(batch)], name="trace-reader")
+    return feed.FeedDataIter(p, shape, batch)
+
+
+@pytest.mark.skipif("fork" not in mp.get_all_start_methods(),
+                    reason="ParallelReader needs fork")
+def test_worker_spans_survive_sigkill_and_merge(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TRACE_SPILL_EVERY", "8")
+
+    def _rec(path, n, shape=(3, 6, 6)):
+        rng = np.random.RandomState(0)
+        w = recordio.MXRecordIO(str(path), "w")
+        for i in range(n):
+            arr = rng.randint(0, 255, shape).astype(np.uint8)
+            w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                  arr.tobytes()))
+        w.close()
+        return str(path)
+
+    rec = _rec(tmp_path / "k.rec", 60)
+
+    def slow_decode(item):
+        label, payload = item
+        time.sleep(0.002)
+        img = np.frombuffer(payload, np.uint8).astype(
+            np.float32).reshape(3, 6, 6)
+        return img, np.float32(label)
+
+    it = _reader_iter(rec, 5, workers=2, decode=slow_decode)
+    for _ in range(3):
+        it.next()
+    reader = it.pipeline.stages[0]
+    killed_pid = reader.worker_pids()[0]
+    os.kill(killed_pid, signal.SIGKILL)
+    for _ in range(2):
+        try:
+            while True:
+                it.next()
+        except StopIteration:
+            pass
+    assert sum(reader.restarts) >= 1
+    restarted_pid = reader.worker_pids()[0]
+    it.close()
+
+    evs = _events(trace.dump_trace(str(tmp_path / "kill.json")))
+    decode_pids = {e["pid"] for e in evs
+                   if e["name"].startswith("feed:decode[")}
+    # the killed worker's flushed spans AND its replacement's both
+    # merge, under their real (distinct) pids, next to the parent's
+    assert killed_pid in decode_pids
+    assert restarted_pid in decode_pids and restarted_pid != killed_pid
+    assert len(decode_pids) >= 3            # w0 (killed), w0 (new), w1
+    assert os.getpid() not in decode_pids
+    w0 = sorted(e["ts"] for e in evs
+                if e["pid"] == killed_pid and
+                e["name"] == "feed:decode[w0]")
+    assert w0 == sorted(w0) and len(w0) >= 8
+
+
+@pytest.mark.skipif("fork" not in mp.get_all_start_methods(),
+                    reason="ParallelReader needs fork")
+def test_fit_dump_shows_reader_feed_and_dispatch_lanes(tmp_path):
+    """The acceptance dump: one fit(prefetch_to_device=True) over a
+    2-process reader pipeline shows distinct pid lanes for both reader
+    workers, the feed stages, and the fused dispatch."""
+    rec = _raw_rec(tmp_path / "fit.rec", 48)
+    it = feed.record_pipeline(rec, 8, (3, 8, 8), reader_procs=2,
+                              shuffle_window=4, seed=0, scale=1.0 / 255,
+                              max_epochs=3, to_device=False,
+                              device_augment=False)
+    data = mx.sym.Variable("data")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Flatten(data), num_hidden=3,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(0))
+    mod.fit(it, num_epoch=1, prefetch_to_device=True,
+            optimizer_params=(("learning_rate", 0.05),))
+    it.close()
+    path = mx.profiler.dump_trace(str(tmp_path / "fit.trace.json"))
+    evs = _events(path, meta=True)
+    body = [e for e in evs if e["ph"] != "M"]
+    main_pid = os.getpid()
+    reader_pids = {e["pid"] for e in body if e["pid"] != main_pid}
+    assert len(reader_pids) >= 2
+    names = {e["name"] for e in body}
+    assert "fused:dispatch" in names
+    assert any(n.startswith("feed:") for n in names)
+    assert "feed:h2d_stage" in names or "feed:batch" in names
+    # worker lanes are labeled in the metadata
+    labels = [e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"
+              and e["pid"] in reader_pids]
+    assert any("feed-reader" in lb for lb in labels)
+
+
+# -- overhead budget ---------------------------------------------------------
+
+def test_tracing_overhead_and_zero_recompiles():
+    """Steady fused loop with tracing ON: zero extra XLA compiles and
+    per-step cost within budget of the MXNET_TRACE=0 loop.  The issue's
+    budget is <2% of real step time; CPU-CI step times here are tens of
+    microseconds with scheduler noise far above 2%, so the assertion
+    uses a generous margin (1.5x + 1ms) that still catches any
+    per-span cost regression measured in milliseconds."""
+    it = _data_iter(32, 16)
+    mod = mx.mod.Module(_mlp(), context=[mx.current_context()])
+    mod.bind(it.provide_data, it.provide_label, for_training=True)
+    mod.init_params(mx.init.Uniform(0.05))
+    mod.init_optimizer(optimizer_params=(("learning_rate", 0.1),))
+    batch = it.next()
+
+    def warm(n):
+        for _ in range(n):
+            mod.forward_backward(batch)
+            mod.update()
+
+    def measure(n):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            mod.forward_backward(batch)
+            mod.update()
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+
+    warm(10)
+    trace.set_enabled(False)
+    off1 = measure(150)
+    trace.set_enabled(True)
+    with assert_no_compiles("traced steady fused loop"):
+        on = measure(150)
+    trace.set_enabled(False)
+    off2 = measure(150)
+    off = min(off1, off2)
+    assert on <= off * 1.5 + 1e-3, \
+        "tracing overhead: on=%.6fs off=%.6fs" % (on, off)
+    assert trace.event_count() >= 150     # the loop really was traced

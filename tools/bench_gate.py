@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Bench regression gate: newest BENCH_r*.json vs the best prior run.
+
+The driver appends one ``BENCH_rNN.json`` per round; ROADMAP's open
+bench questions ("watch the bench numbers") are only answerable if
+someone actually compares the trajectory.  This tool does, mechanically::
+
+    python tools/bench_gate.py                       # gate the repo root
+    python tools/bench_gate.py --threshold 5 --metrics value,mfu
+    python tools/bench_gate.py --dir /path --glob 'BENCH_r*.json'
+
+For every gated metric it finds the BEST prior value across comparable
+runs and compares the newest run against it; a drop of more than
+``--threshold`` percent (default 10) on any gated metric prints a
+REGRESS row and exits 1.  Metrics new in the newest run pass as NEW;
+metrics the newest run dropped entirely are flagged MISSING (gated —
+silently losing a bench leg is itself a regression).
+
+Comparability filters (the trajectory contains known artifacts):
+
+* runs with nonzero ``rc`` or no parsed metrics are skipped (r03's
+  wedged-device round);
+* runs whose headline ``metric``/``unit``/``path`` differ from the
+  newest run's are skipped (r01 predates the fused path label);
+* runs whose ``peak_tflops`` probe sits outside the physically sane
+  band are skipped (r02's 66,500 "TF/s" clock artifact — same band as
+  bench.clock_is_suspect, duplicated here so the gate never imports
+  jax).
+
+Config keys (``io_host_cores``, ``peak_tflops``, ...) are excluded from
+gating by default; ``--metrics`` gives an explicit allowlist instead,
+``--lower-is-better`` flips the direction for latency-style metrics.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+# mirror of bench.PEAK_SANE_TFLOPS (bench.py imports jax at module
+# level; the gate must stay importable anywhere)
+PEAK_SANE_TFLOPS = (10.0, 1000.0)
+
+# keys that describe the run rather than measure it — never gated unless
+# explicitly allowlisted via --metrics
+DEFAULT_IGNORE = {
+    "n", "rc", "peak_tflops", "io_host_cores", "io_threads",
+    "train_gflop_per_img_xla",
+    # tracks `value` exactly (value / BASELINE); gating both would
+    # double-report every headline move
+    "vs_baseline",
+}
+
+
+class GateError(Exception):
+    """The gate cannot run at all (distinct from exit 1 = regression):
+    main() turns this into exit 2."""
+
+
+class Run:
+    def __init__(self, path: str, doc: Dict):
+        self.path = path
+        self.name = os.path.basename(path)
+        self.rc = doc.get("rc")
+        parsed = doc.get("parsed")
+        self.parsed = parsed if isinstance(parsed, dict) else {}
+
+    def round_key(self):
+        m = re.search(r"_r(\d+)", self.name)
+        return (int(m.group(1)) if m else -1, self.name)
+
+    def headline(self):
+        return (self.parsed.get("metric"), self.parsed.get("unit"),
+                self.parsed.get("path"))
+
+    def metrics(self) -> Dict[str, float]:
+        return {k: float(v) for k, v in self.parsed.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+    def invalid_reason(self, ref: Optional["Run"] = None) -> Optional[str]:
+        if self.rc not in (0, None):
+            return "rc=%s" % self.rc
+        if not self.metrics():
+            return "no parsed metrics"
+        peak = self.parsed.get("peak_tflops")
+        if isinstance(peak, (int, float)) and peak and not (
+                PEAK_SANE_TFLOPS[0] <= peak <= PEAK_SANE_TFLOPS[1]):
+            return "clock-suspect probe (%.1f TF/s)" % peak
+        if ref is not None and self.headline() != ref.headline():
+            return "different bench configuration %r" % (self.headline(),)
+        return None
+
+
+def load_runs(directory: str, pattern: str) -> List[Run]:
+    runs = []
+    for path in glob.glob(os.path.join(directory, pattern)):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print("bench_gate: skipping unreadable %s (%s)" % (path, e),
+                  file=sys.stderr)
+            continue
+        runs.append(Run(path, doc))
+    runs.sort(key=Run.round_key)
+    return runs
+
+
+def gate(runs: List[Run], threshold: float, metrics=None,
+         ignore=DEFAULT_IGNORE, lower_is_better=()):
+    """-> (rows, regressions, newest, priors).  Each row:
+    (metric, new value or None, best prior or None, prior run name,
+    delta_pct or None, status)."""
+    if not runs:
+        raise GateError("bench_gate: no BENCH files found")
+    newest = runs[-1]
+    reason = newest.invalid_reason()
+    if reason:
+        raise GateError("bench_gate: newest run %s is not gateable (%s)"
+                        % (newest.name, reason))
+    priors = [r for r in runs[:-1] if r.invalid_reason(ref=newest) is None]
+    new_metrics = newest.metrics()
+    if metrics:
+        gated = list(metrics)
+    else:
+        gated = sorted(set(new_metrics) - set(ignore)
+                       | {k for r in priors for k in r.metrics()
+                          if k not in ignore})
+    rows, regressions = [], []
+    for key in gated:
+        best = None
+        best_run = None
+        for r in priors:
+            v = r.metrics().get(key)
+            if v is None:
+                continue
+            better = (best is None or
+                      (v < best if key in lower_is_better else v > best))
+            if better:
+                best, best_run = v, r.name
+        new = new_metrics.get(key)
+        if new is None:
+            if best is None:
+                # only reachable via an explicit --metrics name that no
+                # run carries — almost certainly a typo, but still a
+                # failed gate (the named metric is unverifiable)
+                rows.append((key, None, None, None, None, "ABSENT"))
+                regressions.append(
+                    "%s: named in --metrics but present in no run "
+                    "(typo?)" % key)
+            else:
+                rows.append((key, None, best, best_run, None, "MISSING"))
+                regressions.append("%s: present in %s, missing from %s"
+                                   % (key, best_run, newest.name))
+            continue
+        if best is None:
+            rows.append((key, new, None, None, None, "NEW"))
+            continue
+        if best == 0:
+            delta = 0.0
+        elif key in lower_is_better:
+            delta = (best - new) / abs(best) * 100.0
+        else:
+            delta = (new - best) / abs(best) * 100.0
+        status = "OK"
+        if delta < -threshold:
+            status = "REGRESS"
+            regressions.append(
+                "%s: %.6g -> %.6g (%+.1f%% vs best prior %s, threshold "
+                "%.1f%%)" % (key, best, new, delta, best_run, threshold))
+        rows.append((key, new, best, best_run, delta, status))
+    return rows, regressions, newest, priors
+
+
+def print_table(rows, newest, priors) -> None:
+    print("bench_gate: %s vs best of %d comparable prior run(s) %s"
+          % (newest.name, len(priors), [r.name for r in priors]))
+    fmt = "  %-28s %14s %14s %-16s %9s  %s"
+    print(fmt % ("metric", "newest", "best prior", "from", "delta%", ""))
+    for key, new, best, best_run, delta, status in rows:
+        print(fmt % (
+            key,
+            "%.6g" % new if new is not None else "-",
+            "%.6g" % best if best is not None else "-",
+            best_run or "-",
+            "%+.1f" % delta if delta is not None else "-",
+            status))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding the BENCH files (default .)")
+    ap.add_argument("--glob", default="BENCH_r*.json",
+                    help="bench-file pattern (default BENCH_r*.json)")
+    ap.add_argument("--threshold", type=float, default=10.0,
+                    help="max tolerated regression, percent (default 10)")
+    ap.add_argument("--metrics", default=None,
+                    help="comma-separated allowlist; default: every "
+                         "numeric metric minus the config keys")
+    ap.add_argument("--ignore", default=None,
+                    help="comma-separated keys to add to the default "
+                         "ignore set")
+    ap.add_argument("--lower-is-better", default=None,
+                    help="comma-separated keys where smaller is better "
+                         "(latency metrics)")
+    args = ap.parse_args(argv)
+
+    def split(s):
+        return [x for x in (s or "").split(",") if x]
+    ignore = set(DEFAULT_IGNORE) | set(split(args.ignore))
+    runs = load_runs(args.dir, args.glob)
+    skipped = []
+    if runs:
+        ref = runs[-1]
+        skipped = [(r.name, r.invalid_reason(ref=ref))
+                   for r in runs[:-1] if r.invalid_reason(ref=ref)]
+    try:
+        rows, regressions, newest, priors = gate(
+            runs, threshold=args.threshold, metrics=split(args.metrics),
+            ignore=ignore, lower_is_better=set(split(args.lower_is_better)))
+    except GateError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    for name, why in skipped:
+        print("bench_gate: skipping %s (%s)" % (name, why))
+    print_table(rows, newest, priors)
+    if regressions:
+        print("\nbench_gate: FAIL — %d regression(s):" % len(regressions))
+        for r in regressions:
+            print("  " + r)
+        return 1
+    print("\nbench_gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
