@@ -436,7 +436,11 @@ def main():
     # serving leg (mxnet_tpu.serve): closed-loop multithreaded load on the
     # dynamic micro-batcher vs serial batch-1 Predictor.predict — the
     # inference-side throughput the north star asks for (acceptance:
-    # serve_speedup >= 3x at >= 8 client threads, outputs parity-checked)
+    # serve_speedup >= 3x at >= 8 client threads, outputs parity-checked).
+    # Includes the quantized leg (mxnet_tpu.passes): the same load on a
+    # wide-FC model served f32 vs calibrated int8 — serve_qps_int8,
+    # serve_quant_speedup (acceptance >= 1.5) and serve_quant_top1_delta
+    # (acceptance <= 0.005), gated by tools/bench_gate.py from round 1
     try:
         from bench_serve import run as serve_run
         _feed_watchdog("serve")

@@ -20,6 +20,20 @@ are still waking, hiding the completion-wakeup latency.
 
 Outputs are cross-checked per request against the serial predictions —
 a throughput number from wrong answers is worse than no number.
+
+Quantized leg (``mxnet_tpu.passes``, ISSUE 9) — the SAME closed-loop
+load against one wide-FC model served f32 vs int8 (calibrated q/dq
+graph rewrite).  The model is GEMM-heavy (int8 pays above ~1k-wide
+matmuls; the tiny main-leg MLP is dispatch-bound where int8 loses) and
+DECISIVE: its output layer holds planted class prototypes, so top-1
+agreement measures real answer flips, not coin-toss ties between
+near-uniform logits.
+
+  serve_qps_int8          int8 engine under closed-loop load
+  serve_qps_f32_wide      the f32 twin, interleaved windows
+  serve_quant_speedup     qps_int8 / qps_f32_wide (acceptance: >= 1.5)
+  serve_quant_top1_delta  fraction of requests whose argmax differs
+                          from the f32 engine's (acceptance: <= 0.005)
 """
 import shutil
 import tempfile
@@ -33,6 +47,13 @@ WINDOWS = 4         # median window: 1-core tunnel hosts are noisy
 IN_DIM = 64
 HIDDEN = 128
 CLASSES = 10
+# quantized leg: wide enough that the int8 GEMM wins (host sweep:
+# ~0.75x at 128-wide, 1.4x at 1024, 2.2x at 2048), small request count
+# (each f32 batch is ~tens of ms of real GEMM)
+IN_Q = 512
+HIDDEN_Q = 2048
+Q_REQS_PER_THREAD = 20
+Q_WINDOWS = 3
 
 
 def _save_model(tmp):
@@ -153,7 +174,140 @@ def run(feed=lambda *_: None, threads=N_THREADS,
         out["serve_threads"] = threads
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+    # the quantized leg must never sink the measured main-leg numbers
+    try:
+        out.update(quant_leg(feed=feed, threads=threads))
+    except Exception as e:            # pragma: no cover
+        import sys
+        sys.stderr.write("bench_serve: quantized leg failed (%s)\n" % e)
     return out
+
+
+def _quant_model():
+    """Wide decisive MLP for the int8 vs f32 comparison: random hidden
+    layers, output layer = planted class prototypes (the L2-normalized
+    hidden representation of 10 anchor inputs), requests = noisy
+    anchors.  Top-1 is then a real answer (f32 accuracy 1.0 on the
+    planted labels), so `serve_quant_top1_delta` counts genuine flips."""
+    import mxnet_tpu as mx
+
+    rng = np.random.RandomState(7)
+
+    def xavier(n_out, n_in):
+        return (rng.randn(n_out, n_in) *
+                np.sqrt(2.0 / n_in)).astype(np.float32)
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=HIDDEN_Q, name="qfc0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=HIDDEN_Q, name="qfc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="qfc_out")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    args = {"qfc0_weight": xavier(HIDDEN_Q, IN_Q),
+            "qfc0_bias": np.zeros(HIDDEN_Q, np.float32),
+            "qfc1_weight": xavier(HIDDEN_Q, HIDDEN_Q),
+            "qfc1_bias": np.zeros(HIDDEN_Q, np.float32)}
+    anchors = rng.rand(CLASSES, IN_Q).astype(np.float32)
+    hidden = mx.sym.Activation(net.get_internals()["qfc1_output"],
+                               act_type="relu")
+    exe = hidden.simple_bind(mx.cpu(), grad_req="null",
+                             data=(CLASSES, IN_Q))
+    exe.copy_params_from(args, {}, allow_extra_params=True)
+    exe.arg_dict["data"][:] = anchors
+    protos = np.asarray(exe.forward(is_train=False)[0]._get())
+    args["qfc_out_weight"] = (
+        protos / np.linalg.norm(protos, axis=1, keepdims=True)
+    ).astype(np.float32)
+    args["qfc_out_bias"] = np.zeros(CLASSES, np.float32)
+    return net, args, anchors, rng
+
+
+def quant_leg(feed=lambda *_: None, threads=N_THREADS,
+              reqs_per_thread=Q_REQS_PER_THREAD):
+    """serve_qps_int8 / serve_quant_speedup / serve_quant_top1_delta:
+    one wide-FC model closed-loop served f32 vs calibrated-int8
+    (interleaved windows, like the main leg)."""
+    import threading
+
+    from mxnet_tpu.serve import ServeEngine
+
+    net, args, anchors, rng = _quant_model()
+    n = threads * reqs_per_thread
+    labels = rng.randint(0, CLASSES, n)
+    X = (0.7 * anchors[labels] +
+         0.3 * rng.rand(n, IN_Q)).astype(np.float32)
+    shapes = {"data": (1, IN_Q), "softmax_label": (1,)}
+    buckets = tuple(b for b in (1, 2, 4, 8, 16, 32) if b <= threads) \
+        + ((threads,) if threads & (threads - 1) else ())
+
+    feed("serve-quant-warmup")
+    # engines build INSIDE the close-guard: a failed int8 construction
+    # (calibration error etc.) must not leak the f32 engine's dispatcher
+    # thread and device buffers into the rest of the bench
+    engines = {}
+    results = {"f32": [None] * n, "int8": [None] * n}
+
+    def window(kind):
+        eng, res = engines[kind], results[kind]
+        errors = []
+
+        def client(t):
+            try:
+                for j in range(reqs_per_thread):
+                    i = t * reqs_per_thread + j
+                    res[i] = eng.predict(X[i], timeout=120)
+            except Exception as e:               # pragma: no cover
+                errors.append(e)
+        workers = [threading.Thread(target=client, args=(t,))
+                   for t in range(threads)]
+        t0 = time.perf_counter()
+        for wk in workers:
+            wk.start()
+        for wk in workers:
+            wk.join()
+        if errors:
+            raise errors[0]
+        return n / (time.perf_counter() - t0)
+
+    try:
+        engines["f32"] = ServeEngine(net, dict(args), shapes,
+                                     batch_buckets=buckets,
+                                     max_delay_ms=2.0, deadline_ms=60000.0,
+                                     name="bench-qf32")
+        # calibrate on the same wire distribution the load uses
+        engines["int8"] = ServeEngine(net, dict(args), shapes,
+                                      batch_buckets=buckets,
+                                      max_delay_ms=2.0, deadline_ms=60000.0,
+                                      name="bench-int8", quantize="int8",
+                                      calib_data=X[:64])
+        f32_rates, int8_rates, ratios = [], [], []
+        for w in range(Q_WINDOWS):
+            feed("serve-quant-f32")
+            f32_rates.append(window("f32"))
+            feed("serve-quant-int8")
+            int8_rates.append(window("int8"))
+            ratios.append(int8_rates[-1] / f32_rates[-1])
+    finally:
+        for eng in engines.values():
+            eng.close()
+    yf = np.stack(results["f32"])
+    yq = np.stack(results["int8"])
+    if (yf.argmax(1) == labels).mean() < 0.99:
+        raise AssertionError("quant leg f32 engine does not solve its "
+                             "own planted task; delta is meaningless")
+
+    def peak(rates):
+        med = sorted(rates)[len(rates) // 2]
+        return max(r for r in rates if r <= 1.3 * med)
+
+    return {
+        "serve_qps_int8": round(peak(int8_rates), 1),
+        "serve_qps_f32_wide": round(peak(f32_rates), 1),
+        "serve_quant_speedup": round(peak(ratios), 2),
+        "serve_quant_top1_delta": round(
+            float((yf.argmax(1) != yq.argmax(1)).mean()), 4),
+    }
 
 
 if __name__ == "__main__":

@@ -57,6 +57,7 @@ from . import native_io
 from . import feed
 from . import checkpoint
 from . import compile_cache
+from . import passes
 from . import predictor
 from . import serve
 from . import trace

@@ -10,6 +10,7 @@ from . import tensor  # noqa: F401  (registers elementwise/broadcast/reduce/matr
 from . import nn      # noqa: F401  (registers NN layers)
 from . import special  # noqa: F401 (registers ROIPooling/SpatialTransformer/Correlation)
 from . import rnn     # noqa: F401  (registers the fused scan-based RNN)
+from . import quantized  # noqa: F401 (registers q/dq + int8 matmul/conv)
 
 __all__ = ["OpDef", "OpContext", "Param", "register_op", "register_simple_op",
            "get_op", "list_ops"]

@@ -1,0 +1,177 @@
+"""Quantized inference operators (the op-level half of ``mxnet_tpu.passes``).
+
+Reference heritage: the upstream contrib quantization flow
+(``mx.contrib.quantization.quantize_model``) registers ``_contrib_quantize``
+/ ``_contrib_dequantize`` plus quantized kernels for the matmul/conv
+family; this is the TPU-native analogue.  Symmetric int8 (zero_point=0):
+
+    q = clip(round(x / scale), -127, 127)        x ~= q * scale
+
+The compute ops take int8 activations + int8 weights, accumulate in int32
+(``preferred_element_type`` — the MXU/AVX int8 path), and dequantize +
+add the f32 bias IN the op, so each quantized layer emits f32 and the
+surrounding graph (activations, pooling, softmax) is untouched.  Weight
+scales are PER OUTPUT CHANNEL and arrive as a small f32 input vector
+(``<name>_wscale``) baked into the param blob by the quantize pass —
+keeping the symbol json small and letting hot weight reload re-quantize
+without touching the graph.
+
+None of these ops defines a gradient story: they are inference-only
+(Predictor/ServeEngine bind with ``grad_req='null'``); autodiff through
+``round`` would silently train nonsense, so backward is not a goal.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import OpDef, Param, register_op
+
+INT8_QMAX = 127.0
+
+
+def quantize_array(arr: np.ndarray, axis: Optional[int] = None):
+    """Host-side symmetric int8 quantization of a weight array.
+
+    -> (int8 array, f32 scale array).  ``axis`` selects per-channel
+    scales (one per slice along ``axis``); None = one per-tensor scale.
+    Zero slices get scale 1.0 (q is all-zero either way; a zero scale
+    would NaN the dequantize)."""
+    arr = np.asarray(arr, np.float32)
+    if axis is None:
+        amax = float(np.max(np.abs(arr))) if arr.size else 0.0
+        scale = np.float32(amax / INT8_QMAX if amax > 0 else 1.0)
+        q = np.clip(np.rint(arr / scale), -INT8_QMAX, INT8_QMAX)
+        return q.astype(np.int8), np.asarray(scale, np.float32)
+    red = tuple(i for i in range(arr.ndim) if i != axis)
+    amax = np.max(np.abs(arr), axis=red) if arr.size else \
+        np.zeros(arr.shape[axis], np.float32)
+    scale = np.where(amax > 0, amax / INT8_QMAX, 1.0).astype(np.float32)
+    bshape = [1] * arr.ndim
+    bshape[axis] = -1
+    q = np.clip(np.rint(arr / scale.reshape(bshape)), -INT8_QMAX, INT8_QMAX)
+    return q.astype(np.int8), scale
+
+
+@register_op("_contrib_quantize", hint="quantize")
+class QuantizeOp(OpDef):
+    """f32 -> int8 with a calibration-baked scale (symmetric, zp=0)."""
+    params = [Param("scale", float, required=True,
+                    doc="dequantize step: x ~= q * scale")]
+
+    def infer_type(self, p, in_types):
+        return [np.dtype(np.float32)], [np.dtype(np.int8)], []
+
+    def forward(self, p, inputs, aux, ctx):
+        if p.scale <= 0:
+            raise MXNetError("_contrib_quantize scale must be > 0, got %r"
+                             % (p.scale,))
+        q = jnp.clip(jnp.round(inputs[0] / np.float32(p.scale)),
+                     -INT8_QMAX, INT8_QMAX)
+        return [q.astype(jnp.int8)]
+
+
+@register_op("_contrib_dequantize", hint="dequantize")
+class DequantizeOp(OpDef):
+    """int8/int32 -> f32 by a single baked scale."""
+    params = [Param("scale", float, required=True)]
+
+    def infer_type(self, p, in_types):
+        t = in_types[0] if in_types[0] is not None else np.dtype(np.int8)
+        return [t], [np.dtype(np.float32)], []
+
+    def forward(self, p, inputs, aux, ctx):
+        return [inputs[0].astype(jnp.float32) * np.float32(p.scale)]
+
+
+class _QuantizedBase(OpDef):
+    """Shared plumbing: int8 data+weight, f32 wscale vector (+f32 bias)."""
+
+    def list_arguments(self, p):
+        args = ["data", "weight", "wscale"]
+        if not p.no_bias:
+            args.append("bias")
+        return args
+
+    def infer_type(self, p, in_types):
+        i8, f32 = np.dtype(np.int8), np.dtype(np.float32)
+        ins = [i8, i8, f32] + ([] if p.no_bias else [f32])
+        return ins, [f32], []
+
+
+@register_op("_quantized_FullyConnected", hint="quantized_fullyconnected")
+class QuantizedFullyConnectedOp(_QuantizedBase):
+    """int8 x (int8 W)^T -> int32, dequant by scale_data*wscale, +bias.
+
+    y = (x_q · W_qᵀ).astype(f32) * (scale_data * wscale) + bias
+    """
+    params = [Param("num_hidden", int, required=True),
+              Param("no_bias", bool, default=False),
+              Param("scale_data", float, required=True,
+                    doc="calibrated activation scale of the int8 data input")]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        num_input = int(np.prod(d[1:]))
+        shapes = [d, (p.num_hidden, num_input), (p.num_hidden,)]
+        if not p.no_bias:
+            shapes.append((p.num_hidden,))
+        return shapes, [(d[0], p.num_hidden)], []
+
+    def forward(self, p, inputs, aux, ctx):
+        x = inputs[0].reshape(inputs[0].shape[0], -1)
+        acc = lax.dot_general(x, inputs[1], (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (np.float32(p.scale_data) * inputs[2])
+        if not p.no_bias:
+            out = out + inputs[3]
+        return [out]
+
+
+@register_op("_quantized_Convolution", hint="quantized_convolution")
+class QuantizedConvolutionOp(_QuantizedBase):
+    """int8 NCHW conv, int32 accumulation, fused per-filter dequant+bias."""
+    params = [Param("kernel", "shape", required=True),
+              Param("stride", "shape", default=(1, 1)),
+              Param("dilate", "shape", default=(1, 1)),
+              Param("pad", "shape", default=(0, 0)),
+              Param("num_filter", int, required=True),
+              Param("num_group", int, default=1),
+              Param("no_bias", bool, default=False),
+              Param("scale_data", float, required=True)]
+
+    def infer_shape(self, p, in_shapes):
+        from .nn import _conv_out
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        kh, kw = p.kernel
+        wshape = (p.num_filter, d[1] // p.num_group, kh, kw)
+        oshape = (d[0], p.num_filter,
+                  _conv_out(d[2], kh, p.stride[0], p.pad[0], p.dilate[0]),
+                  _conv_out(d[3], kw, p.stride[1], p.pad[1], p.dilate[1]))
+        shapes = [d, wshape, (p.num_filter,)]
+        if not p.no_bias:
+            shapes.append((p.num_filter,))
+        return shapes, [oshape], []
+
+    def forward(self, p, inputs, aux, ctx):
+        x, w, wscale = inputs[0], inputs[1], inputs[2]
+        acc = lax.conv_general_dilated(
+            x, w, window_strides=tuple(p.stride),
+            padding=[(p.pad[0], p.pad[0]), (p.pad[1], p.pad[1])],
+            rhs_dilation=tuple(p.dilate),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=p.num_group,
+            preferred_element_type=jnp.int32)
+        scale = (np.float32(p.scale_data) * wscale)[None, :, None, None]
+        out = acc.astype(jnp.float32) * scale
+        if not p.no_bias:
+            out = out + inputs[3][None, :, None, None]
+        return [out]

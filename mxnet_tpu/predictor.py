@@ -39,6 +39,15 @@ def strip_param_prefixes(params: Dict[str, NDArray]) -> Dict[str, NDArray]:
             for k, v in params.items()}
 
 
+def _as_nd(v) -> NDArray:
+    """To NDArray PRESERVING dtype (nd.array defaults to f32, which
+    would silently upcast int8/fp16 params on hot reload)."""
+    if isinstance(v, NDArray):
+        return v
+    arr = np.asarray(v)
+    return nd_array(arr, dtype=arr.dtype)
+
+
 def load_ndarray_file(path: str) -> Dict[str, NDArray]:
     """MXNDListCreate analogue: read a saved param blob."""
     return strip_param_prefixes(nd_load(path))
@@ -101,7 +110,8 @@ class Predictor:
     def __init__(self, symbol_json: str, param_bytes_or_path,
                  input_shapes: Dict[str, Tuple[int, ...]],
                  dev_type: str = "cpu", dev_id: int = 0,
-                 type_dict: Optional[Dict] = None):
+                 type_dict: Optional[Dict] = None,
+                 pipeline=None):
         self.symbol = sym_load_json(symbol_json) \
             if isinstance(symbol_json, str) and symbol_json.lstrip().startswith("{") \
             else sym_load_json(open(symbol_json).read())
@@ -110,6 +120,22 @@ class Predictor:
             params = strip_param_prefixes(param_bytes_or_path)
         else:
             params = load_ndarray_file(param_bytes_or_path)
+        # graph-optimization hook (mxnet_tpu.passes): run the pipeline on
+        # the checkpointed f32 graph, bind the TRANSFORMED symbol.  The
+        # pipeline fingerprint lands in the symbol's graph attrs, which
+        # Executor._program_desc hashes into the compile-cache fast key —
+        # a quantized program can never alias its f32 twin.  set_params
+        # replays the params-side transform (re-quantize/cast) so hot
+        # weight reload keeps working against the rewritten graph.
+        self._pipeline = pipeline
+        if pipeline is not None:
+            sym, qparams = pipeline.run(self.symbol, params)
+            self.symbol, params = sym, dict(qparams)
+            # a pass that retypes an input (u8 wire) publishes it here;
+            # explicit caller type_dict entries still win below
+            overrides = dict(pipeline.type_overrides)
+            overrides.update(type_dict or {})
+            type_dict = overrides
         # each list_arguments() call walks the whole graph — compute the
         # name sets ONCE (set_params runs them under the serving lock)
         self._arg_names = frozenset(self.symbol.list_arguments())
@@ -174,21 +200,28 @@ class Predictor:
         """Hot-swap weights into EVERY cached executor (they share param
         buffers, but iterating keeps the swap correct even for executors
         bound before sharing was possible).  Later ``_bind`` calls copy
-        from the updated host dicts, so new shapes see the new weights."""
+        from the updated host dicts, so new shapes see the new weights.
+
+        With a pass pipeline bound, incoming f32 weights are pushed
+        through ``pipeline.transform_params`` first — re-folded,
+        re-quantized to int8 + wscale, re-cast — so a training loop can
+        keep hot-reloading checkpoints into a quantized serving graph."""
+        if self._pipeline is not None and (arg_params or aux_params):
+            merged = dict(strip_param_prefixes(dict(arg_params or {})))
+            merged.update(strip_param_prefixes(dict(aux_params or {})))
+            merged = self._pipeline.transform_params(merged)
+            arg_params, aux_params = merged, None
         if arg_params:
             arg_params = strip_param_prefixes(dict(arg_params))
             for k, v in arg_params.items():
                 if k in self._arg_names:
-                    self._arg_params[k] = v if isinstance(v, NDArray) \
-                        else nd_array(np.asarray(v))
+                    self._arg_params[k] = _as_nd(v)
                 elif k in self._aux_names:
-                    self._aux_params[k] = v if isinstance(v, NDArray) \
-                        else nd_array(np.asarray(v))
+                    self._aux_params[k] = _as_nd(v)
         if aux_params:
             for k, v in strip_param_prefixes(dict(aux_params)).items():
                 if k in self._aux_names:
-                    self._aux_params[k] = v if isinstance(v, NDArray) \
-                        else nd_array(np.asarray(v))
+                    self._aux_params[k] = _as_nd(v)
         seen = set()
         for ex in self._exec_cache.values():
             if id(ex) in seen:

@@ -30,6 +30,7 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "register_superstep_stats", "superstep_report",
            "superstep_report_str", "register_serve_stats", "serve_report",
            "serve_report_str", "compile_report", "compile_report_str",
+           "register_passes_stats", "passes_report", "passes_report_str",
            "MultichipStats", "register_multichip_stats",
            "parse_hlo_collectives", "multichip_report",
            "multichip_report_str", "unified_report", "unified_report_str"]
@@ -555,6 +556,30 @@ def serve_report_str() -> str:
     return _serve_registry.report_str()
 
 
+# -- pass-pipeline instrumentation (mxnet_tpu.passes) ------------------------
+# Every PassPipeline registers its PassStats at construction; one
+# passes_report() shows, per live pipeline, the per-pass wall time, node
+# counts and rewrite counts of its runs plus the fingerprint the
+# compile-cache fast key carries.
+_passes_registry = _Registry("passes", "(no pass pipelines)")
+
+
+def register_passes_stats(passes_stats) -> None:
+    """Called by passes.PassPipeline on construction."""
+    _passes_registry.register(passes_stats)
+
+
+def passes_report() -> dict:
+    """Per-pipeline, per-pass wall seconds, node counts in/out, rewrite
+    counts and the pipeline fingerprint (see mxnet_tpu.passes)."""
+    return _passes_registry.report()
+
+
+def passes_report_str() -> str:
+    """Human-readable pass-pipeline table (see passes_report)."""
+    return _passes_registry.report_str()
+
+
 # -- compilation instrumentation (mxnet_tpu.compile_cache) -------------------
 # Compilation is process-global (one XLA compiler, one jit cache, one disk
 # cache), so unlike the per-instance registries above there is exactly one
@@ -586,6 +611,7 @@ def unified_report() -> dict:
         "multichip": multichip_report(),
         "checkpoint": checkpoint_report(),
         "serve": serve_report(),
+        "passes": passes_report(),
     }
     try:
         out["compile"] = compile_report()
@@ -604,6 +630,7 @@ def unified_report_str() -> str:
         ("multichip", multichip_report_str),
         ("checkpoint", checkpoint_report_str),
         ("serve", serve_report_str),
+        ("passes", passes_report_str),
         ("compile", compile_report_str),
     ]
     parts = []

@@ -37,6 +37,7 @@ import numpy as np
 
 from .. import trace as _trace
 from ..base import MXNetError, get_env
+from ..context import Context
 from ..predictor import Predictor, load_checkpoint_pair
 from .batcher import MicroBatcher
 from .errors import ServeError, ServeRequestError
@@ -93,6 +94,20 @@ class ServeEngine:
         on gather.  Composes with hot reload (a swapped weight lands
         back in its shard sharding) and the compile cache (mesh axes
         join the program keys).
+    quantize / calib_data / u8_wire / pipeline :
+        Graph-optimized serving (``mxnet_tpu.passes``).  ``quantize=``
+        takes ``"int8"`` (needs ``calib_data``: a sample of requests in
+        WIRE format, item-stacked — the engine calibrates activation
+        ranges on it), ``"float16"``/``"bfloat16"`` (pure precision
+        rewrite, no calibration), or a dict of QuantizePass kwargs.
+        ``u8_wire=`` (True or ``{"mean":, "scale":, "hwc":}``) moves the
+        cast/normalize prologue into the graph and retypes the data
+        input to uint8, so each request ships 4x fewer bytes.
+        ``pipeline=`` overrides with a pre-built PassPipeline.  The
+        bucket grid is compiled FROM the transformed graph (AOT-warmed
+        through compile_cache.parallel_warm), the pipeline fingerprint
+        keys the compiled programs apart from their f32 twins, and hot
+        reload re-quantizes fresh f32 weights automatically.
     """
 
     def __init__(self, symbol, params: Dict,
@@ -106,7 +121,9 @@ class ServeEngine:
                  dev_type: str = "cpu", dev_id: int = 0,
                  type_dict: Optional[Dict] = None,
                  name: str = "serve", warmup: bool = True,
-                 mesh=None, param_specs: Optional[Dict] = None):
+                 mesh=None, param_specs: Optional[Dict] = None,
+                 quantize=None, calib_data=None, u8_wire=None,
+                 pipeline=None):
         if not input_shapes:
             raise ServeError("input_shapes must name at least one input")
         sym_json = symbol.tojson() if hasattr(symbol, "tojson") else symbol
@@ -165,9 +182,17 @@ class ServeEngine:
         if self._param_specs and mesh is None:
             raise ServeError("param_specs without mesh=: specs are "
                              "PartitionSpecs over a named mesh")
+        if pipeline is None and (quantize or u8_wire):
+            from ..passes import build_serving_pipeline
+            pipeline = build_serving_pipeline(
+                quantize=quantize, calib_data=calib_data,
+                calib_shapes=self._shapes_by_bucket[self.max_batch_size],
+                data_name=data_name, u8_wire=u8_wire, name=name,
+                ctx=Context(dev_type, dev_id))
+        self.pipeline = pipeline
         self._predictor = Predictor(
             sym_json, params, self._shapes_by_bucket[self.max_batch_size],
-            dev_type, dev_id, type_dict=type_dict)
+            dev_type, dev_id, type_dict=type_dict, pipeline=pipeline)
         self._data_dtype = np.dtype(
             self._predictor._exec.arg_dict[data_name].dtype)
         self.stats = ServeStats(name, self.max_batch_size)

@@ -23,7 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, _AttrDict
 from .attribute import AttrScope
 from .name import NameManager
 from .ops import get_op, list_ops, OpDef
@@ -101,8 +101,15 @@ def id_valued_inputs(symbol: "Symbol") -> set:
 class Symbol:
     """Symbol = list of output heads over a shared DAG."""
 
-    def __init__(self, heads: Sequence[Tuple[_Node, int]]):
+    def __init__(self, heads: Sequence[Tuple[_Node, int]],
+                 graph_attrs: Optional[Dict[str, str]] = None):
         self._heads: List[Tuple[_Node, int]] = list(heads)
+        # graph-LEVEL attrs (vs per-node attrs): serialized into the json
+        # "attrs" block and restored by load_json.  mxnet_tpu.passes stamps
+        # the pipeline fingerprint here (``__passes__``) so a transformed
+        # symbol's identity — and through tojson, its compile-cache fast
+        # key — can never alias the untransformed graph's.
+        self._graph_attrs: Dict[str, str] = dict(graph_attrs or {})
 
     # -- composition --------------------------------------------------------
     def __call__(self, *args, **kwargs):
@@ -138,10 +145,15 @@ class Symbol:
         """Deep copy of the reachable graph."""
         mapping: Dict[int, _Node] = {}
         for node in _topo(self._heads):
-            new = _Node(node.op, node.name, dict(node.params), dict(node.attrs),
+            # params must stay an _AttrDict: op infer_shape/forward read
+            # them as attributes, and a plain dict() copy used to make
+            # every copied/composed symbol unbindable
+            new = _Node(node.op, node.name, _AttrDict(node.params),
+                        dict(node.attrs),
                         [(mapping[id(i)], x) for (i, x) in node.inputs], node.is_aux)
             mapping[id(node)] = new
-        return Symbol([(mapping[id(n)], i) for (n, i) in self._heads])
+        return Symbol([(mapping[id(n)], i) for (n, i) in self._heads],
+                      graph_attrs=self._graph_attrs)
 
     def __deepcopy__(self, memo=None):
         return self.__copy__()
@@ -221,7 +233,7 @@ class Symbol:
             else:
                 for i in range(node.num_outputs()):
                     heads.append((node, i))
-        return Symbol(heads)
+        return Symbol(heads, graph_attrs=self._graph_attrs)
 
     def __getitem__(self, index) -> "Symbol":
         if isinstance(index, str):
@@ -231,7 +243,7 @@ class Symbol:
             index = names.index(index)
         if not isinstance(index, int):
             raise TypeError("index must be int or str")
-        return Symbol([self._heads[index]])
+        return Symbol([self._heads[index]], graph_attrs=self._graph_attrs)
 
     def __len__(self):
         return len(self._heads)
@@ -378,8 +390,10 @@ class Symbol:
                     "inputs": [[idx[id(i)], x] for (i, x) in n.inputs]})
         heads = [[idx[id(n)], i] for (n, i) in self._heads]
         arg_nodes = [i for i, n in enumerate(nodes) if n.is_variable]
+        attrs = {"mxnet_tpu_version": 1}
+        attrs.update(self._graph_attrs)
         return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
-                           "heads": heads, "attrs": {"mxnet_tpu_version": 1}},
+                           "heads": heads, "attrs": attrs},
                           indent=2)
 
     def save(self, fname: str) -> None:
@@ -457,11 +471,13 @@ var = Variable
 def Group(symbols: Sequence[Symbol]) -> Symbol:
     """Group symbols into one multi-output symbol (reference symbol.py Group)."""
     heads = []
+    gattrs: Dict[str, str] = {}
     for s in symbols:
         if not isinstance(s, Symbol):
             raise TypeError("Expected Symbol in Group")
         heads.extend(s._heads)
-    return Symbol(heads)
+        gattrs.update(s._graph_attrs)
+    return Symbol(heads, graph_attrs=gattrs)
 
 
 def load(fname: str) -> Symbol:
@@ -483,9 +499,9 @@ def load_json(json_str: str) -> Symbol:
             nodes.append(_Node(op, jn["name"], params=params,
                                attrs=jn.get("attr", {}), inputs=inputs))
     heads = [(nodes[i], x) for (i, x) in data["heads"]]
-    # mark aux variables
-    sym = Symbol(heads)
-    return sym
+    graph_attrs = {k: v for k, v in (data.get("attrs") or {}).items()
+                   if k != "mxnet_tpu_version"}
+    return Symbol(heads, graph_attrs=graph_attrs)
 
 
 # ---------------------------------------------------------------------------

@@ -55,6 +55,15 @@ DEFAULT_IGNORE = {
     "vs_baseline",
 }
 
+# metrics where SMALLER is better, gated in that direction by default
+# (merged with --lower-is-better): latencies, padding waste, and the
+# quantized-serving accuracy delta (ISSUE 9: a growing top-1 delta is a
+# quantization-quality regression even when its qps improves)
+DEFAULT_LOWER_IS_BETTER = {
+    "serve_p50_ms", "serve_p99_ms", "serve_pad_waste_frac",
+    "serve_quant_top1_delta",
+}
+
 
 class GateError(Exception):
     """The gate cannot run at all (distinct from exit 1 = regression):
@@ -206,8 +215,9 @@ def main(argv=None) -> int:
                     help="comma-separated keys to add to the default "
                          "ignore set")
     ap.add_argument("--lower-is-better", default=None,
-                    help="comma-separated keys where smaller is better "
-                         "(latency metrics)")
+                    help="comma-separated keys where smaller is better, "
+                         "merged with the built-in latency/accuracy-delta "
+                         "defaults")
     args = ap.parse_args(argv)
 
     def split(s):
@@ -222,7 +232,9 @@ def main(argv=None) -> int:
     try:
         rows, regressions, newest, priors = gate(
             runs, threshold=args.threshold, metrics=split(args.metrics),
-            ignore=ignore, lower_is_better=set(split(args.lower_is_better)))
+            ignore=ignore,
+            lower_is_better=(DEFAULT_LOWER_IS_BETTER
+                             | set(split(args.lower_is_better))))
     except GateError as e:
         print(str(e), file=sys.stderr)
         return 2

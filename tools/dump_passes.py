@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Print the before/after graph per optimization pass (mxnet_tpu.passes).
+
+The pass-regression debugging loop: when a pipeline produces a wrong or
+slow graph, this shows exactly which pass did what — node counts, the
+nodes each pass folded/merged/removed, the q/dq pairs quantization
+inserted, and the per-pass wall time::
+
+    python tools/dump_passes.py model-symbol.json
+    python tools/dump_passes.py model-symbol.json --params model-0001.params
+    python tools/dump_passes.py model-symbol.json --params model-0001.params \
+        --quantize int8 --calib-npy sample.npy --data-shape 8,3,224,224
+    python tools/dump_passes.py model-symbol.json --u8-wire --diff
+    python tools/dump_passes.py model-symbol.json --out-prefix /tmp/stage
+
+``--diff`` prints a per-pass op-census delta (which op counts changed);
+``--out-prefix`` writes ``<prefix>.<NN>.<pass>.json`` after every stage
+so two pipeline versions can be diffed offline with plain ``diff``.
+
+Without ``--params`` the structural passes still run (param-subgraph
+folding and quantization need the blob and are skipped loudly).
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def op_census(sym) -> "collections.Counter":
+    doc = json.loads(sym.tojson())
+    return collections.Counter(n["op"] for n in doc["nodes"])
+
+
+def census_delta(before, after) -> str:
+    parts = []
+    for op in sorted(set(before) | set(after)):
+        d = after.get(op, 0) - before.get(op, 0)
+        if d:
+            parts.append("%s%+d %s" % ("", d, op))
+    return ", ".join(parts) or "(no op-census change)"
+
+
+def summarize(summary: dict) -> str:
+    """One line per interesting summary key, lists truncated."""
+    lines = []
+    for k in sorted(summary):
+        if k == "type_overrides":
+            continue
+        v = summary[k]
+        if isinstance(v, list):
+            shown = ", ".join(map(str, v[:8]))
+            if len(v) > 8:
+                shown += ", ... +%d more" % (len(v) - 8)
+            lines.append("    %s (%d): %s" % (k, len(v), shown or "-"))
+        else:
+            lines.append("    %s: %s" % (k, v))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("symbol", help="symbol json file (tojson/save output)")
+    ap.add_argument("--params", help="param blob (save_checkpoint .params); "
+                                     "enables param folding + quantization")
+    ap.add_argument("--quantize", default=None,
+                    help="int8|float16|bfloat16 (int8 needs --calib-npy)")
+    ap.add_argument("--calib-npy",
+                    help=".npy of calibration items (wire format, "
+                         "item-stacked; batched per --data-shape)")
+    ap.add_argument("--data-shape", default=None,
+                    help="comma shape WITH batch dim for calibration "
+                         "binding, e.g. 8,3,224,224")
+    ap.add_argument("--data-name", default="data")
+    ap.add_argument("--u8-wire", action="store_true",
+                    help="insert the uint8 cast/normalize wire prologue")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="skip per-pass round-trip/attr verification")
+    ap.add_argument("--diff", action="store_true",
+                    help="print the per-pass op-census delta")
+    ap.add_argument("--out-prefix", default=None,
+                    help="write <prefix>.<NN>.<pass>.json after each pass")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu import passes
+    from mxnet_tpu.predictor import load_ndarray_file
+    from mxnet_tpu.symbol import load_json
+
+    with open(args.symbol) as f:
+        sym = load_json(f.read())
+    params = None
+    if args.params:
+        params = {k: v.asnumpy()
+                  for k, v in load_ndarray_file(args.params).items()}
+    elif args.quantize:
+        print("dump_passes: --quantize needs --params (weights are "
+              "pre-quantized host-side)", file=sys.stderr)
+        return 2
+
+    q_pass = None
+    if args.quantize:
+        kw = {"dtype": args.quantize, "data_name": args.data_name}
+        if args.calib_npy:
+            import numpy as np
+            kw["calib_data"] = np.load(args.calib_npy)
+            if not args.data_shape:
+                print("dump_passes: --calib-npy needs --data-shape",
+                      file=sys.stderr)
+                return 2
+            kw["calib_shapes"] = {args.data_name: tuple(
+                int(x) for x in args.data_shape.split(","))}
+        q_pass = kw
+    pipe = passes.build_serving_pipeline(
+        quantize=q_pass, data_name=args.data_name,
+        u8_wire=args.u8_wire or None, name="dump")
+    pipe.verify = not args.no_verify
+
+    census = op_census(sym)
+    print("input graph: %d nodes — %s"
+          % (sum(census.values()),
+             ", ".join("%dx %s" % (c, op)
+                       for op, c in census.most_common())))
+
+    # run pass-by-pass so each stage can be censused/dumped individually
+    out_sym, out_params = sym, params
+    for i, p in enumerate(pipe.passes):
+        stage = passes.PassPipeline([p], name="dump:%s" % p.name,
+                                    verify=pipe.verify)
+        before = op_census(out_sym)
+        try:
+            out_sym, out_params = stage.run(out_sym, out_params)
+        except passes.PassError as e:
+            print("\n[%d] %-16s FAILED: %s" % (i, p.name, e))
+            return 1
+        rep = stage.last_report[0]
+        after = op_census(out_sym)
+        print("\n[%d] %-16s %d -> %d nodes, %s rewrites, %.1f ms"
+              % (i, p.name, rep["nodes_in"], rep["nodes_out"],
+                 rep["summary"].get("rewrites", 0), rep["wall_s"] * 1e3))
+        detail = summarize(rep["summary"])
+        if detail:
+            print(detail)
+        if args.diff:
+            print("    op census: %s" % census_delta(before, after))
+        if args.out_prefix:
+            path = "%s.%02d.%s.json" % (args.out_prefix, i, p.name)
+            with open(path, "w") as f:
+                f.write(out_sym.tojson())
+            print("    wrote %s" % path)
+
+    print("\npipeline fingerprint: %s" % pipe.fingerprint())
+    roundtrip = passes.verify_roundtrip(out_sym, label="final graph")
+    problems = passes.diff_attrs(sym, roundtrip)
+    if problems:
+        print("ATTR REGRESSIONS vs input graph:")
+        for p in problems[:20]:
+            print("  " + p)
+        return 1
+    print("final graph round-trips; node attrs preserved")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
