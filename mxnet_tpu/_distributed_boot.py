@@ -16,7 +16,8 @@ def ensure() -> None:
     global _done
     if _done:
         return
-    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    from .base import get_env
+    coord = get_env("MXNET_TPU_COORDINATOR")
     if coord is None:
         _done = True
         return
@@ -24,6 +25,9 @@ def ensure() -> None:
     try:
         jax.distributed.initialize(
             coordinator_address=coord,
+            # lint: allow(raw-env) — rendezvous vars are a set: once
+            # the coordinator is present, a missing peer var is a broken
+            # launcher and must KeyError loudly, not default
             num_processes=int(os.environ["MXNET_TPU_NUM_WORKERS"]),
             process_id=int(os.environ["MXNET_TPU_WORKER_ID"]))
     except RuntimeError as e:

@@ -1,0 +1,27 @@
+"""mxnet_tpu.analysis — project-specific static + runtime bug detectors.
+
+Three layers, all enforced tier-1 (docs/analysis.md):
+
+* **Static lint** (`linter.py`): AST rules distilled from this repo's
+  CHANGES.md bug archaeology — donated-buffer host aliasing, raw
+  ``jax.jit`` outside the compile cache, raw env reads, wall-clock
+  timing arithmetic, fork-hostile global RNG draws, raw future
+  settlement.  Run via ``tools/lint.py`` (inline suppressions with
+  reasons, checked-in baseline, ``--diff`` fast path).
+* **Lock-order recorder** (`lockcheck.py`): ``base.make_lock(name)``
+  builds the per-process acquired-while-holding graph and reports
+  cycles — potential deadlocks — on any schedule that exercises both
+  orders (``MXNET_LOCK_CHECK=1``).
+* **Leak guard** (`leakguard.py` + `pytest_plugin.py`): fails any test
+  module leaving stray threads or child processes behind.
+"""
+from . import linter
+from .leakguard import check as check_leaks
+from .leakguard import snapshot as leak_snapshot
+from .linter import Finding, lint_paths, lint_source
+from .lockcheck import (cycles, lock_order_report, make_condition,
+                        make_lock, make_rlock)
+
+__all__ = ["linter", "Finding", "lint_paths", "lint_source",
+           "make_lock", "make_rlock", "make_condition", "cycles",
+           "lock_order_report", "leak_snapshot", "check_leaks"]

@@ -17,7 +17,8 @@ import numpy as np
 
 __all__ = ["MXNetError", "string_types", "numeric_types", "mx_uint", "mx_float",
            "get_env", "c_array", "MXNetTPUError", "atomic_local_write",
-           "fsync_dir", "is_local_path", "local_path"]
+           "fsync_dir", "is_local_path", "local_path", "make_lock",
+           "make_rlock", "make_condition"]
 
 
 class MXNetError(Exception):
@@ -37,6 +38,8 @@ mx_float = float
 
 def get_env(name: str, default: Any = None, typ: Callable = str) -> Any:
     """dmlc::GetEnv equivalent (reference: docs/how_to/env_var.md)."""
+    # lint: allow(raw-env) — this IS the accessor every other read routes
+    # through; the rule exists to funnel reads here
     val = os.environ.get(name)
     if val is None:
         return default
@@ -46,6 +49,34 @@ def get_env(name: str, default: Any = None, typ: Callable = str) -> Any:
         return typ(val)
     except (TypeError, ValueError):
         return default
+
+
+def make_lock(name: str):
+    """Named ``threading.Lock`` for the lock-order recorder.
+
+    Every lock in mxnet_tpu is created through this factory (or
+    :func:`make_rlock` / :func:`make_condition`).  ``name`` is the lock
+    CLASS — ``"serve.swap"`` names every engine's swap lock, not one
+    instance — dotted ``subsystem.role``.  With ``MXNET_LOCK_CHECK=1``
+    the returned lock records the per-process acquisition graph and
+    reports order cycles (potential deadlocks) via
+    ``mxnet_tpu.analysis.lockcheck``; otherwise it is a plain
+    ``threading.Lock`` with zero overhead."""
+    from .analysis.lockcheck import make_lock as _mk
+    return _mk(name)
+
+
+def make_rlock(name: str):
+    """Named ``threading.RLock`` (see :func:`make_lock`)."""
+    from .analysis.lockcheck import make_rlock as _mk
+    return _mk(name)
+
+
+def make_condition(name: str):
+    """Named ``threading.Condition`` (see :func:`make_lock`);
+    ``wait`` correctly releases the name in the order model."""
+    from .analysis.lockcheck import make_condition as _mk
+    return _mk(name)
 
 
 def open_stream(fname: str, mode: str = "r"):

@@ -19,9 +19,11 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from .base import make_lock
+
 _TABLE: Dict[int, Any] = {}
 _NEXT = [1]
-_LOCK = threading.Lock()
+_LOCK = make_lock("capi_bridge.handles")
 
 # reference dtype codes (mshadow type flags used across the C ABI)
 _DTYPE_TO_CODE = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,

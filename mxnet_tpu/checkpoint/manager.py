@@ -31,7 +31,7 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from .. import trace as _trace
-from ..base import MXNetError
+from ..base import MXNetError, make_lock
 from . import layout
 from .sharded import flatten_state, merge_indexes, read_leaf, write_leaf
 from .snapshot import AsyncWriter, snapshot_tree
@@ -47,7 +47,7 @@ class CheckpointStats:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("checkpoint.manager")
         self._c: Dict[str, float] = {
             "saves_started": 0, "saves_committed": 0, "save_failures": 0,
             "restores": 0, "last_step": -1,

@@ -191,6 +191,9 @@ def _restore_fused(module, tree: Dict, meta: Dict) -> None:
     if kd is not None:
         if module._fused._multiprocess():
             import jax
+            # lint: allow(donated-aliasing) — the RNG key is a step
+            # INPUT, never donated (donation covers state arg 0 only),
+            # so aliasing the local kd buffer is safe
             key = jax.random.wrap_key_data(
                 jax.device_put(kd, module._fused._replicated()))
         else:

@@ -23,6 +23,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, List, Optional
 
+from ..base import make_rlock
+
 __all__ = ["snapshot_tree", "AsyncWriter"]
 
 
@@ -78,7 +80,7 @@ class AsyncWriter:
         # the same thread — a plain Lock would self-deadlock and eat the
         # preemption grace period (Condition handles RLock re-entrancy
         # via _release_save/_acquire_restore)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("checkpoint.async_writer")
         self._cv = threading.Condition(self._lock)
         self._error: Optional[BaseException] = None
         self._closed = False

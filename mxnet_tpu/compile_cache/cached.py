@@ -37,7 +37,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import trace as _trace
-from ..base import get_env
+from ..base import get_env, make_lock
 from .fingerprint import (environment_fingerprint,
                           fast_key as _fast_key_of, program_key)
 from .stats import get_stats
@@ -54,7 +54,7 @@ class _CacheEntryInvalid(Exception):
     handled by falling back to a fresh compile."""
 
 
-_nocache_lock = threading.Lock()
+_nocache_lock = make_lock("compile_cache.nocache")
 _nocache_depth = 0
 _nocache_prev = True
 
@@ -529,7 +529,7 @@ class CompileCache:
 
 _cache: Optional[CompileCache] = None
 _cache_resolved = False
-_cache_lock = threading.Lock()
+_cache_lock = make_lock("compile_cache.configure")
 
 
 def get_cache() -> Optional[CompileCache]:
@@ -541,7 +541,7 @@ def get_cache() -> Optional[CompileCache]:
     with _cache_lock:
         if _cache_resolved:
             return _cache
-        d = (os.environ.get("MXNET_COMPILE_CACHE") or "").strip()
+        d = (get_env("MXNET_COMPILE_CACHE") or "").strip()
         cache = None
         if d:
             try:
@@ -628,7 +628,7 @@ class CachedFunction:
         self._entries: Dict[Tuple, Any] = {}
         self._last: Optional[Tuple[Tuple, Any]] = None
         self._called = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("compile_cache.cached_fn")
 
     @property
     def has_compiled(self) -> bool:

@@ -61,6 +61,9 @@ def environment_fingerprint(refresh: bool = False) -> str:
         "processes=%d" % jax.process_count(),
     ]
     for name in COMPILE_RELEVANT_ENV:
+        # lint: allow(raw-env) — hashes the raw env VALUE bytes into the
+        # compile key; get_env's typed defaults would fold unset into
+        # default and alias distinct compile configurations
         parts.append("%s=%s" % (name, os.environ.get(name, "")))
     _env_fp_cache = ";".join(parts)
     return _env_fp_cache

@@ -18,6 +18,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from ..base import make_lock
+
 
 class _ProgramStats:
     __slots__ = ("trace_lower_s", "compile_s", "deserialize_s", "hits",
@@ -53,7 +55,7 @@ class CompileStats:
 
     def __init__(self, name: str = "compile"):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("compile_cache.stats")
         self._programs: Dict[str, _ProgramStats] = {}
         self.bytes_written = 0
         self.entries_written = 0
@@ -155,7 +157,7 @@ class CompileStats:
 
 
 _global_stats: Optional[CompileStats] = None
-_stats_lock = threading.Lock()
+_stats_lock = make_lock("compile_cache.stats_registry")
 
 
 def get_stats() -> CompileStats:

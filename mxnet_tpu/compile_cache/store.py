@@ -25,7 +25,7 @@ import pickle
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..base import atomic_local_write
+from ..base import atomic_local_write, make_lock
 from .fingerprint import blob_digest
 
 logger = logging.getLogger(__name__)
@@ -33,7 +33,7 @@ logger = logging.getLogger(__name__)
 META_VERSION = 2
 
 _warned: set = set()
-_warned_lock = threading.Lock()
+_warned_lock = make_lock("compile_cache.store_warned")
 
 
 def warn_once(category: str, msg: str) -> None:
@@ -57,7 +57,7 @@ class CacheStore:
     def __init__(self, directory: str, size_mb: float):
         self.directory = os.path.abspath(directory)
         self.size_bytes = int(float(size_mb) * 1024 * 1024)
-        self._lock = threading.Lock()
+        self._lock = make_lock("compile_cache.store")
         os.makedirs(self.directory, exist_ok=True)
 
     # -- paths -------------------------------------------------------------

@@ -37,7 +37,7 @@ from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import jax
 
-from .base import get_env
+from .base import get_env, make_lock
 
 __all__ = ["Engine", "engine", "naive_mode", "wait_for_all", "track"]
 
@@ -61,7 +61,7 @@ class Engine:
         # weak references to recently produced arrays, for WaitForAll.
         self._pending: "weakref.WeakSet" = weakref.WeakSet()
         self._native = None  # lazily-created C++ engine for host closures
-        self._native_lock = threading.Lock()
+        self._native_lock = make_lock("engine.native")
 
     # -- native host-side engine --------------------------------------------
     @property

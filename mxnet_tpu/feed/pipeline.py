@@ -26,6 +26,7 @@ import time
 from typing import Any, List, Optional, Sequence
 
 from .. import trace as _trace
+from ..base import make_lock
 from .stats import PipelineStats, StageStats
 
 __all__ = ["EndOfEpoch", "EndOfStream", "StageError", "QueueClosed",
@@ -86,7 +87,7 @@ class BoundedQueue:
         assert capacity >= 1
         self.capacity = capacity
         self._items: List[Any] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("feed.pipeline")
         self._not_full = threading.Condition(self._lock)
         self._not_empty = threading.Condition(self._lock)
         self._closed = False

@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..base import make_lock
+
 __all__ = ["StageStats", "PipelineStats"]
 
 
@@ -29,7 +31,7 @@ class StageStats:
 
     def __init__(self, name: str):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("feed.stats")
         self._items = 0
         self._busy_s = 0.0
         self._stall_in_s = 0.0

@@ -277,6 +277,8 @@ class KVStoreDistAsync(KVStore):
 
     @property
     def num_workers(self) -> int:
+        # lint: allow(raw-env) — DMLC rendezvous protocol var,
+        # reference semantics (launcher-owned, not a user knob)
         return int(os.environ.get("DMLC_NUM_WORKER", "1"))
 
     def init(self, key, value):
@@ -346,6 +348,7 @@ def create(name: str = "local") -> KVStore:
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     name_l = name.lower()
+    # lint: allow(raw-env) — DMLC rendezvous presence probe
     if name_l == "dist_async" and os.environ.get("DMLC_PS_ROOT_URI"):
         return KVStoreDistAsync(name)
     if name_l.startswith("dist"):

@@ -10,7 +10,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from .. import metric as metric_mod
 from .. import io as mx_io
 from .. import trace as _trace
@@ -332,273 +332,283 @@ class BaseModule:
             # caller's decision to train again
             ckpt_mgr.preempted = False
 
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+        # a manager fit constructed from a bare path is fit's to close:
+        # its async-writer thread must not outlive this call (the tier-1
+        # leak guard flags exactly that); a caller-supplied manager stays
+        # the caller's resource
+        _owns_ckpt_mgr = ckpt_mgr is not None and \
+            not isinstance(checkpoint, CheckpointManager)
+        try:
+            if validation_metric is None:
+                validation_metric = eval_metric
+            if not isinstance(eval_metric, metric_mod.EvalMetric):
+                eval_metric = metric_mod.create(eval_metric)
 
-        # superstep resolution: K from the argument or the env knob,
-        # then every semantic blocker gets a logged fallback to K=1
-        k_super = int(superstep) if superstep is not None \
-            else int(os.environ.get("MXNET_SUPERSTEP", "1") or "1")
-        k_super = max(1, k_super)
-        use_super = k_super > 1 and callable(
-            getattr(self, "superstep_train", None))
-        if k_super > 1 and not use_super:
-            self.logger.info("superstep disabled (K=%d -> 1): module has "
-                             "no fused superstep support", k_super)
-        if use_super:
-            blocker = self._superstep_blockers(
-                eval_metric, k_super, monitor=monitor,
-                batch_end_callback=batch_end_callback,
-                checkpoint_every=(ckpt_mgr.save_every_steps
-                                  if ckpt_mgr is not None else None))
-            if blocker is not None:
-                self.logger.info("superstep disabled (K=%d -> 1): %s",
-                                 k_super, blocker)
-                use_super = False
+            # superstep resolution: K from the argument or the env knob,
+            # then every semantic blocker gets a logged fallback to K=1
+            k_super = int(superstep) if superstep is not None \
+                else get_env("MXNET_SUPERSTEP", 1, int)
+            k_super = max(1, k_super)
+            use_super = k_super > 1 and callable(
+                getattr(self, "superstep_train", None))
+            if k_super > 1 and not use_super:
+                self.logger.info("superstep disabled (K=%d -> 1): module has "
+                                 "no fused superstep support", k_super)
+            if use_super:
+                blocker = self._superstep_blockers(
+                    eval_metric, k_super, monitor=monitor,
+                    batch_end_callback=batch_end_callback,
+                    checkpoint_every=(ckpt_mgr.save_every_steps
+                                      if ckpt_mgr is not None else None))
+                if blocker is not None:
+                    self.logger.info("superstep disabled (K=%d -> 1): %s",
+                                     k_super, blocker)
+                    use_super = False
 
-        if prefetch_to_device and hasattr(self, "prefetch_to_device"):
-            # wrap AFTER init_optimizer so the fused step's batch sharding
-            # exists and staged batches land directly in its input layout;
-            # in superstep mode the prefetcher assembles whole megabatches
-            # (stacked K axis) under the running superstep
-            depth = 2 if prefetch_to_device is True \
-                else max(1, int(prefetch_to_device))
-            train_data = self.prefetch_to_device(
-                train_data, depth=depth,
-                megabatch=k_super if use_super else 1)
+            if prefetch_to_device and hasattr(self, "prefetch_to_device"):
+                # wrap AFTER init_optimizer so the fused step's batch sharding
+                # exists and staged batches land directly in its input layout;
+                # in superstep mode the prefetcher assembles whole megabatches
+                # (stacked K axis) under the running superstep
+                depth = 2 if prefetch_to_device is True \
+                    else max(1, int(prefetch_to_device))
+                train_data = self.prefetch_to_device(
+                    train_data, depth=depth,
+                    megabatch=k_super if use_super else 1)
 
-        # each fit journals independently: a later fit restarting from
-        # step 1 in the same process must not be muted by the previous
-        # run's high-water step
-        _trace.reset_journal()
-        global_step = 0
-        start_epoch, start_batch = begin_epoch, 0
-        if ckpt_mgr is not None and resume:
-            meta = restore_module(ckpt_mgr, self)
-            if meta is not None:
-                global_step = int(meta.get("global_step", 0))
-                start_epoch = int(meta.get("epoch", begin_epoch))
-                start_batch = int(meta.get("nbatch", 0))
-                feed_state = meta.get("feed")
-                if feed_state is not None and \
-                        callable(getattr(train_data, "restore", None)):
-                    train_data.restore(feed_state)
-                elif start_batch:
-                    if callable(getattr(train_data, "restore", None)):
-                        # a cursor-less checkpoint resumed into a feed
-                        # wrapper (e.g. prefetch added after the save):
-                        # its restore() skips UNDERLYING batches exactly,
-                        # where next() would pop whole megabatches
-                        train_data.restore({"batch": start_batch})
-                    else:
-                        # generic DataIter: fast-forward by discarding
-                        # the already-trained batches (counting the
-                        # batches a megabatch carries)
-                        skipped = 0
-                        while skipped < start_batch:
+            # each fit journals independently: a later fit restarting from
+            # step 1 in the same process must not be muted by the previous
+            # run's high-water step
+            _trace.reset_journal()
+            global_step = 0
+            start_epoch, start_batch = begin_epoch, 0
+            if ckpt_mgr is not None and resume:
+                meta = restore_module(ckpt_mgr, self)
+                if meta is not None:
+                    global_step = int(meta.get("global_step", 0))
+                    start_epoch = int(meta.get("epoch", begin_epoch))
+                    start_batch = int(meta.get("nbatch", 0))
+                    feed_state = meta.get("feed")
+                    if feed_state is not None and \
+                            callable(getattr(train_data, "restore", None)):
+                        train_data.restore(feed_state)
+                    elif start_batch:
+                        if callable(getattr(train_data, "restore", None)):
+                            # a cursor-less checkpoint resumed into a feed
+                            # wrapper (e.g. prefetch added after the save):
+                            # its restore() skips UNDERLYING batches exactly,
+                            # where next() would pop whole megabatches
+                            train_data.restore({"batch": start_batch})
+                        else:
+                            # generic DataIter: fast-forward by discarding
+                            # the already-trained batches (counting the
+                            # batches a megabatch carries)
+                            skipped = 0
+                            while skipped < start_batch:
+                                try:
+                                    b = train_data.next()
+                                except StopIteration:
+                                    break
+                                skipped += getattr(b, "megabatch", 1)
+                    self.logger.info(
+                        "resumed from checkpoint step %d: epoch %d, batch %d",
+                        global_step, start_epoch, start_batch)
+
+            last_saved_step = [-1]
+
+            def ckpt_save(epoch_, nbatch_, blocking=False):
+                meta = {"global_step": global_step, "epoch": epoch_,
+                        "nbatch": nbatch_}
+                if callable(getattr(train_data, "state", None)):
+                    meta["feed"] = train_data.state()
+                save_module(ckpt_mgr, self, global_step, meta=meta,
+                            blocking=blocking)
+                last_saved_step[0] = global_step
+
+            for epoch in range(start_epoch, num_epoch):
+                tic = time.perf_counter()
+                eval_metric.reset()
+                nbatch = start_batch if epoch == start_epoch else 0
+                preempted = False
+
+                def fire_batch_end(nb, loc=None):
+                    # merge the call site's locals: per-batch sites expose
+                    # 'data_batch' like the reference loop did; the
+                    # superstep site fires once per K and exposes the whole
+                    # 'group' instead (a callback needing per-batch locals
+                    # should declare inspects_outputs=True, which forces
+                    # K=1)
+                    loc = dict(loc or {})
+                    loc.setdefault("self", self)
+                    loc.setdefault("epoch", epoch)
+                    loc.setdefault("nbatch", nb)
+                    loc.setdefault("eval_metric", eval_metric)
+                    _fire_callbacks(batch_end_callback,
+                                    BatchEndParam(epoch=epoch, nbatch=nb,
+                                                  eval_metric=eval_metric,
+                                                  locals=loc))
+
+                def advance(count, allow_ckpt=True, ckpt_from=None):
+                    """Bookkeeping after ``count`` trained batches: counters
+                    + checkpoint cadence.  True => leave fit (preemption).
+                    ``allow_ckpt=False`` suppresses saves at an unsafe point
+                    (mid-way through an unstacked megabatch, where the feed
+                    cursor already counted the whole group); ``ckpt_from``
+                    re-bases the save-crossing check to the group's first
+                    step so a suppressed crossing still saves at its end."""
+                    nonlocal nbatch, global_step, preempted
+                    prev_step = global_step if ckpt_from is None else ckpt_from
+                    nbatch += count
+                    global_step += count
+                    # run-metrics journal (MXNET_TRACE_JOURNAL): one unified-
+                    # report JSONL line every N global steps; a no-op (one
+                    # env lookup) when the knob is unset
+                    _trace.maybe_journal_step(global_step, epoch=epoch,
+                                              nbatch=nbatch)
+                    if not allow_ckpt:
+                        return False
+                    if ckpt_mgr is not None:
+                        if ckpt_mgr.preempted:
+                            # SIGTERM: snapshot at this safe batch boundary,
+                            # flush, and leave the loop (snapshot-then-exit)
+                            ckpt_save(epoch, nbatch, blocking=True)
+                            ckpt_mgr.wait()
+                            self.logger.info(
+                                "preempted: checkpoint committed at step %d "
+                                "(epoch %d, batch %d); exiting fit",
+                                global_step, epoch, nbatch)
+                            preempted = True
+                            return True
+                        # save when (prev_step, global_step] crosses a
+                        # save_every multiple — for count=1 that is exactly
+                        # should_save(); for a K-step jump it keeps the
+                        # cadence alive even after a partial tail or a
+                        # resume leaves global_step off the K-aligned
+                        # residue class (a bare `step % every == 0` would
+                        # then never fire again)
+                        every = ckpt_mgr.save_every_steps
+                        if every and global_step // every > prev_step // every:
+                            ckpt_save(epoch, nbatch)
+                    return False
+
+                def train_one(data_batch, allow_ckpt=True, ckpt_from=None):
+                    """The reference per-batch body (the K=1 path)."""
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    fire_batch_end(nbatch, locals())
+                    return advance(1, allow_ckpt=allow_ckpt,
+                                   ckpt_from=ckpt_from)
+
+                if use_super:
+                    # pull K batches (or one prefetch-assembled megabatch)
+                    # per iteration and run them as ONE dispatch; a partial
+                    # tail or a mid-training fallback (hparams mutated,
+                    # fusion disabled) trains per-batch instead
+                    data_iter = iter(train_data)
+                    while not preempted:
+                        mega, pulled = None, []
+                        while len(pulled) < k_super:
                             try:
-                                b = train_data.next()
+                                b = next(data_iter)
                             except StopIteration:
                                 break
-                            skipped += getattr(b, "megabatch", 1)
-                self.logger.info(
-                    "resumed from checkpoint step %d: epoch %d, batch %d",
-                    global_step, start_epoch, start_batch)
-
-        last_saved_step = [-1]
-
-        def ckpt_save(epoch_, nbatch_, blocking=False):
-            meta = {"global_step": global_step, "epoch": epoch_,
-                    "nbatch": nbatch_}
-            if callable(getattr(train_data, "state", None)):
-                meta["feed"] = train_data.state()
-            save_module(ckpt_mgr, self, global_step, meta=meta,
-                        blocking=blocking)
-            last_saved_step[0] = global_step
-
-        for epoch in range(start_epoch, num_epoch):
-            tic = time.perf_counter()
-            eval_metric.reset()
-            nbatch = start_batch if epoch == start_epoch else 0
-            preempted = False
-
-            def fire_batch_end(nb, loc=None):
-                # merge the call site's locals: per-batch sites expose
-                # 'data_batch' like the reference loop did; the
-                # superstep site fires once per K and exposes the whole
-                # 'group' instead (a callback needing per-batch locals
-                # should declare inspects_outputs=True, which forces
-                # K=1)
-                loc = dict(loc or {})
-                loc.setdefault("self", self)
-                loc.setdefault("epoch", epoch)
-                loc.setdefault("nbatch", nb)
-                loc.setdefault("eval_metric", eval_metric)
-                _fire_callbacks(batch_end_callback,
-                                BatchEndParam(epoch=epoch, nbatch=nb,
-                                              eval_metric=eval_metric,
-                                              locals=loc))
-
-            def advance(count, allow_ckpt=True, ckpt_from=None):
-                """Bookkeeping after ``count`` trained batches: counters
-                + checkpoint cadence.  True => leave fit (preemption).
-                ``allow_ckpt=False`` suppresses saves at an unsafe point
-                (mid-way through an unstacked megabatch, where the feed
-                cursor already counted the whole group); ``ckpt_from``
-                re-bases the save-crossing check to the group's first
-                step so a suppressed crossing still saves at its end."""
-                nonlocal nbatch, global_step, preempted
-                prev_step = global_step if ckpt_from is None else ckpt_from
-                nbatch += count
-                global_step += count
-                # run-metrics journal (MXNET_TRACE_JOURNAL): one unified-
-                # report JSONL line every N global steps; a no-op (one
-                # env lookup) when the knob is unset
-                _trace.maybe_journal_step(global_step, epoch=epoch,
-                                          nbatch=nbatch)
-                if not allow_ckpt:
-                    return False
-                if ckpt_mgr is not None:
-                    if ckpt_mgr.preempted:
-                        # SIGTERM: snapshot at this safe batch boundary,
-                        # flush, and leave the loop (snapshot-then-exit)
-                        ckpt_save(epoch, nbatch, blocking=True)
-                        ckpt_mgr.wait()
-                        self.logger.info(
-                            "preempted: checkpoint committed at step %d "
-                            "(epoch %d, batch %d); exiting fit",
-                            global_step, epoch, nbatch)
-                        preempted = True
-                        return True
-                    # save when (prev_step, global_step] crosses a
-                    # save_every multiple — for count=1 that is exactly
-                    # should_save(); for a K-step jump it keeps the
-                    # cadence alive even after a partial tail or a
-                    # resume leaves global_step off the K-aligned
-                    # residue class (a bare `step % every == 0` would
-                    # then never fire again)
-                    every = ckpt_mgr.save_every_steps
-                    if every and global_step // every > prev_step // every:
-                        ckpt_save(epoch, nbatch)
-                return False
-
-            def train_one(data_batch, allow_ckpt=True, ckpt_from=None):
-                """The reference per-batch body (the K=1 path)."""
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                fire_batch_end(nbatch, locals())
-                return advance(1, allow_ckpt=allow_ckpt,
-                               ckpt_from=ckpt_from)
-
-            if use_super:
-                # pull K batches (or one prefetch-assembled megabatch)
-                # per iteration and run them as ONE dispatch; a partial
-                # tail or a mid-training fallback (hparams mutated,
-                # fusion disabled) trains per-batch instead
-                data_iter = iter(train_data)
-                while not preempted:
-                    mega, pulled = None, []
-                    while len(pulled) < k_super:
-                        try:
-                            b = next(data_iter)
-                        except StopIteration:
+                            if getattr(b, "megabatch", 0) > 1:
+                                mega = b
+                                break
+                            pulled.append(b)
+                        if mega is None and not pulled:
                             break
-                        if getattr(b, "megabatch", 0) > 1:
-                            mega = b
-                            break
-                        pulled.append(b)
-                    if mega is None and not pulled:
-                        break
-                    if pulled and (mega is not None
-                                   or len(pulled) < k_super):
-                        # plain batches that cannot form a full K — the
-                        # epoch tail, or stragglers ahead of an arriving
-                        # megabatch: train them per-batch, never drop.
-                        # They were all pulled from the iterator up
-                        # front, so a feed cursor already counts them —
-                        # defer saves to the group's end like the
-                        # unstacked-fallback below.
-                        start_step = global_step
-                        for i, b in enumerate(pulled):
-                            last = i == len(pulled) - 1
-                            if train_one(b, allow_ckpt=last,
-                                         ckpt_from=(start_step if last
-                                                    else None)):
+                        if pulled and (mega is not None
+                                       or len(pulled) < k_super):
+                            # plain batches that cannot form a full K — the
+                            # epoch tail, or stragglers ahead of an arriving
+                            # megabatch: train them per-batch, never drop.
+                            # They were all pulled from the iterator up
+                            # front, so a feed cursor already counts them —
+                            # defer saves to the group's end like the
+                            # unstacked-fallback below.
+                            start_step = global_step
+                            for i, b in enumerate(pulled):
+                                last = i == len(pulled) - 1
+                                if train_one(b, allow_ckpt=last,
+                                             ckpt_from=(start_step if last
+                                                        else None)):
+                                    return
+                            pulled = []
+                        group = mega if mega is not None else pulled
+                        if not group:
+                            continue
+                        count = mega.megabatch if mega is not None \
+                            else len(pulled)
+                        if self.superstep_train(group, eval_metric):
+                            fire_batch_end(nbatch + count - 1, locals())
+                            if advance(count):
                                 return
-                        pulled = []
-                    group = mega if mega is not None else pulled
-                    if not group:
-                        continue
-                    count = mega.megabatch if mega is not None \
-                        else len(pulled)
-                    if self.superstep_train(group, eval_metric):
-                        fire_batch_end(nbatch + count - 1, locals())
-                        if advance(count):
+                        else:
+                            # superstep refused (fused path gone / hparams
+                            # changed): K=1 fallback.  For an unstacked
+                            # megabatch the feed cursor already counted ALL
+                            # K batches, so a save fired mid-group would
+                            # resume past never-trained data — defer
+                            # preemption/save checks to the group's end (an
+                            # exact boundary again), re-basing the crossing
+                            # test so no save_every multiple is skipped.
+                            singles = mega.unstack() if mega is not None \
+                                else pulled
+                            start_step = global_step
+                            for i, b in enumerate(singles):
+                                last = i == len(singles) - 1
+                                if train_one(b, allow_ckpt=last,
+                                             ckpt_from=(start_step if last
+                                                        else None)):
+                                    return
+                else:
+                    for data_batch in train_data:
+                        if train_one(data_batch):
                             return
-                    else:
-                        # superstep refused (fused path gone / hparams
-                        # changed): K=1 fallback.  For an unstacked
-                        # megabatch the feed cursor already counted ALL
-                        # K batches, so a save fired mid-group would
-                        # resume past never-trained data — defer
-                        # preemption/save checks to the group's end (an
-                        # exact boundary again), re-basing the crossing
-                        # test so no save_every multiple is skipped.
-                        singles = mega.unstack() if mega is not None \
-                            else pulled
-                        start_step = global_step
-                        for i, b in enumerate(singles):
-                            last = i == len(singles) - 1
-                            if train_one(b, allow_ckpt=last,
-                                         ckpt_from=(start_step if last
-                                                    else None)):
-                                return
-            else:
-                for data_batch in train_data:
-                    if train_one(data_batch):
-                        return
-            if preempted:
-                return
+                if preempted:
+                    return
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.perf_counter()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
-            _trace.complete("fit:epoch", tic, toc - tic, cat="train",
-                            epoch=epoch, batches=nbatch)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+                toc = time.perf_counter()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+                _trace.complete("fit:epoch", tic, toc - tic, cat="train",
+                                epoch=epoch, batches=nbatch)
 
-            if epoch_end_callback is not None:
-                arg_params_, aux_params_ = self.get_params()
-                for callback in (epoch_end_callback
-                                 if isinstance(epoch_end_callback, list)
-                                 else [epoch_end_callback]):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+                if epoch_end_callback is not None:
+                    arg_params_, aux_params_ = self.get_params()
+                    for callback in (epoch_end_callback
+                                     if isinstance(epoch_end_callback, list)
+                                     else [epoch_end_callback]):
+                        callback(epoch, self.symbol, arg_params_, aux_params_)
 
-            if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+                if eval_data:
+                    res = self.score(eval_data, validation_metric,
+                                     batch_end_callback=eval_batch_end_callback,
+                                     epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
 
-            train_data.reset()
-            if ckpt_mgr is not None and last_saved_step[0] != global_step:
-                # epoch boundary: cursor points at the NEXT epoch's start.
-                # Skipped when the epoch's last batch already saved this
-                # global_step (an end-of-epoch cursor and a full-epoch
-                # cursor resume identically): re-committing the same step
-                # would rewrite the whole state AND briefly uncommit the
-                # newest checkpoint — a crash there loses it.
-                ckpt_save(epoch + 1, 0)
-        if ckpt_mgr is not None:
-            ckpt_mgr.wait()
+                train_data.reset()
+                if ckpt_mgr is not None and last_saved_step[0] != global_step:
+                    # epoch boundary: cursor points at the NEXT epoch's start.
+                    # Skipped when the epoch's last batch already saved this
+                    # global_step (an end-of-epoch cursor and a full-epoch
+                    # cursor resume identically): re-committing the same step
+                    # would rewrite the whole state AND briefly uncommit the
+                    # newest checkpoint — a crash there loses it.
+                    ckpt_save(epoch + 1, 0)
+            if ckpt_mgr is not None:
+                ckpt_mgr.wait()
+        finally:
+            if _owns_ckpt_mgr:
+                ckpt_mgr.close()
 
     # -- symbol -------------------------------------------------------------
     @property

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from ..executor import _GraphProgram
 from ..ndarray import NDArray
 from .. import trace as _trace
@@ -183,9 +183,8 @@ class FusedTrainStep:
         # (not the whole device set), composing with per-param tensor-
         # parallel specs — a dp=4 x tp=2 mesh shards each tp shard's
         # update 4 ways.
-        import os as _os
         self.shard_update = (
-            _os.environ.get("MXNET_SHARD_WEIGHT_UPDATE", "0") == "1"
+            get_env("MXNET_SHARD_WEIGHT_UPDATE", False, bool)
             and self.dp_size > 1)
         # on-device augmentation prologue (feed.AugmentSpec): when set,
         # uint8 HWC data batches are cast/cropped/flipped/normalized
@@ -369,6 +368,9 @@ class FusedTrainStep:
                     struct = jax.eval_shape(self._opt_init, w)
                     shardings = jax.tree_util.tree_map(
                         lambda x, _n=n: self._update_spec(x, _n), struct)
+                    # lint: allow(raw-jit) — one-shot init compile
+                    # per (shape, dtype, spec); out_shardings are LIVE
+                    # mesh objects, not serializable cache-key material
                     init_cache[key] = jax.jit(self._opt_init,
                                               out_shardings=shardings)
                 opt[n] = init_cache[key](w)
@@ -795,6 +797,9 @@ class FusedTrainStep:
         layout it cannot use."""
         if x is None:
             return None
+        # lint: allow(raw-jit) — trivial all-gather reshard with live
+        # out_shardings, built on the rare classic-fallback path; never a
+        # steady-state dispatch worth a disk entry
         gathered = jax.jit(lambda a: a,
                            out_shardings=self._replicated())(x)
         # materialize through host: the classic path mixes this with

@@ -9,7 +9,7 @@ import logging
 from typing import Dict, List, Optional, Sequence
 
 from .. import trace as _trace
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from ..context import Context, cpu, current_context
 from ..initializer import Uniform
 from ..ndarray import NDArray, zeros as nd_zeros
@@ -401,8 +401,7 @@ class Module(BaseModule):
         """Whether the batch body can run as one donated XLA program with
         reference semantics. Anything here that says no falls back to the
         classic executor-group + kvstore/updater path."""
-        import os
-        if os.environ.get("MXNET_FUSED_TRAIN", "1") == "0":
+        if not get_env("MXNET_FUSED_TRAIN", True, bool):
             return False
         if not self.for_training or self.inputs_need_grad:
             return False
@@ -447,9 +446,8 @@ class Module(BaseModule):
         self._fused_outputs = None
         self._superstep_progs = {}
         self._discard_speculation()
-        import os
         mesh = self._mesh
-        if mesh is None and os.environ.get("MXNET_MESH", "").strip():
+        if mesh is None and (get_env("MXNET_MESH", "") or "").strip():
             # MXNET_MESH="dp=4,tp=2": the env-knob spelling of set_mesh
             from ..parallel import mesh_from_env
             mesh = mesh_from_env()
@@ -476,10 +474,10 @@ class Module(BaseModule):
                     "bound batch size %d is not divisible by the mesh's "
                     "dp axis (%d); pick a batch the devices can slice "
                     "evenly" % (bs, dp))
-        remat = bool(int(os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0")))
+        remat = get_env("MXNET_BACKWARD_DO_MIRROR", False, bool)
         # MXNET_COMPUTE_DTYPE=bfloat16: bf16 fwd/bwd on the MXU with f32
         # master weights (the fp16-era capability mapped the TPU way)
-        cdt = os.environ.get("MXNET_COMPUTE_DTYPE") or None
+        cdt = get_env("MXNET_COMPUTE_DTYPE") or None
         try:
             gdp = (self._kvstore is not None
                    and "dist_sync" in self._kvstore.type)

@@ -18,7 +18,7 @@ import os
 import threading
 from typing import Callable, Optional, Sequence
 
-from .base import get_env
+from .base import get_env, make_lock
 
 __all__ = ["NativeEngine", "NativeStorage", "FnProperty", "VarHandle",
            "lib_available"]
@@ -112,7 +112,7 @@ def _load():
 
 
 _CLOSURES = {}
-_CLOSURES_LOCK = threading.Lock()
+_CLOSURES_LOCK = make_lock("native_engine.closures")
 _NEXT_TOKEN = [1]
 
 

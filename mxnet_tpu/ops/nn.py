@@ -107,8 +107,8 @@ class ConvolutionOp(OpDef):
 
     def forward(self, p, inputs, aux, ctx):
         x, w = inputs[0], inputs[1]
-        import os
-        if os.environ.get("MXNET_CONV_LAYOUT", "NCHW").upper() == "NHWC":
+        from ..base import get_env
+        if get_env("MXNET_CONV_LAYOUT", "NCHW").upper() == "NHWC":
             # channels-last lowering experiment (docs/perf.md records the
             # measurement): the API stays NCHW; the op transposes at its
             # boundary and XLA cancels back-to-back transposes through

@@ -158,7 +158,9 @@ class DPTrainStep:
             return ({"params": new_params, "aux": merged_aux, "mom": new_mom},
                     outs)
 
-        self._step = jax.jit(step, donate_argnums=(0,))
+        from ..compile_cache import cached_jit
+        self._step = cached_jit(step, name="parallel:dp_step",
+                                donate_argnums=(0,))
         return self._step
 
     def __call__(self, state, batch, rng=None):

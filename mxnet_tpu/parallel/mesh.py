@@ -78,8 +78,8 @@ def parse_mesh_spec(spec: str) -> List[Tuple[str, int]]:
 def mesh_from_env(devices=None) -> Optional[Mesh]:
     """Mesh from the ``MXNET_MESH`` env knob (``"dp=4,tp=2"``), or None
     when the knob is unset/empty."""
-    import os
-    spec = os.environ.get("MXNET_MESH", "").strip()
+    from ..base import get_env
+    spec = (get_env("MXNET_MESH", "") or "").strip()
     if not spec:
         return None
     return make_mesh(parse_mesh_spec(spec), devices=devices)

@@ -138,16 +138,15 @@ class GPipeTrainStep:
             h = outs.reshape(data.shape[0], *outs.shape[2:])
             return loss_fn(params["tail"], h, labels)
 
-        from functools import partial
-
-        @partial(jax.jit, donate_argnums=(0,))
         def step(params, data, labels):
             loss, grads = jax.value_and_grad(loss_of)(params, data, labels)
             new = jax.tree_util.tree_map(lambda w, g: w - lr * g,
                                          params, grads)
             return new, loss
 
-        return step
+        from ..compile_cache import cached_jit
+        return cached_jit(step, name="parallel:pipeline_step",
+                          donate_argnums=(0,))
 
     def __call__(self, params, data, labels):
         if len(data) % self.num_micro:

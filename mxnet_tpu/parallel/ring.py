@@ -132,4 +132,7 @@ def make_ring_attention(mesh: Mesh, axis: str = "sp", causal: bool = False,
     spec = P(None, axis, None, None)
     sharded = shard_map_norep(fn, mesh, in_specs=(spec, spec, spec),
                               out_specs=spec)
-    return jax.jit(sharded)
+    # persistent-cache entry: an unrolled long-context attention trace
+    # is exactly the compile a warm restart should skip (CHANGES PR 5)
+    from ..compile_cache import cached_jit
+    return cached_jit(sharded, name="parallel:%s_attention" % impl)

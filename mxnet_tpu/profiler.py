@@ -23,6 +23,8 @@ import os
 import threading
 import weakref
 
+from .base import make_lock
+
 __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "dump_profile", "dump_trace", "state", "register_feed_stats",
            "feed_report", "feed_report_str", "register_checkpoint_stats",
@@ -100,7 +102,7 @@ def dump_trace(path: str) -> str:
 # snapshot-copies under the lock first.  The per-object counter locks
 # (StageStats, ServeStats, ...) stay where they are; this lock only
 # covers registry membership.
-_registry_lock = threading.Lock()
+_registry_lock = make_lock("profiler.registry")
 
 
 class _Registry:

@@ -1,3 +1,6 @@
+# lint: allow-file(raw-env) — DMLC_* rendezvous vars are the
+# launcher-owned wire protocol (reference ps-lite semantics:
+# set-vs-unset matters, missing required vars must KeyError loudly)
 """Host-side parameter server for ``dist_async`` training.
 
 Reference: src/kvstore/kvstore_dist.h (worker), kvstore_dist_server.h
@@ -39,6 +42,8 @@ from multiprocessing.connection import Client, Listener
 
 import numpy as np
 
+from .base import get_env, make_lock
+
 __all__ = ["Scheduler", "PSServer", "PSWorkerClient", "run_scheduler",
            "run_server", "bigarray_bound", "key_to_server", "stripe_ranges"]
 
@@ -79,7 +84,7 @@ def _connect_retry(addr, timeout=None):
     until the rendezvous window closes (reference ps-lite van retries)."""
     import time
     if timeout is None:
-        timeout = float(os.environ.get("MXNET_PS_CONNECT_TIMEOUT", "180"))
+        timeout = get_env("MXNET_PS_CONNECT_TIMEOUT", 180.0, float)
     addr = tuple(addr) if isinstance(addr, (list, tuple)) else addr
     deadline = time.monotonic() + timeout
     delay = 0.05
@@ -101,7 +106,7 @@ def _root_addr():
 
 def bigarray_bound() -> int:
     """Stripe threshold (reference env MXNET_KVSTORE_BIGARRAY_BOUND)."""
-    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000))
+    return get_env("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int)
 
 
 def _key_int(key) -> int:
@@ -149,7 +154,7 @@ class Scheduler:
         addr = addr or _root_addr()
         self.listener = Listener(addr, authkey=_get_authkey())
         self.server_addrs = [None] * num_servers
-        self._lock = threading.Lock()
+        self._lock = make_lock("ps.scheduler_roster")
         self._servers_ready = threading.Event()
         self._barrier_conns = []
         self._worker_ranks = 0
@@ -198,7 +203,7 @@ class Scheduler:
 
     def _send(self, conn, msg):
         entry = self._roster.get(id(conn))
-        lock = entry[2] if entry else threading.Lock()
+        lock = entry[2] if entry else make_lock("ps.conn_send")
         try:
             with lock:
                 conn.send(msg)
@@ -243,7 +248,7 @@ class Scheduler:
                         self.server_addrs[rank] = msg[1]
                         role = "server"
                         self._roster[id(conn)] = (role, rank,
-                                                  threading.Lock(), conn)
+                                                  make_lock("ps.conn_send"), conn)
                         if all(a is not None for a in self.server_addrs):
                             self._servers_ready.set()
                     self._send(conn, ("rank", rank))
@@ -257,7 +262,7 @@ class Scheduler:
                         self._worker_ranks += 1
                         role = "worker"
                         self._roster[id(conn)] = (role, rank,
-                                                  threading.Lock(), conn)
+                                                  make_lock("ps.conn_send"), conn)
                     self._send(conn, ("servers", list(self.server_addrs),
                                       rank))
                 elif kind == "barrier":
@@ -349,7 +354,7 @@ class PSServer:
         self.num_workers = num_workers
         self.store = {}
         self.updater = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("ps.server_store")
         self._exec = _MainThreadExec()
         # own listen socket on an ephemeral port
         host = os.environ.get("DMLC_NODE_HOST", "127.0.0.1")
@@ -513,8 +518,8 @@ class PSWorkerClient:
         self.rank = int(os.environ.get("DMLC_WORKER_ID", msg[2]))
         self.num_servers = len(self.server_addrs)
         self._conns = [_connect_retry(a) for a in self.server_addrs]
-        self._locks = [threading.Lock() for _ in self._conns]
-        self._sched_lock = threading.Lock()
+        self._locks = [make_lock("ps.worker_conn") for _ in self._conns]
+        self._sched_lock = make_lock("ps.worker_sched")
         self._closed = False
         self._fatal = False
         # the stop handshake distinguishes a clean exit from a death (the
@@ -548,7 +553,7 @@ class PSWorkerClient:
         instead of an indefinite hang (the reference job simply hung on
         node death, SURVEY §5.3 — we can do better than that).  A
         scheduler-broadcast ("abort", reason) surfaces as RuntimeError."""
-        timeout = float(os.environ.get("MXNET_PS_RECV_TIMEOUT", "600"))
+        timeout = get_env("MXNET_PS_RECV_TIMEOUT", 600.0, float)
         if not conn.poll(timeout):
             raise RuntimeError(
                 "parameter-server RPC timed out after %.0fs waiting for %s "

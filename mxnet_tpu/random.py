@@ -26,6 +26,9 @@ _state = threading.local()
 
 def _key():
     if not hasattr(_state, "key"):
+        # lint: allow(unseeded-fork-rng) — entropy bootstrap: the
+        # default key deliberately derives from the np stream that
+        # mx.random.seed seeds (the documented seeding contract)
         _state.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
     return _state.key
 

@@ -62,8 +62,12 @@ class Rtc:
             if not HAS_PALLAS:
                 raise MXNetError("pallas unavailable in this JAX build")
             out_shape = [jax.ShapeDtypeStruct(s, d) for (_, s, d) in self._out_proto]
+            # lint: allow(raw-jit) — pallas_call executables do not
+            # round-trip PJRT serialize_executable; rtc kernels are
+            # user-supplied one-offs, not warm-restart hot paths
             self._fn = jax.jit(pl.pallas_call(kernel, out_shape=out_shape))
         else:
+            # lint: allow(raw-jit) — same: user-supplied one-off kernel
             self._fn = jax.jit(kernel)
 
     def push(self, ins: Sequence[NDArray], outs: Sequence[NDArray],

@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import trace as _trace
-from ..base import MXNetError, get_env
+from ..base import MXNetError, get_env, make_rlock
 from ..context import Context
 from ..predictor import Predictor, load_checkpoint_pair
 from .batcher import MicroBatcher
@@ -162,13 +162,13 @@ class ServeEngine:
         # batch instead of tearing it.  RLock so reload()/pause() nest
         # on one thread; _pause_owner guards the close-inside-pause
         # deadlock (close joins the dispatcher, which needs this lock).
-        self._swap_lock = threading.RLock()
+        self._swap_lock = make_rlock("serve.engine_swap")
         self._pause_owner: Optional[int] = None
         # serializes close(): every closer returns only after shutdown
         # actually finished, not merely after some other thread STARTED
         # it.  RLock: a drop-on-close done-callback runs inline on the
         # closer's own thread and may close() again (see close()).
-        self._close_lock = threading.RLock()
+        self._close_lock = make_rlock("serve.engine_close")
         # per-bucket shape dicts, built once: _run_batch is the hot loop
         self._shapes_by_bucket = {b: self._bucket_shapes(b)
                                   for b in self._buckets}

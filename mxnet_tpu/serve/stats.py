@@ -23,6 +23,8 @@ import math
 import threading
 from typing import Dict, List, Optional
 
+from ..base import make_lock
+
 __all__ = ["ServeStats"]
 
 # sliding latency window: big enough for stable p99, small enough that a
@@ -47,7 +49,7 @@ class ServeStats:
     def __init__(self, name: str, max_batch_size: int):
         self.name = name
         self.max_batch_size = int(max_batch_size)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.stats")
         self._submitted = 0
         self._completed = 0
         self._overloaded = 0

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..base import make_lock as _make_lock
 from .journal import (journal_every, journal_path, maybe_journal_step,
                       reset_journal, write_journal_line)
 from .recorder import DEFAULT_BUF_EVENTS, Recorder
@@ -54,22 +55,20 @@ __all__ = ["span", "complete", "instant", "async_begin", "async_instant",
 
 
 def _env_enabled() -> bool:
-    return os.environ.get("MXNET_TRACE", "1") not in ("0", "false", "False")
+    from ..base import get_env
+    return bool(get_env("MXNET_TRACE", True, bool))
 
 
 def _env_cap() -> int:
-    try:
-        return int(os.environ.get("MXNET_TRACE_BUF_EVENTS", "") or
-                   DEFAULT_BUF_EVENTS)
-    except ValueError:
-        return DEFAULT_BUF_EVENTS
+    from ..base import get_env
+    return get_env("MXNET_TRACE_BUF_EVENTS", DEFAULT_BUF_EVENTS, int)
 
 
 _enabled = _env_enabled()
 _recorder = Recorder(_env_cap())
 _spill_dirs: List[str] = []
 _process_labels: Dict[int, str] = {}
-_dirs_lock = threading.Lock()
+_dirs_lock = _make_lock("trace.spill_dirs")
 # registered spill dirs are bounded: a reader-per-job service must not
 # make every dump re-read an ever-growing list of dead readers' files
 MAX_SPILL_DIRS = 64

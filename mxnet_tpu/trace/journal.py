@@ -27,15 +27,13 @@ _last_step: Optional[int] = None
 
 
 def journal_path() -> Optional[str]:
-    return os.environ.get("MXNET_TRACE_JOURNAL") or None
+    from ..base import get_env
+    return get_env("MXNET_TRACE_JOURNAL") or None
 
 
 def journal_every() -> int:
-    try:
-        return max(1, int(os.environ.get("MXNET_TRACE_JOURNAL_EVERY",
-                                         "50") or "50"))
-    except ValueError:
-        return 50
+    from ..base import get_env
+    return max(1, get_env("MXNET_TRACE_JOURNAL_EVERY", 50, int))
 
 
 def reset_journal() -> None:
@@ -63,9 +61,18 @@ def maybe_journal_step(step: int, **extra) -> bool:
 
 def write_journal_line(path: str, step: int, **extra) -> None:
     """Append one snapshot line; a journal failure must never take the
-    training loop down, so I/O errors are swallowed."""
+    training loop down, so I/O errors are swallowed.
+
+    Each line carries BOTH clocks: ``ts`` is wall time (absolute, for
+    humans and cross-host joins) and ``mono`` is ``perf_counter`` — the
+    monotonic timeline step DURATIONS must be computed on.  An NTP step
+    between two lines shifts ``ts`` arbitrarily (the exact hazard
+    callback.py's Speedometer documents); ``mono`` deltas survive it."""
     from .. import profiler
-    line = {"ts": time.time(), "step": int(step),
+    # lint: allow(raw-time) — ts is the absolute stamp for humans;
+    # durations must be computed on the mono field next to it
+    line = {"ts": time.time(),
+            "mono": time.perf_counter(), "step": int(step),
             "reports": profiler.unified_report()}
     line.update(extra)
     try:

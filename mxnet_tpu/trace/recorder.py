@@ -33,6 +33,8 @@ import time
 import weakref
 from typing import Dict, List, Optional
 
+from ..base import make_lock as _make_lock
+
 __all__ = ["Recorder", "DEFAULT_BUF_EVENTS"]
 
 DEFAULT_BUF_EVENTS = 65536
@@ -44,11 +46,8 @@ DEFAULT_BUF_EVENTS = 65536
 
 
 def _spill_every() -> int:
-    try:
-        return max(1, int(os.environ.get("MXNET_TRACE_SPILL_EVERY",
-                                         "64") or "64"))
-    except ValueError:
-        return 64
+    from ..base import get_env
+    return max(1, get_env("MXNET_TRACE_SPILL_EVERY", 64, int))
 
 
 def _spill_max() -> int:
@@ -56,11 +55,8 @@ def _spill_max() -> int:
     default 200k ≈ 25MB of JSONL): the spill file must honor the same
     bounded-resources contract as the rings — a week-long reader run
     must not fill the disk with decode spans."""
-    try:
-        return max(1, int(os.environ.get("MXNET_TRACE_SPILL_MAX_EVENTS",
-                                         "200000") or "200000"))
-    except ValueError:
-        return 200000
+    from ..base import get_env
+    return max(1, get_env("MXNET_TRACE_SPILL_MAX_EVENTS", 200000, int))
 
 
 # dead-thread rings kept for the dump (short-lived threads' spans are
@@ -112,7 +108,7 @@ class Recorder:
     def __init__(self, buf_events: int = DEFAULT_BUF_EVENTS):
         self.buf_events = max(16, int(buf_events))
         self.pid = os.getpid()
-        self._lock = threading.Lock()
+        self._lock = _make_lock("trace.recorder")
         self._bufs: List[_ThreadBuf] = []
         self._tls = threading.local()
         self._spill_path: Optional[str] = None
@@ -251,7 +247,7 @@ class Recorder:
         start fresh under its own pid (and never double-report the
         parent's)."""
         self.pid = os.getpid()
-        self._lock = threading.Lock()
+        self._lock = _make_lock("trace.recorder")
         self._bufs = []
         self._tls = threading.local()
         self._spill_path = None

@@ -12,6 +12,14 @@ real TPU for every test process).
 import os
 import sys
 
+# tier-1 runs with the lock-order recorder armed: every base.make_lock
+# in the serve/feed/checkpoint/compile_cache thread soup records the
+# acquisition graph, and mxnet_tpu.analysis.pytest_plugin fails any
+# module that closes an order cycle (or leaks threads/processes).
+# Must be set BEFORE mxnet_tpu imports — module-level locks are created
+# at import time.
+os.environ.setdefault("MXNET_LOCK_CHECK", "1")
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
@@ -58,6 +66,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 if _cache_dir is not None:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
+
+
+# per-module thread/child-process leak guard + lock-order cycle check
+# (importing the fixture registers it; pytest_plugins in a non-rootdir
+# conftest is rejected by pytest >= 8)
+from mxnet_tpu.analysis.pytest_plugin import (  # noqa: E402,F401
+    _mxnet_analysis_guard)
 
 
 def pytest_configure(config):

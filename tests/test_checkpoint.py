@@ -374,10 +374,11 @@ def test_fit_mid_epoch_resume_bitwise(tmp_path):
     it = _data()
     mx.random.seed(7)
     m1 = mx.mod.Module(_mlp(), context=mx.cpu(0))
-    m1.fit(it, num_epoch=3, optimizer="sgd",
-           optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-           checkpoint=ck.CheckpointManager(store, save_every_steps=4,
-                                           keep_last_n=None))
+    with ck.CheckpointManager(store, save_every_steps=4,
+                              keep_last_n=None) as mgr1:
+        m1.fit(it, num_epoch=3, optimizer="sgd",
+               optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+               checkpoint=mgr1)
     ref, _ = m1.get_params()
     # keep only step 12 = epoch 2, batch 2: a mid-epoch cursor
     for s in ck.all_steps(store):
@@ -386,11 +387,12 @@ def test_fit_mid_epoch_resume_bitwise(tmp_path):
     seen = []
     mx.random.seed(99)
     m2 = mx.mod.Module(_mlp(), context=mx.cpu(0))
-    m2.fit(_data(), num_epoch=3, optimizer="sgd",
-           optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-           checkpoint=ck.CheckpointManager(store, keep_last_n=None),
-           resume=True,
-           batch_end_callback=lambda p: seen.append((p.epoch, p.nbatch)))
+    with ck.CheckpointManager(store, keep_last_n=None) as mgr2:
+        m2.fit(_data(), num_epoch=3, optimizer="sgd",
+               optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+               checkpoint=mgr2, resume=True,
+               batch_end_callback=lambda p: seen.append((p.epoch,
+                                                         p.nbatch)))
     assert seen[0] == (2, 2)     # resumed on the exact next batch
     p2, _ = m2.get_params()
     assert _params_equal(ref, p2)
@@ -582,11 +584,12 @@ def test_kill9_during_async_save_then_resume_bitwise(tmp_path):
     seen = []
     mx.random.seed(999)
     m2 = mx.mod.Module(_mlp(), context=mx.cpu(0))
-    m2.fit(_data(), num_epoch=2, optimizer="sgd",
-           optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-           checkpoint=ck.CheckpointManager(store, keep_last_n=None),
-           resume=True,
-           batch_end_callback=lambda p: seen.append((p.epoch, p.nbatch)))
+    with ck.CheckpointManager(store, keep_last_n=None) as mgr2:
+        m2.fit(_data(), num_epoch=2, optimizer="sgd",
+               optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+               checkpoint=mgr2, resume=True,
+               batch_end_callback=lambda p: seen.append((p.epoch,
+                                                         p.nbatch)))
     assert seen[0] == (0, 3)    # step 3 = epoch 0, batch cursor 3
     p2, _ = m2.get_params()
     assert _params_equal(ref, p2)

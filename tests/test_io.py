@@ -65,7 +65,48 @@ def test_prefetching_iter():
     seen = []
     for batch in p:
         seen.append(batch.data[0].asnumpy()[0, 0])
+    p.dispose()
     assert len(seen) == 4
+
+
+def test_prefetching_iter_dispose_mid_fetch():
+    """dispose() while a prefetch thread is inside iters[i].next(): the
+    thread clears data_taken after dispose set it, so a one-shot set()
+    would park it in wait() forever (the tier-1 leak guard would flag
+    the stray thread).  dispose must re-arm the event until the thread
+    actually exits, and return promptly."""
+    import time
+
+    class SlowIter(mx.io.DataIter):
+        def __init__(self):
+            super().__init__()
+            self.batch_size = 2
+
+        @property
+        def provide_data(self):
+            return [mx.io.DataDesc("data", (2, 2))]
+
+        @property
+        def provide_label(self):
+            return [mx.io.DataDesc("label", (2,))]
+
+        def reset(self):
+            pass
+
+        def next(self):
+            time.sleep(0.3)        # dispose lands while we're in here
+            return mx.io.DataBatch(data=[mx.nd.ones((2, 2))],
+                                   label=[mx.nd.zeros((2,))],
+                                   pad=0, index=None)
+
+    p = mx.io.PrefetchingIter(SlowIter())
+    p.next()                       # consume one; a fresh fetch starts
+    time.sleep(0.05)               # thread is now mid-next()
+    t0 = time.perf_counter()
+    p.dispose()
+    took = time.perf_counter() - t0
+    assert took < 2.0, "dispose stalled %.2fs" % took
+    assert not any(t.is_alive() for t in p.prefetch_threads)
 
 
 def test_csv_iter(tmp_path):

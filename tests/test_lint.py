@@ -1,0 +1,554 @@
+"""mxnet_tpu.analysis: the static lint rules, the runtime lock-order
+recorder, and the thread/process leak guard — tier-1 enforcement from
+ISSUE 10.
+
+Contracts:
+
+* ``python tools/lint.py`` exits 0 on the real tree (every suppression
+  carries a reason, the baseline holds only grandfathered findings) and
+  exits 1 on a synthetic-violation fixture for EACH of the six rules —
+  each fixture is a distilled reproduction of the CHANGES.md incident
+  its rule descends from, and each rule stays silent on the fixed form.
+* The lock-order recorder builds the acquired-while-holding graph and
+  flags a deliberate A->B / B->A inversion on a schedule that never
+  deadlocks; the real tree records zero cycles under tier-1.
+* The leak guard fails a pytest module that leaves a stray thread or
+  child process behind, and stays green on a clean module.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "tools", "lint.py")
+
+from mxnet_tpu.analysis import linter  # noqa: E402
+from mxnet_tpu.analysis import leakguard, lockcheck  # noqa: E402
+
+
+def _rules_hit(source, rel="mxnet_tpu/serve/somefile.py"):
+    return {f.rule for f in linter.lint_source(textwrap.dedent(source),
+                                               rel)}
+
+
+# ---------------------------------------------------------------------------
+# one synthetic fixture per rule: the distilled historical bug must be
+# caught, the fixed form must be silent
+
+# PR 2 / PR 7r2: device_put of a host buffer in an init path — on CPU it
+# zero-copy aliases numpy's memory and the donated step scribbles on it
+BAD_DONATED = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def init_state(host_params, sharding):
+        return {k: jax.device_put(v, sharding)
+                for k, v in host_params.items()}
+"""
+GOOD_DONATED = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def init_state(host_params, sharding):
+        return {k: jnp.copy(jax.device_put(v, sharding))
+                for k, v in host_params.items()}
+"""
+
+# PR 5: a bare jax.jit bypasses the persistent executable cache
+BAD_JIT = """
+    import jax
+
+    def build_step(fn):
+        return jax.jit(fn, donate_argnums=(0,))
+"""
+GOOD_JIT = """
+    from ..compile_cache import cached_jit
+
+    def build_step(fn):
+        return cached_jit(fn, donate_argnums=(0,))
+"""
+
+# PR 6 convention: env reads go through base.get_env
+BAD_ENV = """
+    import os
+
+    def workers():
+        return int(os.environ.get("MXNET_FEED_WORKERS", "0") or "0")
+"""
+GOOD_ENV = """
+    from ..base import get_env
+
+    def workers():
+        return get_env("MXNET_FEED_WORKERS", 0, int)
+"""
+
+# PR 3's Speedometer bug: wall clock in rate arithmetic steps under NTP
+BAD_TIME = """
+    import time
+
+    def rate(count):
+        start = time.time()
+        do_work()
+        return count / (time.time() - start)
+"""
+GOOD_TIME = """
+    import time
+
+    def rate(count):
+        start = time.perf_counter()
+        do_work()
+        return count / (time.perf_counter() - start)
+"""
+
+# PR 6's decorrelation bug: forked workers inherit one global RNG state
+BAD_RNG = """
+    import numpy as np
+
+    def random_crop(img, out_h, out_w):
+        y = np.random.randint(0, img.shape[0] - out_h)
+        return img[y:y + out_h, :out_w]
+"""
+GOOD_RNG = """
+    import numpy as np
+
+    def random_crop(img, out_h, out_w, rng):
+        y = rng.integers(0, img.shape[0] - out_h)
+        return img[y:y + out_h, :out_w]
+"""
+
+# PR 4 review round 2: raw settle on a client-cancelled future raises
+# InvalidStateError and kills the worker thread
+BAD_FUTURE = """
+    def resolve(requests, outs):
+        for req, out in zip(requests, outs):
+            req.future.set_result(out)
+"""
+GOOD_FUTURE = """
+    def _set_result(fut, value):
+        try:
+            fut.set_result(value)
+        except Exception:
+            pass
+
+    def resolve(requests, outs):
+        for req, out in zip(requests, outs):
+            _set_result(req.future, out)
+"""
+
+FIXTURES = [
+    ("donated-aliasing", BAD_DONATED, GOOD_DONATED),
+    ("raw-jit", BAD_JIT, GOOD_JIT),
+    ("raw-env", BAD_ENV, GOOD_ENV),
+    ("raw-time", BAD_TIME, GOOD_TIME),
+    ("unseeded-fork-rng", BAD_RNG, GOOD_RNG),
+    ("raw-future-settle", BAD_FUTURE, GOOD_FUTURE),
+]
+
+
+@pytest.mark.parametrize("rule,bad,good",
+                         FIXTURES, ids=[f[0] for f in FIXTURES])
+def test_rule_catches_bug_and_passes_fix(rule, bad, good):
+    assert rule in _rules_hit(bad), \
+        "%s missed its historical reproduction" % rule
+    assert rule not in _rules_hit(good), \
+        "%s flags the fixed form" % rule
+
+
+@pytest.mark.parametrize("rule,bad,good",
+                         FIXTURES, ids=[f[0] for f in FIXTURES])
+def test_cli_exits_1_on_each_fixture(rule, bad, good, tmp_path):
+    """Acceptance: tools/lint.py exits 1 on every synthetic fixture."""
+    f = tmp_path / ("bad_%s.py" % rule.replace("-", "_"))
+    f.write_text(textwrap.dedent(bad))
+    res = subprocess.run(
+        [sys.executable, LINT, "--no-style", str(f)],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert rule in res.stdout
+
+
+def test_full_tree_lint_green():
+    """The tier-1 gate: the shipped tree has no style problems and no
+    un-grandfathered analysis findings."""
+    res = subprocess.run([sys.executable, LINT],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_diff_mode_checks_only_changed_files(tmp_path):
+    """--diff HEAD on a clean-vs-HEAD worktree lints the (possibly
+    empty) changed set and must stay green; a violation in a changed
+    file under mxnet_tpu/ is caught by the same entry point when the
+    file is named directly (the pre-commit path)."""
+    res = subprocess.run([sys.executable, LINT, "--diff", "HEAD"],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=120)
+    assert res.returncode in (0, 1), res.stdout + res.stderr
+    # whatever --diff sees is exactly what full-tree lint already
+    # gates; with a green tree it must be green too
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+
+def test_suppression_with_reason_silences():
+    src = """
+    import time
+
+    def rate(count):
+        # lint: allow(raw-time) — measured interval crosses process
+        # boundaries and must join wall-clock logs
+        start = time.time()
+        return count / (time.time() - start)  # lint: allow(raw-time) — ditto
+    """
+    assert "raw-time" not in _rules_hit(src)
+
+
+def test_suppression_without_reason_is_an_error():
+    src = """
+    import time
+
+    def rate(count):
+        start = time.time()  # lint: allow(raw-time)
+        return count / (time.time() - start)
+    """
+    hits = {f.rule for f in linter.lint_source(textwrap.dedent(src),
+                                               "mxnet_tpu/x.py")}
+    assert "lint-meta" in hits        # the reasonless allow itself
+    assert "raw-time" in hits         # and it does NOT suppress
+
+
+def test_inline_allow_does_not_bless_next_statement():
+    """An allow trailing a code line covers THAT statement only; the
+    next line's genuine violation must still fire (only a comment-only
+    allow line extends to the code below it)."""
+    src = """
+    import time
+
+    def rates(count, t0):
+        ts = time.time() - t0  # lint: allow(raw-time) — wall stamp ok
+        d = time.time() - t0
+        return ts, d
+    """
+    findings = [f for f in linter.lint_source(textwrap.dedent(src),
+                                              "mxnet_tpu/x.py")
+                if f.rule == "raw-time"]
+    assert len(findings) == 1, findings
+    assert "d = time.time() - t0" in findings[0].src_line
+
+
+def test_diff_mode_sees_untracked_files():
+    """A brand-new (not yet git-added) file is exactly what the fast
+    pre-commit path must lint; `git diff --name-only` alone omits it."""
+    scratch = os.path.join(REPO, "mxnet_tpu", "_lint_selftest_scratch.py")
+    try:
+        with open(scratch, "w") as f:
+            f.write("import time\nd = time.time() - time.time()\n")
+        res = subprocess.run([sys.executable, LINT, "--diff", "HEAD",
+                              "--no-style"],
+                             capture_output=True, text=True, cwd=REPO,
+                             timeout=120)
+        assert res.returncode == 1, res.stdout + res.stderr
+        assert "_lint_selftest_scratch.py" in res.stdout
+        assert "raw-time" in res.stdout
+    finally:
+        os.unlink(scratch)
+
+
+def test_file_level_suppression():
+    src = '''
+    # lint: allow-file(raw-env) — DMLC protocol vars, reference semantics
+    """module docstring"""
+    import os
+
+    def a():
+        return os.environ.get("DMLC_ROLE")
+
+    def b():
+        return os.environ["DMLC_PS_ROOT_URI"]
+    '''
+    assert "raw-env" not in _rules_hit(src)
+
+
+def test_baseline_grandfathers_old_but_fails_new():
+    src_old = "import os\nx = os.environ.get('A')\n"
+    old = linter.lint_source(src_old, "mxnet_tpu/old.py")
+    assert {f.rule for f in old} == {"raw-env"}
+    base = linter.Baseline.from_findings(old)
+    # the same finding moved to another line keeps its fingerprint
+    moved = linter.lint_source("import os\n\n\nx = os.environ.get('A')\n",
+                               "mxnet_tpu/old.py")
+    assert base.new_findings(moved) == []
+    # a NEW violation in the same file fails
+    grown = linter.lint_source(
+        "import os\nx = os.environ.get('A')\ny = os.environ.get('B')\n",
+        "mxnet_tpu/old.py")
+    new = base.new_findings(grown)
+    assert len(new) == 1 and "'B'" in new[0].src_line
+
+
+def test_raw_jit_exempt_inside_compile_cache():
+    src = "import jax\nstep = jax.jit(lambda x: x)\n"
+    assert "raw-jit" in {f.rule for f in linter.lint_source(
+        src, "mxnet_tpu/module/x.py")}
+    assert "raw-jit" not in {f.rule for f in linter.lint_source(
+        src, "mxnet_tpu/compile_cache/cached.py")}
+
+
+# ---------------------------------------------------------------------------
+# lock-order recorder
+
+def _ordered_grab(lock1, lock2, gate_in, gate_out):
+    # wait for the turn token so the two threads hold their pairs at
+    # DISJOINT times — the schedule can't deadlock, but each still
+    # acquires lock2 while holding lock1, which is all the recorder
+    # needs to see both orders
+    gate_in.wait(10)
+    with lock1:
+        with lock2:
+            pass
+    gate_out.set()
+
+
+def test_lock_inversion_detected():
+    """Deliberate A->B / B->A inversion on a deadlock-free schedule:
+    the graph closes the cycle even though this run never hung."""
+    with lockcheck.scoped() as graph:
+        a = lockcheck.CheckedLock("test.A")
+        b = lockcheck.CheckedLock("test.B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        cycles = graph.snapshot()[1]
+    assert cycles, "inversion not detected"
+    names = set(cycles[0]["cycle"])
+    assert {"test.A", "test.B"} <= names
+
+
+def test_lock_inversion_detected_across_threads():
+    with lockcheck.scoped() as graph:
+        a = lockcheck.CheckedLock("thr.A")
+        b = lockcheck.CheckedLock("thr.B")
+        g1 = threading.Event()
+        g2 = threading.Event()
+        g1.set()                      # t1 goes first, then hands off
+        t1 = threading.Thread(
+            target=_ordered_grab, args=(a, b, g1, g2), name="inv1")
+        t2 = threading.Thread(
+            target=_ordered_grab, args=(b, a, g2, threading.Event()),
+            name="inv2")
+        t1.start(); t2.start()
+        t1.join(10); t2.join(10)
+        cycles = graph.snapshot()[1]
+    assert cycles, "cross-thread inversion not detected"
+
+
+def test_consistent_order_is_clean():
+    with lockcheck.scoped() as graph:
+        a = lockcheck.CheckedLock("ok.A")
+        b = lockcheck.CheckedLock("ok.B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert graph.snapshot()[1] == []
+
+
+def test_rlock_reentry_no_self_edge():
+    with lockcheck.scoped() as graph:
+        r = lockcheck.CheckedRLock("re.R")
+        with r:
+            with r:       # reentrant: must not record R->R
+                pass
+        edges, cycles = graph.snapshot()
+        assert ("re.R", "re.R") not in edges
+        assert cycles == []
+
+
+def test_condition_wait_releases_name():
+    """cv.wait() releases the real lock; holding it in the model would
+    fabricate a cv->other edge from whatever the waiter touches next —
+    and a notify-side other->cv edge would then read as a cycle."""
+    with lockcheck.scoped() as graph:
+        cv = lockcheck.CheckedCondition("cw.cv")
+        other = lockcheck.CheckedLock("cw.other")
+        done = []
+
+        def waiter():
+            with cv:
+                cv.wait_for(lambda: done, timeout=10)
+
+        t = threading.Thread(target=waiter, name="cw-waiter")
+        t.start()
+        time.sleep(0.1)          # let the waiter block inside wait_for
+        with other:              # taken while cv's REAL lock is free
+            with cv:
+                done.append(1)
+                cv.notify_all()
+        t.join(10)
+        edges, cycles = graph.snapshot()
+    assert cycles == [], cycles
+    assert ("cw.cv", "cw.other") not in edges
+
+
+def test_same_name_two_instances_one_node():
+    """Two engines' 'serve.swap' locks are one graph node: an inversion
+    BETWEEN instances of the same class is invisible by design (it
+    cannot deadlock — different objects), and instance identity would
+    make the graph unbounded."""
+    with lockcheck.scoped() as graph:
+        a1 = lockcheck.CheckedLock("inst.A")
+        a2 = lockcheck.CheckedLock("inst.A")
+        with a1:
+            with a2:        # A->A self edge is skipped by name
+                pass
+        edges, cycles = graph.snapshot()
+        assert ("inst.A", "inst.A") not in edges
+        assert cycles == []
+
+
+def test_lockcheck_trace_spill_reentrancy_no_deadlock(tmp_path):
+    """Edge emission goes through mxnet_tpu.trace, whose recorder lock
+    is itself a make_lock: at a spill-cadence boundary the instant
+    re-enters note_edge via CheckedLock.acquire.  The reentrancy guard
+    must drop the nested emission — without it the nested spill flush
+    deadlocks on the recorder's non-reentrant inner lock."""
+    prog = textwrap.dedent("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["MXNET_LOCK_CHECK"] = "1"
+        os.environ["MXNET_TRACE_SPILL_EVERY"] = "4"
+        sys.path.insert(0, %r)
+        from mxnet_tpu import trace
+        from mxnet_tpu.analysis import lockcheck
+        trace.configure_spill(%r)
+        for i in range(3):
+            trace.instant("warm%%d" %% i)
+        a = lockcheck.make_lock("t.spillA")
+        b = lockcheck.make_lock("t.spillB")
+        with a:
+            with b:
+                pass
+        print("NO-DEADLOCK")
+    """) % (REPO, str(tmp_path / "spill.jsonl"))
+    res = subprocess.run([sys.executable, "-c", prog],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0 and "NO-DEADLOCK" in res.stdout, \
+        res.stdout + res.stderr
+
+
+def test_factories_plain_when_disabled():
+    saved = lockcheck._enabled
+    try:
+        lockcheck.set_enabled(False)
+        assert isinstance(lockcheck.make_lock("x"),
+                          type(threading.Lock()))
+        lockcheck.set_enabled(True)
+        assert isinstance(lockcheck.make_lock("x"), lockcheck.CheckedLock)
+    finally:
+        lockcheck._enabled = saved
+
+
+def test_real_tree_zero_cycles():
+    """Tier-1 acceptance: after every suite that ran before this module
+    (serve/feed/checkpoint/compile_cache exercise their thread soup
+    under MXNET_LOCK_CHECK=1 from conftest), the process graph holds no
+    cycle.  The module-scoped guard enforces this per module; this test
+    states it explicitly."""
+    assert lockcheck.cycles() == [], lockcheck.lock_order_report()
+
+
+def test_lock_order_report_shape():
+    rep = lockcheck.lock_order_report()
+    assert set(rep) == {"enabled", "edges", "cycles"}
+    assert isinstance(rep["edges"], list)
+
+
+# ---------------------------------------------------------------------------
+# leak guard
+
+def test_leakguard_catches_thread_and_child():
+    before = leakguard.snapshot()
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="deliberate-leak",
+                         daemon=True)
+    t.start()
+    child = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(30)"])
+    try:
+        leaks = leakguard.check(before, grace_s=0.3)
+        assert any("deliberate-leak" in l for l in leaks), leaks
+        assert any("pid=%d" % child.pid in l for l in leaks), leaks
+    finally:
+        stop.set()
+        t.join(5)
+        child.kill()
+        child.wait()
+    # ... and after cleanup the same snapshot is clean again
+    assert leakguard.check(before, grace_s=5.0) == []
+
+
+def test_leakguard_grace_window_tolerates_slow_join():
+    """A thread that exits within the grace window is not a leak —
+    clean shutdown paths get time to join."""
+    before = leakguard.snapshot()
+    t = threading.Thread(target=lambda: time.sleep(0.4),
+                         name="slow-join")
+    t.start()
+    assert leakguard.check(before, grace_s=5.0) == []
+    t.join()
+
+
+GUARD_FAIL_SNIPPET = """
+import threading
+
+def test_leaks_a_thread():
+    threading.Thread(target=lambda: __import__('time').sleep(60),
+                     name='suite-leaked-thread', daemon=True).start()
+"""
+
+GUARD_CLEAN_SNIPPET = """
+def test_clean():
+    assert 1 + 1 == 2
+"""
+
+
+@pytest.mark.slow
+def test_pytest_guard_fails_leaky_module(tmp_path):
+    """End to end: a pytest run over a module that leaks a thread fails
+    with the analysis-guard message, while a clean module passes."""
+    (tmp_path / "test_leaky_mod.py").write_text(GUARD_FAIL_SNIPPET)
+    (tmp_path / "test_clean_mod.py").write_text(GUARD_CLEAN_SNIPPET)
+    env = dict(os.environ,
+               MXNET_LEAK_CHECK="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "-p", "mxnet_tpu.analysis.pytest_plugin", str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+        timeout=180)
+    out = res.stdout + res.stderr
+    assert res.returncode != 0, out
+    assert "analysis guard" in out and "suite-leaked-thread" in out, out
+    # the clean module itself passed; only the guard error is reported
+    assert "test_clean" not in out.split("short test summary")[-1], out
+
+
+def test_leakguard_disabled_knob(monkeypatch):
+    monkeypatch.setenv("MXNET_LEAK_CHECK", "0")
+    assert not leakguard.enabled()
+    monkeypatch.setenv("MXNET_LEAK_CHECK", "1")
+    assert leakguard.enabled()

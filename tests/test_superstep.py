@@ -18,6 +18,25 @@ from mxnet_tpu import checkpoint as ck
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+_live_mgrs = []
+
+
+def _closing_mgr(store):
+    """A caller-supplied CheckpointManager is the caller's to close —
+    these tests hand one straight to fit and never touch it again, so
+    park it for the autouse fixture below to close (the tier-1 leak
+    guard flags the async-writer thread otherwise)."""
+    mgr = ck.CheckpointManager(store, keep_last_n=None)
+    _live_mgrs.append(mgr)
+    return mgr
+
+
+@pytest.fixture(autouse=True)
+def _close_live_mgrs():
+    yield
+    while _live_mgrs:
+        _live_mgrs.pop().close()
+
 
 def _mlp():
     data = mx.sym.Variable("data")
@@ -249,8 +268,7 @@ def test_superstep_checkpoint_resume_bitwise(tmp_path):
     m2.fit(_data(n=80), num_epoch=2,
            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
            superstep=2,
-           checkpoint=ck.CheckpointManager(store, keep_last_n=None),
-           resume=True)
+           checkpoint=_closing_mgr(store), resume=True)
     m_ref, _ = _fit(2, n=80, momentum=0.9)
     _assert_bitwise(m_ref, m2)
 
@@ -274,8 +292,7 @@ def test_resume_cursorless_checkpoint_into_prefetch_superstep(tmp_path):
     m2 = mx.mod.Module(_mlp(), context=[mx.current_context()])
     m2.fit(_data(n=80), num_epoch=2, superstep=2, prefetch_to_device=True,
            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
-           checkpoint=ck.CheckpointManager(store, keep_last_n=None),
-           resume=True)
+           checkpoint=_closing_mgr(store), resume=True)
     m_ref, _ = _fit(2, n=80, momentum=0.9)
     _assert_bitwise(m_ref, m2)
 
@@ -368,8 +385,7 @@ def test_kill9_through_superstep_boundary_then_resume(tmp_path):
     m2 = mx.mod.Module(_mlp(), context=mx.cpu(0))
     m2.fit(_data(n=80), num_epoch=2, superstep=2,
            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
-           checkpoint=ck.CheckpointManager(store, keep_last_n=None),
-           resume=True)
+           checkpoint=_closing_mgr(store), resume=True)
     m_ref, _ = _fit(2, n=80, momentum=0.9)
     _assert_bitwise(m_ref, m2)
 

@@ -306,6 +306,11 @@ def test_journal_lines(tmp_path, monkeypatch):
         assert set(("feed", "superstep", "multichip", "checkpoint",
                     "serve", "compile", "trace")) <= set(ln["reports"])
         assert ln["ts"] > 0
+    # both clocks on every line: ts is the absolute wall stamp for
+    # humans, mono is perf_counter — step DURATIONS are computed on
+    # mono deltas, which survive an NTP step between lines
+    monos = [ln["mono"] for ln in lines]
+    assert all(m > 0 for m in monos) and monos == sorted(monos)
 
 
 def test_checkpoint_spans(tmp_path):

@@ -1,42 +1,82 @@
-"""Repo lint: the CI gate's first stage (reference tests/travis/run_test.sh
-ran pylint + cpplint; this image ships no linters, so the checks that
-matter are vendored: python syntax, tabs, trailing whitespace, long
-lines, and C++ trailing whitespace/tabs-in-indent).
+"""Repo lint: style gate + project-specific static analysis.
 
-Usage: python tools/lint.py  (exit 0 clean, 1 with findings listed)
+Two stages (reference tests/travis/run_test.sh ran pylint + cpplint;
+this image ships no linters, so both stages are vendored):
+
+* **style** — python syntax, tabs, trailing whitespace, long lines over
+  the whole repo; C++ trailing whitespace / tabs-in-indent.
+* **analysis** — the AST rules in ``mxnet_tpu/analysis/linter.py``
+  (donated-aliasing, raw-jit, raw-env, raw-time, unseeded-fork-rng,
+  raw-future-settle — each distilled from a CHANGES.md incident, see
+  docs/analysis.md) over ``mxnet_tpu/``.
+
+Usage::
+
+    python tools/lint.py                    # style (repo) + analysis
+    python tools/lint.py mxnet_tpu/serve    # both stages, these paths
+    python tools/lint.py --diff HEAD~1      # only files changed since
+                                            # rev (fast pre-commit path)
+    python tools/lint.py --write-baseline   # grandfather current hits
+
+Known findings live in ``tools/lint_baseline.json`` (override with
+``MXNET_LINT_BASELINE`` or ``--baseline``); only NEW findings fail.
+Exit 0 clean, 1 with findings listed.
+
+The analysis module is loaded by file path — not ``import mxnet_tpu``
+— so the linter runs in milliseconds without initializing jax.
 """
+import argparse
 import ast
+import importlib.util
 import os
+import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 MAX_LEN = 100
 SKIP_DIRS = {".git", "__pycache__", ".claude", "node_modules",
              ".venv", "venv", "build", "dist", ".eggs"}
+DEFAULT_BASELINE = os.path.join(ROOT, "tools", "lint_baseline.json")
 
 
-def py_files():
-    for base, dirs, files in os.walk(ROOT):
-        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(base, f)
+def _load_linter():
+    path = os.path.join(ROOT, "mxnet_tpu", "analysis", "linter.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_linter", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
-def cc_files():
-    for sub in ("src", "include", "tests/cpp", "amalgamation",
-                "cpp-package", "example/cpp"):
-        top = os.path.join(ROOT, sub)
-        for base, dirs, files in os.walk(top):
+def py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for base, dirs, files in os.walk(p):
             dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
-            for f in files:
-                if f.endswith((".cc", ".h", ".hpp", ".c")):
+            for f in sorted(files):
+                if f.endswith(".py"):
                     yield os.path.join(base, f)
 
 
-def main():
+def cc_files(paths):
+    exts = (".cc", ".h", ".hpp", ".c")
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(exts):
+                yield p
+            continue
+        for base, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+            for f in sorted(files):
+                if f.endswith(exts):
+                    yield os.path.join(base, f)
+
+
+def style_problems(py_paths, cc_paths):
     problems = []
-    for path in py_files():
+    for path in py_files(py_paths):
         rel = os.path.relpath(path, ROOT)
         try:
             with open(path, encoding="utf-8", errors="replace") as f:
@@ -55,7 +95,7 @@ def main():
                 if len(line) > MAX_LEN:
                     problems.append("%s:%d: line length %d > %d"
                                     % (rel, i, len(line), MAX_LEN))
-    for path in cc_files():
+    for path in cc_files(cc_paths):
         rel = os.path.relpath(path, ROOT)
         with open(path, encoding="utf-8", errors="replace") as f:
             for i, line in enumerate(f, 1):
@@ -65,11 +105,110 @@ def main():
                 indent = line[:len(line) - len(line.lstrip())]
                 if "\t" in indent:
                     problems.append("%s:%d: tab in indentation" % (rel, i))
+    return problems
+
+
+def _default_cc_paths():
+    return [os.path.join(ROOT, s)
+            for s in ("src", "include", "tests/cpp", "amalgamation",
+                      "cpp-package", "example/cpp")
+            if os.path.isdir(os.path.join(ROOT, s))]
+
+
+def _diff_paths(rev):
+    """Changed files vs ``rev`` (committed + staged + worktree + new
+    untracked files — a brand-new module is exactly what a pre-commit
+    lint must see), repo paths that still exist."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", rev, "--"],
+        cwd=ROOT, capture_output=True, text=True)
+    if out.returncode != 0:
+        raise SystemExit("lint: git diff %s failed: %s"
+                         % (rev, out.stderr.strip()))
+    names = out.stdout.splitlines()
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=ROOT, capture_output=True, text=True)
+    if untracked.returncode == 0:
+        names += untracked.stdout.splitlines()
+    paths = []
+    for line in sorted(set(names)):
+        p = os.path.join(ROOT, line.strip())
+        if line.strip() and os.path.exists(p):
+            paths.append(p)
+    return paths
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: whole repo style "
+                    "+ mxnet_tpu/ analysis)")
+    ap.add_argument("--diff", metavar="REV",
+                    help="lint only files changed since REV")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default tools/lint_baseline.json "
+                    "or $MXNET_LINT_BASELINE)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record current analysis findings as the "
+                    "baseline and exit")
+    ap.add_argument("--no-style", action="store_true",
+                    help="skip the style stage (analysis only)")
+    ap.add_argument("--no-analysis", action="store_true",
+                    help="skip the analysis stage (style only)")
+    args = ap.parse_args(argv)
+
+    if args.diff:
+        if args.paths:
+            ap.error("--diff and explicit paths are mutually exclusive")
+        changed = _diff_paths(args.diff)
+        style_paths = changed
+        analysis_paths = [p for p in changed
+                          if os.path.relpath(p, ROOT)
+                          .startswith("mxnet_tpu" + os.sep)
+                          and p.endswith(".py")]
+        cc_extra = []
+    elif args.paths:
+        style_paths = [os.path.abspath(p) for p in args.paths]
+        analysis_paths = style_paths
+        cc_extra = []
+    else:
+        style_paths = [ROOT]
+        analysis_paths = [os.path.join(ROOT, "mxnet_tpu")]
+        cc_extra = _default_cc_paths()
+
+    problems = []
+    if not args.no_style:
+        # the default run keeps the historical shape: python over the
+        # whole tree, C++ over the reference source dirs only
+        problems += style_problems(style_paths, style_paths + cc_extra
+                                   if (args.paths or args.diff)
+                                   else cc_extra)
+
+    findings = []
+    if not args.no_analysis:
+        linter = _load_linter()
+        findings = linter.lint_paths(analysis_paths, ROOT)
+        baseline_path = (args.baseline
+                         or os.environ.get("MXNET_LINT_BASELINE")
+                         or DEFAULT_BASELINE)
+        if args.write_baseline:
+            linter.Baseline(set()).save(baseline_path, findings)
+            print("lint: baseline written to %s (%d finding(s) "
+                  "grandfathered)" % (os.path.relpath(baseline_path, ROOT),
+                                      len(findings)))
+            return 0
+        baseline = linter.load_baseline(baseline_path)
+        findings = baseline.new_findings(findings)
+
     for p in problems:
         print(p)
-    print("lint: %d finding(s) over %s"
-          % (len(problems), "python + C++ sources"))
-    return 1 if problems else 0
+    for f in findings:
+        print(f)
+    total = len(problems) + len(findings)
+    print("lint: %d finding(s) (%d style, %d analysis)"
+          % (total, len(problems), len(findings)))
+    return 1 if total else 0
 
 
 if __name__ == "__main__":
