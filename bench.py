@@ -448,6 +448,19 @@ def main():
     except Exception as e:
         sys.stderr.write("bench: serve leg failed (%s)\n" % e)
     _PARTIAL_LINE = dict(line)
+    # fusion + autotune leg (mxnet_tpu.passes.fuse / mxnet_tpu.autotune):
+    # fused-vs-unfused serve step latency (fused_step_ms lower-is-better,
+    # fused_step_speedup), closed-loop QPS through the fused pipeline
+    # (serve_qps_fused), and the fit-side superstep autotuner's measured
+    # win (autotune_superstep_k / autotune_speedup) — all gated by
+    # tools/bench_gate.py from their first round
+    try:
+        from bench_fusion import run as fusion_run
+        _feed_watchdog("fusion")
+        line.update(fusion_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: fusion leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
     # compile / cold-start leg (mxnet_tpu.compile_cache): cold-process vs
     # warm-cache construction of the serve bucket grid and a 4-bucket
     # LSTM BucketingModule (acceptance: compile_cache_speedup >= 2 with

@@ -58,6 +58,7 @@ from . import feed
 from . import checkpoint
 from . import compile_cache
 from . import passes
+from . import autotune
 from . import predictor
 from . import serve
 from . import trace

@@ -1,0 +1,242 @@
+"""``mxnet_tpu.autotune`` — measurement-driven search over the knob
+space the repo already exposes.
+
+A dozen performance knobs ship hand-tuned per model (superstep K, serve
+bucket grids, pass-pipeline variants, quantize op sets, warmup threads);
+this package closes ROADMAP item 3's second half by SEARCHING that space
+with measurements instead of folklore, on the infrastructure PRs 5/8/9
+built:
+
+* **candidate evaluation is cheap** — every candidate program rides
+  ``compile_cache``, so a warm candidate costs one dispatch, not one
+  XLA compile;
+* **cost comes from trace spans** — candidates run under
+  ``autotune:candidate`` spans and the tuner reads the durations back
+  from the recorder (``trace.span_events``): the numbers in
+  ``mx.profiler.autotune_report()`` are the numbers in the exported
+  Perfetto timeline;
+* **winners persist** — per (model-symbol digest, input shapes,
+  backend topology) fingerprint, atomically
+  (``base.atomic_local_write``), under ``MXNET_AUTOTUNE_DIR``; a fresh
+  process loads the config with zero measurements;
+* **selection is deterministic** — ``select_best`` is a pure function
+  of the measurement log (min cost, ties by order), so a stored log
+  replays to the stored winner.
+
+Entry points::
+
+    Module.fit(..., autotune=True)     # tunes superstep K
+    ServeEngine(..., autotune=True)    # tunes the pass-pipeline variant
+    MXNET_AUTOTUNE=1                   # same, via env
+    mx.profiler.autotune_report_str()  # what was decided, from what
+
+See docs/fusion.md ("Autotuning") for the workflow.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import get_env
+from .measure import (CANDIDATE_SPAN, backend_descriptor, measure_candidate,
+                      timed_span, tuning_key)
+from .store import (config_path, list_configs, load_config, save_config,
+                    store_dir)
+from .tuner import Autotuner, AutotuneStats, select_best
+
+__all__ = ["Autotuner", "AutotuneStats", "select_best", "tuning_key",
+           "backend_descriptor", "measure_candidate", "timed_span",
+           "store_dir", "config_path", "load_config", "save_config",
+           "list_configs", "enabled", "tune_superstep",
+           "tune_serve_pipeline", "CANDIDATE_SPAN"]
+
+# the profiler registry holds stats weakly (live-object reporting); a
+# tuning run is an EVENT, so keep the last N strongly here or every
+# report after fit returns would be empty
+_MAX_KEPT = 64
+_kept_stats: List[AutotuneStats] = []
+
+
+def _register_stats(stats: AutotuneStats) -> None:
+    from .. import profiler
+    _kept_stats.append(stats)
+    del _kept_stats[:-_MAX_KEPT]
+    profiler.register_autotune_stats(stats)
+
+
+def enabled(flag=None) -> bool:
+    """Resolve an ``autotune=`` argument: an explicit True/False wins;
+    None falls back to the ``MXNET_AUTOTUNE`` env knob (default off)."""
+    if flag is not None:
+        return bool(flag)
+    return get_env("MXNET_AUTOTUNE", False, bool)
+
+
+# -- fit-side tuning: superstep K --------------------------------------------
+
+def _zero_batch(module):
+    """A zero DataBatch at the module's bound shapes — superstep cost
+    does not depend on data values, so measurement needs no real feed
+    (the same trick Module.prepare uses), including the compact uint8
+    wire when on-device augmentation is active."""
+    from ..io import DataBatch
+    from ..ndarray import NDArray, zeros as nd_zeros
+    import jax.numpy as jnp
+    spec = getattr(module._fused, "device_augment", None)
+    if spec is not None:
+        batch = module._data_shapes[0][1][0]
+        data = [NDArray(jnp.zeros((batch,) + spec.pre_shape, jnp.uint8))]
+        data += [nd_zeros(s) for _, s in module._data_shapes[1:]]
+    else:
+        data = [nd_zeros(s) for _, s in module._data_shapes]
+    return DataBatch(data=data,
+                     label=[nd_zeros(s)
+                            for _, s in (module._label_shapes or [])])
+
+
+def _measure_superstep(module, k: int, trials: int) -> float:
+    """Seconds per TRAINING STEP at superstep K, measured by dispatching
+    the real (warm) program on a COPY of the live train state — the
+    donated copy is discarded, so measurement never advances training
+    (no param, optimizer-slot, step-counter or RNG drift)."""
+    import jax
+    import jax.numpy as jnp
+    fused = module._fused
+    state = module._fused_state
+    key = module._fused_key
+    holder: Dict[str, Any] = {}
+
+    def setup():
+        holder["state"] = jax.tree_util.tree_map(jnp.copy, state)
+
+    if k == 1:
+        pend = fused.make_batch(_zero_batch(module))
+
+        def run():
+            new_state, _outs = fused.step(holder.pop("state"), pend, key)
+            jax.block_until_ready(
+                next(iter(new_state["params"].values()), new_state["t"]))
+
+        return measure_candidate(run, label="superstep=1", trials=trials,
+                                 warmup=1, setup=setup)
+    _k, mega = fused.make_megabatch([_zero_batch(module)
+                                     for _ in range(k)])
+    prog = fused.build_superstep(k, None)
+    lr = float(module._optimizer.base_lr())
+    lrs = jax.device_put(np.asarray([lr] * k, np.float32),
+                         fused._replicated())
+
+    def run():
+        new_state, _acc = prog(holder.pop("state"), mega, lrs, key, ())
+        jax.block_until_ready(
+            next(iter(new_state["params"].values()), new_state["t"]))
+
+    return measure_candidate(run, label="superstep=%d" % k, trials=trials,
+                             warmup=1, setup=setup) / k
+
+
+def tune_superstep(module, candidates: Sequence[int] = (1, 2, 4, 8),
+                   viable: Optional[Callable[[int], Optional[str]]] = None,
+                   trials: int = 2, persist: bool = True) -> int:
+    """Pick superstep K by measuring — the fit-side autotune entry
+    (``Module.fit(autotune=True)`` calls this when neither the
+    ``superstep=`` argument nor ``MXNET_SUPERSTEP`` chose).
+
+    ``viable(k)`` returns a blocker string (Module._superstep_blockers)
+    or None; blocked Ks leave the candidate list.  Returns 1 when the
+    fused path is off or nothing beyond K=1 survives.  The winner
+    persists per (symbol, shapes, optimizer, K-space, topology) key and
+    a fresh process reloads it without measuring."""
+    fused = getattr(module, "_fused", None)
+    if fused is None or not module.optimizer_initialized:
+        return 1
+    ks = sorted({int(k) for k in candidates if int(k) >= 1})
+    if viable is not None:
+        ks = [k for k in ks if k == 1 or viable(k) is None]
+    if not ks:
+        return 1
+    if ks == [1]:
+        return 1
+    key = tuning_key(
+        "fit:superstep", module._symbol.tojson(),
+        sorted(module._data_shapes), sorted(module._label_shapes or []),
+        type(module._optimizer).__name__, fused.hparam_signature(),
+        tuple(ks))
+    module._fused_ensure_state()
+    tuner = Autotuner("fit:superstep", key, persist=persist)
+    best, _cost = tuner.tune(
+        [{"superstep": k} for k in ks],
+        lambda cfg: _measure_superstep(module, cfg["superstep"], trials),
+        meta={"candidates": ks, "backend": backend_descriptor()})
+    return int(best["superstep"])
+
+
+# -- serve-side tuning: pass-pipeline variant --------------------------------
+
+def _quantize_tag(quantize) -> str:
+    """Stable digest material for a ServeEngine ``quantize=`` argument
+    (str mode, falsy, or a kwargs dict whose array values must not join
+    the key)."""
+    if not quantize:
+        return "-"
+    if isinstance(quantize, str):
+        return quantize
+    if isinstance(quantize, dict):
+        return ";".join(
+            "%s=%r" % (k, v) for k, v in sorted(quantize.items())
+            if isinstance(v, (str, int, float, bool, tuple)))
+    return type(quantize).__name__
+
+
+def tune_serve_pipeline(symbol_json: str, params: Dict,
+                        shapes: Dict[str, Tuple[int, ...]],
+                        data_name: str = "data", quantize=None,
+                        calib_data=None, u8_wire=None,
+                        dev: Tuple[str, int] = ("cpu", 0),
+                        name: str = "autotune",
+                        trials: int = 5, persist: bool = True):
+    """Pick the serving pass-pipeline variant by measuring — the
+    ``ServeEngine(autotune=True)`` entry.  Candidates are the fusion
+    variants (``fuse`` on/off around the same fold/CSE/DCE/quantize
+    spine); each builds a Predictor at the engine's max bucket through
+    ``compile_cache`` and is timed over warm steady-state forwards.
+
+    Returns ``(fuse, pipeline)``: the winning ``fuse`` setting plus the
+    winner's already-built PassPipeline when this call measured (so the
+    caller skips a third build — with int8 that is a full calibration
+    pass), or None on a store hit (the caller builds one; persisted per
+    (symbol, shapes, quantize mode, wire, topology))."""
+    from ..passes import build_serving_pipeline
+    from ..predictor import Predictor
+    key = tuning_key("serve:pipeline", symbol_json,
+                     sorted((k, tuple(v)) for k, v in shapes.items()),
+                     data_name, _quantize_tag(quantize), bool(u8_wire))
+    tuner = Autotuner("serve:pipeline", key, persist=persist)
+    built: Dict[bool, Any] = {}
+
+    def measure(cfg):
+        pipe = build_serving_pipeline(
+            quantize=quantize, calib_data=calib_data,
+            calib_shapes=dict(shapes), data_name=data_name,
+            u8_wire=u8_wire, fuse=cfg["fuse"], name=name)
+        built[bool(cfg["fuse"])] = pipe
+        p = Predictor(symbol_json, dict(params), dict(shapes),
+                      dev[0], dev[1], pipeline=pipe)
+        arr = p._exec.arg_dict[data_name]
+        data = np.zeros(tuple(arr.shape), np.dtype(arr.dtype))
+
+        def run():
+            p.set_input(data_name, data)
+            p.forward()
+            p.get_output(0)
+
+        return measure_candidate(run, label="fuse=%s" % cfg["fuse"],
+                                 trials=trials, warmup=2)
+
+    best, _cost = tuner.tune(
+        [{"fuse": True}, {"fuse": False}], measure,
+        meta={"quantize": _quantize_tag(quantize),
+              "backend": backend_descriptor()})
+    fuse = bool(best["fuse"])
+    return fuse, built.get(fuse)

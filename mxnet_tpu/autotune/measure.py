@@ -1,0 +1,97 @@
+"""Measurement: span-timed candidate evaluation + tuning-key digests.
+
+The cost signal is the trace subsystem's span timeline — the same spans
+``mx.profiler.dump_trace`` shows.  :func:`measure_candidate` runs one
+candidate under an ``autotune:candidate`` span per trial and reads the
+cost back out of the recorder (``trace.span_events``), so the numbers
+the tuner decided on are literally visible in the exported trace; when
+tracing is disabled (``MXNET_TRACE=0``) it falls back to the same
+perf_counter pair the span would have recorded.
+
+Keys: :func:`tuning_key` digests the model identity (symbol json), the
+shapes, the knob space and :func:`backend_descriptor` — platform,
+device kind, device count — into the store key.  Two processes on the
+same (model, topology) share a winner; a different topology never
+aliases.
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import trace as _trace
+
+__all__ = ["backend_descriptor", "tuning_key", "measure_candidate",
+           "CANDIDATE_SPAN"]
+
+CANDIDATE_SPAN = "autotune:candidate"
+
+
+def backend_descriptor() -> str:
+    """Stable description of the accelerator topology a measurement is
+    valid for: ``platform/device-kind/xN``.  Falls back to ``cpu/x1``
+    when no backend initializes (the tuner then still keys consistently
+    within that degraded environment)."""
+    try:
+        import jax
+        devs = jax.devices()
+        return "%s/%s/x%d" % (devs[0].platform,
+                              getattr(devs[0], "device_kind", "?"),
+                              len(devs))
+    except Exception:
+        return "cpu/?/x1"
+
+
+def tuning_key(*parts: Any) -> str:
+    """sha256 over every ingredient that changes the winning config.
+    Callers pass the symbol json, shapes, knob space and task tag; the
+    backend descriptor is always appended."""
+    h = hashlib.sha256()
+    for part in parts + (backend_descriptor(),):
+        h.update(repr(part).encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def timed_span(fn: Callable[[], Any], label: str = "", trial: int = 0,
+               span: str = CANDIDATE_SPAN) -> float:
+    """Run ``fn`` once under a ``span`` trace span and return its
+    duration in seconds, READ BACK from the trace recorder
+    (``trace.span_events``) so the number the tuner decided on is the
+    number the exported timeline shows.  Falls back to the same
+    perf_counter pair when tracing is off."""
+    t0 = time.perf_counter_ns()
+    with _trace.span(span, cat="autotune", label=label, trial=trial):
+        fn()
+    t1 = time.perf_counter_ns()
+    evs = _trace.span_events(names=(span,), since_ns=t0)
+    if evs:
+        # newest matching span (rings are per-thread; ours started at
+        # or after t0 by construction)
+        return max(evs, key=lambda e: e["ts"])["dur"] / 1e6
+    return (t1 - t0) / 1e9
+
+
+def measure_candidate(fn: Callable[[], Any], label: str = "",
+                      trials: int = 3, warmup: int = 1,
+                      setup: Optional[Callable[[], Any]] = None,
+                      span: str = CANDIDATE_SPAN) -> float:
+    """Cost of one candidate in seconds: run ``fn`` ``warmup`` times off
+    the clock (compile/cache-load happens there — compile_cache makes a
+    warm candidate cost one dispatch, not one compile), then ``trials``
+    times under a ``span`` trace span each, and return the MINIMUM span
+    duration (the least-interfered trial; autotune measures capability,
+    not load).  ``setup`` runs before every call OUTSIDE the span —
+    per-trial state that must not pollute the cost (e.g. copying a
+    donated train state)."""
+    for _ in range(max(0, warmup)):
+        if setup is not None:
+            setup()
+        fn()
+    costs: List[float] = []
+    for i in range(max(1, trials)):
+        if setup is not None:
+            setup()
+        costs.append(timed_span(fn, label=label, trial=i, span=span))
+    return min(costs)

@@ -1,0 +1,111 @@
+"""The autotune config store: one JSON file per tuning key, published
+atomically.
+
+A winning config is worth nothing if the next process re-measures it, so
+every :class:`~mxnet_tpu.autotune.Autotuner` run persists its result
+keyed by a **fingerprint of everything that changes the answer** — the
+model-symbol digest, the input shapes, the knob space, and the backend
+topology (platform + device kind + device count: a config tuned on one
+CPU box must not silently apply to an 8-chip TPU mesh).  Publication
+rides ``base.atomic_local_write`` (tmp + fsync + rename), the same
+crash-safety contract every other on-disk artifact in this repo uses: a
+killed tuner leaves either the old winner or the new one, never a torn
+file.
+
+Layout: ``$MXNET_AUTOTUNE_DIR/<key>.json`` (default
+``~/.cache/mxnet_tpu/autotune``), each file::
+
+    {"version": 1, "key": ..., "config": {...}, "cost_s": ...,
+     "meta": {...}, "log": [[{config}, cost_s], ...]}
+
+``log`` is the full measurement log the decision was made from —
+``select_best(log)`` is a pure function, so a stored log replays to the
+stored winner deterministically (tested), and a human can audit why a
+config won.
+
+Corrupt or unreadable entries load as None (warn once, delete): the
+tuner then simply re-measures, the same recover-by-redoing story the
+compile cache uses.
+"""
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..base import atomic_local_write, get_env
+
+__all__ = ["store_dir", "config_path", "load_config", "save_config",
+           "list_configs"]
+
+_VERSION = 1
+
+
+def store_dir() -> str:
+    """The store's root directory: ``MXNET_AUTOTUNE_DIR``, defaulting to
+    ``~/.cache/mxnet_tpu/autotune`` (created on first save)."""
+    d = get_env("MXNET_AUTOTUNE_DIR", "", str)
+    if not d:
+        d = os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu",
+                         "autotune")
+    return os.path.expanduser(d)
+
+
+def config_path(key: str) -> str:
+    return os.path.join(store_dir(), "%s.json" % key)
+
+
+def load_config(key: str) -> Optional[Dict[str, Any]]:
+    """The stored record for ``key``, or None (absent, corrupt, or a
+    different schema version — corrupt entries are deleted so the next
+    save is clean)."""
+    path = config_path(key)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        warnings.warn("autotune: dropping unreadable store entry %s (%s)"
+                      % (path, e))
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    if not isinstance(doc, dict) or doc.get("version") != _VERSION \
+            or "config" not in doc:
+        warnings.warn("autotune: dropping store entry %s with unknown "
+                      "schema" % path)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    return doc
+
+
+def save_config(key: str, config: Dict[str, Any], cost_s: float,
+                meta: Optional[Dict[str, Any]] = None,
+                log: Optional[List[Tuple[Dict[str, Any], float]]] = None) \
+        -> str:
+    """Atomically publish the winning config (+ the measurement log it
+    was selected from); returns the path."""
+    os.makedirs(store_dir(), exist_ok=True)
+    path = config_path(key)
+    doc = {"version": _VERSION, "key": key, "config": dict(config),
+           "cost_s": float(cost_s), "meta": dict(meta or {}),
+           "log": [[dict(c), float(s)] for (c, s) in (log or [])]}
+    with atomic_local_write(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    return path
+
+
+def list_configs() -> List[str]:
+    """Keys present in the store (for reports/debugging)."""
+    try:
+        names = os.listdir(store_dir())
+    except OSError:
+        return []
+    return sorted(n[:-5] for n in names if n.endswith(".json"))

@@ -1,0 +1,160 @@
+"""The tuner core: deterministic selection over a measurement log, a
+persistent-store fast path, and the profiler-visible run record.
+
+Decision discipline: measurement is noisy, selection is not.  Every
+candidate's cost lands in a measurement log ``[(config, cost_s), ...]``
+and :func:`select_best` is a PURE function of that log — minimum cost,
+ties broken by log order — so a stored log replays to the stored winner
+bit-for-bit (the determinism contract ``tests/test_autotune.py``
+enforces), and two processes that measured identically choose
+identically.
+
+An :class:`Autotuner` run:
+
+1. looks its key up in the store (``autotune.store``) — a hit applies
+   the persisted winner with zero measurements (``source="cache"``);
+2. otherwise measures every candidate through the caller's measure
+   function (span-timed; warm candidates cost one dispatch because the
+   programs ride ``compile_cache``), selects, and persists winner + log.
+
+Every run registers an :class:`AutotuneStats` with
+``mx.profiler.autotune_report()`` — key, source, per-candidate costs,
+winner, wall time — so "what did autotune decide and why" is one call.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, make_lock
+from . import store as _store
+
+__all__ = ["Autotuner", "AutotuneStats", "select_best"]
+
+Config = Dict[str, Any]
+Log = List[Tuple[Config, float]]
+
+
+def select_best(log: Log) -> Tuple[Config, float]:
+    """The winning (config, cost_s) of a measurement log: minimum cost,
+    ties broken by log order.  Pure and total on non-empty logs — the
+    whole determinism story rests on this staying a one-liner."""
+    if not log:
+        raise MXNetError("autotune: empty measurement log")
+    best_i = 0
+    for i, (_c, cost) in enumerate(log):
+        if cost < log[best_i][1]:
+            best_i = i
+    return dict(log[best_i][0]), float(log[best_i][1])
+
+
+class AutotuneStats:
+    """One tuning run's record for ``mx.profiler.autotune_report()``."""
+
+    def __init__(self, name: str, key: str):
+        self.name = name
+        self.key = key
+        self._lock = make_lock("autotune.stats")
+        self.source = "pending"      # -> "measured" | "cache"
+        self.trials: Log = []
+        self.best: Optional[Config] = None
+        self.best_cost_s: Optional[float] = None
+        self.wall_s = 0.0
+        self.store_path: Optional[str] = None
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "tuner": self.name,
+                "key": self.key,
+                "source": self.source,
+                "trials": [[dict(c), s] for (c, s) in self.trials],
+                "best": dict(self.best) if self.best else None,
+                "best_cost_s": self.best_cost_s,
+                "wall_s": round(self.wall_s, 4),
+                "store_path": self.store_path,
+            }
+
+    def report_str(self) -> str:
+        r = self.report()
+        lines = ["%s: %s (key %s..., %.3fs)"
+                 % (r["tuner"], r["source"], r["key"][:12], r["wall_s"])]
+        for cfg, cost in r["trials"]:
+            mark = " <== best" if cfg == r["best"] else ""
+            lines.append("  %-40s %10.6fs%s"
+                         % (_cfg_str(cfg), cost, mark))
+        if r["source"] == "cache" and r["best"] is not None:
+            lines.append("  %-40s %10s  (loaded from store)"
+                         % (_cfg_str(r["best"]),
+                            "%.6fs" % r["best_cost_s"]
+                            if r["best_cost_s"] is not None else "-"))
+        return "\n".join(lines)
+
+
+def _cfg_str(cfg: Config) -> str:
+    return ",".join("%s=%s" % (k, cfg[k]) for k in sorted(cfg))
+
+
+class Autotuner:
+    """Measure-or-load driver for one knob space (see module docstring).
+
+    Parameters
+    ----------
+    name : str
+        Report label ("fit:superstep", "serve:pipeline", ...).
+    key : str
+        Store key (``measure.tuning_key`` output) — everything that
+        changes the answer must be in it.
+    persist : bool
+        Write/read the on-disk store (default True; tests may disable).
+    """
+
+    def __init__(self, name: str, key: str, persist: bool = True):
+        self.name = name
+        self.key = key
+        self.persist = persist
+        self.stats = AutotuneStats(name, key)
+        from . import _register_stats
+        _register_stats(self.stats)
+
+    def tune(self, candidates: Sequence[Config],
+             measure: Callable[[Config], float],
+             meta: Optional[Dict[str, Any]] = None) -> Tuple[Config, float]:
+        """-> (winning config, its cost; cost is the stored one on a
+        cache hit).  ``candidates`` must be non-empty; a persisted
+        winner no longer in the candidate list is ignored (the space
+        changed under the key — re-measure)."""
+        if not candidates:
+            raise MXNetError("autotune %r: no candidates" % self.name)
+        t0 = time.perf_counter()
+        stats = self.stats
+        if self.persist:
+            doc = _store.load_config(self.key)
+            if doc is not None and any(doc["config"] == dict(c)
+                                       for c in candidates):
+                with stats._lock:
+                    stats.source = "cache"
+                    stats.best = dict(doc["config"])
+                    stats.best_cost_s = doc.get("cost_s")
+                    stats.trials = [(dict(c), float(s))
+                                    for c, s in doc.get("log") or []]
+                    stats.store_path = _store.config_path(self.key)
+                    stats.wall_s = time.perf_counter() - t0
+                return dict(doc["config"]), float(doc.get("cost_s") or 0.0)
+        log: Log = []
+        for cfg in candidates:
+            cost = float(measure(dict(cfg)))
+            log.append((dict(cfg), cost))
+        best, best_cost = select_best(log)
+        path = None
+        if self.persist:
+            path = _store.save_config(self.key, best, best_cost,
+                                      meta=meta, log=log)
+        with stats._lock:
+            stats.source = "measured"
+            stats.trials = log
+            stats.best = best
+            stats.best_cost_s = best_cost
+            stats.store_path = path
+            stats.wall_s = time.perf_counter() - t0
+        return best, best_cost

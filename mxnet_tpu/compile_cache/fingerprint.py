@@ -32,6 +32,7 @@ COMPILE_RELEVANT_ENV = (
     "MXNET_COMPUTE_DTYPE",
     "MXNET_EXEC_PREFER_BULK_EXEC",
     "MXNET_FUSED_TRAIN",
+    "MXNET_FUSE_PALLAS",
     "MXNET_LSTM_SCAN",
     "MXNET_SHARD_WEIGHT_UPDATE",
     "MXNET_SUPERSTEP",

@@ -212,7 +212,7 @@ class BaseModule:
             num_epoch=None, validation_metric=None, monitor=None,
             work_load_list=None, prefetch_to_device=False,
             checkpoint=None, checkpoint_every=None, resume=False,
-            superstep=None, mesh=None, sharding=None):
+            superstep=None, mesh=None, sharding=None, autotune=None):
         """Train (reference base_module.py:273-393).
 
         ``mesh``/``sharding``: first-class multichip training.  ``mesh``
@@ -248,6 +248,18 @@ class BaseModule:
         carrying the megabatch ``group`` rather than a per-batch
         ``data_batch``; a callback that needs per-batch locals or
         outputs should declare ``inspects_outputs = True``.
+
+        ``autotune``: measurement-driven knob tuning
+        (``mxnet_tpu.autotune``).  When True (or ``MXNET_AUTOTUNE=1``
+        with ``autotune=None``) and neither ``superstep=`` nor
+        ``MXNET_SUPERSTEP`` chose a K, the superstep is picked by
+        dispatching candidate programs on a COPY of the train state —
+        training never advances during measurement — with cost read
+        from trace spans, and the winner persisted per (model, shapes,
+        optimizer, topology) fingerprint under ``MXNET_AUTOTUNE_DIR``;
+        the next fit of the same model loads it without measuring.
+        Candidates that a superstep blocker rules out are never
+        measured.  ``mx.profiler.autotune_report()`` shows the decision.
 
         ``checkpoint``: a ``mx.checkpoint.CheckpointManager`` (or a
         directory path, wrapped in one with defaults) for crash-safe
@@ -344,10 +356,31 @@ class BaseModule:
             if not isinstance(eval_metric, metric_mod.EvalMetric):
                 eval_metric = metric_mod.create(eval_metric)
 
-            # superstep resolution: K from the argument or the env knob,
-            # then every semantic blocker gets a logged fallback to K=1
-            k_super = int(superstep) if superstep is not None \
-                else get_env("MXNET_SUPERSTEP", 1, int)
+            # superstep resolution: K from the argument, the env knob,
+            # or (neither set + autotune on) the measured winner; then
+            # every semantic blocker gets a logged fallback to K=1
+            k_env = get_env("MXNET_SUPERSTEP", None, int)
+            if superstep is not None:
+                k_super = int(superstep)
+            elif k_env is not None:
+                k_super = k_env
+            else:
+                k_super = 1
+                from ..autotune import enabled as _autotune_enabled
+                if _autotune_enabled(autotune) and \
+                        callable(getattr(self, "superstep_train", None)) \
+                        and getattr(self, "_fused", None) is not None:
+                    from ..autotune import tune_superstep
+
+                    def _viable(k):
+                        return self._superstep_blockers(
+                            eval_metric, k, monitor=monitor,
+                            batch_end_callback=batch_end_callback,
+                            checkpoint_every=(ckpt_mgr.save_every_steps
+                                              if ckpt_mgr is not None
+                                              else None))
+                    k_super = tune_superstep(self, viable=_viable)
+                    self.logger.info("autotune: superstep K=%d", k_super)
             k_super = max(1, k_super)
             use_super = k_super > 1 and callable(
                 getattr(self, "superstep_train", None))

@@ -11,6 +11,7 @@ from . import nn      # noqa: F401  (registers NN layers)
 from . import special  # noqa: F401 (registers ROIPooling/SpatialTransformer/Correlation)
 from . import rnn     # noqa: F401  (registers the fused scan-based RNN)
 from . import quantized  # noqa: F401 (registers q/dq + int8 matmul/conv)
+from . import fused   # noqa: F401  (registers the epilogue-fused op family)
 
 __all__ = ["OpDef", "OpContext", "Param", "register_op", "register_simple_op",
            "get_op", "list_ops"]

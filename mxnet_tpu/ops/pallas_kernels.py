@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .quantized import INT8_QMAX
+
 try:
     from jax.experimental import pallas as pl
     HAS_PALLAS = True
@@ -25,7 +27,8 @@ except Exception:  # pragma: no cover
     pl = None
     HAS_PALLAS = False
 
-__all__ = ["flash_attention", "correlation", "HAS_PALLAS"]
+__all__ = ["flash_attention", "correlation", "fused_fc_epilogue",
+           "HAS_PALLAS"]
 
 
 def _attention_dense(q, k, v, causal):
@@ -119,6 +122,68 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _fc_epilogue_kernel(x_ref, w_ref, b_ref, o_ref, *, act_type, out_scale):
+    """One N-block of act(x·Wᵀ + b) [+ int8 requantize]: the epilogue
+    rides the MXU tile's output registers — one VMEM round trip for the
+    whole matmul+bias+act(+quantize) chain instead of one per op."""
+    x = x_ref[...].astype(jnp.float32)                 # (M, K)
+    w = w_ref[...].astype(jnp.float32)                 # (block_n, K)
+    acc = jnp.dot(x, w.T, preferred_element_type=jnp.float32)
+    acc = acc + b_ref[...][None, :]
+    if act_type == "relu":
+        acc = jnp.maximum(acc, 0.0)
+    elif act_type == "sigmoid":
+        acc = jax.nn.sigmoid(acc)
+    elif act_type == "tanh":
+        acc = jnp.tanh(acc)
+    elif act_type == "softrelu":
+        acc = jax.nn.softplus(acc)
+    if out_scale is not None:
+        acc = jnp.clip(jnp.round(acc / out_scale), -INT8_QMAX, INT8_QMAX)
+    o_ref[...] = acc.astype(o_ref.dtype)
+
+
+def fused_fc_epilogue(x, w, b, act_type: str, out_scale=None,
+                      block_n: int = 128, interpret: bool = False):
+    """FullyConnected epilogue kernel: x (M, K) · w (N, K)ᵀ + b, fused
+    activation, optional int8 requantize (``out_scale``).  Returns the
+    (M, N) result — f32, or int8 when ``out_scale`` is set — or None
+    when the Pallas path is unavailable/ineligible (off-TPU without
+    ``interpret``, odd shapes, unknown act): the caller falls back to
+    the jnp body, which keeps CPU tier-1 numerics identical to the
+    unfused graph."""
+    on_tpu = jax.default_backend() == "tpu"
+    if not HAS_PALLAS or (not on_tpu and not interpret):
+        return None
+    if act_type not in ("none", "relu", "sigmoid", "tanh", "softrelu"):
+        return None
+    m, k = x.shape
+    n = w.shape[0]
+    # MXU lane/sublane alignment: K and N on the 128 lanes; M must fill
+    # the output tile's sublanes (8 for f32, 32 for an int8 result)
+    min_m = 32 if out_scale is not None else 8
+    if n % block_n or k % 128 or (on_tpu and m % min_m):
+        return None
+    if b is None:
+        b = jnp.zeros((n,), jnp.float32)
+    out_dtype = jnp.int8 if out_scale is not None else x.dtype
+    kernel = functools.partial(
+        _fc_epilogue_kernel, act_type=act_type,
+        out_scale=None if out_scale is None else float(out_scale))
+    return pl.pallas_call(
+        kernel,
+        grid=(n // block_n,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda i: (0, 0)),
+            pl.BlockSpec((block_n, k), lambda i: (i, 0)),
+            pl.BlockSpec((block_n,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((m, block_n), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        interpret=interpret,
+    )(x, w, b)
 
 
 def _correlation_kernel(a_ref, b_ref, o_ref, *, d2, stride2, base, hh, ww,

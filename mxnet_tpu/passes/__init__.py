@@ -10,6 +10,10 @@ rewrites the graph BEFORE the compiler sees it —
 * ``U8WirePass``                 in-graph uint8 cast/normalize prologue
 * ``QuantizePass``               calibrated int8 (fp16 fallback) q/dq
                                  insertion for the matmul/conv family
+* ``FuseEpiloguePass``           matmul/conv + bias + Activation
+                                 (+ ``_contrib_quantize``) -> one
+                                 ``_fused_*`` op (TVM's epilogue fusion)
+* ``ElementwiseFusePass``        elementwise chains -> ``_fused_elemwise``
 
 with per-pass trace spans and ``mx.profiler.passes_report()``, a
 round-trip + attr-preservation verifier after every pass, and a pipeline
@@ -36,6 +40,8 @@ from .graph_passes import (CSEPass, DeadNodeEliminationPass,
                            FoldConstantsPass, U8WirePass, rebuild,
                            tensor_name)
 from .calibrate import CalibrationTable, calibrate, calibrate_arrays
+from .fuse import (ElementwiseFusePass, FuseEpiloguePass, default_fuse,
+                   fusion_passes)
 from .quantize import (QuantizePass, build_serving_pipeline,
                        default_fallback_dtype, default_inference_pipeline,
                        default_quantize_ops, quantize_model)
@@ -45,6 +51,8 @@ __all__ = [
     "check_attrs_preserved", "diff_attrs", "verify_roundtrip",
     "CSEPass", "DeadNodeEliminationPass", "FoldConstantsPass",
     "U8WirePass", "rebuild", "tensor_name",
+    "ElementwiseFusePass", "FuseEpiloguePass", "default_fuse",
+    "fusion_passes",
     "CalibrationTable", "calibrate", "calibrate_arrays",
     "QuantizePass", "build_serving_pipeline", "default_fallback_dtype",
     "default_inference_pipeline", "default_quantize_ops", "quantize_model",

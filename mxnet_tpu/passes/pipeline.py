@@ -60,6 +60,12 @@ class Pass:
     """
 
     name = "pass"
+    # names of passes that, when present in the same pipeline, must run
+    # BEFORE this one.  PassPipeline validates the order at construction
+    # and raises a PassError carrying the corrected order — the fusion
+    # passes use this: FuseEpiloguePass before QuantizePass silently
+    # defeats int8 epilogue fusion (quantize skips _fused_* nodes).
+    order_after: Tuple[str, ...] = ()
 
     def __init__(self):
         self.summary: Dict[str, Any] = {}
@@ -161,6 +167,7 @@ class PassPipeline:
                                 % (p,))
         self.name = name
         self.verify = verify
+        self._validate_order()
         self.stats = PassStats(name)
         from .. import profiler
         profiler.register_passes_stats(self.stats)
@@ -168,6 +175,43 @@ class PassPipeline:
         #            "summary": {...}}, ...] — dump_passes.py reads this
         self.last_report: List[Dict[str, Any]] = []
         self.type_overrides: Dict[str, Any] = {}
+
+    # -- ordering ----------------------------------------------------------
+    def canonical_order(self) -> List[Pass]:
+        """The pass list re-ordered to satisfy every ``order_after``
+        declaration, stably (ties keep the given order).  A declaration
+        cycle falls back to the given order for the cyclic remainder."""
+        remaining = list(self.passes)
+        out: List[Pass] = []
+        while remaining:
+            for i, p in enumerate(remaining):
+                deps = set(p.order_after)
+                if not any(q.name in deps for q in remaining if q is not p):
+                    out.append(remaining.pop(i))
+                    break
+            else:
+                out.extend(remaining)     # cycle: keep given order
+                break
+        return out
+
+    def _validate_order(self) -> None:
+        """Fail LOUD on a mis-ordered pipeline instead of silently
+        producing a worse graph: running FuseEpiloguePass before
+        QuantizePass, for example, defeats int8 epilogue fusion because
+        quantize only rewrites unfused FullyConnected/Convolution
+        nodes.  The error carries the corrected order."""
+        violations = []
+        for i, p in enumerate(self.passes):
+            for dep in p.order_after:
+                if any(q.name == dep for q in self.passes[i + 1:]):
+                    violations.append("%r must run after %r" % (p.name, dep))
+        if violations:
+            raise PassError(
+                "pipeline %r pass ordering invalid: %s — the early pass "
+                "would silently rewrite nodes the later pass needs to "
+                "see in their unrewritten form.  Corrected order: %s"
+                % (self.name, "; ".join(violations),
+                   [p.name for p in self.canonical_order()]))
 
     # -- identity ----------------------------------------------------------
     def fingerprint(self) -> str:

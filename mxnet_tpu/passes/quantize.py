@@ -383,24 +383,32 @@ class QuantizePass(Pass):
 
 def default_inference_pipeline(quantize: Optional[QuantizePass] = None,
                                u8_wire: Optional[U8WirePass] = None,
+                               fuse=None,
                                name: str = "inference",
                                verify: bool = True) -> PassPipeline:
     """The serving pipeline: [u8 wire] -> fold -> cse -> dce ->
-    [quantize].  Order matters: the u8 prologue must exist before
-    calibration sees the graph; folds/CSE/DCE shrink what calibration
-    and quantization must visit."""
+    [quantize] -> [fuse].  Order matters: the u8 prologue must exist
+    before calibration sees the graph; folds/CSE/DCE shrink what
+    calibration and quantization must visit; fusion runs LAST so the
+    int8 epilogues exist to fuse (the pipeline enforces this ordering
+    — see ``passes.fuse``).  ``fuse``: falsy = off (the default here;
+    ``build_serving_pipeline`` defaults it on via ``MXNET_FUSE``), True
+    or a dict of FuseEpiloguePass kwargs + ``elemwise``."""
+    from .fuse import fusion_passes
     passes: List[Pass] = []
     if u8_wire is not None:
         passes.append(u8_wire)
     passes += [FoldConstantsPass(), CSEPass(), DeadNodeEliminationPass()]
     if quantize is not None:
         passes.append(quantize)
+    passes += fusion_passes(fuse)
     return PassPipeline(passes, name=name, verify=verify)
 
 
 def build_serving_pipeline(quantize=None, calib_data=None, calib_shapes=None,
                            data_name: str = "data", u8_wire=None,
-                           name: str = "serve", ctx=None) -> PassPipeline:
+                           fuse=None, name: str = "serve",
+                           ctx=None) -> PassPipeline:
     """ServeEngine's pipeline factory.
 
     ``quantize``: falsy = off; ``"int8"``/``"float16"``/``"bfloat16"``;
@@ -408,8 +416,13 @@ def build_serving_pipeline(quantize=None, calib_data=None, calib_shapes=None,
     needs ``calib_data`` (a sample of requests in WIRE format — u8 HWC
     items when ``u8_wire`` is on) or an explicit ``calib=`` table in the
     dict.  ``u8_wire``: falsy = off; True or a dict with
-    ``mean``/``scale``/``hwc``.
+    ``mean``/``scale``/``hwc``.  ``fuse``: None = the ``MXNET_FUSE``
+    default (on); False = off; True/dict = fusion passes appended after
+    quantization (see ``passes.fuse``).
     """
+    from .fuse import default_fuse
+    if fuse is None:
+        fuse = default_fuse()
     u8_pass = None
     if u8_wire:
         kw = dict(u8_wire) if isinstance(u8_wire, dict) else {}
@@ -443,7 +456,7 @@ def build_serving_pipeline(quantize=None, calib_data=None, calib_shapes=None,
         q_pass = QuantizePass(**kw)
         q_pass.ctx = ctx if q_pass.ctx is None else q_pass.ctx
     return default_inference_pipeline(quantize=q_pass, u8_wire=u8_pass,
-                                      name=name)
+                                      fuse=fuse, name=name)
 
 
 def quantize_model(sym: Symbol, arg_params: Dict, aux_params: Dict,

@@ -33,6 +33,8 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "superstep_report_str", "register_serve_stats", "serve_report",
            "serve_report_str", "compile_report", "compile_report_str",
            "register_passes_stats", "passes_report", "passes_report_str",
+           "register_autotune_stats", "autotune_report",
+           "autotune_report_str",
            "MultichipStats", "register_multichip_stats",
            "parse_hlo_collectives", "multichip_report",
            "multichip_report_str", "unified_report", "unified_report_str"]
@@ -582,6 +584,31 @@ def passes_report_str() -> str:
     return _passes_registry.report_str()
 
 
+# -- autotune instrumentation (mxnet_tpu.autotune) ---------------------------
+# One AutotuneStats per tuning run (fit's superstep search, a serve
+# engine's pipeline-variant search).  Registered weakly like every other
+# registry; the autotune package ALSO keeps the last N strongly, so a
+# report after the tuning call returns still shows what was decided.
+_autotune_registry = _Registry("autotune", "(no autotune runs)")
+
+
+def register_autotune_stats(autotune_stats) -> None:
+    """Called by autotune.Autotuner on construction."""
+    _autotune_registry.register(autotune_stats)
+
+
+def autotune_report() -> dict:
+    """{run key: record} per tuning run: the store key, whether the
+    config was measured or loaded, every candidate's measured cost, and
+    the winner (see mxnet_tpu.autotune)."""
+    return _autotune_registry.report()
+
+
+def autotune_report_str() -> str:
+    """Human-readable candidate/cost table per tuning run."""
+    return _autotune_registry.report_str()
+
+
 # -- compilation instrumentation (mxnet_tpu.compile_cache) -------------------
 # Compilation is process-global (one XLA compiler, one jit cache, one disk
 # cache), so unlike the per-instance registries above there is exactly one
@@ -614,6 +641,7 @@ def unified_report() -> dict:
         "checkpoint": checkpoint_report(),
         "serve": serve_report(),
         "passes": passes_report(),
+        "autotune": autotune_report(),
     }
     try:
         out["compile"] = compile_report()
@@ -633,6 +661,7 @@ def unified_report_str() -> str:
         ("checkpoint", checkpoint_report_str),
         ("serve", serve_report_str),
         ("passes", passes_report_str),
+        ("autotune", autotune_report_str),
         ("compile", compile_report_str),
     ]
     parts = []

@@ -94,6 +94,18 @@ class ServeEngine:
         on gather.  Composes with hot reload (a swapped weight lands
         back in its shard sharding) and the compile cache (mesh axes
         join the program keys).
+    fuse :
+        Operator fusion on the serving graph (``passes.fuse``): None =
+        the ``MXNET_FUSE`` default when a pipeline is built (on), False
+        = off, True/dict = fusion passes even without quantization.
+        Fusion is exact (bitwise in f32).
+    autotune :
+        ``True`` (or ``MXNET_AUTOTUNE=1`` with ``autotune=None``) picks
+        the pass-pipeline variant by measurement — candidates are timed
+        through ``compile_cache``-warmed predictors, the winner is
+        persisted per (model, topology) fingerprint
+        (``MXNET_AUTOTUNE_DIR``) and reloaded with zero measurements on
+        the next construction.  See ``mx.profiler.autotune_report()``.
     quantize / calib_data / u8_wire / pipeline :
         Graph-optimized serving (``mxnet_tpu.passes``).  ``quantize=``
         takes ``"int8"`` (needs ``calib_data``: a sample of requests in
@@ -123,7 +135,7 @@ class ServeEngine:
                  name: str = "serve", warmup: bool = True,
                  mesh=None, param_specs: Optional[Dict] = None,
                  quantize=None, calib_data=None, u8_wire=None,
-                 pipeline=None):
+                 fuse=None, pipeline=None, autotune=None):
         if not input_shapes:
             raise ServeError("input_shapes must name at least one input")
         sym_json = symbol.tojson() if hasattr(symbol, "tojson") else symbol
@@ -182,13 +194,30 @@ class ServeEngine:
         if self._param_specs and mesh is None:
             raise ServeError("param_specs without mesh=: specs are "
                              "PartitionSpecs over a named mesh")
-        if pipeline is None and (quantize or u8_wire):
+        from ..autotune import enabled as _autotune_enabled
+        autotuned = False
+        if pipeline is None and fuse is None and _autotune_enabled(autotune):
+            # measurement-driven pipeline-variant choice (fusion on/off
+            # around the same fold/CSE/DCE[/quantize] spine); the winner
+            # is persisted per (symbol, shapes, quantize, topology) and
+            # a fresh process loads it without measuring.  An explicit
+            # fuse= argument always wins — tuning only decides where the
+            # call site did not (the documented MXNET_AUTOTUNE contract)
+            from ..autotune import tune_serve_pipeline
+            fuse, pipeline = tune_serve_pipeline(
+                sym_json, params,
+                self._shapes_by_bucket[self.max_batch_size],
+                data_name=data_name, quantize=quantize,
+                calib_data=calib_data, u8_wire=u8_wire,
+                dev=(dev_type, dev_id), name=name)
+            autotuned = True
+        if pipeline is None and (quantize or u8_wire or fuse or autotuned):
             from ..passes import build_serving_pipeline
             pipeline = build_serving_pipeline(
                 quantize=quantize, calib_data=calib_data,
                 calib_shapes=self._shapes_by_bucket[self.max_batch_size],
-                data_name=data_name, u8_wire=u8_wire, name=name,
-                ctx=Context(dev_type, dev_id))
+                data_name=data_name, u8_wire=u8_wire, fuse=fuse,
+                name=name, ctx=Context(dev_type, dev_id))
         self.pipeline = pipeline
         self._predictor = Predictor(
             sym_json, params, self._shapes_by_bucket[self.max_batch_size],

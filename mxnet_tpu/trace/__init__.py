@@ -49,7 +49,7 @@ __all__ = ["span", "complete", "instant", "async_begin", "async_instant",
            "async_end", "next_async_id", "enabled", "set_enabled",
            "dump_trace", "add_spill_dir", "spill_dirs", "configure_spill",
            "flush_spill", "label_process", "event_count", "drop_count",
-           "trace_report", "reset", "maybe_journal_step",
+           "span_events", "trace_report", "reset", "maybe_journal_step",
            "write_journal_line", "journal_path", "journal_every",
            "reset_journal"]
 
@@ -234,6 +234,30 @@ def event_count() -> int:
 
 def drop_count() -> int:
     return _recorder.drop_count()
+
+
+def span_events(names=None, since_ns: Optional[int] = None,
+                cat: Optional[str] = None) -> List[Dict]:
+    """Matching complete-span event dicts from this process's rings
+    (Chrome format: ``ts``/``dur`` in microseconds, perf_counter
+    timeline).  ``names`` filters by span name, ``since_ns`` (a
+    ``time.perf_counter_ns()`` watermark) keeps only spans that started
+    at or after it.  This is how the autotuner reads candidate cost out
+    of the same span timeline every hot path already records — the
+    measurement the report shows IS the measurement the trace shows."""
+    name_set = set(names) if names is not None else None
+    out = []
+    for e in _recorder.snapshot():
+        if e.get("ph") != "X":
+            continue
+        if name_set is not None and e["name"] not in name_set:
+            continue
+        if cat is not None and e.get("cat") != cat:
+            continue
+        if since_ns is not None and e["ts"] * 1000.0 < since_ns:
+            continue
+        out.append(e)
+    return out
 
 
 def dump_trace(path: str) -> str:

@@ -1,0 +1,352 @@
+"""mxnet_tpu.autotune: measurement-driven knob search (tier-1, CPU).
+
+ISSUE 11 contracts: selection is a PURE function of the measurement log
+(fixed log -> same winner, ties by order); the winning config persists
+atomically per (model, topology) fingerprint and RELOADS across a fresh
+subprocess with zero measurements; corrupt store entries re-measure
+instead of crashing; fit-side superstep tuning never advances training
+state; ``Module.fit(autotune=True)`` / ``ServeEngine(autotune=True)`` /
+``MXNET_AUTOTUNE`` wire it in; and ``mx.profiler.autotune_report()``
+shows every decision with its evidence.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autotune as at
+from mxnet_tpu.autotune import (Autotuner, load_config, save_config,
+                                select_best, tune_superstep, tuning_key)
+
+IN_DIM = 8
+HIDDEN = 16
+CLASSES = 4
+
+
+def _net():
+    # explicit names everywhere: auto-generated names (activation0,
+    # activation1, ...) increment per process, and the tuning key
+    # digests the symbol json — an auto-named model would re-measure on
+    # every fresh construction instead of hitting the store
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=HIDDEN, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="act1")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _module(batch=8):
+    rng = np.random.RandomState(0)
+    X = rng.rand(4 * batch, IN_DIM).astype(np.float32)
+    y = rng.randint(0, CLASSES, 4 * batch).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    return mod, it
+
+
+# ---------------------------------------------------------------------------
+# selection determinism
+
+
+def test_select_best_is_pure_and_deterministic():
+    log = [({"k": 1}, 0.5), ({"k": 2}, 0.2), ({"k": 4}, 0.9)]
+    for _ in range(3):
+        best, cost = select_best(list(log))
+        assert best == {"k": 2} and cost == 0.2
+    # ties break by log ORDER, not dict contents
+    tied = [({"k": 8}, 0.2), ({"k": 2}, 0.2)]
+    assert select_best(tied)[0] == {"k": 8}
+    with pytest.raises(mx.base.MXNetError):
+        select_best([])
+
+
+def test_tuner_replays_fixed_log_to_same_winner(tmp_path, monkeypatch):
+    """Given the same measurement log (injected via a fake measure fn),
+    two tuner runs pick the same winner — and the stored log replays to
+    the stored config through select_best."""
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    costs = {1: 0.43, 2: 0.19, 4: 0.19, 8: 0.77}     # 2 vs 4 tied
+    cands = [{"superstep": k} for k in (1, 2, 4, 8)]
+
+    def measure(cfg):
+        return costs[cfg["superstep"]]
+
+    winners = set()
+    for i in range(2):
+        t = Autotuner("t-replay", "key-replay-%d" % i, persist=False)
+        best, cost = t.tune(cands, measure)
+        winners.add((best["superstep"], cost))
+    assert winners == {(2, 0.19)}
+    # persisted log -> select_best -> persisted winner, bit for bit
+    t = Autotuner("t-persist", "key-persist", persist=True)
+    best, _ = t.tune(cands, measure)
+    doc = load_config("key-persist")
+    replayed, _ = select_best([(c, s) for c, s in doc["log"]])
+    assert replayed == doc["config"] == best
+
+
+# ---------------------------------------------------------------------------
+# the store
+
+
+def test_store_roundtrip_atomic_and_corrupt(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    path = save_config("k1", {"superstep": 4}, 0.01,
+                       meta={"note": "t"}, log=[({"superstep": 4}, 0.01)])
+    assert os.path.dirname(path) == str(tmp_path)
+    doc = load_config("k1")
+    assert doc["config"] == {"superstep": 4} and doc["cost_s"] == 0.01
+    # no temp droppings from the atomic publish
+    assert all(not f.startswith("k1.json.tmp") for f in os.listdir(str(tmp_path)))
+    # corrupt entry: load as None AND self-delete so the next save is clean
+    with open(path, "w") as f:
+        f.write("{torn")
+    with pytest.warns(UserWarning):
+        assert load_config("k1") is None
+    assert not os.path.exists(path)
+    # wrong schema version: same story
+    with open(path, "w") as f:
+        json.dump({"version": 99, "config": {}}, f)
+    with pytest.warns(UserWarning):
+        assert load_config("k1") is None
+
+
+def test_tuner_cache_hit_skips_measurement(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    calls = []
+
+    def measure(cfg):
+        calls.append(dict(cfg))
+        return 0.1 * cfg["k"]
+
+    cands = [{"k": 1}, {"k": 2}]
+    t1 = Autotuner("t-cache", "key-c", persist=True)
+    best1, _ = t1.tune(cands, measure)
+    assert best1 == {"k": 1} and len(calls) == 2
+    t2 = Autotuner("t-cache", "key-c", persist=True)
+    best2, _ = t2.tune(cands, measure)
+    assert best2 == best1
+    assert len(calls) == 2                      # zero new measurements
+    assert t2.stats.report()["source"] == "cache"
+    # a stored winner no longer in the candidate space re-measures
+    t3 = Autotuner("t-cache", "key-c", persist=True)
+    t3.tune([{"k": 2}, {"k": 3}], measure)
+    assert len(calls) == 4
+
+
+def test_tuning_key_covers_backend_and_parts():
+    k1 = tuning_key("a", (1, 2))
+    assert k1 == tuning_key("a", (1, 2))        # stable
+    assert k1 != tuning_key("a", (1, 3))
+    assert len(k1) == 64
+
+
+# ---------------------------------------------------------------------------
+# fit-side: superstep tuning
+
+
+def test_tune_superstep_picks_and_persists(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    mod, _it = _module()
+    import jax
+    before = jax.tree_util.tree_map(np.asarray, mod._fused_state)
+    k = tune_superstep(mod, candidates=(1, 2, 4), trials=2)
+    assert k in (1, 2, 4)
+    # measurement ran on COPIES: the live train state is untouched
+    after = jax.tree_util.tree_map(np.asarray, mod._fused_state)
+    for (pa, pb) in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(after)):
+        np.testing.assert_array_equal(pa, pb)
+    assert mod._fused_t == 0
+    # persisted + reported
+    assert len(os.listdir(str(tmp_path))) == 1
+    rep = mx.profiler.autotune_report()
+    mine = [v for v in rep.values() if v["tuner"] == "fit:superstep"]
+    assert mine and mine[-1]["source"] == "measured"
+    assert {c["superstep"] for c, _s in mine[-1]["trials"]} == {1, 2, 4}
+    assert "fit:superstep" in mx.profiler.autotune_report_str()
+    # a second module of the same model: cache, same K
+    mod2, _ = _module()
+    assert tune_superstep(mod2, candidates=(1, 2, 4), trials=2) == k
+    rep2 = mx.profiler.autotune_report()
+    mine2 = [v for v in rep2.values() if v["tuner"] == "fit:superstep"]
+    assert mine2[-1]["source"] == "cache"
+
+
+def test_tune_superstep_respects_blockers(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    mod, _it = _module()
+    k = tune_superstep(mod, candidates=(1, 2, 4, 8),
+                       viable=lambda k: None if k <= 2 else "blocked",
+                       trials=1)
+    assert k in (1, 2)
+    doc = load_config(list(at.list_configs())[0])
+    assert {c["superstep"] for c, _s in
+            [(c, s) for c, s in doc["log"]]} == {1, 2}
+
+
+def test_fit_autotune_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    mod, it = _module()
+    mod2 = mx.mod.Module(_net(), context=mx.cpu())
+    it.reset()
+    mod2.fit(it, num_epoch=1, autotune=True,
+             optimizer_params={"learning_rate": 0.1})
+    assert os.listdir(str(tmp_path))            # winner persisted
+    arg, _aux = mod2.get_params()
+    for v in arg.values():
+        assert np.isfinite(v.asnumpy()).all()
+    # an explicit superstep= wins over autotune (no second store entry
+    # for a differently-keyed space; the explicit K is used untouched)
+    it.reset()
+    mod3 = mx.mod.Module(_net(), context=mx.cpu())
+    n_before = len(os.listdir(str(tmp_path)))
+    mod3.fit(it, num_epoch=1, autotune=True, superstep=2,
+             optimizer_params={"learning_rate": 0.1})
+    assert len(os.listdir(str(tmp_path))) == n_before
+
+
+def test_mxnet_autotune_env_knob(monkeypatch):
+    from mxnet_tpu.autotune import enabled
+    monkeypatch.delenv("MXNET_AUTOTUNE", raising=False)
+    assert enabled(None) is False
+    assert enabled(True) is True
+    monkeypatch.setenv("MXNET_AUTOTUNE", "1")
+    assert enabled(None) is True
+    assert enabled(False) is False              # explicit arg wins
+
+
+# ---------------------------------------------------------------------------
+# persistence across a FRESH subprocess (the acceptance bar)
+
+
+_SUBPROC = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu.autotune import tune_superstep
+
+    IN_DIM, HIDDEN, CLASSES = 8, 16, 4
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=HIDDEN, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="act1")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, IN_DIM).astype(np.float32)
+    y = rng.randint(0, CLASSES, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    k = tune_superstep(mod, candidates=(1, 2, 4), trials=2)
+    rep = mx.profiler.autotune_report()
+    run = [v for v in rep.values() if v["tuner"] == "fit:superstep"][-1]
+    print("RESULT", k, run["source"])
+""")
+
+
+@pytest.mark.slow
+def test_winning_config_reloads_in_fresh_subprocess(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_AUTOTUNE_DIR=str(tmp_path))
+
+    def run_child():
+        res = subprocess.run([sys.executable, "-c", _SUBPROC],
+                             capture_output=True, text=True, timeout=600,
+                             env=env, cwd=os.path.dirname(
+                                 os.path.dirname(os.path.abspath(__file__))))
+        assert res.returncode == 0, res.stdout + res.stderr
+        line = [ln for ln in res.stdout.splitlines()
+                if ln.startswith("RESULT")][0]
+        _tag, k, source = line.split()
+        return int(k), source
+
+    k1, source1 = run_child()
+    assert source1 == "measured"
+    files = os.listdir(str(tmp_path))
+    assert len(files) == 1
+    k2, source2 = run_child()                   # FRESH process
+    assert source2 == "cache"
+    assert k2 == k1
+    # the store entry carries the full evidence log (read directly:
+    # MXNET_AUTOTUNE_DIR points there only in the CHILD's env)
+    with open(os.path.join(str(tmp_path), files[0])) as f:
+        doc = json.load(f)
+    assert doc["config"] == {"superstep": k1}
+    assert len(doc["log"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# serve-side: pipeline-variant tuning
+
+
+def test_serve_autotune_parity_and_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    from mxnet_tpu.serve import ServeEngine
+    rng = np.random.RandomState(0)
+    params = {"fc1_weight": (rng.randn(HIDDEN, IN_DIM) * 0.3
+                             ).astype(np.float32),
+              "fc1_bias": np.zeros(HIDDEN, np.float32),
+              "fc2_weight": (rng.randn(CLASSES, HIDDEN) * 0.3
+                             ).astype(np.float32),
+              "fc2_bias": np.zeros(CLASSES, np.float32)}
+    shapes = {"data": (1, IN_DIM), "softmax_label": (1,)}
+    net = _net()
+    ref = ServeEngine(net, dict(params), shapes, batch_buckets=(1, 2),
+                      name="t-ref")
+    eng = ServeEngine(net, dict(params), shapes, batch_buckets=(1, 2),
+                      name="t-at", autotune=True)
+    try:
+        assert eng.pipeline is not None         # tuned variant applied
+        X = rng.rand(6, IN_DIM).astype(np.float32)
+        for x in X:
+            np.testing.assert_array_equal(eng.predict(x, timeout=60),
+                                          ref.predict(x, timeout=60))
+    finally:
+        eng.close()
+        ref.close()
+    assert os.listdir(str(tmp_path))
+    eng2 = ServeEngine(net, dict(params), shapes, batch_buckets=(1, 2),
+                       name="t-at2", autotune=True)
+    eng2.close()
+    rep = mx.profiler.autotune_report()
+    mine = [v for v in rep.values() if v["tuner"] == "serve:pipeline"]
+    assert mine[-1]["source"] == "cache"
+    assert mine[-1]["best"] in ({"fuse": True}, {"fuse": False})
+    # autotune decisions land in the unified report too
+    assert "autotune" in mx.profiler.unified_report()
+
+
+def test_serve_autotune_explicit_fuse_wins(tmp_path, monkeypatch):
+    """An explicit fuse= argument is the call site DECIDING — autotune
+    must not override it (the documented MXNET_AUTOTUNE contract)."""
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_AUTOTUNE", "1")
+    from mxnet_tpu.serve import ServeEngine
+    rng = np.random.RandomState(0)
+    params = {"fc1_weight": (rng.randn(HIDDEN, IN_DIM) * 0.3
+                             ).astype(np.float32),
+              "fc1_bias": np.zeros(HIDDEN, np.float32),
+              "fc2_weight": (rng.randn(CLASSES, HIDDEN) * 0.3
+                             ).astype(np.float32),
+              "fc2_bias": np.zeros(CLASSES, np.float32)}
+    shapes = {"data": (1, IN_DIM), "softmax_label": (1,)}
+    eng = ServeEngine(_net(), dict(params), shapes, batch_buckets=(1,),
+                      name="t-explicit", fuse=False)
+    try:
+        # no tuning ran (nothing persisted) and no fusion was applied
+        assert not os.listdir(str(tmp_path))
+        assert eng.pipeline is None
+    finally:
+        eng.close()
