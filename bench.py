@@ -461,6 +461,19 @@ def main():
     except Exception as e:
         sys.stderr.write("bench: fusion leg failed (%s)\n" % e)
     _PARTIAL_LINE = dict(line)
+    # sharded-embedding leg (mxnet_tpu.embed, ISSUE 12): deduped sparse
+    # update vs the naive per-occurrence scatter-add / full-table-sweep
+    # baseline at rec-traffic duplication (acceptance: speedup >= 2x),
+    # the full fused rec-model step sparse vs dense, the live dedup
+    # ratio, and closed-loop rec-serve QPS (ids -> embedding -> tower
+    # through ServeEngine(embed_dedup=True), parity-checked)
+    try:
+        from bench_embed import run as embed_run
+        _feed_watchdog("embed")
+        line.update(embed_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: embed leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
     # compile / cold-start leg (mxnet_tpu.compile_cache): cold-process vs
     # warm-cache construction of the serve bucket grid and a 4-bucket
     # LSTM BucketingModule (acceptance: compile_cache_speedup >= 2 with

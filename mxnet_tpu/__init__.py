@@ -59,6 +59,7 @@ from . import checkpoint
 from . import compile_cache
 from . import passes
 from . import autotune
+from . import embed
 from . import predictor
 from . import serve
 from . import trace

@@ -1,0 +1,39 @@
+"""mxnet_tpu.embed: TPU-native sharded embedding engine.
+
+The rebuild of the seed's parameter-server heritage (kvstore/ps-lite,
+PAPER.md layer 7) as a first-class sparse workload: giant embedding
+tables live on device, rows sharded across a mesh axis via GSPMD, with
+deduped traced lookup/update paths instead of host round trips.
+
+Layers, bottom up::
+
+    sparse.py    dedup_ids / dedup_lookup / dedup_scatter_add /
+                 sparse_apply_rows — pure-jnp primitives, traceable
+                 anywhere (fused step, superstep scan, serving graph)
+    detect.py    which Embedding layers of a symbol can train sparsely
+    table.py     EmbeddingTable: the device object (lookup / update /
+                 accumulate programs via compile_cache, checkpoint
+                 state, row sharding over a mesh)
+    kvstore.py   kvstore.create("device_embed"): seed pull/push call
+                 compatibility for sparse keys
+    stats.py     dedup-ratio instrumentation -> mx.profiler.embed_report
+
+``Module.fit`` needs none of this imported explicitly: the fused train
+step detects eligible Embedding layers structurally and fuses the
+deduped sparse update into the same donated XLA program as the dense
+params (module/fused.py; ``MXNET_EMBED_SPARSE=0`` restores the dense
+path).  See docs/embedding.md.
+"""
+from .detect import SparseEmbedSpec, find_sparse_embeds
+from .kvstore import KVStoreDeviceEmbed, sparse_bound
+from .sparse import (dedup_ids, dedup_lookup, dedup_scatter_add,
+                     naive_lookup, naive_scatter_add, resolve_cap,
+                     slot_leaves_row_shaped, sparse_apply_rows)
+from .stats import EmbedStats
+from .table import EmbeddingTable
+
+__all__ = ["EmbeddingTable", "KVStoreDeviceEmbed", "EmbedStats",
+           "SparseEmbedSpec", "find_sparse_embeds", "sparse_bound",
+           "dedup_ids", "dedup_lookup", "dedup_scatter_add",
+           "naive_lookup", "naive_scatter_add", "resolve_cap",
+           "slot_leaves_row_shaped", "sparse_apply_rows"]

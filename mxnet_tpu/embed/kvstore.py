@@ -1,0 +1,215 @@
+"""``kvstore.create("device_embed")``: the seed pull/push surface over
+device-resident sharded embedding tables.
+
+The reference's sparse kvstore contract (python/mxnet/kvstore.py
+row_sparse_pull + push of row-sparse grads; server-side lazy updates in
+kvstore_dist_server.h) re-lands on TPU with NO server processes: every
+sparse key wraps an :class:`~mxnet_tpu.embed.EmbeddingTable` whose rows
+(and optimizer slots) live on device, optionally sharded across a mesh
+axis, and whose lookup/update paths are the deduped traced programs from
+``embed/sparse.py``.  Dense keys keep the plain KVStore semantics
+unchanged, so one store serves a rec model's mixture of dense tower
+params and sparse tables.
+
+Call compatibility with the seed:
+
+* ``init(key, value)`` — a 2-D value at or above the sparse threshold
+  (``MXNET_EMBED_SPARSE_BOUND`` rows, default 2048 — the
+  MXNET_KVSTORE_BIGARRAY_BOUND idea applied to rows) becomes a table;
+  smaller values stay dense.  ``init(key, value, sparse=True/False)``
+  overrides.
+* ``pull(key, out=)`` — dense keys as before; sparse keys materialize
+  the full table into ``out`` (the reference's full pull).
+* ``row_sparse_pull(key, out=, row_ids=)`` — deduped row gather;
+  ``out`` rows are the embeddings of ``row_ids`` in order (padded /
+  out-of-range ids come back zero).
+* ``push(key, value)`` — dense keys as before.  Sparse push takes the
+  row-sparse form ``push(key, (row_ids, values))``: with an optimizer
+  installed (``set_optimizer``) the rows take a lazy deduped update;
+  without one, values scatter-ADD into the table (the reference
+  server's default accumulate merge).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..base import MXNetError, get_env
+from ..ndarray import NDArray
+from .table import EmbeddingTable
+
+__all__ = ["KVStoreDeviceEmbed", "sparse_bound"]
+
+
+def sparse_bound() -> int:
+    """Row-count threshold above which an init'd 2-D value becomes a
+    device embedding table (``MXNET_EMBED_SPARSE_BOUND``)."""
+    return get_env("MXNET_EMBED_SPARSE_BOUND", 2048, int)
+
+
+def _ids_array(row_ids) -> np.ndarray:
+    a = row_ids.asnumpy() if isinstance(row_ids, NDArray) \
+        else np.asarray(row_ids)
+    return a.astype(np.int64).reshape(-1)
+
+
+class KVStoreDeviceEmbed:
+    """Single-process device store with first-class sparse keys (see
+    module docstring)."""
+
+    def __init__(self, kv_type: str = "device_embed", mesh=None,
+                 spec=None):
+        # composition, not inheritance-from-modes: dense keys delegate
+        # to a plain device-mode KVStore so its semantics stay
+        # byte-compatible with kvstore.create("device")
+        from ..kvstore import KVStore
+        self._dense = KVStore("device")
+        self._type = kv_type
+        self._tables = {}
+        self._mesh = mesh
+        self._spec = spec
+        self._optimizer = None
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def is_sparse_key(self, key) -> bool:
+        return key in self._tables
+
+    def table(self, key) -> EmbeddingTable:
+        """The live EmbeddingTable behind a sparse key (for serve /
+        checkpoint integration)."""
+        if key not in self._tables:
+            raise MXNetError("key %r is not a sparse embedding key"
+                             % (key,))
+        return self._tables[key]
+
+    # -- init ---------------------------------------------------------------
+    def init(self, key, value, sparse: Optional[bool] = None):
+        """Initialize key(s).  2-D values with >= sparse_bound() rows
+        (or ``sparse=True``) become device embedding tables."""
+        from ..kvstore import _key_list, _val_list
+        keys, _ = _key_list(key)
+        values = _val_list(len(keys), value)
+        for k, vs in zip(keys, values):
+            v = vs[0]
+            arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+            is_sparse = sparse if sparse is not None else (
+                arr.ndim == 2 and arr.shape[0] >= sparse_bound())
+            if not is_sparse:
+                self._dense.init(k, vs)
+                continue
+            if arr.ndim != 2:
+                raise MXNetError(
+                    "sparse key %r needs a 2-D (vocab, dim) value, got "
+                    "shape %s" % (k, tuple(arr.shape)))
+            tab = EmbeddingTable(arr.shape[0], arr.shape[1],
+                                 mesh=self._mesh, spec=self._spec,
+                                 dtype=arr.dtype, initializer=arr,
+                                 name="kv:%s" % k)
+            if self._optimizer is not None:
+                tab.set_optimizer(self._optimizer)
+            self._tables[k] = tab
+
+    # -- data plane ---------------------------------------------------------
+    def push(self, key, value, priority=0):
+        from ..kvstore import _key_list
+        keys, multi = _key_list(key)
+        values = value if multi else [value]
+        for k, v in zip(keys, values):
+            if k not in self._tables:
+                self._dense.push(k, v)
+                continue
+            tab = self._tables[k]
+            if not (isinstance(v, tuple) and len(v) == 2):
+                raise MXNetError(
+                    "sparse key %r push wants the row-sparse form "
+                    "(row_ids, values); got %s — use pull/push on a "
+                    "dense key for whole-table writes" % (k, type(v)))
+            ids, vals = v
+            ids = _ids_array(ids)
+            g = vals.asnumpy() if isinstance(vals, NDArray) \
+                else np.asarray(vals)
+            if g.shape != (ids.size, tab.dim):
+                raise MXNetError(
+                    "sparse push %r: values shape %s != (%d, %d)"
+                    % (k, tuple(g.shape), ids.size, tab.dim))
+            if tab.optimizer is not None:
+                tab.update(ids, g)
+            else:
+                tab.accumulate(ids, g)
+
+    def pull(self, key, out=None, priority=0):
+        if out is None:
+            raise MXNetError("pull requires out=")
+        from ..kvstore import _key_list
+        keys, multi = _key_list(key)
+        outs = out if multi else [out]
+        for k, o in zip(keys, outs):
+            if k not in self._tables:
+                self._dense.pull(k, out=o)
+                continue
+            full = self._tables[k].as_numpy()
+            for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                dst[:] = full
+
+    def row_sparse_pull(self, key, out=None, row_ids=None, priority=0):
+        """Deduped sparse pull: ``out`` receives the rows of ``row_ids``
+        (reference kvstore.py row_sparse_pull surface; out-of-range ids
+        read as zero rows)."""
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull requires out= and row_ids=")
+        from ..kvstore import _key_list
+        keys, multi = _key_list(key)
+        outs = out if multi else [out]
+        idss = row_ids if multi else [row_ids]
+        for k, o, ids in zip(keys, outs, idss):
+            if k not in self._tables:
+                raise MXNetError(
+                    "row_sparse_pull on dense key %r (init it with "
+                    "sparse=True or >= %d rows)" % (k, sparse_bound()))
+            rows = np.asarray(self._tables[k].lookup(_ids_array(ids)))
+            for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                dst[:] = rows
+
+    # -- updater / optimizer ------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Sparse keys take the lazy deduped row update on push; dense
+        keys get the classic per-key updater (reference semantics)."""
+        self._optimizer = optimizer
+        for tab in self._tables.values():
+            tab.set_optimizer(optimizer)
+        self._dense.set_optimizer(optimizer)
+
+    def set_updater(self, updater):
+        # dense-only: the sparse update is a traced program, not a
+        # host callback
+        self._dense.set_updater(updater)
+
+    _set_updater = set_updater
+
+    def barrier(self):
+        pass
+
+    _barrier = barrier
+
+    def save_state(self) -> dict:
+        """Checkpoint pytree for every sparse key (rows + slots +
+        step), consumable by mxnet_tpu.checkpoint's sharded writer."""
+        return {str(k): t.state() for k, t in self._tables.items()}
+
+    def load_state(self, tree: dict) -> None:
+        for k, t in self._tables.items():
+            if str(k) in tree:
+                t.restore(tree[str(k)])

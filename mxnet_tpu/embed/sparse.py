@@ -1,0 +1,185 @@
+"""Deduped sparse lookup/update: the functional core of mxnet_tpu.embed.
+
+The reference's sparse story (row_sparse NDArrays + kvstore pull/push of
+row slices, src/kvstore/kvstore_dist.h big-array striping) is a HOST
+protocol: workers ship (row_ids, values) pairs and servers apply lazy
+per-row updates.  On TPU the table never leaves the device, so the whole
+protocol collapses into three traced primitives over a device-resident
+``(vocab, dim)`` array:
+
+* **dedup** — ``jnp.unique(size=cap)`` with a fixed output size (the
+  traced shape contract), mapping a batch of ids to ``(uniq[cap],
+  inv[N])``.  A batch at realistic duplication (4096 ids, ~10% unique)
+  gathers each hot row ONCE instead of per occurrence.
+* **dedup lookup** — gather the unique rows, scatter back to batch
+  positions via ``inv`` (a cheap cap-sized take, not a vocab-sized one).
+  Out-of-range ids (the padded-batch sentinel ``>= vocab``) read as ZERO
+  vectors, which makes fixed-shape padded id batches mask themselves.
+* **dedup update** — segment-sum the per-occurrence output grads onto
+  the unique rows (one cap-row reduction replaces the naive N-scatter
+  into the full table), apply the optimizer's fused row update to those
+  rows only (lazy semantics: untouched rows see no momentum decay /
+  weight decay, exactly the reference's row_sparse "lazy update"), and
+  scatter the new rows + new slot rows back with out-of-range drops.
+
+Everything here is pure jnp — traceable into the fused train step, the
+superstep scan, and the serving graph alike.  Shape-polymorphic callers
+(FusedTrainStep, the ``_sparse_embedding`` op) pick the cap; correctness
+never depends on it as long as ``cap >= #distinct ids`` in the batch —
+``dedup_ids`` guarantees that by clamping cap to ``N`` (the worst case),
+so a too-optimistic user cap can only be a performance choice, not a
+wrong-result one.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dedup_ids", "dedup_lookup", "naive_lookup",
+           "dedup_scatter_add", "naive_scatter_add", "sparse_apply_rows",
+           "slot_leaves_row_shaped", "resolve_cap"]
+
+
+def resolve_cap(cap: Optional[int], n_ids: int, vocab: int) -> int:
+    """The traced unique-output size: a caller/attr cap clamped into
+    ``[1, min(n_ids, vocab)]``; 0/None means the safe worst case
+    (every id distinct).  Must be static at trace time."""
+    worst = max(1, min(int(n_ids), int(vocab)))
+    if not cap:
+        return worst
+    return max(1, min(int(cap), worst))
+
+
+def dedup_ids(flat_ids, cap: int, sentinel: int) -> Tuple:
+    """``(uniq[cap], inv[N])`` for a flat int batch.  ``uniq`` is sorted
+    ascending and padded with ``sentinel`` (pass ``vocab`` — out of
+    range, so padding slots drop out of every downstream scatter);
+    ``inv`` maps each batch position to its row in ``uniq``.
+
+    If the batch holds more than ``cap`` distinct values the overflow
+    is TRUNCATED by jnp.unique — callers must size cap for the worst
+    case they admit (see ``resolve_cap``)."""
+    flat_ids = flat_ids.astype(jnp.int32)
+    # negative ids (feed.PAD_ID = -1) fold into the HIGH sentinel HERE,
+    # at the one choke point every deduped path runs through: jax's
+    # scatter mode="drop" drops only after python-style negative-index
+    # WRAPPING, so a raw -1 in uniq would alias row vocab-1 and every
+    # padded batch would corrupt it with pad-position updates
+    flat_ids = jnp.where(flat_ids < 0, jnp.int32(sentinel), flat_ids)
+    uniq, inv = jnp.unique(flat_ids, size=cap, fill_value=sentinel,
+                           return_inverse=True)
+    return uniq, inv.reshape(flat_ids.shape)
+
+
+def _mask_oov_rows(rows, uniq, vocab: int):
+    """Zero the gathered rows whose id is out of table range: padded-id
+    sentinels and unique-padding slots read as zero vectors instead of
+    the clip-gathered garbage of row vocab-1."""
+    ok = (uniq >= 0) & (uniq < vocab)
+    return jnp.where(ok[:, None], rows, jnp.zeros_like(rows))
+
+
+def dedup_lookup(table, ids, cap: Optional[int] = None):
+    """Deduped embedding lookup: ``ids (...,) int -> (..., dim)``.
+
+    One cap-row gather from the (possibly sharded) table + one cheap
+    take over ``inv``.  Out-of-range ids yield zero vectors (the padded
+    batch contract).  Returns ``(out, uniq, inv)`` so callers can reuse
+    the dedup for the update side."""
+    vocab = table.shape[0]
+    flat = ids.reshape(-1)
+    k = resolve_cap(cap, flat.shape[0], vocab)
+    uniq, inv = dedup_ids(flat, k, sentinel=vocab)
+    rows = jnp.take(table, uniq, axis=0, mode="clip")
+    rows = _mask_oov_rows(rows, uniq, vocab)
+    out = jnp.take(rows, inv, axis=0).reshape(
+        tuple(ids.shape) + (table.shape[1],))
+    return out, uniq, inv
+
+
+def naive_lookup(table, ids):
+    """The per-occurrence baseline: one table gather per id (what the
+    plain ``Embedding`` op does).  Out-of-range ids CLIP to the last
+    row — the reference semantics, kept for parity."""
+    idx = ids.reshape(-1).astype(jnp.int32)
+    out = jnp.take(table, idx, axis=0, mode="clip")
+    return out.reshape(tuple(ids.shape) + (table.shape[1],))
+
+
+def dedup_scatter_add(grads_flat, inv, cap: int):
+    """Reduce per-occurrence row grads onto their unique rows: ``(N,
+    dim) x inv[N] -> (cap, dim)``.  The N-way scatter into the full
+    table becomes one segment reduction into a cap-row (usually
+    cache-resident) buffer."""
+    return jax.ops.segment_sum(grads_flat, inv, num_segments=cap)
+
+
+def naive_scatter_add(table, flat_ids, grads_flat):
+    """The baseline the dedup path is benched against: one scatter-add
+    into the full ``(vocab, dim)`` table per id occurrence (the XLA
+    lowering of ``take``'s VJP).  Out-of-range ids drop — including
+    negative pad ids, which scatter mode="drop" alone would WRAP to
+    the last row."""
+    idx = flat_ids.reshape(-1).astype(jnp.int32)
+    idx = jnp.where(idx < 0, jnp.int32(table.shape[0]), idx)
+    return table.at[idx].add(grads_flat, mode="drop")
+
+
+def sparse_apply_rows(table, slots, uniq, grad_rows, opt_update, lr, wd, t):
+    """Lazy per-row optimizer step on the unique rows only.
+
+    Gathers the touched rows of the table and of every row-shaped
+    optimizer-slot leaf, applies the optimizer's fused row update
+    (elementwise, so a row slice is exactly the dense math restricted
+    to touched rows), and scatters rows + slots back.  Out-of-range
+    ``uniq`` entries (sentinel padding) clip on the gather and DROP on
+    the scatter, so they cannot corrupt row vocab-1.  Untouched rows
+    keep their weights AND slots bitwise — the reference row_sparse
+    "lazy update" semantics (documented divergence from the dense path,
+    which decays momentum/weight on every row every step).
+
+    Returns ``(new_table, new_slots)``."""
+    vocab = table.shape[0]
+
+    def gather(leaf):
+        return jnp.take(leaf, uniq, axis=0, mode="clip")
+
+    def scatter(leaf, new_rows):
+        return leaf.at[uniq].set(new_rows, mode="drop")
+
+    row_shaped = _row_leaf_pred(vocab)
+    slot_rows = jax.tree_util.tree_map(
+        lambda s: gather(s) if row_shaped(s) else s, slots,
+        is_leaf=lambda x: x is None)
+    rows = gather(table)
+    new_rows, new_slot_rows = opt_update(rows, grad_rows, slot_rows,
+                                         lr, wd, t)
+    new_table = scatter(table, new_rows)
+    new_slots = jax.tree_util.tree_map(
+        lambda s, r: scatter(s, r) if row_shaped(s) else r,
+        slots, new_slot_rows, is_leaf=lambda x: x is None)
+    return new_table, new_slots
+
+
+def _row_leaf_pred(vocab: int):
+    def pred(leaf):
+        return leaf is not None and getattr(leaf, "ndim", 0) >= 1 \
+            and leaf.shape[0] == vocab
+    return pred
+
+
+def slot_leaves_row_shaped(opt_init, vocab: int, dim: int, dtype) -> bool:
+    """Whether an optimizer's fused state for a ``(vocab, dim)`` table
+    is entirely row-shaped (every non-None leaf has leading dim vocab)
+    — the condition for the lazy per-row update to be exactly the dense
+    update restricted to touched rows.  SGD/NAG momentum, Adagrad
+    history and Adam (m, v) all qualify; anything with scalar or
+    oddly-shaped state falls back to the dense path."""
+    struct = jax.eval_shape(opt_init,
+                            jax.ShapeDtypeStruct((vocab, dim), dtype))
+    leaves = jax.tree_util.tree_leaves(struct, is_leaf=lambda x: x is None)
+    return all(lf is None or (getattr(lf, "ndim", 0) >= 1
+                              and lf.shape[0] == vocab)
+               for lf in leaves)

@@ -1,0 +1,394 @@
+"""EmbeddingTable: a giant ``(vocab, dim)`` table as a first-class, fast
+device object.
+
+The TPU-native rebuild of the reference parameter server's raison
+d'être (PAPER.md layer 7): where ps-lite striped big arrays across
+server PROCESSES (``kvstore_dist.h`` GetServerKeyRanges) and shipped
+(row_ids, values) over ZeroMQ, this shards table ROWS across a mesh
+axis via GSPMD and lets XLA collectives do the routing — lookups gather
+from whichever chip owns the row, updates scatter back, and the "server
+side" optimizer state shards along the very same axis (the
+cross-replica weight-update-sharding recipe applied to rows).
+
+Three traced programs per table, all through the compile cache:
+
+* ``lookup(ids)``        — deduped gather (embed/sparse.py), optional
+                           sum/mean pooling with padded-id masking
+* ``update(ids, grads)`` — deduped scatter-add + lazy per-row optimizer
+                           (slots sharded like the table, donated)
+* ``accumulate(ids, g)`` — optimizer-free deduped scatter-add (the
+                           kvstore "server accumulates pushes" default)
+
+The table also trains INSIDE ``Module.fit``'s fused step without this
+class (module/fused.py detects Embedding layers structurally); this
+object is the serving/kvstore-facing surface: ``kvstore.create(
+"device_embed")`` wraps one per sparse key, ``ServeEngine`` rec models
+look up through the same traced path.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import trace as _trace
+from ..base import MXNetError, get_env
+from .sparse import (dedup_ids, dedup_scatter_add, resolve_cap,
+                     slot_leaves_row_shaped, sparse_apply_rows)
+from .stats import EmbedStats
+
+__all__ = ["EmbeddingTable"]
+
+
+class EmbeddingTable:
+    """Device-resident, optionally row-sharded embedding table.
+
+    Parameters
+    ----------
+    vocab, dim : int
+        Table geometry.  Row ids outside ``[0, vocab)`` read as zero
+        vectors (the padded-batch sentinel contract) and their updates
+        drop.
+    mesh / spec :
+        Row sharding: a named mesh (``parallel.make_mesh`` result) plus
+        the axis to shard rows over — an axis name string (``"dp"``), a
+        PartitionSpec, or None for the mesh's first axis.  ``vocab``
+        must divide evenly (same rule as every sharded param).  Without
+        a mesh the table lives on the default device.
+    dtype :
+        Row dtype (f32 default).
+    unique_cap : int, optional
+        Traced dedup output size per lookup/update batch; 0/None = the
+        safe worst case (batch size).  ``MXNET_EMBED_UNIQUE_CAP`` is
+        the env spelling.
+    optimizer :
+        An ``mxnet_tpu.optimizer.Optimizer`` with a fused functional
+        form and row-shaped state (SGD/NAG/Adagrad/Adam); arms
+        ``update``.  Settable later via :meth:`set_optimizer`.
+    """
+
+    def __init__(self, vocab: int, dim: int, mesh=None, spec=None,
+                 dtype=jnp.float32, unique_cap: Optional[int] = None,
+                 optimizer=None, initializer=None, name: str = "embed"):
+        if vocab < 1 or dim < 1:
+            raise MXNetError("EmbeddingTable needs vocab, dim >= 1 "
+                             "(got %d, %d)" % (vocab, dim))
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.name = name
+        self.dtype = np.dtype(dtype)
+        if unique_cap is None:
+            unique_cap = get_env("MXNET_EMBED_UNIQUE_CAP", 0, int)
+        self.unique_cap = int(unique_cap) or None
+        self.mesh = mesh
+        self._sharding = self._row_sharding(mesh, spec)
+        self.stats = EmbedStats(name)
+        from .. import profiler
+        profiler.register_embed_stats(self.stats)
+        self._t = 0
+        self._progs = {}
+        self.optimizer = None
+        self._opt_update = None
+        self.slots = None
+        rows = self._init_rows(initializer)
+        # jnp.copy: the table is DONATED by the update/accumulate
+        # programs; a zero-copy device_put alias of the host init buffer
+        # would be scribbled over (the PR 2 corruption class)
+        self.rows = jnp.copy(jax.device_put(rows, self._sharding)) \
+            if self._sharding is not None else jnp.array(rows, copy=True)
+        if optimizer is not None:
+            self.set_optimizer(optimizer)
+
+    # -- construction -------------------------------------------------------
+    def _init_rows(self, initializer):
+        if initializer is None:
+            return np.zeros((self.vocab, self.dim), self.dtype)
+        if callable(initializer):
+            out = np.zeros((self.vocab, self.dim), np.float32)
+            initializer("%s_weight" % self.name, _HostArr(out))
+            return out.astype(self.dtype)
+        arr = np.asarray(
+            initializer._get() if hasattr(initializer, "_get")
+            else initializer)
+        if tuple(arr.shape) != (self.vocab, self.dim):
+            raise MXNetError(
+                "EmbeddingTable %r init value shape %s != (%d, %d)"
+                % (self.name, tuple(arr.shape), self.vocab, self.dim))
+        return arr.astype(self.dtype)
+
+    def _row_sharding(self, mesh, spec):
+        if mesh is None:
+            if spec is not None:
+                raise MXNetError("EmbeddingTable spec= without mesh=")
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import normalize_spec, validate_spec
+        if spec is None:
+            spec = P(mesh.axis_names[0], None)
+        elif isinstance(spec, str) and "," not in spec:
+            spec = P(spec, None)
+        else:
+            spec = normalize_spec(spec)
+        validate_spec("%s_weight" % self.name, spec, mesh,
+                      shape=(self.vocab, self.dim))
+        self.row_spec = spec
+        return NamedSharding(mesh, spec)
+
+    def set_optimizer(self, optimizer) -> None:
+        """Arm the sparse update path.  The optimizer's fused form is
+        snapshotted NOW (hyperparameters bake into the traced program;
+        re-call after mutating them) and its state must be row-shaped —
+        the lazy per-row update condition (embed/sparse.py)."""
+        fused = optimizer.fused_update_fn()
+        if fused is None:
+            raise MXNetError(
+                "optimizer %s has no fused functional form; the sparse "
+                "embedding update is a traced program"
+                % type(optimizer).__name__)
+        opt_init, opt_update = fused
+        if not slot_leaves_row_shaped(opt_init, self.vocab, self.dim,
+                                      self.dtype):
+            raise MXNetError(
+                "optimizer %s state for a (%d, %d) table is not row-"
+                "shaped; the lazy per-row sparse update cannot express "
+                "it — use SGD/NAG/Adagrad/Adam or the dense path"
+                % (type(optimizer).__name__, self.vocab, self.dim))
+        self.optimizer = optimizer
+        self._opt_update = opt_update
+        slots = opt_init(self.rows)
+        if self._sharding is not None:
+            slots = jax.tree_util.tree_map(
+                lambda s: jax.device_put(s, self._sharding), slots,
+                is_leaf=lambda x: x is None)
+        self.slots = slots
+        # drop every traced update program (keys are ("update", cap)):
+        # the new optimizer's hyperparameters/closures must re-bake
+        self._progs = {k: v for k, v in self._progs.items()
+                       if k[0] != "update"}
+
+    # -- traced programs ----------------------------------------------------
+    def _cap(self, n_ids: int) -> int:
+        return resolve_cap(self.unique_cap, n_ids, self.vocab)
+
+    def _desc(self, tag: str, extra=()) -> str:
+        """Trace-free fast-key description: the table geometry, sharding
+        layout, and every optimizer scalar the traced update closes
+        over (the ``fused_hparams`` contract from module/fused.py)."""
+        import hashlib
+        from ..parallel.mesh import mesh_axes
+        opt = self.optimizer
+        hparams = None
+        if opt is not None:
+            hparams = (type(opt).__name__, float(opt.wd),
+                       tuple((k, getattr(opt, k, None))
+                             for k in sorted(
+                                 getattr(opt, "fused_hparams", ()))))
+        h = hashlib.sha256()
+        parts = (tag, self.vocab, self.dim, str(self.dtype),
+                 self.unique_cap,
+                 mesh_axes(self.mesh) if self.mesh is not None else None,
+                 tuple(self.row_spec) if self._sharding is not None
+                 else None,
+                 hparams) + tuple(extra)
+        for p in parts:
+            h.update(repr(p).encode())
+            h.update(b"\x00")
+        return "embed|%s" % h.hexdigest()
+
+    def _lookup_prog(self, cap: int, combiner: Optional[str]):
+        key = ("lookup", cap, combiner)
+        prog = self._progs.get(key)
+        if prog is not None:
+            return prog
+        vocab = self.vocab
+        from .sparse import dedup_lookup
+
+        def fn(table, ids):
+            # ONE implementation of the lookup contract (sparse.py):
+            # the table, fused-step and _sparse_embedding paths must
+            # never drift on dedup/pad semantics
+            out, _uniq, _inv = dedup_lookup(table, ids, cap=cap)
+            if combiner is None:
+                return out
+            pooled = jnp.sum(out, axis=-2)
+            if combiner == "sum":
+                return pooled
+            # mean over REAL (in-range) ids; all-pad rows divide by 1
+            n = jnp.sum(((ids >= 0) & (ids < vocab)),
+                        axis=-1).astype(out.dtype)
+            return pooled / jnp.maximum(n, 1)[..., None]
+
+        from ..compile_cache import cached_jit
+        prog = cached_jit(fn, name="embed:lookup",
+                          fast_key=self._desc("lookup", (cap, combiner)))
+        self._progs[key] = prog
+        return prog
+
+    def _update_prog(self, cap: int):
+        if self._opt_update is None:
+            raise MXNetError(
+                "EmbeddingTable %r has no optimizer; call set_optimizer "
+                "(or use accumulate for optimizer-free scatter-add)"
+                % self.name)
+        key = ("update", cap)
+        prog = self._progs.get(key)
+        if prog is not None:
+            return prog
+        vocab, dim = self.vocab, self.dim
+        opt_update = self._opt_update
+        wd = float(self.optimizer.wd)
+
+        def fn(table, slots, ids, grads, lr, t):
+            flat = ids.reshape(-1)
+            uniq, inv = dedup_ids(flat, cap, sentinel=vocab)
+            grows = dedup_scatter_add(
+                grads.reshape(-1, dim).astype(table.dtype), inv, cap)
+            return sparse_apply_rows(table, slots, uniq, grows,
+                                     opt_update, lr, wd, t)
+
+        from ..compile_cache import cached_jit
+        prog = cached_jit(fn, name="embed:update", donate_argnums=(0, 1),
+                          fast_key=self._desc("update", (cap,)))
+        self._progs[key] = prog
+        return prog
+
+    def _accumulate_prog(self, cap: int):
+        key = ("acc", cap)
+        prog = self._progs.get(key)
+        if prog is not None:
+            return prog
+        vocab, dim = self.vocab, self.dim
+
+        def fn(table, ids, values):
+            flat = ids.reshape(-1)
+            uniq, inv = dedup_ids(flat, cap, sentinel=vocab)
+            vrows = dedup_scatter_add(
+                values.reshape(-1, dim).astype(table.dtype), inv, cap)
+            return table.at[uniq].add(vrows, mode="drop")
+
+        from ..compile_cache import cached_jit
+        prog = cached_jit(fn, name="embed:accumulate", donate_argnums=(0,),
+                          fast_key=self._desc("accumulate", (cap,)))
+        self._progs[key] = prog
+        return prog
+
+    # -- public surface -----------------------------------------------------
+    def lookup(self, ids, combiner: Optional[str] = None):
+        """Deduped lookup: ``ids (...,) -> (..., dim)`` (or pooled
+        ``(..., dim)`` over the last ids axis with ``combiner=
+        "sum"|"mean"``, padded ids masked).  Returns a jnp array."""
+        if combiner not in (None, "sum", "mean"):
+            raise MXNetError("combiner must be None|'sum'|'mean', got %r"
+                             % (combiner,))
+        ids_h = np.asarray(ids._get() if hasattr(ids, "_get") else ids)
+        self.stats.note_ids("%s_weight" % self.name, ids_h)
+        cap = self._cap(ids_h.size)
+        prog = self._lookup_prog(cap, combiner)
+        t0 = _time.perf_counter()
+        out = prog(self.rows, jnp.asarray(ids_h.astype(np.int32)))
+        _trace.complete("embed:lookup", t0, _time.perf_counter() - t0,
+                        cat="embed")
+        return out
+
+    def update(self, ids, grads, lr: Optional[float] = None):
+        """Deduped sparse train step: apply the optimizer to the rows
+        named by ``ids`` with per-occurrence output grads ``grads``
+        (``ids.shape + (dim,)``).  Donates and replaces the table and
+        slot buffers."""
+        ids_h = np.asarray(ids._get() if hasattr(ids, "_get") else ids)
+        g = grads._get() if hasattr(grads, "_get") else grads
+        cap = self._cap(ids_h.size)
+        prog = self._update_prog(cap)
+        self.stats.note_ids("%s_weight" % self.name, ids_h)
+        self.stats.note_update("%s_weight" % self.name, cap)
+        if lr is None:
+            lr = self.optimizer.base_lr()
+        self._t += 1
+        t0 = _time.perf_counter()
+        self.rows, self.slots = prog(
+            self.rows, self.slots, jnp.asarray(ids_h.astype(np.int32)),
+            jnp.asarray(g), jnp.asarray(lr, jnp.float32),
+            jnp.asarray(self._t, jnp.int32))
+        _trace.complete("embed:update", t0, _time.perf_counter() - t0,
+                        cat="embed")
+        return self.rows
+
+    def accumulate(self, ids, values):
+        """Optimizer-free deduped scatter-add (the kvstore "server
+        accumulates pushes" default merge).  Donates the table."""
+        ids_h = np.asarray(ids._get() if hasattr(ids, "_get") else ids)
+        v = values._get() if hasattr(values, "_get") else values
+        cap = self._cap(ids_h.size)
+        self.stats.note_ids("%s_weight" % self.name, ids_h)
+        t0 = _time.perf_counter()
+        self.rows = self._accumulate_prog(cap)(
+            self.rows, jnp.asarray(ids_h.astype(np.int32)),
+            jnp.asarray(v))
+        _trace.complete("embed:update", t0, _time.perf_counter() - t0,
+                        cat="embed")
+        return self.rows
+
+    def set_rows(self, value) -> None:
+        """Replace the whole table (dense init/push), re-placed into the
+        row sharding."""
+        arr = np.asarray(value._get() if hasattr(value, "_get")
+                         else value)
+        if tuple(arr.shape) != (self.vocab, self.dim):
+            raise MXNetError(
+                "EmbeddingTable %r set_rows shape %s != (%d, %d)"
+                % (self.name, tuple(arr.shape), self.vocab, self.dim))
+        arr = arr.astype(self.dtype)
+        # jnp.copy: donated table must own fresh storage (see __init__)
+        self.rows = jnp.copy(jax.device_put(arr, self._sharding)) \
+            if self._sharding is not None else jnp.array(arr, copy=True)
+
+    def as_numpy(self) -> np.ndarray:
+        """The full table on host (gathers a sharded table)."""
+        return np.asarray(jax.device_get(self.rows))
+
+    # -- checkpoint ---------------------------------------------------------
+    def state(self) -> dict:
+        """Pytree for mxnet_tpu.checkpoint's sharded save (leaves keep
+        their live sharding: each process writes only its own rows)."""
+        return {"rows": self.rows, "slots": self.slots,
+                "t": jnp.asarray(self._t, jnp.int32)}
+
+    def restore(self, tree: dict) -> None:
+        """Restore from :meth:`state` output (host or device leaves);
+        rows land back in this table's row sharding — a table saved on
+        one mesh restores onto another (cross-mesh restore)."""
+        def put(x):
+            if x is None:
+                return None
+            a = np.asarray(x)
+            # jnp.copy: donated table/slots must own fresh storage
+            # (see __init__)
+            return jnp.copy(jax.device_put(a, self._sharding)) \
+                if self._sharding is not None else jnp.array(a, copy=True)
+        self.rows = put(tree["rows"])
+        slots = tree.get("slots")
+        if slots is not None and self.optimizer is None:
+            raise MXNetError(
+                "EmbeddingTable %r restore carries optimizer slots but "
+                "no optimizer is set; call set_optimizer first"
+                % self.name)
+        if self.optimizer is not None:
+            self.slots = jax.tree_util.tree_map(
+                put, slots, is_leaf=lambda x: x is None)
+        self._t = int(np.asarray(tree.get("t", 0)))
+
+
+class _HostArr:
+    """Minimal NDArray-alike handed to reference initializers (they call
+    ``arr[:] = value``)."""
+
+    def __init__(self, arr):
+        self._a = arr
+        self.shape = arr.shape
+
+    def __setitem__(self, key, value):
+        self._a[key] = np.asarray(
+            value._get() if hasattr(value, "_get") else value)

@@ -40,6 +40,8 @@ from .stages import (BatchStage, DevicePutStage, MapStage, SourceStage,
                      StagingStage)
 from .staging import (DevicePrefetchIter, MegaBatch, device_feed,
                       stack_batch_arrays)
+from .sparse import (PAD_ID, ids_pipeline, make_ids_decode, pad_ids,
+                     write_ids_record)
 from .stats import PipelineStats, StageStats
 
 __all__ = ["Pipeline", "Stage", "BoundedQueue", "EndOfEpoch", "EndOfStream",
@@ -48,7 +50,9 @@ __all__ = ["Pipeline", "Stage", "BoundedQueue", "EndOfEpoch", "EndOfStream",
            "PipelineStats", "DevicePrefetchIter", "MegaBatch", "device_feed",
            "stack_batch_arrays", "FeedDataIter", "record_pipeline",
            "make_jpeg_decode", "make_u8_decode", "ParallelReader",
-           "AugmentSpec", "augment_batch", "augment_batch_host"]
+           "AugmentSpec", "augment_batch", "augment_batch_host",
+           "PAD_ID", "pad_ids", "make_ids_decode", "write_ids_record",
+           "ids_pipeline"]
 
 
 class FeedDataIter:

@@ -20,7 +20,6 @@ call-compatible with the reference python package.
 """
 from __future__ import annotations
 
-import os
 import pickle
 from typing import Dict, List, Optional, Union
 
@@ -28,7 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 from .context import Context, cpu, current_context
 from .ndarray import NDArray, zeros as nd_zeros
 
@@ -277,9 +276,10 @@ class KVStoreDistAsync(KVStore):
 
     @property
     def num_workers(self) -> int:
-        # lint: allow(raw-env) — DMLC rendezvous protocol var,
-        # reference semantics (launcher-owned, not a user knob)
-        return int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        # DMLC rendezvous var via the typed accessor: a malformed value
+        # degrades to 1 worker instead of crashing mid-train (ps.py's
+        # server side still KeyErrors loudly on a broken launcher)
+        return get_env("DMLC_NUM_WORKER", 1, int)
 
     def init(self, key, value):
         """Rank-0 value wins; barrier so pushes can't race inits."""
@@ -338,6 +338,11 @@ def create(name: str = "local") -> KVStore:
 
     local / local_update_cpu / local_allreduce_cpu -> host-side aggregation
     device / local_allreduce_device               -> on-accelerator aggregation
+    device_embed -> device store with first-class SPARSE keys: big 2-D
+        values become mesh-shardable embedding tables with deduped
+        row_sparse_pull / (row_ids, grads) push and lazy per-row
+        optimizer updates (mxnet_tpu.embed.KVStoreDeviceEmbed); dense
+        keys keep plain ``device`` semantics.
     dist_sync / dist_sync_tpu / dist_sync_device ->
         process-replicated store with collective aggregation (no servers)
     dist_async -> host parameter-server (scheduler+servers via mxnet_tpu.ps)
@@ -348,8 +353,12 @@ def create(name: str = "local") -> KVStore:
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     name_l = name.lower()
-    # lint: allow(raw-env) — DMLC rendezvous presence probe
-    if name_l == "dist_async" and os.environ.get("DMLC_PS_ROOT_URI"):
+    if name_l == "device_embed":
+        from .embed.kvstore import KVStoreDeviceEmbed
+        return KVStoreDeviceEmbed(name)
+    # DMLC rendezvous presence probe through the typed accessor (empty
+    # string == unset, matching the launcher contract)
+    if name_l == "dist_async" and get_env("DMLC_PS_ROOT_URI", ""):
         return KVStoreDistAsync(name)
     if name_l.startswith("dist"):
         return KVStoreDistTPU(name)

@@ -155,6 +155,35 @@ class FusedTrainStep:
                 "falling back to the per-param update path"
                 % type(optimizer).__name__)
         self._opt_init, self._opt_update = fused
+        # deduped sparse embedding updates (mxnet_tpu.embed): Embedding
+        # layers whose ids input is a data variable and whose table is
+        # consumed nowhere else train through the sparse path — the step
+        # dedups the batch's ids, gathers each unique row ONCE, takes
+        # grads w.r.t. those rows only (the take-VJP then scatters into
+        # a cap-row buffer, not the full table), and applies the
+        # optimizer lazily to the touched rows.  One donated dispatch
+        # still covers dense + sparse params.  MXNET_EMBED_SPARSE=0
+        # restores the dense take-VJP everywhere (the bench baseline).
+        from ..embed.detect import find_sparse_embeds
+        from ..embed.sparse import slot_leaves_row_shaped
+        self.sparse_embeds = {}
+        for n, sp in find_sparse_embeds(symbol, self.data_names,
+                                        self.train_names).items():
+            # lazy per-row updates need row-shaped optimizer state
+            # (SGD/NAG/Adagrad/Adam); anything else keeps the dense path
+            # for that table
+            if slot_leaves_row_shaped(self._opt_init, sp.vocab, sp.dim,
+                                      jnp.float32):
+                self.sparse_embeds[n] = sp
+        self.embed_stats = None
+        if self.sparse_embeds:
+            from ..embed.stats import EmbedStats
+            from .. import profiler as _prof
+            self.embed_stats = EmbedStats("fused")
+            _prof.register_embed_stats(self.embed_stats)
+        self._embed_stats_every = max(
+            1, get_env("MXNET_EMBED_STATS_EVERY", 1, int))
+        self._embed_stats_n = 0
         # static per-param schedule factors (reference lr_mult/wd_mult and
         # the bias/gamma/beta wd rule, resolved by NAME not index)
         self._lr_mult = {n: optimizer._name_lr_mult(n) for n in self.train_names}
@@ -408,6 +437,22 @@ class FusedTrainStep:
         num_workers x the bound batch size."""
         sh = self._batched()
         mp = self._multiprocess()
+        if self.embed_stats is not None:
+            # dedup-ratio instrumentation on the HOST ids (microseconds
+            # on an int batch vs a multi-ms step), sampled every
+            # MXNET_EMBED_STATS_EVERY batches — the number
+            # mx.profiler.embed_report() and bench_embed's
+            # embed_dedup_ratio leg surface
+            self._embed_stats_n += 1
+            if self._embed_stats_n % self._embed_stats_every == 0:
+                by_name = dict(zip(self.data_names, data_batch.data))
+                from ..embed.sparse import resolve_cap
+                for n, sp in self.sparse_embeds.items():
+                    ids = by_name.get(sp.ids_name)
+                    if ids is not None:
+                        self.embed_stats.note_ids(n, ids.asnumpy())
+                        self.embed_stats.note_update(
+                            n, resolve_cap(sp.cap, ids.size, sp.vocab))
 
         def put(arr):
             a = arr._get()
@@ -537,6 +582,7 @@ class FusedTrainStep:
         rescale = self.optimizer.rescale_grad
         clip = self.optimizer.clip_gradient
         lr_mult, wd, opt_update = self._lr_mult, self._wd, self._opt_update
+        sparse = self.sparse_embeds
         # which params ride GSPMD constraints through the update: every
         # specced (tensor-parallel) param always; every param when the
         # cross-replica sharded weight update is on
@@ -563,6 +609,31 @@ class FusedTrainStep:
             rng = jax.random.fold_in(base_key, t)
             batch = self._maybe_augment(batch, rng, train=True)
 
+            # sparse embed prologue: dedup each table's id batch, gather
+            # the unique rows ONCE (zero-masked for out-of-range / padded
+            # ids), and substitute (rows, inverse indices) for (table,
+            # ids) — the Embedding op computes take(rows, inv), which is
+            # bit-identical to take(table, ids), but its VJP now scatters
+            # into a cap-row buffer instead of the full (vocab, dim)
+            # table.  full_tables keeps the real tables for the update.
+            full_tables = {}
+            sparse_ctx = {}
+            if sparse:
+                from ..embed.sparse import (_mask_oov_rows, dedup_ids,
+                                            resolve_cap)
+                batch = dict(batch)
+                params = dict(params)
+                for n, sp in sparse.items():
+                    ids = batch[sp.ids_name]
+                    flat = ids.reshape(-1).astype(jnp.int32)
+                    cap = resolve_cap(sp.cap, flat.shape[0], sp.vocab)
+                    uniq, inv = dedup_ids(flat, cap, sentinel=sp.vocab)
+                    full_tables[n] = params[n]
+                    raw = jnp.take(params[n], uniq, axis=0, mode="clip")
+                    params[n] = _mask_oov_rows(raw, uniq, sp.vocab)
+                    batch[sp.ids_name] = inv.reshape(ids.shape)
+                    sparse_ctx[n] = (uniq, cap)
+
             def loss_fn(train_params):
                 args = dict(train_params)
                 args.update(fixed)
@@ -585,8 +656,31 @@ class FusedTrainStep:
             outs, vjp_fn, new_aux = jax.vjp(loss_fn, params, has_aux=True)
             grads = vjp_fn([jnp.ones_like(o) for o in outs])[0]
 
+            if sparse:
+                from ..embed.sparse import sparse_apply_rows
             new_params, new_opt = {}, {}
+            for n, sp in sparse.items():
+                # grads[n] is ALREADY per-unique-row: the take-over-inv
+                # VJP segment-summed the per-occurrence grads into the
+                # cap-row buffer.  Lazy per-row optimizer on the touched
+                # rows only; sentinel rows drop on the scatter.
+                uniq, cap = sparse_ctx[n]
+                w = full_tables[n]
+                g = grads[n].astype(w.dtype) * rescale
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                new_params[n], new_opt[n] = sparse_apply_rows(
+                    w, state["opt"][n], uniq, g, opt_update,
+                    lr * lr_mult[n], wd[n], t)
+                if constrained:
+                    new_params[n] = jax.lax.with_sharding_constraint(
+                        new_params[n], self._param_sharding(n))
+                    new_opt[n] = jax.tree_util.tree_map(
+                        lambda x, _n=n: jax.lax.with_sharding_constraint(
+                            x, self._update_spec(x, _n)), new_opt[n])
             for n, w in params.items():
+                if n in sparse:
+                    continue
                 g = grads[n].astype(w.dtype) * rescale
                 if clip is not None:
                     g = jnp.clip(g, -clip, clip)
@@ -639,6 +733,11 @@ class FusedTrainStep:
                      repr(_mesh_axes(self.mesh)),
                      repr(sorted((n, tuple(s))
                                  for n, s in self.param_specs.items())),
+                     # sparse-embed geometry: a cap change or a table
+                     # entering/leaving the sparse path is a different
+                     # program
+                     repr(sorted((n, sp.describe())
+                                 for n, sp in self.sparse_embeds.items())),
                      repr([int(d.id) for d in self.mesh.devices.ravel()]),
                      repr(self.train_names), repr(self.fixed_names),
                      repr(sorted(self.label_shapes.items()))):

@@ -562,6 +562,37 @@ class EmbeddingOp(OpDef):
         return [jnp.take(weight, idx, axis=0)]
 
 
+@register_op("_sparse_embedding", hint="sparse_embedding")
+class SparseEmbeddingOp(OpDef):
+    """Deduped embedding lookup (mxnet_tpu.embed): unique the id batch
+    (traced fixed-size ``unique_cap``; 0 = the batch size), gather each
+    distinct row ONCE, scatter back to batch positions.  Same output as
+    ``Embedding`` for in-range ids; ids outside ``[0, input_dim)`` read
+    as ZERO vectors (the padded-id-batch contract) where ``Embedding``
+    clips.  ``passes.SparseEmbedPass`` rewrites Embedding nodes to this
+    op on the serving graph."""
+    params = [Param("input_dim", int, required=True),
+              Param("output_dim", int, required=True),
+              Param("unique_cap", int, default=0)]
+
+    def list_arguments(self, p):
+        return ["data", "weight"]
+
+    def infer_shape(self, p, in_shapes):
+        d = in_shapes[0]
+        w = (p.input_dim, p.output_dim)
+        if d is None:
+            return [None, w], [None], []
+        return [d, w], [tuple(d) + (p.output_dim,)], []
+
+    def forward(self, p, inputs, aux, ctx):
+        from ..embed.sparse import dedup_lookup
+        data, weight = inputs
+        idx = lax.stop_gradient(data).astype(jnp.int32)
+        out, _uniq, _inv = dedup_lookup(weight, idx, cap=p.unique_cap)
+        return [out]
+
+
 @register_op("Crop", hint="crop")
 class CropOp(OpDef):
     """reference crop-inl.h: crop x to h_w (or to shape of second input)."""

@@ -40,6 +40,7 @@ from .graph_passes import (CSEPass, DeadNodeEliminationPass,
                            FoldConstantsPass, U8WirePass, rebuild,
                            tensor_name)
 from .calibrate import CalibrationTable, calibrate, calibrate_arrays
+from .embed import SparseEmbedPass, default_embed_dedup
 from .fuse import (ElementwiseFusePass, FuseEpiloguePass, default_fuse,
                    fusion_passes)
 from .quantize import (QuantizePass, build_serving_pipeline,
@@ -52,7 +53,7 @@ __all__ = [
     "CSEPass", "DeadNodeEliminationPass", "FoldConstantsPass",
     "U8WirePass", "rebuild", "tensor_name",
     "ElementwiseFusePass", "FuseEpiloguePass", "default_fuse",
-    "fusion_passes",
+    "fusion_passes", "SparseEmbedPass", "default_embed_dedup",
     "CalibrationTable", "calibrate", "calibrate_arrays",
     "QuantizePass", "build_serving_pipeline", "default_fallback_dtype",
     "default_inference_pipeline", "default_quantize_ops", "quantize_model",

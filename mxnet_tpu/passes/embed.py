@@ -1,0 +1,73 @@
+"""SparseEmbedPass: deduped embedding lookups on the serving graph.
+
+Rewrites every ``Embedding`` node into ``_sparse_embedding`` (ops/
+tensor.py): the request batch's ids are uniqued in-graph (traced fixed
+``unique_cap``) and each distinct row is gathered ONCE — a rec-serve
+batch of users sharing hot ids touches each hot row once per batch, and
+out-of-range ids (the padded id-list sentinel ``>= input_dim``) read as
+zero vectors, so fixed-shape padded requests mask themselves.
+
+Inference-side only (the training-side dedup lives in the fused step's
+prologue, module/fused.py): grads never flow here, so the rewrite is a
+pure forward substitution.  In-range ids produce identical outputs; the
+one semantic change is out-of-range ids — zero vectors instead of
+``Embedding``'s clip-to-last-row garbage, which is the behavior padded
+batches want.  Off by default; ``MXNET_EMBED_DEDUP=1`` (or
+``ServeEngine(embed_dedup=True)``) turns it on.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..base import get_env
+from .graph_passes import _make_node, rebuild
+from .pipeline import Pass
+
+__all__ = ["SparseEmbedPass", "default_embed_dedup"]
+
+
+def default_embed_dedup() -> bool:
+    """The ``MXNET_EMBED_DEDUP`` default for serving pipelines."""
+    return get_env("MXNET_EMBED_DEDUP", False, bool)
+
+
+class SparseEmbedPass(Pass):
+    """Embedding -> _sparse_embedding on every node (see module
+    docstring).  ``unique_cap`` bounds the traced unique buffer per
+    lookup (0 = the id batch size: always safe; a tighter cap is a
+    bandwidth optimization for batches known to repeat ids)."""
+
+    name = "sparse_embed"
+    # run after quantize for the same reason fusion does: earlier passes
+    # match on the ORIGINAL op names
+    order_after = ("quantize",)
+
+    def __init__(self, unique_cap: Optional[int] = None):
+        super().__init__()
+        if unique_cap is None:
+            unique_cap = get_env("MXNET_EMBED_UNIQUE_CAP", 0, int)
+        self.unique_cap = int(unique_cap or 0)
+
+    def config(self) -> str:
+        return "unique_cap=%d" % self.unique_cap
+
+    def apply(self, sym, params):
+        rewritten = []
+
+        def transform(node, new_inputs):
+            if node.is_variable or \
+                    getattr(node.op, "name", "") != "Embedding":
+                return None
+            new = _make_node(
+                "_sparse_embedding", node.name,
+                {"input_dim": node.params.input_dim,
+                 "output_dim": node.params.output_dim,
+                 "unique_cap": self.unique_cap},
+                new_inputs, attrs=node.attrs)
+            rewritten.append(node.name)
+            return [(new, 0)]
+
+        out = rebuild(sym, transform)
+        self.summary = {"rewritten": len(rewritten),
+                        "nodes": rewritten}
+        return (out if rewritten else sym), params

@@ -385,7 +385,8 @@ def default_inference_pipeline(quantize: Optional[QuantizePass] = None,
                                u8_wire: Optional[U8WirePass] = None,
                                fuse=None,
                                name: str = "inference",
-                               verify: bool = True) -> PassPipeline:
+                               verify: bool = True,
+                               embed_dedup=None) -> PassPipeline:
     """The serving pipeline: [u8 wire] -> fold -> cse -> dce ->
     [quantize] -> [fuse].  Order matters: the u8 prologue must exist
     before calibration sees the graph; folds/CSE/DCE shrink what
@@ -402,13 +403,18 @@ def default_inference_pipeline(quantize: Optional[QuantizePass] = None,
     if quantize is not None:
         passes.append(quantize)
     passes += fusion_passes(fuse)
+    if embed_dedup:
+        from .embed import SparseEmbedPass
+        passes.append(SparseEmbedPass(
+            None if embed_dedup is True
+            else int(embed_dedup)))
     return PassPipeline(passes, name=name, verify=verify)
 
 
 def build_serving_pipeline(quantize=None, calib_data=None, calib_shapes=None,
                            data_name: str = "data", u8_wire=None,
                            fuse=None, name: str = "serve",
-                           ctx=None) -> PassPipeline:
+                           ctx=None, embed_dedup=None) -> PassPipeline:
     """ServeEngine's pipeline factory.
 
     ``quantize``: falsy = off; ``"int8"``/``"float16"``/``"bfloat16"``;
@@ -418,11 +424,17 @@ def build_serving_pipeline(quantize=None, calib_data=None, calib_shapes=None,
     dict.  ``u8_wire``: falsy = off; True or a dict with
     ``mean``/``scale``/``hwc``.  ``fuse``: None = the ``MXNET_FUSE``
     default (on); False = off; True/dict = fusion passes appended after
-    quantization (see ``passes.fuse``).
+    quantization (see ``passes.fuse``).  ``embed_dedup``: None = the
+    ``MXNET_EMBED_DEDUP`` default (off); True/int = rewrite Embedding
+    lookups to the deduped ``_sparse_embedding`` op (an int sets the
+    traced unique cap — see ``passes.embed``).
     """
+    from .embed import default_embed_dedup
     from .fuse import default_fuse
     if fuse is None:
         fuse = default_fuse()
+    if embed_dedup is None:
+        embed_dedup = default_embed_dedup()
     u8_pass = None
     if u8_wire:
         kw = dict(u8_wire) if isinstance(u8_wire, dict) else {}
@@ -456,7 +468,8 @@ def build_serving_pipeline(quantize=None, calib_data=None, calib_shapes=None,
         q_pass = QuantizePass(**kw)
         q_pass.ctx = ctx if q_pass.ctx is None else q_pass.ctx
     return default_inference_pipeline(quantize=q_pass, u8_wire=u8_pass,
-                                      fuse=fuse, name=name)
+                                      fuse=fuse, name=name,
+                                      embed_dedup=embed_dedup)
 
 
 def quantize_model(sym: Symbol, arg_params: Dict, aux_params: Dict,

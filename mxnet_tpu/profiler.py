@@ -31,7 +31,8 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "checkpoint_report", "checkpoint_report_str", "SuperstepStats",
            "register_superstep_stats", "superstep_report",
            "superstep_report_str", "register_serve_stats", "serve_report",
-           "serve_report_str", "compile_report", "compile_report_str",
+           "serve_report_str", "register_embed_stats", "embed_report",
+           "embed_report_str", "compile_report", "compile_report_str",
            "register_passes_stats", "passes_report", "passes_report_str",
            "register_autotune_stats", "autotune_report",
            "autotune_report_str",
@@ -560,6 +561,31 @@ def serve_report_str() -> str:
     return _serve_registry.report_str()
 
 
+# -- embedding instrumentation (mxnet_tpu.embed) ----------------------------
+# Every embedding consumer (a FusedTrainStep with sparse tables, an
+# EmbeddingTable, a device_embed kvstore) registers its EmbedStats at
+# construction, weakly like the rest; embed_report() shows per-table
+# lookup/update counts and the measured dedup ratio on the live id
+# distribution — the number bench_embed's embed_dedup_ratio leg holds.
+_embed_registry = _Registry("embed", "(no live embedding tables)")
+
+
+def register_embed_stats(embed_stats) -> None:
+    """Called by embed.EmbeddingTable / FusedTrainStep on construction."""
+    _embed_registry.register(embed_stats)
+
+
+def embed_report() -> dict:
+    """{consumer key: per-table counters} for every live embedding
+    consumer."""
+    return _embed_registry.report()
+
+
+def embed_report_str() -> str:
+    """Human-readable per-table lookup/dedup/update table."""
+    return _embed_registry.report_str()
+
+
 # -- pass-pipeline instrumentation (mxnet_tpu.passes) ------------------------
 # Every PassPipeline registers its PassStats at construction; one
 # passes_report() shows, per live pipeline, the per-pass wall time, node
@@ -640,6 +666,7 @@ def unified_report() -> dict:
         "multichip": multichip_report(),
         "checkpoint": checkpoint_report(),
         "serve": serve_report(),
+        "embed": embed_report(),
         "passes": passes_report(),
         "autotune": autotune_report(),
     }
@@ -660,6 +687,7 @@ def unified_report_str() -> str:
         ("multichip", multichip_report_str),
         ("checkpoint", checkpoint_report_str),
         ("serve", serve_report_str),
+        ("embed", embed_report_str),
         ("passes", passes_report_str),
         ("autotune", autotune_report_str),
         ("compile", compile_report_str),

@@ -99,6 +99,14 @@ class ServeEngine:
         the ``MXNET_FUSE`` default when a pipeline is built (on), False
         = off, True/dict = fusion passes even without quantization.
         Fusion is exact (bitwise in f32).
+    embed_dedup :
+        Rec-serve embedding lookups: None = the ``MXNET_EMBED_DEDUP``
+        default (off), True/int = rewrite ``Embedding`` nodes to the
+        deduped ``_sparse_embedding`` lookup (``passes.embed``) — each
+        distinct id in a request batch gathers its row once, and
+        padded/out-of-range ids read as zero vectors.  For id-list
+        models pass ``type_dict={"<ids input>": np.int32}`` so request
+        payloads ship as ints.
     autotune :
         ``True`` (or ``MXNET_AUTOTUNE=1`` with ``autotune=None``) picks
         the pass-pipeline variant by measurement — candidates are timed
@@ -135,7 +143,8 @@ class ServeEngine:
                  name: str = "serve", warmup: bool = True,
                  mesh=None, param_specs: Optional[Dict] = None,
                  quantize=None, calib_data=None, u8_wire=None,
-                 fuse=None, pipeline=None, autotune=None):
+                 fuse=None, pipeline=None, autotune=None,
+                 embed_dedup=None):
         if not input_shapes:
             raise ServeError("input_shapes must name at least one input")
         sym_json = symbol.tojson() if hasattr(symbol, "tojson") else symbol
@@ -211,13 +220,21 @@ class ServeEngine:
                 calib_data=calib_data, u8_wire=u8_wire,
                 dev=(dev_type, dev_id), name=name)
             autotuned = True
-        if pipeline is None and (quantize or u8_wire or fuse or autotuned):
+        if embed_dedup is None and pipeline is None:
+            # resolve the env default HERE, not only inside
+            # build_serving_pipeline: with no other pipeline feature on,
+            # MXNET_EMBED_DEDUP=1 alone must still build a pipeline
+            from ..passes import default_embed_dedup
+            embed_dedup = default_embed_dedup() or None
+        if pipeline is None and (quantize or u8_wire or fuse or autotuned
+                                 or embed_dedup):
             from ..passes import build_serving_pipeline
             pipeline = build_serving_pipeline(
                 quantize=quantize, calib_data=calib_data,
                 calib_shapes=self._shapes_by_bucket[self.max_batch_size],
                 data_name=data_name, u8_wire=u8_wire, fuse=fuse,
-                name=name, ctx=Context(dev_type, dev_id))
+                name=name, ctx=Context(dev_type, dev_id),
+                embed_dedup=embed_dedup)
         self.pipeline = pipeline
         self._predictor = Predictor(
             sym_json, params, self._shapes_by_bucket[self.max_batch_size],

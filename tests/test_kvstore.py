@@ -137,3 +137,96 @@ def test_dist_sync_arithmetic_single_process():
     val = mx.nd.zeros(shape)
     kv.pull(3, out=val)
     check_diff_to_scalar(val, num)
+
+
+# -- ps-lite big-array striping edges (ISSUE 12 satellite) -------------------
+# stripe_ranges / key_to_server / PSWorkerClient._plan are the placement
+# arithmetic every dist_async byte rides; these edges were untested.
+
+def test_stripe_ranges_cover_and_partition():
+    from mxnet_tpu.ps import stripe_ranges
+    for size, n in [(10, 3), (9, 3), (1000000, 7), (8, 8)]:
+        ranges = stripe_ranges(size, n)
+        assert len(ranges) == n
+        assert ranges[0][0] == 0 and ranges[-1][1] == size
+        for (alo, ahi), (blo, bhi) in zip(ranges, ranges[1:]):
+            assert ahi == blo and alo <= ahi   # contiguous, ordered
+        assert sum(hi - lo for lo, hi in ranges) == size
+
+
+def test_stripe_ranges_more_servers_than_rows():
+    """num_servers > size: the integer step is 0, so the first n-1
+    stripes are EMPTY and the tail stripe carries everything — every
+    server still gets a well-formed (possibly empty) range."""
+    from mxnet_tpu.ps import stripe_ranges
+    ranges = stripe_ranges(3, 8)
+    assert len(ranges) == 8
+    assert all(lo == 0 and hi == 0 for lo, hi in ranges[:7])
+    assert ranges[7] == (0, 3)
+    assert sum(hi - lo for lo, hi in ranges) == 3
+
+
+def test_stripe_ranges_zero_size():
+    from mxnet_tpu.ps import stripe_ranges
+    ranges = stripe_ranges(0, 4)
+    assert ranges == [(0, 0)] * 4
+
+
+def test_key_to_server_deterministic_and_in_range():
+    from mxnet_tpu.ps import key_to_server
+    for n in (1, 2, 7):
+        for key in (0, 1, 9973, "embed_weight", "fc1_bias", 12345):
+            s = key_to_server(key, n)
+            assert 0 <= s < n
+            assert s == key_to_server(key, n)        # stable
+    assert key_to_server(5, 3) == (5 * 9973) % 3     # reference formula
+
+
+def _plan_client(num_servers):
+    """A PSWorkerClient shell with just the placement state: _plan is
+    pure arithmetic over num_servers and must be testable without a
+    live scheduler/servers."""
+    from mxnet_tpu.ps import PSWorkerClient
+    c = PSWorkerClient.__new__(PSWorkerClient)
+    c.num_servers = num_servers
+    return c
+
+
+def test_plan_bigarray_bound_boundary(monkeypatch):
+    """The >= boundary is exact: size == bound stripes across ALL
+    servers, size == bound - 1 stays on its hash-placed single server."""
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "1000")
+    from mxnet_tpu.ps import key_to_server
+    c = _plan_client(4)
+    plan = c._plan(7, 1000)
+    assert [s for s, _, _ in plan] == [0, 1, 2, 3]
+    assert plan[0][1] == 0 and plan[-1][2] == 1000
+    small = c._plan(7, 999)
+    assert small == [(key_to_server(7, 4), 0, 999)]
+
+
+def test_plan_single_server_never_stripes(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "10")
+    c = _plan_client(1)
+    assert c._plan(3, 10 ** 6) == [(0, 0, 10 ** 6)]
+
+
+def test_plan_zero_size_value(monkeypatch):
+    """A zero-size array (an empty bias after a shape edge) plans as a
+    single empty range on its hash server — no striping, no crash."""
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "1000")
+    from mxnet_tpu.ps import key_to_server
+    c = _plan_client(4)
+    assert c._plan(11, 0) == [(key_to_server(11, 4), 0, 0)]
+
+
+def test_plan_more_servers_than_rows(monkeypatch):
+    """Striping a value SMALLER than the server count: empty stripes
+    for most servers, the tail server carries the whole value — the
+    plan still covers [0, size) exactly once."""
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "2")
+    c = _plan_client(8)
+    plan = c._plan(5, 3)
+    assert len(plan) == 8
+    covered = sorted((lo, hi) for _, lo, hi in plan if hi > lo)
+    assert covered == [(0, 3)]
