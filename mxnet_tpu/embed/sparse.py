@@ -25,10 +25,18 @@ protocol collapses into three traced primitives over a device-resident
 Everything here is pure jnp — traceable into the fused train step, the
 superstep scan, and the serving graph alike.  Shape-polymorphic callers
 (FusedTrainStep, the ``_sparse_embedding`` op) pick the cap; correctness
-never depends on it as long as ``cap >= #distinct ids`` in the batch —
-``dedup_ids`` guarantees that by clamping cap to ``N`` (the worst case),
-so a too-optimistic user cap can only be a performance choice, not a
-wrong-result one.
+depends on ``cap >= #distinct ids`` in the batch, counting the pad
+sentinel ALL out-of-range ids fold into as one extra id.  The default
+(no user cap) is always safe: ``resolve_cap`` sizes for the worst case
+— every id distinct PLUS one reserved sentinel slot — and reserves the
+same sentinel slot on top of an explicit user cap, so a user cap means
+"distinct REAL ids per batch".  A user cap below the batch's actual
+distinct-id count is a WRONG-RESULT choice, not a performance one:
+``jnp.unique`` truncates the overflow, the inverse indices run past the
+buffer, lookups read NaN fill and update grads silently drop (see the
+``dedup_ids`` truncation warning; ``EmbeddingTable`` guards this
+host-side under ``MXNET_EMBED_CHECK_CAP``, and docs/embedding.md states
+the sizing rule).
 """
 from __future__ import annotations
 
@@ -43,13 +51,19 @@ __all__ = ["dedup_ids", "dedup_lookup", "naive_lookup",
 
 
 def resolve_cap(cap: Optional[int], n_ids: int, vocab: int) -> int:
-    """The traced unique-output size: a caller/attr cap clamped into
-    ``[1, min(n_ids, vocab)]``; 0/None means the safe worst case
-    (every id distinct).  Must be static at trace time."""
-    worst = max(1, min(int(n_ids), int(vocab)))
+    """The traced unique-output size.  One slot is always reserved for
+    the sentinel (= ``vocab``) that ``dedup_ids`` folds EVERY
+    out-of-range id into — without it a batch covering the full vocab
+    plus a pad would overflow ``jnp.unique`` and poison the inverse
+    indices.  So the
+    worst case is ``min(n_ids, vocab + 1)`` (every id distinct, plus
+    the sentinel), which 0/None means; a caller/attr cap counts
+    distinct REAL ids and gets the same +1 sentinel allowance before
+    clamping into ``[1, worst]``.  Must be static at trace time."""
+    worst = max(1, min(int(n_ids), int(vocab) + 1))
     if not cap:
         return worst
-    return max(1, min(int(cap), worst))
+    return max(1, min(int(cap) + 1, worst))
 
 
 def dedup_ids(flat_ids, cap: int, sentinel: int) -> Tuple:
@@ -62,12 +76,18 @@ def dedup_ids(flat_ids, cap: int, sentinel: int) -> Tuple:
     is TRUNCATED by jnp.unique — callers must size cap for the worst
     case they admit (see ``resolve_cap``)."""
     flat_ids = flat_ids.astype(jnp.int32)
-    # negative ids (feed.PAD_ID = -1) fold into the HIGH sentinel HERE,
-    # at the one choke point every deduped path runs through: jax's
-    # scatter mode="drop" drops only after python-style negative-index
-    # WRAPPING, so a raw -1 in uniq would alias row vocab-1 and every
-    # padded batch would corrupt it with pad-position updates
-    flat_ids = jnp.where(flat_ids < 0, jnp.int32(sentinel), flat_ids)
+    # ALL out-of-range ids fold into the HIGH sentinel HERE, at the one
+    # choke point every deduped path runs through.  Negatives
+    # (feed.PAD_ID = -1) must fold because jax's scatter mode="drop"
+    # drops only after python-style negative-index WRAPPING — a raw -1
+    # in uniq would alias row vocab-1 and every padded batch would
+    # corrupt it with pad-position updates.  Ids above the sentinel
+    # must fold too, or each would eat its own unique-buffer slot and
+    # overflow the one reserved sentinel slot resolve_cap sizes for
+    # (they already read zero and drop on scatter, so folding is
+    # semantics-preserving).
+    oov = (flat_ids < 0) | (flat_ids >= sentinel)
+    flat_ids = jnp.where(oov, jnp.int32(sentinel), flat_ids)
     uniq, inv = jnp.unique(flat_ids, size=cap, fill_value=sentinel,
                            return_inverse=True)
     return uniq, inv.reshape(flat_ids.shape)

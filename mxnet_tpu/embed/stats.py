@@ -38,10 +38,14 @@ class EmbedStats:
         return d
 
     # -- recording ---------------------------------------------------------
-    def note_ids(self, table: str, ids) -> None:
-        """Record one lookup batch's dedup potential (host ids)."""
+    def note_ids(self, table: str, ids, n_uniq: int = None) -> None:
+        """Record one lookup batch's dedup potential (host ids).
+        ``n_uniq`` lets a caller that already counted the batch's
+        distinct values (EmbeddingTable's cap guard) skip the second
+        ``np.unique`` scan."""
         arr = np.asarray(ids).reshape(-1)
-        n_uniq = int(np.unique(arr).size)
+        if n_uniq is None:
+            n_uniq = int(np.unique(arr).size)
         with self._lock:
             d = self._tab(table)
             d["lookups"] += 1

@@ -61,9 +61,15 @@ class EmbeddingTable:
     dtype :
         Row dtype (f32 default).
     unique_cap : int, optional
-        Traced dedup output size per lookup/update batch; 0/None = the
-        safe worst case (batch size).  ``MXNET_EMBED_UNIQUE_CAP`` is
-        the env spelling.
+        Traced dedup output size per lookup/update batch, counted in
+        distinct REAL ids (a sentinel slot for padded ids is reserved
+        on top); 0/None = the safe worst case,
+        ``min(batch size, vocab + 1)``.
+        Must be >= the distinct ids any batch can contain — too small
+        truncates ``jnp.unique`` and corrupts results, which the
+        host-side ``MXNET_EMBED_CHECK_CAP`` guard (default on) turns
+        into a clear error.  ``MXNET_EMBED_UNIQUE_CAP`` is the env
+        spelling.
     optimizer :
         An ``mxnet_tpu.optimizer.Optimizer`` with a fused functional
         form and row-shaped state (SGD/NAG/Adagrad/Adam); arms
@@ -83,6 +89,7 @@ class EmbeddingTable:
         if unique_cap is None:
             unique_cap = get_env("MXNET_EMBED_UNIQUE_CAP", 0, int)
         self.unique_cap = int(unique_cap) or None
+        self._check_cap = get_env("MXNET_EMBED_CHECK_CAP", True, bool)
         self.mesh = mesh
         self._sharding = self._row_sharding(mesh, spec)
         self.stats = EmbedStats(name)
@@ -92,6 +99,7 @@ class EmbeddingTable:
         self._progs = {}
         self.optimizer = None
         self._opt_update = None
+        self._opt_init = None
         self.slots = None
         rows = self._init_rows(initializer)
         # jnp.copy: the table is DONATED by the update/accumulate
@@ -158,20 +166,52 @@ class EmbeddingTable:
                 % (type(optimizer).__name__, self.vocab, self.dim))
         self.optimizer = optimizer
         self._opt_update = opt_update
-        slots = opt_init(self.rows)
-        if self._sharding is not None:
-            slots = jax.tree_util.tree_map(
-                lambda s: jax.device_put(s, self._sharding), slots,
-                is_leaf=lambda x: x is None)
-        self.slots = slots
+        self._opt_init = opt_init
+        self.slots = self._fresh_slots()
+        # the step counter resets WITH the slots (same rule as a
+        # slot-less restore): a stale t against zeroed Adam moments
+        # would skew bias correction on every post-re-arm step
+        self._t = 0
         # drop every traced update program (keys are ("update", cap)):
         # the new optimizer's hyperparameters/closures must re-bake
         self._progs = {k: v for k, v in self._progs.items()
                        if k[0] != "update"}
 
+    def _fresh_slots(self):
+        slots = self._opt_init(self.rows)
+        if self._sharding is not None:
+            slots = jax.tree_util.tree_map(
+                lambda s: jax.device_put(s, self._sharding), slots,
+                is_leaf=lambda x: x is None)
+        return slots
+
     # -- traced programs ----------------------------------------------------
-    def _cap(self, n_ids: int) -> int:
-        return resolve_cap(self.unique_cap, n_ids, self.vocab)
+    def _distinct(self, ids_h: np.ndarray) -> int:
+        """Distinct dedup-buffer values in one host id batch: in-range
+        ids each count once, every out-of-range id shares the one
+        sentinel (the ``dedup_ids`` fold) — exactly the slots the
+        traced ``jnp.unique`` needs.  Computed ONCE per call and fed
+        to both the stats counters and the cap guard."""
+        flat = ids_h.reshape(-1)
+        return int(np.unique(
+            np.where((flat < 0) | (flat >= self.vocab), self.vocab,
+                     flat)).size)
+
+    def _cap(self, ids_h: np.ndarray, n_distinct: int) -> int:
+        cap = resolve_cap(self.unique_cap, ids_h.size, self.vocab)
+        if self._check_cap and self.unique_cap is not None \
+                and n_distinct > cap:
+            # a user cap below the batch's distinct count makes
+            # jnp.unique truncate — NaN lookups, silently dropped grads
+            raise MXNetError(
+                "EmbeddingTable %r: batch holds %d distinct ids "
+                "(out-of-range ids count as one) but unique_cap=%d "
+                "admits only %d dedup slots; jnp.unique would truncate "
+                "and corrupt the result.  Raise unique_cap / "
+                "MXNET_EMBED_UNIQUE_CAP (0 = safe worst case), or "
+                "set MXNET_EMBED_CHECK_CAP=0 to run unchecked."
+                % (self.name, n_distinct, self.unique_cap, cap))
+        return cap
 
     def _desc(self, tag: str, extra=()) -> str:
         """Trace-free fast-key description: the table geometry, sharding
@@ -284,8 +324,11 @@ class EmbeddingTable:
             raise MXNetError("combiner must be None|'sum'|'mean', got %r"
                              % (combiner,))
         ids_h = np.asarray(ids._get() if hasattr(ids, "_get") else ids)
-        self.stats.note_ids("%s_weight" % self.name, ids_h)
-        cap = self._cap(ids_h.size)
+        n_uniq = self._distinct(ids_h)
+        # guard BEFORE stats: a rejected lookup must not inflate the
+        # dedup counters (update/accumulate order likewise)
+        cap = self._cap(ids_h, n_uniq)
+        self.stats.note_ids("%s_weight" % self.name, ids_h, n_uniq=n_uniq)
         prog = self._lookup_prog(cap, combiner)
         t0 = _time.perf_counter()
         out = prog(self.rows, jnp.asarray(ids_h.astype(np.int32)))
@@ -300,18 +343,23 @@ class EmbeddingTable:
         slot buffers."""
         ids_h = np.asarray(ids._get() if hasattr(ids, "_get") else ids)
         g = grads._get() if hasattr(grads, "_get") else grads
-        cap = self._cap(ids_h.size)
+        n_uniq = self._distinct(ids_h)
+        cap = self._cap(ids_h, n_uniq)
         prog = self._update_prog(cap)
-        self.stats.note_ids("%s_weight" % self.name, ids_h)
+        self.stats.note_ids("%s_weight" % self.name, ids_h, n_uniq=n_uniq)
         self.stats.note_update("%s_weight" % self.name, cap)
         if lr is None:
             lr = self.optimizer.base_lr()
-        self._t += 1
+        # commit the step counter only AFTER the program returns: a
+        # raise mid-call (bad grads shape, trace error) must not skew
+        # Adam-style bias correction on the retry
+        t_next = self._t + 1
         t0 = _time.perf_counter()
         self.rows, self.slots = prog(
             self.rows, self.slots, jnp.asarray(ids_h.astype(np.int32)),
             jnp.asarray(g), jnp.asarray(lr, jnp.float32),
-            jnp.asarray(self._t, jnp.int32))
+            jnp.asarray(t_next, jnp.int32))
+        self._t = t_next
         _trace.complete("embed:update", t0, _time.perf_counter() - t0,
                         cat="embed")
         return self.rows
@@ -321,8 +369,9 @@ class EmbeddingTable:
         accumulates pushes" default merge).  Donates the table."""
         ids_h = np.asarray(ids._get() if hasattr(ids, "_get") else ids)
         v = values._get() if hasattr(values, "_get") else values
-        cap = self._cap(ids_h.size)
-        self.stats.note_ids("%s_weight" % self.name, ids_h)
+        n_uniq = self._distinct(ids_h)
+        cap = self._cap(ids_h, n_uniq)
+        self.stats.note_ids("%s_weight" % self.name, ids_h, n_uniq=n_uniq)
         t0 = _time.perf_counter()
         self.rows = self._accumulate_prog(cap)(
             self.rows, jnp.asarray(ids_h.astype(np.int32)),
@@ -359,7 +408,9 @@ class EmbeddingTable:
     def restore(self, tree: dict) -> None:
         """Restore from :meth:`state` output (host or device leaves);
         rows land back in this table's row sharding — a table saved on
-        one mesh restores onto another (cross-mesh restore)."""
+        one mesh restores onto another (cross-mesh restore).  A tree
+        without slots restored into an optimizer-armed table re-arms
+        fresh slots AND a fresh step counter (t = 0)."""
         def put(x):
             if x is None:
                 return None
@@ -375,10 +426,21 @@ class EmbeddingTable:
                 "EmbeddingTable %r restore carries optimizer slots but "
                 "no optimizer is set; call set_optimizer first"
                 % self.name)
-        if self.optimizer is not None:
-            self.slots = jax.tree_util.tree_map(
-                put, slots, is_leaf=lambda x: x is None)
         self._t = int(np.asarray(tree.get("t", 0)))
+        if self.optimizer is not None:
+            if slots is None:
+                # checkpoint saved without slots (optimizer-free table,
+                # or an older tree): re-arm fresh state rather than let
+                # the next update trace None into sparse_apply_rows.
+                # The step counter resets WITH the slots — carrying the
+                # tree's t against zeroed Adam moments would shrink the
+                # bias-correction denominators to ~1 and skew every
+                # post-restore step
+                self.slots = self._fresh_slots()
+                self._t = 0
+            else:
+                self.slots = jax.tree_util.tree_map(
+                    put, slots, is_leaf=lambda x: x is None)
 
 
 class _HostArr:

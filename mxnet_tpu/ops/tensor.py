@@ -565,7 +565,9 @@ class EmbeddingOp(OpDef):
 @register_op("_sparse_embedding", hint="sparse_embedding")
 class SparseEmbeddingOp(OpDef):
     """Deduped embedding lookup (mxnet_tpu.embed): unique the id batch
-    (traced fixed-size ``unique_cap``; 0 = the batch size), gather each
+    (traced fixed-size ``unique_cap``, counted in distinct REAL ids —
+    a sentinel slot for out-of-range ids is reserved on top; 0 = the
+    safe worst case, see ``embed.sparse.resolve_cap``), gather each
     distinct row ONCE, scatter back to batch positions.  Same output as
     ``Embedding`` for in-range ids; ids outside ``[0, input_dim)`` read
     as ZERO vectors (the padded-id-batch contract) where ``Embedding``

@@ -80,10 +80,86 @@ def test_dedup_scatter_add_matches_naive():
 
 
 def test_resolve_cap_clamps():
-    assert embed.resolve_cap(None, 100, VOCAB) == VOCAB
+    # the worst case reserves one slot for the pad sentinel on top of
+    # "every id distinct", bounded by vocab + 1 folded values
+    assert embed.resolve_cap(None, 100, VOCAB) == VOCAB + 1
     assert embed.resolve_cap(0, 10, VOCAB) == 10
-    assert embed.resolve_cap(8, 100, VOCAB) == 8
-    assert embed.resolve_cap(10 ** 9, 100, VOCAB) == VOCAB
+    # an explicit cap counts REAL ids: same +1 sentinel allowance
+    assert embed.resolve_cap(8, 100, VOCAB) == 9
+    assert embed.resolve_cap(10 ** 9, 100, VOCAB) == VOCAB + 1
+
+
+def test_dedup_lookup_full_vocab_plus_pad_no_nan():
+    # regression (REVIEW PR 12): a batch covering the whole vocab AND
+    # holding a pad folds 5 distinct values into what used to be a
+    # 4-slot unique buffer — jnp.unique truncated the sentinel, the
+    # inverse index ran past the buffer, and jnp.take filled NaN at
+    # the pad position
+    vocab = 4
+    W = jnp.ones((vocab, DIM), jnp.float32)
+    ids = jnp.asarray(np.array([0, 1, 2, 3, -1, 0], np.int32))
+    out, _, _ = embed.dedup_lookup(W, ids)
+    o = np.asarray(out)
+    assert np.isfinite(o).all()
+    assert (o[4] == 0).all()                      # pad reads zero
+    np.testing.assert_array_equal(
+        o[[0, 1, 2, 3, 5]], np.ones((5, DIM), np.float32))
+
+
+def test_dedup_high_oov_ids_share_sentinel_slot():
+    # ids ABOVE vocab fold into the same sentinel slot as pads: full
+    # vocab coverage + a pad + two distinct high oov ids must not
+    # overflow the default (worst-case) cap
+    vocab = 4
+    W = jnp.ones((vocab, DIM), jnp.float32)
+    ids = np.array([0, 1, 2, 3, -1, 1000, 2000], np.int32)
+    out, _, _ = embed.dedup_lookup(W, jnp.asarray(ids))
+    o = np.asarray(out)
+    assert np.isfinite(o).all()
+    np.testing.assert_array_equal(o[:4], np.ones((4, DIM), np.float32))
+    assert (o[4:] == 0).all()
+    # the table path, and oov updates still touch nothing
+    t = embed.EmbeddingTable(
+        vocab, DIM, initializer=np.asarray(W),
+        optimizer=opt_mod.SGD(learning_rate=0.5))
+    o2 = np.asarray(t.lookup(ids))
+    np.testing.assert_array_equal(o2, o)
+    t2 = embed.EmbeddingTable(vocab, DIM)
+    t2.accumulate(np.array([1000, 2000, -1], np.int32),
+                  np.ones((3, DIM), np.float32))
+    assert (t2.as_numpy() == 0).all()
+
+
+def test_table_lookup_full_vocab_plus_pads():
+    vocab = 4
+    W = np.arange(vocab * DIM, dtype=np.float32).reshape(vocab, DIM)
+    t = embed.EmbeddingTable(vocab, DIM, initializer=W)
+    ids = np.array([[0, 1, 2, 3, -1, 0]], np.int32)
+    o = np.asarray(t.lookup(ids))
+    assert np.isfinite(o).all()
+    np.testing.assert_array_equal(o[0, [0, 1, 2, 3, 5]],
+                                  W[[0, 1, 2, 3, 0]])
+    assert (o[0, 4] == 0).all()
+    # pooled mean counts only the real ids
+    m = np.asarray(t.lookup(ids, combiner="mean"))
+    np.testing.assert_allclose(m[0], W[[0, 1, 2, 3, 0]].sum(0) / 5,
+                               rtol=1e-6)
+
+
+def test_table_explicit_cap_checked_and_pads_free(monkeypatch):
+    # the host-side guard (MXNET_EMBED_CHECK_CAP default on): a user
+    # cap below the batch's distinct count raises instead of silently
+    # truncating jnp.unique
+    t = embed.EmbeddingTable(VOCAB, DIM, unique_cap=2)
+    with pytest.raises(MXNetError, match="distinct"):
+        t.lookup(np.array([0, 1, 2, 3], np.int32))
+    # pads do not eat into the cap: 2 real ids + pads fits cap=2
+    o = np.asarray(t.lookup(np.array([0, 1, -1, -1], np.int32)))
+    assert np.isfinite(o).all() and (o[2] == 0).all()
+    # the kill switch restores the unchecked path
+    monkeypatch.setenv("MXNET_EMBED_CHECK_CAP", "0")
+    t2 = embed.EmbeddingTable(VOCAB, DIM, unique_cap=2)
+    t2.lookup(np.array([0, 1, 2, 3], np.int32))   # no raise
 
 
 def test_slot_leaves_row_shaped():
@@ -741,6 +817,60 @@ def test_table_set_optimizer_rebakes_update_programs():
     ref_t.update(ids, g)
     ref_t.update(ids, g)
     np.testing.assert_allclose(got, ref_t.as_numpy(), rtol=1e-6)
+
+
+def test_table_restore_without_slots_rearms_optimizer():
+    """A checkpoint from an optimizer-free table (state() carries
+    slots=None) restored into an optimizer-armed table must re-init
+    fresh slots — not trace None into the update program."""
+    rng = np.random.RandomState(12)
+    W = rng.randn(VOCAB, DIM).astype(np.float32)
+    src = embed.EmbeddingTable(VOCAB, DIM, initializer=W)
+    state = src.state()
+    assert state["slots"] is None
+
+    def mk():
+        return embed.EmbeddingTable(
+            VOCAB, DIM, initializer=W,
+            optimizer=opt_mod.SGD(momentum=0.9, learning_rate=0.1))
+    dst = mk()
+    dst.restore(state)
+    np.testing.assert_array_equal(dst.as_numpy(), W)
+    ids = np.array([1, 2, 1], np.int32)
+    g = np.ones((3, DIM), np.float32)
+    dst.update(ids, g)
+    after = dst.as_numpy()
+    assert np.isfinite(after).all()
+    # fresh slots == a newly armed table: step parity
+    ref = mk()
+    ref.update(ids, g)
+    np.testing.assert_allclose(after, ref.as_numpy(), rtol=1e-6)
+    # an older tree missing the key entirely behaves the same, and the
+    # checkpoint's step counter resets WITH the fresh slots — t=5000
+    # against zeroed Adam moments would skew bias correction
+    dst2 = mk()
+    dst2.restore({"rows": W, "t": 5000})
+    assert dst2._t == 0
+    dst2.update(ids, g)
+    np.testing.assert_allclose(dst2.as_numpy(), ref.as_numpy(),
+                               rtol=1e-6)
+
+
+def test_table_update_step_counter_commits_after_success():
+    """A failed update (bad grads shape) must not advance the step
+    counter — Adam bias correction would skew on the retry."""
+    t = embed.EmbeddingTable(
+        VOCAB, DIM, optimizer=opt_mod.Adam(learning_rate=0.1))
+    ids = np.array([1, 2], np.int32)
+    with pytest.raises(Exception):
+        t.update(ids, np.ones((2, DIM + 1), np.float32))
+    assert t._t == 0
+    t.update(ids, np.ones((2, DIM), np.float32))
+    assert t._t == 1
+    # re-arming the optimizer resets the counter WITH the fresh slots
+    # (stale t against zeroed Adam moments skews bias correction)
+    t.set_optimizer(opt_mod.Adam(learning_rate=0.05))
+    assert t._t == 0
 
 
 def test_serve_engine_embed_dedup_env_default(monkeypatch):
