@@ -440,7 +440,14 @@ def main():
     # Includes the quantized leg (mxnet_tpu.passes): the same load on a
     # wide-FC model served f32 vs calibrated int8 — serve_qps_int8,
     # serve_quant_speedup (acceptance >= 1.5) and serve_quant_top1_delta
-    # (acceptance <= 0.005), gated by tools/bench_gate.py from round 1
+    # (acceptance <= 0.005), gated by tools/bench_gate.py from round 1.
+    # ISSUE 13 scale-out legs ride along: continuous-batching decode
+    # tokens/sec vs serial per-stream decode (serve_decode_speedup,
+    # acceptance >= 3x at high slot occupancy, token-parity checked), a
+    # mixed-model closed-loop flood over 3 multiplexed models
+    # (serve_mux_qps / serve_mux_p99_ms with serve_mux_steady_compiles
+    # gated at 0), and a 3-replica router flood with a draining restart
+    # mid-window (serve_router_restart_drops gated at 0)
     try:
         from bench_serve import run as serve_run
         _feed_watchdog("serve")

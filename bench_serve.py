@@ -34,6 +34,28 @@ near-uniform logits.
   serve_quant_speedup     qps_int8 / qps_f32_wide (acceptance: >= 1.5)
   serve_quant_top1_delta  fraction of requests whose argmax differs
                           from the f32 engine's (acceptance: <= 0.005)
+
+Scale-out legs (ISSUE 13) — the serve/ continuous-batching, model-
+multiplexing and router subsystems under the same closed-loop
+discipline, token-parity / answer-parity checked:
+
+  serve_decode_tok_s          continuous-batching DecodeEngine (8
+                              slots, 12 closed-loop clients) tokens/sec
+  serve_decode_serial_tok_s   the serial baseline: one request at a
+                              time through a 1-slot engine
+  serve_decode_speedup        tok_s / serial_tok_s (acceptance: >= 3x
+                              at high slot occupancy)
+  serve_decode_occupancy      mean slot fill during the loaded windows
+  serve_decode_p99_ms         per-stream latency p99 (lower-is-better)
+  serve_mux_qps               aggregate QPS over 3 multiplexed models
+                              under one closed-loop flood
+  serve_mux_p99_ms            client-observed p99 across all 3 models
+  serve_mux_steady_compiles   XLA compiles during the steady flood
+                              (must be 0; gated lower-is-better)
+  serve_router_qps            3-replica router under flood WITH a
+                              draining restart mid-window
+  serve_router_restart_drops  requests dropped through that restart
+                              (must be 0; gated lower-is-better)
 """
 import shutil
 import tempfile
@@ -174,12 +196,22 @@ def run(feed=lambda *_: None, threads=N_THREADS,
         out["serve_threads"] = threads
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
-    # the quantized leg must never sink the measured main-leg numbers
+    # satellite legs must never sink the measured main-leg numbers
     try:
         out.update(quant_leg(feed=feed, threads=threads))
     except Exception as e:            # pragma: no cover
         import sys
         sys.stderr.write("bench_serve: quantized leg failed (%s)\n" % e)
+    try:
+        out.update(decode_leg(feed=feed))
+    except Exception as e:            # pragma: no cover
+        import sys
+        sys.stderr.write("bench_serve: decode leg failed (%s)\n" % e)
+    try:
+        out.update(scaleout_leg(feed=feed, threads=threads))
+    except Exception as e:            # pragma: no cover
+        import sys
+        sys.stderr.write("bench_serve: scale-out leg failed (%s)\n" % e)
     return out
 
 
@@ -308,6 +340,312 @@ def quant_leg(feed=lambda *_: None, threads=N_THREADS,
         "serve_quant_top1_delta": round(
             float((yf.argmax(1) != yq.argmax(1)).mean()), 4),
     }
+
+
+# -- scale-out legs (ISSUE 13) ----------------------------------------------
+D_VOCAB, D_EMB, D_HID = 64, 32, 64
+D_SLOTS = 8
+D_MAX_NEW = 24
+D_STREAMS = 48          # per window
+D_WINDOWS = 3
+
+
+def _decode_symbol():
+    import mxnet_tpu as mx
+    tok = mx.sym.Variable("data")
+    h = mx.sym.Variable("h")
+    emb = mx.sym.Embedding(tok, input_dim=D_VOCAB, output_dim=D_EMB,
+                           name="emb")
+    emb = mx.sym.Flatten(emb)
+    z = mx.sym.FullyConnected(emb, num_hidden=D_HID, name="ih") + \
+        mx.sym.FullyConnected(h, num_hidden=D_HID, name="hh")
+    h_next = mx.sym.Activation(z, act_type="tanh")
+    logits = mx.sym.FullyConnected(h_next, num_hidden=D_VOCAB, name="out")
+    return mx.sym.Group([logits, h_next])
+
+
+def _decode_params():
+    rng = np.random.RandomState(11)
+
+    def g(*s):
+        return (rng.randn(*s) * 0.4).astype(np.float32)
+
+    return {"emb_weight": g(D_VOCAB, D_EMB),
+            "ih_weight": g(D_HID, D_EMB),
+            "ih_bias": np.zeros(D_HID, np.float32),
+            "hh_weight": g(D_HID, D_HID),
+            "hh_bias": np.zeros(D_HID, np.float32),
+            "out_weight": g(D_VOCAB, D_HID),
+            "out_bias": np.zeros(D_VOCAB, np.float32)}
+
+
+def decode_leg(feed=lambda *_: None, threads=N_THREADS):
+    """serve_decode_tok_s / serve_decode_speedup: continuous batching
+    (8 slots, closed-loop clients) vs serial one-stream-at-a-time
+    decode of the SAME recurrent model, token-parity checked.
+    Interleaved windows like the main leg."""
+    import threading as _threading
+
+    from mxnet_tpu.serve import DecodeEngine
+
+    sym, params = _decode_symbol(), _decode_params()
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, D_VOCAB, 1 + rng.randint(0, 4))
+               for _ in range(D_STREAMS)]
+
+    feed("serve-decode-warmup")
+    serial_eng = DecodeEngine(sym, dict(params),
+                              state_shapes={"h": (D_HID,)},
+                              num_slots=1, queue_depth=2 * D_STREAMS,
+                              name="bench-decode-serial")
+    cont_eng = DecodeEngine(sym, dict(params),
+                            state_shapes={"h": (D_HID,)},
+                            num_slots=D_SLOTS, queue_depth=2 * D_STREAMS,
+                            name="bench-decode")
+    serial_out = [None] * D_STREAMS
+    cont_out = [None] * D_STREAMS
+
+    def serial_window():
+        t0 = time.perf_counter()
+        toks = 0
+        for i, p in enumerate(prompts):
+            serial_out[i] = serial_eng.generate(
+                p, timeout=600, max_new_tokens=D_MAX_NEW)
+            toks += len(serial_out[i])
+        return toks / (time.perf_counter() - t0)
+
+    def cont_window():
+        errors = []
+
+        def client(t):
+            try:
+                for i in range(t, D_STREAMS, threads):
+                    cont_out[i] = cont_eng.generate(
+                        prompts[i], timeout=600, max_new_tokens=D_MAX_NEW)
+            except Exception as e:               # pragma: no cover
+                errors.append(e)
+        workers = [_threading.Thread(target=client, args=(t,))
+                   for t in range(threads)]
+        t0 = time.perf_counter()
+        for wk in workers:
+            wk.start()
+        for wk in workers:
+            wk.join()
+        if errors:
+            raise errors[0]
+        return sum(len(y) for y in cont_out) / (time.perf_counter() - t0)
+
+    try:
+        serial_rates, cont_rates, ratios = [], [], []
+        for w in range(D_WINDOWS):
+            feed("serve-decode-serial")
+            serial_rates.append(serial_window())
+            feed("serve-decode-load")
+            cont_rates.append(cont_window())
+            ratios.append(cont_rates[-1] / serial_rates[-1])
+        rep = cont_eng.stats.report()
+    finally:
+        serial_eng.close()
+        cont_eng.close()
+    # greedy decode is deterministic: the slot engine must emit the
+    # SAME tokens the serial engine does, stream for stream
+    for i in range(D_STREAMS):
+        if not np.array_equal(serial_out[i], cont_out[i]):
+            raise AssertionError(
+                "decode stream %d diverges between serial and "
+                "continuous batching" % i)
+
+    def peak(rates):
+        med = sorted(rates)[len(rates) // 2]
+        return max(r for r in rates if r <= 1.3 * med)
+
+    return {
+        "serve_decode_tok_s": round(peak(cont_rates), 1),
+        "serve_decode_serial_tok_s": round(peak(serial_rates), 1),
+        "serve_decode_speedup": round(peak(ratios), 2),
+        "serve_decode_occupancy": rep["slot_occupancy"],
+        "serve_decode_p99_ms": rep["latency_p99_ms"],
+        "serve_decode_slots": D_SLOTS,
+    }
+
+
+MUX_MODELS = {"small": 64, "medium": 128, "wide": 256}
+MUX_REQS_PER_THREAD = 40
+ROUTER_REPLICAS = 3
+ROUTER_REQS_PER_THREAD = 40
+
+
+class _CompileCounter:
+    """Minimal inline twin of tests/common/compile_guard.py (bench must
+    not depend on the test tree): counts real XLA backend compiles."""
+
+    def __enter__(self):
+        from jax import monitoring
+        self.count = 0
+
+        def listener(event, duration_secs, **kw):
+            if event == "/jax/core/compile/backend_compile_duration":
+                self.count += 1
+        self._listener = listener
+        monitoring.register_event_duration_secs_listener(listener)
+        return self
+
+    def __exit__(self, *exc):
+        import jax._src.monitoring as impl
+        impl._unregister_event_duration_listener_by_callback(self._listener)
+        return False
+
+
+def scaleout_leg(feed=lambda *_: None, threads=N_THREADS):
+    """serve_mux_qps / serve_mux_p99_ms / serve_mux_steady_compiles +
+    serve_router_qps / serve_router_restart_drops: a closed-loop flood
+    over 3 multiplexed models (steady loop must not compile), then a
+    3-replica router flood with a draining restart mid-window (zero
+    dropped requests)."""
+    import threading as _threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.serve import ModelMultiplexer, ServeEngine, ServeRouter
+
+    def mlp(hidden, name):
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(net, num_hidden=hidden,
+                                    name="%s_fc1" % name)
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=CLASSES,
+                                    name="%s_fc2" % name)
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    def mlp_params(hidden, name, seed):
+        rng = np.random.RandomState(seed)
+        return {"%s_fc1_weight" % name:
+                rng.randn(hidden, IN_DIM).astype(np.float32),
+                "%s_fc1_bias" % name: np.zeros(hidden, np.float32),
+                "%s_fc2_weight" % name:
+                rng.randn(CLASSES, hidden).astype(np.float32),
+                "%s_fc2_bias" % name: np.zeros(CLASSES, np.float32)}
+
+    shapes = {"data": (1, IN_DIM), "softmax_label": (1,)}
+    buckets = tuple(b for b in (1, 2, 4, 8, 16) if b <= threads) \
+        + ((threads,) if threads & (threads - 1) else ())
+    X = np.random.RandomState(5).rand(
+        threads * MUX_REQS_PER_THREAD, IN_DIM).astype(np.float32)
+    out = {}
+
+    # -- mixed-model multiplexed flood ----------------------------------
+    feed("serve-mux-warmup")
+    mux = ModelMultiplexer(name="bench-mux")
+    for i, (m, hidden) in enumerate(sorted(MUX_MODELS.items())):
+        mux.add_model(m, lambda h=hidden, nm=m, s=i:
+                      ServeEngine(mlp(h, nm), mlp_params(h, nm, s),
+                                  shapes, batch_buckets=buckets,
+                                  max_delay_ms=2.0, deadline_ms=60000.0,
+                                  name="bench-%s" % nm))
+    try:
+        models = sorted(MUX_MODELS)
+        mux.prewarm()
+        refs = {m: mux.predict(m, X[0], timeout=60) for m in models}
+        lat = []
+        lat_lock = _threading.Lock()
+        errors = []
+
+        def client(t):
+            try:
+                my = []
+                for j in range(MUX_REQS_PER_THREAD):
+                    i = t * MUX_REQS_PER_THREAD + j
+                    m = models[i % len(models)]
+                    t0 = time.perf_counter()
+                    y = mux.predict(m, X[i], timeout=120)
+                    my.append((time.perf_counter() - t0) * 1e3)
+                    if i % 37 == 0 and not np.allclose(
+                            y.sum(), y.sum()):     # pragma: no cover
+                        raise AssertionError("nan from model %s" % m)
+                with lat_lock:
+                    lat.extend(my)
+            except Exception as e:               # pragma: no cover
+                errors.append(e)
+
+        feed("serve-mux-load")
+        with _CompileCounter() as cc:
+            workers = [_threading.Thread(target=client, args=(t,))
+                       for t in range(threads)]
+            t0 = time.perf_counter()
+            for wk in workers:
+                wk.start()
+            for wk in workers:
+                wk.join()
+            elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        # spot parity: each model still answers exactly its own weights
+        for m in models:
+            if not np.allclose(mux.predict(m, X[0], timeout=60), refs[m],
+                               atol=1e-5):
+                raise AssertionError("model %s drifted under the flood" % m)
+        lat.sort()
+        out["serve_mux_qps"] = round(len(X) / elapsed, 1)
+        out["serve_mux_p99_ms"] = round(
+            lat[max(0, int(0.99 * len(lat)) - 1)], 3)
+        out["serve_mux_models"] = len(models)
+        out["serve_mux_steady_compiles"] = cc.count
+    finally:
+        mux.close()
+
+    # -- router flood with a draining restart ---------------------------
+    feed("serve-router-load")
+    net, pars = mlp(128, "rt"), mlp_params(128, "rt", 0)
+
+    def factory(i):
+        return ServeEngine(net, dict(pars), shapes, batch_buckets=buckets,
+                           max_delay_ms=2.0, deadline_ms=60000.0,
+                           name="bench-rep%d" % i)
+
+    router = ServeRouter(factory, replicas=ROUTER_REPLICAS,
+                         name="bench-router")
+    try:
+        from mxnet_tpu.predictor import Predictor
+        ref_pred = Predictor(net.tojson(), dict(pars),
+                             {"data": (1, IN_DIM), "softmax_label": (1,)})
+        n = threads * ROUTER_REQS_PER_THREAD
+        results = [None] * n
+        errors = []
+        started = _threading.Event()
+
+        def rclient(t):
+            try:
+                for j in range(ROUTER_REQS_PER_THREAD):
+                    i = t * ROUTER_REQS_PER_THREAD + j
+                    results[i] = router.predict(X[i % len(X)], timeout=120)
+                    if j == 2:
+                        started.set()
+            except Exception as e:               # pragma: no cover
+                errors.append(e)
+
+        workers = [_threading.Thread(target=rclient, args=(t,))
+                   for t in range(threads)]
+        t0 = time.perf_counter()
+        for wk in workers:
+            wk.start()
+        started.wait(60)
+        router.restart(1, timeout=300)      # draining rebuild mid-flood
+        for wk in workers:
+            wk.join()
+        elapsed = time.perf_counter() - t0
+        drops = sum(1 for y in results if y is None) + len(errors)
+        for i in range(0, n, max(1, n // 100)):
+            if results[i] is None:
+                continue
+            want = ref_pred.predict(X[i % len(X)][None])[0]
+            if not np.allclose(results[i], want, atol=1e-4):
+                raise AssertionError(
+                    "router answer %d diverges through the restart" % i)
+        out["serve_router_qps"] = round(n / elapsed, 1)
+        out["serve_router_restart_drops"] = drops
+        out["serve_router_replicas"] = ROUTER_REPLICAS
+    finally:
+        router.close()
+    return out
 
 
 if __name__ == "__main__":

@@ -539,25 +539,38 @@ def checkpoint_report_str() -> str:
 
 
 # -- serving instrumentation (mxnet_tpu.serve) ------------------------------
-# Live ServeEngines register their ServeStats here, weakly like the feed
-# pipelines, so one serve_report() shows every engine's request latency
-# percentiles, queue depth, batch occupancy, pad waste, and per-bucket
-# hit counts — the capacity-planning numbers for the inference side.
+# Every serving component registers its stats object here, weakly like
+# the feed pipelines, so one serve_report() is MULTIPLEX-AWARE: a
+# process serving N models shows one row per component, each tagged by
+# "kind" and carrying its OWN capacity shape — ServeStats rows (kind
+# "engine": latency percentiles, queue depth, batch occupancy against
+# that engine's max_batch_size, pad waste, per-bucket hits), DecodeStats
+# rows (kind "decode": slot occupancy, steps, tokens out), the
+# multiplexer's MuxStats (kind "mux": swap-in/eviction counters, live
+# bytes vs budget) and the router's RouterStats (kind "router":
+# per-replica dispatch/health plus a rollup of the replicas' counters).
 _serve_registry = _Registry("serve", "(no live serve engines)")
 
 
 def register_serve_stats(serve_stats) -> None:
-    """Called by serve.ServeEngine on construction."""
+    """Called by serve.ServeEngine / DecodeEngine / ModelMultiplexer /
+    ServeRouter on construction (any object with name/report/report_str
+    rides along)."""
     _serve_registry.register(serve_stats)
 
 
 def serve_report() -> dict:
-    """{engine key: counters} for every live serve engine."""
+    """{component key: counters} for every live serving component
+    (engines, decode engines, multiplexers, routers — see the "kind"
+    field per row)."""
     return _serve_registry.report()
 
 
 def serve_report_str() -> str:
-    """Human-readable latency/occupancy/queue table per serve engine."""
+    """Human-readable per-component serving table (latency/occupancy/
+    queue per engine, slot occupancy per decode engine, swap-in and
+    eviction counters per multiplexer, per-replica rollups per
+    router)."""
     return _serve_registry.report_str()
 
 

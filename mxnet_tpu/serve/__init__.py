@@ -25,6 +25,24 @@ the resulting models a serving path with the same discipline:
   ``serve_report_str()``: latency p50/p95/p99, queue depth, batch
   occupancy, pad waste, per-bucket hit counts.
 
+Scale-out (the other half of "heavy traffic" — see docs/serve.md):
+
+* **continuous batching for stateful decode** (decode.py) —
+  :class:`DecodeEngine` admits autoregressive/recurrent streams into a
+  fixed set of decode *slots*; per-slot hidden state stays on device
+  across steps, new requests join freed slots between steps without
+  retracing, finished streams resolve immediately, and hot reload uses
+  a drain barrier so no stream ever mixes weight versions;
+* **model multiplexing** (mux.py) — :class:`ModelMultiplexer` shares
+  one chip between N models with memory-aware admission
+  (``MXNET_SERVE_MUX_BYTES`` / ``MXNET_SERVE_MUX_LIVE``) and LRU
+  eviction of idle models; swap-in rides the compile cache, so churn
+  costs buffer copies, not XLA;
+* **a replica front door** (router.py) — :class:`ServeRouter` spreads
+  load across replica engines by queue depth, routes around overload
+  and unhealthy replicas, and does **draining restarts** (weight swap
+  or full rebuild) with zero dropped requests.
+
 Quick start::
 
     eng = mx.serve.ServeEngine.from_checkpoint(
@@ -36,16 +54,26 @@ Quick start::
 
 Knobs (constructor args override): ``MXNET_SERVE_MAX_BATCH``,
 ``MXNET_SERVE_MAX_DELAY_MS``, ``MXNET_SERVE_QUEUE_DEPTH``,
-``MXNET_SERVE_DEADLINE_MS`` — see docs/env_var.md.
+``MXNET_SERVE_DEADLINE_MS``, ``MXNET_SERVE_SLOTS``,
+``MXNET_SERVE_DECODE_QUEUE``, ``MXNET_SERVE_MAX_TOKENS``,
+``MXNET_SERVE_MUX_BYTES``, ``MXNET_SERVE_MUX_LIVE``,
+``MXNET_SERVE_ROUTER_UNHEALTHY`` — see docs/env_var.md.
 """
 from __future__ import annotations
 
 from .batcher import MicroBatcher
+from .decode import DecodeEngine
 from .engine import ServeEngine, default_buckets
 from .errors import (ServeClosedError, ServeDeadlineError, ServeError,
-                     ServeOverloadError, ServeRequestError)
-from .stats import ServeStats
+                     ServeOverloadError, ServeRequestError,
+                     ServeUnavailableError)
+from .mux import ModelMultiplexer, MuxStats
+from .router import RouterStats, ServeRouter
+from .stats import DecodeStats, ServeStats
 
-__all__ = ["ServeEngine", "MicroBatcher", "ServeStats", "default_buckets",
+__all__ = ["ServeEngine", "DecodeEngine", "ModelMultiplexer",
+           "ServeRouter", "MicroBatcher", "ServeStats", "DecodeStats",
+           "MuxStats", "RouterStats", "default_buckets",
            "ServeError", "ServeOverloadError", "ServeDeadlineError",
-           "ServeRequestError", "ServeClosedError"]
+           "ServeRequestError", "ServeClosedError",
+           "ServeUnavailableError"]

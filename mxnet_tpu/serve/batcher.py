@@ -173,10 +173,13 @@ class MicroBatcher:
                     "serve queue full (%d queued, depth %d): shed load or "
                     "retry with backoff" % (len(self._q), self._queue_depth))
             self._q.append(req)
-            depth = len(self._q)
+            # inside the cv: recorded depths stay ordered against the
+            # dispatcher's set_queue_depth (which runs after its own
+            # queue pop) — an on_submit landing after a fresher 0 would
+            # freeze a nonzero gauge on an empty queue
+            if self._stats is not None:
+                self._stats.on_submit(len(self._q))
             self._cv.notify()
-        if self._stats is not None:
-            self._stats.on_submit(depth)
         return req.future
 
     def queue_depth(self) -> int:
@@ -239,9 +242,19 @@ class MicroBatcher:
         while True:
             batch = self._gather()
             if batch is None:
+                # closed and drained: the gauge must read 0, not the
+                # depth of the last submit (a report taken after
+                # shutdown showed the final backlog forever)
+                if self._stats is not None:
+                    with self._cv:      # cv-ordered like every write
+                        self._stats.set_queue_depth(0)
                 break
             if self._stats is not None:
-                self._stats.set_queue_depth(self.queue_depth())
+                # read-and-write under the cv: all gauge writes are
+                # ordered by it, so no stale depth can overwrite a
+                # fresher one
+                with self._cv:
+                    self._stats.set_queue_depth(len(self._q))
             now = time.perf_counter()
             live = []
             cancelled = 0
@@ -341,6 +354,14 @@ class MicroBatcher:
             dropped = [] if drain else list(self._q)
             if not drain:
                 self._q.clear()
+                # drop path: the queue is empty NOW and the dispatcher
+                # may never see it again — zero the gauge here, under
+                # the cv so it cannot race a dispatcher write.  The
+                # drain path leaves the gauge to the dispatcher, whose
+                # exit writes the final 0 (writing the pre-drain depth
+                # here could land AFTER that 0 and freeze it).
+                if self._stats is not None:
+                    self._stats.set_queue_depth(0)
             self._cv.notify_all()
         failed = cancelled = 0
         for r in dropped:

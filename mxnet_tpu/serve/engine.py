@@ -506,6 +506,22 @@ class ServeEngine:
         ``queue_depth`` attribute is the configured bound)."""
         return self._batcher.queue_depth()
 
+    def outstanding(self) -> int:
+        """Admitted requests not yet terminally resolved (queued or in
+        flight) — what a router or multiplexer must wait out before it
+        may drain or evict this engine."""
+        return self.stats.outstanding()
+
+    def device_bytes(self) -> int:
+        """Approximate device-memory footprint of this engine: every
+        distinct PERSISTENT buffer bound by the bucket-grid executors —
+        parameters (shared across buckets, counted once) and per-bucket
+        input staging buffers.  Transient forward outputs are not
+        counted, so the real peak runs somewhat above this; size
+        ``MXNET_SERVE_MUX_BYTES`` with headroom.  The multiplexer's
+        admission budget is checked against this."""
+        return exec_device_bytes(self._predictor._exec_cache.values())
+
     # -- lifecycle ---------------------------------------------------------
     def close(self, drain: bool = True) -> None:
         """Graceful shutdown: stop admissions, drain queued requests
@@ -549,6 +565,29 @@ class ServeEngine:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def exec_device_bytes(execs) -> int:
+    """Distinct PERSISTENT device bytes bound by an iterable of
+    executors (arg + aux buffers), deduped by owning buffer (shared
+    param NDArrays count once across bucket executors); transient
+    forward outputs are excluded.  The one accounting the multiplexer
+    budgets against — ServeEngine and DecodeEngine must agree on it, so
+    there is exactly one implementation."""
+    seen = set()
+    total = 0
+    for ex in execs:
+        for d in (ex.arg_dict, ex.aux_dict):
+            for arr in d.values():
+                root = arr._root()
+                if id(root) in seen:
+                    continue
+                seen.add(id(root))
+                a = root._get()
+                if a is not None:
+                    total += int(getattr(a, "nbytes", 0) or
+                                 a.size * np.dtype(a.dtype).itemsize)
+    return total
 
 
 def _load_checkpoint_dir_params(directory: str,

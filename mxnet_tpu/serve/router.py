@@ -1,0 +1,462 @@
+"""ServeRouter: a front door spreading load across replica engines.
+
+One engine is one dispatcher on one device; the front door for real
+traffic is N **replicas** of the same model behind a router that
+
+* **dispatches by queue depth**: each request goes to the live replica
+  with the least work in flight (outstanding + queued) — the cheap
+  approximation of join-the-shortest-queue that keeps p99 flat when one
+  replica hiccups;
+* **routes around overload**: a replica whose bounded queue rejects is
+  skipped and the next-least-loaded one tried; only when EVERY live
+  replica rejects does the caller see ``ServeOverloadError``;
+* **tracks health**: replica failures (engine errors, not client-side
+  deadline/validation errors) count per replica; at
+  ``MXNET_SERVE_ROUTER_UNHEALTHY`` consecutive failures the replica is
+  taken out of rotation (state ``down``) until an operator restarts it.
+  A failed request is retried once on another replica before the
+  client sees the error;
+* **restarts without dropping**: ``restart(i)`` marks the replica
+  *draining* — the router stops dispatching to it, waits out its
+  in-flight requests, then hot-swaps weights (``reload=``) or rebuilds
+  the engine through its factory (warm via the compile cache) and puts
+  it back in rotation.  Traffic rides the other replicas the whole
+  time: zero dropped requests.  ``rolling_restart()`` does this to
+  every replica in turn — the zero-downtime deploy primitive.
+
+::
+
+    router = mx.serve.ServeRouter(
+        lambda i: ServeEngine.from_checkpoint_dir(store, net, shapes,
+                                                  name="rep%d" % i),
+        replicas=3)
+    fut = router.submit(x)
+    router.rolling_restart()            # picks up the newest checkpoint
+    print(mx.profiler.serve_report_str())
+    router.close()
+
+The router is in-process (replica engines own their device context and
+threads); across hosts the same dispatch/drain logic fronts RPC stubs —
+the replica surface is just ``submit / pending_requests / outstanding /
+close``.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional
+
+from .. import trace as _trace
+from ..base import get_env, make_condition
+from .batcher import _set_exception, _set_result
+from .errors import (ServeClosedError, ServeDeadlineError, ServeError,
+                     ServeOverloadError, ServeRequestError,
+                     ServeUnavailableError)
+
+__all__ = ["ServeRouter", "RouterStats"]
+
+LIVE, DRAINING, DOWN = "live", "draining", "down"
+
+# drain poll bound: wakes also arrive via the cv notify in _on_done, so
+# this only bounds shutdown/timeout latency
+_IDLE_WAIT_S = 0.05
+
+
+class RouterStats:
+    """Router counters + per-replica rollup: one row in
+    ``mx.profiler.serve_report()`` (kind "router")."""
+
+    def __init__(self, name: str, router: "ServeRouter"):
+        self.name = name
+        import weakref
+        self._router = weakref.ref(router)
+
+    def report(self) -> Dict:
+        r = self._router()
+        if r is None:
+            return {"kind": "router", "closed": True}
+        return r._report()
+
+    def report_str(self) -> str:
+        r = self._router()
+        if r is None:
+            return "serve router (closed)"
+        return r._report_str()
+
+
+class _Replica:
+    __slots__ = ("index", "engine", "state", "outstanding", "dispatched",
+                 "failures", "restarts")
+
+    def __init__(self, index: int, engine):
+        self.index = index
+        self.engine = engine
+        self.state = LIVE
+        self.outstanding = 0        # dispatched via the router, unresolved
+        self.dispatched = 0
+        self.failures = 0           # consecutive engine-side failures
+        self.restarts = 0
+
+
+class ServeRouter:
+    """Queue-depth/health-aware dispatch over replica engines (see
+    module docstring).
+
+    Parameters
+    ----------
+    factory : callable(index) -> engine
+        Builds replica ``i``; also used by ``restart`` to rebuild.  Any
+        engine with ``submit / pending_requests / outstanding / close``
+        qualifies (ServeEngine, DecodeEngine).
+    replicas : int
+        How many replicas to build at construction.
+    unhealthy_after : int
+        Consecutive engine-side failures that take a replica out of
+        rotation (``MXNET_SERVE_ROUTER_UNHEALTHY``, default 3; 0
+        disables).
+    retries : int
+        How many times a failed request is re-dispatched to another
+        replica before the client sees the failure (default 1).
+    """
+
+    def __init__(self, factory: Callable[[int], object], replicas: int = 2,
+                 *, unhealthy_after: Optional[int] = None,
+                 retries: int = 1, name: str = "router"):
+        if replicas < 1:
+            raise ServeError("replicas must be >= 1, got %d" % replicas)
+        if unhealthy_after is None:
+            unhealthy_after = get_env("MXNET_SERVE_ROUTER_UNHEALTHY", 3, int)
+        self.unhealthy_after = max(0, int(unhealthy_after))
+        self.retries = max(0, int(retries))
+        self.name = name
+        self._factory = factory
+        self._cv = make_condition("serve.router")
+        self._closed = False
+        self._rejected = 0
+        self._retried = 0
+        self._drains = 0
+        self._downs = 0
+        self._replicas: List[_Replica] = []
+        try:
+            for i in range(int(replicas)):
+                self._replicas.append(_Replica(i, factory(i)))
+        except BaseException:
+            for rep in self._replicas:
+                try:
+                    rep.engine.close(drain=False)
+                except Exception:
+                    pass
+            raise
+        self.stats = RouterStats(name, self)
+        from .. import profiler
+        profiler.register_serve_stats(self.stats)
+
+    # -- dispatch ----------------------------------------------------------
+    def _load(self, rep: _Replica) -> int:
+        try:
+            return rep.outstanding + rep.engine.pending_requests()
+        except Exception:
+            return 1 << 30
+
+    def _pick_locked(self, exclude) -> Optional[_Replica]:
+        """Least-loaded live replica not in ``exclude``."""
+        live = [r for r in self._replicas
+                if r.state == LIVE and r.index not in exclude]
+        if not live:
+            return None
+        return min(live, key=self._load)
+
+    def submit(self, data, deadline_ms: Optional[float] = None,
+               **kwargs) -> Future:
+        """Dispatch one request; returns a router-owned Future.  Raises
+        ServeUnavailableError when no replica is live,
+        ServeOverloadError when every live replica's queue rejects;
+        replica-side failures are retried on another replica before
+        they reach this future."""
+        rfut: Future = Future()
+        self._dispatch(rfut, data, deadline_ms, kwargs, tried=set(),
+                       retries_left=self.retries)
+        return rfut
+
+    def predict(self, data, timeout: Optional[float] = None, **kwargs):
+        """Blocking one-shot: submit + result."""
+        return self.submit(data, **kwargs).result(timeout=timeout)
+
+    def _dispatch(self, rfut: Future, data, deadline_ms, kwargs,
+                  tried, retries_left: int) -> None:
+        """Place the request on the best available replica; on overload
+        walk the remaining live replicas.  Raises into the CALLER when
+        nothing accepted and ``rfut`` was never dispatched; replica
+        failures after acceptance retry via the done callback."""
+        overloads = 0
+        last_exc = None
+        while True:
+            with self._cv:
+                if self._closed:
+                    raise ServeClosedError(
+                        "serve router %r is closed" % self.name)
+                rep = self._pick_locked(tried)
+                if rep is None:
+                    self._rejected += 1
+                    if overloads:
+                        raise ServeOverloadError(
+                            "every live replica's queue is full "
+                            "(%d rejected this dispatch): shed load or "
+                            "add replicas" % overloads)
+                    if last_exc is not None:
+                        raise last_exc
+                    raise ServeUnavailableError(
+                        "no live replica (states: %s) — all draining/"
+                        "down; restart or add replicas"
+                        % [r.state for r in self._replicas])
+                rep.outstanding += 1    # reserve before releasing the lock
+            try:
+                efut = rep.engine.submit(data, deadline_ms=deadline_ms,
+                                         **kwargs)
+            except ServeOverloadError:
+                with self._cv:
+                    rep.outstanding -= 1
+                    self._cv.notify_all()
+                tried.add(rep.index)
+                overloads += 1
+                continue
+            except ServeRequestError:
+                # the request itself is malformed: no replica will take
+                # it — the caller's problem, not the replica's
+                with self._cv:
+                    rep.outstanding -= 1
+                    self._cv.notify_all()
+                raise
+            except ServeError as e:
+                # replica broken at submit time (closed underneath,
+                # wedged): health-count it and walk on
+                self._note_failure(rep)
+                with self._cv:
+                    rep.outstanding -= 1
+                    self._cv.notify_all()
+                tried.add(rep.index)
+                last_exc = e
+                continue
+            except BaseException:
+                with self._cv:
+                    rep.outstanding -= 1
+                    self._cv.notify_all()
+                raise
+            with self._cv:
+                rep.dispatched += 1
+            efut.add_done_callback(
+                lambda f, rep=rep: self._on_done(
+                    f, rep, rfut, data, deadline_ms, kwargs, tried,
+                    retries_left))
+            return
+
+    def _note_failure_locked(self, rep: _Replica) -> None:
+        """Health policy, ONE implementation (cv held): submit-time and
+        future-time failures must agree on when a replica goes down."""
+        rep.failures += 1
+        if (self.unhealthy_after and rep.state == LIVE
+                and rep.failures >= self.unhealthy_after):
+            rep.state = DOWN
+            self._downs += 1
+            _trace.instant("serve:router_down", cat="serve",
+                           replica=rep.index)
+
+    def _note_failure(self, rep: _Replica) -> None:
+        with self._cv:
+            self._note_failure_locked(rep)
+
+    def _retryable(self, exc: BaseException) -> bool:
+        """Engine-side failures worth another replica: a closed or
+        broken replica.  Client-side outcomes (deadline, malformed
+        request) and overload (handled at dispatch) are final."""
+        if isinstance(exc, (ServeDeadlineError, ServeRequestError,
+                            ServeOverloadError)):
+            return False
+        return isinstance(exc, (ServeClosedError, ServeError))
+
+    def _on_done(self, efut: Future, rep: _Replica, rfut: Future, data,
+                 deadline_ms, kwargs, tried, retries_left: int) -> None:
+        exc = efut.exception() if not efut.cancelled() else None
+        engine_fail = exc is not None and self._retryable(exc)
+        with self._cv:
+            rep.outstanding -= 1
+            if engine_fail:
+                self._note_failure_locked(rep)
+            elif exc is None and not efut.cancelled():
+                rep.failures = 0
+            self._cv.notify_all()       # drain waiters watch outstanding
+        if efut.cancelled():
+            rfut.cancel()
+            return
+        if exc is None:
+            _set_result(rfut, efut.result())
+            return
+        if engine_fail and retries_left > 0 and not self._closed:
+            with self._cv:
+                self._retried += 1
+            try:
+                # fresh exclusion set: only the replica that just failed
+                # is off-limits — an earlier transient overload on
+                # another replica must not shrink the retry's options
+                self._dispatch(rfut, data, deadline_ms, kwargs,
+                               {rep.index}, retries_left - 1)
+                return
+            except Exception as redispatch_exc:
+                exc = redispatch_exc
+        _set_exception(rfut, exc)
+
+    # -- draining restart --------------------------------------------------
+    def drain(self, index: int, timeout: Optional[float] = None) -> None:
+        """Take replica ``index`` out of rotation and wait until its
+        in-flight work resolves (new traffic rides the other
+        replicas).  On timeout the replica STAYS out of rotation
+        (state ``draining``) — a drain that cannot finish means the
+        replica is wedged, and handing it fresh traffic would hang
+        clients; retry the restart or rebuild it."""
+        rep = self._rep(index)
+        with self._cv:
+            if rep.state != DRAINING:   # idempotent: restart() after a
+                rep.state = DRAINING    # manual drain() just waits
+                self._drains += 1
+                _trace.instant("serve:router_drain", cat="serve",
+                               replica=index)
+        deadline = (time.perf_counter() + timeout) if timeout else None
+        with self._cv:
+            while rep.outstanding > 0 or rep.engine.pending_requests() > 0:
+                remaining = _IDLE_WAIT_S if deadline is None \
+                    else min(_IDLE_WAIT_S, deadline - time.perf_counter())
+                if remaining <= 0:
+                    raise ServeError(
+                        "replica %d did not drain within %.1fs "
+                        "(%d outstanding); it stays out of rotation — "
+                        "retry restart() or rebuild it"
+                        % (index, timeout, rep.outstanding))
+                self._cv.wait(remaining)
+
+    def restart(self, index: int, reload: Optional[Dict] = None,
+                factory: Optional[Callable] = None,
+                timeout: Optional[float] = None) -> None:
+        """Draining restart of one replica, zero dropped requests: drain
+        it (see :meth:`drain`), then either hot-swap weights into the
+        existing engine (``reload=`` params dict) or close it and
+        rebuild via ``factory`` (default: the constructor's, so a
+        checkpoint-dir factory redeploys the newest step), then return
+        it to rotation with a clean health record."""
+        rep = self._rep(index)
+        self.drain(index, timeout=timeout)
+        try:
+            with _trace.span("serve:router_restart", cat="serve",
+                             replica=index):
+                if reload is not None:
+                    rep.engine.reload(reload)
+                else:
+                    old = rep.engine
+                    build = factory if factory is not None else self._factory
+                    # build BEFORE closing the old engine: a failed
+                    # build must leave the old replica restorable
+                    fresh = build(index)
+                    rep.engine = fresh
+                    old.close(drain=True)
+        finally:
+            with self._cv:
+                rep.failures = 0
+                rep.restarts += 1
+                rep.state = LIVE
+                self._cv.notify_all()
+
+    def rolling_restart(self, reload: Optional[Dict] = None,
+                        factory: Optional[Callable] = None,
+                        timeout: Optional[float] = None) -> None:
+        """Restart every replica in turn — the zero-downtime deploy."""
+        for rep in list(self._replicas):
+            self.restart(rep.index, reload=reload, factory=factory,
+                         timeout=timeout)
+
+    # -- introspection -----------------------------------------------------
+    def _rep(self, index: int) -> _Replica:
+        if not 0 <= index < len(self._replicas):
+            raise ServeError(
+                "replica index %d out of range [0, %d)"
+                % (index, len(self._replicas)))
+        return self._replicas[index]
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+    def replica_states(self) -> List[str]:
+        with self._cv:
+            return [r.state for r in self._replicas]
+
+    def replica(self, index: int):
+        """The replica's engine (maintenance access; dispatch decisions
+        belong to the router)."""
+        return self._rep(index).engine
+
+    def _report(self) -> Dict:
+        with self._cv:
+            reps = list(self._replicas)
+            out = {
+                "kind": "router",
+                "replicas": len(reps),
+                "rejected": self._rejected,
+                "retried": self._retried,
+                "drains": self._drains,
+                "downs": self._downs,
+            }
+        per = {}
+        agg_submitted = agg_completed = agg_failed = 0
+        for r in reps:
+            row = {"state": r.state, "dispatched": r.dispatched,
+                   "outstanding": r.outstanding, "failures": r.failures,
+                   "restarts": r.restarts}
+            st = getattr(r.engine, "stats", None)
+            if st is not None:
+                erep = st.report()
+                row["engine"] = erep
+                agg_submitted += erep.get("submitted", 0)
+                agg_completed += erep.get("completed", 0)
+                agg_failed += erep.get("failed", 0)
+            per[r.index] = row
+        out["per_replica"] = per
+        out["submitted"] = agg_submitted
+        out["completed"] = agg_completed
+        out["failed"] = agg_failed
+        return out
+
+    def _report_str(self) -> str:
+        r = self._report()
+        lines = ["serve router %r" % self.name,
+                 "  replicas: %d, %d rejected, %d retried, %d drains, "
+                 "%d downs" % (r["replicas"], r["rejected"], r["retried"],
+                               r["drains"], r["downs"]),
+                 "  rollup: %d submitted / %d completed / %d failed"
+                 % (r["submitted"], r["completed"], r["failed"])]
+        for i, row in sorted(r["per_replica"].items()):
+            erep = row.get("engine") or {}
+            lines.append(
+                "  replica %d [%s]: %d dispatched, %d outstanding, "
+                "p99 %.2f ms, %d restarts"
+                % (i, row["state"], row["dispatched"], row["outstanding"],
+                   erep.get("latency_p99_ms", 0.0), row["restarts"]))
+        return "\n".join(lines)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        """Close every replica.  Idempotent; concurrent closers
+        serialize on the replicas' own close locks."""
+        with self._cv:
+            if self._closed:
+                reps = []
+            else:
+                self._closed = True
+                reps = list(self._replicas)
+            self._cv.notify_all()
+        for rep in reps:
+            rep.engine.close(drain=drain)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

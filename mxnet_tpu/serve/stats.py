@@ -1,8 +1,17 @@
-"""Serving instrumentation: one :class:`ServeStats` per engine.
+"""Serving instrumentation: one stats object per serving component.
 
 The report answers the capacity questions a serving operator actually
 asks, in one place (``mx.profiler.serve_report()``, next to the feed /
-checkpoint / superstep report family):
+checkpoint / superstep report family).  ``serve_report()`` is
+multiplex-aware: every registered component contributes its own row —
+one :class:`ServeStats` per batching engine, one :class:`DecodeStats`
+per continuous-batching decode engine, plus the multiplexer's and
+router's own counters (mux.py / router.py) — each row tagged with a
+``kind`` and carrying its OWN ``max_batch_size`` / ``num_slots``, so a
+process multiplexing N models never pretends there is one global batch
+size.
+
+Per :class:`ServeStats` row:
 
 * **latency** — p50/p95/p99 over a sliding window of completed
   requests (queue wait + inference + D2H, i.e. what the client saw);
@@ -15,6 +24,10 @@ checkpoint / superstep report family):
   traffic;
 * **queue depth** (live + high-water) and the reject/expiry/cancel/
   failure counters that tell overload apart from client impatience.
+
+Per :class:`DecodeStats` row: slot occupancy (mean fraction of decode
+slots active per step), steps/tokens emitted, admission counters, and
+the same latency window measured submit → stream resolve.
 """
 from __future__ import annotations
 
@@ -25,7 +38,7 @@ from typing import Dict, List, Optional
 
 from ..base import make_lock
 
-__all__ = ["ServeStats"]
+__all__ = ["ServeStats", "DecodeStats"]
 
 # sliding latency window: big enough for stable p99, small enough that a
 # report reflects the recent regime rather than the whole process life
@@ -109,12 +122,28 @@ class ServeStats:
         with self._lock:
             self._queue_depth = depth
 
+    def _outstanding_locked(self) -> int:
+        """Terminal-outcome balance — EVERY new terminal counter must be
+        subtracted here and only here (lock held by the caller)."""
+        return max(0, self._submitted - self._completed - self._failed
+                   - self._expired - self._cancelled)
+
+    def outstanding(self) -> int:
+        """Admitted requests not yet terminally resolved (queued or in
+        flight).  Overloaded submits never entered the queue, so they
+        are not part of the balance."""
+        with self._lock:
+            return self._outstanding_locked()
+
     # -- reading -----------------------------------------------------------
     def report(self) -> Dict:
         with self._lock:
             lat = sorted(self._lat_ms)
             dispatched = self._batch_items + self._pad_items
             out = {
+                "kind": "engine",
+                "max_batch_size": self.max_batch_size,
+                "outstanding": self._outstanding_locked(),
                 "submitted": self._submitted,
                 "completed": self._completed,
                 "overloaded": self._overloaded,
@@ -158,3 +187,138 @@ class ServeStats:
                     r["latency_p99_ms"], r["batches"], r["batch_occupancy"],
                     self.max_batch_size, 100.0 * r["pad_waste_frac"],
                     buckets, r["queue_depth"], r["queue_depth_max"]))
+
+
+class DecodeStats:
+    """Counters for one DecodeEngine (continuous batching): written from
+    the submitter threads and the decode-loop thread under a lock,
+    snapshotted atomically by ``report()``.
+
+    The capacity question here is **slot occupancy**: the mean fraction
+    of decode slots holding an active stream per step.  Low occupancy
+    at high load means requests are not arriving fast enough to refill
+    freed slots (or the queue bound is too tight); tokens/step is
+    occupancy x num_slots."""
+
+    def __init__(self, name: str, num_slots: int):
+        self.name = name
+        self.num_slots = int(num_slots)
+        self._lock = make_lock("serve.stats")
+        self._submitted = 0
+        self._admitted = 0
+        self._completed = 0
+        self._failed = 0
+        self._expired = 0
+        self._cancelled = 0
+        self._overloaded = 0
+        self._reloads = 0
+        self._steps = 0
+        self._slot_steps = 0
+        self._tokens_out = 0
+        self._queue_depth = 0
+        self._queue_depth_max = 0
+        self._lat_ms = collections.deque(maxlen=LATENCY_WINDOW)
+
+    # -- recording ---------------------------------------------------------
+    def on_submit(self, queue_depth: int) -> None:
+        with self._lock:
+            self._submitted += 1
+            self._queue_depth = queue_depth
+            if queue_depth > self._queue_depth_max:
+                self._queue_depth_max = queue_depth
+
+    def on_overload(self) -> None:
+        with self._lock:
+            self._overloaded += 1
+
+    def on_admitted(self, n: int = 1) -> None:
+        with self._lock:
+            self._admitted += n
+
+    def on_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self._expired += n
+
+    def on_cancelled(self, n: int = 1) -> None:
+        with self._lock:
+            self._cancelled += n
+
+    def on_failed(self, n: int = 1) -> None:
+        with self._lock:
+            self._failed += n
+
+    def on_step(self, active: int, emitted: int) -> None:
+        with self._lock:
+            self._steps += 1
+            self._slot_steps += active
+            self._tokens_out += emitted
+
+    def on_complete(self, latencies_ms) -> None:
+        with self._lock:
+            self._completed += len(latencies_ms)
+            self._lat_ms.extend(latencies_ms)
+
+    def on_reload(self) -> None:
+        with self._lock:
+            self._reloads += 1
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._queue_depth = depth
+
+    def _outstanding_locked(self) -> int:
+        """Terminal-outcome balance — EVERY new terminal counter must be
+        subtracted here and only here (lock held by the caller)."""
+        return max(0, self._submitted - self._completed - self._failed
+                   - self._expired - self._cancelled)
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding_locked()
+
+    # -- reading -----------------------------------------------------------
+    def report(self) -> Dict:
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            out = {
+                "kind": "decode",
+                "num_slots": self.num_slots,
+                "outstanding": self._outstanding_locked(),
+                "submitted": self._submitted,
+                "admitted": self._admitted,
+                "completed": self._completed,
+                "overloaded": self._overloaded,
+                "expired": self._expired,
+                "cancelled": self._cancelled,
+                "failed": self._failed,
+                "reloads": self._reloads,
+                "steps": self._steps,
+                "tokens_out": self._tokens_out,
+                "slot_occupancy": round(
+                    self._slot_steps / (self._steps * self.num_slots), 4)
+                if self._steps else 0.0,
+                "queue_depth": self._queue_depth,
+                "queue_depth_max": self._queue_depth_max,
+            }
+        out["latency_p50_ms"] = round(_percentile(lat, 50), 3)
+        out["latency_p95_ms"] = round(_percentile(lat, 95), 3)
+        out["latency_p99_ms"] = round(_percentile(lat, 99), 3)
+        return out
+
+    def report_str(self) -> str:
+        r = self.report()
+        return ("decode engine %r\n"
+                "  streams: %d submitted / %d admitted / %d completed "
+                "(%d overloaded, %d expired, %d cancelled, %d failed), "
+                "%d reloads\n"
+                "  latency ms: p50 %.2f  p95 %.2f  p99 %.2f\n"
+                "  steps: %d, %d tokens out, slot occupancy %.2f of %d "
+                "slots\n"
+                "  queue depth: %d now / %d high-water" % (
+                    self.name, r["submitted"], r["admitted"],
+                    r["completed"], r["overloaded"], r["expired"],
+                    r["cancelled"], r["failed"], r["reloads"],
+                    r["latency_p50_ms"], r["latency_p95_ms"],
+                    r["latency_p99_ms"], r["steps"], r["tokens_out"],
+                    r["slot_occupancy"], self.num_slots,
+                    r["queue_depth"], r["queue_depth_max"]))

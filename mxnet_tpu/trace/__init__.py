@@ -45,13 +45,13 @@ from .journal import (journal_every, journal_path, maybe_journal_step,
                       reset_journal, write_journal_line)
 from .recorder import DEFAULT_BUF_EVENTS, Recorder
 
-__all__ = ["span", "complete", "instant", "async_begin", "async_instant",
-           "async_end", "next_async_id", "enabled", "set_enabled",
-           "dump_trace", "add_spill_dir", "spill_dirs", "configure_spill",
-           "flush_spill", "label_process", "event_count", "drop_count",
-           "span_events", "trace_report", "reset", "maybe_journal_step",
-           "write_journal_line", "journal_path", "journal_every",
-           "reset_journal"]
+__all__ = ["span", "complete", "instant", "counter", "async_begin",
+           "async_instant", "async_end", "next_async_id", "enabled",
+           "set_enabled", "dump_trace", "add_spill_dir", "spill_dirs",
+           "configure_spill", "flush_spill", "label_process",
+           "event_count", "drop_count", "span_events", "trace_report",
+           "reset", "maybe_journal_step", "write_journal_line",
+           "journal_path", "journal_every", "reset_journal"]
 
 
 def _env_enabled() -> bool:
@@ -163,6 +163,18 @@ def instant(name: str, cat: str = "host", **attrs) -> None:
         return
     _recorder.add("i", name, cat, time.perf_counter_ns(), 0, None,
                   attrs or None)
+
+
+def counter(name: str, cat: str = "host", **values) -> None:
+    """Record a Chrome counter sample (``ph: "C"``): each kwarg is one
+    series, rendered by Perfetto as a stacked counter track.  The decode
+    engine samples its slot occupancy here every step
+    (``serve:decode_slots``), so the timeline shows batch fill as a
+    graph alongside the step spans instead of one number in a report."""
+    if not _enabled:
+        return
+    _recorder.add("C", name, cat, time.perf_counter_ns(), 0, None,
+                  values or None)
 
 
 def next_async_id() -> str:

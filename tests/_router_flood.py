@@ -1,0 +1,113 @@
+"""Subprocess body for test_router.py::
+test_draining_restart_under_flood_subprocess.
+
+Closed-loop flood (6 client threads) against a 3-replica ServeRouter
+while replica 1 does a full draining restart mid-flood.  Prints ONE
+JSON line: expected/completed/dropped/errors/restarts/parity_failures.
+Exit 0 only if the flood itself ran; the parent asserts the counters.
+"""
+import json
+import os
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.serve import ServeEngine, ServeRouter
+
+IN_DIM, HID, CLASSES = 6, 8, 3
+SHAPES = {"data": (1, IN_DIM), "softmax_label": (1,)}
+THREADS, REQS = 4, 20
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    n = mx.sym.FullyConnected(data, num_hidden=HID, name="fc1")
+    n = mx.sym.Activation(n, act_type="relu")
+    n = mx.sym.FullyConnected(n, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(n, name="softmax")
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {"fc1_weight": rng.randn(HID, IN_DIM).astype(np.float32),
+            "fc1_bias": np.zeros(HID, np.float32),
+            "fc2_weight": rng.randn(CLASSES, HID).astype(np.float32),
+            "fc2_bias": np.zeros(CLASSES, np.float32)}
+
+
+def factory(i):
+    return ServeEngine(_net(), _params(), SHAPES, batch_buckets=(1, 2, 4),
+                       max_delay_ms=2.0, deadline_ms=60000.0,
+                       name="flood-rep%d" % i)
+
+
+def main():
+    X = np.random.RandomState(7).randn(THREADS * REQS,
+                                       IN_DIM).astype(np.float32)
+    router = ServeRouter(factory, replicas=3, name="flood-router")
+    ref = router.predict(X[0], timeout=60)
+    results = [None] * len(X)
+    errors = []
+    started = threading.Event()
+
+    def client(t):
+        try:
+            for j in range(REQS):
+                i = t * REQS + j
+                results[i] = router.predict(X[i], timeout=120)
+                if j == 2:
+                    started.set()       # flood demonstrably in flight
+        except Exception as e:
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(THREADS)]
+    for t in threads:
+        t.start()
+    started.wait(60)
+    router.restart(1, timeout=120)      # draining full rebuild mid-flood
+    for t in threads:
+        t.join()
+    rep = router.stats.report()
+    # parity: every row must match the (single-model) reference —
+    # a dropped/garbled request would either error or mismatch
+    parity_failures = sum(
+        1 for i, y in enumerate(results)
+        if y is None or not np.allclose(
+            y, mxref(ref, X, i), atol=1e-4))
+    doc = {
+        "expected": len(X),
+        "completed": sum(1 for y in results if y is not None),
+        "dropped": sum(1 for y in results if y is None),
+        "errors": len(errors),
+        "error_samples": errors[:3],
+        "restarts": sum(r["restarts"]
+                        for r in rep["per_replica"].values()),
+        "parity_failures": parity_failures,
+        "rejected": rep["rejected"],
+        "retried": rep["retried"],
+    }
+    router.close()
+    print(json.dumps(doc), flush=True)
+
+
+def mxref(ref0, X, i):
+    """All replicas serve identical weights; compute the expected row
+    once per call via a shared batch-1 predictor."""
+    global _PRED
+    try:
+        _PRED
+    except NameError:
+        from mxnet_tpu.predictor import Predictor
+        _PRED = Predictor(_net().tojson(), _params(),
+                          {"data": (1, IN_DIM), "softmax_label": (1,)})
+    return _PRED.predict(X[i:i + 1])[0]
+
+
+if __name__ == "__main__":
+    main()

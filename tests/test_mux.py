@@ -1,0 +1,214 @@
+"""mxnet_tpu.serve.ModelMultiplexer: N models on one chip (tier-1, CPU).
+
+Covers lazy swap-in, LRU eviction of idle models under both budgets
+(count and bytes), busy-model eviction protection, rebuild-after-
+eviction parity (the compile cache makes it warm; answers must be
+identical), the mixed-model closed-loop flood with ZERO steady-loop XLA
+compiles (ISSUE 13 acceptance), and the mux row in serve_report.
+"""
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+
+import mxnet_tpu as mx
+from mxnet_tpu.serve import (ModelMultiplexer, ServeClosedError,
+                             ServeEngine, ServeError, ServeOverloadError)
+
+IN_DIM, CLASSES = 6, 3
+HIDDENS = {"a": 8, "b": 16, "c": 24}
+
+
+def _net(hidden):
+    data = mx.sym.Variable("data")
+    n = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    n = mx.sym.Activation(n, act_type="relu")
+    n = mx.sym.FullyConnected(n, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(n, name="softmax")
+
+
+def _params(hidden, seed):
+    rng = np.random.RandomState(seed)
+    return {"fc1_weight": rng.randn(hidden, IN_DIM).astype(np.float32),
+            "fc1_bias": np.zeros(hidden, np.float32),
+            "fc2_weight": rng.randn(CLASSES, hidden).astype(np.float32),
+            "fc2_bias": np.zeros(CLASSES, np.float32)}
+
+
+SHAPES = {"data": (1, IN_DIM), "softmax_label": (1,)}
+
+
+def _factory(model, name=None):
+    h = HIDDENS[model]
+    seed = ord(model)
+    return lambda: ServeEngine(
+        _net(h), _params(h, seed), SHAPES, batch_buckets=(1, 2, 4),
+        max_delay_ms=2.0, name=name or ("model-%s" % model))
+
+
+def _mux(**kw):
+    kw.setdefault("name", "test-mux")
+    mux = ModelMultiplexer(**kw)
+    for m in HIDDENS:
+        mux.add_model(m, _factory(m))
+    return mux
+
+
+@pytest.fixture(scope="module")
+def X():
+    return np.random.RandomState(7).randn(24, IN_DIM).astype(np.float32)
+
+
+def test_lazy_swap_in_and_lru_eviction_max_live(X):
+    mux = _mux(max_live=2)
+    try:
+        assert mux.live_models() == []          # nothing built yet
+        ya = mux.predict("a", X[0], timeout=30)
+        yb = mux.predict("b", X[0], timeout=30)
+        assert mux.live_models() == ["a", "b"]
+        # admitting "c" evicts the LRU idle model ("a")
+        mux.predict("c", X[0], timeout=30)
+        assert sorted(mux.live_models()) == ["b", "c"]
+        rep = mux.stats.report()
+        assert rep["kind"] == "mux"
+        assert rep["swap_ins"] == 3 and rep["evictions"] == 1
+        assert rep["live"] == 2 and rep["models"] == 3
+        assert rep["bytes_live"] > 0
+        # "a" comes back via a (compile-cache-warm) rebuild with
+        # identical answers — eviction must not change results
+        ya2 = mux.predict("a", X[0], timeout=30)
+        assert np.allclose(ya, ya2, atol=0)
+        assert mux.stats.report()["swap_ins"] == 4
+        del yb
+    finally:
+        mux.close()
+
+
+def test_bytes_budget_eviction(X):
+    # measure the real footprints, then budget for exactly a+b: the
+    # third model cannot fit without evicting
+    bytes_of = {}
+    for m in ("a", "b"):
+        probe = _factory(m)()
+        bytes_of[m] = probe.device_bytes()
+        probe.close()
+    assert all(b > 0 for b in bytes_of.values())
+    budget = bytes_of["a"] + bytes_of["b"]
+    mux = _mux(budget_bytes=budget)
+    try:
+        mux.predict("a", X[0], timeout=30)
+        mux.predict("b", X[0], timeout=30)
+        assert len(mux.live_models()) == 2
+        assert mux.stats.report()["bytes_live"] == budget
+        mux.predict("c", X[0], timeout=30)      # must evict to fit
+        rep = mux.stats.report()
+        assert rep["evictions"] >= 1
+        assert "c" in mux.live_models()
+        assert len(mux.live_models()) < 3
+    finally:
+        mux.close()
+
+
+def test_busy_model_not_evicted(X):
+    """A model with requests in flight must never be evicted: with
+    max_live=1 and the live model busy, admitting another model is an
+    overload reject, not a drop of in-flight work."""
+    mux = _mux(max_live=1)
+    try:
+        eng_a = mux.ensure_live("a")
+        with eng_a.pause():             # hold a's dispatcher mid-batch
+            fut = mux.submit("a", X[0])     # a is now busy via the mux
+            with pytest.raises(ServeOverloadError, match="busy"):
+                mux.predict("b", X[1], timeout=30)
+            assert mux.stats.report()["rejected"] == 1
+        assert np.allclose(fut.result(timeout=30),
+                           eng_a.predict(X[0], timeout=30), atol=1e-6)
+        # idle now: b admits by evicting a
+        mux.predict("b", X[1], timeout=30)
+        assert mux.live_models() == ["b"]
+    finally:
+        mux.close()
+
+
+def test_unknown_model_closed_and_double_register(X):
+    mux = _mux()
+    try:
+        with pytest.raises(ServeError, match="unknown model"):
+            mux.submit("nope", X[0])
+        with pytest.raises(ServeError, match="already registered"):
+            mux.add_model("a", _factory("a"))
+        with pytest.raises(ServeError, match="callable"):
+            mux.add_model("d", None)
+    finally:
+        mux.close()
+    with pytest.raises(ServeClosedError):
+        mux.submit("a", X[0])
+    mux.close()                         # idempotent
+
+
+def test_mixed_model_flood_zero_compiles(X):
+    """ISSUE 13 acceptance: a closed-loop flood over 3 multiplexed
+    models — every request parity-checked against its model's own
+    serial answer, zero requests dropped, and zero XLA compiles in the
+    steady loop (all three bucket grids warmed at swap-in)."""
+    from compile_guard import assert_no_compiles
+    mux = _mux()    # no budget: all three stay live (no churn to trace)
+    try:
+        mux.prewarm()
+        models = sorted(HIDDENS)
+        refs = {m: mux.predict(m, X[0], timeout=30) for m in models}
+        results = {}
+        errors = []
+
+        def client(t):
+            try:
+                for j in range(9):
+                    m = models[(t + j) % 3]
+                    results[(t, j)] = (m, mux.predict(m, X[0], timeout=60))
+            except Exception as e:      # pragma: no cover - fail loud below
+                errors.append(e)
+
+        with assert_no_compiles("mixed-model flood"):
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+        assert len(results) == 4 * 9            # zero dropped
+        for m, y in results.values():
+            assert np.allclose(y, refs[m], atol=1e-5), m
+        rep = mx.profiler.serve_report()
+        # per-model rows: each engine reports under its own name with
+        # its own max_batch_size (the multiplex-aware report satellite)
+        for m in models:
+            rows = [v for k, v in rep.items()
+                    if k.startswith("model-%s#" % m)]
+            assert rows and rows[-1]["kind"] == "engine"
+            assert rows[-1]["max_batch_size"] == 4
+            assert rows[-1]["completed"] >= 9
+        mux_rows = [v for k, v in rep.items()
+                    if k.startswith("test-mux#")]
+        assert mux_rows and mux_rows[-1]["kind"] == "mux"
+        assert mux_rows[-1]["submits"] and mux_rows[-1]["live"] == 3
+    finally:
+        mux.close()
+
+
+def test_explicit_evict_and_prewarm(X):
+    mux = _mux()
+    try:
+        mux.prewarm(["a", "b"])
+        assert mux.live_models() == ["a", "b"]
+        assert mux.evict("a") is True
+        assert mux.evict("a") is False          # not live anymore
+        assert mux.live_models() == ["b"]
+        with pytest.raises(ServeError, match="unknown"):
+            mux.evict("nope")
+    finally:
+        mux.close()

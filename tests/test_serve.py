@@ -506,6 +506,56 @@ def test_serve_report_counters(model):
         "dead engine should drop out of the weak registry"
 
 
+def test_queue_depth_gauge_resets_on_drain(model):
+    """ISSUE 13 satellite regression: the queue-depth gauge must track
+    every queue transition — after a drain (close with or without
+    drain) the report reads 0, not the depth of the last submit frozen
+    forever."""
+    prefix, X, _ = model
+    # drain=True path: dispatcher empties the queue, gauge ends at 0
+    eng = _engine(prefix, max_delay_ms=200.0)
+    futs = eng.submit_many([X[i] for i in range(6)])
+    assert eng.stats.report()["queue_depth_max"] >= 1
+    eng.close()
+    for f in futs:
+        f.result(timeout=30)
+    assert eng.stats.report()["queue_depth"] == 0
+
+    # drain=False path: the queue is CLEARED without a dispatch — the
+    # gauge must still drop to 0 (this was the stale-forever case)
+    eng2 = _engine(prefix, batch_buckets=(1, 2), max_delay_ms=500.0,
+                   queue_depth=64)
+    closer = threading.Thread(target=lambda: eng2.close(drain=False))
+    with eng2.pause():
+        eng2.submit_many([X[i] for i in range(6)])
+        time.sleep(0.1)
+        assert eng2.stats.report()["queue_depth"] >= 1
+        closer.start()
+        time.sleep(0.1)
+    closer.join(timeout=30)
+    assert not closer.is_alive()
+    assert eng2.stats.report()["queue_depth"] == 0
+
+
+def test_report_row_is_multiplex_aware(model):
+    """Each engine's report row carries its own kind/max_batch_size and
+    an outstanding balance (serve_report is per-model, never one global
+    batch size per process)."""
+    prefix, X, _ = model
+    eng = _engine(prefix, batch_buckets=(1, 2, 4, 8))
+    try:
+        for f in eng.submit_many([X[i] for i in range(4)]):
+            f.result(timeout=30)
+        r = eng.stats.report()
+        assert r["kind"] == "engine"
+        assert r["max_batch_size"] == 8
+        assert r["outstanding"] == 0
+        assert eng.outstanding() == 0
+        assert eng.device_bytes() > 0
+    finally:
+        eng.close()
+
+
 def test_default_buckets_and_env_knobs(model, monkeypatch):
     assert default_buckets(8) == (1, 2, 4, 8)
     assert default_buckets(6) == (1, 2, 4, 6)

@@ -144,6 +144,24 @@ def test_span_decorator_and_disable():
     assert trace.event_count() == 2
 
 
+def test_counter_events(tmp_path):
+    """trace.counter emits Chrome ph:"C" samples (the decode engine's
+    slot-occupancy track): each kwarg is one series carried in args,
+    disabled tracing records nothing."""
+    for n in (1, 3, 2):
+        trace.counter("serve:decode_slots", cat="serve", active=n)
+    trace.set_enabled(False)
+    trace.counter("serve:decode_slots", cat="serve", active=9)
+    trace.set_enabled(True)
+    path = trace.dump_trace(str(tmp_path / "c.json"))
+    evs = [e for e in _events(path)
+           if e["name"] == "serve:decode_slots"]
+    assert [e["ph"] for e in evs] == ["C"] * 3
+    assert [e["args"]["active"] for e in evs] == [1, 3, 2]
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+
 def test_nonserializable_attrs_survive_dump(tmp_path):
     with trace.span("np-attrs", val=np.float32(0.5), arr=np.arange(2)):
         pass

@@ -64,6 +64,8 @@ DEFAULT_IGNORE = {
 DEFAULT_LOWER_IS_BETTER = {
     "serve_p50_ms", "serve_p99_ms", "serve_pad_waste_frac",
     "serve_quant_top1_delta",
+    "serve_decode_p99_ms", "serve_mux_p99_ms",
+    "serve_mux_steady_compiles", "serve_router_restart_drops",
     "fused_step_ms", "unfused_step_ms",
     "embed_sparse_update_ms", "embed_naive_update_ms",
     "embed_sparse_step_ms", "embed_dense_step_ms",
