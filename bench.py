@@ -503,6 +503,19 @@ def main():
         line.update(multichip_run(feed=_feed_watchdog))
     except Exception as e:
         sys.stderr.write("bench: multichip leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
+    # robustness leg (mxnet_tpu.faults, ISSUE 15): supervised crash-and-
+    # resume recovery seconds (train_recovery_s), a router flood under
+    # injected dispatch faults (serve_failover_dropped gated at 0), and
+    # the fault plane's cost on the fused loop with the plan armed at
+    # rate=0 (chaos_overhead_frac gated ~0 — disabled points are one
+    # `is None` check, faults_point_ns shows the microcost)
+    try:
+        from bench_faults import run as faults_run
+        _feed_watchdog("faults")
+        line.update(faults_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: faults leg failed (%s)\n" % e)
     _wd.stop()
     print(json.dumps(line), flush=True)
 

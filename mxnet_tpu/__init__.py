@@ -64,6 +64,7 @@ from . import predictor
 from . import serve
 from . import trace
 from . import profiler
+from . import faults
 from . import libinfo
 from . import misc
 from . import symbol_doc

@@ -22,6 +22,11 @@ unseeded-fork-rng  global ``np.random.*`` draws — decorrelation hazard
 raw-future-settle  ``set_result``/``set_exception`` outside the
                    InvalidStateError-tolerant helpers (PR 4's
                    engine-wedging class)
+raw-retry          a loop that both sleeps and swallows exceptions —
+                   a bare retry loop outside ``mxnet_tpu.faults``
+                   (PR 15: unbudgeted instant reforks let a
+                   crash-looping decode bug hot-spin the reader fork
+                   path; retries ride faults.Backoff/retry_call)
 
 Suppressions
 ------------
@@ -369,6 +374,44 @@ def _rule_raw_future_settle(ctx: _Ctx) -> Iterable[Finding]:
             % node.func.attr)
 
 
+def _rule_raw_retry(ctx: _Ctx) -> Iterable[Finding]:
+    """A loop whose body both sleeps AND swallows an exception is a
+    hand-rolled retry loop: unbounded, unjittered, invisible to the
+    fault plane's counters (the PR 15 reader-refork hot-loop class).
+    Retries belong to faults.Backoff / faults.retry_call — bounded,
+    jittered, deterministic, traced.  Poll loops (sleep, no swallowed
+    exception) and fail-fast loops (except that raises/breaks/returns)
+    are not flagged; faults/ itself implements the primitive."""
+    if ctx.rel.startswith("mxnet_tpu/faults/"):
+        return
+    flagged: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.While, ast.For)):
+            continue
+        sleeps = [n for n in ast.walk(node)
+                  if isinstance(n, ast.Call)
+                  and _dotted(n.func) == "time.sleep"]
+        if not sleeps:
+            continue
+        swallowing = [
+            h for h in ast.walk(node)
+            if isinstance(h, ast.ExceptHandler)
+            and not any(isinstance(x, (ast.Raise, ast.Break, ast.Return))
+                        for x in ast.walk(h))]
+        if not swallowing:
+            continue
+        for s in sleeps:
+            if id(s) in flagged:    # inner loop already reported it
+                continue
+            flagged.add(id(s))
+            yield ctx.finding(
+                "raw-retry", s,
+                "sleep inside a loop that swallows exceptions — a bare "
+                "retry loop: unbounded and unjittered; use "
+                "faults.retry_call / faults.Backoff (bounded budget, "
+                "deterministic jitter, traced waits)")
+
+
 _JNP_FRESH = {"zeros", "ones", "full", "zeros_like", "ones_like",
               "full_like", "arange", "eye", "copy", "empty"}
 
@@ -416,6 +459,7 @@ RULES = {
     "raw-time": _rule_raw_time,
     "unseeded-fork-rng": _rule_unseeded_fork_rng,
     "raw-future-settle": _rule_raw_future_settle,
+    "raw-retry": _rule_raw_retry,
 }
 
 

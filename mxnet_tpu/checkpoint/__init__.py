@@ -38,7 +38,7 @@ or standalone over any pytree of arrays::
 """
 from __future__ import annotations
 
-from .layout import (all_steps, latest_step, set_fault_hook, step_dir_name,
+from .layout import (all_steps, latest_step, step_dir_name,
                      COMMIT_MARKER, INDEX_FILE, META_FILE)
 from .manager import CheckpointManager, CheckpointStats
 from .module_state import (capture_train_state, restore_train_state,
@@ -46,6 +46,6 @@ from .module_state import (capture_train_state, restore_train_state,
 from .snapshot import snapshot_tree
 
 __all__ = ["CheckpointManager", "CheckpointStats", "latest_step",
-           "all_steps", "step_dir_name", "set_fault_hook", "snapshot_tree",
+           "all_steps", "step_dir_name", "snapshot_tree",
            "capture_train_state", "restore_train_state", "save_module",
            "restore_module", "COMMIT_MARKER", "INDEX_FILE", "META_FILE"]

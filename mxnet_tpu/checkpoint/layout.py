@@ -26,10 +26,13 @@ a reader can never observe a torn checkpoint.  Retention
 removing their ``COMMIT`` marker (uncommitting them) and then the tree —
 a crash mid-delete leaves an uncommitted directory, which is skipped.
 
-``set_fault_hook`` installs a test-only hook invoked at the protocol's
-named points (``"shards_written"``, ``"before_rename"``,
-``"after_rename"``, ``"after_commit"``) so the crash-and-resume test can
-kill the writer at any stage and prove discovery skips the wreckage.
+The protocol's named stages (``"shards_written"``, ``"before_rename"``,
+``"after_rename"``, ``"after_commit"``) are ``checkpoint.commit`` fault
+points in the :mod:`mxnet_tpu.faults` plane — one seeded schedule
+(``MXNET_FAULTS``) or a targeted programmatic rule can kill/tear the
+writer at any stage and prove discovery skips the wreckage; the same
+plane drives the chaos suite and the supervisor bench, so the test-only
+hook this module used to carry is gone.
 """
 from __future__ import annotations
 
@@ -37,13 +40,14 @@ import json
 import os
 import re
 import shutil
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from ..base import MXNetError, fsync_dir
+from ..faults import point as _fault_point
 
 __all__ = ["step_dir_name", "parse_step", "is_committed", "latest_step",
            "all_steps", "begin_step", "commit_step", "abort_step",
-           "apply_retention", "clean_stale_tmp", "set_fault_hook",
+           "apply_retention", "clean_stale_tmp",
            "COMMIT_MARKER", "INDEX_FILE", "META_FILE"]
 
 COMMIT_MARKER = "COMMIT"
@@ -52,19 +56,9 @@ META_FILE = "meta.json"
 
 _STEP_RE = re.compile(r"^step-(\d{8,})$")
 
-# test-only fault injection: fn(point: str, step: int, path: str)
-_fault_hook: Optional[Callable] = None
 
-
-def set_fault_hook(fn: Optional[Callable]) -> None:
-    """Install (or clear, with None) the commit-protocol fault hook."""
-    global _fault_hook
-    _fault_hook = fn
-
-
-def _fault(point: str, step: int, path: str) -> None:
-    if _fault_hook is not None:
-        _fault_hook(point, step, path)
+def _fault(stage: str, step: int, path: str) -> None:
+    _fault_point("checkpoint.commit", stage=stage, step=step, path=path)
 
 
 def step_dir_name(step: int) -> str:

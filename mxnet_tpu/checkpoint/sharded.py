@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..base import MXNetError
+from ..faults import point as _fault_point
 
 __all__ = ["flatten_state", "unflatten_state", "write_leaf", "read_leaf",
            "merge_indexes"]
@@ -106,6 +107,10 @@ def _np_write(path: str, arr: np.ndarray) -> int:
         np.save(f, np.ascontiguousarray(arr))
         f.flush()
         os.fsync(f.fileno())
+    # the shard-file storage seam: a `torn` fault here truncates the
+    # file just written (the save aborts, the tmp dir never commits),
+    # a `crash` leaves the torn bytes for discovery to skip
+    _fault_point("storage.write", path=path)
     return os.path.getsize(path)
 
 

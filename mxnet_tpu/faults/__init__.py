@@ -1,0 +1,38 @@
+"""mxnet_tpu.faults: deterministic fault injection + elastic recovery.
+
+The robustness plane (ISSUE 15).  Three layers, smallest first:
+
+* **retry** (retry.py) — :class:`Backoff` (jittered exponential,
+  deterministic seeded jitter, interruptible sleep),
+  :class:`RestartWindow` (sliding-window restart budgets) and
+  :func:`retry_call`: THE retry primitive for the repo.  Bare
+  sleep-in-a-loop retries are a lint error (``raw-retry``).
+* **plane** (plane.py) — named fault points at the recovery seams
+  (``checkpoint.commit``, ``storage.write``, ``feed.worker_decode``,
+  ``serve.dispatch``, ``decode.step``, ``kvstore.push``) driven by a
+  seeded schedule (``MXNET_FAULTS="seed=7,rate=0.02,kinds=crash|torn|
+  delay|error"``): any chaos run is exactly reproducible, every
+  injected fault is a ``fault:`` instant in the PR 8 timeline, a
+  disabled plane costs one ``is None`` check per point.
+* **supervisor** (supervisor.py) — run training under a watchdog:
+  crash/preemption/hang -> bounded, backed-off restart from the latest
+  committed checkpoint, with the feed cursor making the recovered
+  stream bitwise identical to a fault-free run.
+
+``mx.profiler.faults_report()`` aggregates plane + supervisor counters.
+See docs/robustness.md for the fault-point catalog and workflows.
+"""
+from __future__ import annotations
+
+from .plane import (KINDS, FaultPlan, FaultStats, InjectedFault, Rule,
+                    active, attempt, clear, enabled, install, parse_spec,
+                    point, refresh_attempt, reload_from_env, stats)
+from .retry import Backoff, RestartWindow, retry_call
+from .supervisor import Supervisor, SupervisorStats
+
+__all__ = ["point", "install", "clear", "active", "enabled", "attempt",
+           "parse_spec", "reload_from_env", "refresh_attempt", "stats",
+           "KINDS",
+           "FaultPlan", "FaultStats", "InjectedFault", "Rule",
+           "Backoff", "RestartWindow", "retry_call",
+           "Supervisor", "SupervisorStats"]

@@ -51,7 +51,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import trace as _trace
-from ..base import MXNetError
+from ..base import MXNetError, get_env
+from ..faults import point as _fault_point
+from ..faults.retry import Backoff, RestartWindow
 from .pipeline import EndOfEpoch, EndOfStream, QueueClosed, Stage
 
 __all__ = ["ParallelReader"]
@@ -310,6 +312,12 @@ def _reader_worker(ring: _Ring, counters, stop, source, decode,
                 t0 = time.perf_counter()
                 data, lab = decode((label, payload))
                 dt = time.perf_counter() - t0
+                # fires BEFORE the ring publish: a `crash` here loses
+                # only this unpublished sample, and the refork re-enters
+                # at exactly (epoch, seq) — the chaos suite proves the
+                # delivered stream stays identical
+                _fault_point("feed.worker_decode", shard=shard,
+                             epoch=epoch, seq=seq)
                 counters[1] += dt
                 if span_name is not None:
                     _trace.complete(span_name, t0, dt, cat="feed")
@@ -454,9 +462,24 @@ class ParallelReader(Stage):
         self._seed = int(seed)
         self._max_epochs = max_epochs
         if max_restarts is None:
-            from ..base import get_env
             max_restarts = get_env("MXNET_FEED_MAX_RESTARTS", 3, int)
         self._max_restarts = max_restarts
+        # refork discipline (ISSUE 15): restarts are budgeted over a
+        # SLIDING window (a worker that dies once an hour for a week is
+        # healthy; one that dies max_restarts times inside the window is
+        # a crash loop) and each refork waits out a seeded jittered
+        # Backoff — a crash-looping decode bug can never hot-loop the
+        # fork spinner, and the parent stays responsive throughout
+        # (the backoff sleep polls the stop flag)
+        window_s = get_env("MXNET_FEED_RESTART_WINDOW_S", 60.0, float)
+        base_s = get_env("MXNET_FEED_RESTART_BACKOFF_S", 0.05, float)
+        self._restart_windows = [RestartWindow(max_restarts, window_s)
+                                 for _ in range(self._nworkers)]
+        self._backoffs = [Backoff(base_s=base_s, factor=2.0, max_s=2.0,
+                                  jitter=0.25, seed=[seed, w],
+                                  name="feed.refork")
+                          for w in range(self._nworkers)]
+        self._just_restarted = [False] * self._nworkers
         self._ctx = mp.get_context("fork")
         self._rings = [_Ring(slots_per_worker, self._sample_shape,
                              self._sample_dtype, self._label_width,
@@ -659,16 +682,30 @@ class ParallelReader(Stage):
 
     def _restart(self, w: int, epoch: int, offset: int) -> None:
         self.restarts[w] += 1
-        if self.restarts[w] > self._max_restarts:
+        in_window = self._restart_windows[w].note()
+        if in_window > self._max_restarts:
             raise MXNetError(
-                "reader worker %d of %r died %d times (limit %d, "
-                "MXNET_FEED_MAX_RESTARTS); giving up"
-                % (w, self.name, self.restarts[w], self._max_restarts))
+                "reader worker %d of %r died %d times within %.0fs "
+                "(limit %d, MXNET_FEED_MAX_RESTARTS over "
+                "MXNET_FEED_RESTART_WINDOW_S) — a crash loop, not a "
+                "flake; giving up"
+                % (w, self.name, in_window,
+                   self._restart_windows[w].window_s, self._max_restarts))
+        wait = self._backoffs[w].next_wait()
+        _trace.instant("feed:refork", cat="feed", worker=w,
+                       restart=in_window, wait_s=round(wait, 4))
+        # interruptible: close() flips _stopping and this returns in
+        # ~one poll tick, so a backing-off parent never blocks shutdown
+        self._backoffs[w].sleep(wait,
+                                should_stop=lambda: self._stopping)
+        if self._stopping:
+            raise QueueClosed()
         proc = self._procs[w]
         if proc is not None:
             proc.join(timeout=1.0)
         self._rings[w].reset(ctx=self._ctx)
         self._spawn(w, epoch, offset)
+        self._just_restarted[w] = True
 
     def _worker_stats(self) -> Dict[str, dict]:
         wall = max(time.perf_counter() - self._t0, 1e-9)
@@ -699,6 +736,11 @@ class ParallelReader(Stage):
                 return buf.popleft()
             got = ring.try_get()
             if got is not None:
+                if self._just_restarted[w]:
+                    # the refork took: this worker's backoff rung resets
+                    # (the sliding window still remembers the crash)
+                    self._just_restarted[w] = False
+                    self._backoffs[w].reset()
                 return got
             if self._stopping:
                 raise QueueClosed()

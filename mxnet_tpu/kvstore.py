@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from .base import MXNetError, get_env
 from .context import Context, cpu, current_context
+from .faults import point as _fault_point
 from .ndarray import NDArray, zeros as nd_zeros
 
 __all__ = ["KVStore", "create"]
@@ -105,6 +106,9 @@ class KVStore:
         return NDArray(acc)
 
     def push(self, key, value, priority=0):
+        # gradient-aggregation seam: an injected `error`/`delay` here is
+        # what a lost or straggling host looks like to the update path
+        _fault_point("kvstore.push")
         keys, _ = _key_list(key)
         values = _val_list(len(keys), value)
         for k, vs in zip(keys, values):
@@ -233,6 +237,7 @@ class KVStoreDistTPU(KVStore):
         """Dist semantics: without an updater the server ACCUMULATES pushes
         (reference kvstore_dist_server.h default merge: stored += merged —
         the nightly test arithmetic (n+1)*n*rate/2*nrepeat+1 relies on it)."""
+        _fault_point("kvstore.push")
         keys, _ = _key_list(key)
         values = _val_list(len(keys), value)
         for k, vs in zip(keys, values):

@@ -35,7 +35,8 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "embed_report_str", "compile_report", "compile_report_str",
            "register_passes_stats", "passes_report", "passes_report_str",
            "register_autotune_stats", "autotune_report",
-           "autotune_report_str",
+           "autotune_report_str", "register_faults_stats",
+           "faults_report", "faults_report_str",
            "MultichipStats", "register_multichip_stats",
            "parse_hlo_collectives", "multichip_report",
            "multichip_report_str", "unified_report", "unified_report_str"]
@@ -648,6 +649,33 @@ def autotune_report_str() -> str:
     return _autotune_registry.report_str()
 
 
+# -- fault-injection / recovery instrumentation (mxnet_tpu.faults) -----------
+# The fault plane's process-global FaultStats (kind "plane": injected
+# faults by kind and point) and every live Supervisor's SupervisorStats
+# (kind "supervisor": restarts, recovery seconds, backoff waits) share
+# one registry, so faults_report() is the single "what broke and how we
+# recovered" view of a chaos run.
+_faults_registry = _Registry("faults", "(no fault plane or supervisor)")
+
+
+def register_faults_stats(faults_stats) -> None:
+    """Called by faults.install (the plane singleton) and
+    faults.Supervisor on construction."""
+    _faults_registry.register(faults_stats)
+
+
+def faults_report() -> dict:
+    """Per-component fault counters: the plane row (injected faults by
+    kind/point, current attempt) and one row per supervisor (attempts,
+    restarts, recovery_s, backoff waits).  See mxnet_tpu.faults."""
+    return _faults_registry.report()
+
+
+def faults_report_str() -> str:
+    """Human-readable fault-injection + recovery table."""
+    return _faults_registry.report_str()
+
+
 # -- compilation instrumentation (mxnet_tpu.compile_cache) -------------------
 # Compilation is process-global (one XLA compiler, one jit cache, one disk
 # cache), so unlike the per-instance registries above there is exactly one
@@ -682,6 +710,7 @@ def unified_report() -> dict:
         "embed": embed_report(),
         "passes": passes_report(),
         "autotune": autotune_report(),
+        "faults": faults_report(),
     }
     try:
         out["compile"] = compile_report()
@@ -703,6 +732,7 @@ def unified_report_str() -> str:
         ("embed", embed_report_str),
         ("passes", passes_report_str),
         ("autotune", autotune_report_str),
+        ("faults", faults_report_str),
         ("compile", compile_report_str),
     ]
     parts = []

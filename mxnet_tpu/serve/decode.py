@@ -60,6 +60,7 @@ import numpy as np
 
 from .. import trace as _trace
 from ..base import get_env, make_condition
+from ..faults import point as _fault_point
 from ..predictor import Predictor, load_checkpoint_pair
 from .batcher import _IDLE_POLL_S, _set_exception, _set_result
 from .engine import _load_checkpoint_dir_params, exec_device_bytes
@@ -510,6 +511,10 @@ class DecodeEngine:
             if slot is not None:
                 toks[i] = slot.next_tok
         n_active = self._active
+        # stateful-decode seam: `delay` stretches a step (slot-occupancy
+        # pressure), `error` kills the decode loop — the replica-crash
+        # shape for continuous batching
+        _fault_point("decode.step", active=n_active)
         with _trace.span("serve:decode_step", cat="serve",
                          active=n_active, slots=self.num_slots):
             p = self._predictor
@@ -599,6 +604,11 @@ class DecodeEngine:
         or anything that slipped in during shutdown) and release reload
         waiters — nothing may hang on a dead loop."""
         with self._cv:
+            # the loop may be dying from an ERROR (e.g. an injected
+            # decode.step fault), not a close(): flip _closed so no new
+            # submit can enqueue onto a dead loop and hang its future
+            # forever (on a normal close it is already True)
+            self._closed = True
             leftovers = list(self._q)
             self._q.clear()
             reloads = list(self._reload_q)

@@ -38,6 +38,7 @@ import numpy as np
 from .. import trace as _trace
 from ..base import MXNetError, get_env, make_rlock
 from ..context import Context
+from ..faults import point as _fault_point
 from ..predictor import Predictor, load_checkpoint_pair
 from .batcher import MicroBatcher
 from .errors import ServeError, ServeRequestError
@@ -405,6 +406,11 @@ class ServeEngine:
     def _run_batch(self, reqs) -> Tuple:
         n = len(reqs)
         bucket = self._pick_bucket(n)
+        # replica-failure seam: an injected `error` fails this batch
+        # (every future gets the exception — exactly what a broken
+        # replica looks like to the router), a `crash` kills the whole
+        # engine process
+        _fault_point("serve.dispatch", n=n, bucket=bucket)
         with _trace.span("serve:run_batch", cat="serve", n=n,
                          bucket=bucket):
             data = np.stack([r.data for r in reqs])

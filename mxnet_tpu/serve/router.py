@@ -13,9 +13,19 @@ traffic is N **replicas** of the same model behind a router that
 * **tracks health**: replica failures (engine errors, not client-side
   deadline/validation errors) count per replica; at
   ``MXNET_SERVE_ROUTER_UNHEALTHY`` consecutive failures the replica is
-  taken out of rotation (state ``down``) until an operator restarts it.
-  A failed request is retried once on another replica before the
-  client sees the error;
+  taken out of rotation (state ``down``).  A failed request is
+  re-dispatched to another replica — a configurable budget
+  (``MXNET_SERVE_ROUTER_RETRIES``) with deterministic jittered backoff
+  between attempts (``faults.Backoff``) — before the client sees the
+  error;
+* **heals itself**: a down replica is not down forever.  After a
+  backed-off probe interval (``MXNET_SERVE_ROUTER_PROBE_S``, jittered
+  exponential per re-trip) the breaker goes HALF-OPEN: exactly one
+  live request is routed to the down replica as a probe.  Success
+  reinstates it (state ``live``, health + backoff reset); failure
+  re-trips it with a doubled interval — and the probe request itself
+  just retries on a healthy replica, so probing never costs a client
+  an error.  No operator ``restart()`` required for transient faults;
 * **restarts without dropping**: ``restart(i)`` marks the replica
   *draining* — the router stops dispatching to it, waits out its
   in-flight requests, then hot-swaps weights (``reload=``) or rebuilds
@@ -42,12 +52,15 @@ close``.
 """
 from __future__ import annotations
 
+import itertools
 import time
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional
 
 from .. import trace as _trace
 from ..base import get_env, make_condition
+from ..faults import InjectedFault
+from ..faults.retry import Backoff
 from .batcher import _set_exception, _set_result
 from .errors import (ServeClosedError, ServeDeadlineError, ServeError,
                      ServeOverloadError, ServeRequestError,
@@ -60,6 +73,11 @@ LIVE, DRAINING, DOWN = "live", "draining", "down"
 # drain poll bound: wakes also arrive via the cv notify in _on_done, so
 # this only bounds shutdown/timeout latency
 _IDLE_WAIT_S = 0.05
+
+# a dispatched probe whose future never settles (a down replica that
+# accepts but wedges) is reclaimed after this long so the breaker can
+# keep probing instead of freezing open
+_PROBE_STALE_S = 30.0
 
 
 class RouterStats:
@@ -86,9 +104,10 @@ class RouterStats:
 
 class _Replica:
     __slots__ = ("index", "engine", "state", "outstanding", "dispatched",
-                 "failures", "restarts")
+                 "failures", "restarts", "probe_at", "probe_inflight",
+                 "probe_backoff", "probe_gen", "probes", "reinstated")
 
-    def __init__(self, index: int, engine):
+    def __init__(self, index: int, engine, probe_base_s: float):
         self.index = index
         self.engine = engine
         self.state = LIVE
@@ -96,6 +115,20 @@ class _Replica:
         self.dispatched = 0
         self.failures = 0           # consecutive engine-side failures
         self.restarts = 0
+        # half-open circuit breaker (see module docstring): while DOWN,
+        # probe_at is the perf_counter after which ONE request may be
+        # routed here as a probe; the interval backs off per re-trip
+        self.probe_at: Optional[float] = None
+        self.probe_inflight = False
+        self.probe_backoff = Backoff(
+            base_s=probe_base_s, factor=2.0, max_s=30.0, jitter=0.25,
+            seed=[977, index], name="router.probe")
+        # generation token: a reclaimed-stale probe's future that
+        # settles LATE carries an old gen and must not touch the
+        # breaker (at most one live probe decides its state)
+        self.probe_gen = 0
+        self.probes = 0
+        self.reinstated = 0
 
 
 class ServeRouter:
@@ -115,31 +148,60 @@ class ServeRouter:
         rotation (``MXNET_SERVE_ROUTER_UNHEALTHY``, default 3; 0
         disables).
     retries : int
-        How many times a failed request is re-dispatched to another
-        replica before the client sees the failure (default 1).
+        Retry budget: how many times a failed request is re-dispatched
+        to another replica before the client sees the failure
+        (``MXNET_SERVE_ROUTER_RETRIES``, default 2; 0 disables), with
+        jittered backoff between attempts (base
+        ``MXNET_SERVE_ROUTER_RETRY_MS``, default 2ms, factor 2, capped
+        50ms — short enough for a completion-thread wait, long enough
+        to ride out a replica's draining hiccup).
+    probe_after_s : float
+        Half-open breaker base interval: how long a freshly tripped
+        replica stays down before one live request probes it
+        (``MXNET_SERVE_ROUTER_PROBE_S``, default 1.0; the interval
+        doubles per failed probe, caps at 30s; 0 disables probing —
+        a down replica then waits for an operator ``restart()``).
+        Probing drafts a real request and relies on the retry budget
+        to shield that client, so it is also disabled when
+        ``retries`` is 0.
     """
 
     def __init__(self, factory: Callable[[int], object], replicas: int = 2,
                  *, unhealthy_after: Optional[int] = None,
-                 retries: int = 1, name: str = "router"):
+                 retries: Optional[int] = None,
+                 probe_after_s: Optional[float] = None,
+                 name: str = "router"):
         if replicas < 1:
             raise ServeError("replicas must be >= 1, got %d" % replicas)
         if unhealthy_after is None:
             unhealthy_after = get_env("MXNET_SERVE_ROUTER_UNHEALTHY", 3, int)
         self.unhealthy_after = max(0, int(unhealthy_after))
+        if retries is None:
+            retries = get_env("MXNET_SERVE_ROUTER_RETRIES", 2, int)
         self.retries = max(0, int(retries))
+        if probe_after_s is None:
+            probe_after_s = get_env("MXNET_SERVE_ROUTER_PROBE_S", 1.0,
+                                    float)
+        self.probe_after_s = max(0.0, float(probe_after_s))
+        self._retry_base_s = max(
+            0.0, get_env("MXNET_SERVE_ROUTER_RETRY_MS", 2.0, float) / 1e3)
+        self._retry_seed = itertools.count()
         self.name = name
         self._factory = factory
         self._cv = make_condition("serve.router")
         self._closed = False
         self._rejected = 0
         self._retried = 0
+        self._retry_wait_s = 0.0
         self._drains = 0
         self._downs = 0
+        self._probes = 0
+        self._reinstated = 0
         self._replicas: List[_Replica] = []
         try:
             for i in range(int(replicas)):
-                self._replicas.append(_Replica(i, factory(i)))
+                self._replicas.append(
+                    _Replica(i, factory(i), self.probe_after_s or 1.0))
         except BaseException:
             for rep in self._replicas:
                 try:
@@ -158,13 +220,75 @@ class ServeRouter:
         except Exception:
             return 1 << 30
 
-    def _pick_locked(self, exclude) -> Optional[_Replica]:
-        """Least-loaded live replica not in ``exclude``."""
+    def _pick_locked(self, exclude):
+        """-> (replica, is_probe).  Least-loaded live replica not in
+        ``exclude`` — unless a DOWN replica's half-open probe timer has
+        expired, in which case THAT replica gets this one request as
+        its probe (at most one in flight; the retry budget shields the
+        client if the probe fails)."""
+        # probing drafts a real client request, and the retry budget is
+        # what shields that client from a failing probe — with no
+        # budget, probing would break the "clients never pay for
+        # probing" contract, so it requires retries >= 1
+        if self.probe_after_s > 0 and self.retries > 0:
+            now = time.perf_counter()
+            for r in self._replicas:
+                if r.probe_inflight and r.probe_at is not None \
+                        and now - r.probe_at > _PROBE_STALE_S:
+                    # the probe's future never settled (a down replica
+                    # that accepts but wedges): reclaim the breaker so
+                    # probing can continue — counts as a failed probe,
+                    # and the gen bump invalidates the wedged future's
+                    # eventual late outcome
+                    self._probe_result_locked(r, False, r.probe_gen)
+                    r.probe_gen += 1
+                if (r.state == DOWN and not r.probe_inflight
+                        and r.index not in exclude
+                        and r.probe_at is not None and now >= r.probe_at):
+                    r.probe_inflight = True
+                    r.probe_at = now        # stale-probe watermark
+                    r.probe_gen += 1
+                    r.probes += 1
+                    self._probes += 1
+                    _trace.instant("serve:router_probe", cat="serve",
+                                   replica=r.index)
+                    return r, True
         live = [r for r in self._replicas
                 if r.state == LIVE and r.index not in exclude]
         if not live:
-            return None
-        return min(live, key=self._load)
+            return None, False
+        return min(live, key=self._load), False
+
+    def _probe_result_locked(self, rep: _Replica, ok,
+                             gen: Optional[int] = None) -> None:
+        """Half-open probe outcome (cv held): True reinstates the
+        replica, False re-trips it with a doubled interval, None
+        (client-side outcome — cancel, deadline, malformed request:
+        says nothing about replica health) re-arms the CURRENT
+        interval without advancing the backoff.  ``gen`` is the probe
+        generation the outcome belongs to: a reclaimed-stale probe's
+        future settling late must not touch the breaker."""
+        if gen is not None and gen != rep.probe_gen:
+            return
+        rep.probe_inflight = False
+        if rep.state != DOWN:       # restarted/reinstated underneath
+            return
+        if ok is True:
+            rep.state = LIVE
+            rep.failures = 0
+            rep.probe_backoff.reset()
+            rep.probe_at = None
+            rep.reinstated += 1
+            self._reinstated += 1
+            _trace.instant("serve:router_probe_up", cat="serve",
+                           replica=rep.index)
+        elif ok is False:
+            rep.probe_at = time.perf_counter() \
+                + rep.probe_backoff.next_wait()
+            _trace.instant("serve:router_probe_fail", cat="serve",
+                           replica=rep.index)
+        else:
+            rep.probe_at = time.perf_counter() + rep.probe_backoff.peek()
 
     def submit(self, data, deadline_ms: Optional[float] = None,
                **kwargs) -> Future:
@@ -183,19 +307,30 @@ class ServeRouter:
         return self.submit(data, **kwargs).result(timeout=timeout)
 
     def _dispatch(self, rfut: Future, data, deadline_ms, kwargs,
-                  tried, retries_left: int) -> None:
+                  tried, retries_left: int,
+                  backoff: Optional[Backoff] = None) -> None:
         """Place the request on the best available replica; on overload
         walk the remaining live replicas.  Raises into the CALLER when
         nothing accepted and ``rfut`` was never dispatched; replica
         failures after acceptance retry via the done callback."""
         overloads = 0
         last_exc = None
+        relaxed = False
         while True:
             with self._cv:
                 if self._closed:
                     raise ServeClosedError(
                         "serve router %r is closed" % self.name)
-                rep = self._pick_locked(tried)
+                rep, is_probe = self._pick_locked(tried)
+                if rep is None and tried and not relaxed \
+                        and any(r.state == LIVE for r in self._replicas):
+                    # the exclusion set (a just-failed replica, an
+                    # earlier overload) ate every live replica: retrying
+                    # an excluded LIVE replica beats failing the client
+                    # — relax once and re-pick
+                    relaxed = True
+                    tried.clear()
+                    continue
                 if rep is None:
                     self._rejected += 1
                     if overloads:
@@ -209,6 +344,7 @@ class ServeRouter:
                         "no live replica (states: %s) — all draining/"
                         "down; restart or add replicas"
                         % [r.state for r in self._replicas])
+                probe_gen = rep.probe_gen if is_probe else None
                 rep.outstanding += 1    # reserve before releasing the lock
             try:
                 efut = rep.engine.submit(data, deadline_ms=deadline_ms,
@@ -216,6 +352,8 @@ class ServeRouter:
             except ServeOverloadError:
                 with self._cv:
                     rep.outstanding -= 1
+                    if is_probe:    # a probe that can't even queue
+                        self._probe_result_locked(rep, False, probe_gen)
                     self._cv.notify_all()
                 tried.add(rep.index)
                 overloads += 1
@@ -225,14 +363,18 @@ class ServeRouter:
                 # it — the caller's problem, not the replica's
                 with self._cv:
                     rep.outstanding -= 1
+                    if is_probe:
+                        self._probe_result_locked(rep, None, probe_gen)
                     self._cv.notify_all()
                 raise
-            except ServeError as e:
+            except (ServeError, InjectedFault) as e:
                 # replica broken at submit time (closed underneath,
-                # wedged): health-count it and walk on
-                self._note_failure(rep)
+                # wedged, chaos-injected): health-count it and walk on
                 with self._cv:
                     rep.outstanding -= 1
+                    if is_probe:
+                        self._probe_result_locked(rep, False, probe_gen)
+                    self._note_failure_locked(rep)
                     self._cv.notify_all()
                 tried.add(rep.index)
                 last_exc = e
@@ -240,46 +382,60 @@ class ServeRouter:
             except BaseException:
                 with self._cv:
                     rep.outstanding -= 1
+                    if is_probe:
+                        self._probe_result_locked(rep, None, probe_gen)
                     self._cv.notify_all()
                 raise
             with self._cv:
                 rep.dispatched += 1
             efut.add_done_callback(
-                lambda f, rep=rep: self._on_done(
+                lambda f, rep=rep, is_probe=is_probe,
+                probe_gen=probe_gen: self._on_done(
                     f, rep, rfut, data, deadline_ms, kwargs, tried,
-                    retries_left))
+                    retries_left, is_probe, probe_gen, backoff))
             return
 
     def _note_failure_locked(self, rep: _Replica) -> None:
         """Health policy, ONE implementation (cv held): submit-time and
-        future-time failures must agree on when a replica goes down."""
+        future-time failures must agree on when a replica goes down.
+        Tripping arms the half-open probe timer."""
         rep.failures += 1
         if (self.unhealthy_after and rep.state == LIVE
                 and rep.failures >= self.unhealthy_after):
             rep.state = DOWN
             self._downs += 1
+            if self.probe_after_s > 0:
+                rep.probe_at = time.perf_counter() \
+                    + rep.probe_backoff.next_wait()
             _trace.instant("serve:router_down", cat="serve",
                            replica=rep.index)
 
-    def _note_failure(self, rep: _Replica) -> None:
-        with self._cv:
-            self._note_failure_locked(rep)
-
     def _retryable(self, exc: BaseException) -> bool:
         """Engine-side failures worth another replica: a closed or
-        broken replica.  Client-side outcomes (deadline, malformed
-        request) and overload (handled at dispatch) are final."""
+        broken replica, or a chaos-injected fault.  Client-side
+        outcomes (deadline, malformed request) and overload (handled
+        at dispatch) are final."""
         if isinstance(exc, (ServeDeadlineError, ServeRequestError,
                             ServeOverloadError)):
             return False
-        return isinstance(exc, (ServeClosedError, ServeError))
+        return isinstance(exc, (ServeClosedError, ServeError,
+                                InjectedFault))
 
     def _on_done(self, efut: Future, rep: _Replica, rfut: Future, data,
-                 deadline_ms, kwargs, tried, retries_left: int) -> None:
+                 deadline_ms, kwargs, tried, retries_left: int,
+                 is_probe: bool = False, probe_gen: Optional[int] = None,
+                 backoff: Optional[Backoff] = None) -> None:
         exc = efut.exception() if not efut.cancelled() else None
         engine_fail = exc is not None and self._retryable(exc)
         with self._cv:
             rep.outstanding -= 1
+            if is_probe:
+                if exc is None and not efut.cancelled():
+                    self._probe_result_locked(rep, True, probe_gen)
+                elif engine_fail:
+                    self._probe_result_locked(rep, False, probe_gen)
+                else:
+                    self._probe_result_locked(rep, None, probe_gen)
             if engine_fail:
                 self._note_failure_locked(rep)
             elif exc is None and not efut.cancelled():
@@ -292,14 +448,26 @@ class ServeRouter:
             _set_result(rfut, efut.result())
             return
         if engine_fail and retries_left > 0 and not self._closed:
+            if backoff is None:
+                # one jittered schedule per request's retry chain —
+                # concurrent failures fan back in de-synchronized
+                backoff = Backoff(base_s=self._retry_base_s, factor=2.0,
+                                  max_s=0.05, jitter=0.5,
+                                  seed=next(self._retry_seed),
+                                  name="router.retry")
             with self._cv:
                 self._retried += 1
+            if self._retry_base_s > 0:
+                wait = backoff.next_wait()
+                with self._cv:
+                    self._retry_wait_s += wait
+                time.sleep(wait)        # bounded: max_s caps at 50ms
             try:
                 # fresh exclusion set: only the replica that just failed
                 # is off-limits — an earlier transient overload on
                 # another replica must not shrink the retry's options
                 self._dispatch(rfut, data, deadline_ms, kwargs,
-                               {rep.index}, retries_left - 1)
+                               {rep.index}, retries_left - 1, backoff)
                 return
             except Exception as redispatch_exc:
                 exc = redispatch_exc
@@ -362,6 +530,11 @@ class ServeRouter:
                 rep.failures = 0
                 rep.restarts += 1
                 rep.state = LIVE
+                # an operator restart is a clean bill of health: the
+                # breaker re-arms from its first rung
+                rep.probe_inflight = False
+                rep.probe_at = None
+                rep.probe_backoff.reset()
                 self._cv.notify_all()
 
     def rolling_restart(self, reload: Optional[Dict] = None,
@@ -401,15 +574,19 @@ class ServeRouter:
                 "replicas": len(reps),
                 "rejected": self._rejected,
                 "retried": self._retried,
+                "retry_wait_s": round(self._retry_wait_s, 4),
                 "drains": self._drains,
                 "downs": self._downs,
+                "probes": self._probes,
+                "reinstated": self._reinstated,
             }
         per = {}
         agg_submitted = agg_completed = agg_failed = 0
         for r in reps:
             row = {"state": r.state, "dispatched": r.dispatched,
                    "outstanding": r.outstanding, "failures": r.failures,
-                   "restarts": r.restarts}
+                   "restarts": r.restarts, "probes": r.probes,
+                   "reinstated": r.reinstated}
             st = getattr(r.engine, "stats", None)
             if st is not None:
                 erep = st.report()
@@ -428,8 +605,10 @@ class ServeRouter:
         r = self._report()
         lines = ["serve router %r" % self.name,
                  "  replicas: %d, %d rejected, %d retried, %d drains, "
-                 "%d downs" % (r["replicas"], r["rejected"], r["retried"],
-                               r["drains"], r["downs"]),
+                 "%d downs, %d probes (%d reinstated)"
+                 % (r["replicas"], r["rejected"], r["retried"],
+                    r["drains"], r["downs"], r["probes"],
+                    r["reinstated"]),
                  "  rollup: %d submitted / %d completed / %d failed"
                  % (r["submitted"], r["completed"], r["failed"])]
         for i, row in sorted(r["per_replica"].items()):

@@ -49,7 +49,8 @@ __all__ = ["span", "complete", "instant", "counter", "async_begin",
            "async_instant", "async_end", "next_async_id", "enabled",
            "set_enabled", "dump_trace", "add_spill_dir", "spill_dirs",
            "configure_spill", "flush_spill", "label_process",
-           "event_count", "drop_count", "span_events", "trace_report",
+           "event_count", "drop_count", "span_events", "instant_events",
+           "trace_report",
            "reset", "maybe_journal_step", "write_journal_line",
            "journal_path", "journal_every", "reset_journal"]
 
@@ -263,6 +264,31 @@ def span_events(names=None, since_ns: Optional[int] = None,
         if e.get("ph") != "X":
             continue
         if name_set is not None and e["name"] not in name_set:
+            continue
+        if cat is not None and e.get("cat") != cat:
+            continue
+        if since_ns is not None and e["ts"] * 1000.0 < since_ns:
+            continue
+        out.append(e)
+    return out
+
+
+def instant_events(names=None, cat: Optional[str] = None,
+                   prefix: Optional[str] = None,
+                   since_ns: Optional[int] = None) -> List[Dict]:
+    """Matching instant-event dicts (``ph: "i"``) from this process's
+    rings — the read side of :func:`instant`, same filters as
+    :func:`span_events` plus a name ``prefix`` (the fault plane's
+    injections are all ``fault:*`` instants; the chaos tests assert on
+    exactly these)."""
+    name_set = set(names) if names is not None else None
+    out = []
+    for e in _recorder.snapshot():
+        if e.get("ph") != "i":
+            continue
+        if name_set is not None and e["name"] not in name_set:
+            continue
+        if prefix is not None and not e["name"].startswith(prefix):
             continue
         if cat is not None and e.get("cat") != cat:
             continue

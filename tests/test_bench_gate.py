@@ -107,6 +107,40 @@ def test_lower_is_better_direction(tmp_path):
                 tmp_path).returncode == 1
 
 
+def test_zero_floor_metric_regression_is_caught(tmp_path):
+    """ISSUE 15: a ZERO_FLOOR metric (the discrete 'gated at 0' class
+    — dropped requests, steady-loop compiles) must fail on ANY nonzero
+    value, not ride the no-percent-scale free pass; staying at 0
+    passes; continuous lower-is-better metrics (chaos_overhead_frac)
+    are exempt so a noise-floor 0.0 cannot condemn later runs."""
+    for n, drops in ((1, 0.0), (2, 1.0)):
+        with open(str(tmp_path / ("BENCH_r%02d.json" % n)), "w") as f:
+            json.dump({"rc": 0, "parsed": {"metric": "m", "unit": "q",
+                                           "path": "p",
+                                           "serve_failover_dropped":
+                                           drops}}, f)
+    res = _run([], tmp_path)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "serve_failover_dropped" in res.stdout
+    # no threshold can wave a zero-floor hit through
+    assert _run(["--threshold", "500"], tmp_path).returncode == 1
+    with open(str(tmp_path / "BENCH_r02.json"), "w") as f:
+        json.dump({"rc": 0, "parsed": {"metric": "m", "unit": "q",
+                                       "path": "p",
+                                       "serve_failover_dropped": 0.0}},
+                  f)
+    assert _run([], tmp_path).returncode == 0
+    # continuous metric: prior clamped to 0.0, later normal noise value
+    # must still pass (not in ZERO_FLOOR)
+    for n, frac in ((1, 0.0), (2, 0.01)):
+        with open(str(tmp_path / ("BENCH_r%02d.json" % n)), "w") as f:
+            json.dump({"rc": 0, "parsed": {"metric": "m", "unit": "q",
+                                           "path": "p",
+                                           "chaos_overhead_frac": frac}},
+                      f)
+    assert _run([], tmp_path).returncode == 0
+
+
 def test_invalid_newest_run_is_an_error(tmp_path):
     with open(str(tmp_path / "BENCH_r01.json"), "w") as f:
         json.dump({"rc": 2, "parsed": {}}, f)

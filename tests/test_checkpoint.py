@@ -29,9 +29,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(autouse=True)
-def _clear_fault_hook():
+def _clear_fault_plan():
     yield
-    ck.set_fault_hook(None)
+    mx.faults.clear()
 
 
 def _mlp():
@@ -109,13 +109,14 @@ def test_fault_after_rename_leaves_uncommitted_and_skipped(tmp_path):
     mgr = ck.CheckpointManager(root, async_save=False, keep_last_n=None)
     mgr.save(1, {"w": np.ones(3)}, {})
 
-    def boom(point, step, path):
-        if point == "after_rename" and step == 2:
-            raise RuntimeError("injected crash before COMMIT")
-    ck.set_fault_hook(boom)
-    with pytest.raises(RuntimeError, match="injected"):
+    # the faults plane replaces the old layout-private hook: target the
+    # exact protocol stage + step with a programmatic rule
+    mx.faults.install(mx.faults.Rule(
+        points="checkpoint.commit@after_rename", kinds="error",
+        when=lambda ctx: ctx["step"] == 2))
+    with pytest.raises(mx.faults.InjectedFault, match="injected"):
         mgr.save(2, {"w": np.ones(3) * 2}, {})
-    ck.set_fault_hook(None)
+    mx.faults.clear()
     # step-2 exists on disk but uncommitted: discovery must skip it
     assert os.path.isdir(os.path.join(root, ck.step_dir_name(2)))
     assert ck.latest_step(root) == 1
@@ -129,14 +130,12 @@ def test_async_writer_error_reraises_on_wait(tmp_path):
     mgr = ck.CheckpointManager(str(tmp_path), async_save=True,
                                keep_last_n=None)
 
-    def boom(point, step, path):
-        if point == "shards_written":
-            raise RuntimeError("writer died")
-    ck.set_fault_hook(boom)
+    mx.faults.install(mx.faults.Rule(
+        points="checkpoint.commit@shards_written", kinds="error"))
     mgr.save(1, {"w": np.ones(2)}, {})
-    with pytest.raises(RuntimeError, match="writer died"):
+    with pytest.raises(mx.faults.InjectedFault, match="injected"):
         mgr.wait()
-    ck.set_fault_hook(None)
+    mx.faults.clear()
     mgr.save(2, {"w": np.ones(2)}, {})
     mgr.wait()
     assert mgr.latest_step() == 2
@@ -528,12 +527,10 @@ from mxnet_tpu import checkpoint as ck
 
 store = sys.argv[1]
 
-def fault(point, step, path):
-    # SIGKILL the process mid-save (shards on disk, no rename, no COMMIT)
-    if point == "shards_written" and step >= 5:
-        os.kill(os.getpid(), signal.SIGKILL)
-
-ck.set_fault_hook(fault)
+# SIGKILL the process mid-save (shards on disk, no rename, no COMMIT)
+mx.faults.install(mx.faults.Rule(
+    points="checkpoint.commit@shards_written", kinds="crash",
+    when=lambda ctx: ctx["step"] >= 5))
 rng = np.random.RandomState(0)
 X = rng.rand(80, 10).astype(np.float32)
 y = rng.randint(0, 3, 80).astype(np.float32)

@@ -368,3 +368,34 @@ def test_env_knobs(model, monkeypatch):
         assert len(got) == 3            # default cap from the env
     finally:
         eng.close()
+
+
+def test_injected_step_fault_kills_loop_but_not_liveness(model):
+    """ISSUE 15 review: an injected decode.step error kills the decode
+    loop — a dead engine must flip closed so later submits fast-fail
+    with ServeClosedError instead of enqueueing futures that can never
+    resolve (a wedged replica the router can then health-count)."""
+    from mxnet_tpu import faults
+    params, prompts, _ = model
+    eng = _engine(params, name="fault-decode")
+    try:
+        fut = eng.submit(prompts[0], max_new_tokens=4)
+        fut.result(timeout=60)                    # healthy first
+        faults.install(faults.Rule(points="decode.step", kinds="error",
+                                   max_faults=1))
+        doomed = eng.submit(prompts[1], max_new_tokens=4)
+        with pytest.raises(ServeError):
+            doomed.result(timeout=60)             # loop died, stream failed
+        faults.clear()
+        deadline = time.perf_counter() + 10
+        while time.perf_counter() < deadline:     # loop exit is async
+            try:
+                eng.submit(prompts[2], max_new_tokens=2)
+            except ServeClosedError:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("dead decode engine still accepting submits")
+    finally:
+        faults.clear()
+        eng.close(drain=False)

@@ -462,11 +462,9 @@ from mxnet_tpu import checkpoint as ck
 
 store = sys.argv[1]
 
-def fault(point, step, path):
-    if point == "shards_written" and step >= 6:
-        os.kill(os.getpid(), signal.SIGKILL)
-
-ck.set_fault_hook(fault)
+mx.faults.install(mx.faults.Rule(
+    points="checkpoint.commit@shards_written", kinds="crash",
+    when=lambda ctx: ctx["step"] >= 6))
 mx.random.seed(5)
 rng = np.random.RandomState(0)
 X = rng.randint(0, 48, size=(64, 4)).astype(np.float32)

@@ -1,0 +1,572 @@
+"""mxnet_tpu.faults: the seeded chaos suite (ISSUE 15, tier-1).
+
+Covers the three layers end to end:
+
+* the **plane** — deterministic seeded schedules (same seed => same
+  faults, attempt folding changes them), every kind (error/delay/torn;
+  crash is exercised by the subprocess legs), point/stage filtering,
+  ``after``/``max`` budgets, env-spec parsing, ``fault:`` trace
+  instants, the profiler report, and near-zero disabled cost;
+* **retry** — Backoff determinism/reset/interruptible sleep,
+  RestartWindow sliding expiry, retry_call semantics;
+* **supervisor + recovery** — restart-until-success with backoff,
+  give-up budget, hang watchdog; and THE acceptance scenario: a
+  fit + checkpoint + 2-process ParallelReader run under a schedule
+  that SIGKILLs a reader worker, tears a shard write, and kills the
+  committer mid-protocol across two attempts — the supervised run's
+  final committed state is BITWISE identical to a fault-free run
+  (params, optimizer state, RNG, feed cursor);
+* **self-healing serve** — a router flood under injected dispatch
+  faults completes with zero dropped requests while replicas trip and
+  probe back in; a crash-looping reader worker burns its sliding
+  restart window through Backoff waits instead of hot-spinning, with
+  the parent responsive throughout.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, feed, recordio
+from mxnet_tpu import trace as mtrace
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.faults import (Backoff, FaultPlan, InjectedFault,
+                              RestartWindow, Rule, retry_call)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clear_plan():
+    yield
+    faults.clear()
+
+
+# -- the plane ---------------------------------------------------------------
+
+def _fire_pattern(plan, n=60, point="x.y"):
+    return [plan.decide(point, {}) is not None for _ in range(n)]
+
+
+def test_schedule_is_deterministic_and_seed_sensitive():
+    mk = lambda s: FaultPlan([Rule(rate=0.25, kinds="error")], seed=s)
+    assert _fire_pattern(mk(7)) == _fire_pattern(mk(7))
+    assert _fire_pattern(mk(7)) != _fire_pattern(mk(8))
+    # distinct points draw from distinct streams
+    p = mk(7)
+    assert _fire_pattern(p, point="a.b") != _fire_pattern(p, point="c.d")
+
+
+def test_attempt_folds_into_the_stream(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULTS_ATTEMPT", "0")
+    p0 = _fire_pattern(FaultPlan([Rule(rate=0.25)], seed=7))
+    monkeypatch.setenv("MXNET_FAULTS_ATTEMPT", "1")
+    p1 = _fire_pattern(FaultPlan([Rule(rate=0.25)], seed=7))
+    assert p0 != p1
+
+
+def test_error_kind_raises_and_traces_and_counts():
+    faults.install("seed=1,rate=1,kinds=error,points=t.err")
+    before = len(mtrace.instant_events(prefix="fault:t.err"))
+    with pytest.raises(InjectedFault, match="t.err"):
+        faults.point("t.err", step=3)
+    faults.point("other.point")          # filtered: silent
+    evs = mtrace.instant_events(prefix="fault:t.err")
+    assert len(evs) == before + 1
+    assert evs[-1]["args"]["kind"] == "error"
+    assert evs[-1]["args"]["step"] == 3
+    rep = mx.profiler.faults_report()
+    plane_rows = [r for r in rep.values() if r.get("kind") == "plane"]
+    assert plane_rows and plane_rows[0]["by_point"].get("t.err", 0) >= 1
+
+
+def test_delay_kind_sleeps_then_continues():
+    faults.install(FaultPlan([Rule(points="t.slow", kinds="delay",
+                                   delay_s=0.05)]))
+    t0 = time.perf_counter()
+    faults.point("t.slow")               # no raise
+    assert time.perf_counter() - t0 >= 0.045
+
+
+def test_torn_kind_truncates_the_path_then_raises(tmp_path):
+    victim = tmp_path / "shard.npy"
+    victim.write_bytes(b"x" * 1000)
+    faults.install(FaultPlan([Rule(points="t.write", kinds="torn")]))
+    with pytest.raises(InjectedFault, match="torn"):
+        faults.point("t.write", path=str(victim))
+    assert victim.stat().st_size == 500
+
+
+def test_stage_filter_after_and_max():
+    faults.install(FaultPlan([Rule(points="c.commit@rename", kinds="error",
+                                   after=1, max_faults=1)], seed=3))
+    faults.point("c.commit", stage="shards")      # wrong stage
+    faults.point("c.commit", stage="rename")      # 1st eligible: after=1
+    with pytest.raises(InjectedFault):
+        faults.point("c.commit", stage="rename")  # 2nd: fires
+    faults.point("c.commit", stage="rename")      # max=1 spent
+
+
+def test_env_spec_parse_and_reject():
+    plan = faults.parse_spec(
+        "seed=9,rate=0.5,kinds=crash|delay,points=a.b|c.d@s,max=2,"
+        "after=3,attempts=0|2,delay_ms=5")
+    r = plan.rules[0]
+    assert plan.seed == 9 and r.rate == 0.5
+    assert r.kinds == ("crash", "delay")
+    assert r.points == [("a.b", None), ("c.d", "s")]
+    assert r.max_faults == 2 and r.after == 3
+    assert r.attempts == {0, 2} and abs(r.delay_s - 0.005) < 1e-9
+    with pytest.raises(MXNetError, match="unknown key"):
+        faults.parse_spec("rate=1,bogus=2")
+    with pytest.raises(MXNetError, match="unknown fault kind"):
+        faults.parse_spec("kinds=meteor")
+
+
+def test_env_spec_installs_at_import():
+    """A process born with MXNET_FAULTS set has the plan armed before
+    any user code runs — forked readers and supervisor children
+    inherit chaos schedules with zero wiring."""
+    code = ("import mxnet_tpu as mx, sys; "
+            "sys.exit(0 if mx.faults.enabled() "
+            "and mx.faults.attempt() == 3 else 1)")
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=ROOT, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 MXNET_FAULTS="seed=5,rate=0,kinds=error",
+                 MXNET_FAULTS_ATTEMPT="3"))
+    assert r.returncode == 0
+
+
+def test_disabled_point_is_effectively_free():
+    faults.clear()
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        faults.point("hot.path")
+    dt = time.perf_counter() - t0
+    # one `is None` check + kwargs-free call: generous ceiling, the
+    # real number is tens of ns — the bench leg reports the fraction
+    assert dt < 1.0, "disabled faults.point too slow: %.1fus/call" \
+        % (dt / 200_000 * 1e6)
+
+
+# -- retry primitives --------------------------------------------------------
+
+def test_backoff_deterministic_caps_and_reset():
+    b = Backoff(base_s=0.1, factor=2.0, max_s=0.8, jitter=0.5, seed=4)
+    seq = [b.next_wait() for _ in range(8)]
+    b.reset()
+    assert seq == [b.next_wait() for _ in range(8)]
+    for i, w in enumerate(seq):
+        raw = min(0.1 * 2.0 ** i, 0.8)
+        assert raw * 0.5 <= w <= raw * 1.5
+    assert Backoff(base_s=0.1, jitter=0.0, seed=1).next_wait() == 0.1
+
+
+def test_backoff_sleep_is_interruptible():
+    b = Backoff(base_s=5.0, jitter=0.0)
+    stop = {"v": False}
+    t0 = time.perf_counter()
+    import threading
+    threading.Timer(0.1, lambda: stop.update(v=True)).start()
+    b.sleep(should_stop=lambda: stop["v"], poll_s=0.01)
+    assert time.perf_counter() - t0 < 1.0   # nowhere near 5s
+
+
+def test_restart_window_slides():
+    rw = RestartWindow(2, window_s=0.15)
+    assert rw.note() == 1 and rw.note() == 2
+    assert not rw.exceeded()
+    assert rw.note() == 3 and rw.exceeded()
+    time.sleep(0.2)
+    assert rw.count() == 0 and not rw.exceeded()
+    assert rw.total == 3
+
+
+def test_retry_call_budget_and_reraise():
+    calls = {"n": 0}
+
+    def flaky(limit):
+        calls["n"] += 1
+        if calls["n"] < limit:
+            raise ValueError("flake %d" % calls["n"])
+        return "ok"
+
+    b = Backoff(base_s=0.001, jitter=0.0)
+    assert retry_call(flaky, 3, retries=5, backoff=b) == "ok"
+    assert calls["n"] == 3
+    calls["n"] = 0
+    with pytest.raises(ValueError, match="flake 3"):
+        retry_call(flaky, 99, retries=2,
+                   backoff=Backoff(base_s=0.001, jitter=0.0))
+    with pytest.raises(KeyError):     # not in retry_on: no retry
+        retry_call(lambda: {}[0], retries=3, retry_on=(ValueError,))
+
+
+# -- supervisor --------------------------------------------------------------
+
+_CHILD_RC_BY_ATTEMPT = ("import os, sys; "
+                        "a = int(os.environ['MXNET_FAULTS_ATTEMPT']); "
+                        "sys.exit(0 if a >= %d else 1)")
+
+
+def _sup(argv, **kw):
+    kw.setdefault("backoff", Backoff(base_s=0.01, jitter=0.0))
+    return faults.Supervisor(argv, **kw)
+
+
+def test_supervisor_restarts_until_success():
+    sup = _sup([sys.executable, "-c", _CHILD_RC_BY_ATTEMPT % 2],
+               max_restarts=5)
+    assert sup.run() == 0
+    r = sup.stats.report()
+    assert r["attempts"] == 3 and r["restarts"] == 2
+    assert r["backoff_wait_s"] > 0 and r["last_rc"] == 0
+    assert not r["gave_up"]
+
+
+def test_supervisor_gives_up_after_budget():
+    sup = _sup([sys.executable, "-c", "import sys; sys.exit(3)"],
+               max_restarts=1)
+    with pytest.raises(MXNetError, match="restart budget"):
+        sup.run()
+    r = sup.stats.report()
+    assert r["gave_up"] and r["attempts"] == 2 and r["last_rc"] == 3
+
+
+def test_supervisor_watchdog_kills_a_hang():
+    sup = _sup([sys.executable, "-c", "import time; time.sleep(60)"],
+               max_restarts=0, timeout_s=0.5)
+    t0 = time.perf_counter()
+    with pytest.raises(MXNetError, match="restart budget"):
+        sup.run()
+    assert time.perf_counter() - t0 < 10.0
+    assert sup.stats.report()["last_rc"] == -9
+
+
+# -- THE chaos acceptance: supervised fit resumes bitwise --------------------
+
+_CHAOS_FIT = """
+import os, sys
+sys.path.insert(0, %(root)r)
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import faults, feed
+
+rec, store, markers = sys.argv[1], sys.argv[2], sys.argv[3]
+
+def once(name):
+    # cross-attempt (and cross-worker-process) exactly-once: O_EXCL
+    # creation is atomic and the marker survives the crash
+    try:
+        os.close(os.open(os.path.join(markers, name),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+        return True
+    except FileExistsError:
+        return False
+
+faults.install(faults.FaultPlan([
+    # SIGKILL one reader worker mid-epoch-0 (exactly once across the
+    # whole chaos run): the refork must re-enter the stream exactly
+    faults.Rule(points="feed.worker_decode", kinds="crash",
+                when=lambda ctx: ctx["epoch"] == 0 and ctx["seq"] == 3
+                and once("worker_kill")),
+    # attempt 0: tear a shard file of an early save -> the async
+    # writer dies, fit crashes, the supervisor restarts it
+    faults.Rule(points="storage.write", kinds="torn", attempts=[0],
+                after=6, max_faults=1),
+    # attempt 1: SIGKILL between shards-written and rename on its FIRST
+    # commit (attempt 0's torn save surfaces at the NEXT submit, so
+    # attempt 1 resumes late in the run with one save left) -> torn tmp
+    # wreckage on disk that discovery and attempt 2 must skip
+    faults.Rule(points="checkpoint.commit@shards_written", kinds="crash",
+                attempts=[1], max_faults=1),
+], seed=7))
+
+mx.random.seed(123)
+it = feed.record_pipeline(rec, 8, (3, 8, 8), reader_procs=2,
+                          shuffle_window=4, seed=5, scale=1.0 / 255,
+                          max_epochs=8, to_device=False,
+                          device_augment=False)
+d = mx.sym.Variable("data")
+n = mx.sym.FullyConnected(mx.sym.Flatten(d), num_hidden=4, name="fc")
+net = mx.sym.SoftmaxOutput(n, name="softmax")
+init = {"fc_weight": mx.nd.array(
+    np.random.RandomState(7).uniform(-0.05, 0.05, (4, 192))
+    .astype(np.float32)), "fc_bias": mx.nd.zeros((4,))}
+m = mx.mod.Module(net, context=mx.cpu(0))
+m.fit(it, num_epoch=2, arg_params=init,
+      optimizer="sgd",
+      optimizer_params=(("learning_rate", 0.05), ("momentum", 0.9)),
+      checkpoint=store, checkpoint_every=3, resume=True)
+it.close()
+sys.exit(0)
+"""
+
+
+def _write_rec(path, n=32, shape=(3, 8, 8)):
+    rng = np.random.RandomState(0)
+    w = recordio.MXRecordIO(str(path), "w")
+    for i in range(n):
+        arr = rng.randint(0, 255, shape).astype(np.uint8)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 4), i, 0),
+                              arr.tobytes()))
+    w.close()
+    return str(path)
+
+
+def _tree_equal(a, b, path=""):
+    if isinstance(a, dict):
+        assert isinstance(b, dict) and set(a) == set(b), path
+        for k in a:
+            _tree_equal(a[k], b[k], path + "/" + str(k))
+        return
+    if isinstance(a, (list, tuple)):
+        assert len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            _tree_equal(x, y, "%s[%d]" % (path, i))
+        return
+    if a is None:
+        assert b is None, path
+        return
+    assert np.array_equal(np.asarray(a), np.asarray(b)), \
+        "mismatch at %s" % path
+
+
+def test_chaos_fit_supervised_recovery_is_bitwise(tmp_path):
+    """The ISSUE 15 acceptance scenario: one seeded schedule SIGKILLs a
+    reader worker, tears a checkpoint shard write (attempt 0), and
+    SIGKILLs the committer mid-protocol (attempt 1); the supervisor
+    restarts the job from the latest committed step each time, and the
+    final committed train state — params, momentum slots, RNG, feed
+    cursor — is bitwise identical to an uninterrupted run."""
+    from mxnet_tpu import checkpoint as ck
+    rec = _write_rec(tmp_path / "chaos.rec")
+
+    # fault-free reference, in-process (same seeds/pipeline/config)
+    ref_store = str(tmp_path / "ck_ref")
+    mx.random.seed(123)
+    it = feed.record_pipeline(rec, 8, (3, 8, 8), reader_procs=2,
+                              shuffle_window=4, seed=5, scale=1.0 / 255,
+                              max_epochs=8, to_device=False,
+                              device_augment=False)
+    d = mx.sym.Variable("data")
+    n = mx.sym.FullyConnected(mx.sym.Flatten(d), num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(n, name="softmax")
+    init = {"fc_weight": mx.nd.array(
+        np.random.RandomState(7).uniform(-0.05, 0.05, (4, 192))
+        .astype(np.float32)), "fc_bias": mx.nd.zeros((4,))}
+    m = mx.mod.Module(net, context=mx.cpu(0))
+    m.fit(it, num_epoch=2, arg_params=init, optimizer="sgd",
+          optimizer_params=(("learning_rate", 0.05), ("momentum", 0.9)),
+          checkpoint=ref_store, checkpoint_every=3)
+    it.close()
+
+    # chaos run under the supervisor (argv children: fresh jax runtime
+    # per attempt, the production shape)
+    script = tmp_path / "chaos_child.py"
+    script.write_text(_CHAOS_FIT % {"root": ROOT})
+    store = str(tmp_path / "ck_chaos")
+    markers = tmp_path / "markers"
+    markers.mkdir()
+    env = {"JAX_PLATFORMS": "cpu"}
+    sup = faults.Supervisor(
+        [sys.executable, str(script), rec, store, str(markers)],
+        max_restarts=4, backoff=Backoff(base_s=0.05, jitter=0.0),
+        timeout_s=180.0, checkpoint_dir=store, env=env, name="chaos-fit")
+    assert sup.run() == 0
+    r = sup.stats.report()
+    # attempt 0 died (torn shard write), attempt 1 died (crash mid-
+    # commit), attempt 2 finished: exactly two supervised recoveries
+    assert r["restarts"] == 2, r
+    assert r["recovery_s"] > 0 and r["last_recovery_s"] > 0
+    assert os.path.exists(markers / "worker_kill")   # the SIGKILL fired
+
+    ref_mgr = ck.CheckpointManager(ref_store, keep_last_n=None)
+    chaos_mgr = ck.CheckpointManager(store, keep_last_n=None)
+    try:
+        assert ref_mgr.latest_step() == chaos_mgr.latest_step() == 8
+        ref_tree, ref_meta = ref_mgr.restore()
+        chaos_tree, chaos_meta = chaos_mgr.restore()
+        _tree_equal(ref_tree, chaos_tree)
+        for k in ("global_step", "epoch", "nbatch", "feed"):
+            assert ref_meta.get(k) == chaos_meta.get(k), k
+    finally:
+        ref_mgr.close()
+        chaos_mgr.close()
+
+
+# -- self-healing serve under chaos ------------------------------------------
+
+class _ChaosReplica:
+    """Fake replica whose dispatch rides the REAL serve.dispatch fault
+    point — injected faults surface exactly like a broken engine."""
+
+    def __init__(self, index):
+        self.index = index
+
+    def submit(self, data, deadline_ms=None, **kw):
+        fut = Future()
+        try:
+            faults.point("serve.dispatch", replica=self.index)
+        except InjectedFault as e:
+            fut.set_exception(e)
+            return fut
+        fut.set_result(np.asarray(data, np.float32) + 1.0)
+        return fut
+
+    def pending_requests(self):
+        return 0
+
+    def outstanding(self):
+        return 0
+
+    def close(self, drain=True):
+        pass
+
+
+def test_router_chaos_flood_zero_dropped():
+    """A 300-request flood against 3 replicas while the fault plane
+    fails ~12%% of dispatches: replicas trip, the breaker probes them
+    back in, the retry budget absorbs every injected failure — ZERO
+    dropped requests, every answer correct."""
+    from mxnet_tpu.serve import ServeRouter
+    faults.install("seed=11,rate=0.12,kinds=error,points=serve.dispatch")
+    # budget sized for the injected rate: this seed's stream contains a
+    # 4-deep failure run, and the router must be configured to survive
+    # the chaos it is asked to survive (retries=3 drops exactly one)
+    router = ServeRouter(lambda i: _ChaosReplica(i), replicas=3,
+                         unhealthy_after=4, retries=5,
+                         probe_after_s=0.02, name="chaos-flood")
+    dropped = 0
+    try:
+        x = np.arange(4, dtype=np.float32)
+        for i in range(300):
+            try:
+                out = router.submit(x).result(timeout=30)
+                assert np.array_equal(out, x + 1.0)
+            except Exception:
+                dropped += 1
+            if i % 50 == 49:
+                time.sleep(0.03)    # let probe timers breathe
+        assert dropped == 0
+        r = router.stats.report()
+        assert r["retried"] >= 1          # injected faults were absorbed
+        plane = [row for row in mx.profiler.faults_report().values()
+                 if row.get("kind") == "plane"][0]
+        assert plane["by_point"].get("serve.dispatch", 0) >= 10
+        if r["downs"]:                    # tripped replicas healed
+            assert r["reinstated"] >= 1 or \
+                "down" not in router.replica_states()
+    finally:
+        router.close()
+
+
+def test_reader_crash_loop_burns_window_with_backoff(tmp_path):
+    """A decode bug that kills the worker instantly must not hot-loop
+    the fork spinner: each refork waits out the seeded Backoff, the
+    sliding window (MXNET_FEED_MAX_RESTARTS) bounds the attempts, the
+    parent raises a crash-loop error and stays responsive (close
+    returns promptly)."""
+    rec = _write_rec(tmp_path / "loop.rec", n=12, shape=(3, 4, 4))
+
+    def suicide_decode(item):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    reader = feed.ParallelReader(rec, suicide_decode, workers=1,
+                                 sample_shape=(3, 4, 4),
+                                 sample_dtype=np.float32,
+                                 max_restarts=2, seed=3, name="loop")
+    pipe = feed.Pipeline([reader, feed.BatchStage(4)], name="looppipe")
+    it = feed.FeedDataIter(pipe, (3, 4, 4), 4)
+    t0 = time.perf_counter()
+    with pytest.raises(MXNetError, match="crash loop"):
+        it.next()
+    waited = time.perf_counter() - t0
+    # two reforks waited ~0.05 and ~0.1s (jitter 0.25): the loop is
+    # paced, not hot
+    assert waited >= 0.08, waited
+    assert reader.restarts[0] >= 2
+    t1 = time.perf_counter()
+    it.close()
+    assert time.perf_counter() - t1 < 5.0
+
+
+def test_fault_points_add_no_steady_loop_compiles(tmp_path):
+    """MXNET_FAULTS armed (rate=0: plan installed, never fires) must
+    not perturb the fused step: the points are host-side — zero new
+    steady-loop compiles, bit-identical dispatch path."""
+    from compile_guard import assert_no_compiles
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 10).astype(np.float32)
+    y = rng.randint(0, 3, 64).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                              name="fc"), name="softmax")
+    m = mx.mod.Module(net, context=mx.cpu(0))
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    m.fit(it, num_epoch=1, optimizer_params=(("learning_rate", 0.05),))
+    kv = mx.kvstore.create("local")
+    kv.init(0, mx.nd.zeros((3,)))
+    faults.install("rate=0,kinds=error")
+    with assert_no_compiles("fused loop with fault plane armed"):
+        it.reset()
+        for batch in it:
+            m.forward_backward(batch)
+            m.update()
+            kv.push(0, mx.nd.ones((3,)))   # the kvstore.push point
+    faults.clear()
+
+
+def test_faults_in_unified_report():
+    faults.install("rate=0")
+    rep = mx.profiler.unified_report()
+    assert "faults" in rep
+    assert "fault plane" in mx.profiler.faults_report_str()
+
+
+def test_fork_mode_child_keeps_programmatic_plan():
+    """ISSUE 15 review: fork-mode children used to WIPE a
+    programmatically installed plan (reload_from_env cleared it when
+    MXNET_FAULTS was unset) — an attempts-targeted chaos schedule then
+    silently tested nothing.  The fork child must keep the inherited
+    plan with only the attempt index refreshed."""
+    faults.install(FaultPlan([Rule(points="fork.pt", kinds="error",
+                                   attempts=[1])], seed=5))
+
+    def target():
+        # jax-free target: plane + numpy only, safe to fork
+        try:
+            faults.point("fork.pt")
+        except InjectedFault:
+            return 0 if faults.attempt() == 1 else 9
+        return 1    # not injected: attempt 0 by schedule -> "crash"
+
+    sup = faults.Supervisor(target, max_restarts=3,
+                            backoff=Backoff(base_s=0.01, jitter=0.0),
+                            name="fork-plan")
+    assert sup.run() == 0                   # attempt 1 DID inject
+    assert sup.stats.report()["restarts"] == 1
+
+
+def test_supervisor_stop_interrupts_backoff_and_child():
+    """stop() from another thread cuts the backoff wait short and
+    kills the running child — run() returns without further
+    restarts."""
+    import threading
+    sup = _sup([sys.executable, "-c", "import time; time.sleep(60)"],
+               max_restarts=5,
+               backoff=Backoff(base_s=30.0, jitter=0.0))
+    threading.Timer(0.3, sup.stop).start()
+    t0 = time.perf_counter()
+    rc = sup.run()
+    assert time.perf_counter() - t0 < 20.0
+    assert rc == -9 and sup.stats.report()["restarts"] == 0

@@ -6,7 +6,7 @@ Contracts:
 
 * ``python tools/lint.py`` exits 0 on the real tree (every suppression
   carries a reason, the baseline holds only grandfathered findings) and
-  exits 1 on a synthetic-violation fixture for EACH of the six rules —
+  exits 1 on a synthetic-violation fixture for EACH of the rules —
   each fixture is a distilled reproduction of the CHANGES.md incident
   its rule descends from, and each rule stays silent on the fixed form.
 * The lock-order recorder builds the acquired-while-holding graph and
@@ -142,6 +142,39 @@ GOOD_FUTURE = """
             _set_result(req.future, out)
 """
 
+# PR 15: MXNET_FEED_MAX_RESTARTS allowed back-to-back instant reforks —
+# a crash-looping decode bug hot-spun the fork path; the distilled form
+# is any loop that both sleeps and swallows the failure
+BAD_RETRY = """
+    import time
+
+    def fetch_with_retry(url):
+        while True:
+            try:
+                return fetch(url)
+            except ConnectionError:
+                pass
+            time.sleep(0.5)
+"""
+GOOD_RETRY = """
+    from ..faults import Backoff, retry_call
+
+    def fetch_with_retry(url):
+        return retry_call(fetch, url, retries=5,
+                          backoff=Backoff(base_s=0.5),
+                          retry_on=(ConnectionError,))
+"""
+# a poll loop sleeps without swallowing anything: not a retry loop
+GOOD_POLL = """
+    import time
+
+    def wait_until(pred, stop):
+        while not pred():
+            if stop.is_set():
+                raise TimeoutError("stopped")
+            time.sleep(0.01)
+"""
+
 FIXTURES = [
     ("donated-aliasing", BAD_DONATED, GOOD_DONATED),
     ("raw-jit", BAD_JIT, GOOD_JIT),
@@ -149,7 +182,30 @@ FIXTURES = [
     ("raw-time", BAD_TIME, GOOD_TIME),
     ("unseeded-fork-rng", BAD_RNG, GOOD_RNG),
     ("raw-future-settle", BAD_FUTURE, GOOD_FUTURE),
+    ("raw-retry", BAD_RETRY, GOOD_RETRY),
 ]
+
+
+def test_raw_retry_ignores_poll_loops_and_faults_package():
+    """A sleep-only poll loop is fine; a fail-fast except (raise/break/
+    return) is fine; the faults package itself (which IMPLEMENTS the
+    primitive) is exempt by path."""
+    assert "raw-retry" not in _rules_hit(GOOD_POLL)
+    fail_fast = """
+        import time
+
+        def drain(q):
+            while True:
+                try:
+                    q.get_nowait()
+                except Exception:
+                    break
+                time.sleep(0.01)
+    """
+    assert "raw-retry" not in _rules_hit(fail_fast)
+    assert "raw-retry" in _rules_hit(BAD_RETRY)
+    assert "raw-retry" not in _rules_hit(
+        BAD_RETRY, rel="mxnet_tpu/faults/retry.py")
 
 
 @pytest.mark.parametrize("rule,bad,good",

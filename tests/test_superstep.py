@@ -339,12 +339,10 @@ from mxnet_tpu import checkpoint as ck
 
 store = sys.argv[1]
 
-def fault(point, step, path):
-    # SIGKILL mid-save at a superstep boundary past step 4
-    if point == "shards_written" and step >= 4:
-        os.kill(os.getpid(), signal.SIGKILL)
-
-ck.set_fault_hook(fault)
+# SIGKILL mid-save at a superstep boundary past step 4
+mx.faults.install(mx.faults.Rule(
+    points="checkpoint.commit@shards_written", kinds="crash",
+    when=lambda ctx: ctx["step"] >= 4))
 rng = np.random.RandomState(0)
 X = rng.randn(80, 6).astype(np.float32)
 y = rng.randint(0, 3, 80).astype(np.float32)

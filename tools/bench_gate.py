@@ -69,6 +69,18 @@ DEFAULT_LOWER_IS_BETTER = {
     "fused_step_ms", "unfused_step_ms",
     "embed_sparse_update_ms", "embed_naive_update_ms",
     "embed_sparse_step_ms", "embed_dense_step_ms",
+    "train_recovery_s", "serve_failover_dropped",
+    "chaos_overhead_frac", "faults_point_ns",
+}
+
+# Discrete "gated at 0" metrics: a zero best prior means ANY nonzero
+# newest value is a regression (dropped requests, steady-loop
+# compiles).  Continuous lower-is-better metrics stay out — a noise
+# floor that happens to clamp to 0.0 once must not condemn every
+# later run (chaos_overhead_frac does exactly that).
+ZERO_FLOOR = {
+    "serve_router_restart_drops", "serve_mux_steady_compiles",
+    "serve_failover_dropped",
 }
 
 
@@ -177,6 +189,17 @@ def gate(runs: List[Run], threshold: float, metrics=None,
             rows.append((key, new, None, None, None, "NEW"))
             continue
         if best == 0:
+            # a zero best prior has no percent scale — but for the
+            # discrete gated-at-0 class (ZERO_FLOOR), ANY nonzero value
+            # is a regression, recorded directly so no --threshold
+            # (however large) can wave it through
+            if key in ZERO_FLOOR and new > 0:
+                regressions.append(
+                    "%s: 0 -> %.6g (zero-floor metric: any nonzero "
+                    "value is a regression, threshold does not apply)"
+                    % (key, new))
+                rows.append((key, new, best, best_run, None, "REGRESS"))
+                continue
             delta = 0.0
         elif key in lower_is_better:
             delta = (best - new) / abs(best) * 100.0
