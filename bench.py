@@ -516,6 +516,20 @@ def main():
         line.update(faults_run(feed=_feed_watchdog))
     except Exception as e:
         sys.stderr.write("bench: faults leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
+    # LLM-serving leg (mxnet_tpu.serve.paged, ISSUE 16): mixed-length
+    # stream flood through the paged KV-cache engine, token-parity
+    # checked against the dense baseline; reports tokens/s, p99
+    # inter-token gap (chunked prefill bounds it), peak KV pool
+    # utilization, per-stream KV bytes vs dense (llm_kv_bytes_frac
+    # < 1 is the point of paging), and the speculative-decode speedup
+    # (llm_spec_speedup gated >= prior; llm_dropped_streams gated at 0)
+    try:
+        from bench_llm import run as llm_run
+        _feed_watchdog("llm")
+        line.update(llm_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: llm leg failed (%s)\n" % e)
     _wd.stop()
     print(json.dumps(line), flush=True)
 

@@ -27,6 +27,14 @@ raw-retry          a loop that both sleeps and swallows exceptions —
                    (PR 15: unbudgeted instant reforks let a
                    crash-looping decode bug hot-spin the reader fork
                    path; retries ride faults.Backoff/retry_call)
+decode-host-sync   ``np.asarray``/``.item()``/``float(x)`` inside a
+                   per-token decode loop (a For/While whose body calls
+                   a ``*step*``/``forward`` callee) — each one is a
+                   device→host sync serialized against the step stream,
+                   turning a per-STEP sync budget into per-token * N
+                   (PR 16: the paged engine's contract is ONE host sync
+                   per compiled step; hoist the pull out of the loop or
+                   batch it into the step's single asarray)
 
 Suppressions
 ------------
@@ -412,6 +420,59 @@ def _rule_raw_retry(ctx: _Ctx) -> Iterable[Finding]:
                 "deterministic jitter, traced waits)")
 
 
+_HOST_SYNC_DOTTED = {"np.asarray", "numpy.asarray", "np.array",
+                     "numpy.array", "jax.device_get"}
+
+
+def _rule_decode_host_sync(ctx: _Ctx) -> Iterable[Finding]:
+    """A device->host materialization inside a per-token decode loop: a
+    For/While whose body drives a ``*step*``/``forward`` callee is the
+    serving hot loop, and every ``np.asarray``/``.item()``/``float(x)``
+    in it blocks on the device stream once per token.  The paged decode
+    engine's budget is ONE host sync per compiled step (PR 16); extra
+    pulls belong outside the loop, or batched into that one asarray.
+    ``int(...)`` on an already-host numpy scalar is not flagged — the
+    sync already happened at the step's asarray."""
+    flagged: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.While, ast.For)):
+            continue
+        steppy = False
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                name = n.func.attr if isinstance(n.func, ast.Attribute) \
+                    else (n.func.id if isinstance(n.func, ast.Name)
+                          else None)
+                if name and ("step" in name or name == "forward"):
+                    steppy = True
+                    break
+        if not steppy:
+            continue
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call) or id(n) in flagged:
+                continue
+            d = _dotted(n.func)
+            what = None
+            if d in _HOST_SYNC_DOTTED:
+                what = d
+            elif isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "item" and not n.args:
+                what = ".item()"
+            elif isinstance(n.func, ast.Name) and n.func.id == "float" \
+                    and n.args and not isinstance(n.args[0], ast.Constant):
+                what = "float(...)"
+            if what is None:
+                continue
+            flagged.add(id(n))
+            yield ctx.finding(
+                "decode-host-sync", n,
+                "%s inside a per-token decode loop — a device->host "
+                "sync serialized against the step stream once per "
+                "token; hoist it out of the loop or batch it into the "
+                "step's single asarray (one host sync per compiled "
+                "step)" % what)
+
+
 _JNP_FRESH = {"zeros", "ones", "full", "zeros_like", "ones_like",
               "full_like", "arange", "eye", "copy", "empty"}
 
@@ -460,6 +521,7 @@ RULES = {
     "unseeded-fork-rng": _rule_unseeded_fork_rng,
     "raw-future-settle": _rule_raw_future_settle,
     "raw-retry": _rule_raw_retry,
+    "decode-host-sync": _rule_decode_host_sync,
 }
 
 

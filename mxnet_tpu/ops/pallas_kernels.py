@@ -6,8 +6,15 @@ users write their own through mxnet_tpu.rtc.
 
 flash_attention: blockwise attention with online softmax, MXU-shaped tiles
 (q blocks x k blocks of 128, fp32 accumulators in VMEM), causal masking via
-block skipping.  Falls back to the dense jnp reference off-TPU; tests run the
-kernel in interpret mode for numerical parity.
+block skipping; ragged lengths are padded up to the tile grid and masked.
+Falls back to the dense jnp reference off-TPU; tests run the kernel in
+interpret mode for numerical parity.
+
+paged_attention: attention through a paged KV cache (serve.paged) — the
+per-slot page table rides scalar prefetch and indexes the block pool
+directly from the BlockSpec index map, so each grid step streams one
+physical KV block; online softmax accumulates across the page walk in
+VMEM scratch.  Off-TPU the engine takes the dense gather reference.
 """
 from __future__ import annotations
 
@@ -27,8 +34,12 @@ except Exception:  # pragma: no cover
     pl = None
     HAS_PALLAS = False
 
-__all__ = ["flash_attention", "correlation", "fused_fc_epilogue",
-           "HAS_PALLAS"]
+__all__ = ["flash_attention", "paged_attention", "correlation",
+           "fused_fc_epilogue", "HAS_PALLAS"]
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
 
 
 def _attention_dense(q, k, v, causal):
@@ -43,7 +54,7 @@ def _attention_dense(q, k, v, causal):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal,
-                  scale, seq_len):
+                  scale, seq_len, true_len):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)            # (block_q, D)
     d = q.shape[-1]
@@ -58,11 +69,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal,
         kblk = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
         vblk = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+        k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32,
+                                                    (block_q, block_k), 1)
+        if true_len < seq_len:
+            # ragged tail: the sequence was padded up to the tile grid —
+            # padded KEYS are masked here, padded QUERY rows compute
+            # garbage the caller slices off
+            s = jnp.where(k_pos < true_len, s, -jnp.inf)
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32,
                                                         (block_q, block_k), 0)
-            k_pos = kb * block_k + lax.broadcasted_iota(jnp.int32,
-                                                       (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         blk_max = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, blk_max)
@@ -94,34 +110,183 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     """
     b, t, h, d = q.shape
     on_tpu = jax.default_backend() == "tpu"
-    if not HAS_PALLAS or (not on_tpu and not interpret) or t % block_k:
+    if not HAS_PALLAS or (not on_tpu and not interpret):
         from ..parallel.ring import attention_reference
         return attention_reference(q, k, v, causal=causal)
 
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    # ragged sequence lengths: clamp the tiles near T (8-aligned for the
+    # f32 sublane), pad T up to the tile grid, mask the padded keys in
+    # the kernel, slice the padded queries off the output — odd lengths
+    # stay on the kernel instead of silently falling back to dense
+    block_q = min(block_q, _round_up(t, 8))
+    block_k = min(block_k, _round_up(t, 8))
+    tp = _round_up(t, block_q * block_k // math.gcd(block_q, block_k))
+    if tp != t:
+        pad = [(0, 0), (0, tp - t), (0, 0), (0, 0)]
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
     scale = 1.0 / math.sqrt(d)
     # (B, T, H, D) -> (B*H, T, D)
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, tp, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, tp, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, tp, d)
 
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, causal=causal, scale=scale,
-                               seq_len=t)
+                               seq_len=tp, true_len=t)
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, t // block_q),
+        grid=(b * h, tp // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, tp, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, tp, d), lambda bh, i: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * h, tp, d), q.dtype),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, tp, d).transpose(0, 2, 1, 3)
+    return out[:, :t] if tp != t else out
+
+
+def _paged_attention_dense(q, k_pool, v_pool, pages, lengths, q_pos,
+                           causal: bool = True):
+    """Dense reference for paged attention — and the off-TPU execution
+    path of the paged engine (it is jit-traceable and bitwise-stable
+    across physical block layouts: the gather reorders pool rows into
+    logical order before one fixed-shape reduction, so dense-stripe and
+    scattered page tables produce identical floats).
+
+    q:               (S, C, H, D)  per-slot query window
+    k_pool / v_pool: (N, bt, H, D) block pools (N may include a
+                     sentinel scratch block at index >= the page-table
+                     domain; any out-of-range entry is clamped and its
+                     keys masked by ``lengths``)
+    pages:           (S, B)  int32 physical block id per logical block
+    lengths:         (S,)    int32 valid context tokens per slot
+    q_pos:           (S, C)  int32 absolute position of each query
+    -> (S, C, H, D)
+    """
+    n = k_pool.shape[0]
+    s_, c, h, d = q.shape
+    b = pages.shape[1]
+    bt = k_pool.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    safe = jnp.minimum(pages, n - 1)
+    kg = k_pool[safe].reshape(s_, b * bt, h, d).astype(jnp.float32)
+    vg = v_pool[safe].reshape(s_, b * bt, h, d).astype(jnp.float32)
+    s = jnp.einsum("schd,skhd->shck", q.astype(jnp.float32), kg) * scale
+    k_idx = jnp.arange(b * bt, dtype=jnp.int32)
+    mask = (k_idx[None, :] < lengths[:, None])[:, None, None, :]
+    if causal:
+        mask = mask & (k_idx[None, None, :]
+                       <= q_pos[:, :, None])[:, None, :, :]
+    s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isinf(m), 0.0, m)
+    p = jnp.where(jnp.isinf(s), 0.0, jnp.exp(s - m_safe))
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-20)
+    out = jnp.einsum("shck,skhd->schd", p / l, vg)
+    return out.astype(q.dtype)
+
+
+def _paged_kernel(pages_ref, len_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_s, l_s, acc_s, *, block_tokens, causal, scale):
+    """Online-softmax attention over one slot's page-table walk: grid
+    (S, B), one physical KV block per step (fetched straight from the
+    pool via the scalar-prefetched page table — no gather materializes
+    the context), f32 m/l/acc carries in VMEM scratch across the B
+    axis, output written on the last block."""
+    s_i, b_i = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(b_i == 0)
+    def _init():
+        m_s[...] = jnp.full(m_s.shape, -jnp.inf, jnp.float32)
+        l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    qh = q_ref[0].astype(jnp.float32).transpose(1, 0, 2)   # (H, C, D)
+    kh = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)   # (H, bt, D)
+    vh = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+    s = jnp.einsum("hcd,hkd->hck", qh, kh,
+                   preferred_element_type=jnp.float32) * scale
+    k_pos = b_i * block_tokens + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    mask = k_pos < len_ref[s_i]
+    if causal:
+        mask = mask & (k_pos <= pos_ref[s_i][None, :, None])
+    s = jnp.where(mask, s, -jnp.inf)
+    m_prev = m_s[...]
+    new_m = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    safe_m = jnp.where(jnp.isinf(new_m), 0.0, new_m)
+    p = jnp.where(jnp.isinf(s), 0.0, jnp.exp(s - safe_m[..., None]))
+    corr = jnp.where(jnp.isinf(m_prev), 0.0, jnp.exp(m_prev - safe_m))
+    m_s[...] = new_m
+    l_s[...] = l_s[...] * corr + jnp.sum(p, axis=-1)
+    acc_s[...] = acc_s[...] * corr[..., None] + jnp.einsum(
+        "hck,hkd->hcd", p, vh, preferred_element_type=jnp.float32)
+
+    @pl.when(b_i == pl.num_programs(1) - 1)
+    def _finish():
+        l = jnp.maximum(l_s[...], 1e-20)
+        o_ref[0] = (acc_s[...] / l[..., None]).transpose(1, 0, 2).astype(
+            o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, pages, lengths, q_pos=None,
+                    causal: bool = True, interpret: bool = False):
+    """Attention through a paged KV cache (see _paged_attention_dense
+    for the argument contract).  Q is a (S, C) token window per slot —
+    C = 1 for plain decode, the prefill chunk / speculative verify
+    width otherwise.
+
+    Uses the Pallas page-walk kernel on TPU (or with ``interpret=True``
+    anywhere): the page table rides scalar prefetch, so each grid step
+    DMAs exactly one physical block from the pool — context length
+    costs bandwidth, not a materialized gather.  Falls back to the
+    dense gather reference off-TPU, keeping CPU tier-1 numerics
+    identical to the engine's reference path.
+    """
+    s_, c, h, d = q.shape
+    if q_pos is None:
+        q_pos = lengths[:, None] - c + jnp.arange(c, dtype=jnp.int32)[None]
+    on_tpu = jax.default_backend() == "tpu"
+    if not HAS_PALLAS or (not on_tpu and not interpret):
+        return _paged_attention_dense(q, k_pool, v_pool, pages, lengths,
+                                      q_pos, causal=causal)
+    from jax.experimental.pallas import tpu as pltpu
+    n, bt = k_pool.shape[0], k_pool.shape[1]
+    b = pages.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    kernel = functools.partial(_paged_kernel, block_tokens=bt,
+                               causal=causal, scale=scale)
+
+    def _page(sl, bl, pages_ref, _len, _pos):
+        # sentinel / unassigned entries clamp to a real block — their
+        # keys sit past `lengths` and are masked in the kernel
+        return (jnp.minimum(pages_ref[sl, bl], n - 1), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s_, b),
+        in_specs=[
+            pl.BlockSpec((1, c, h, d), lambda sl, bl, *_: (sl, 0, 0, 0)),
+            pl.BlockSpec((1, bt, h, d), _page),
+            pl.BlockSpec((1, bt, h, d), _page),
+        ],
+        out_specs=pl.BlockSpec((1, c, h, d), lambda sl, bl, *_:
+                               (sl, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, c), jnp.float32),
+            pltpu.VMEM((h, c), jnp.float32),
+            pltpu.VMEM((h, c, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_, c, h, d), q.dtype),
+        interpret=interpret,
+    )(pages.astype(jnp.int32), lengths.astype(jnp.int32),
+      q_pos.astype(jnp.int32), q, k_pool, v_pool)
 
 
 def _fc_epilogue_kernel(x_ref, w_ref, b_ref, o_ref, *, act_type, out_scale):

@@ -43,6 +43,19 @@ Scale-out (the other half of "heavy traffic" — see docs/serve.md):
   and unhealthy replicas, and does **draining restarts** (weight swap
   or full rebuild) with zero dropped requests.
 
+LLM-class serving (paged/ — see docs/llm_serve.md): transformer decode
+outgrows the dense per-slot state rows, so
+:class:`~mxnet_tpu.serve.paged.PagedDecodeEngine` keeps the slot/queue
+discipline and pages the KV cache instead — a shared device block pool
+with per-slot page tables (:class:`~mxnet_tpu.serve.paged.KVBlockPool`),
+chunked prefill that co-batches with in-flight decode, and greedy
+speculative decode whose emitted streams stay token-identical to plain
+decode.  Paged engines expose the same duck-type surface (submit /
+close / device_bytes / stats), so they mux and route like any other
+engine — and ``device_bytes()`` counts the full KV pool plus the draft
+model, which is what keeps multiplexer admission honest for
+pool-resident engines.
+
 Quick start::
 
     eng = mx.serve.ServeEngine.from_checkpoint(
@@ -57,7 +70,9 @@ Knobs (constructor args override): ``MXNET_SERVE_MAX_BATCH``,
 ``MXNET_SERVE_DEADLINE_MS``, ``MXNET_SERVE_SLOTS``,
 ``MXNET_SERVE_DECODE_QUEUE``, ``MXNET_SERVE_MAX_TOKENS``,
 ``MXNET_SERVE_MUX_BYTES``, ``MXNET_SERVE_MUX_LIVE``,
-``MXNET_SERVE_ROUTER_UNHEALTHY`` — see docs/env_var.md.
+``MXNET_SERVE_ROUTER_UNHEALTHY``, ``MXNET_KVPOOL_BLOCKS``,
+``MXNET_KVPOOL_BLOCK_TOKENS``, ``MXNET_PAGED_CHUNK``,
+``MXNET_SPEC_DECODE_K``, ``MXNET_PAGED_PALLAS`` — see docs/env_var.md.
 """
 from __future__ import annotations
 
@@ -68,11 +83,15 @@ from .errors import (ServeClosedError, ServeDeadlineError, ServeError,
                      ServeOverloadError, ServeRequestError,
                      ServeUnavailableError)
 from .mux import ModelMultiplexer, MuxStats
+from .paged import (KVBlockPool, LMConfig, PagedDecodeEngine,
+                    init_lm_params)
 from .router import RouterStats, ServeRouter
-from .stats import DecodeStats, ServeStats
+from .stats import DecodeStats, PagedStats, ServeStats
 
-__all__ = ["ServeEngine", "DecodeEngine", "ModelMultiplexer",
-           "ServeRouter", "MicroBatcher", "ServeStats", "DecodeStats",
+__all__ = ["ServeEngine", "DecodeEngine", "PagedDecodeEngine",
+           "ModelMultiplexer", "ServeRouter", "MicroBatcher",
+           "KVBlockPool", "LMConfig", "init_lm_params",
+           "ServeStats", "DecodeStats", "PagedStats",
            "MuxStats", "RouterStats", "default_buckets",
            "ServeError", "ServeOverloadError", "ServeDeadlineError",
            "ServeRequestError", "ServeClosedError",

@@ -1,0 +1,40 @@
+"""mxnet_tpu.serve.paged — LLM-class serving on the continuous-batching
+substrate: paged KV-cache attention, chunked prefill, speculative decode.
+
+The dense :class:`~..decode.DecodeEngine` pads every slot's state to max
+context and replays whole prompts through C=1 steps.  This package keeps
+its scheduling discipline (slots, FIFO admission, decode thread owns all
+model state) and replaces the memory/compute story underneath:
+
+* :mod:`.pool` — :class:`.KVBlockPool`: device K/V lives in fixed-size
+  blocks addressed through per-slot page tables; memory scales with live
+  tokens, admission reserves worst-case blocks so nothing drops
+  mid-stream;
+* :mod:`.model` — a small transformer LM (:class:`.LMConfig`,
+  :func:`.init_lm_params`, :func:`.lm_forward`) parameterised over the
+  attention primitive, shared by target and draft;
+* :mod:`.engine` — :class:`.PagedDecodeEngine`: one compiled (S, C)
+  step program serves pure decode (C=1), chunk-width prefill, and
+  speculative verify; prompt chunks enter the batch as ordinary slot
+  work so a long prompt never stalls other streams' tokens;
+* :mod:`.spec` — :class:`.SpecDecoder`: greedy draft/verify speculative
+  decode, token-identical to pure target decode.
+
+The attention kernel itself (``paged_attention`` + its dense reference)
+lives in :mod:`mxnet_tpu.ops.pallas_kernels` next to flash attention.
+See ``docs/llm_serve.md``.
+"""
+from .engine import PagedDecodeEngine
+from .model import LMConfig, init_lm_params, lm_forward, param_bytes
+from .pool import KVBlockPool
+from .spec import SpecDecoder
+
+__all__ = [
+    "KVBlockPool",
+    "LMConfig",
+    "PagedDecodeEngine",
+    "SpecDecoder",
+    "init_lm_params",
+    "lm_forward",
+    "param_bytes",
+]

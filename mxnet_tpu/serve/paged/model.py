@@ -1,0 +1,113 @@
+"""A minimal pure-jax causal transformer LM for the paged serving path.
+
+The symbol-based DecodeEngine (decode.py) carries fixed-shape recurrent
+state rows; an LLM-class decoder instead carries a *growing* KV cache,
+which is exactly what the paged engine virtualizes.  This module is the
+model half of that contract: parameter init + a forward that delegates
+attention to the ENGINE through an ``attend`` callback, so the same
+forward serves dense layout, paged layout, and the Pallas kernel
+without the model knowing which is live.
+
+The model is deliberately tiny and dependency-free (embedding + learned
+positions, pre-RMSNorm blocks, GELU MLP, tied unembedding): the subject
+under test is the serving machinery, not modeling quality.  Tied
+embeddings double as the speculative-decode trick — a draft sharing the
+target's embedding table (``init_lm_params(..., embed=...)``) agrees
+with the target often enough to make verification worthwhile.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+import numpy as np
+
+__all__ = ["LMConfig", "init_lm_params", "lm_forward", "param_bytes"]
+
+
+class LMConfig(NamedTuple):
+    """Static model geometry (hashable: jit-safe as a closure)."""
+    vocab: int
+    dim: int
+    heads: int
+    layers: int
+    max_context: int
+    mlp_ratio: int = 4
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+def init_lm_params(cfg: LMConfig, seed: int = 0, scale: float = 0.02,
+                   embed=None) -> Dict[str, np.ndarray]:
+    """Deterministic float32 parameter blob.  ``embed`` (vocab, dim)
+    overrides the embedding table — pass the target's to build a
+    high-acceptance draft."""
+    if cfg.dim % cfg.heads:
+        raise ValueError("dim %d not divisible by heads %d"
+                         % (cfg.dim, cfg.heads))
+    rng = np.random.RandomState(seed)
+
+    def w(*shape):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    p = {"embed": (np.array(embed, np.float32) if embed is not None
+                   else w(cfg.vocab, cfg.dim)),
+         "pos": w(cfg.max_context, cfg.dim),
+         "lnf": np.ones((cfg.dim,), np.float32)}
+    if p["embed"].shape != (cfg.vocab, cfg.dim):
+        raise ValueError("embed shape %s != (vocab, dim) %s"
+                         % (p["embed"].shape, (cfg.vocab, cfg.dim)))
+    mlp = cfg.dim * cfg.mlp_ratio
+    for l in range(cfg.layers):
+        p["l%d.ln1" % l] = np.ones((cfg.dim,), np.float32)
+        p["l%d.ln2" % l] = np.ones((cfg.dim,), np.float32)
+        p["l%d.wq" % l] = w(cfg.dim, cfg.dim)
+        p["l%d.wk" % l] = w(cfg.dim, cfg.dim)
+        p["l%d.wv" % l] = w(cfg.dim, cfg.dim)
+        p["l%d.wo" % l] = w(cfg.dim, cfg.dim)
+        p["l%d.w1" % l] = w(cfg.dim, mlp)
+        p["l%d.w2" % l] = w(mlp, cfg.dim)
+    return p
+
+
+def param_bytes(params: Dict) -> int:
+    return sum(int(np.asarray(a).nbytes) if not hasattr(a, "nbytes")
+               else int(a.nbytes) for a in params.values())
+
+
+def _rmsnorm(x, g):
+    import jax.numpy as jnp
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * (g / jnp.sqrt(var + 1e-6))
+
+
+def lm_forward(params, tokens, positions, attend, cfg: LMConfig):
+    """One step over a (S, C) token window -> (S, C, vocab) logits.
+
+    ``attend(layer, q, k, v)`` receives the window's fresh projections
+    ((S, C, H, Dh) each) and returns the attention output over whatever
+    context the caller manages (KV append + paged gather live there).
+    ``positions`` (S, C) int32 index the learned position table; rows
+    past a slot's valid window may hold anything — the engine discards
+    those logits.
+    """
+    import jax
+    import jax.numpy as jnp
+    s, c = tokens.shape
+    pos = jnp.clip(positions, 0, cfg.max_context - 1)
+    x = params["embed"][tokens] + params["pos"][pos]
+    for l in range(cfg.layers):
+        h = _rmsnorm(x, params["l%d.ln1" % l])
+        q = (h @ params["l%d.wq" % l]).reshape(
+            s, c, cfg.heads, cfg.head_dim)
+        k = (h @ params["l%d.wk" % l]).reshape(
+            s, c, cfg.heads, cfg.head_dim)
+        v = (h @ params["l%d.wv" % l]).reshape(
+            s, c, cfg.heads, cfg.head_dim)
+        a = attend(l, q, k, v).reshape(s, c, cfg.dim)
+        x = x + a @ params["l%d.wo" % l]
+        h2 = _rmsnorm(x, params["l%d.ln2" % l])
+        x = x + jax.nn.gelu(h2 @ params["l%d.w1" % l]) @ params["l%d.w2" % l]
+    x = _rmsnorm(x, params["lnf"])
+    return x @ params["embed"].T

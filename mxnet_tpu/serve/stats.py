@@ -28,6 +28,14 @@ Per :class:`ServeStats` row:
 Per :class:`DecodeStats` row: slot occupancy (mean fraction of decode
 slots active per step), steps/tokens emitted, admission counters, and
 the same latency window measured submit → stream resolve.
+
+Per :class:`PagedStats` row (serve.paged): everything DecodeStats
+tracks, plus prefill-token throughput, speculative-decode
+proposed/accepted counters (acceptance rate is the headline spec-decode
+health metric), KV-block-pool gauges (used / reserved / total), an
+**inter-token latency** window (the p99 the chunked-prefill scheduler
+exists to bound), and ``dropped_streams`` — 0 by design under exact
+block reservation, reported so the bench gate can hold it at 0.
 """
 from __future__ import annotations
 
@@ -38,7 +46,7 @@ from typing import Dict, List, Optional
 
 from ..base import make_lock
 
-__all__ = ["ServeStats", "DecodeStats"]
+__all__ = ["ServeStats", "DecodeStats", "PagedStats"]
 
 # sliding latency window: big enough for stable p99, small enough that a
 # report reflects the recent regime rather than the whole process life
@@ -321,4 +329,110 @@ class DecodeStats:
                     r["latency_p50_ms"], r["latency_p95_ms"],
                     r["latency_p99_ms"], r["steps"], r["tokens_out"],
                     r["slot_occupancy"], self.num_slots,
+                    r["queue_depth"], r["queue_depth_max"]))
+
+
+class PagedStats(DecodeStats):
+    """DecodeStats plus the paged-serving axes (see module docstring).
+    Written from the submitter threads and the ONE paged-decode thread;
+    the terminal-outcome balance is inherited — dropped_streams is NOT
+    a terminal counter (a dropped stream also counts failed), it is the
+    zero-floor health gauge."""
+
+    def __init__(self, name: str, num_slots: int, pool_blocks: int):
+        super().__init__(name, num_slots)
+        self.pool_blocks = int(pool_blocks)
+        self._prefill_tokens = 0
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._dropped_streams = 0
+        self._blocks_used = 0
+        self._blocks_reserved = 0
+        self._blocks_used_peak = 0
+        self._it_ms = collections.deque(maxlen=LATENCY_WINDOW)
+
+    # -- recording ---------------------------------------------------------
+    def on_prefill(self, tokens: int) -> None:
+        with self._lock:
+            self._prefill_tokens += tokens
+
+    def on_spec_round(self, proposed: int, accepted: int) -> None:
+        with self._lock:
+            self._spec_rounds += 1
+            self._spec_proposed += proposed
+            self._spec_accepted += accepted
+
+    def on_dropped(self, n: int = 1) -> None:
+        with self._lock:
+            self._dropped_streams += n
+
+    def on_inter_token(self, gaps_ms) -> None:
+        with self._lock:
+            self._it_ms.extend(gaps_ms)
+
+    def set_pool(self, used: int, reserved: int) -> None:
+        with self._lock:
+            self._blocks_used = used
+            self._blocks_reserved = reserved
+            if used > self._blocks_used_peak:
+                self._blocks_used_peak = used
+
+    # -- reading -----------------------------------------------------------
+    def report(self) -> Dict:
+        out = super().report()
+        with self._lock:
+            it = sorted(self._it_ms)
+            out.update({
+                "kind": "paged",
+                "prefill_tokens": self._prefill_tokens,
+                "spec_rounds": self._spec_rounds,
+                "spec_proposed": self._spec_proposed,
+                "spec_accepted": self._spec_accepted,
+                "spec_accept_rate": round(
+                    self._spec_accepted / self._spec_proposed, 4)
+                if self._spec_proposed else 0.0,
+                "dropped_streams": self._dropped_streams,
+                "kv_blocks": self.pool_blocks,
+                "kv_blocks_used": self._blocks_used,
+                "kv_blocks_reserved": self._blocks_reserved,
+                "kv_utilization": round(
+                    self._blocks_used / self.pool_blocks, 4)
+                if self.pool_blocks else 0.0,
+                # peak survives stream completion: "how full did the
+                # pool get" outlives "is anything live right now"
+                "kv_utilization_peak": round(
+                    self._blocks_used_peak / self.pool_blocks, 4)
+                if self.pool_blocks else 0.0,
+            })
+        out["inter_token_p50_ms"] = round(_percentile(it, 50), 3)
+        out["inter_token_p99_ms"] = round(_percentile(it, 99), 3)
+        return out
+
+    def report_str(self) -> str:
+        r = self.report()
+        return ("paged decode engine %r\n"
+                "  streams: %d submitted / %d admitted / %d completed "
+                "(%d overloaded, %d expired, %d cancelled, %d failed, "
+                "%d dropped)\n"
+                "  latency ms: p50 %.2f  p99 %.2f; inter-token p50 %.2f "
+                "p99 %.2f\n"
+                "  steps: %d, %d tokens out, %d prefill tokens, slot "
+                "occupancy %.2f of %d\n"
+                "  spec decode: %d rounds, %d proposed, %d accepted "
+                "(rate %.2f)\n"
+                "  kv pool: %d used / %d reserved / %d blocks "
+                "(util %.2f)\n"
+                "  queue depth: %d now / %d high-water" % (
+                    self.name, r["submitted"], r["admitted"],
+                    r["completed"], r["overloaded"], r["expired"],
+                    r["cancelled"], r["failed"], r["dropped_streams"],
+                    r["latency_p50_ms"], r["latency_p99_ms"],
+                    r["inter_token_p50_ms"], r["inter_token_p99_ms"],
+                    r["steps"], r["tokens_out"], r["prefill_tokens"],
+                    r["slot_occupancy"], self.num_slots,
+                    r["spec_rounds"], r["spec_proposed"],
+                    r["spec_accepted"], r["spec_accept_rate"],
+                    r["kv_blocks_used"], r["kv_blocks_reserved"],
+                    r["kv_blocks"], r["kv_utilization"],
                     r["queue_depth"], r["queue_depth_max"]))

@@ -370,6 +370,73 @@ def test_env_knobs(model, monkeypatch):
         eng.close()
 
 
+def test_eos_exactly_at_max_new_tokens(model):
+    """EOS landing on the final allowed token must not double-count the
+    terminal outcome or truncate: the stream completes once, the eos
+    token is included, and the length is exactly max_new."""
+    params, prompts, _ = model
+    full = [int(t) for t in _ref_decode(params, prompts[0], 8)]
+    # pick the eos token whose FIRST occurrence is deepest in the
+    # stream, and cap max_new exactly there: eos fires ON the cap
+    k = max(i for i, t in enumerate(full) if t not in full[:i])
+    assert k >= 1, "degenerate stream, test proves nothing"
+    eos, max_new = full[k], k + 1
+    eng = _engine(params)
+    try:
+        got = eng.generate(prompts[0], timeout=60,
+                           max_new_tokens=max_new, eos_id=eos)
+        assert np.array_equal(got, np.asarray(full[:k + 1], np.int32))
+        assert len(got) == max_new and int(got[-1]) == eos
+        rep = eng.stats.report()
+        assert rep["completed"] == 1 and rep["failed"] == 0
+        assert rep["outstanding"] == 0
+    finally:
+        eng.close()
+
+
+def test_stream_joins_slot_freed_same_step(model):
+    """A queued request must be able to join a slot in the same loop
+    pass that freed it: with ONE slot and a deep backlog of 1-token
+    streams, every stream completes and matches the reference — no
+    admission stall between a finish and the next join."""
+    params, prompts, _ = model
+    eng = _engine(params, num_slots=1, queue_depth=32)
+    try:
+        futs = [eng.submit(prompts[i % len(prompts)], max_new_tokens=1)
+                for i in range(16)]
+        for i, f in enumerate(futs):
+            want = _ref_decode(params, prompts[i % len(prompts)], 1)
+            assert np.array_equal(f.result(timeout=120), want), i
+        rep = eng.stats.report()
+        assert rep["completed"] == 16 and rep["queue_depth"] == 0
+    finally:
+        eng.close()
+
+
+def test_closed_engine_beats_full_queue(model):
+    """Submit on a closed engine raises ServeClosedError even when the
+    queue is also full: the closed check must run FIRST, so clients see
+    'gone', not 'retry with backoff' against an engine that will never
+    drain (retrying a dead replica is the router's wedge case)."""
+    params, prompts, _ = model
+    eng = _engine(params, num_slots=1, queue_depth=2)
+    hog = eng.submit([1], max_new_tokens=200)
+    t0 = time.perf_counter()
+    while eng.pending_requests() > 0:       # wait for the hog to admit
+        assert time.perf_counter() - t0 < 10, "hog never admitted"
+        time.sleep(0.005)
+    queued = [eng.submit([2], max_new_tokens=4) for _ in range(2)]
+    assert eng.pending_requests() >= 2      # queue genuinely full
+    eng.close(drain=False)
+    t0 = time.perf_counter()
+    with pytest.raises(ServeClosedError):
+        eng.submit(prompts[0], max_new_tokens=4)
+    assert time.perf_counter() - t0 < 1.0, "closed fast-fail was slow"
+    for f in [hog] + queued:
+        with pytest.raises(ServeClosedError):
+            f.result(timeout=60)
+
+
 def test_injected_step_fault_kills_loop_but_not_liveness(model):
     """ISSUE 15 review: an injected decode.step error kills the decode
     loop — a dead engine must flip closed so later submits fast-fail

@@ -175,6 +175,31 @@ GOOD_POLL = """
             time.sleep(0.01)
 """
 
+# PR 16: the paged engine budgets ONE host sync per compiled step; an
+# asarray/.item()/float() inside the per-token loop serializes a
+# device->host pull against the step stream once per token
+BAD_HOST_SYNC = """
+    import numpy as np
+
+    def decode(engine, prompt, max_new):
+        out = []
+        for _ in range(max_new):
+            logits = engine.decode_step(prompt)
+            tok = int(np.asarray(logits).argmax())
+            score = float(logits.max())
+            out.append(tok)
+        return out
+"""
+GOOD_HOST_SYNC = """
+    import numpy as np
+
+    def decode(engine, prompt, max_new):
+        toks = []
+        for _ in range(max_new):
+            toks.append(engine.decode_step(prompt))
+        return [int(t) for t in np.asarray(toks)]
+"""
+
 FIXTURES = [
     ("donated-aliasing", BAD_DONATED, GOOD_DONATED),
     ("raw-jit", BAD_JIT, GOOD_JIT),
@@ -183,7 +208,33 @@ FIXTURES = [
     ("unseeded-fork-rng", BAD_RNG, GOOD_RNG),
     ("raw-future-settle", BAD_FUTURE, GOOD_FUTURE),
     ("raw-retry", BAD_RETRY, GOOD_RETRY),
+    ("decode-host-sync", BAD_HOST_SYNC, GOOD_HOST_SYNC),
 ]
+
+
+def test_decode_host_sync_scope():
+    """Only loops that drive a *step*/forward callee count as decode
+    loops; .item() is a sync too; a host pull in a non-steppy loop
+    (e.g. metric accumulation over host arrays) is not flagged."""
+    item_sync = """
+        def run(engine, n):
+            total = 0
+            for _ in range(n):
+                out = engine.forward(x)
+                total += out.loss.item()
+            return total
+    """
+    assert "decode-host-sync" in _rules_hit(item_sync)
+    not_steppy = """
+        import numpy as np
+
+        def summarize(rows):
+            out = []
+            for r in rows:
+                out.append(np.asarray(r).mean())
+            return out
+    """
+    assert "decode-host-sync" not in _rules_hit(not_steppy)
 
 
 def test_raw_retry_ignores_poll_loops_and_faults_package():

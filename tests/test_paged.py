@@ -1,0 +1,455 @@
+"""mxnet_tpu.serve.paged: paged-KV LLM serving (tier-1, CPU).
+
+ISSUE 16 acceptance: the paged engine emits BITWISE-identical token
+streams to the dense-stripe baseline under a mixed-length flood; the
+speculative path is token-identical to pure target decode (good draft
+and bad draft); pool exhaustion queues instead of dropping
+(dropped_streams stays 0 by design); chunked prefill co-batches with
+in-flight decode; the steady loop never enters the XLA compiler; and
+engine.device_bytes() counts the full KV pool + draft model, which is
+what keeps ModelMultiplexer admission honest for pool-resident engines.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+
+import mxnet_tpu as mx
+from mxnet_tpu.serve import (KVBlockPool, LMConfig, PagedDecodeEngine,
+                             ServeClosedError, ServeError,
+                             ServeOverloadError, ServeRequestError,
+                             init_lm_params)
+from mxnet_tpu.serve.paged.model import param_bytes
+
+CFG = LMConfig(vocab=64, dim=32, heads=4, layers=2, max_context=96)
+
+
+def _prompts(n, seed=7, lens=(3, 17, 33, 5, 26, 48, 1, 12)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, CFG.vocab, size=lens[i % len(lens)])
+            .astype(np.int64) for i in range(n)]
+
+
+def _engine(params, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("block_tokens", 8)
+    kw.setdefault("chunk_tokens", 16)
+    kw.setdefault("name", "test-paged")
+    return PagedDecodeEngine(params, CFG, **kw)
+
+
+def _run_all(eng, prompts, max_new=24):
+    futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    return [f.result(timeout=120) for f in futs]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_lm_params(CFG, seed=0)
+
+
+@pytest.fixture(scope="module")
+def dense_streams(params):
+    """The dense-stripe baseline: every slot statically owns a full
+    max-context stripe, same step program — the parity ground truth."""
+    eng = _engine(params, paged=False, name="dense-base")
+    try:
+        return _run_all(eng, _prompts(8))
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# pool allocator
+
+def test_pool_reserve_ensure_release_invariants():
+    pool = KVBlockPool(num_slots=2, max_blocks_per_slot=4, num_blocks=6,
+                       block_tokens=8)
+    assert pool.blocks_for(1) == 1 and pool.blocks_for(8) == 1
+    assert pool.blocks_for(9) == 2 and pool.blocks_for(32) == 4
+    assert pool.available_blocks() == 6 and pool.sentinel == 6
+    assert np.all(pool.page_table() == pool.sentinel)
+    # exact reservation: blocks leave the admission budget immediately
+    assert pool.reserve(0, 4)
+    assert pool.available_blocks() == 2
+    assert not pool.reserve(1, 3)       # would oversubscribe: refused
+    assert pool.available_blocks() == 2
+    assert pool.reserve(1, 2)
+    # lazy assignment: physical pages appear as tokens land
+    assert pool.used_blocks() == 0
+    pool.ensure(0, 9)                   # 2 blocks
+    assert pool.used_blocks() == 2
+    assert sorted(set(int(b) for b in pool.page_table()[0, :2])) \
+        == sorted(set(int(b) for b in pool.page_table()[0, :2]))
+    assert all(0 <= int(b) < 6 for b in pool.page_table()[0, :2])
+    pool.ensure(0, 9)                   # idempotent
+    assert pool.used_blocks() == 2
+    with pytest.raises(ServeError):     # beyond the reservation: a bug
+        pool.ensure(1, 32)
+    # release returns pages AND the unused reservation tail
+    pool.release(0)
+    assert pool.used_blocks() == 0 and pool.available_blocks() == 4
+    assert np.all(pool.page_table()[0] == pool.sentinel)
+    pool.release(1)
+    assert pool.available_blocks() == 6
+
+
+def test_pool_geometry_validation_and_dense_mode():
+    with pytest.raises(ServeError):
+        KVBlockPool(2, 4, num_blocks=3, block_tokens=8)   # < one stream
+    with pytest.raises(ServeError):
+        KVBlockPool(2, 4, num_blocks=6, block_tokens=8, dense=True)
+    with pytest.raises(ServeError):
+        KVBlockPool(2, 4, block_tokens=0)
+    dense = KVBlockPool(2, 4, block_tokens=8, dense=True)
+    # static stripes, reservations always fit, release keeps the stripe
+    assert dense.num_blocks == 8
+    assert np.array_equal(dense.page_table()[1], np.arange(4, 8))
+    assert dense.available_blocks() == 8
+    assert dense.reserve(0, 4) and dense.reserve(0, 4)
+    dense.release(0)
+    assert np.array_equal(dense.page_table()[0], np.arange(0, 4))
+
+
+def test_pool_views_and_device_bytes():
+    pool = KVBlockPool(2, 4, num_blocks=6, block_tokens=8)
+    pool.add_view("target", layers=2, heads=4, head_dim=8)
+    with pytest.raises(ServeError):
+        pool.add_view("target", 2, 4, 8)
+    k, v = pool.view("target")
+    # +1 sentinel scratch row, 4 bytes/float, K and V
+    want = 2 * (2 * 7 * 8 * 4 * 8 * 4)
+    assert pool.device_bytes() == want
+    assert k.shape == (2, 7, 8, 4, 8)
+
+
+def test_env_pool_geometry(monkeypatch):
+    monkeypatch.setenv("MXNET_KVPOOL_BLOCK_TOKENS", "4")
+    monkeypatch.setenv("MXNET_KVPOOL_BLOCKS", "13")
+    pool = KVBlockPool(2, 4)
+    assert pool.block_tokens == 4 and pool.num_blocks == 13
+
+
+# ---------------------------------------------------------------------------
+# engine parity
+
+def test_paged_matches_dense_mixed_length_flood(params, dense_streams):
+    """8 mixed-length streams through 4 slots with a pool SMALLER than
+    dense-equivalent (admission must queue on blocks): every token
+    stream is bitwise identical to the dense-stripe baseline."""
+    eng = _engine(params, num_blocks=30, name="paged-parity")
+    try:
+        got = _run_all(eng, _prompts(8))
+        for i, (a, b) in enumerate(zip(dense_streams, got)):
+            assert a.dtype == b.dtype == np.int32
+            assert np.array_equal(a, b), (i, a, b)
+        rep = eng.stats.report()
+        assert rep["kind"] == "paged"
+        assert rep["completed"] == 8 and rep["dropped_streams"] == 0
+        assert rep["prefill_tokens"] == sum(
+            len(p) for p in _prompts(8))
+        # mixed-length flood through half-size pool must have paged
+        assert rep["kv_blocks"] == 30
+    finally:
+        eng.close()
+
+
+@pytest.mark.parametrize("draft_seed", [0, 99])
+def test_spec_decode_token_identical(params, dense_streams, draft_seed):
+    """Speculative decode emits the SAME stream as plain decode whether
+    the draft is perfect (seed 0 = the target itself: near-1.0
+    acceptance) or unrelated (seed 99: low acceptance) — acceptance
+    moves throughput, never tokens."""
+    draft = params if draft_seed == 0 else init_lm_params(CFG, seed=99)
+    eng = _engine(params, num_blocks=40, draft_params=draft,
+                  draft_cfg=CFG, spec_k=4,
+                  name="spec-%d" % draft_seed)
+    try:
+        got = _run_all(eng, _prompts(8))
+        for a, b in zip(dense_streams, got):
+            assert np.array_equal(a, b)
+        rep = eng.stats.report()
+        assert rep["spec_rounds"] > 0
+        assert rep["spec_proposed"] >= rep["spec_accepted"] >= 0
+        if draft_seed == 0:
+            assert rep["spec_accept_rate"] > 0.9, rep
+    finally:
+        eng.close()
+
+
+def test_chunked_prefill_counters_and_long_prompt(params):
+    """A near-max-context prompt prefills in chunk_tokens pieces while a
+    short stream keeps decoding — both finish, prefill accounting adds
+    up, and the long stream's answer matches the dense baseline."""
+    long_p = _prompts(1, seed=11, lens=(72,))[0]
+    short_p = _prompts(1, seed=12, lens=(2,))[0]
+    base = _engine(params, paged=False, name="chunk-base")
+    try:
+        want_long, want_short = _run_all(base, [long_p, short_p],
+                                         max_new=12)
+    finally:
+        base.close()
+    eng = _engine(params, num_blocks=24, chunk_tokens=16,
+                  name="chunk-paged")
+    try:
+        got_long, got_short = _run_all(eng, [long_p, short_p],
+                                       max_new=12)
+        assert np.array_equal(got_long, want_long)
+        assert np.array_equal(got_short, want_short)
+        rep = eng.stats.report()
+        assert rep["prefill_tokens"] == len(long_p) + len(short_p)
+        assert rep["inter_token_p99_ms"] > 0
+    finally:
+        eng.close()
+
+
+def test_pool_exhaustion_queues_never_drops(params):
+    """A pool that fits ~2 worst-case streams against 4 slots and 12
+    queued streams: admission waits on blocks (FIFO, no head-of-line
+    skipping), every stream completes, dropped_streams is 0 BY DESIGN."""
+    prompts = _prompts(12)
+    dense = _engine(params, paged=False, queue_depth=16,
+                    name="exhaust-base")
+    try:
+        want = _run_all(dense, prompts, max_new=16)
+    finally:
+        dense.close()
+    eng = _engine(params, num_blocks=14, queue_depth=16,
+                  name="exhaust-paged")
+    try:
+        got = _run_all(eng, prompts, max_new=16)
+        for a, b in zip(want, got):
+            assert np.array_equal(a, b)
+        rep = eng.stats.report()
+        assert rep["completed"] == 12
+        assert rep["dropped_streams"] == 0 and rep["failed"] == 0
+        assert rep["kv_utilization"] <= 1.0
+    finally:
+        eng.close()
+
+
+def test_eos_at_max_new_and_submit_validation(params):
+    eng = _engine(params, num_blocks=30)
+    try:
+        with pytest.raises(ServeRequestError):
+            eng.submit([])
+        with pytest.raises(ServeRequestError):
+            eng.submit([[1, 2]])
+        with pytest.raises(ServeRequestError):
+            eng.submit([0.5])
+        with pytest.raises(ServeRequestError):
+            eng.submit([CFG.vocab])             # out of vocab
+        with pytest.raises(ServeRequestError):
+            eng.submit([1], max_new_tokens=0)
+        with pytest.raises(ServeRequestError):  # can't fit max_context
+            eng.submit(np.ones(60, np.int64), max_new_tokens=60)
+        p = _prompts(1)[0]
+        full = [int(t) for t in eng.generate(p, timeout=120,
+                                             max_new_tokens=8)]
+        k = max(i for i, t in enumerate(full) if t not in full[:i])
+        got = eng.generate(p, timeout=120, max_new_tokens=k + 1,
+                           eos_id=full[k])
+        assert np.array_equal(got, np.asarray(full[:k + 1], np.int32))
+        rep = eng.stats.report()
+        assert rep["outstanding"] == 0 and rep["failed"] == 0
+    finally:
+        eng.close()
+
+
+def test_overload_and_closed_fast_fail(params):
+    eng = _engine(params, num_slots=1, num_blocks=13, queue_depth=2,
+                  name="overload-paged")
+    hog = eng.submit([1], max_new_tokens=64)
+    t0 = time.perf_counter()
+    while eng.pending_requests() > 0:
+        assert time.perf_counter() - t0 < 10, "hog never admitted"
+        time.sleep(0.005)
+    queued = [eng.submit([2], max_new_tokens=64) for _ in range(2)]
+    with pytest.raises(ServeOverloadError):
+        eng.submit([3], max_new_tokens=4)
+    assert eng.stats.report()["overloaded"] == 1
+    for f in [hog] + queued:
+        f.result(timeout=120)
+    eng.close()
+    with pytest.raises(ServeClosedError):       # closed beats full
+        eng.submit([1], max_new_tokens=4)
+    eng.close()                                 # idempotent
+
+
+def test_close_no_drain_fails_streams_and_releases_pool(params):
+    eng = _engine(params, num_slots=2, num_blocks=26,
+                  name="nodrain-paged")
+    futs = [eng.submit(p, max_new_tokens=32) for p in _prompts(4)]
+    eng.close(drain=False)
+    failed = 0
+    for f in futs:
+        try:
+            f.result(timeout=60)
+        except ServeClosedError:
+            failed += 1
+    assert failed >= 1
+    assert eng.pool.used_blocks() == 0
+    assert eng.pool.available_blocks() == 26
+
+
+def test_no_compiles_in_steady_paged_loop(params):
+    """Warmup builds both widths (C=1 and C=chunk) for target AND
+    draft; the serving loop — admission, prefill chunks, spec rounds,
+    finishes — must never enter the XLA compiler."""
+    from compile_guard import assert_no_compiles
+    prompts = _prompts(8)
+    eng = _engine(params, num_blocks=40, draft_params=params,
+                  draft_cfg=CFG, spec_k=3, name="warm-paged")
+    try:
+        eng.generate(prompts[0], timeout=120, max_new_tokens=4)
+        with assert_no_compiles("paged decode loop"):
+            _run_all(eng, prompts, max_new=12)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# accounting + integration
+
+def test_device_bytes_counts_pool_and_draft(params):
+    """The mux admission currency must include the KV pool (dominant
+    for long contexts) and the draft model — a params-only number would
+    let pool-resident engines silently blow MXNET_SERVE_MUX_BYTES."""
+    draft = init_lm_params(CFG, seed=1)
+    eng = _engine(params, num_blocks=30, draft_params=draft,
+                  draft_cfg=CFG, spec_k=2, name="bytes-paged")
+    try:
+        pb = param_bytes(eng._params)
+        assert eng.device_bytes() == (pb + eng.pool.device_bytes()
+                                      + param_bytes(eng._spec.params))
+        assert eng.pool.device_bytes() > 0
+        # two views (target + draft) over 30+1 blocks
+        assert eng.pool.device_bytes() == \
+            2 * 2 * (CFG.layers * 31 * 8 * CFG.heads * CFG.head_dim * 4)
+    finally:
+        eng.close()
+
+
+def test_paged_memory_per_stream_below_dense(params):
+    """The headline: serving the same stream load, the paged pool holds
+    fewer device bytes than dense-equivalent stripes."""
+    dense = _engine(params, paged=False, name="mem-dense")
+    paged = _engine(params, num_blocks=30, name="mem-paged")
+    try:
+        assert paged.pool.device_bytes() < dense.pool.device_bytes()
+        d = _run_all(dense, _prompts(8))
+        p = _run_all(paged, _prompts(8))
+        for a, b in zip(d, p):
+            assert np.array_equal(a, b)
+    finally:
+        dense.close()
+        paged.close()
+
+
+def test_mux_evicts_pool_resident_paged_engine(params):
+    """ModelMultiplexer admission over paged engines: measured bytes
+    (device_bytes = params + FULL pool + draft) drive eviction; an idle
+    paged engine is evicted to admit the next one, and comes back warm
+    on demand."""
+    from mxnet_tpu.serve import ModelMultiplexer
+
+    def mk(name):
+        return lambda: _engine(params, num_blocks=16, num_slots=2,
+                               name=name)
+
+    one = _engine(params, num_blocks=16, num_slots=2, name="probe")
+    cost = one.device_bytes()
+    one.close()
+    mux = ModelMultiplexer(budget_bytes=int(cost * 1.5), max_live=0,
+                           name="paged-mux")
+    try:
+        mux.add_model("a", mk("mux-a"), bytes_hint=cost)
+        mux.add_model("b", mk("mux-b"), bytes_hint=cost)
+        pa = _prompts(1)[0]
+        got_a = mux.submit("a", pa, max_new_tokens=6).result(timeout=120)
+        assert mux.live_models() == ["a"]
+        # b does not fit beside a: a (idle) must be evicted, not b refused
+        got_b = mux.submit("b", pa, max_new_tokens=6).result(timeout=120)
+        assert mux.live_models() == ["b"]
+        assert np.array_equal(got_a, got_b)     # same params, same stream
+        rep = mux.stats.report()
+        assert rep["evictions"] == 1 and rep["rejected"] == 0
+        # measured footprint replaced the hint and includes the pool
+        with mux._lock:
+            e = mux._entries["b"]
+            assert e.measured_bytes == cost
+        # a comes back via rebuild and still serves correctly
+        got_a2 = mux.submit("a", pa, max_new_tokens=6).result(timeout=120)
+        assert np.array_equal(got_a2, got_a)
+        assert mux.stats.report()["evictions"] == 2
+    finally:
+        mux.close()
+
+
+def test_profiler_serve_report_paged_row(params):
+    eng = _engine(params, num_blocks=30, draft_params=params,
+                  draft_cfg=CFG, spec_k=2, name="report-paged")
+    try:
+        _run_all(eng, _prompts(4), max_new=8)
+        rep = mx.profiler.serve_report()
+        keys = [k for k in rep if k.startswith("report-paged#")]
+        assert keys, "paged engine not registered with mx.profiler"
+        r = rep[keys[-1]]
+        assert r["kind"] == "paged" and r["completed"] == 4
+        assert r["spec_rounds"] > 0 and r["prefill_tokens"] > 0
+        assert 0 <= r["kv_utilization"] <= 1
+        assert r["inter_token_p99_ms"] >= r["inter_token_p50_ms"] >= 0
+        s = mx.profiler.serve_report_str()
+        assert "report-paged" in s and "kv" in s
+    finally:
+        eng.close()
+
+
+def test_env_knobs(params, monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_SLOTS", "2")
+    monkeypatch.setenv("MXNET_SERVE_MAX_TOKENS", "3")
+    monkeypatch.setenv("MXNET_PAGED_CHUNK", "8")
+    monkeypatch.setenv("MXNET_KVPOOL_BLOCK_TOKENS", "4")
+    monkeypatch.setenv("MXNET_SPEC_DECODE_K", "2")
+    eng = PagedDecodeEngine(params, CFG, draft_params=params,
+                            draft_cfg=CFG, name="env-paged")
+    try:
+        assert eng.num_slots == 2 and eng.max_new_tokens == 3
+        assert eng.chunk == 8 and eng.spec_k == 2
+        assert eng.pool.block_tokens == 4
+        got = eng.generate([1], timeout=120)
+        assert len(got) == 3
+    finally:
+        eng.close()
+
+
+def test_injected_step_fault_closes_engine(params):
+    """The decode.step fault seam exists on the paged loop too: an
+    injected paged.step error kills the loop, the engine flips closed,
+    and later submits fast-fail instead of hanging."""
+    from mxnet_tpu import faults
+    eng = _engine(params, num_blocks=30, name="fault-paged")
+    try:
+        eng.generate([1], timeout=120, max_new_tokens=2)
+        faults.install(faults.Rule(points="paged.step", kinds="error",
+                                   max_faults=1))
+        doomed = eng.submit([2], max_new_tokens=4)
+        with pytest.raises(ServeError):
+            doomed.result(timeout=60)
+        faults.clear()
+        deadline = time.perf_counter() + 10
+        while time.perf_counter() < deadline:
+            try:
+                eng.submit([3], max_new_tokens=2)
+            except ServeClosedError:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("dead paged engine still accepting submits")
+    finally:
+        faults.clear()
+        eng.close(drain=False)

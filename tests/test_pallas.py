@@ -27,12 +27,142 @@ def test_flash_attention_matches_dense(causal):
         np.abs(np.asarray(out) - np.asarray(ref)).max()
 
 
+@pytest.mark.parametrize("t", [1, 7, 33, 100, 129])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_kernel_odd_len(t, causal):
+    """The KERNEL (not the dense fallback) at lengths that don't divide
+    the k-block: the tail is padded to the block grid and the padded
+    keys masked in-kernel, so ragged T runs the same tiled program
+    (historically ragged T silently fell back to dense)."""
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, t, 2, 16).astype(np.float32))
+    out = flash_attention(q, q, q, causal=causal, interpret=True)
+    ref = attention_reference(q, q, q, causal=causal)
+    assert out.shape == ref.shape
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=2e-5), \
+        np.abs(np.asarray(out) - np.asarray(ref)).max()
+
+
 def test_flash_attention_fallback_odd_len():
+    # off-TPU without interpret the dense fallback still serves ragged T
     rng = np.random.RandomState(0)
     q = rng.randn(1, 33, 2, 16).astype(np.float32)
     out = flash_attention(jnp.asarray(q), jnp.asarray(q), jnp.asarray(q))
     ref = attention_reference(jnp.asarray(q), jnp.asarray(q), jnp.asarray(q))
     assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def _paged_setup(seed=0, s=3, blocks=16, bt=8, h=2, d=16, c=4,
+                 scatter=True):
+    """A ragged paged-KV scenario: per-slot lengths that straddle block
+    boundaries, physical blocks assigned out of order (scatter=True)
+    or as contiguous stripes (the dense layout)."""
+    rng = np.random.RandomState(seed)
+    lengths = np.array([5, 19, 12][:s], np.int32)
+    max_b = 4
+    k_pool = rng.randn(blocks + 1, bt, h, d).astype(np.float32)
+    v_pool = rng.randn(blocks + 1, bt, h, d).astype(np.float32)
+    pages = np.full((s, max_b), blocks, np.int32)   # sentinel
+    order = rng.permutation(blocks) if scatter else np.arange(blocks)
+    nxt = 0
+    for i in range(s):
+        for b in range(-(-int(lengths[i]) // bt)):
+            pages[i, b] = order[nxt]
+            nxt += 1
+    q = rng.randn(s, c, h, d).astype(np.float32)
+    q_pos = lengths[:, None] - c + np.arange(c, dtype=np.int32)[None, :]
+    return (jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(pages), jnp.asarray(lengths),
+            jnp.asarray(q_pos))
+
+
+def _paged_numpy_ref(q, k_pool, v_pool, pages, lengths, q_pos, causal):
+    """Independent numpy reference: gather each slot's live tokens in
+    logical order, plain softmax attention."""
+    q, k_pool, v_pool, pages, lengths, q_pos = map(
+        np.asarray, (q, k_pool, v_pool, pages, lengths, q_pos))
+    s, c, h, d = q.shape
+    bt = k_pool.shape[1]
+    out = np.zeros_like(q)
+    for i in range(s):
+        n = int(lengths[i])
+        ks = np.concatenate([k_pool[pages[i, b]]
+                             for b in range(-(-n // bt))])[:n]
+        vs = np.concatenate([v_pool[pages[i, b]]
+                             for b in range(-(-n // bt))])[:n]
+        for hh in range(h):
+            sc = q[i, :, hh] @ ks[:, hh].T / np.sqrt(d)
+            if causal:
+                mask = np.arange(n)[None, :] > q_pos[i][:, None]
+                sc = np.where(mask, -np.inf, sc)
+            sc = sc - sc.max(axis=-1, keepdims=True)
+            p = np.exp(sc)
+            p /= p.sum(axis=-1, keepdims=True)
+            out[i, :, hh] = p @ vs[:, hh]
+    return out
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("scatter", [False, True])
+def test_paged_attention_kernel_matches_reference(causal, scatter):
+    """The Pallas page-walk kernel (interpret mode) against an
+    independent numpy reference, across causal/non-causal and both
+    contiguous-stripe and scattered page tables."""
+    from mxnet_tpu.ops.pallas_kernels import paged_attention
+    args = _paged_setup(scatter=scatter)
+    got = paged_attention(*args, causal=causal, interpret=True)
+    want = _paged_numpy_ref(*args, causal=causal)
+    assert np.allclose(np.asarray(got), want, atol=3e-5), \
+        np.abs(np.asarray(got) - want).max()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_paged_attention_dense_fallback_matches_reference(causal):
+    """The off-TPU dense gather path (what the engine runs on CPU)
+    against the same numpy reference — and against the kernel, pinning
+    the three-way agreement the engine's parity story relies on."""
+    from mxnet_tpu.ops.pallas_kernels import (_paged_attention_dense,
+                                              paged_attention)
+    args = _paged_setup(scatter=True, seed=3)
+    q, k_pool, v_pool, pages, lengths, q_pos = args
+    got = _paged_attention_dense(q, k_pool, v_pool, pages, lengths,
+                                 q_pos, causal=causal)
+    want = _paged_numpy_ref(*args, causal=causal)
+    assert np.allclose(np.asarray(got), want, atol=3e-5), \
+        np.abs(np.asarray(got) - want).max()
+    kern = paged_attention(*args, causal=causal, interpret=True)
+    assert np.allclose(np.asarray(got), np.asarray(kern), atol=3e-5)
+
+
+def test_paged_attention_scatter_layout_invariant():
+    """The SAME logical K/V laid out contiguously vs scattered must
+    produce identical attention — the property that makes dense-stripe
+    and paged engines bitwise-comparable."""
+    from mxnet_tpu.ops.pallas_kernels import _paged_attention_dense
+    rng = np.random.RandomState(1)
+    blocks, bt, h, d, s, c = 12, 8, 2, 16, 2, 3
+    lengths = np.array([21, 9], np.int32)
+    rows = [rng.randn(bt, h, d).astype(np.float32)
+            for _ in range(blocks)]
+    q = jnp.asarray(rng.randn(s, c, h, d).astype(np.float32))
+    q_pos = jnp.asarray(lengths[:, None]
+                        - c + np.arange(c, dtype=np.int32)[None, :])
+    outs = []
+    for order in (np.arange(blocks), rng.permutation(blocks)):
+        k_pool = np.zeros((blocks + 1, bt, h, d), np.float32)
+        pages = np.full((s, 4), blocks, np.int32)
+        nxt = 0
+        for i in range(s):
+            for b in range(-(-int(lengths[i]) // bt)):
+                k_pool[order[nxt]] = rows[sum(
+                    -(-int(lengths[j]) // bt) for j in range(i)) + b]
+                pages[i, b] = order[nxt]
+                nxt += 1
+        outs.append(np.asarray(_paged_attention_dense(
+            q, jnp.asarray(k_pool), jnp.asarray(k_pool),
+            jnp.asarray(pages), jnp.asarray(lengths), q_pos,
+            causal=True)))
+    assert np.array_equal(outs[0], outs[1])
 
 
 def test_rtc_pallas_kernel():

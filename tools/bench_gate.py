@@ -71,6 +71,12 @@ DEFAULT_LOWER_IS_BETTER = {
     "embed_sparse_step_ms", "embed_dense_step_ms",
     "train_recovery_s", "serve_failover_dropped",
     "chaos_overhead_frac", "faults_point_ns",
+    # ISSUE 16 LLM-serving leg: inter-token latency (chunked prefill's
+    # whole point is bounding it), per-stream KV memory and its paged/
+    # dense ratio, and mid-generation stream drops (also zero-floored)
+    "llm_p99_inter_token_ms", "llm_kv_bytes_per_stream",
+    "llm_kv_bytes_per_stream_dense", "llm_kv_bytes_frac",
+    "llm_dropped_streams",
 }
 
 # Discrete "gated at 0" metrics: a zero best prior means ANY nonzero
@@ -80,7 +86,7 @@ DEFAULT_LOWER_IS_BETTER = {
 # later run (chaos_overhead_frac does exactly that).
 ZERO_FLOOR = {
     "serve_router_restart_drops", "serve_mux_steady_compiles",
-    "serve_failover_dropped",
+    "serve_failover_dropped", "llm_dropped_streams",
 }
 
 
