@@ -530,6 +530,20 @@ def main():
         line.update(llm_run(feed=_feed_watchdog))
     except Exception as e:
         sys.stderr.write("bench: llm leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
+    # online-loop leg (mxnet_tpu.online, ISSUE 17): serve -> capture ->
+    # fine-tune -> gated zero-drop promotion, end to end.  Reports
+    # capture-to-live freshness seconds (plus a chaos re-measure with an
+    # absorbable fault plan armed), requests dropped through the
+    # promotion (online_promote_dropped gated at 0) and the capture
+    # seam's cost on flood throughput (online_capture_overhead_frac,
+    # absolute ceiling 0.02 — capture must stay invisible to serving)
+    try:
+        from bench_online import run as online_run
+        _feed_watchdog("online")
+        line.update(online_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: online leg failed (%s)\n" % e)
     _wd.stop()
     print(json.dumps(line), flush=True)
 

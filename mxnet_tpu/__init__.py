@@ -65,6 +65,7 @@ from . import serve
 from . import trace
 from . import profiler
 from . import faults
+from . import online
 from . import libinfo
 from . import misc
 from . import symbol_doc

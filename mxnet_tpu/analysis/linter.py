@@ -35,6 +35,12 @@ decode-host-sync   ``np.asarray``/``.item()``/``float(x)`` inside a
                    (PR 16: the paged engine's contract is ONE host sync
                    per compiled step; hoist the pull out of the loop or
                    batch it into the step's single asarray)
+unsealed-replay    ``np.load``/``np.fromfile`` in a capture-shard
+                   reader with no SEALED-marker gate — a torn or
+                   in-progress shard tail silently becomes training
+                   data (PR 17: replay readers must check
+                   ``is_sealed``/``sealed_shards`` first, mirroring
+                   the checkpoint COMMIT discipline)
 
 Suppressions
 ------------
@@ -513,6 +519,60 @@ def _rule_donated_aliasing(ctx: _Ctx) -> Iterable[Finding]:
             "donated)")
 
 
+_SHARD_LOADERS = {"np.load", "numpy.load", "np.fromfile",
+                  "numpy.fromfile"}
+
+
+def _rule_unsealed_replay(ctx: _Ctx) -> Iterable[Finding]:
+    """A function that reads capture-shard files (``np.load`` /
+    ``np.fromfile`` in shard-touching code) without any reference to
+    the SEALED discipline: capture shards publish in two atomic steps
+    (shard file, then SEALED marker — mirroring the checkpoint COMMIT
+    protocol), so a reader that skips the marker check replays torn or
+    in-progress tails as training data (PR 17).  The gate is any
+    seal-named reference (``is_sealed`` / ``sealed_shards`` / a SEALED
+    constant) in the same function; shard-ness is a ``shard-`` string
+    (the capture file prefix) or a shard-named identifier."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        sharded = "shard" in node.name.lower()
+        sealed = "seal" in node.name.lower()
+        loads = []
+        for n in ast.walk(node):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                low = n.value.lower()
+                if "shard-" in low:
+                    sharded = True
+                if "seal" in low:
+                    sealed = True
+            elif isinstance(n, ast.Name):
+                low = n.id.lower()
+                if "shard" in low:
+                    sharded = True
+                if "seal" in low:
+                    sealed = True
+            elif isinstance(n, ast.Attribute):
+                low = n.attr.lower()
+                if "shard" in low:
+                    sharded = True
+                if "seal" in low:
+                    sealed = True
+            elif isinstance(n, ast.Call) \
+                    and _dotted(n.func) in _SHARD_LOADERS:
+                loads.append(n)
+        if not (sharded and loads) or sealed:
+            continue
+        for n in loads:
+            yield ctx.finding(
+                "unsealed-replay", n,
+                "capture-shard read with no SEALED-marker gate — a "
+                "torn or in-progress shard tail becomes training "
+                "data; check online.capture.is_sealed(path) (or "
+                "iterate sealed_shards()) before loading, like the "
+                "checkpoint COMMIT discipline")
+
+
 RULES = {
     "donated-aliasing": _rule_donated_aliasing,
     "raw-jit": _rule_raw_jit,
@@ -522,6 +582,7 @@ RULES = {
     "raw-future-settle": _rule_raw_future_settle,
     "raw-retry": _rule_raw_retry,
     "decode-host-sync": _rule_decode_host_sync,
+    "unsealed-replay": _rule_unsealed_replay,
 }
 
 

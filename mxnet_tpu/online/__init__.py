@@ -1,0 +1,51 @@
+"""mxnet_tpu.online — continuous training on live serve traffic
+(ISSUE 17).
+
+The closed loop the system papers promise: the same substrate trains
+and serves, and models move from learner to server continuously ::
+
+    serve --> capture --> replay --> fine-tune --> gate --> promote
+      ^                                                        |
+      +------------- rolling_restart (zero drops) -------------+
+
+* :mod:`capture`  — sampled request/response capture at the router
+  seam into crash-tolerant SEALED shards (``ServeRouter(capture=w)``).
+* :mod:`replay`   — sealed shards back into a ``FeedDataIter`` whose
+  checkpointed cursor resumes exactly.
+* :mod:`trainer`  — ``OnlineTrainer``: cumulative ``Module.fit``
+  rounds against one checkpoint store, Supervisor-restartable bitwise.
+* :mod:`promote`  — ``PromotionGate`` (held-out quality + drift) and
+  the zero-drop ``rolling_restart`` promotion, with embed-table
+  freshness carried forward.
+
+Every stage rides the fault plane (``online.capture@seal``,
+``online.train@round``, ``online.promote@decide/restart/record``), and
+the whole loop is chaos-acceptance-tested: torn capture shard, worker
+SIGKILL mid-fit, crash mid-promotion — the promoted weights stay
+bitwise equal to a fault-free run.  See docs/online.md.
+"""
+from . import capture
+from . import replay
+from . import trainer
+from . import promote
+
+from .capture import (CaptureWriter, is_sealed, sealed_shards,
+                      shard_path, seal_path)
+from .replay import (UnsealedShardError, load_shard, replay_pipeline,
+                     replay_source)
+from .trainer import OnlineTrainer
+from .promote import (PromotionGate, freshen_embed, promote as
+                      promote_checkpoint, quarantine, read_record,
+                      PROMOTED_RECORD, QUARANTINED_RECORD)
+
+__all__ = [
+    "capture", "replay", "trainer", "promote",
+    "CaptureWriter", "is_sealed", "sealed_shards", "shard_path",
+    "seal_path",
+    "UnsealedShardError", "load_shard", "replay_pipeline",
+    "replay_source",
+    "OnlineTrainer",
+    "PromotionGate", "freshen_embed", "promote_checkpoint",
+    "quarantine", "read_record", "PROMOTED_RECORD",
+    "QUARANTINED_RECORD",
+]

@@ -37,6 +37,7 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "register_autotune_stats", "autotune_report",
            "autotune_report_str", "register_faults_stats",
            "faults_report", "faults_report_str",
+           "register_online_stats", "online_report", "online_report_str",
            "MultichipStats", "register_multichip_stats",
            "parse_hlo_collectives", "multichip_report",
            "multichip_report_str", "unified_report", "unified_report_str"]
@@ -676,6 +677,33 @@ def faults_report_str() -> str:
     return _faults_registry.report_str()
 
 
+# -- online-loop instrumentation (mxnet_tpu.online) --------------------------
+# The continuous-training loop's three legs share one registry: every
+# CaptureWriter (kind "capture": offered/kept/shards sealed — the
+# counters that make the sampled capture rate verifiable), OnlineTrainer
+# (kind "trainer": fine-tune rounds, last candidate step) and
+# PromotionGate (kind "gate": decisions, promoted vs quarantined), so
+# online_report() is the loop's single health view.
+_online_registry = _Registry("online", "(no online loop)")
+
+
+def register_online_stats(online_stats) -> None:
+    """Called by online.CaptureWriter / OnlineTrainer / PromotionGate
+    on construction."""
+    _online_registry.register(online_stats)
+
+
+def online_report() -> dict:
+    """Per-component online-loop counters: capture sampling, fine-tune
+    rounds, gate decisions.  See mxnet_tpu.online."""
+    return _online_registry.report()
+
+
+def online_report_str() -> str:
+    """Human-readable online-loop table."""
+    return _online_registry.report_str()
+
+
 # -- compilation instrumentation (mxnet_tpu.compile_cache) -------------------
 # Compilation is process-global (one XLA compiler, one jit cache, one disk
 # cache), so unlike the per-instance registries above there is exactly one
@@ -711,6 +739,7 @@ def unified_report() -> dict:
         "passes": passes_report(),
         "autotune": autotune_report(),
         "faults": faults_report(),
+        "online": online_report(),
     }
     try:
         out["compile"] = compile_report()
@@ -733,6 +762,7 @@ def unified_report_str() -> str:
         ("passes", passes_report_str),
         ("autotune", autotune_report_str),
         ("faults", faults_report_str),
+        ("online", online_report_str),
         ("compile", compile_report_str),
     ]
     parts = []

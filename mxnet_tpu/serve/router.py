@@ -52,7 +52,9 @@ close``.
 """
 from __future__ import annotations
 
+import collections
 import itertools
+import threading
 import time
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional
@@ -164,13 +166,26 @@ class ServeRouter:
         Probing drafts a real request and relies on the retry budget
         to shield that client, so it is also disabled when
         ``retries`` is 0.
+    capture : online.CaptureWriter
+        Optional request/response capture sampler (the online-training
+        loop's intake, ``mxnet_tpu.online``): every SUCCESSFUL request
+        is offered as ``capture.offer(data, result)``.  The completion
+        path only ENQUEUES the pair (one lock + append); a dedicated
+        capture thread drains the queue and pays the sampling/spill
+        cost, so capture stays invisible to serving throughput
+        (``online_capture_overhead_frac`` gates this).  By the time a
+        client's ``result()`` returns, its pair is queued — so queue
+        order is completion order, and :meth:`capture_sync` (or
+        :meth:`close`) is a barrier after which every completed
+        request has been offered.  Capture failures are counted
+        (``capture_errors``), never surfaced to clients.
     """
 
     def __init__(self, factory: Callable[[int], object], replicas: int = 2,
                  *, unhealthy_after: Optional[int] = None,
                  retries: Optional[int] = None,
                  probe_after_s: Optional[float] = None,
-                 name: str = "router"):
+                 capture=None, name: str = "router"):
         if replicas < 1:
             raise ServeError("replicas must be >= 1, got %d" % replicas)
         if unhealthy_after is None:
@@ -188,15 +203,22 @@ class ServeRouter:
         self._retry_seed = itertools.count()
         self.name = name
         self._factory = factory
+        self.capture = capture
         self._cv = make_condition("serve.router")
         self._closed = False
         self._rejected = 0
+        self._captured = 0
+        self._capture_errors = 0
         self._retried = 0
         self._retry_wait_s = 0.0
         self._drains = 0
         self._downs = 0
         self._probes = 0
         self._reinstated = 0
+        self._capture_cv = make_condition("serve.router.capture")
+        self._capture_q = collections.deque()
+        self._capture_busy = False
+        self._capture_thread = None
         self._replicas: List[_Replica] = []
         try:
             for i in range(int(replicas)):
@@ -210,6 +232,11 @@ class ServeRouter:
                     pass
             raise
         self.stats = RouterStats(name, self)
+        if self.capture is not None:
+            self._capture_thread = threading.Thread(
+                target=self._capture_drain_loop,
+                name="%s-capture" % name, daemon=True)
+            self._capture_thread.start()
         from .. import profiler
         profiler.register_serve_stats(self.stats)
 
@@ -445,7 +472,18 @@ class ServeRouter:
             rfut.cancel()
             return
         if exc is None:
-            _set_result(rfut, efut.result())
+            result = efut.result()
+            # enqueue BEFORE the client future settles: once result()
+            # returns, the pair is in the queue, so capture_sync()/
+            # close() see every completed request
+            if self.capture is not None:
+                # append only — no notify: waking the capture thread
+                # per request would put a context switch on every
+                # completion; it polls at _IDLE_WAIT_S and drains in
+                # batches instead
+                with self._capture_cv:
+                    self._capture_q.append((rep, data, result))
+            _set_result(rfut, result)
             return
         if engine_fail and retries_left > 0 and not self._closed:
             if backoff is None:
@@ -472,6 +510,72 @@ class ServeRouter:
             except Exception as redispatch_exc:
                 exc = redispatch_exc
         _set_exception(rfut, exc)
+
+    def _capture_drain_loop(self) -> None:
+        """The capture thread: drains queued pairs into the sampler.
+        Exits when the router is closed AND the queue is empty, so
+        every pair enqueued before close() is still offered."""
+        while True:
+            with self._capture_cv:
+                if not self._capture_q:
+                    if self._closed:
+                        return
+                    self._capture_cv.wait(_IDLE_WAIT_S)
+                    if not self._capture_q:
+                        continue
+                batch = list(self._capture_q)
+                self._capture_q.clear()
+                self._capture_busy = True
+            try:
+                for rep, data, result in batch:
+                    self._offer_capture(rep, data, result)
+            finally:
+                with self._capture_cv:
+                    self._capture_busy = False
+                    self._capture_cv.notify_all()
+
+    def _offer_capture(self, rep: _Replica, data, result) -> None:
+        """Feed a served pair to the capture sampler (capture thread
+        only).  A capture failure is counted here and remembered by the
+        writer (its flush() re-raises), so the serving path never
+        breaks but the online loop still dies loud on a torn shard."""
+        try:
+            kept = self.capture.offer(data, result)
+        except Exception:
+            with self._cv:
+                self._capture_errors += 1
+            return
+        if not kept:
+            return
+        with self._cv:
+            self._captured += 1
+        # mirror onto the replica's engine stats so the sampled rate is
+        # verifiable from serve_report() (captured / completed)
+        st = getattr(rep.engine, "stats", None)
+        fn = getattr(st, "on_captured", None)
+        if fn is not None:
+            fn()
+
+    def capture_sync(self, timeout: Optional[float] = None) -> None:
+        """Barrier: wait until every pair enqueued so far has been
+        offered to the capture sampler.  Because completions enqueue
+        before the client future settles, calling this after the last
+        ``result()`` guarantees the writer saw the whole flood.
+        Raises ServeError on timeout."""
+        if self.capture is None:
+            return
+        deadline = (time.perf_counter() + timeout
+                    if timeout is not None else None)
+        with self._capture_cv:
+            while self._capture_q or self._capture_busy:
+                wait = _IDLE_WAIT_S
+                if deadline is not None:
+                    wait = min(wait, deadline - time.perf_counter())
+                    if wait <= 0:
+                        raise ServeError(
+                            "capture_sync timed out with %d pair(s) "
+                            "pending" % len(self._capture_q))
+                self._capture_cv.wait(wait)
 
     # -- draining restart --------------------------------------------------
     def drain(self, index: int, timeout: Optional[float] = None) -> None:
@@ -573,6 +677,8 @@ class ServeRouter:
                 "kind": "router",
                 "replicas": len(reps),
                 "rejected": self._rejected,
+                "captured": self._captured,
+                "capture_errors": self._capture_errors,
                 "retried": self._retried,
                 "retry_wait_s": round(self._retry_wait_s, 4),
                 "drains": self._drains,
@@ -599,6 +705,8 @@ class ServeRouter:
         out["submitted"] = agg_submitted
         out["completed"] = agg_completed
         out["failed"] = agg_failed
+        out["capture_rate"] = round(out["captured"] / agg_completed, 4) \
+            if agg_completed else 0.0
         return out
 
     def _report_str(self) -> str:
@@ -609,8 +717,11 @@ class ServeRouter:
                  % (r["replicas"], r["rejected"], r["retried"],
                     r["drains"], r["downs"], r["probes"],
                     r["reinstated"]),
-                 "  rollup: %d submitted / %d completed / %d failed"
-                 % (r["submitted"], r["completed"], r["failed"])]
+                 "  rollup: %d submitted / %d completed / %d failed, "
+                 "%d captured (rate %.3f, %d capture errors)"
+                 % (r["submitted"], r["completed"], r["failed"],
+                    r["captured"], r["capture_rate"],
+                    r["capture_errors"])]
         for i, row in sorted(r["per_replica"].items()):
             erep = row.get("engine") or {}
             lines.append(
@@ -633,6 +744,13 @@ class ServeRouter:
             self._cv.notify_all()
         for rep in reps:
             rep.engine.close(drain=drain)
+        t = self._capture_thread
+        if t is not None:
+            # wake the capture thread; it drains whatever is queued
+            # (everything enqueued before close) and exits
+            with self._capture_cv:
+                self._capture_cv.notify_all()
+            t.join(timeout=30.0)
 
     def __enter__(self):
         return self

@@ -78,6 +78,7 @@ class ServeStats:
         self._cancelled = 0
         self._failed = 0
         self._reloads = 0
+        self._captured = 0
         self._batches = 0
         self._batch_items = 0
         self._pad_items = 0
@@ -126,6 +127,15 @@ class ServeStats:
         with self._lock:
             self._reloads += 1
 
+    def on_captured(self) -> None:
+        """A completed request was sampled into the online-training
+        capture (mxnet_tpu.online) — NOT a terminal outcome (the
+        request already completed), so it stays out of the outstanding
+        balance; it exists so the sampled rate is verifiable as
+        captured / completed straight from serve_report()."""
+        with self._lock:
+            self._captured += 1
+
     def set_queue_depth(self, depth: int) -> None:
         with self._lock:
             self._queue_depth = depth
@@ -159,6 +169,9 @@ class ServeStats:
                 "cancelled": self._cancelled,
                 "failed": self._failed,
                 "reloads": self._reloads,
+                "captured": self._captured,
+                "capture_rate": round(self._captured / self._completed, 4)
+                if self._completed else 0.0,
                 "batches": self._batches,
                 "batch_occupancy": round(
                     self._batch_items
@@ -220,6 +233,7 @@ class DecodeStats:
         self._cancelled = 0
         self._overloaded = 0
         self._reloads = 0
+        self._captured = 0
         self._steps = 0
         self._slot_steps = 0
         self._tokens_out = 0
@@ -270,6 +284,12 @@ class DecodeStats:
         with self._lock:
             self._reloads += 1
 
+    def on_captured(self) -> None:
+        """Stream sampled into the online-training capture — not a
+        terminal outcome (see ServeStats.on_captured)."""
+        with self._lock:
+            self._captured += 1
+
     def set_queue_depth(self, depth: int) -> None:
         with self._lock:
             self._queue_depth = depth
@@ -300,6 +320,9 @@ class DecodeStats:
                 "cancelled": self._cancelled,
                 "failed": self._failed,
                 "reloads": self._reloads,
+                "captured": self._captured,
+                "capture_rate": round(self._captured / self._completed, 4)
+                if self._completed else 0.0,
                 "steps": self._steps,
                 "tokens_out": self._tokens_out,
                 "slot_occupancy": round(

@@ -141,6 +141,30 @@ def test_zero_floor_metric_regression_is_caught(tmp_path):
     assert _run([], tmp_path).returncode == 0
 
 
+def test_abs_ceiling_metric_is_gated_without_priors(tmp_path):
+    """ISSUE 17: an ABS_CEILING metric fails above its ceiling even on
+    the FIRST run carrying it (no trajectory, no percent scale) and
+    regardless of --threshold; at/below the ceiling it gates normally."""
+    def write(n, frac):
+        with open(str(tmp_path / ("BENCH_r%02d.json" % n)), "w") as f:
+            json.dump({"rc": 0, "parsed": {"metric": "m", "unit": "q",
+                                           "path": "p",
+                                           "online_capture_overhead_frac":
+                                           frac}}, f)
+    write(1, 0.05)                  # first-ever run, over the ceiling
+    res = _run([], tmp_path)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "absolute ceiling" in res.stdout
+    assert _run(["--threshold", "500"], tmp_path).returncode == 1
+    write(1, 0.0)                   # under the ceiling: NEW, passes
+    assert _run([], tmp_path).returncode == 0
+    write(2, 0.015)                 # noise over a 0.0 prior, under the
+    assert _run([], tmp_path).returncode == 0   # ceiling: passes (the
+    # continuous zero-clamp exemption — not in ZERO_FLOOR)
+    write(2, 0.03)                  # later run crosses the ceiling
+    assert _run([], tmp_path).returncode == 1
+
+
 def test_invalid_newest_run_is_an_error(tmp_path):
     with open(str(tmp_path / "BENCH_r01.json"), "w") as f:
         json.dump({"rc": 2, "parsed": {}}, f)

@@ -200,6 +200,34 @@ GOOD_HOST_SYNC = """
         return [int(t) for t in np.asarray(toks)]
 """
 
+# PR 17: capture shards publish in two atomic steps (shard file, then
+# SEALED marker); a replay reader that loads without gating on the
+# marker trains on torn or in-progress tails
+BAD_UNSEALED = """
+    import numpy as np
+
+    def read_shards(directory, names):
+        out = []
+        for name in names:
+            if name.startswith("shard-"):
+                z = np.load(directory + "/" + name)
+                out.append(z["data"])
+        return out
+"""
+GOOD_UNSEALED = """
+    import numpy as np
+    from mxnet_tpu.online.capture import is_sealed
+
+    def read_shards(directory, names):
+        out = []
+        for name in names:
+            path = directory + "/" + name
+            if name.startswith("shard-") and is_sealed(path):
+                z = np.load(path)
+                out.append(z["data"])
+        return out
+"""
+
 FIXTURES = [
     ("donated-aliasing", BAD_DONATED, GOOD_DONATED),
     ("raw-jit", BAD_JIT, GOOD_JIT),
@@ -209,7 +237,30 @@ FIXTURES = [
     ("raw-future-settle", BAD_FUTURE, GOOD_FUTURE),
     ("raw-retry", BAD_RETRY, GOOD_RETRY),
     ("decode-host-sync", BAD_HOST_SYNC, GOOD_HOST_SYNC),
+    ("unsealed-replay", BAD_UNSEALED, GOOD_UNSEALED),
 ]
+
+
+def test_unsealed_replay_scope():
+    """Only shard-touching readers count: a checkpoint .npy read with
+    no shard naming anywhere is not flagged, and a reader that
+    iterates sealed_shards() is gated by construction."""
+    plain_npy = """
+        import numpy as np
+
+        def read_leaf(path, dtype):
+            arr = np.load(path)
+            return arr.astype(dtype)
+    """
+    assert "unsealed-replay" not in _rules_hit(plain_npy)
+    via_listing = """
+        import numpy as np
+        from mxnet_tpu.online.capture import sealed_shards
+
+        def read_all(directory):
+            return [np.load(p)["data"] for p in sealed_shards(directory)]
+    """
+    assert "unsealed-replay" not in _rules_hit(via_listing)
 
 
 def test_decode_host_sync_scope():

@@ -331,6 +331,66 @@ def test_journal_lines(tmp_path, monkeypatch):
     assert all(m > 0 for m in monos) and monos == sorted(monos)
 
 
+def test_journal_rotation_size_based_keep_last_n(tmp_path, monkeypatch):
+    """ISSUE 17 satellite: size-based rotation with keep-last-N — no
+    torn lines, generations shift whole, the oldest drops."""
+    from mxnet_tpu.trace import journal
+    jpath = str(tmp_path / "rot.jsonl")
+    # one line is ~1k (it embeds unified_report); cap at ~3 lines
+    one = len(json.dumps({"probe": True})) + 1
+    journal.write_journal_line(jpath, 0)
+    one = os.path.getsize(jpath)
+    os.unlink(jpath)
+    monkeypatch.setenv("MXNET_TRACE_JOURNAL_MAX_BYTES", str(3 * one + 16))
+    monkeypatch.setenv("MXNET_TRACE_JOURNAL_KEEP", "2")
+    for step in range(12):
+        journal.write_journal_line(jpath, step)
+    gens = journal.journal_files(jpath)
+    assert [os.path.basename(g) for g in gens] == [
+        "rot.jsonl", "rot.jsonl.1", "rot.jsonl.2"]
+    # every surviving line parses whole and the step sequence across
+    # generations (oldest first) is contiguous
+    steps = []
+    for gen in reversed(gens):
+        for ln in open(gen):
+            steps.append(json.loads(ln)["step"])
+    assert steps == sorted(steps)
+    assert steps[-1] == 11
+    assert len(steps) < 12          # the oldest generation was dropped
+    assert 0 not in steps
+    # live file respects the cap
+    assert os.path.getsize(jpath) <= 3 * one + 16
+
+
+def test_journal_tail_reads_across_generations(tmp_path, monkeypatch):
+    from mxnet_tpu.trace import journal
+    jpath = str(tmp_path / "tail.jsonl")
+    journal.write_journal_line(jpath, 0)
+    one = os.path.getsize(jpath)
+    monkeypatch.setenv("MXNET_TRACE_JOURNAL_MAX_BYTES", str(2 * one + 8))
+    monkeypatch.setenv("MXNET_TRACE_JOURNAL_KEEP", "3")
+    for step in range(1, 7):
+        journal.write_journal_line(jpath, step)
+    # the live file holds fewer than 4 lines -> tail must walk back
+    # through .1 (and further) to satisfy n
+    last4 = journal.tail(jpath, 4)
+    assert [ln["step"] for ln in last4] == [3, 4, 5, 6]
+    assert journal.tail(jpath, 1)[0]["step"] == 6
+    # degrade, never raise
+    assert journal.tail(str(tmp_path / "absent.jsonl"), 3) == []
+    assert journal.tail(jpath, 0) == []
+
+
+def test_journal_rotation_off_by_default(tmp_path, monkeypatch):
+    from mxnet_tpu.trace import journal
+    monkeypatch.delenv("MXNET_TRACE_JOURNAL_MAX_BYTES", raising=False)
+    jpath = str(tmp_path / "nocap.jsonl")
+    for step in range(8):
+        journal.write_journal_line(jpath, step)
+    assert journal.journal_files(jpath) == [jpath]
+    assert len(open(jpath).readlines()) == 8
+
+
 def test_checkpoint_spans(tmp_path):
     from mxnet_tpu import checkpoint
     mgr = checkpoint.CheckpointManager(str(tmp_path / "ck"),

@@ -77,6 +77,12 @@ DEFAULT_LOWER_IS_BETTER = {
     "llm_p99_inter_token_ms", "llm_kv_bytes_per_stream",
     "llm_kv_bytes_per_stream_dense", "llm_kv_bytes_frac",
     "llm_dropped_streams",
+    # ISSUE 17 online loop: capture-to-live freshness (plain and with
+    # the absorbable chaos plan armed), dropped requests through the
+    # rolling promotion (also zero-floored) and the capture seam's
+    # flood cost (also ceilinged absolutely)
+    "online_freshness_s", "online_freshness_chaos_s",
+    "online_promote_dropped", "online_capture_overhead_frac",
 }
 
 # Discrete "gated at 0" metrics: a zero best prior means ANY nonzero
@@ -87,6 +93,17 @@ DEFAULT_LOWER_IS_BETTER = {
 ZERO_FLOOR = {
     "serve_router_restart_drops", "serve_mux_steady_compiles",
     "serve_failover_dropped", "llm_dropped_streams",
+    "online_promote_dropped",
+}
+
+# Absolute ceilings, independent of any prior run: a newest value above
+# the ceiling is a regression even on the very first run that carries
+# the metric (no trajectory needed) and regardless of --threshold.
+# online_capture_overhead_frac: the ISSUE 17 contract is that sampling
+# live traffic costs serving at most 2% — a capture seam that drags
+# more than that would quietly tax every request to feed retraining.
+ABS_CEILING = {
+    "online_capture_overhead_frac": 0.02,
 }
 
 
@@ -190,6 +207,14 @@ def gate(runs: List[Run], threshold: float, metrics=None,
                 rows.append((key, None, best, best_run, None, "MISSING"))
                 regressions.append("%s: present in %s, missing from %s"
                                    % (key, best_run, newest.name))
+            continue
+        ceiling = ABS_CEILING.get(key)
+        if ceiling is not None and new > ceiling:
+            regressions.append(
+                "%s: %.6g exceeds absolute ceiling %.6g (gated "
+                "independently of prior runs, threshold does not "
+                "apply)" % (key, new, ceiling))
+            rows.append((key, new, best, best_run, None, "REGRESS"))
             continue
         if best is None:
             rows.append((key, new, None, None, None, "NEW"))
