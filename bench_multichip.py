@@ -17,12 +17,33 @@ ServeEngine's closed-loop throughput:
                                  shared-core host measure the GSPMD
                                  path's overhead, not chip scaling)
 
+ISSUE 18 (mxnet_tpu.dist) adds the multi-PROCESS legs — always on the
+host-CPU backend (two local processes cannot share one TPU, and the
+gloo process-boundary overhead is what the leg measures):
+
+  dist_scaling_eff_2proc         img/s(2 processes x 1 dev, dp=2 mesh
+                                 across the process boundary) / img/s
+                                 (1 process x 2 forced host devices,
+                                 same dp=2 mesh) — the cost of crossing
+                                 from XLA-internal collectives to gloo
+  dist_host_recovery_s           FleetSupervisor under the dist.host
+                                 chaos spec: SIGKILL'd rank ->
+                                 checkpoint-commit recovery seconds
+  shardsearch_vs_hand_frac       worst-case (CNN, LSTM) ratio of the
+                                 sharding="auto" winner's steady step
+                                 time over the hand-written PR 7 specs
+                                 on a dp=4 x tp=2 mesh; <= 1.05 is the
+                                 acceptance bar ("within 5% of hand")
+
 Each datapoint runs in a FRESH subprocess (same pattern as
 bench_compile.py): the mesh is a process-level property of the backend,
 and forcing the host platform must not poison the parent's real device.
+The 2-process leg goes through ``tools/launch.py --launcher local`` —
+the exact rendezvous a real fleet uses.
 """
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -38,6 +59,10 @@ TRAIN_WINDOWS = 3
 SERVE_THREADS = 8
 SERVE_SECONDS = 4.0
 SERVE_HIDDEN = 64
+DIST_PORT = 9343
+FLEET_CHAOS = "points=dist.host@rank1,kinds=crash,after=5,max=1,attempts=0"
+SHARD_WINDOWS = 5
+SHARD_ITERS = 8
 
 
 def _cnn():
@@ -178,6 +203,170 @@ def _serve_child():
          "devices": jax.device_count()}), flush=True)
 
 
+def _dist_train_child(ref):
+    """One side of the 2-process scaling leg: the SAME dp=2 CNN step,
+    either across two launch.py workers (1 host device each, dist_sync
+    rendezvous — the gloo path) or in one process over 2 forced host
+    devices (the XLA-internal-collectives baseline).  Prints a json
+    line with the GLOBAL img/s."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import make_mesh
+
+    global_bs = PER_DEVICE_BATCH * 2
+    if ref:
+        assert jax.device_count() == 2, \
+            "--ref needs XLA_FLAGS=--xla_force_host_platform_device_count=2"
+        kv, rank, bs = None, 0, global_bs
+    else:
+        kv = mx.kv.create("dist_sync")
+        rank, bs = kv.rank, PER_DEVICE_BATCH
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(bs, *IMG_SHAPE).astype(np.float32)
+    y = rng.randint(0, CLASSES, bs).astype(np.float32)
+    mod = mx.mod.Module(_cnn(), context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (bs,) + IMG_SHAPE)],
+             label_shapes=[("softmax_label", (bs,))])
+    mod.init_params(mx.init.Xavier())
+    mod.set_mesh(make_mesh([("dp", 2)]))
+    mod.init_optimizer(kvstore=kv, optimizer_params={
+        "learning_rate": 0.05, "momentum": 0.9})
+    assert mod._fused is not None, "fused mesh path did not engage"
+    # both sides feed host arrays through the normal DataBatch path:
+    # the ratio must charge the input transfer to BOTH legs equally
+    batch = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+    for _ in range(4):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    jax.block_until_ready(next(iter(mod._fused_state["params"].values())))
+    if not ref:
+        from jax.experimental import multihost_utils as mhu
+        mhu.sync_global_devices("bench_dist_warm")
+    rates = []
+    for _ in range(TRAIN_WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(TRAIN_ITERS):
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        jax.block_until_ready(
+            next(iter(mod._fused_state["params"].values())))
+        rates.append(global_bs * TRAIN_ITERS / (time.perf_counter() - t0))
+    img_s = sorted(rates)[len(rates) // 2]
+    print("BENCH_MULTICHIP_CHILD " + json.dumps(
+        {"img_s": img_s, "rank": rank, "nproc": 1 if ref else 2}),
+        flush=True)
+    if not ref:
+        from jax.experimental import multihost_utils as mhu
+        mhu.sync_global_devices("bench_dist_done")
+
+
+def _fleet_child():
+    """FleetSupervisor recovery leg: 2 fleet workers, the dist.host
+    chaos spec SIGKILLs rank1 mid-run, and the supervisor's
+    commit-watch clocks death-to-recommit seconds."""
+    import tempfile
+    from mxnet_tpu.dist import FleetSupervisor
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(root, "tests", "nightly", "dist_fleet_worker.py")
+    ckpt = tempfile.mkdtemp(prefix="bench_fleet_")
+    sup = FleetSupervisor(
+        [sys.executable, worker, "--ckpt", ckpt],
+        nworkers=2, on_loss="rejoin", checkpoint_dir=ckpt,
+        timeout_s=240, env={"MXNET_FAULTS": FLEET_CHAOS})
+    rc = sup.run()
+    doc = sup.stats.report()
+    doc["rc"] = rc
+    print("BENCH_MULTICHIP_CHILD " + json.dumps(doc), flush=True)
+
+
+def _shard_cnn(mesh, sharding):
+    import mxnet_tpu as mx
+    bs = PER_DEVICE_BATCH * 4
+    mod = mx.mod.Module(_cnn(), context=mx.cpu(0))
+    mod.bind(data_shapes=[("data", (bs,) + IMG_SHAPE)],
+             label_shapes=[("softmax_label", (bs,))])
+    mod.init_params(mx.init.Xavier())
+    mod.set_mesh(mesh, sharding=sharding)
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.rand(bs, *IMG_SHAPE).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, CLASSES, bs)
+                           .astype(np.float32))])
+    return mod, batch
+
+
+def _shard_lstm(mesh, sharding):
+    import mxnet_tpu as mx
+    from mxnet_tpu.models.lstm import lstm_unroll
+    bs, seq, vocab, hidden = 32, 8, 256, 64
+    net = lstm_unroll(1, seq, vocab, hidden, hidden, vocab, dropout=0.0)
+    data_names = ["data", "l0_init_c", "l0_init_h"]
+    data_shapes = [("data", (bs, seq)), ("l0_init_c", (bs, hidden)),
+                   ("l0_init_h", (bs, hidden))]
+    mod = mx.mod.Module(net, data_names=data_names,
+                        label_names=["softmax_label"], context=mx.cpu(0))
+    mod.bind(data_shapes, [("softmax_label", (bs, seq))])
+    mod.init_params(mx.init.Xavier())
+    mod.set_mesh(mesh, sharding=sharding)
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randint(0, vocab, (bs, seq))
+                          .astype(np.float32)),
+              mx.nd.array(np.zeros((bs, hidden), np.float32)),
+              mx.nd.array(np.zeros((bs, hidden), np.float32))],
+        label=[mx.nd.array(rng.randint(0, vocab, (bs, seq))
+                           .astype(np.float32))])
+    return mod, batch
+
+
+def _shard_child(model, mode):
+    """Steady step time of MODEL on a dp=4 x tp=2 mesh under either the
+    hand-written PR 7 specs or the persisted sharding="auto" winner
+    (the search runs before timing starts; only the chosen program is
+    measured)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import make_mesh
+
+    mesh = make_mesh([("dp", 4), ("tp", 2)])
+    if mode == "auto":
+        sharding = "auto"
+    elif model == "cnn":
+        sharding = {"fc1_weight": P("tp", None), "fc1_bias": P("tp")}
+    else:
+        # Megatron-style vocab parallelism: the embedding table and the
+        # classifier head split their vocab rows over tp
+        sharding = {"embed_weight": P("tp", None),
+                    "cls_weight": P("tp", None)}
+    mod, batch = (_shard_cnn if model == "cnn" else _shard_lstm)(
+        mesh, sharding)
+    for _ in range(4):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    jax.block_until_ready(next(iter(mod._fused_state["params"].values())))
+    times = []
+    for _ in range(SHARD_WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(SHARD_ITERS):
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        jax.block_until_ready(
+            next(iter(mod._fused_state["params"].values())))
+        times.append((time.perf_counter() - t0) / SHARD_ITERS)
+    step_ms = sorted(times)[len(times) // 2] * 1e3
+    print("BENCH_MULTICHIP_CHILD " + json.dumps(
+        {"step_ms": step_ms, "model": model, "mode": mode}), flush=True)
+
+
 def _child_env(force_host):
     env = dict(os.environ)
     if force_host:
@@ -189,11 +378,25 @@ def _child_env(force_host):
     return env
 
 
-def _run_child(args, force_host, timeout_s=600):
+def _dist_env(ndev=None):
+    """Env for the multi-process legs: always host CPU (two local
+    processes cannot share one TPU; the process boundary is the thing
+    measured), with an EXACT forced device count when asked — the
+    parent's own XLA_FLAGS never leak into a worker that must see 1."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    if ndev:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
+                            % ndev)
+    return env
+
+
+def _run_child(args, force_host, timeout_s=600, env=None):
     res = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--child"] + args,
-        env=_child_env(force_host), capture_output=True, text=True,
-        timeout=timeout_s)
+        env=env if env is not None else _child_env(force_host),
+        capture_output=True, text=True, timeout=timeout_s)
     if res.returncode != 0:
         raise RuntimeError("bench_multichip child %s failed: %s"
                            % (args, res.stderr[-1200:]))
@@ -202,6 +405,60 @@ def _run_child(args, force_host, timeout_s=600):
             return json.loads(ln.split(" ", 1)[1])
     raise RuntimeError("bench_multichip child %s printed no result: %s"
                        % (args, res.stdout[-800:]))
+
+
+def _dist_leg():
+    """2-process vs 1-process dp=2: the gloo process-boundary tax."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    args = [sys.executable, os.path.join(root, "tools", "launch.py"),
+            "-n", "2", "--launcher", "local", "--port", str(DIST_PORT),
+            "%s %s --child dist_train"
+            % (sys.executable, os.path.abspath(__file__))]
+    res = subprocess.run(args, capture_output=True, text=True,
+                         timeout=600, env=_dist_env(), cwd=root)
+    if res.returncode != 0:
+        raise RuntimeError("dist_train workers failed: %s"
+                           % (res.stderr[-1200:] or res.stdout[-1200:]))
+    # two ranks share one pipe — match by pattern, not by line
+    docs = [json.loads(m) for m in
+            re.findall(r"BENCH_MULTICHIP_CHILD (\{[^{}\n]*\})",
+                       res.stdout)]
+    two = next(d for d in docs if d.get("rank") == 0)
+    ref = _run_child(["dist_ref"], True, env=_dist_env(2))
+    eff = two["img_s"] / ref["img_s"] if ref["img_s"] else None
+    return {"dist_img_s_2proc": round(two["img_s"], 1),
+            "dist_img_s_1proc_2dev": round(ref["img_s"], 1),
+            "dist_scaling_eff_2proc": round(eff, 4) if eff else None}
+
+
+def _fleet_leg():
+    doc = _run_child(["fleet"], True, env=_dist_env())
+    if doc.get("rc") not in (0, None) or not doc.get("restarts"):
+        raise RuntimeError("fleet leg did not recover: %r" % doc)
+    return {"dist_host_recovery_s": round(float(doc["last_recovery_s"]),
+                                          2)}
+
+
+def _shard_leg(feed):
+    """auto-vs-hand specs on the dp=4 x tp=2 mesh; the published frac
+    is the WORST model's ratio (<= 1.05 = within 5% of hand)."""
+    import tempfile
+    store = tempfile.mkdtemp(prefix="bench_shard_store_")
+    env8 = _dist_env(8)
+    out = {}
+    fracs = []
+    for model in ("cnn", "lstm"):
+        feed("shardsearch-" + model)
+        hand = _run_child(["shard", model, "hand"], True, env=dict(env8))
+        auto = _run_child(["shard", model, "auto"], True,
+                          env=dict(env8, MXNET_AUTOTUNE_DIR=store))
+        out["shardsearch_%s_hand_step_ms" % model] = \
+            round(hand["step_ms"], 2)
+        out["shardsearch_%s_auto_step_ms" % model] = \
+            round(auto["step_ms"], 2)
+        fracs.append(auto["step_ms"] / hand["step_ms"])
+    out["shardsearch_vs_hand_frac"] = round(max(fracs), 4)
+    return out
 
 
 def run(feed=lambda *_: None):
@@ -248,6 +505,18 @@ def run(feed=lambda *_: None):
         # the acceptance key names it serve_tp_qps; publish both
         "serve_tp_qps": round(serve["qps"], 1),
     }
+    # ISSUE 18 multi-process legs — guarded individually: a flaky
+    # rendezvous must not take the in-process metrics down with it (the
+    # gate's MISSING row still flags the lost leg)
+    for name, leg in (("dist-2proc", _dist_leg),
+                      ("dist-fleet", _fleet_leg),
+                      ("shardsearch", lambda: _shard_leg(feed))):
+        feed(name)
+        try:
+            out.update(leg())
+        except Exception as e:
+            sys.stderr.write("bench_multichip: %s leg failed (%s)\n"
+                             % (name, str(e)[-400:]))
     return out
 
 
@@ -255,6 +524,14 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         if sys.argv[2] == "train":
             _train_child(sys.argv[3] if len(sys.argv) > 3 else "")
+        elif sys.argv[2] == "dist_train":
+            _dist_train_child(ref=False)
+        elif sys.argv[2] == "dist_ref":
+            _dist_train_child(ref=True)
+        elif sys.argv[2] == "fleet":
+            _fleet_child()
+        elif sys.argv[2] == "shard":
+            _shard_child(sys.argv[3], sys.argv[4])
         else:
             _serve_child()
         return

@@ -4,10 +4,13 @@ Joins the jax.distributed process group when launched by tools/launch.py
 (MXNET_TPU_COORDINATOR / _NUM_WORKERS / _WORKER_ID envs — the TPU-native
 replacement for the reference's DMLC_PS_ROOT_* rendezvous).  MUST run before
 any JAX backend initialization, so mxnet_tpu/__init__ imports this first.
+
+The actual initialize (and the CPU gloo-collectives selection a
+multi-process CPU backend needs) lives in ``mxnet_tpu.dist.boot`` — the
+one owner of the jax.distributed lifecycle, enforced by the
+``raw-dist-init`` lint rule.
 """
 from __future__ import annotations
-
-import os
 
 _done = False
 
@@ -16,23 +19,8 @@ def ensure() -> None:
     global _done
     if _done:
         return
-    from .base import get_env
-    coord = get_env("MXNET_TPU_COORDINATOR")
-    if coord is None:
-        _done = True
-        return
-    import jax
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            # lint: allow(raw-env) — rendezvous vars are a set: once
-            # the coordinator is present, a missing peer var is a broken
-            # launcher and must KeyError loudly, not default
-            num_processes=int(os.environ["MXNET_TPU_NUM_WORKERS"]),
-            process_id=int(os.environ["MXNET_TPU_WORKER_ID"]))
-    except RuntimeError as e:
-        if "already" not in str(e):
-            raise
+    from .dist import boot
+    boot.ensure_from_env()
     _done = True
 
 

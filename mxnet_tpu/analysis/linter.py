@@ -14,6 +14,10 @@ donated-aliasing   ``jax.device_put`` of a host buffer flowing into
                    nondeterministic result corruption on CPU zero-copy)
 raw-jit            ``jax.jit`` outside ``compile_cache`` — bypasses the
                    persistent executable cache (PR 5's whole point)
+raw-dist-init      ``jax.distributed.initialize`` outside
+                   ``mxnet_tpu/dist/`` — the process-group boot is
+                   single-owner (gloo selection, pre-backend ordering,
+                   idempotent re-entry; ISSUE 18)
 raw-env            ``os.environ`` reads bypassing ``base.get_env``
 raw-time           ``time.time()`` in rate/duration arithmetic (PR 3's
                    Speedometer NTP-step bug class)
@@ -258,6 +262,25 @@ def _rule_raw_jit(ctx: _Ctx) -> Iterable[Finding]:
                 "jax.jit bypasses compile_cache.cached_jit — route through "
                 "the persistent executable cache, or suppress with the "
                 "serialization reason (donation layout / pallas)")
+
+
+def _rule_raw_dist_init(ctx: _Ctx) -> Iterable[Finding]:
+    """jax.distributed.initialize outside mxnet_tpu/dist/: the boot is
+    single-owner (dist.boot) — it must run before any backend init,
+    select the CPU collectives implementation, and tolerate re-entry.
+    A second raw call either crashes ("already initialized") or, worse,
+    races the backend into a coordinator-less state (ISSUE 18)."""
+    if ctx.rel.startswith("mxnet_tpu/dist/"):
+        return
+    for node in ast.walk(ctx.tree):
+        if _dotted(node) == "jax.distributed.initialize" \
+                and isinstance(node, ast.Attribute):
+            yield ctx.finding(
+                "raw-dist-init", node,
+                "raw jax.distributed.initialize — the process-group "
+                "lifecycle is owned by mxnet_tpu.dist.boot (gloo "
+                "selection, pre-backend ordering, idempotent re-entry); "
+                "call dist.boot.initialize / ensure_from_env instead")
 
 
 _ENV_READS = ("os.environ.get", "os.getenv", "environ.get")
@@ -576,6 +599,7 @@ def _rule_unsealed_replay(ctx: _Ctx) -> Iterable[Finding]:
 RULES = {
     "donated-aliasing": _rule_donated_aliasing,
     "raw-jit": _rule_raw_jit,
+    "raw-dist-init": _rule_raw_dist_init,
     "raw-env": _rule_raw_env,
     "raw-time": _rule_raw_time,
     "unseeded-fork-rng": _rule_unseeded_fork_rng,

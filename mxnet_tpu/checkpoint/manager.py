@@ -104,6 +104,29 @@ def _multiprocess() -> Tuple[int, int]:
         return 0, 1
 
 
+def _barrier(name: str, timeout_ms: int = 120000) -> None:
+    """Cross-process rendezvous that is safe OFF the main thread.
+
+    The save path runs on the async writer thread, concurrently with the
+    train thread's dispatches.  ``mhu.sync_global_devices`` is a device
+    collective (a jitted psum): issued from a second thread it interleaves
+    with the train step's collectives in a different order on each rank
+    and wedges the whole collective runtime ("Gloo ... connection reset
+    by peer", then the coordination service takes the job down).  The
+    coordination-service barrier is a plain gRPC rendezvous — no device
+    programs — so the writer thread can block on it freely."""
+    try:
+        from jax._src import distributed
+        client = distributed.global_state.client
+    except Exception:
+        client = None
+    if client is not None:
+        client.wait_at_barrier(name, timeout_in_ms=int(timeout_ms))
+        return
+    from jax.experimental import multihost_utils as mhu   # fallback
+    mhu.sync_global_devices(name)
+
+
 class CheckpointManager:
     """Async, sharded, crash-safe checkpoint store rooted at one
     directory (see module docstring)."""
@@ -235,8 +258,8 @@ class CheckpointManager:
         """Multi-process protocol on a shared filesystem: every process
         writes its own shards into ONE deterministic tmp dir, rank 0
         merges the per-process indexes and runs the commit.  Barriers
-        ride the jax collective runtime."""
-        from jax.experimental import multihost_utils as mhu
+        ride the coordination service (NOT device collectives — this
+        runs on the writer thread, see :func:`_barrier`)."""
         tmp = os.path.join(self.directory,
                            layout.step_dir_name(step) + ".tmp-shared")
         if proc == 0:
@@ -245,9 +268,9 @@ class CheckpointManager:
                 import shutil
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
-        mhu.sync_global_devices("ckpt-begin-%d" % step)
+        _barrier("ckpt-begin-%d" % step)
         self._write_shards(tmp, step, snap, meta, proc, nproc)
-        mhu.sync_global_devices("ckpt-shards-%d" % step)
+        _barrier("ckpt-shards-%d" % step)
         if proc == 0:
             per_proc = []
             spec = None
@@ -264,7 +287,7 @@ class CheckpointManager:
             final = layout.commit_step(self.directory, step, tmp)
         else:
             final = os.path.join(self.directory, layout.step_dir_name(step))
-        mhu.sync_global_devices("ckpt-commit-%d" % step)
+        _barrier("ckpt-commit-%d" % step)
         return final
 
     def _dir_bytes(self, step: int) -> int:

@@ -1,0 +1,81 @@
+"""mxnet_tpu.dist: multi-host meshes — the last scale axis.
+
+Everything cross-PROCESS lives here:
+
+``boot``
+    The one owner of the ``jax.distributed`` lifecycle.  Workers
+    launched by ``tools/launch.py`` (or :class:`FleetSupervisor`) carry
+    ``MXNET_TPU_COORDINATOR`` / ``_NUM_WORKERS`` / ``_WORKER_ID`` and
+    join the process group at ``import mxnet_tpu`` time, before any JAX
+    backend initialization.  On CPU backends the boot also selects the
+    gloo collectives implementation — without it every cross-process
+    collective dies with "Multiprocess computations aren't implemented
+    on the CPU backend".  The ``raw-dist-init`` lint rule keeps every
+    other ``jax.distributed.initialize`` call out of the tree.
+
+``FleetSupervisor``
+    The PR 15 ``faults.Supervisor`` generalized to fleet level: N
+    worker processes under one coordinator, a SIGKILL'd host detected
+    by the parent, the fleet restarted from the latest checkpoint
+    COMMIT (``on_loss="rejoin"``) or re-formed one host smaller
+    (``on_loss="shrink"`` — survivors ride the elastic-remesh path:
+    the restore lands the committed state on the new, smaller global
+    mesh).  The ``dist.host`` fault point (per-rank stage
+    ``rank<i>``) drives deterministic chaos runs.
+
+``shardsearch``
+    Automatic GSPMD sharding search: per-layer spec candidates
+    enumerated from the symbol graph, scored with XLA cost analysis +
+    the post-partitioner collective census (the ``multichip_report()``
+    cost model), only the shortlist measured through compile_cache-
+    warmed programs, winners persisted per (model, topology)
+    fingerprint like autotune configs — ``fit(mesh=...,
+    sharding="auto")``.
+
+``rpc``
+    The cross-host serve seam: ``RpcReplica`` speaks the replica
+    surface (``submit / pending_requests / outstanding / close``) over
+    a socket to an engine in another process, so ``ServeRouter``
+    health-removal and draining-restart semantics hold across hosts.
+
+``report``
+    Per-host rollup of ``multichip_report()`` rows across the fleet's
+    trace journals.
+"""
+from __future__ import annotations
+
+import importlib
+
+# import-light: mxnet_tpu/__init__ pulls this package (via
+# _distributed_boot) BEFORE any JAX backend init; boot must not
+# trigger one, and the heavy submodules load lazily below
+from . import boot  # noqa: F401
+
+__all__ = ["boot", "FleetSupervisor", "FleetStats", "shardsearch",
+           "rpc", "fleet", "report", "RpcReplica", "fleet_multichip_report",
+           "search_sharding", "resolve_auto"]
+
+_LAZY = {
+    "FleetSupervisor": ("fleet", "FleetSupervisor"),
+    "FleetStats": ("fleet", "FleetStats"),
+    "RpcReplica": ("rpc", "RpcReplica"),
+    "fleet_multichip_report": ("report", "fleet_multichip_report"),
+    "fleet_multichip_report_str": ("report", "fleet_multichip_report_str"),
+    "search_sharding": ("shardsearch", "search_sharding"),
+    "resolve_auto": ("shardsearch", "resolve_auto"),
+    "fleet": ("fleet", None),
+    "rpc": ("rpc", None),
+    "report": ("report", None),
+    "shardsearch": ("shardsearch", None),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name))
+    mod = importlib.import_module("." + entry[0], __name__)
+    obj = mod if entry[1] is None else getattr(mod, entry[1])
+    globals()[name] = obj
+    return obj

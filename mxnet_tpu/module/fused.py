@@ -80,13 +80,22 @@ class FusedTrainStep:
             # parallel.make_mesh([("dp", 4), ("tp", 2)])).  The batch
             # axis shards over "dp"; per-param GSPMD constraints over
             # the remaining axes come from ``sharding`` below.
-            if global_dp:
-                raise MXNetError(
-                    "mesh= and dist_sync kvstores are mutually exclusive "
-                    "(a named mesh already owns all cross-device "
-                    "placement; run single-process with the mesh spanning "
-                    "every device instead)")
             mdevs = list(mesh.devices.ravel())
+            if global_dp:
+                # dist_sync + named mesh: the mesh axes span the WHOLE
+                # process group (mxnet_tpu.dist).  A mesh covering only
+                # a subset would leave the other workers' devices out
+                # of the collectives — every SPMD program would hang at
+                # the first cross-process barrier, so refuse up front
+                # with the shapes.
+                if set(mdevs) != set(jax.devices()):
+                    raise MXNetError(
+                        "dist_sync needs the named mesh to span every "
+                        "process's devices (%d in mesh, %d global over "
+                        "%d processes); build it from jax.devices() — "
+                        "parallel.make_mesh does by default"
+                        % (len(mdevs), len(jax.devices()),
+                           jax.process_count()))
             if len(set(mdevs)) != len(mdevs):
                 raise MXNetError("fused step needs distinct devices")
             if "dp" not in mesh.axis_names:
@@ -115,6 +124,13 @@ class FusedTrainStep:
             else:
                 self.mesh = Mesh(np.array(devices), ("dp",))
         self.dp_size = int(self.mesh.shape["dp"])
+        # how many PROCESSES the mesh spans: >1 engages the multi-host
+        # contract everywhere (per-process batch slices, broadcast init,
+        # host-local output gathers, collective-safe checkpointing) —
+        # for dist_sync's implicit dp mesh AND for a named mesh whose
+        # axes cross process boundaries (mxnet_tpu.dist)
+        self._mesh_procs = len({d.process_index
+                                for d in self.mesh.devices.ravel()})
         self.data_names = tuple(data_names)
         self.label_names = tuple(label_names)
         self.label_shapes = dict(label_shapes or [])
@@ -304,7 +320,7 @@ class FusedTrainStep:
         return NamedSharding(self.mesh, P(None, "dp"))
 
     def _multiprocess(self):
-        return self.global_dp and jax.process_count() > 1
+        return self._mesh_procs > 1
 
     def _param_sharding(self, name):
         """At-rest sharding for one named param/aux: its declared GSPMD

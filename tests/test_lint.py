@@ -75,6 +75,22 @@ GOOD_JIT = """
         return cached_jit(fn, donate_argnums=(0,))
 """
 
+# ISSUE 18: the process-group boot is single-owner (dist.boot) — a raw
+# initialize elsewhere races the backend or dies on "already initialized"
+BAD_DIST_INIT = """
+    import jax
+
+    def join_cluster(coordinator, nprocs, rank):
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=nprocs, process_id=rank)
+"""
+GOOD_DIST_INIT = """
+    from ..dist import boot
+
+    def join_cluster(coordinator, nprocs, rank):
+        boot.initialize(coordinator, nprocs, rank)
+"""
+
 # PR 6 convention: env reads go through base.get_env
 BAD_ENV = """
     import os
@@ -231,6 +247,7 @@ GOOD_UNSEALED = """
 FIXTURES = [
     ("donated-aliasing", BAD_DONATED, GOOD_DONATED),
     ("raw-jit", BAD_JIT, GOOD_JIT),
+    ("raw-dist-init", BAD_DIST_INIT, GOOD_DIST_INIT),
     ("raw-env", BAD_ENV, GOOD_ENV),
     ("raw-time", BAD_TIME, GOOD_TIME),
     ("unseeded-fork-rng", BAD_RNG, GOOD_RNG),
@@ -452,6 +469,16 @@ def test_baseline_grandfathers_old_but_fails_new():
         "mxnet_tpu/old.py")
     new = base.new_findings(grown)
     assert len(new) == 1 and "'B'" in new[0].src_line
+
+
+def test_raw_dist_init_exempt_inside_dist_package():
+    """dist/ OWNS the lifecycle: the same call that is a violation
+    anywhere else is the implementation there."""
+    src = "import jax\njax.distributed.initialize('c:1', 2, 0)\n"
+    assert "raw-dist-init" in {f.rule for f in linter.lint_source(
+        src, "mxnet_tpu/module/x.py")}
+    assert "raw-dist-init" not in {f.rule for f in linter.lint_source(
+        src, "mxnet_tpu/dist/boot.py")}
 
 
 def test_raw_jit_exempt_inside_compile_cache():

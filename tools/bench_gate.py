@@ -83,6 +83,13 @@ DEFAULT_LOWER_IS_BETTER = {
     # flood cost (also ceilinged absolutely)
     "online_freshness_s", "online_freshness_chaos_s",
     "online_promote_dropped", "online_capture_overhead_frac",
+    # ISSUE 18 multi-host legs: killed-host recovery seconds, the
+    # auto-vs-hand sharding step-time ratio (<= 1.05 is the acceptance
+    # bar) and its per-model step times; dist_scaling_eff_2proc stays
+    # higher-is-better like every efficiency
+    "dist_host_recovery_s", "shardsearch_vs_hand_frac",
+    "shardsearch_cnn_hand_step_ms", "shardsearch_cnn_auto_step_ms",
+    "shardsearch_lstm_hand_step_ms", "shardsearch_lstm_auto_step_ms",
 }
 
 # Discrete "gated at 0" metrics: a zero best prior means ANY nonzero
