@@ -544,6 +544,21 @@ def main():
         line.update(online_run(feed=_feed_watchdog))
     except Exception as e:
         sys.stderr.write("bench: online leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
+    # routed-MoE leg (mxnet_tpu.moe, ISSUE 19): fused-step time vs the
+    # FLOP-matched dense equivalent (moe_step_ms / moe_dense_step_ms,
+    # both lower-is-better — the routed block spends k/E of the dense
+    # FLOPs and must beat it), trained-router expert imbalance
+    # (moe_expert_imbalance, absolute ceiling 4.0 — a collapsed router
+    # un-earns the speedup) and routed decode throughput through
+    # DecodeEngine + MoEServeParityPass, parity-checked token-for-token
+    # against a numpy no-drop reference (moe_serve_tok_s)
+    try:
+        from bench_moe import run as moe_run
+        _feed_watchdog("moe")
+        line.update(moe_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: moe leg failed (%s)\n" % e)
     _wd.stop()
     print(json.dumps(line), flush=True)
 

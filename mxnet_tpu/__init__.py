@@ -60,6 +60,7 @@ from . import compile_cache
 from . import passes
 from . import autotune
 from . import embed
+from . import moe
 from . import predictor
 from . import serve
 from . import trace

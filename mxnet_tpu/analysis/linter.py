@@ -45,6 +45,13 @@ unsealed-replay    ``np.load``/``np.fromfile`` in a capture-shard
                    data (PR 17: replay readers must check
                    ``is_sealed``/``sealed_shards`` first, mirroring
                    the checkpoint COMMIT discipline)
+moe-raw-scatter    ``.at[].add``/``segment_sum`` scatter-accumulates
+                   outside ``mxnet_tpu/moe/`` and the embed choke
+                   files — a raw scatter-add wraps or clamps
+                   out-of-range indices onto LIVE expert/embedding
+                   rows (ISSUE 19; the PR 12 pad-bug class); writes
+                   ride ``moe.dispatch`` / ``embed.sparse``, which
+                   fold overflow to a dropped sentinel
 
 Suppressions
 ------------
@@ -596,6 +603,50 @@ def _rule_unsealed_replay(ctx: _Ctx) -> Iterable[Finding]:
                 "checkpoint COMMIT discipline")
 
 
+_SEGMENT_SUMS = {"jax.ops.segment_sum", "ops.segment_sum",
+                 "jops.segment_sum"}
+# the scatter choke points: capacity-bucketed dispatch (sentinel-fold,
+# mode="drop") and the sparse-embed grad path (capped-unique dedup)
+_SCATTER_CHOKE = ("mxnet_tpu/moe/", "mxnet_tpu/embed/sparse.py",
+                  "mxnet_tpu/embed/table.py")
+
+
+def _rule_moe_raw_scatter(ctx: _Ctx) -> Iterable[Finding]:
+    """``.at[...].add(...)`` / ``segment_sum`` scatter-accumulates
+    outside the dispatch/embed choke points: a raw scatter-add onto an
+    expert or row buffer bypasses the sentinel-fold discipline (ISSUE
+    19 / the PR 12 pad-bug class) — an out-of-range or dropped index
+    wraps (negatives) or clamps onto a LIVE row and silently corrupts
+    it with traffic the row never accepted.  In-place ``.at[].set``
+    writes (paged KV cache, slot zeroing) are not accumulates and stay
+    legal."""
+    if ctx.rel.startswith(_SCATTER_CHOKE):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "add" \
+                and isinstance(f.value, ast.Subscript) \
+                and isinstance(f.value.value, ast.Attribute) \
+                and f.value.value.attr == "at":
+            yield ctx.finding(
+                "moe-raw-scatter", node,
+                "raw .at[].add scatter-accumulate — expert/row buffers "
+                "are written only through the choke points "
+                "(moe.dispatch.dispatch, embed.sparse grad fold) where "
+                "sentinel-fold + mode=\"drop\" keep dropped traffic out "
+                "of live rows; route through them or suppress with why "
+                "this buffer has no out-of-range indices")
+        elif isinstance(f, ast.Attribute) and _dotted(f) in _SEGMENT_SUMS:
+            yield ctx.finding(
+                "moe-raw-scatter", node,
+                "raw segment_sum scatter-accumulate outside the "
+                "moe.dispatch / embed.sparse choke points — same "
+                "wrapped-index corruption class as .at[].add (see "
+                "moe-raw-scatter)")
+
+
 RULES = {
     "donated-aliasing": _rule_donated_aliasing,
     "raw-jit": _rule_raw_jit,
@@ -607,6 +658,7 @@ RULES = {
     "raw-retry": _rule_raw_retry,
     "decode-host-sync": _rule_decode_host_sync,
     "unsealed-replay": _rule_unsealed_replay,
+    "moe-raw-scatter": _rule_moe_raw_scatter,
 }
 
 

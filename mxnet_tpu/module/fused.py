@@ -200,6 +200,19 @@ class FusedTrainStep:
         self._embed_stats_every = max(
             1, get_env("MXNET_EMBED_STATS_EVERY", 1, int))
         self._embed_stats_n = 0
+        # routed-MoE blocks: graph-side detection registers the stats
+        # consumer (per-expert traffic lands here from bench/serve
+        # samplers — routing is data-dependent, so there is nothing to
+        # sample host-side per step) and stamps each block's routing
+        # geometry into the program descriptor
+        from ..moe.detect import find_moe_blocks
+        self.moe_blocks = find_moe_blocks(symbol)
+        self.moe_stats = None
+        if self.moe_blocks:
+            from ..moe.stats import MoeStats
+            from .. import profiler as _prof
+            self.moe_stats = MoeStats("fused")
+            _prof.register_moe_stats(self.moe_stats)
         # static per-param schedule factors (reference lr_mult/wd_mult and
         # the bias/gamma/beta wd rule, resolved by NAME not index)
         self._lr_mult = {n: optimizer._name_lr_mult(n) for n in self.train_names}
@@ -754,6 +767,10 @@ class FusedTrainStep:
                      # program
                      repr(sorted((n, sp.describe())
                                  for n, sp in self.sparse_embeds.items())),
+                     # MoE routing geometry: belt-and-braces with the
+                     # symbol json, same as the embed specs
+                     repr(sorted((n, sp.describe())
+                                 for n, sp in self.moe_blocks.items())),
                      repr([int(d.id) for d in self.mesh.devices.ravel()]),
                      repr(self.train_names), repr(self.fixed_names),
                      repr(sorted(self.label_shapes.items()))):

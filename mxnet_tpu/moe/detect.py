@@ -1,0 +1,57 @@
+"""Graph-side detection of MoE blocks.
+
+The fused train step asks: does this symbol route tokens through
+``_moe_dispatch``?  If so it registers a ``MoeStats`` with the profiler
+and folds each block's routing geometry into the compile-cache program
+descriptor — two graphs that differ only in an expert count or capacity
+factor can never alias a compiled program (the geometry is also in the
+serialized symbol json, so this is belt-and-braces the same way the
+embed specs are).  Serving uses the same walk to find the blocks whose
+capacity the ``MoEServeParityPass`` pins to the no-drop setting.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["MoEBlockSpec", "find_moe_blocks"]
+
+
+class MoEBlockSpec:
+    """One routed block: its name and static routing geometry."""
+
+    __slots__ = ("name", "num_experts", "k", "capacity_factor",
+                 "renormalize")
+
+    def __init__(self, name: str, num_experts: int, k: int,
+                 capacity_factor: float, renormalize: bool):
+        self.name = name
+        self.num_experts = int(num_experts)
+        self.k = int(k)
+        self.capacity_factor = float(capacity_factor)
+        self.renormalize = bool(renormalize)
+
+    def describe(self):
+        """Stable tuple for compile-cache fast keys."""
+        return (self.name, self.num_experts, self.k,
+                self.capacity_factor, self.renormalize)
+
+    def __repr__(self):
+        return ("MoEBlockSpec(name=%r, E=%d, k=%d, cf=%g, renorm=%r)"
+                % (self.name, self.num_experts, self.k,
+                   self.capacity_factor, self.renormalize))
+
+
+def find_moe_blocks(symbol) -> Dict[str, MoEBlockSpec]:
+    """``{dispatch_node_name: MoEBlockSpec}`` for every ``_moe_dispatch``
+    node reachable from ``symbol``'s heads."""
+    from ..symbol import _topo
+    out: Dict[str, MoEBlockSpec] = {}
+    for node in _topo(symbol._heads):
+        if node.is_variable or \
+                getattr(node.op, "name", "") != "_moe_dispatch":
+            continue
+        p = node.params
+        out[node.name] = MoEBlockSpec(
+            node.name, p.num_experts, p.k, p.capacity_factor,
+            p.renormalize)
+    return out

@@ -1,0 +1,91 @@
+"""Capacity-bucketed dispatch/combine — THE expert-buffer scatter choke.
+
+Pure-jnp primitives shared by training (inside the fused step's traced
+graph) and serving (inside the decode symbol).  All writes into an
+expert buffer in this tree go through ``dispatch`` here or the embed
+engine's ``embed.sparse`` scatters — enforced by the linter's
+``moe-raw-scatter`` rule, because the sentinel-fold bug class (PR 12)
+must have exactly one implementation per subsystem.
+
+Sharding: these are plain gathers/scatters with no mesh plumbing.  When
+the expert tensors are sharded over an ``ep``/``tp`` axis (layer.py's
+``expert_axis=``) and tokens are dp-sharded, GSPMD reshards the buffer
+between the token layout and the expert layout — the all-to-all family
+in ``multichip_report()``'s collective census.
+
+When called eagerly (serving probes, bench, tests) the primitives emit
+``moe:dispatch`` / ``moe:combine`` trace spans plus a per-call
+``moe:expert_occupancy`` counter; under a jit trace they stay silent —
+host-side timing of a traced region would record tracing, not compute.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import trace
+
+__all__ = ["dispatch", "combine"]
+
+
+def _eager(*xs) -> bool:
+    return not any(isinstance(x, jax.core.Tracer) for x in xs)
+
+
+def dispatch(x, slot, num_experts: int, capacity: int):
+    """Scatter ``(T, D)`` tokens into the ``(E, C, D)`` expert buffer.
+
+    ``slot`` is the routing plan's ``(T, k)`` flat bucket index in
+    ``[0, E*C]``.  Slots below the sentinel are unique by construction
+    (one position-in-expert per accepted choice), so this is a pure
+    ``set`` scatter; the sentinel ``E*C`` is out of range and
+    ``mode="drop"`` discards it — a dropped token touches no expert.
+    """
+    E, C = int(num_experts), int(capacity)
+    T, D = x.shape
+    k = slot.shape[1]
+
+    def impl():
+        buf = jnp.zeros((E * C, D), dtype=x.dtype)
+        rows = jnp.broadcast_to(x[:, None, :], (T, k, D)).reshape(T * k, D)
+        buf = buf.at[slot.reshape(T * k)].set(rows, mode="drop",
+                                              unique_indices=True)
+        return buf.reshape(E, C, D)
+
+    if not _eager(x, slot):
+        return impl()
+    with trace.span("moe:dispatch", cat="moe", tokens=int(T),
+                    experts=E, capacity=C):
+        out = jax.block_until_ready(impl())
+    occ = jnp.bincount(jnp.minimum(slot.reshape(-1) // C, E),
+                       length=E + 1)[:E]
+    trace.counter("moe:expert_occupancy", cat="moe",
+                  **{"e%d" % i: int(occ[i]) for i in range(E)})
+    return out
+
+
+def combine(expert_out, slot, weight, num_experts: int, capacity: int):
+    """Gather ``(E, C, Dout)`` expert outputs back to ``(T, Dout)``.
+
+    The gather clips the sentinel slot to the last real row, then the
+    explicit ``slot < E*C`` mask zeroes it — folded tokens read zero by
+    construction even if a caller hands in non-zero weights, keeping the
+    read side of the sentinel discipline independent of the write side.
+    """
+    E, C = int(num_experts), int(capacity)
+    n = E * C
+    T, k = slot.shape
+
+    def impl():
+        flat = expert_out.reshape(n, expert_out.shape[-1])
+        rows = jnp.take(flat, jnp.minimum(slot, n - 1).reshape(T * k),
+                        axis=0).reshape(T, k, -1)
+        live = (slot < n)[..., None].astype(flat.dtype)
+        w = weight[..., None].astype(flat.dtype)
+        return (rows * live * w).sum(axis=1)
+
+    if not _eager(expert_out, slot, weight):
+        return impl()
+    with trace.span("moe:combine", cat="moe", tokens=int(T),
+                    experts=E, capacity=C):
+        return jax.block_until_ready(impl())

@@ -1,0 +1,123 @@
+"""``MoEFeedForward``: the routed-expert block at the symbol level.
+
+One call builds gate -> ``_moe_dispatch`` -> ``_moe_expert_ffn`` ->
+``_moe_combine`` and returns the combined ``(T, D)`` output symbol.
+The load-balance aux loss stays an un-consumed extra output of the
+dispatch node until ``with_aux_loss(net)`` groups ``MakeLoss`` heads
+onto the final symbol — at which point the fused train step's vjp
+trains the router and the superstep scan accumulates the loss value
+on-device like any metric (no fused-step special cases).
+
+Sharding: ``expert_axis="ep"`` stamps ``__sharding__`` attrs on the
+stacked expert tensors (row-sharded over the named mesh axis, the same
+layout a row-sharded embedding table uses), which
+``parallel.sharding_attrs`` feeds into the fused step's GSPMD
+constraints — dispatch/combine reshard as collectives in
+``multichip_report()``'s census.  The gate stays replicated.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..base import get_env
+from .. import symbol as _sym
+
+__all__ = ["MoEFeedForward", "aux_loss_symbols", "count_symbols",
+           "hit_symbols", "with_aux_loss"]
+
+# _moe_dispatch output indices (ops/moe.py list_outputs)
+_AUX_IDX = 3
+_COUNTS_IDX = 4
+_HITS_IDX = 5
+
+
+def MoEFeedForward(data, num_hidden: int, num_experts: int, k: int = 2,
+                   capacity_factor: Optional[float] = None,
+                   name: str = "moe", act_type: str = "relu",
+                   renormalize: bool = False, output_dim: int = 0,
+                   no_bias: bool = False,
+                   expert_axis: Optional[str] = None):
+    """Build one routed MoE feed-forward block over ``data`` (T, D).
+
+    ``capacity_factor`` None reads ``MXNET_MOE_CAPACITY_FACTOR``
+    (default 0 = no dropping); ``expert_axis`` names the mesh axis the
+    stacked expert weights shard over (None = replicated).  Returns the
+    combined output symbol; recover the aux-loss / counts heads with
+    ``aux_loss_symbols`` / ``count_symbols`` or attach them in one move
+    with ``with_aux_loss``.
+    """
+    if capacity_factor is None:
+        capacity_factor = get_env("MXNET_MOE_CAPACITY_FACTOR", 0.0, float)
+    logits = _sym.FullyConnected(data, num_hidden=num_experts,
+                                 no_bias=True, name=name + "_gate")
+    disp = _sym._moe_dispatch(data, logits, num_experts=num_experts,
+                              k=k, capacity_factor=capacity_factor,
+                              renormalize=renormalize,
+                              name=name + "_dispatch")
+
+    def expert_var(suffix, spec):
+        attr = {"__sharding__": spec} if expert_axis else None
+        return _sym.Variable("%s_experts_%s" % (name, suffix), attr=attr)
+
+    row3 = "%s,None,None" % expert_axis
+    row2 = "%s,None" % expert_axis
+    args = [disp[0], expert_var("i2h_weight", row3)]
+    if not no_bias:
+        args.append(expert_var("i2h_bias", row2))
+    args.append(expert_var("h2o_weight", row3))
+    if not no_bias:
+        args.append(expert_var("h2o_bias", row2))
+    ffn = _sym._moe_expert_ffn(*args, num_hidden=num_hidden,
+                               output_dim=output_dim, act_type=act_type,
+                               no_bias=no_bias, name=name + "_experts")
+    return _sym._moe_combine(ffn, disp[1], disp[2],
+                             name=name + "_combine")
+
+
+def _dispatch_heads(symbol, out_idx: int) -> List:
+    from ..symbol import Symbol, _topo
+    heads = []
+    for node in _topo(symbol._heads):
+        if not node.is_variable and \
+                getattr(node.op, "name", "") == "_moe_dispatch":
+            heads.append(Symbol([(node, out_idx)]))
+    return heads
+
+
+def aux_loss_symbols(symbol) -> List:
+    """The ``(1,)`` load-balance aux-loss head of every MoE block
+    reachable from ``symbol``, in topological order."""
+    return _dispatch_heads(symbol, _AUX_IDX)
+
+
+def count_symbols(symbol) -> List:
+    """The ``(E,)`` per-expert accepted-count head of every MoE block
+    (stop-gradient — a stats/metric output, never a loss)."""
+    return _dispatch_heads(symbol, _COUNTS_IDX)
+
+
+def hit_symbols(symbol) -> List:
+    """The ``(T, E)`` per-token accepted-assignment head of every MoE
+    block (stop-gradient).  A decode graph adds this onto its per-slot
+    ``moe_hits`` state variable — ``DecodeEngine(moe_hits_state=...)``
+    then samples the running histogram into ``moe_report()``."""
+    return _dispatch_heads(symbol, _HITS_IDX)
+
+
+def with_aux_loss(net, grad_scale: Optional[float] = None):
+    """Group ``MakeLoss`` heads for every MoE block's aux loss onto
+    ``net``.  ``grad_scale`` None reads ``MXNET_MOE_AUX_COEF`` (default
+    0.01).  The forward value stays the raw balance score (a uniform
+    router reads 1.0) so metrics see it unscaled; only the injected
+    gradient is scaled.  Returns ``net`` unchanged when the graph has
+    no MoE blocks."""
+    if grad_scale is None:
+        grad_scale = get_env("MXNET_MOE_AUX_COEF", 0.01, float)
+    auxes = aux_loss_symbols(net)
+    if not auxes:
+        return net
+    heads = [net]
+    for i, aux in enumerate(auxes):
+        heads.append(_sym.MakeLoss(aux, grad_scale=float(grad_scale),
+                                   name="%s_aux" % aux._heads[0][0].name))
+    return _sym.Group(heads)

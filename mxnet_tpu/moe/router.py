@@ -1,0 +1,112 @@
+"""Top-k softmax routing with capacity-factor dropping (sentinel-fold).
+
+Pure jnp, shape-static, trace-safe: every array in the routing plan has
+a shape fixed by (tokens, experts, k, capacity), so the fused train step
+and the decode engine compile it once per geometry.  Overflow handling
+follows the embed engine's sentinel discipline (embed/sparse.py):
+instead of clamping an over-capacity token onto some expert row (the
+PR 12 pad-bug class), its dispatch slot folds to the single out-of-range
+sentinel ``num_experts * capacity`` — the scatter drops it, the combine
+masks it, and its gate weight is zeroed, so dropped traffic is exactly
+absent rather than approximately present.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["resolve_capacity", "route", "RoutingPlan"]
+
+
+def resolve_capacity(capacity_factor: float, n_tokens: int,
+                     num_experts: int, k: int) -> int:
+    """Static per-expert bucket size for a routing geometry.
+
+    ``capacity_factor <= 0`` means no dropping: the bucket holds the
+    worst case (every token lands on the same expert), i.e. ``C =
+    n_tokens``.  Otherwise ``C = ceil(cf * n_tokens * k / num_experts)``
+    — the perfectly-balanced load times the slack factor — clamped to
+    ``[1, n_tokens]``.  Mirrors ``embed.sparse.resolve_cap``.
+    """
+    n_tokens = int(n_tokens)
+    worst = max(1, n_tokens)
+    if capacity_factor is None or capacity_factor <= 0:
+        return worst
+    cap = int(math.ceil(float(capacity_factor) * n_tokens * int(k)
+                        / float(max(1, int(num_experts)))))
+    return max(1, min(worst, cap))
+
+
+class RoutingPlan(NamedTuple):
+    """Everything downstream of the gate, shapes static per geometry.
+
+    ``slot``    (T, k) int32 in ``[0, E*C]``; ``E*C`` IS the sentinel —
+                out of range for the ``(E*C, D)`` dispatch buffer, so
+                the scatter's ``mode="drop"`` discards it
+    ``weight``  (T, k) f32 combine weights; exactly 0.0 on folded slots
+    ``counts``  (E,) f32 tokens accepted per expert (post-capacity)
+    ``assigned``(E,) f32 tokens routed per expert (pre-capacity)
+    ``hits``    (T, E) f32 per-token accepted-assignment one-hots
+                (sums to ``counts`` over tokens) — the per-slot routing
+                state a decode graph accumulates
+    ``aux``     () f32 load-balance loss (GShard/Switch form:
+                ``E * sum(mean_gate_prob * dispatch_frac)``)
+    ``dropped`` () f32 token-choice pairs folded to the sentinel
+    """
+    slot: jax.Array
+    weight: jax.Array
+    counts: jax.Array
+    assigned: jax.Array
+    hits: jax.Array
+    aux: jax.Array
+    dropped: jax.Array
+
+
+def route(logits, k: int, capacity: int,
+          renormalize: bool = False) -> RoutingPlan:
+    """Route ``(T, E)`` gate logits into capacity buckets.
+
+    Priority is GShard's: all first choices (across tokens, in batch
+    order) claim capacity before any second choice — position-in-expert
+    is a cumulative sum over the ``(k, T)``-flattened one-hot assignment
+    matrix.  Deterministic, shape-static, and independent of data
+    values except through the top-k itself.
+    """
+    T, E = logits.shape
+    k = int(k)
+    capacity = int(capacity)
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_k, expert_k = jax.lax.top_k(gates, k)            # (T, k)
+    if renormalize:
+        gate_k = gate_k / jnp.maximum(
+            gate_k.sum(axis=-1, keepdims=True), jnp.float32(1e-9))
+    # one-hot assignments ordered (choice-rank, token): cumsum gives each
+    # (token, choice) its position within the chosen expert's bucket
+    onehot = jax.nn.one_hot(expert_k, E, dtype=jnp.int32)  # (T, k, E)
+    flat = onehot.transpose(1, 0, 2).reshape(k * T, E)     # (k*T, E)
+    running = jnp.cumsum(flat, axis=0) - flat
+    pos = (running * flat).sum(axis=-1).reshape(k, T).transpose(1, 0)
+    over = pos >= capacity                                 # (T, k)
+    sentinel = jnp.int32(E * capacity)
+    slot = jnp.where(over, sentinel,
+                     (expert_k * capacity + pos).astype(jnp.int32))
+    weight = jnp.where(over, jnp.float32(0.0), gate_k)
+    assigned = flat.sum(axis=0).astype(jnp.float32)        # (E,)
+    counts = jnp.minimum(assigned, jnp.float32(capacity))
+    hits = (onehot.astype(jnp.float32)
+            * (~over)[..., None].astype(jnp.float32)).sum(axis=1)
+    dropped = over.sum().astype(jnp.float32)
+    # load balance: mean gate mass per expert x fraction of routed
+    # choices per expert, scaled by E so a uniform router scores 1.0
+    me = gates.mean(axis=0)
+    ce = assigned / jnp.float32(max(1, T * k))
+    aux = (me * ce).sum() * jnp.float32(E)
+    return RoutingPlan(slot=slot, weight=weight,
+                       counts=jax.lax.stop_gradient(counts),
+                       assigned=jax.lax.stop_gradient(assigned),
+                       hits=jax.lax.stop_gradient(hits),
+                       aux=aux,
+                       dropped=jax.lax.stop_gradient(dropped))

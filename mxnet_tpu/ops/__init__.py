@@ -12,6 +12,7 @@ from . import special  # noqa: F401 (registers ROIPooling/SpatialTransformer/Cor
 from . import rnn     # noqa: F401  (registers the fused scan-based RNN)
 from . import quantized  # noqa: F401 (registers q/dq + int8 matmul/conv)
 from . import fused   # noqa: F401  (registers the epilogue-fused op family)
+from . import moe     # noqa: F401  (registers the routed-MoE dispatch family)
 
 __all__ = ["OpDef", "OpContext", "Param", "register_op", "register_simple_op",
            "get_op", "list_ops"]

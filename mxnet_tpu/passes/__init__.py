@@ -14,6 +14,8 @@ rewrites the graph BEFORE the compiler sees it —
                                  (+ ``_contrib_quantize``) -> one
                                  ``_fused_*`` op (TVM's epilogue fusion)
 * ``ElementwiseFusePass``        elementwise chains -> ``_fused_elemwise``
+* ``MoEServeParityPass``         ``_moe_dispatch`` capacity pinned to
+                                 no-drop on serving graphs (moe parity)
 
 with per-pass trace spans and ``mx.profiler.passes_report()``, a
 round-trip + attr-preservation verifier after every pass, and a pipeline
@@ -41,6 +43,7 @@ from .graph_passes import (CSEPass, DeadNodeEliminationPass,
                            tensor_name)
 from .calibrate import CalibrationTable, calibrate, calibrate_arrays
 from .embed import SparseEmbedPass, default_embed_dedup
+from .moe import MoEServeParityPass, default_moe_exact
 from .fuse import (ElementwiseFusePass, FuseEpiloguePass, default_fuse,
                    fusion_passes)
 from .quantize import (QuantizePass, build_serving_pipeline,
@@ -54,6 +57,7 @@ __all__ = [
     "U8WirePass", "rebuild", "tensor_name",
     "ElementwiseFusePass", "FuseEpiloguePass", "default_fuse",
     "fusion_passes", "SparseEmbedPass", "default_embed_dedup",
+    "MoEServeParityPass", "default_moe_exact",
     "CalibrationTable", "calibrate", "calibrate_arrays",
     "QuantizePass", "build_serving_pipeline", "default_fallback_dtype",
     "default_inference_pipeline", "default_quantize_ops", "quantize_model",

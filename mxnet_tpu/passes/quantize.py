@@ -386,7 +386,8 @@ def default_inference_pipeline(quantize: Optional[QuantizePass] = None,
                                fuse=None,
                                name: str = "inference",
                                verify: bool = True,
-                               embed_dedup=None) -> PassPipeline:
+                               embed_dedup=None,
+                               moe_exact=None) -> PassPipeline:
     """The serving pipeline: [u8 wire] -> fold -> cse -> dce ->
     [quantize] -> [fuse].  Order matters: the u8 prologue must exist
     before calibration sees the graph; folds/CSE/DCE shrink what
@@ -402,6 +403,16 @@ def default_inference_pipeline(quantize: Optional[QuantizePass] = None,
     passes += [FoldConstantsPass(), CSEPass(), DeadNodeEliminationPass()]
     if quantize is not None:
         passes.append(quantize)
+    if moe_exact is None:
+        from .moe import default_moe_exact
+        moe_exact = default_moe_exact()
+    if moe_exact:
+        # no-op on MoE-free graphs; on routed graphs, pin serve-time
+        # capacity to no-drop so responses don't depend on batch
+        # composition (see passes.moe).  Before fusion: it only edits
+        # _moe_dispatch attrs, and fusion must stay last.
+        from .moe import MoEServeParityPass
+        passes.append(MoEServeParityPass())
     passes += fusion_passes(fuse)
     if embed_dedup:
         from .embed import SparseEmbedPass

@@ -32,7 +32,8 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "register_superstep_stats", "superstep_report",
            "superstep_report_str", "register_serve_stats", "serve_report",
            "serve_report_str", "register_embed_stats", "embed_report",
-           "embed_report_str", "compile_report", "compile_report_str",
+           "embed_report_str", "register_moe_stats", "moe_report",
+           "moe_report_str", "compile_report", "compile_report_str",
            "register_passes_stats", "passes_report", "passes_report_str",
            "register_autotune_stats", "autotune_report",
            "autotune_report_str", "register_faults_stats",
@@ -601,6 +602,32 @@ def embed_report_str() -> str:
     return _embed_registry.report_str()
 
 
+# -- MoE instrumentation (mxnet_tpu.moe) ------------------------------------
+# Every MoE consumer (a FusedTrainStep whose graph routes through
+# _moe_dispatch, a DecodeEngine sampling its per-slot routing state)
+# registers its MoeStats at construction, weakly like the rest;
+# moe_report() shows per-block expert hit histograms, the max/mean
+# imbalance bench gates as moe_expert_imbalance, and the dropped
+# fraction the capacity factor buys.
+_moe_registry = _Registry("moe", "(no live MoE blocks)")
+
+
+def register_moe_stats(moe_stats) -> None:
+    """Called by FusedTrainStep / DecodeEngine on construction."""
+    _moe_registry.register(moe_stats)
+
+
+def moe_report() -> dict:
+    """{consumer key: per-block routing counters} for every live MoE
+    consumer."""
+    return _moe_registry.report()
+
+
+def moe_report_str() -> str:
+    """Human-readable per-block expert-traffic table."""
+    return _moe_registry.report_str()
+
+
 # -- pass-pipeline instrumentation (mxnet_tpu.passes) ------------------------
 # Every PassPipeline registers its PassStats at construction; one
 # passes_report() shows, per live pipeline, the per-pass wall time, node
@@ -736,6 +763,7 @@ def unified_report() -> dict:
         "checkpoint": checkpoint_report(),
         "serve": serve_report(),
         "embed": embed_report(),
+        "moe": moe_report(),
         "passes": passes_report(),
         "autotune": autotune_report(),
         "faults": faults_report(),
@@ -759,6 +787,7 @@ def unified_report_str() -> str:
         ("checkpoint", checkpoint_report_str),
         ("serve", serve_report_str),
         ("embed", embed_report_str),
+        ("moe", moe_report_str),
         ("passes", passes_report_str),
         ("autotune", autotune_report_str),
         ("faults", faults_report_str),

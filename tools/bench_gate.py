@@ -90,6 +90,11 @@ DEFAULT_LOWER_IS_BETTER = {
     "dist_host_recovery_s", "shardsearch_vs_hand_frac",
     "shardsearch_cnn_hand_step_ms", "shardsearch_cnn_auto_step_ms",
     "shardsearch_lstm_hand_step_ms", "shardsearch_lstm_auto_step_ms",
+    # ISSUE 19 routed-MoE leg: fused step times for the routed block
+    # and its FLOP-matched dense equivalent; their RATIO
+    # (moe_step_speedup) gates higher-is-better like every speedup, and
+    # moe_expert_imbalance is absolutely ceilinged below
+    "moe_step_ms", "moe_dense_step_ms",
 }
 
 # Discrete "gated at 0" metrics: a zero best prior means ANY nonzero
@@ -111,6 +116,11 @@ ZERO_FLOOR = {
 # more than that would quietly tax every request to feed retraining.
 ABS_CEILING = {
     "online_capture_overhead_frac": 0.02,
+    # moe_expert_imbalance: max/mean expert hits of the trained router
+    # (1.0 = balanced).  A router collapsing onto few experts starves
+    # the rest and un-earns the routed speedup — worse than 4x-on-8
+    # is a balance regression regardless of any prior run.
+    "moe_expert_imbalance": 4.0,
 }
 
 
