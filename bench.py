@@ -559,6 +559,20 @@ def main():
         line.update(moe_run(feed=_feed_watchdog))
     except Exception as e:
         sys.stderr.write("bench: moe leg failed (%s)\n" % e)
+    _PARTIAL_LINE = dict(line)
+    # joint-autotune leg (mxnet_tpu.autotune, ISSUE 20): cold-host
+    # joint fit search in an isolated store — winner's measured step
+    # cost vs the K=1 defaults (autotune_joint_speedup), search wall
+    # time and its amortization horizon (autotune_search_s /
+    # autotune_amortize_steps, both lower-is-better), plus a full
+    # Pallas kernel-search sweep whose bitwise-parity-gate failure
+    # count must stay at exactly zero (kernelsearch_parity_fail)
+    try:
+        from bench_tune import run as tune_run
+        _feed_watchdog("tune")
+        line.update(tune_run(feed=_feed_watchdog))
+    except Exception as e:
+        sys.stderr.write("bench: tune leg failed (%s)\n" % e)
     _wd.stop()
     print(json.dumps(line), flush=True)
 

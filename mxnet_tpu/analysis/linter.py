@@ -52,6 +52,12 @@ moe-raw-scatter    ``.at[].add``/``segment_sum`` scatter-accumulates
                    rows (ISSUE 19; the PR 12 pad-bug class); writes
                    ride ``moe.dispatch`` / ``embed.sparse``, which
                    fold overflow to a dropped sentinel
+raw-pallas-call    ``pl.pallas_call`` outside ``ops/pallas_kernels`` —
+                   shipped kernels live in ONE module so the kernel
+                   search's bitwise parity gate covers every tiling
+                   the repo runs (ISSUE 20); a stray pallas_call is
+                   an unsearched, ungated kernel (the rtc user-kernel
+                   passthrough carries inline suppressions)
 
 Suppressions
 ------------
@@ -269,6 +275,29 @@ def _rule_raw_jit(ctx: _Ctx) -> Iterable[Finding]:
                 "jax.jit bypasses compile_cache.cached_jit — route through "
                 "the persistent executable cache, or suppress with the "
                 "serialization reason (donation layout / pallas)")
+
+
+_PALLAS_CALLS = ("pl.pallas_call", "pallas.pallas_call",
+                 "jax.experimental.pallas.pallas_call")
+
+
+def _rule_raw_pallas_call(ctx: _Ctx) -> Iterable[Finding]:
+    """pallas_call outside ops/pallas_kernels: the kernel search's
+    parity gate (ISSUE 20) only covers kernels it can enumerate — every
+    shipped tiling lives in the one module whose candidates are
+    bitwise-checked against jnp twins before a winner persists.  A
+    pallas_call elsewhere is an unsearched, ungated kernel."""
+    if ctx.rel.startswith("mxnet_tpu/ops/pallas_kernels"):
+        return
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute) and _dotted(node) in _PALLAS_CALLS:
+            yield ctx.finding(
+                "raw-pallas-call", node,
+                "pallas_call outside ops/pallas_kernels — shipped kernels "
+                "live there so the kernel search's parity gate covers "
+                "them; add the kernel to ops/pallas_kernels (plus a "
+                "kernelsearch candidate space), or suppress with the "
+                "reason it cannot ride the gated module")
 
 
 def _rule_raw_dist_init(ctx: _Ctx) -> Iterable[Finding]:
@@ -659,6 +688,7 @@ RULES = {
     "decode-host-sync": _rule_decode_host_sync,
     "unsealed-replay": _rule_unsealed_replay,
     "moe-raw-scatter": _rule_moe_raw_scatter,
+    "raw-pallas-call": _rule_raw_pallas_call,
 }
 
 

@@ -27,10 +27,13 @@ Entry points::
 
     Module.fit(..., autotune=True)     # tunes superstep K
     ServeEngine(..., autotune=True)    # tunes the pass-pipeline variant
-    MXNET_AUTOTUNE=1                   # same, via env
+    Module.fit(autotune="joint")       # joint space, cost-model-ranked
+    ServeEngine(autotune="joint")      # fuse x bucket grid x quantize ops
+    MXNET_AUTOTUNE=1 / =joint          # same, via env
     mx.profiler.autotune_report_str()  # what was decided, from what
 
-See docs/fusion.md ("Autotuning") for the workflow.
+See docs/autotune.md for the joint-space workflow and the cost-model
+lifecycle; docs/fusion.md ("Autotuning") for the per-axis tuners.
 """
 from __future__ import annotations
 
@@ -48,8 +51,9 @@ from .tuner import Autotuner, AutotuneStats, select_best
 __all__ = ["Autotuner", "AutotuneStats", "select_best", "tuning_key",
            "backend_descriptor", "measure_candidate", "timed_span",
            "store_dir", "config_path", "load_config", "save_config",
-           "list_configs", "enabled", "tune_superstep",
-           "tune_serve_pipeline", "CANDIDATE_SPAN"]
+           "list_configs", "enabled", "mode", "tune_superstep",
+           "tune_serve_pipeline", "JointTuner", "tune_fit_joint",
+           "tune_serve_joint", "default_shortlist", "CANDIDATE_SPAN"]
 
 # the profiler registry holds stats weakly (live-object reporting); a
 # tuning run is an EVENT, so keep the last N strongly here or every
@@ -71,6 +75,24 @@ def enabled(flag=None) -> bool:
     if flag is not None:
         return bool(flag)
     return get_env("MXNET_AUTOTUNE", False, bool)
+
+
+def mode(flag=None):
+    """Resolve an ``autotune=`` argument to a tuning MODE: ``"joint"``
+    (rank the joint space with the cost model, measure a shortlist),
+    ``"measure"`` (PR 11's brute per-axis measurement — what ``True``
+    means), or None (off).  ``MXNET_AUTOTUNE=joint`` selects joint via
+    env, any other truthy env value selects measure."""
+    if flag is None:
+        env = get_env("MXNET_AUTOTUNE", "", str)
+        if env in ("", "0", "false", "False"):
+            return None
+        return "joint" if env == "joint" else "measure"
+    if isinstance(flag, str):
+        if not flag:
+            return None
+        return flag if flag == "joint" else "measure"
+    return "measure" if flag else None
 
 
 # -- fit-side tuning: superstep K --------------------------------------------
@@ -95,7 +117,8 @@ def _zero_batch(module):
                             for _, s in (module._label_shapes or [])])
 
 
-def _measure_superstep(module, k: int, trials: int) -> float:
+def _measure_superstep(module, k: int, trials: int,
+                       unroll: int = 1) -> float:
     """Seconds per TRAINING STEP at superstep K, measured by dispatching
     the real (warm) program on a COPY of the live train state — the
     donated copy is discarded, so measurement never advances training
@@ -122,7 +145,7 @@ def _measure_superstep(module, k: int, trials: int) -> float:
                                  warmup=1, setup=setup)
     _k, mega = fused.make_megabatch([_zero_batch(module)
                                      for _ in range(k)])
-    prog = fused.build_superstep(k, None)
+    prog = fused.build_superstep(k, None, unroll=unroll)
     lr = float(module._optimizer.base_lr())
     lrs = jax.device_put(np.asarray([lr] * k, np.float32),
                          fused._replicated())
@@ -132,7 +155,8 @@ def _measure_superstep(module, k: int, trials: int) -> float:
         jax.block_until_ready(
             next(iter(new_state["params"].values()), new_state["t"]))
 
-    return measure_candidate(run, label="superstep=%d" % k, trials=trials,
+    return measure_candidate(run, label="superstep=%d,unroll=%d"
+                             % (k, unroll), trials=trials,
                              warmup=1, setup=setup) / k
 
 
@@ -240,3 +264,10 @@ def tune_serve_pipeline(symbol_json: str, params: Dict,
               "backend": backend_descriptor()})
     fuse = bool(best["fuse"])
     return fuse, built.get(fuse)
+
+
+# -- joint-space tuning (cost-model-ranked; see joint.py) --------------------
+# imported LAST: joint builds on everything above (and lazily imports
+# _measure_superstep/_zero_batch back from here)
+from .joint import (JointTuner, default_shortlist,  # noqa: E402
+                    tune_fit_joint, tune_serve_joint)

@@ -1,0 +1,401 @@
+"""Joint-space tuning: rank with the shared cost model, measure a
+shortlist.
+
+PR 11's tuners brute-measure one axis at a time; ``dist.shardsearch``
+(PR 18) proved the scaling move on one axis — score candidates
+analytically, measure only a shortlist.  :class:`JointTuner` is that
+loop generalized over ANY joint candidate space, with the scorer being
+``autotune.costmodel`` (analytic roofline + learned residual trained on
+the store's own logs):
+
+1. store lookup (``model_version``-stamped — a cost-model bump never
+   resurrects a winner ranked by the old model); a hit applies with
+   ZERO featurize/measure calls and zero XLA compiles;
+2. otherwise: optional parity ``gate`` over every candidate
+   (kernelsearch), featurize survivors, rank by predicted cost, measure
+   only the top-``MXNET_AUTOTUNE_SHORTLIST`` through compile_cache-warm
+   programs, select by :func:`~mxnet_tpu.autotune.tuner.select_best`
+   over the measured entries;
+3. persist winner + FULL audit log — every candidate appears: measured
+   ones with their cost, feature vector (``"_feat"``) and prediction
+   (``"est_s"``), unmeasured ones with ``"shortlisted": False`` and
+   cost ``-1.0``, gate failures with ``"parity": False`` — then refit
+   the model from the store, so the next search on this host ranks
+   better.
+
+Entry points: :func:`tune_fit_joint` (``Module.fit(autotune="joint")``
+— superstep K x scan unroll x remat) and :func:`tune_serve_joint`
+(``ServeEngine(autotune="joint")`` — fusion x bucket grid x quantize op
+set).  See docs/autotune.md.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError, get_env
+from . import store as _store
+from .costmodel import (COSTMODEL_VERSION, clean_config, features, get_model,
+                        refit_from_store)
+from .measure import backend_descriptor, measure_candidate, tuning_key, \
+    wall_timer
+from .tuner import AutotuneStats, select_best
+
+__all__ = ["JointTuner", "tune_fit_joint", "tune_serve_joint",
+           "default_shortlist"]
+
+Config = Dict[str, Any]
+
+
+def default_shortlist() -> int:
+    """How many top-ranked candidates a joint search measures
+    (``MXNET_AUTOTUNE_SHORTLIST``, default 3)."""
+    return max(1, get_env("MXNET_AUTOTUNE_SHORTLIST", 3, int))
+
+
+class JointTuner:
+    """Rank-then-measure driver over one joint candidate space (see
+    module docstring).  Candidate configs must be JSON-round-trippable
+    (lists, not tuples): store-hit membership compares the persisted
+    winner against ``dict(c)`` literally."""
+
+    def __init__(self, name: str, key: str, persist: bool = True,
+                 shortlist: Optional[int] = None):
+        self.name = name
+        self.key = key
+        self.persist = persist
+        self.shortlist = default_shortlist() if shortlist is None \
+            else max(1, int(shortlist))
+        self.gate_failures = 0
+        self.stats = AutotuneStats(name, key)
+        from . import _register_stats
+        _register_stats(self.stats)
+
+    def tune(self, candidates: Sequence[Config],
+             featurize: Callable[[Config], Sequence[float]],
+             measure: Callable[[Config], float],
+             meta: Optional[Dict[str, Any]] = None,
+             gate: Optional[Callable[[Config], bool]] = None) \
+            -> Tuple[Config, float]:
+        """-> (winning clean config, its cost).  ``featurize`` maps a
+        candidate to a ``costmodel.features`` vector; it is only called
+        on a store miss, so cache hits touch no program.  ``gate``
+        (parity check) runs on EVERY candidate before ranking — a
+        failing candidate can never win, only be logged."""
+        cands = [dict(c) for c in candidates]
+        if not cands:
+            raise MXNetError("autotune %r: no candidates" % self.name)
+        elapsed = wall_timer()
+        stats = self.stats
+        if self.persist:
+            doc = _store.load_config(self.key,
+                                     model_version=COSTMODEL_VERSION)
+            if doc is not None and any(doc["config"] == c for c in cands):
+                with stats._lock:
+                    stats.source = "cache"
+                    stats.best = dict(doc["config"])
+                    stats.best_cost_s = doc.get("cost_s")
+                    stats.trials = [(dict(c), float(s))
+                                    for c, s in doc.get("log") or []]
+                    stats.store_path = _store.config_path(self.key)
+                    stats.wall_s = elapsed()
+                return dict(doc["config"]), float(doc.get("cost_s") or 0.0)
+
+        gated: List[Tuple[Config, float]] = []
+        live: List[int] = []
+        for i, c in enumerate(cands):
+            if gate is not None and not gate(dict(c)):
+                self.gate_failures += 1
+                gated.append((dict(c, parity=False), -1.0))
+                continue
+            live.append(i)
+        if not live:
+            raise MXNetError("autotune %r: no candidate passed the "
+                             "parity gate" % self.name)
+        model = get_model()
+        feats = {i: [float(v) for v in featurize(dict(cands[i]))]
+                 for i in live}
+        preds = {i: model.predict(feats[i]) for i in live}
+        order = sorted(live, key=lambda i: (preds[i], i))
+        short = order[:self.shortlist]
+
+        log: List[Tuple[Config, float]] = []
+        for i in short:
+            cost = float(measure(dict(cands[i])))
+            log.append((dict(cands[i], _feat=feats[i],
+                             est_s=round(preds[i], 9)), cost))
+        measured = list(log)
+        for i in order[self.shortlist:]:
+            log.append((dict(cands[i], est_s=round(preds[i], 9),
+                             shortlisted=False), -1.0))
+        log.extend(gated)
+
+        best_aud, best_cost = select_best(measured)
+        best = clean_config(best_aud)
+        path = None
+        if self.persist:
+            path = _store.save_config(
+                self.key, best, best_cost,
+                meta=dict(meta or {}, space_size=len(cands),
+                          measured=len(measured), shortlist=self.shortlist,
+                          model_trained=model.trained,
+                          backend=backend_descriptor()),
+                log=log, model_version=COSTMODEL_VERSION)
+            # the new measurements join the training set immediately:
+            # the NEXT search on this host ranks with them
+            refit_from_store()
+        with stats._lock:
+            stats.source = "measured"
+            stats.trials = log
+            stats.best = best
+            stats.best_cost_s = best_cost
+            stats.store_path = path
+            stats.wall_s = elapsed()
+        return best, best_cost
+
+
+# -- fit-side joint space: superstep K x scan unroll x remat -----------------
+
+_FIT_KS = (1, 2, 3, 4, 6, 8, 12, 16)
+_FIT_UNROLLS = (1, 2, 4)
+
+
+def _fit_space(ks: Sequence[int]) -> List[Config]:
+    """The fit-side joint space.  Every knob is semantics-preserving:
+    superstep K is bitwise-identical to K sequential steps,
+    ``lax.scan(unroll=...)`` only restructures control flow, and
+    ``jax.checkpoint`` recomputes the identical forward."""
+    space: List[Config] = []
+    for k in ks:
+        unrolls = [u for u in _FIT_UNROLLS if u <= k] if k > 1 else [1]
+        for u in unrolls:
+            for remat in (False, True):
+                space.append({"superstep": int(k), "unroll": int(u),
+                              "remat": bool(remat)})
+    return space
+
+
+def tune_fit_joint(module, viable=None, trials: int = 2,
+                   persist: bool = True,
+                   shortlist: Optional[int] = None) -> Config:
+    """Joint fit-side search — the ``Module.fit(autotune="joint")``
+    entry.  Enumerates superstep K x unroll x remat from the module's
+    knob surfaces, ranks with the shared cost model (featurized from
+    ONE AOT compile's XLA cost analysis + collective census), measures
+    the shortlist on discarded state copies, returns the winning
+    ``{"superstep", "unroll", "remat"}`` (the caller applies it via
+    ``Module.apply_joint_config``).  ``viable(k)`` is
+    ``Module._superstep_blockers``' closure: blocked Ks leave the
+    space."""
+    from . import _measure_superstep, _zero_batch
+    fused = getattr(module, "_fused", None)
+    if fused is None or not module.optimizer_initialized:
+        return {"superstep": 1, "unroll": 1, "remat": False}
+    ks = [k for k in _FIT_KS if k == 1 or viable is None or viable(k) is None]
+    space = _fit_space(ks)
+    key = tuning_key(
+        "fit:joint", module._symbol.tojson(),
+        sorted(module._data_shapes), sorted(module._label_shapes or []),
+        type(module._optimizer).__name__, fused.hparam_signature(),
+        tuple(ks), _FIT_UNROLLS)
+    module._fused_ensure_state()
+    base: Dict[str, float] = {}
+
+    def _baseline() -> Dict[str, float]:
+        # ONE AOT compile feeds every candidate's compute/memory/
+        # collective features — lazy, so a store hit compiles nothing
+        if not base:
+            batch = fused.make_batch(_zero_batch(module))
+            flops = fused.aot_compile(module._fused_state, batch,
+                                      module._fused_key)
+            cs = getattr(fused, "cost_summary", None) or {}
+            census = cs.get("collectives") or {}
+            base.update(
+                gflops=float(flops) / 1e9,
+                hbm_gb=float(cs.get("bytes_accessed", 0.0)) / 1e9,
+                coll_gb=float(census.get("total_bytes", 0.0)) / 1e9,
+                coll_count=float(census.get("total_count", 0.0)))
+        return base
+
+    mesh = fused.mesh
+
+    def featurize(cfg: Config) -> List[float]:
+        b = _baseline()
+        k = int(cfg["superstep"])
+        return features(
+            gflops=b["gflops"], hbm_gb=b["hbm_gb"], coll_gb=b["coll_gb"],
+            coll_count=b["coll_count"], inv_k=1.0 / k, superstep_k=k,
+            unroll=cfg["unroll"], remat=1.0 if cfg["remat"] else 0.0,
+            mesh_devices=mesh.devices.size, mesh_axes=len(mesh.axis_names))
+
+    def measure(cfg: Config) -> float:
+        prev_remat, prev_step = fused._remat, fused._step
+        want = bool(cfg["remat"])
+        try:
+            if want != bool(prev_remat):
+                fused._remat = want
+                fused._step = None       # program_desc includes remat
+            return _measure_superstep(module, int(cfg["superstep"]),
+                                      trials, unroll=int(cfg["unroll"]))
+        finally:
+            fused._remat = prev_remat
+            fused._step = prev_step
+
+    tuner = JointTuner("fit:joint", key, persist=persist,
+                       shortlist=shortlist)
+    best, _cost = tuner.tune(
+        space, featurize, measure,
+        meta={"candidates": ks, "backend": backend_descriptor()})
+    return {"superstep": int(best["superstep"]),
+            "unroll": int(best.get("unroll", 1)),
+            "remat": bool(best.get("remat", False))}
+
+
+# -- serve-side joint space: fusion x bucket grid x quantize op set ----------
+
+def _bucket_grids(max_b: int) -> List[Tuple[int, ...]]:
+    """Candidate bucket grids under one max batch: every suffix of the
+    pow2 chain up to ``max_b`` (finer grids pad less but resident more
+    programs) plus the sparse (small, max) pairs."""
+    chain: List[int] = []
+    b = max(1, int(max_b))
+    while b >= 1:
+        chain.append(b)
+        b //= 2
+    chain = sorted(set(chain))
+    grids = [tuple(chain[i:]) for i in range(len(chain))]
+    for b in chain[:-1]:
+        pair = (b, chain[-1])
+        if pair not in grids:
+            grids.append(pair)
+    return grids
+
+
+def _grid_pad_waste(grid: Sequence[int]) -> float:
+    """Mean padded fraction over request sizes 1..max assuming uniform
+    arrivals: each size r runs at the smallest bucket >= r."""
+    buckets = sorted(grid)
+    waste = []
+    for r in range(1, buckets[-1] + 1):
+        b = next(x for x in buckets if x >= r)
+        waste.append((b - r) / float(b))
+    return float(np.mean(waste)) if waste else 0.0
+
+
+def _quantize_candidates(quantize) -> List[Any]:
+    """Quantize-axis candidates: for a plain string mode ("int8") every
+    non-empty subset of the default op set; an explicit dict or falsy
+    value is respected verbatim (one candidate)."""
+    if not (isinstance(quantize, str) and quantize):
+        return [quantize]
+    from ..passes.quantize import default_quantize_ops
+    ops = sorted(default_quantize_ops())
+    subsets: List[List[str]] = []
+    for bits in range(1, 2 ** len(ops)):
+        subsets.append([op for i, op in enumerate(ops) if bits >> i & 1])
+    return [{"dtype": quantize, "ops": subset} for subset in subsets]
+
+
+def tune_serve_joint(symbol_json: str, params: Dict,
+                     shapes_tpl: Dict[str, Tuple[int, ...]],
+                     buckets: Sequence[int], data_name: str = "data",
+                     quantize=None, calib_data=None, u8_wire=None,
+                     dev: Tuple[str, int] = ("cpu", 0),
+                     name: str = "autotune", explicit_buckets: bool = False,
+                     trials: int = 5, persist: bool = True,
+                     shortlist: Optional[int] = None):
+    """Joint serve-side search — the ``ServeEngine(autotune="joint")``
+    entry.  Space: fusion on/off x bucket grid (suffixes of the pow2
+    chain under the engine's max batch; just the caller's grid when
+    ``explicit_buckets``) x quantize op subset (for a string ``quantize``
+    mode).  Cost per candidate: expected per-item service time — each
+    bucket's warm forward is measured once and averaged over request
+    sizes 1..max at the grid's padding.
+
+    Returns ``(fuse, buckets, quantize_resolved, pipeline)`` where
+    ``pipeline`` is the winner's already-built PassPipeline when this
+    call measured (None on a store hit — the caller rebuilds)."""
+    from ..passes import build_serving_pipeline
+    from ..predictor import Predictor
+    from . import _quantize_tag
+    max_b = max(int(b) for b in buckets)
+    grids = [tuple(sorted(int(b) for b in buckets))] if explicit_buckets \
+        else _bucket_grids(max_b)
+    qcands = _quantize_candidates(quantize)
+    space: List[Config] = []
+    for fuse in (True, False):
+        for grid in grids:
+            for q in qcands:
+                space.append({
+                    "fuse": fuse, "buckets": [int(b) for b in grid],
+                    "quant_ops": sorted(q["ops"])
+                    if isinstance(q, dict) and "ops" in q else None})
+    key = tuning_key(
+        "serve:joint", symbol_json,
+        sorted((k, tuple(v)) for k, v in shapes_tpl.items()),
+        data_name, _quantize_tag(quantize), bool(u8_wire),
+        tuple(sorted(int(b) for b in buckets)), bool(explicit_buckets))
+
+    def _resolve_quantize(cfg: Config):
+        if cfg["quant_ops"] is None:
+            return quantize
+        return {"dtype": quantize if isinstance(quantize, str) else "int8",
+                "ops": tuple(cfg["quant_ops"])}
+
+    def featurize(cfg: Config) -> List[float]:
+        return features(
+            fuse=1.0 if cfg["fuse"] else 0.0,
+            quant_ops=float(len(cfg["quant_ops"] or ())),
+            num_buckets=float(len(cfg["buckets"])),
+            pad_waste=_grid_pad_waste(cfg["buckets"]))
+
+    built: Dict[Tuple, Any] = {}
+
+    def _built_key(cfg: Config) -> Tuple:
+        return (bool(cfg["fuse"]), tuple(cfg["quant_ops"] or ()))
+
+    def measure(cfg: Config) -> float:
+        q = _resolve_quantize(cfg)
+        bkey = _built_key(cfg)
+        pipe = built.get(bkey)
+        if pipe is None:
+            pipe = build_serving_pipeline(
+                quantize=q, calib_data=calib_data,
+                calib_shapes={k: (max_b,) + tuple(v[1:])
+                              for k, v in shapes_tpl.items()},
+                data_name=data_name, u8_wire=u8_wire, fuse=cfg["fuse"],
+                name=name)
+            built[bkey] = pipe
+        grid = sorted(cfg["buckets"])
+        t_bucket: Dict[int, float] = {}
+        for b in grid:
+            shapes = {k: (b,) + tuple(v[1:]) for k, v in shapes_tpl.items()}
+            p = Predictor(symbol_json, dict(params), shapes,
+                          dev[0], dev[1], pipeline=pipe)
+            arr = p._exec.arg_dict[data_name]
+            data = np.zeros(tuple(arr.shape), np.dtype(arr.dtype))
+
+            def run():
+                p.set_input(data_name, data)
+                p.forward()
+                p.get_output(0)
+
+            t_bucket[b] = measure_candidate(
+                run, label="fuse=%s,b=%d" % (cfg["fuse"], b),
+                trials=trials, warmup=2)
+        # expected per-item service time over request sizes 1..max
+        per_item = [t_bucket[next(x for x in grid if x >= r)] / r
+                    for r in range(1, grid[-1] + 1)]
+        return float(np.mean(per_item))
+
+    tuner = JointTuner("serve:joint", key, persist=persist,
+                       shortlist=shortlist)
+    best, _cost = tuner.tune(
+        space, featurize, measure,
+        meta={"quantize": _quantize_tag(quantize), "max_batch": max_b,
+              "backend": backend_descriptor()})
+    fuse = bool(best["fuse"])
+    win_buckets = tuple(sorted(int(b) for b in best["buckets"]))
+    return (fuse, win_buckets, _resolve_quantize(best),
+            built.get(_built_key(best)))

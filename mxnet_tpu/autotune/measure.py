@@ -23,9 +23,18 @@ from typing import Any, Callable, Dict, List, Optional
 from .. import trace as _trace
 
 __all__ = ["backend_descriptor", "tuning_key", "measure_candidate",
-           "CANDIDATE_SPAN"]
+           "wall_timer", "CANDIDATE_SPAN"]
 
 CANDIDATE_SPAN = "autotune:candidate"
+
+
+def wall_timer() -> Callable[[], float]:
+    """Elapsed-seconds closure over one perf_counter origin: tuning
+    wall-time accounting goes through here (or :func:`timed_span`), not
+    raw ``time`` calls, so every duration autotune reports shares one
+    clock discipline."""
+    t0 = time.perf_counter()
+    return lambda: time.perf_counter() - t0
 
 
 def backend_descriptor() -> str:

@@ -26,6 +26,13 @@ config won.
 Corrupt or unreadable entries load as None (warn once, delete): the
 tuner then simply re-measures, the same recover-by-redoing story the
 compile cache uses.
+
+The store mirrors the compile cache's LRU discipline: a load touches
+the entry's mtime, a save evicts oldest-mtime entries past the
+``MXNET_AUTOTUNE_STORE_MAX`` entry cap (default 256; <= 0 unbounded).
+Winners scored by the learned cost model additionally carry the
+``model_version`` that ranked them — a version bump invalidates the
+entry on load instead of resurrecting a stale winner.
 """
 from __future__ import annotations
 
@@ -56,10 +63,15 @@ def config_path(key: str) -> str:
     return os.path.join(store_dir(), "%s.json" % key)
 
 
-def load_config(key: str) -> Optional[Dict[str, Any]]:
+def load_config(key: str,
+                model_version: Optional[int] = None) -> Optional[Dict[str, Any]]:
     """The stored record for ``key``, or None (absent, corrupt, or a
     different schema version — corrupt entries are deleted so the next
-    save is clean)."""
+    save is clean).  ``model_version``: the cost-model version the
+    caller ranks with; an entry saved under any other version is stale
+    (the ranking that picked it no longer exists) and is dropped rather
+    than resurrected.  A load that succeeds touches the entry's mtime,
+    so the save-time entry cap evicts least-recently-used keys first."""
     path = config_path(key)
     try:
         with open(path) as f:
@@ -83,23 +95,77 @@ def load_config(key: str) -> Optional[Dict[str, Any]]:
         except OSError:
             pass
         return None
+    if model_version is not None and doc.get("model_version") != model_version:
+        warnings.warn("autotune: dropping store entry %s ranked by "
+                      "cost-model v%s (current v%d)"
+                      % (path, doc.get("model_version"), model_version))
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+    try:
+        os.utime(path)          # LRU recency: a hit is a use
+    except OSError:
+        pass
     return doc
 
 
 def save_config(key: str, config: Dict[str, Any], cost_s: float,
                 meta: Optional[Dict[str, Any]] = None,
-                log: Optional[List[Tuple[Dict[str, Any], float]]] = None) \
-        -> str:
+                log: Optional[List[Tuple[Dict[str, Any], float]]] = None,
+                model_version: Optional[int] = None) -> str:
     """Atomically publish the winning config (+ the measurement log it
-    was selected from); returns the path."""
+    was selected from); returns the path.  ``model_version`` stamps the
+    cost-model version whose ranking produced the entry (see
+    :func:`load_config`).  Every save then enforces the entry cap."""
     os.makedirs(store_dir(), exist_ok=True)
     path = config_path(key)
     doc = {"version": _VERSION, "key": key, "config": dict(config),
            "cost_s": float(cost_s), "meta": dict(meta or {}),
            "log": [[dict(c), float(s)] for (c, s) in (log or [])]}
+    if model_version is not None:
+        doc["model_version"] = int(model_version)
     with atomic_local_write(path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
+    _enforce_cap(keep=path)
     return path
+
+
+def _enforce_cap(keep: Optional[str] = None) -> None:
+    """Drop oldest-mtime entries until the store holds at most
+    ``MXNET_AUTOTUNE_STORE_MAX`` configs (<= 0: unbounded) — the compile
+    cache's eviction discipline.  ``keep``: never evict this path (the
+    entry just written)."""
+    cap = get_env("MXNET_AUTOTUNE_STORE_MAX", 256, int)
+    if cap <= 0:
+        return
+    root = store_dir()
+    try:
+        names = [n for n in os.listdir(root) if n.endswith(".json")]
+    except OSError:
+        return
+    if len(names) <= cap:
+        return
+    aged = []
+    for n in names:
+        p = os.path.join(root, n)
+        try:
+            aged.append((os.stat(p).st_mtime, p))
+        except OSError:
+            continue
+    aged.sort()
+    excess = len(aged) - cap
+    for _mt, p in aged:
+        if excess <= 0:
+            break
+        if p == keep:
+            continue
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+        excess -= 1
 
 
 def list_configs() -> List[str]:

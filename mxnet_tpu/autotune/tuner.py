@@ -23,11 +23,11 @@ winner, wall time — so "what did autotune decide and why" is one call.
 """
 from __future__ import annotations
 
-import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..base import MXNetError, make_lock
 from . import store as _store
+from .measure import wall_timer
 
 __all__ = ["Autotuner", "AutotuneStats", "select_best"]
 
@@ -126,7 +126,7 @@ class Autotuner:
         changed under the key — re-measure)."""
         if not candidates:
             raise MXNetError("autotune %r: no candidates" % self.name)
-        t0 = time.perf_counter()
+        elapsed = wall_timer()
         stats = self.stats
         if self.persist:
             doc = _store.load_config(self.key)
@@ -139,7 +139,7 @@ class Autotuner:
                     stats.trials = [(dict(c), float(s))
                                     for c, s in doc.get("log") or []]
                     stats.store_path = _store.config_path(self.key)
-                    stats.wall_s = time.perf_counter() - t0
+                    stats.wall_s = elapsed()
                 return dict(doc["config"]), float(doc.get("cost_s") or 0.0)
         log: Log = []
         for cfg in candidates:
@@ -156,5 +156,5 @@ class Autotuner:
             stats.best = best
             stats.best_cost_s = best_cost
             stats.store_path = path
-            stats.wall_s = time.perf_counter() - t0
+            stats.wall_s = elapsed()
         return best, best_cost

@@ -12,12 +12,16 @@ autotune recipe applied to sharding:
    product are sharded; everything else stays replicated — every
    candidate is valid by construction (``parallel.mesh.validate_spec``).
 
-2. **Score** each candidate with the ``multichip_report()`` cost model:
-   AOT-compile the real fused step (through the compile cache — a warm
-   process re-scores for free), take per-device FLOPs + bytes from XLA
-   cost analysis and the collective payload census from the
-   post-partitioner HLO, and estimate a step time as
-   ``flops/peak + max(bytes_hbm, 0)/bw + collective_bytes/ici``.
+2. **Score** each candidate with the SHARED learned cost model
+   (``autotune.costmodel`` — the same scorer JointTuner ranks with, no
+   forked roofline): AOT-compile the real fused step (through the
+   compile cache — a warm process re-scores for free), take per-device
+   FLOPs + bytes from XLA cost analysis and the collective payload
+   census from the post-partitioner HLO, featurize, and predict.  A
+   single-process search uses the host's trained model; multi-process
+   ranks score with the deterministic ``analytic_cost`` prior instead
+   (per-host training sets differ, and every rank must shortlist
+   identically — they are one collective program).
 
 3. **Measure** only the shortlist (``MXNET_DIST_SHARDSEARCH_SHORTLIST``
    best estimates, default 2) by stepping the compiled program a few
@@ -142,17 +146,30 @@ def fingerprint(symbol, param_shapes: Dict[str, tuple], mesh) -> str:
 
 
 # -- scoring + measurement ---------------------------------------------------
-def _estimate_s(flops: float, bytes_accessed: float, census,
-                peak_tflops: float, hbm_gbps: float,
-                ici_gbps: float) -> float:
-    """The multichip_report() split as a scalar step-time estimate
-    (relative ranking is all the shortlist needs; the absolute scale
-    cancels)."""
-    est = flops / (peak_tflops * 1e12)
-    est += bytes_accessed / (hbm_gbps * 1e9)
-    if census:
-        est += float(census.get("total_bytes", 0)) / (ici_gbps * 1e9)
-    return est
+def _featurize(flops: float, bytes_accessed: float, census, mesh) \
+        -> List[float]:
+    """A candidate's compiled-program characteristics on the shared
+    cost-model feature schema (autotune.costmodel.FEATURE_NAMES)."""
+    from ..autotune.costmodel import features
+    census = census or {}
+    return features(
+        gflops=float(flops) / 1e9,
+        hbm_gb=float(bytes_accessed) / 1e9,
+        coll_gb=float(census.get("total_bytes", 0.0)) / 1e9,
+        coll_count=float(census.get("total_count", 0.0)),
+        mesh_devices=float(mesh.devices.size),
+        mesh_axes=float(len(mesh.axis_names)))
+
+
+def _estimate_s(feat, multiprocess: bool) -> float:
+    """Predicted step time from the shared cost model.  Multi-process
+    ranks use the deterministic analytic prior (identical on every rank
+    by construction); a single-process search gets the host's trained
+    model (relative ranking is all the shortlist needs)."""
+    from ..autotune import costmodel
+    if multiprocess:
+        return costmodel.analytic_cost(feat)
+    return costmodel.get_model().predict(feat)
 
 
 class _Trial:
@@ -235,32 +252,32 @@ def search_sharding(module, mesh, log_fn=None) \
     shapes = {n: tuple(module._arg_params[n].shape)
               for n in module._param_names}
     cands = enumerate_candidates(shapes, mesh)
-    peak = get_env("MXNET_PEAK_TFLOPS", 100.0, float)
-    hbm = get_env("MXNET_HBM_GBPS", 800.0, float)
-    ici = get_env("MXNET_ICI_GBPS", 50.0, float)
     shortlist_n = max(1, get_env("MXNET_DIST_SHARDSEARCH_SHORTLIST",
                                  2, int))
     steps = max(1, get_env("MXNET_DIST_SHARDSEARCH_STEPS", 3, int))
+    nproc = len({d.process_index for d in mesh.devices.ravel()})
 
     scored = []
     for name, specs in cands:
         trial = _Trial(module, mesh, specs)
         try:
             flops, nbytes, census = trial.compile_cost()
-            est = _estimate_s(flops, nbytes, census, peak, hbm, ici)
+            feat = _featurize(flops, nbytes, census, mesh)
+            est = _estimate_s(feat, multiprocess=nproc > 1)
         finally:
             trial.close()
-        scored.append((est, name, specs))
+        scored.append((est, name, specs, feat))
         if log_fn:
             log_fn("shardsearch: candidate %-4s est %.3es" % (name, est))
     # deterministic shortlist: estimate, then name — identical on every
-    # rank (the estimate is a pure function of the compiled program)
+    # rank (multi-process estimates come from the analytic prior, a pure
+    # function of the compiled program and the env knobs)
     scored.sort(key=lambda t: (t[0], t[1]))
     shortlist = scored[:shortlist_n]
 
     measured = []
     mlog = []
-    for est, name, specs in shortlist:
+    for est, name, specs, feat in shortlist:
         trial = _Trial(module, mesh, specs)
         try:
             trial.compile_cost()   # cache hit: installs the executable
@@ -268,18 +285,19 @@ def search_sharding(module, mesh, log_fn=None) \
         finally:
             trial.close()
         measured.append((s, name, specs))
+        # "_feat" makes this measurement training data for the shared
+        # cost model (costmodel.refit_from_store walks the audit logs)
         mlog.append(({"strategy": name, "specs": specs,
-                      "est_s": round(est, 9)}, s))
+                      "est_s": round(est, 9), "_feat": feat}, s))
         if log_fn:
             log_fn("shardsearch: measured  %-4s %.3es/step" % (name, s))
-    for est, name, specs in scored[shortlist_n:]:
+    for est, name, specs, feat in scored[shortlist_n:]:
         # the audit log records WHY the tail was never measured
         mlog.append(({"strategy": name, "specs": specs,
                       "est_s": round(est, 9), "shortlisted": False},
                      -1.0))
 
     best = min(range(len(measured)), key=lambda i: measured[i][0])
-    nproc = len({d.process_index for d in mesh.devices.ravel()})
     if nproc > 1:
         # ranks' wall clocks differ; rank 0's pick is THE pick, or the
         # fleet installs divergent specs and wedges in its first step
@@ -323,4 +341,8 @@ def resolve_auto(module, mesh) -> Optional[dict]:
                   "mesh": [list(ax) for ax in mesh_axes(mesh)],
                   "nparams": len(shapes)},
             log=mlog)
+        # the featurized measurements just joined the training set —
+        # fold them into the shared cost model for the next search
+        from ..autotune.costmodel import refit_from_store
+        refit_from_store()
     return _to_partition_specs(specs) if specs else None

@@ -366,11 +366,11 @@ class BaseModule:
                 k_super = k_env
             else:
                 k_super = 1
-                from ..autotune import enabled as _autotune_enabled
-                if _autotune_enabled(autotune) and \
+                from ..autotune import mode as _autotune_mode
+                amode = _autotune_mode(autotune)
+                if amode is not None and \
                         callable(getattr(self, "superstep_train", None)) \
                         and getattr(self, "_fused", None) is not None:
-                    from ..autotune import tune_superstep
 
                     def _viable(k):
                         return self._superstep_blockers(
@@ -379,8 +379,22 @@ class BaseModule:
                             checkpoint_every=(ckpt_mgr.save_every_steps
                                               if ckpt_mgr is not None
                                               else None))
-                    k_super = tune_superstep(self, viable=_viable)
-                    self.logger.info("autotune: superstep K=%d", k_super)
+                    if amode == "joint" and \
+                            callable(getattr(self, "apply_joint_config",
+                                             None)):
+                        from ..autotune import tune_fit_joint
+                        jcfg = tune_fit_joint(self, viable=_viable)
+                        k_super = int(jcfg["superstep"])
+                        self.apply_joint_config(jcfg)
+                        self.logger.info(
+                            "autotune(joint): superstep K=%d unroll=%d "
+                            "remat=%s", k_super, jcfg["unroll"],
+                            jcfg["remat"])
+                    else:
+                        from ..autotune import tune_superstep
+                        k_super = tune_superstep(self, viable=_viable)
+                        self.logger.info("autotune: superstep K=%d",
+                                         k_super)
             k_super = max(1, k_super)
             use_super = k_super > 1 and callable(
                 getattr(self, "superstep_train", None))

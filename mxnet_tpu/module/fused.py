@@ -807,7 +807,7 @@ class FusedTrainStep:
         self._fwd = {True: make(True), False: make(False)}
         return self._fwd
 
-    def build_superstep(self, k, metric_update=None):
+    def build_superstep(self, k, metric_update=None, unroll=1):
         """ONE donated XLA program executing K fused steps: the step body
         from _make_step_fn traced under ``jax.lax.scan`` over the
         megabatch's leading K axis, with zero host involvement between
@@ -822,9 +822,12 @@ class FusedTrainStep:
         (new_state, acc)``, jitted with the state donated.  Because the
         scan body IS the sequential step's trace (same in-program step
         counter, same per-step RNG fold), superstep K is bitwise-
-        identical to K sequential fused steps."""
+        identical to K sequential fused steps — and ``unroll`` (the
+        ``lax.scan`` unroll factor, an autotune="joint" knob) only
+        restructures control flow, so it preserves that bit-identity."""
         step_fn = self._make_step_fn()
         label_names = self.label_names
+        unroll = max(1, min(int(unroll), int(k)))
 
         def superstep(state, megabatch, lrs, base_key, acc):
             def body(carry, xs):
@@ -837,7 +840,8 @@ class FusedTrainStep:
                 return (st, a), None
 
             (state, acc), _ = jax.lax.scan(body, (state, acc),
-                                           (megabatch, lrs), length=k)
+                                           (megabatch, lrs), length=k,
+                                           unroll=unroll)
             return state, acc
 
         from ..compile_cache import cached_jit
@@ -855,7 +859,7 @@ class FusedTrainStep:
         return cached_jit(superstep, name="fused:superstep:k%d" % k,
                           donate_argnums=(0,),
                           fast_key=self._program_desc(
-                              "superstep:k%d:%s" % (k, mtag)))
+                              "superstep:k%d:u%d:%s" % (k, unroll, mtag)))
 
     def step(self, state, batch, base_key):
         """Advance one batch; returns (new_state, outputs)."""
@@ -984,6 +988,7 @@ class FusedTrainStep:
                 bytes_accessed = float(ca.get("bytes accessed", 0.0))
         except Exception:
             pass
+        census = None
         if self.multichip_stats is not None:
             # the optimized (post-SPMD-partitioner) HLO names the REAL
             # collectives; parse counts + payload bytes for the
@@ -997,10 +1002,15 @@ class FusedTrainStep:
             except Exception:
                 pass
             from .. import profiler as _prof
+            census = _prof.parse_hlo_collectives(txt) if txt else None
             self.multichip_stats.set_cost(
                 flops=flops, bytes_accessed=bytes_accessed,
-                collectives=_prof.parse_hlo_collectives(txt)
-                if txt else None)
+                collectives=census)
+        # the cost-model featurizer reads this regardless of topology
+        # (multichip_stats only exists past one device)
+        self.cost_summary = {"flops": flops,
+                             "bytes_accessed": bytes_accessed,
+                             "collectives": census}
         self._step = compiled
         self._lr_cache = None
         return flops

@@ -74,8 +74,9 @@ class Module(BaseModule):
         # the configuration allows one donated XLA program per batch
         self._fused = None
         # superstep (K fused steps per dispatch): compiled programs keyed
-        # by (K, metric signature), plus the profiler counters
+        # by (K, unroll, metric signature), plus the profiler counters
         self._superstep_progs = {}
+        self._superstep_unroll = 1
         self._superstep_stats = None
         self._fused_state = None
         self._fused_pending = None
@@ -455,6 +456,7 @@ class Module(BaseModule):
         self._fused_pending = None
         self._fused_outputs = None
         self._superstep_progs = {}
+        self._superstep_unroll = 1
         self._discard_speculation()
         mesh = self._mesh
         if mesh is None and (get_env("MXNET_MESH", "") or "").strip():
@@ -556,6 +558,27 @@ class Module(BaseModule):
             # the prologue is part of the superstep trace too, and the
             # module-level cache keys only (K, metric) — a stale entry
             # would train through the OLD spec's crop/normalize
+            self._superstep_progs = {}
+        return True
+
+    def apply_joint_config(self, cfg):
+        """Install a joint-autotune winner (autotune.tune_fit_joint):
+        superstep unroll depth and the rematerialization flag.  Both
+        knobs preserve the training semantics bit-for-bit — unroll only
+        changes how lax.scan emits the K iterations, remat only recomputes
+        activations in backward — so a persisted winner from another
+        process is always safe to apply.  The superstep K itself is
+        returned to fit(), which owns the batching loop."""
+        if self._fused is None:
+            return False
+        self._superstep_unroll = max(1, int(cfg.get("unroll", 1)))
+        remat = bool(cfg.get("remat", False))
+        if remat != bool(self._fused._remat):
+            # the remat flag is baked into the traced step: drop every
+            # compiled program so the next dispatch re-traces with it
+            self._fused._remat = remat
+            self._fused._step = None
+            self._fused._fwd = None
             self._superstep_progs = {}
         return True
 
@@ -856,11 +879,13 @@ class Module(BaseModule):
         h2d_s = _time.perf_counter() - t0
         _trace.complete("superstep:h2d_stage", t0, h2d_s, cat="train")
 
-        sig = (k, reducer.signature if reducer is not None else None)
+        unroll = max(1, min(int(self._superstep_unroll), int(k)))
+        sig = (k, unroll, reducer.signature if reducer is not None else None)
         prog = self._superstep_progs.get(sig)
         if prog is None:
             prog = self._fused.build_superstep(
-                k, reducer.update if reducer is not None else None)
+                k, reducer.update if reducer is not None else None,
+                unroll=unroll)
             self._superstep_progs[sig] = prog
 
         # per-step lr exactly as K sequential update() calls resolve it:

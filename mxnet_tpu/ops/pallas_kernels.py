@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import get_env
 from .quantized import INT8_QMAX
 
 try:
@@ -40,6 +41,22 @@ __all__ = ["flash_attention", "paged_attention", "correlation",
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
+
+
+def _searched(family: str, *args):
+    """The kernel search's persisted winner for this call's shape class,
+    or None.  Tiling resolves explicit argument > searched winner >
+    hand-tuned default; the winner layer only engages under
+    ``MXNET_KERNEL_SEARCH=1`` (call-time behavior must not silently
+    depend on store state), is LOAD-ONLY (never searches on the hot
+    path), and is process-cached per class — negative lookups included
+    (autotune.kernelsearch.best_config)."""
+    if not get_env("MXNET_KERNEL_SEARCH", False, bool):
+        return None
+    from ..autotune import kernelsearch as ks
+    cls = {"flash": ks.flash_class, "fc": ks.fc_class,
+           "paged": ks.paged_class}[family](*args)
+    return ks.best_config(cls)
 
 
 def _attention_dense(q, k, v, causal):
@@ -101,18 +118,28 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal,
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
+def flash_attention(q, k, v, causal: bool = False, block_q=None,
+                    block_k=None, interpret: bool = False):
     """Blockwise attention.  q, k, v: (B, T, H, D) -> (B, T, H, D).
 
     Uses the Pallas kernel on TPU (or with interpret=True anywhere);
-    falls back to dense attention otherwise.
+    falls back to dense attention otherwise.  ``block_q``/``block_k``
+    default to the kernel search's persisted winner for this shape
+    class when ``MXNET_KERNEL_SEARCH=1`` (every winner was
+    bitwise-parity-gated before persistence), else 128; an explicit
+    argument always wins.
     """
     b, t, h, d = q.shape
     on_tpu = jax.default_backend() == "tpu"
     if not HAS_PALLAS or (not on_tpu and not interpret):
         from ..parallel.ring import attention_reference
         return attention_reference(q, k, v, causal=causal)
+    if block_q is None or block_k is None:
+        win = _searched("flash", t, d, causal, q.dtype) or {}
+        block_q = int(win.get("block_q", 128)) if block_q is None \
+            else block_q
+        block_k = int(win.get("block_k", 128)) if block_k is None \
+            else block_k
 
     # ragged sequence lengths: clamp the tiles near T (8-aligned for the
     # f32 sublane), pad T up to the tile grid, mask the padded keys in
@@ -253,6 +280,13 @@ def paged_attention(q, k_pool, v_pool, pages, lengths, q_pos=None,
     if not HAS_PALLAS or (not on_tpu and not interpret):
         return _paged_attention_dense(q, k_pool, v_pool, pages, lengths,
                                       q_pos, causal=causal)
+    # the kernel's blocking is fixed by the pool's page size, so the
+    # searched axis is WHICH program: a persisted "dense" winner means
+    # the gather reference beat the page walk on this backend/class
+    win = _searched("paged", k_pool.shape[1], d, causal, q.dtype)
+    if win is not None and win.get("impl") == "dense":
+        return _paged_attention_dense(q, k_pool, v_pool, pages, lengths,
+                                      q_pos, causal=causal)
     from jax.experimental.pallas import tpu as pltpu
     n, bt = k_pool.shape[0], k_pool.shape[1]
     b = pages.shape[1]
@@ -311,14 +345,15 @@ def _fc_epilogue_kernel(x_ref, w_ref, b_ref, o_ref, *, act_type, out_scale):
 
 
 def fused_fc_epilogue(x, w, b, act_type: str, out_scale=None,
-                      block_n: int = 128, interpret: bool = False):
+                      block_n=None, interpret: bool = False):
     """FullyConnected epilogue kernel: x (M, K) · w (N, K)ᵀ + b, fused
     activation, optional int8 requantize (``out_scale``).  Returns the
     (M, N) result — f32, or int8 when ``out_scale`` is set — or None
     when the Pallas path is unavailable/ineligible (off-TPU without
     ``interpret``, odd shapes, unknown act): the caller falls back to
     the jnp body, which keeps CPU tier-1 numerics identical to the
-    unfused graph."""
+    unfused graph.  ``block_n`` defaults to the kernel search's
+    persisted winner under ``MXNET_KERNEL_SEARCH=1``, else 128."""
     on_tpu = jax.default_backend() == "tpu"
     if not HAS_PALLAS or (not on_tpu and not interpret):
         return None
@@ -326,6 +361,10 @@ def fused_fc_epilogue(x, w, b, act_type: str, out_scale=None,
         return None
     m, k = x.shape
     n = w.shape[0]
+    if block_n is None:
+        win = _searched("fc", n, k, act_type, out_scale is not None,
+                        x.dtype) or {}
+        block_n = int(win.get("block_n", 128))
     # MXU lane/sublane alignment: K and N on the 128 lanes; M must fill
     # the output tile's sublanes (8 for f32, 32 for an int8 result)
     min_m = 32 if out_scale is not None else 8

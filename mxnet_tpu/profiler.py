@@ -36,7 +36,8 @@ __all__ = ["profiler_set_config", "profiler_set_state", "scope",
            "moe_report_str", "compile_report", "compile_report_str",
            "register_passes_stats", "passes_report", "passes_report_str",
            "register_autotune_stats", "autotune_report",
-           "autotune_report_str", "register_faults_stats",
+           "autotune_report_str", "costmodel_report",
+           "costmodel_report_str", "register_faults_stats",
            "faults_report", "faults_report_str",
            "register_online_stats", "online_report", "online_report_str",
            "MultichipStats", "register_multichip_stats",
@@ -677,6 +678,24 @@ def autotune_report_str() -> str:
     return _autotune_registry.report_str()
 
 
+def costmodel_report() -> dict:
+    """The shared learned cost model's lifecycle snapshot for this
+    backend: version, trained or prior-only, training-sample count, and
+    the pickle path (see autotune.costmodel)."""
+    from .autotune import costmodel
+    return costmodel.report()
+
+
+def costmodel_report_str() -> str:
+    """Human-readable cost-model lifecycle line (see costmodel_report)."""
+    r = costmodel_report()
+    return ("costmodel v%d backend=%s %s samples=%d path=%s"
+            % (r["version"], r["backend"],
+               "trained" if r["trained"]
+               else ("loaded(prior)" if r["loaded"] else "(not loaded)"),
+               r["samples"], r["path"] or "-"))
+
+
 # -- fault-injection / recovery instrumentation (mxnet_tpu.faults) -----------
 # The fault plane's process-global FaultStats (kind "plane": injected
 # faults by kind and point) and every live Supervisor's SupervisorStats
@@ -766,6 +785,7 @@ def unified_report() -> dict:
         "moe": moe_report(),
         "passes": passes_report(),
         "autotune": autotune_report(),
+        "costmodel": costmodel_report(),
         "faults": faults_report(),
         "online": online_report(),
     }
@@ -790,6 +810,7 @@ def unified_report_str() -> str:
         ("moe", moe_report_str),
         ("passes", passes_report_str),
         ("autotune", autotune_report_str),
+        ("costmodel", costmodel_report_str),
         ("faults", faults_report_str),
         ("online", online_report_str),
         ("compile", compile_report_str),

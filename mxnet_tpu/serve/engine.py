@@ -114,7 +114,12 @@ class ServeEngine:
         through ``compile_cache``-warmed predictors, the winner is
         persisted per (model, topology) fingerprint
         (``MXNET_AUTOTUNE_DIR``) and reloaded with zero measurements on
-        the next construction.  See ``mx.profiler.autotune_report()``.
+        the next construction.  ``"joint"`` (or ``MXNET_AUTOTUNE=joint``)
+        searches the JOINT space — fusion x bucket grid x quantize op
+        subset — ranked by the learned cost model with only a shortlist
+        measured (``MXNET_AUTOTUNE_SHORTLIST``); an explicit
+        ``batch_buckets=`` pins the grid axis.  See docs/autotune.md and
+        ``mx.profiler.autotune_report()``.
     quantize / calib_data / u8_wire / pipeline :
         Graph-optimized serving (``mxnet_tpu.passes``).  ``quantize=``
         takes ``"int8"`` (needs ``calib_data``: a sample of requests in
@@ -149,6 +154,7 @@ class ServeEngine:
         if not input_shapes:
             raise ServeError("input_shapes must name at least one input")
         sym_json = symbol.tojson() if hasattr(symbol, "tojson") else symbol
+        explicit_buckets = batch_buckets is not None
         if batch_buckets is None:
             batch_buckets = default_buckets(
                 get_env("MXNET_SERVE_MAX_BATCH", 8, int))
@@ -204,9 +210,32 @@ class ServeEngine:
         if self._param_specs and mesh is None:
             raise ServeError("param_specs without mesh=: specs are "
                              "PartitionSpecs over a named mesh")
-        from ..autotune import enabled as _autotune_enabled
+        from ..autotune import mode as _autotune_mode
         autotuned = False
-        if pipeline is None and fuse is None and _autotune_enabled(autotune):
+        amode = _autotune_mode(autotune) \
+            if pipeline is None and fuse is None else None
+        if amode == "joint":
+            # cost-model-ranked joint search over fusion x bucket grid x
+            # quantize op subset (autotune.tune_serve_joint): the model
+            # ranks the whole space, only a shortlist is measured, the
+            # winner persists per (symbol, shapes, quantize, topology).
+            # The winning grid replaces the default bucket chain (an
+            # explicit batch_buckets= argument pins the grid — only the
+            # other axes are searched then)
+            from ..autotune import tune_serve_joint
+            fuse, win_buckets, quantize, pipeline = tune_serve_joint(
+                sym_json, params, self._shapes_tpl, self._buckets,
+                data_name=data_name, quantize=quantize,
+                calib_data=calib_data, u8_wire=u8_wire,
+                dev=(dev_type, dev_id), name=name,
+                explicit_buckets=explicit_buckets)
+            if win_buckets != self._buckets:
+                self._buckets = win_buckets
+                self.max_batch_size = self._buckets[-1]
+                self._shapes_by_bucket = {b: self._bucket_shapes(b)
+                                          for b in self._buckets}
+            autotuned = True
+        elif amode is not None:
             # measurement-driven pipeline-variant choice (fusion on/off
             # around the same fold/CSE/DCE[/quantize] spine); the winner
             # is persisted per (symbol, shapes, quantize, topology) and

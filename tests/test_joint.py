@@ -1,0 +1,504 @@
+"""mxnet_tpu.autotune joint tuner + shared cost model (tier-1, CPU).
+
+ISSUE 20 contracts: the cost model fits DETERMINISTICALLY from the
+store's own audit logs (same samples -> same coefficients, in-process
+and across fresh subprocesses); ``JointTuner`` measures only the
+predicted-best shortlist, in prediction order; a store hit applies with
+zero featurize/measure calls AND zero XLA compiles; the persisted audit
+log replays to the persisted winner through ``select_best``; a
+cost-model version bump invalidates stored winners instead of
+resurrecting them; the store enforces an LRU entry cap
+(``MXNET_AUTOTUNE_STORE_MAX``); and the ``Module.fit(autotune="joint")``
+/ ``ServeEngine(autotune="joint")`` entries rank a joint space at least
+10x larger than what they measure.
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autotune as at
+from mxnet_tpu.autotune import load_config, save_config, select_best
+from mxnet_tpu.autotune import costmodel as cm
+from mxnet_tpu.autotune.costmodel import (AUDIT_KEYS, COSTMODEL_VERSION,
+                                          FEATURE_NAMES, CostModel,
+                                          analytic_cost, clean_config,
+                                          features)
+from mxnet_tpu.autotune.joint import (JointTuner, _fit_space,
+                                      default_shortlist, tune_fit_joint)
+from common.compile_guard import assert_no_compiles
+
+IN_DIM = 8
+HIDDEN = 16
+CLASSES = 4
+
+
+@pytest.fixture(autouse=True)
+def _isolated_store(tmp_path, monkeypatch):
+    """Every test gets its own store AND a cold model cache — the
+    process-wide model memo would otherwise leak one test's training
+    set into the next test's ranking."""
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    with cm._model_lock:
+        cm._MODELS.clear()
+    yield
+    with cm._model_lock:
+        cm._MODELS.clear()
+
+
+def _net():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=HIDDEN, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="act1")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _module(batch=8):
+    rng = np.random.RandomState(0)
+    X = rng.rand(4 * batch, IN_DIM).astype(np.float32)
+    y = rng.randint(0, CLASSES, 4 * batch).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    return mod, it
+
+
+def _samples(n=10):
+    """Synthetic featurized measurements: cost is a deterministic
+    function of the features, so any correct fit ranks them back."""
+    out = []
+    for i in range(n):
+        k = (i % 4) + 1
+        feat = features(gflops=float(i + 1), hbm_gb=0.1 * (i + 1),
+                        superstep_k=float(k), inv_k=1.0 / k,
+                        unroll=float((i % 2) + 1))
+        out.append((feat, 1e-3 * (i + 1) * (1.0 + 0.1 * k)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# feature schema + analytic prior
+
+
+def test_features_schema_and_clean_config():
+    vec = features(gflops=2.0, superstep_k=4, inv_k=0.25)
+    assert len(vec) == len(FEATURE_NAMES)
+    assert vec[0] == 1.0                              # bias always set
+    assert vec[FEATURE_NAMES.index("gflops")] == 2.0
+    assert vec[FEATURE_NAMES.index("remat")] == 0.0   # unnamed axes 0
+    with pytest.raises(ValueError):
+        features(not_a_feature=1.0)                   # schema drift is loud
+    audited = {"superstep": 4, "_feat": vec, "est_s": 0.1,
+               "shortlisted": True, "parity": True}
+    assert clean_config(audited) == {"superstep": 4}
+    assert set(AUDIT_KEYS) & set(audited)
+
+
+def test_analytic_cost_orders_the_obvious():
+    cheap = features(gflops=1.0)
+    dear = features(gflops=100.0)
+    assert analytic_cost(cheap) < analytic_cost(dear)
+    # superstep amortizes dispatch; remat pays an extra forward
+    k1 = features(gflops=1.0, superstep_k=1, inv_k=1.0)
+    k8 = features(gflops=1.0, superstep_k=8, inv_k=0.125, unroll=1)
+    assert analytic_cost(k8) < analytic_cost(k1)
+    rem = features(gflops=1.0, remat=1.0)
+    assert analytic_cost(rem) > analytic_cost(cheap)
+
+
+# ---------------------------------------------------------------------------
+# cost-model determinism
+
+
+def test_costmodel_fit_is_deterministic():
+    samples = _samples(12)
+    m1 = CostModel("test-backend").fit(samples)
+    m2 = CostModel("test-backend").fit(list(samples))
+    assert m1.trained and m2.trained and m1.n == m2.n == 12
+    assert np.array_equal(m1.coef, m2.coef)           # bit for bit
+    probe = features(gflops=3.5, superstep_k=4, inv_k=0.25, unroll=2)
+    assert m1.predict(probe) == m2.predict(probe)
+    # under MIN_SAMPLES the model degrades to the analytic prior
+    m3 = CostModel("test-backend").fit(samples[:CostModel.MIN_SAMPLES - 1])
+    assert not m3.trained
+    assert m3.predict(probe) == analytic_cost(probe)
+
+
+def test_costmodel_pickle_roundtrip_corrupt_and_stale(tmp_path):
+    m = CostModel("test-backend").fit(_samples(12))
+    path = cm.save_model(m)
+    assert os.path.dirname(path) == str(tmp_path)
+    loaded = cm.load_model("test-backend")
+    assert loaded is not None and loaded.n == m.n
+    assert np.array_equal(loaded.coef, m.coef)
+    # corrupt pickle: warn, unlink, retrain-from-None
+    with open(path, "wb") as f:
+        f.write(b"\x80not a pickle")
+    with pytest.warns(UserWarning):
+        assert cm.load_model("test-backend") is None
+    assert not os.path.exists(path)
+    # stale version stamp: same story
+    with open(path, "wb") as f:
+        pickle.dump({"version": 99, "features": FEATURE_NAMES,
+                     "backend": "test-backend", "n": 12,
+                     "coef": m.coef.tolist()}, f)
+    with pytest.warns(UserWarning):
+        assert cm.load_model("test-backend") is None
+    assert not os.path.exists(path)
+
+
+def test_refit_from_store_reads_the_audit_logs():
+    for i, (feat, cost) in enumerate(_samples(10)):
+        save_config("seed%d" % i, {"i": i}, cost,
+                    log=[(dict({"i": i}, _feat=feat), cost)])
+    model = cm.refit_from_store("test-backend")
+    assert model.trained and model.n == 10
+    # gate-failure (-1.0) and unmeasured entries never train the model
+    save_config("seedx", {"i": 99}, 0.1,
+                log=[({"i": 99, "_feat": _samples(1)[0][0],
+                       "parity": False}, -1.0)])
+    assert cm.refit_from_store("test-backend").n == 10
+
+
+_SUBPROC = textwrap.dedent("""
+    import os, sys
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu.autotune import costmodel as cm
+    probe = cm.features(gflops=3.5, superstep_k=4, inv_k=0.25, unroll=2)
+    mode = sys.argv[1]
+    if mode == "refit":
+        m = cm.refit_from_store()
+    else:
+        m = cm.get_model()          # memory -> pickle -> store
+    assert m.trained, "expected a trained model, n=%d" % m.n
+    print("COEF " + ",".join("%.17g" % c for c in m.coef))
+    print("PRED %.17g" % m.predict(probe))
+""")
+
+
+@pytest.mark.slow
+def test_costmodel_determinism_across_fresh_subprocesses(tmp_path):
+    """The acceptance bar: two FRESH processes refit from the same
+    store to the same coefficients, and a third that only loads the
+    persisted pickle predicts the identical number."""
+    for i, (feat, cost) in enumerate(_samples(10)):
+        save_config("seed%d" % i, {"i": i}, cost,
+                    log=[(dict({"i": i}, _feat=feat), cost)])
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               MXNET_AUTOTUNE_DIR=str(tmp_path))
+
+    def run_child(mode):
+        res = subprocess.run([sys.executable, "-c", _SUBPROC, mode],
+                             capture_output=True, text=True, timeout=600,
+                             env=env, cwd=os.path.dirname(
+                                 os.path.dirname(os.path.abspath(__file__))))
+        assert res.returncode == 0, res.stdout + res.stderr
+        lines = {ln.split()[0]: ln for ln in res.stdout.splitlines()
+                 if ln.startswith(("COEF", "PRED"))}
+        return lines["COEF"], lines["PRED"]
+
+    coef1, pred1 = run_child("refit")
+    coef2, pred2 = run_child("refit")           # fresh process, same store
+    assert coef1 == coef2 and pred1 == pred2
+    load_coef, load_pred = run_child("load")    # pickle written by child 1
+    assert load_coef == coef1 and load_pred == pred1
+
+
+# ---------------------------------------------------------------------------
+# JointTuner: shortlist order, audit log, store hit, gate
+
+
+def _fake_space(gflops_by_c):
+    """Candidates {"c": i} whose PREDICTED cost (untrained model = the
+    analytic prior) is ordered by the gflops value assigned to each."""
+    cands = [{"c": i} for i in range(len(gflops_by_c))]
+
+    def featurize(cfg):
+        return features(gflops=float(gflops_by_c[cfg["c"]]))
+
+    return cands, featurize
+
+
+def test_shortlist_respects_prediction_order():
+    gflops = [5.0, 1.0, 4.0, 2.0, 3.0]          # prediction order: 1,3,4,2,0
+    cands, featurize = _fake_space(gflops)
+    measured = []
+    costs = {1: 0.5, 3: 0.2}
+
+    def measure(cfg):
+        measured.append(cfg["c"])
+        return costs[cfg["c"]]
+
+    tuner = JointTuner("t-order", "key-order", persist=True, shortlist=2)
+    best, cost = tuner.tune(cands, featurize, measure)
+    # only the predicted-top-2 ran, in prediction order — the whole
+    # point of the cost model is that 1 and 3 ran and 0 never did
+    assert measured == [1, 3]
+    assert best == {"c": 3} and cost == 0.2     # select_best over MEASURED
+    doc = load_config("key-order", model_version=COSTMODEL_VERSION)
+    assert doc["config"] == {"c": 3}
+    assert doc["meta"]["space_size"] == 5 and doc["meta"]["measured"] == 2
+    # full audit: measured entries carry features + prediction,
+    # unmeasured carry the prediction and shortlisted=False at cost -1
+    log = [(dict(c), s) for c, s in doc["log"]]
+    assert len(log) == 5
+    for c, s in log[:2]:
+        assert len(c["_feat"]) == len(FEATURE_NAMES) and "est_s" in c
+        assert s > 0
+    for c, s in log[2:]:
+        assert c["shortlisted"] is False and s == -1.0 and "_feat" not in c
+
+
+def test_joint_winner_replays_from_audit_log():
+    cands, featurize = _fake_space([3.0, 1.0, 2.0])
+    tuner = JointTuner("t-replay", "key-replay", persist=True, shortlist=2)
+    best, _ = tuner.tune(cands, featurize,
+                         lambda cfg: 0.1 * (cfg["c"] + 1))
+    doc = load_config("key-replay", model_version=COSTMODEL_VERSION)
+    # the stored log IS the decision: replaying the measured entries
+    # (cost >= 0) through select_best reproduces the stored winner
+    replayed, _ = select_best([(c, s) for c, s in doc["log"] if s >= 0])
+    assert clean_config(replayed) == doc["config"] == best
+
+
+def test_store_hit_zero_work_and_zero_compiles():
+    cands, featurize = _fake_space([2.0, 1.0, 3.0])
+    calls = {"feat": 0, "meas": 0}
+
+    def counting_featurize(cfg):
+        calls["feat"] += 1
+        return featurize(cfg)
+
+    def measure(cfg):
+        calls["meas"] += 1
+        return 0.1 * (cfg["c"] + 1)
+
+    t1 = JointTuner("t-hit", "key-hit", persist=True, shortlist=2)
+    t1.tune(cands, counting_featurize, measure)
+    first = dict(calls)
+    assert first["meas"] == 2 and first["feat"] == 3
+    t2 = JointTuner("t-hit", "key-hit", persist=True, shortlist=2)
+    with assert_no_compiles("joint store hit"):
+        best2, _ = t2.tune(cands, counting_featurize, measure)
+    assert calls == first                       # ZERO new work
+    assert t2.stats.report()["source"] == "cache"
+    assert best2 == {"c": 0}                    # cheapest MEASURED cost
+    # a winner outside the new candidate space re-measures
+    t3 = JointTuner("t-hit", "key-hit", persist=True, shortlist=2)
+    t3.tune([{"c": 7}, {"c": 8}],
+            lambda c: features(gflops=1.0), measure)
+    assert calls["meas"] == first["meas"] + 2
+
+
+def test_gate_failures_logged_and_never_win():
+    cands, featurize = _fake_space([1.0, 2.0, 3.0])
+
+    def gate(cfg):
+        return cfg["c"] != 0                    # the predicted-best fails
+
+    measured = []
+
+    def measure(cfg):
+        measured.append(cfg["c"])
+        return 0.1
+
+    tuner = JointTuner("t-gate", "key-gate", persist=True, shortlist=2)
+    best, _ = tuner.tune(cands, featurize, measure, gate=gate)
+    assert tuner.gate_failures == 1
+    assert 0 not in measured and best["c"] != 0
+    doc = load_config("key-gate", model_version=COSTMODEL_VERSION)
+    gated = [(c, s) for c, s in doc["log"] if dict(c).get("parity") is False]
+    assert len(gated) == 1 and gated[0][1] == -1.0
+    assert dict(gated[0][0])["c"] == 0
+    # every candidate failing the gate is an error, not a silent winner
+    with pytest.raises(mx.base.MXNetError):
+        JointTuner("t-gate2", "key-gate2").tune(
+            cands, featurize, measure, gate=lambda c: False)
+
+
+def test_shortlist_env_knob(monkeypatch):
+    monkeypatch.delenv("MXNET_AUTOTUNE_SHORTLIST", raising=False)
+    assert default_shortlist() == 3
+    monkeypatch.setenv("MXNET_AUTOTUNE_SHORTLIST", "5")
+    assert default_shortlist() == 5
+    monkeypatch.setenv("MXNET_AUTOTUNE_SHORTLIST", "0")
+    assert default_shortlist() == 1             # at least one measurement
+
+
+# ---------------------------------------------------------------------------
+# store: model-version invalidation + LRU entry cap
+
+
+def test_model_version_bump_invalidates_stored_winner(tmp_path):
+    cands, featurize = _fake_space([2.0, 1.0])
+    meas = []
+    tuner = JointTuner("t-ver", "key-ver", persist=True, shortlist=1)
+    tuner.tune(cands, featurize, lambda c: meas.append(1) or 0.1)
+    assert len(meas) == 1
+    # an entry ranked by a DIFFERENT model version is stale: dropped on
+    # load (with a warning), never resurrected
+    path = at.store.config_path("key-ver")
+    with open(path) as f:
+        doc = json.load(f)
+    doc["model_version"] = 99
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.warns(UserWarning):
+        assert load_config("key-ver",
+                           model_version=COSTMODEL_VERSION) is None
+    assert not os.path.exists(path)
+    # ... and the tuner re-measures instead of applying the stale winner
+    t2 = JointTuner("t-ver", "key-ver", persist=True, shortlist=1)
+    t2.tune(cands, featurize, lambda c: meas.append(1) or 0.1)
+    assert len(meas) == 2
+    # an unstamped load (plain Autotuner path) still reads its entries
+    assert load_config("key-ver") is not None
+
+
+def test_store_entry_cap_evicts_lru(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE_STORE_MAX", "0")   # unbounded
+    for i in range(5):
+        p = save_config("k%d" % i, {"i": i}, 0.1)
+        os.utime(p, (100 + i, 100 + i))         # deterministic ages
+    monkeypatch.setenv("MXNET_AUTOTUNE_STORE_MAX", "3")
+    save_config("k5", {"i": 5}, 0.1)            # -> evict oldest down to 3
+    left = {n for n in os.listdir(str(tmp_path)) if n.endswith(".json")}
+    assert left == {"k3.json", "k4.json", "k5.json"}
+    # a LOAD is a use: touching k3 promotes it past k4 in the LRU order
+    os.utime(at.store.config_path("k4"), (200, 200))
+    os.utime(at.store.config_path("k5"), (201, 201))
+    assert load_config("k3") is not None        # utime -> now
+    save_config("k6", {"i": 6}, 0.1)
+    left = {n for n in os.listdir(str(tmp_path)) if n.endswith(".json")}
+    assert "k3.json" in left and "k4.json" not in left
+
+
+# ---------------------------------------------------------------------------
+# fit-side joint space + the Module.fit entry
+
+
+def test_fit_space_is_joint_and_semantics_preserving():
+    space = _fit_space((1, 2, 3, 4, 6, 8, 12, 16))
+    assert len(space) == 40
+    assert all(set(c) == {"superstep", "unroll", "remat"} for c in space)
+    assert all(c["unroll"] <= c["superstep"] for c in space)
+    assert all(c["unroll"] == 1 for c in space if c["superstep"] == 1)
+    # the acceptance ratio: the joint space is >= 10x the default
+    # shortlist, so the cost model prunes >= 90% of the measurements
+    assert len(space) >= 10 * default_shortlist()
+
+
+def test_tune_fit_joint_measures_shortlist_and_caches():
+    mod, _it = _module()
+    cfg = tune_fit_joint(mod, trials=1, shortlist=1)
+    assert set(cfg) == {"superstep", "unroll", "remat"}
+    assert cfg["unroll"] <= cfg["superstep"]
+    keys = [k for k in at.list_configs()]
+    assert len(keys) == 1
+    doc = load_config(keys[0], model_version=COSTMODEL_VERSION)
+    assert doc["meta"]["measured"] == 1
+    assert doc["meta"]["space_size"] == 40
+    assert doc["meta"]["space_size"] >= 10 * doc["meta"]["measured"]
+    # winner replay: the audit log reproduces the stored config
+    replayed, _ = select_best([(c, s) for c, s in doc["log"] if s >= 0])
+    assert clean_config(replayed) == doc["config"]
+    # the winner applies to the module's knob surfaces
+    assert mod.apply_joint_config(cfg) is True
+    assert mod._superstep_unroll == cfg["unroll"]
+    assert bool(mod._fused._remat) == cfg["remat"]
+    # second run on the same module: store hit, ZERO measurements and
+    # ZERO XLA compiles (the AOT featurization baseline is lazy)
+    with assert_no_compiles("fit:joint store hit"):
+        cfg2 = tune_fit_joint(mod, trials=1, shortlist=1)
+    assert cfg2 == cfg
+    rep = mx.profiler.autotune_report()
+    mine = [v for v in rep.values() if v["tuner"] == "fit:joint"]
+    assert mine[-1]["source"] == "cache"
+
+
+def test_fit_autotune_joint_end_to_end(monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE_SHORTLIST", "2")
+    mod, it = _module()
+    mod2 = mx.mod.Module(_net(), context=mx.cpu())
+    it.reset()
+    mod2.fit(it, num_epoch=1, autotune="joint",
+             optimizer_params={"learning_rate": 0.1})
+    assert at.list_configs()                    # winner persisted
+    arg, _aux = mod2.get_params()
+    for v in arg.values():
+        assert np.isfinite(v.asnumpy()).all()
+    rep = mx.profiler.autotune_report()
+    mine = [v for v in rep.values() if v["tuner"] == "fit:joint"]
+    assert mine and mine[-1]["source"] == "measured"
+    assert len([1 for _c, s in mine[-1]["trials"] if s >= 0]) <= 2
+    # the cost model trained... shows up in the profiler lifecycle
+    rep = mx.profiler.costmodel_report()
+    assert rep["version"] == COSTMODEL_VERSION and rep["loaded"]
+    assert "costmodel" in mx.profiler.unified_report()
+    assert "costmodel" in mx.profiler.costmodel_report_str()
+
+
+# ---------------------------------------------------------------------------
+# serve-side joint entry
+
+
+def test_serve_autotune_joint_parity_and_cache():
+    from mxnet_tpu.serve import ServeEngine
+    rng = np.random.RandomState(0)
+    params = {"fc1_weight": (rng.randn(HIDDEN, IN_DIM) * 0.3
+                             ).astype(np.float32),
+              "fc1_bias": np.zeros(HIDDEN, np.float32),
+              "fc2_weight": (rng.randn(CLASSES, HIDDEN) * 0.3
+                             ).astype(np.float32),
+              "fc2_bias": np.zeros(CLASSES, np.float32)}
+    shapes = {"data": (1, IN_DIM), "softmax_label": (1,)}
+    net = _net()
+    ref = ServeEngine(net, dict(params), shapes, batch_buckets=(1, 2),
+                      name="tj-ref")
+    eng = ServeEngine(net, dict(params), shapes, batch_buckets=(1, 2),
+                      name="tj-at", autotune="joint")
+    try:
+        # explicit buckets: the grid axis collapses to the caller's grid
+        assert eng._buckets == (1, 2)
+        X = rng.rand(5, IN_DIM).astype(np.float32)
+        for x in X:
+            np.testing.assert_array_equal(eng.predict(x, timeout=60),
+                                          ref.predict(x, timeout=60))
+    finally:
+        eng.close()
+        ref.close()
+    rep = mx.profiler.autotune_report()
+    mine = [v for v in rep.values() if v["tuner"] == "serve:joint"]
+    assert mine and mine[-1]["source"] == "measured"
+    assert "fuse" in mine[-1]["best"] and "buckets" in mine[-1]["best"]
+    # second engine of the same model: store hit
+    eng2 = ServeEngine(net, dict(params), shapes, batch_buckets=(1, 2),
+                       name="tj-at2", autotune="joint")
+    eng2.close()
+    rep = mx.profiler.autotune_report()
+    mine = [v for v in rep.values() if v["tuner"] == "serve:joint"]
+    assert mine[-1]["source"] == "cache"
+
+
+def test_autotune_mode_resolution(monkeypatch):
+    monkeypatch.delenv("MXNET_AUTOTUNE", raising=False)
+    assert at.mode(None) is None
+    assert at.mode(True) == "measure"
+    assert at.mode(False) is None
+    assert at.mode("joint") == "joint"
+    assert at.mode("measure") == "measure"
+    monkeypatch.setenv("MXNET_AUTOTUNE", "joint")
+    assert at.mode(None) == "joint"
+    monkeypatch.setenv("MXNET_AUTOTUNE", "1")
+    assert at.mode(None) == "measure"
+    monkeypatch.setenv("MXNET_AUTOTUNE", "0")
+    assert at.mode(None) is None

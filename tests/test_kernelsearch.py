@@ -1,0 +1,240 @@
+"""mxnet_tpu.autotune.kernelsearch: parity-gated Pallas tiling search
+(tier-1, CPU — kernels run in interpret mode).
+
+ISSUE 20 contracts: EVERY candidate in a shape class is interpret-mode
+**bitwise** equal to its pure-jnp twin (and allclose to the independent
+dense reference) before it may win; a candidate failing the parity gate
+is logged (``"parity": False``) and can never be selected; winners
+persist per (family, shape class, backend) and reload with zero
+measurements; ``ops.pallas_kernels`` resolves winners at call time only
+under ``MXNET_KERNEL_SEARCH=1`` (explicit block arguments always win).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autotune as at
+from mxnet_tpu.autotune import costmodel as cm
+from mxnet_tpu.autotune import kernelsearch as ks
+from mxnet_tpu.autotune.costmodel import COSTMODEL_VERSION
+from mxnet_tpu.ops import pallas_kernels as pk
+
+jnp = pytest.importorskip("jax.numpy")
+if not pk.HAS_PALLAS:                            # pragma: no cover
+    pytest.skip("pallas unavailable in this JAX build",
+                allow_module_level=True)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_store(tmp_path, monkeypatch):
+    """Own store + cold model memo + cold winner cache per test: the
+    winner cache memoizes negative lookups, so a stale entry would make
+    a freshly persisted winner invisible."""
+    monkeypatch.setenv("MXNET_AUTOTUNE_DIR", str(tmp_path))
+    with cm._model_lock:
+        cm._MODELS.clear()
+    with ks._cache_lock:
+        ks._best_cache.clear()
+    yield
+    with cm._model_lock:
+        cm._MODELS.clear()
+    with ks._cache_lock:
+        ks._best_cache.clear()
+
+
+def _flash_candidates(t):
+    """The exact candidate set search_flash enumerates for T."""
+    lim = pk._round_up(t, 8)
+    seen = []
+    for bq in ks._FLASH_BLOCK_Q:
+        for bk in ks._FLASH_BLOCK_K:
+            eff = (min(bq, lim), min(bk, lim))
+            if eff not in seen:
+                seen.append(eff)
+    return seen
+
+
+def _probe_qkv(b, t, h, d, dtype=np.float32):
+    rng = np.random.RandomState(0)
+    return [jnp.asarray(rng.randn(b, t, h, d).astype(dtype))
+            for _ in range(3)]
+
+
+# ---------------------------------------------------------------------------
+# the parity gate itself: every candidate, every shape class
+
+
+@pytest.mark.parametrize("t,causal", [(40, False), (40, True), (64, True)])
+def test_flash_parity_every_candidate(t, causal):
+    """Bitwise: the interpret-mode kernel == the blockwise jnp twin for
+    EVERY tiling candidate (the tiling permutes no arithmetic), and
+    allclose to the independent dense reference (the twin itself is
+    attention).  T=40 exercises the ragged pad/mask path, T=64 the
+    aligned one."""
+    from mxnet_tpu.parallel.ring import attention_reference
+    q, k, v = _probe_qkv(1, t, 1, 8)
+    ref = attention_reference(q, k, v, causal=causal)
+    cands = _flash_candidates(t)
+    assert len(cands) >= 2
+    for bq, bk in cands:
+        got = pk.flash_attention(q, k, v, causal=causal, block_q=bq,
+                                 block_k=bk, interpret=True)
+        twin = ks._flash_twin(q, k, v, causal, bq, bk)
+        assert np.array_equal(np.asarray(got), np.asarray(twin)), \
+            "flash (%d, %d) not bitwise-equal to its twin" % (bq, bk)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5)
+
+
+@pytest.mark.parametrize("act,out_scale", [("relu", None), ("tanh", None),
+                                           ("relu", 0.05)])
+def test_fc_parity_every_candidate(act, out_scale):
+    m, k, n = 8, 128, 256
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+    w = jnp.asarray(rng.randn(n, k).astype(np.float32))
+    bias = jnp.asarray(rng.randn(n).astype(np.float32))
+    cands = [bn for bn in ks._FC_BLOCK_N if n % bn == 0]
+    assert cands == [128, 256]
+    for bn in cands:
+        got = pk.fused_fc_epilogue(x, w, bias, act, out_scale=out_scale,
+                                   block_n=bn, interpret=True)
+        assert got is not None
+        twin = ks._fc_twin(x, w, bias, act, out_scale, bn)
+        assert np.array_equal(np.asarray(got), np.asarray(twin)), \
+            "fc block_n=%d not bitwise-equal to its twin" % bn
+        if out_scale is not None:
+            assert np.asarray(got).dtype == np.int8
+
+
+def test_paged_parity_kernel_vs_twin_and_reference():
+    s, c, h, d, n_blocks, bt = 2, 2, 1, 8, 4, 8
+    rng = np.random.RandomState(0)
+    k_pool = jnp.asarray(rng.randn(n_blocks, bt, h, d).astype(np.float32))
+    v_pool = jnp.asarray(rng.randn(n_blocks, bt, h, d).astype(np.float32))
+    q = jnp.asarray(rng.randn(s, c, h, d).astype(np.float32))
+    nb = (n_blocks - 1) // s
+    pages = jnp.asarray(rng.permutation(n_blocks - 1)[:s * nb]
+                        .reshape(s, nb).astype(np.int32))
+    lengths = jnp.asarray(rng.randint(c, nb * bt + 1, size=(s,))
+                          .astype(np.int32))
+    q_pos = lengths[:, None] - c + jnp.arange(c, dtype=jnp.int32)[None]
+    got = pk.paged_attention(q, k_pool, v_pool, pages, lengths,
+                             q_pos=q_pos, causal=True, interpret=True)
+    twin = ks._paged_twin(q, k_pool, v_pool, pages, lengths, q_pos, True)
+    assert np.array_equal(np.asarray(got), np.asarray(twin))
+    ref = pk._paged_attention_dense(q, k_pool, v_pool, pages, lengths,
+                                    q_pos, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=3e-5)
+
+
+# ---------------------------------------------------------------------------
+# the search: gate exclusion, persistence, reload
+
+
+def test_search_flash_persists_and_reloads(tmp_path):
+    cls = ks.flash_class(40, 8, False, np.float32)
+    assert ks.best_config(cls) is None          # nothing persisted yet
+    with ks._cache_lock:                        # drop the negative memo
+        ks._best_cache.clear()
+    win = ks.search_flash(1, 40, 1, 8, causal=False, trials=1, shortlist=1)
+    assert set(win) == {"block_q", "block_k"}
+    assert (win["block_q"], win["block_k"]) in _flash_candidates(40)
+    # persisted under the shape class; call-time lookup sees it
+    assert ks.best_config(cls) == win
+    doc = at.load_config(ks._class_key(cls),
+                         model_version=COSTMODEL_VERSION)
+    assert doc["config"] == win and doc["meta"]["measured"] == 1
+    assert doc["meta"]["space_size"] == len(_flash_candidates(40))
+    # second search: store hit, zero measurements
+    win2 = ks.search_flash(1, 40, 1, 8, causal=False, trials=1, shortlist=1)
+    assert win2 == win
+    rep = mx.profiler.autotune_report()
+    mine = [v for v in rep.values() if v["tuner"] == "kernelsearch:flash"]
+    assert mine[-1]["source"] == "cache"
+    # the class buckets T to its pow2 ceiling: T=200 and T=256 share a
+    # winner, T=257 does not
+    assert ks.flash_class(200, 8, False, np.float32) \
+        == ks.flash_class(256, 8, False, np.float32)
+    assert ks.flash_class(257, 8, False, np.float32) \
+        != ks.flash_class(256, 8, False, np.float32)
+
+
+def test_search_fc_gate_excludes_parity_failures(monkeypatch):
+    """A candidate whose kernel output is not bitwise-equal to its twin
+    is logged and can NEVER win, even if it would measure fastest."""
+    real_twin = ks._fc_twin
+    fails_before = ks.parity_fail_total()
+
+    def sabotaged_twin(x, w, b, act_type, out_scale, block_n):
+        out = real_twin(x, w, b, act_type, out_scale, block_n)
+        return out + 1 if block_n == 128 else out
+
+    monkeypatch.setattr(ks, "_fc_twin", sabotaged_twin)
+    win = ks.search_fc(8, 128, 256, act_type="relu", trials=1, shortlist=2)
+    assert win == {"block_n": 256}              # 128 failed the gate
+    assert ks.parity_fail_total() == fails_before + 1
+    cls = ks.fc_class(256, 128, "relu", False, np.float32)
+    doc = at.load_config(ks._class_key(cls),
+                         model_version=COSTMODEL_VERSION)
+    gated = [(c, s) for c, s in doc["log"]
+             if dict(c).get("parity") is False]
+    assert len(gated) == 1 and gated[0][1] == -1.0
+    assert dict(gated[0][0])["block_n"] == 128
+    # every candidate failing: an error, never a silent un-gated winner
+    monkeypatch.setattr(ks, "_fc_twin",
+                        lambda *a: real_twin(*a) + 1)
+    with pytest.raises(mx.base.MXNetError):
+        ks.search_fc(8, 128, 256, act_type="tanh", trials=1)
+    assert ks.parity_fail_total() == fails_before + 3
+
+
+def test_search_paged_picks_an_implementation():
+    win = ks.search_paged(2, 2, 1, 8, n_blocks=4, bt=8, trials=1,
+                          shortlist=2)
+    assert win["impl"] in ("kernel", "dense")
+    cls = ks.paged_class(8, 8, True, np.float32)
+    assert ks.best_config(cls) == win
+
+
+# ---------------------------------------------------------------------------
+# call-time resolution in ops.pallas_kernels
+
+
+def test_call_time_resolution_is_opt_in(monkeypatch):
+    win = ks.search_fc(8, 128, 256, act_type="relu", trials=1, shortlist=1)
+    # knob off: call sites never consult the store
+    monkeypatch.delenv("MXNET_KERNEL_SEARCH", raising=False)
+    assert pk._searched("fc", 256, 128, "relu", False, np.float32) is None
+    # knob on: the persisted winner resolves at call time ...
+    monkeypatch.setenv("MXNET_KERNEL_SEARCH", "1")
+    assert pk._searched("fc", 256, 128, "relu", False, np.float32) == win
+    # ... and an unsearched class resolves (and memoizes) to None
+    assert pk._searched("fc", 512, 128, "relu", False, np.float32) is None
+    # the winner drives the kernel: default-block call == explicit-block
+    # call with the winning tile, bitwise
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 128).astype(np.float32))
+    w = jnp.asarray(rng.randn(256, 128).astype(np.float32))
+    bias = jnp.asarray(rng.randn(256).astype(np.float32))
+    via_winner = pk.fused_fc_epilogue(x, w, bias, "relu", interpret=True)
+    explicit = pk.fused_fc_epilogue(x, w, bias, "relu",
+                                    block_n=win["block_n"], interpret=True)
+    assert np.array_equal(np.asarray(via_winner), np.asarray(explicit))
+
+
+def test_flash_call_time_winner(monkeypatch):
+    from mxnet_tpu.parallel.ring import attention_reference
+    win = ks.search_flash(1, 40, 1, 8, causal=True, trials=1, shortlist=1)
+    monkeypatch.setenv("MXNET_KERNEL_SEARCH", "1")
+    q, k, v = _probe_qkv(1, 40, 1, 8)
+    out = pk.flash_attention(q, k, v, causal=True, interpret=True)
+    want = pk.flash_attention(q, k, v, causal=True,
+                              block_q=win["block_q"],
+                              block_k=win["block_k"], interpret=True)
+    assert np.array_equal(np.asarray(out), np.asarray(want))
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(attention_reference(q, k, v, causal=True)), atol=2e-5)

@@ -260,6 +260,26 @@ GOOD_MOE_SCATTER = """
         return dispatch(x, slots, num_experts, capacity)
 """
 
+# ISSUE 20: a pallas_call outside ops/pallas_kernels never meets the
+# kernel search's bitwise parity gate — shipped kernels live in the one
+# module whose candidate tilings are twin-checked before persistence
+BAD_PALLAS = """
+    import jax
+    from jax.experimental import pallas as pl
+
+    def scale_op(x):
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+        return pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+"""
+GOOD_PALLAS = """
+    from mxnet_tpu.ops.pallas_kernels import flash_attention
+
+    def attend(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+"""
+
 FIXTURES = [
     ("donated-aliasing", BAD_DONATED, GOOD_DONATED),
     ("raw-jit", BAD_JIT, GOOD_JIT),
@@ -272,7 +292,26 @@ FIXTURES = [
     ("decode-host-sync", BAD_HOST_SYNC, GOOD_HOST_SYNC),
     ("unsealed-replay", BAD_UNSEALED, GOOD_UNSEALED),
     ("moe-raw-scatter", BAD_MOE_SCATTER, GOOD_MOE_SCATTER),
+    ("raw-pallas-call", BAD_PALLAS, GOOD_PALLAS),
 ]
+
+
+def test_raw_pallas_call_scope():
+    """ops/pallas_kernels OWNS shipped kernels (exempt by path); the rtc
+    user-kernel passthrough suppresses inline with a reason; anywhere
+    else the same call is a violation."""
+    assert "raw-pallas-call" not in _rules_hit(
+        BAD_PALLAS, rel="mxnet_tpu/ops/pallas_kernels.py")
+    assert "raw-pallas-call" in _rules_hit(
+        BAD_PALLAS, rel="mxnet_tpu/serve/engine.py")
+    suppressed = """
+        from jax.experimental import pallas as pl
+
+        def passthrough(kernel, out_shape):
+            # lint: allow(raw-pallas-call) — user-kernel passthrough
+            return pl.pallas_call(kernel, out_shape=out_shape)
+    """
+    assert "raw-pallas-call" not in _rules_hit(suppressed)
 
 
 def test_moe_raw_scatter_scope():

@@ -95,6 +95,14 @@ DEFAULT_LOWER_IS_BETTER = {
     # (moe_step_speedup) gates higher-is-better like every speedup, and
     # moe_expert_imbalance is absolutely ceilinged below
     "moe_step_ms", "moe_dense_step_ms",
+    # ISSUE 20 joint-autotune leg: search wall time and its
+    # amortization horizon (steps until the search pays for itself);
+    # autotune_joint_speedup gates higher-is-better like every
+    # speedup, and the kernel-search parity-gate failure count is
+    # zero-floored below — one bitwise-parity failure anywhere is a
+    # numerics regression, not a perf tradeoff
+    "autotune_search_s", "autotune_amortize_steps",
+    "kernelsearch_parity_fail",
 }
 
 # Discrete "gated at 0" metrics: a zero best prior means ANY nonzero
@@ -105,7 +113,7 @@ DEFAULT_LOWER_IS_BETTER = {
 ZERO_FLOOR = {
     "serve_router_restart_drops", "serve_mux_steady_compiles",
     "serve_failover_dropped", "llm_dropped_streams",
-    "online_promote_dropped",
+    "online_promote_dropped", "kernelsearch_parity_fail",
 }
 
 # Absolute ceilings, independent of any prior run: a newest value above

@@ -7,8 +7,8 @@ this image ships no linters, so both stages are vendored):
   the whole repo; C++ trailing whitespace / tabs-in-indent.
 * **analysis** — the AST rules in ``mxnet_tpu/analysis/linter.py``
   (donated-aliasing, raw-jit, raw-env, raw-time, unseeded-fork-rng,
-  raw-future-settle — each distilled from a CHANGES.md incident, see
-  docs/analysis.md) over ``mxnet_tpu/``.
+  raw-future-settle, raw-pallas-call, ... — each distilled from a
+  CHANGES.md incident, see docs/analysis.md) over ``mxnet_tpu/``.
 
 Usage::
 
